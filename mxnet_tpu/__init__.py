@@ -1,0 +1,19 @@
+"""mxnet_tpu — a TPU-native deep-learning framework with MXNet's capabilities.
+
+From-scratch rebuild of Apache MXNet 0.11.1's API surface and semantics
+(reference at /root/reference) on a JAX/XLA/Pallas execution model: eager
+NDArray ops dispatch through cached jit closures, Symbol.bind compiles whole
+graphs into single XLA computations, KVStore lowers to mesh collectives.
+See SURVEY.md for the layer map this follows.
+"""
+__version__ = '0.1.0'
+
+from . import base
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
+from . import ndarray
+from . import ndarray as nd
+from . import random
+from .random import seed  # noqa: F401
+from . import autograd
+from . import engine

@@ -1,0 +1,257 @@
+"""Imperative autograd — tape-based, VJP-chained.
+
+Reference: src/ndarray/autograd.{h,cc} (AutogradRuntime: thread-local
+train/record flags autograd.cc:45-48, MarkVariables:79, RecordOp:160,
+ComputeGradient:244) and python/mxnet/autograd.py (record/pause scopes,
+backward, grad_and_loss, Function).
+
+TPU-native design: the reference records an NNVM tape and replays it through
+a freshly-built GraphExecutor. Here each recorded op is executed via
+``jax.vjp`` — the vjp closure (an XLA-compiled pullback) IS the tape entry,
+so backward is a pure reverse walk accumulating cotangents; no graph executor
+needs to be constructed.
+"""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ['record', 'pause', 'train_mode', 'predict_mode', 'is_recording',
+           'is_training', 'mark_variables', 'backward', 'grad_and_loss', 'grad']
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, 'recording'):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(flag):
+    prev = _st().recording
+    _state.recording = flag
+    return prev
+
+
+def set_training(flag):
+    prev = _st().training
+    _state.training = flag
+    return prev
+
+
+class _RecordingScope:
+    def __init__(self, recording, training):
+        self._recording = recording
+        self._training = training
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._recording is not None:
+            st.recording = self._recording
+        if self._training is not None:
+            st.training = self._training
+        return self
+
+    def __exit__(self, *args):
+        _state.recording, _state.training = self._prev
+
+
+def record(train_mode=True):
+    """``with autograd.record():`` — reference python/mxnet/autograd.py:87."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingScope(None, True)
+
+
+def predict_mode():
+    return _RecordingScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: holds the vjp closure + links to parent nodes.
+
+    Parallels AGNodeEntry/AGNode in src/ndarray/autograd.h:40-70.
+    """
+    __slots__ = ('vjp_fn', 'parents', 'n_outputs', 'out_grads', 'n_grad_inputs',
+                 'head_ids')
+
+    def __init__(self, vjp_fn, parents, n_outputs, n_grad_inputs):
+        self.vjp_fn = vjp_fn
+        self.parents = parents          # list[TapeNode|None] aligned with grad inputs
+        self.n_outputs = n_outputs
+        self.n_grad_inputs = n_grad_inputs
+        self.out_grads = None           # list of cotangents, filled during backward
+
+
+class LeafNode:
+    """A marked variable (MarkVariables, autograd.cc:79)."""
+    __slots__ = ('array_ref', 'grad_req')
+
+    def __init__(self, array_ref, grad_req='write'):
+        self.array_ref = array_ref  # the NDArray whose .grad we accumulate into
+        self.grad_req = grad_req
+
+
+def record_op(vjp_fn, parent_entries, n_outputs, n_grad_inputs):
+    return TapeNode(vjp_fn, parent_entries, n_outputs, n_grad_inputs)
+
+
+def mark_variables(variables, gradients, grad_reqs='write'):
+    """Attach gradient buffers to variables (reference autograd.py:36)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, grad, req in zip(variables, gradients, grad_reqs):
+        var._grad = grad
+        var._leaf = LeafNode(var, req)
+
+
+def _toposort(heads):
+    """Reverse-topological order over TapeNodes reachable from heads."""
+    order = []
+    visited = set()
+    stack = [(n, False) for n in heads if isinstance(n, TapeNode)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            order.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for p, _ in node.parents:
+            if isinstance(p, TapeNode) and id(p) not in visited:
+                stack.append((p, False))
+    order.reverse()
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run the tape backward from head NDArrays.
+
+    Reference: MXAutogradBackwardEx (c_api_ndarray.cc:799) →
+    AutogradRuntime::ComputeGradient (autograd.cc:244). There the tape is
+    compiled into a GraphExecutor; here we chain the stored vjp closures.
+    """
+    from .ndarray.ndarray import NDArray
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    # Seed cotangents on the head nodes.
+    for h, hg in zip(heads, head_grads):
+        node = getattr(h, '_node', None)
+        if node is None:
+            leaf = getattr(h, '_leaf', None)
+            if leaf is not None and h._grad is not None:
+                g = hg._data if hg is not None else jnp.ones_like(h._data)
+                _accumulate_leaf(leaf, g)
+            continue
+        if node.out_grads is None:
+            node.out_grads = [None] * node.n_outputs
+        g = hg._data if hg is not None else jnp.ones_like(h._data)
+        idx = h._out_idx
+        node.out_grads[idx] = g if node.out_grads[idx] is None else node.out_grads[idx] + g
+
+    head_nodes = [h._node for h in heads if getattr(h, '_node', None) is not None]
+    order = _toposort(head_nodes)  # heads-first (reverse-topological)
+
+    for node in order:
+        if node.out_grads is None:
+            continue
+        cotangents = tuple(
+            g if g is not None else jnp.zeros(shape, dtype)
+            for g, (shape, dtype) in zip(node.out_grads, node.head_ids))
+        if node.n_outputs == 1:
+            in_grads = node.vjp_fn(cotangents[0])
+        else:
+            in_grads = node.vjp_fn(cotangents)
+        for (parent, out_idx), g in zip(node.parents, in_grads):
+            if parent is None or g is None:
+                continue
+            if isinstance(g, jax.Array) and g.dtype == jax.dtypes.float0:
+                continue
+            if isinstance(parent, LeafNode):
+                _accumulate_leaf(parent, g)
+            else:
+                if parent.out_grads is None:
+                    parent.out_grads = [None] * parent.n_outputs
+                og = parent.out_grads[out_idx]
+                parent.out_grads[out_idx] = g if og is None else og + g
+        if not retain_graph:
+            node.out_grads = None
+            node.vjp_fn = None
+
+    # Drop tape references from the heads so memory is freed.
+    if not retain_graph:
+        for h in heads:
+            if getattr(h, '_node', None) is not None:
+                h._node = None
+
+
+def _accumulate_leaf(leaf, g):
+    var = leaf.array_ref
+    if var._grad is None:
+        return
+    g = g.astype(var._grad._data.dtype)
+    if leaf.grad_req == 'add':
+        var._grad._data = var._grad._data + g
+    elif leaf.grad_req != 'null':
+        if getattr(var, '_fresh_grad', True):
+            var._grad._data = jnp.broadcast_to(g, var._grad.shape) if g.shape != var._grad.shape else g
+            var._fresh_grad = False
+        else:
+            var._grad._data = var._grad._data + g
+
+
+def reset_fresh_grads(variables):
+    for v in variables:
+        v._fresh_grad = True
+
+
+def grad_and_loss(func, argnum=None):
+    """Return a function computing both gradient and loss (reference autograd.py:257)."""
+    def wrapped(*args):
+        variables = list(args)
+        if argnum is not None:
+            argnums = [argnum] if isinstance(argnum, int) else argnum
+            variables = [args[i] for i in argnums]
+        for v in variables:
+            v.attach_grad()
+        with record():
+            outputs = func(*args)
+        backward([outputs] if not isinstance(outputs, (list, tuple)) else list(outputs))
+        grads = [v.grad for v in variables]
+        return grads, outputs
+    return wrapped
+
+
+def grad(func, argnum=None):
+    def wrapped(*args):
+        return grad_and_loss(func, argnum)(*args)[0]
+    return wrapped

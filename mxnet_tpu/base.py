@@ -1,0 +1,67 @@
+"""Shared plumbing: dtype tables, error type, attr normalization.
+
+Reference: python/mxnet/base.py (check_call/handles/string_types) — here there
+is no C-API ctypes boundary for the compute path (XLA is the backend), so this
+module only keeps the shared tables and helpers.
+"""
+import numpy as np
+
+__all__ = ['MXNetError', 'string_types', 'numeric_types']
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+
+
+class MXNetError(Exception):
+    """Error raised by the framework (reference: base.py MXNetError)."""
+
+
+# dtype <-> string tables (reference: ndarray/ndarray.py _DTYPE_NP_TO_MX/_DTYPE_MX_TO_NP)
+_DTYPE_STR = {
+    np.dtype('float32'): 'float32',
+    np.dtype('float64'): 'float64',
+    np.dtype('float16'): 'float16',
+    np.dtype('uint8'): 'uint8',
+    np.dtype('int8'): 'int8',
+    np.dtype('int32'): 'int32',
+    np.dtype('int64'): 'int64',
+    np.dtype('bool'): 'bool',
+}
+
+
+def np_dtype(dtype):
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, type) to np.dtype."""
+    if dtype is None:
+        return np.dtype('float32')
+    if isinstance(dtype, str) and dtype == 'bfloat16':
+        import jax.numpy as jnp
+        return jnp.bfloat16
+    return np.dtype(dtype)
+
+
+def dtype_str(dtype):
+    d = np.dtype(dtype) if not isinstance(dtype, str) else dtype
+    return str(d) if not isinstance(d, str) else d
+
+
+def normalize_attrs(attrs):
+    """Make an attr dict hashable & canonical (lists/shapes -> tuples)."""
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, (list, tuple)):
+            out[k] = tuple(normalize_value(x) for x in v)
+        else:
+            out[k] = normalize_value(v)
+    return out
+
+
+def normalize_value(v):
+    if isinstance(v, np.generic):
+        return v.item()
+    if isinstance(v, (list, tuple)):
+        return tuple(normalize_value(x) for x in v)
+    return v
+
+
+def attr_key(attrs):
+    return tuple(sorted(attrs.items(), key=lambda kv: kv[0]))

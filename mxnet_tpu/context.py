@@ -1,0 +1,123 @@
+"""Device context — TPU-native analog of MXNet's Context.
+
+Reference: python/mxnet/context.py (Context, mx.cpu()/mx.gpu(), current_context)
+and include/mxnet/base.h (Context struct, dev_type/dev_id).
+
+Design: a Context names a JAX device. ``tpu(i)`` maps to the i-th TPU chip;
+``cpu(i)`` maps to the i-th host CPU device (with
+``--xla_force_host_platform_device_count=N`` this gives the multi-device-
+without-a-cluster testing story the reference got from ``mx.cpu(1..n)``,
+tests/python/unittest/test_multi_device_exec.py). ``gpu(i)`` is accepted for
+API compatibility and resolves to the best available accelerator.
+"""
+import threading
+
+import jax
+
+__all__ = ['Context', 'cpu', 'gpu', 'tpu', 'cpu_pinned', 'current_context', 'num_gpus', 'num_tpus']
+
+_thread_local = threading.local()
+
+
+class Context:
+    """Execution device. Immutable, hashable, usable as a `with` scope."""
+
+    devtype2str = {1: 'cpu', 2: 'gpu', 3: 'cpu_pinned', 4: 'tpu'}
+    devstr2type = {'cpu': 1, 'gpu': 2, 'cpu_pinned': 3, 'tpu': 4}
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            if isinstance(device_type, str):
+                device_type = self.devstr2type[device_type]
+            self.device_typeid = device_type
+            self.device_id = device_id
+        self._jax_device = None
+
+    @property
+    def device_type(self):
+        return self.devtype2str[self.device_typeid]
+
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_typeid == other.device_typeid
+                and self.device_id == other.device_id)
+
+    def __repr__(self):
+        return '%s(%d)' % (self.device_type, self.device_id)
+
+    def __enter__(self):
+        if not hasattr(_thread_local, 'stack'):
+            _thread_local.stack = []
+        _thread_local.stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_value, traceback):
+        _thread_local.stack.pop()
+
+    # -- JAX mapping ------------------------------------------------------
+    def jax_device(self):
+        """Resolve this context to a concrete jax.Device (cached)."""
+        if self._jax_device is None:
+            self._jax_device = _resolve_device(self.device_type, self.device_id)
+        return self._jax_device
+
+    def empty_cache(self):
+        """MXNet API compat (GPU mem pool flush). No-op: XLA owns HBM."""
+
+
+def _platform_devices(platform):
+    try:
+        return jax.devices(platform)
+    except RuntimeError:
+        return []
+
+
+def _resolve_device(device_type, device_id):
+    if device_type == 'cpu' or device_type == 'cpu_pinned':
+        devs = _platform_devices('cpu')
+        if not devs:  # TPU-only runtime: fall back to default devices
+            devs = jax.devices()
+        return devs[device_id % len(devs)]
+    # accelerator request: prefer tpu, then gpu, then cpu (so tests run anywhere)
+    for plat in ('tpu', 'gpu', 'cpu'):
+        devs = _platform_devices(plat)
+        if devs:
+            return devs[device_id % len(devs)]
+    raise RuntimeError('no jax devices available')
+
+
+def cpu(device_id=0):
+    return Context('cpu', device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context('cpu_pinned', device_id)
+
+
+def gpu(device_id=0):
+    """Compatibility alias: resolves to the best available accelerator."""
+    return Context('gpu', device_id)
+
+
+def tpu(device_id=0):
+    return Context('tpu', device_id)
+
+
+def num_gpus():
+    return len(_platform_devices('gpu')) or len(_platform_devices('tpu'))
+
+
+def num_tpus():
+    return len(_platform_devices('tpu'))
+
+
+def current_context():
+    if getattr(_thread_local, 'stack', None):
+        return _thread_local.stack[-1]
+    return Context('cpu', 0)

@@ -1,0 +1,99 @@
+"""Engine façade — the dependency-scheduler API surface over JAX dispatch.
+
+Reference: include/mxnet/engine.h:93-268 + src/engine/ (ThreadedEngine,
+NaiveEngine). The reference's engine exists to order reads/writes on mutable
+buffers across worker threads. On TPU the compute path is XLA async dispatch
+over immutable buffers, so the ordering problem vanishes for device work;
+what remains (and what this module provides) is the *API*: WaitForAll /
+WaitForVar semantics, a bulk/naive mode switch (MXNET_ENGINE_TYPE), and a
+host-side work queue for genuinely stateful host tasks (IO prefetch,
+checkpoint writes) — see io.py's prefetcher for its use.
+"""
+import os
+import queue
+import threading
+
+import jax
+
+__all__ = ['push', 'wait_for_var', 'wait_for_all', 'engine_type', 'set_bulk_size']
+
+_engine_type = os.environ.get('MXNET_ENGINE_TYPE', 'ThreadedEngine')
+
+
+def engine_type():
+    return _engine_type
+
+
+def naive():
+    """True when MXNET_ENGINE_TYPE=NaiveEngine: synchronous execution for
+    debugging (reference engine.cc:32)."""
+    return _engine_type == 'NaiveEngine'
+
+
+class _HostWorker:
+    """Single background worker for host-side async tasks (the analog of the
+    reference's CPU worker pool, threaded_engine_perdevice.cc:44)."""
+
+    def __init__(self):
+        self._q = None
+        self._thread = None
+        self._lock = threading.Lock()
+
+    def _ensure(self):
+        with self._lock:
+            if self._thread is None:
+                self._q = queue.Queue()
+                self._thread = threading.Thread(target=self._loop, daemon=True)
+                self._thread.start()
+
+    def _loop(self):
+        while True:
+            fn, done = self._q.get()
+            try:
+                fn()
+            finally:
+                done.set()
+
+    def push(self, fn):
+        if naive():
+            fn()
+            ev = threading.Event()
+            ev.set()
+            return ev
+        self._ensure()
+        done = threading.Event()
+        self._q.put((fn, done))
+        return done
+
+
+_worker = _HostWorker()
+
+
+def push(fn, sync=False):
+    """Push a host-side task; returns an Event completing when done."""
+    ev = _worker.push(fn)
+    if sync:
+        ev.wait()
+    return ev
+
+
+def wait_for_var(arr):
+    """Engine::WaitForVar ≙ block on the array's buffer."""
+    arr.wait_to_read()
+
+
+def wait_for_all():
+    """Engine::WaitForAll (engine.h:180)."""
+    from .ndarray.ndarray import waitall
+    waitall()
+
+
+_bulk_size = int(os.environ.get('MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN', 15))
+
+
+def set_bulk_size(size):
+    """API compat: XLA fuses the whole graph; bulk segments are moot."""
+    global _bulk_size
+    prev = _bulk_size
+    _bulk_size = size
+    return prev

@@ -1,0 +1,83 @@
+"""mx.nd — imperative tensor namespace.
+
+Reference: python/mxnet/ndarray/__init__.py (ndarray + generated op module).
+"""
+import sys as _sys
+
+from .ndarray import (NDArray, array, zeros, ones, empty, full, arange,
+                      invoke, imperative_invoke, waitall, concatenate, stack,
+                      moveaxis, onehot_encode, from_jax)
+from . import register as _register
+from .utils import save, load
+from . import random  # noqa: F401
+from . import sparse  # noqa: F401
+from .sparse import csr_matrix, row_sparse_array
+
+_register.install_ops(globals())
+
+# method-style conveniences that MXNet exposes at module level
+from .ndarray import _binary as _nd_binary  # noqa: F401
+
+
+def add(lhs, rhs):
+    return lhs + rhs
+
+
+def subtract(lhs, rhs):
+    return lhs - rhs
+
+
+def multiply(lhs, rhs):
+    return lhs * rhs
+
+
+def divide(lhs, rhs):
+    return lhs / rhs
+
+
+def power(lhs, rhs):
+    return lhs ** rhs
+
+
+def maximum(lhs, rhs):
+    if isinstance(rhs, NDArray):
+        return invoke('broadcast_maximum', [lhs, rhs], {})
+    return invoke('_maximum_scalar', [lhs], {'scalar': float(rhs)})
+
+
+def minimum(lhs, rhs):
+    if isinstance(rhs, NDArray):
+        return invoke('broadcast_minimum', [lhs, rhs], {})
+    return invoke('_minimum_scalar', [lhs], {'scalar': float(rhs)})
+
+
+def equal(l, r):
+    return l == r
+
+
+def not_equal(l, r):
+    return l != r
+
+
+def greater(l, r):
+    return l > r
+
+
+def greater_equal(l, r):
+    return l >= r
+
+
+def lesser(l, r):
+    return l < r
+
+
+def lesser_equal(l, r):
+    return l <= r
+
+
+def negative(data):
+    return -data
+
+
+def true_divide(lhs, rhs):
+    return divide(lhs, rhs)

@@ -1,0 +1,638 @@
+"""NDArray — the mutable, async-dispatch tensor every layer passes around.
+
+Reference: include/mxnet/ndarray.h:93-1242 + src/ndarray/ndarray.cc +
+python/mxnet/ndarray/ndarray.py:150.
+
+TPU-native design (SURVEY.md §7): the reference's NDArray is a shared Chunk
+(storage handle + engine Var); all mutation is an engine push and reads
+synchronize via WaitToRead. Here the backing store is an immutable
+``jax.Array`` and "mutation" rebinds ``_data`` — JAX's async dispatch gives
+the same caller-returns-immediately pipelining the threaded engine provided,
+and ``wait_to_read()`` maps to ``block_until_ready()``. Write-after-read
+hazards cannot exist (buffers are immutable), which deletes the entire
+ThreadedVar dependency-queue machinery (threaded_engine.h:111-213) with no
+loss of semantics.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import autograd as _ag
+from .. import random as _random
+from ..base import MXNetError, np_dtype, normalize_attrs, numeric_types
+from ..context import Context, current_context
+from ..ops import registry as _reg
+
+__all__ = ['NDArray', 'array', 'zeros', 'ones', 'empty', 'full', 'arange',
+           'invoke', 'waitall', 'concatenate', 'moveaxis', 'onehot_encode',
+           'imperative_invoke', 'from_jax', 'stack']
+
+_recent = []  # small ring of recently-dispatched arrays, for waitall()
+_RECENT_MAX = 64
+
+
+def _track(data):
+    _recent.append(data)
+    if len(_recent) > _RECENT_MAX:
+        del _recent[:_RECENT_MAX // 2]
+
+
+def waitall():
+    """Block until all dispatched computation is done.
+
+    Reference: MXNDArrayWaitAll / Engine::WaitForAll (engine.h:180)."""
+    for d in _recent:
+        try:
+            jax.block_until_ready(d)
+        except Exception:  # deleted buffers are fine to skip
+            pass
+    del _recent[:]
+
+
+class NDArray:
+    """Multi-dimensional, context-bound array (reference ndarray.py:150)."""
+
+    __slots__ = ('_data', '_ctx', '_grad', '_leaf', '_node', '_out_idx',
+                 '_fresh_grad', '__weakref__')
+
+    def __init__(self, data, ctx=None):
+        self._data = data
+        self._ctx = ctx if ctx is not None else current_context()
+        self._grad = None
+        self._leaf = None
+        self._node = None
+        self._out_idx = 0
+        self._fresh_grad = True
+
+    # -- basic properties -------------------------------------------------
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 \
+            else jnp.bfloat16
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    @property
+    def stype(self):
+        return 'default'
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @property
+    def handle(self):
+        """Opaque-handle compat: the backing jax.Array."""
+        return self._data
+
+    def __repr__(self):
+        return '\n%s\n<NDArray %s @%s>' % (
+            str(self.asnumpy()), 'x'.join(str(s) for s in self.shape), self._ctx)
+
+    def __len__(self):
+        if not self.shape:
+            raise TypeError('len() of unsized object')
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError('The truth value of an NDArray with multiple '
+                             'elements is ambiguous.')
+        return bool(self.asscalar())
+
+    # -- synchronization (engine semantics) -------------------------------
+    def wait_to_read(self):
+        """Reference ndarray.h:336 WaitToRead ≙ block_until_ready."""
+        jax.block_until_ready(self._data)
+
+    def wait_to_write(self):
+        jax.block_until_ready(self._data)
+
+    # -- host transfer ----------------------------------------------------
+    def asnumpy(self):
+        arr = np.asarray(self._data)
+        if arr.dtype == jnp.bfloat16:
+            arr = arr.astype(np.float32)
+        return arr
+
+    def asscalar(self):
+        if self.size != 1:
+            raise ValueError('The current array is not a scalar')
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    # -- copies / context movement ----------------------------------------
+    def copy(self):
+        return self.copyto(self._ctx)
+
+    def copyto(self, other):
+        """Reference ndarray.cc:497 CopyFromTo (engine copy op)."""
+        if isinstance(other, NDArray):
+            other._data = jax.device_put(self._data, other._ctx.jax_device())
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self._data, other.jax_device()), other)
+        raise TypeError('copyto does not support type ' + str(type(other)))
+
+    def as_in_context(self, context):
+        if context == self._ctx:
+            return self
+        return self.copyto(context)
+
+    def astype(self, dtype, copy=True):
+        d = np_dtype(dtype)
+        if not copy and self._data.dtype == d:
+            return self
+        return invoke('Cast', [self], {'dtype': str(dtype)})
+
+    def tostype(self, stype):
+        if stype != 'default':
+            raise NotImplementedError('sparse storage is provided by '
+                                      'mxnet_tpu.ndarray.sparse')
+        return self
+
+    # -- autograd ---------------------------------------------------------
+    def attach_grad(self, grad_req='write', stype=None):
+        """Reference ndarray.py attach_grad → MXAutogradMarkVariables."""
+        grad = NDArray(jnp.zeros_like(self._data), self._ctx)
+        _ag.mark_variables([self], [grad], grad_req)
+        self._fresh_grad = True
+
+    def detach(self):
+        out = NDArray(self._data, self._ctx)
+        return out
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        _ag.backward([self], [out_grad] if out_grad is not None else None,
+                     retain_graph, train_mode)
+
+    # -- mutation ---------------------------------------------------------
+    def _set_data(self, new_data, node=None, out_idx=0):
+        self._data = new_data
+        self._node = node
+        self._out_idx = out_idx
+        _track(new_data)
+
+    def __setitem__(self, key, value):
+        if isinstance(value, NDArray):
+            value = value._data
+        elif isinstance(value, numeric_types):
+            value = jnp.asarray(value, dtype=self._data.dtype)
+        else:
+            value = jnp.asarray(np.asarray(value), dtype=self._data.dtype)
+        if key is None or key == slice(None):
+            self._set_data(jnp.broadcast_to(value, self.shape).astype(self._data.dtype))
+        else:
+            self._set_data(self._data.at[key].set(value))
+
+    def __getitem__(self, key):
+        if isinstance(key, NDArray):
+            key = key._data
+        out = self._data[key]
+        res = NDArray(out, self._ctx)
+        if _ag.is_recording() and (self._node is not None or self._leaf is not None):
+            # record the slice so gradients flow through indexing
+            return invoke('_slice_like_getitem', [self], {'key': _freeze_key(key)})
+        return res
+
+    # -- operator overloads (dispatch to registered ops, reference
+    #    ndarray.py __add__ etc → broadcast_add/_plus_scalar) -------------
+    def __add__(self, other):
+        return _binary(self, other, 'broadcast_add', '_plus_scalar')
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __iadd__(self, other):
+        out = _binary(self, other, 'broadcast_add', '_plus_scalar')
+        self._set_data(out._data, out._node, out._out_idx)
+        return self
+
+    def __sub__(self, other):
+        return _binary(self, other, 'broadcast_sub', '_minus_scalar')
+
+    def __rsub__(self, other):
+        return _scalar(self, other, '_rminus_scalar')
+
+    def __isub__(self, other):
+        out = self.__sub__(other)
+        self._set_data(out._data, out._node, out._out_idx)
+        return self
+
+    def __mul__(self, other):
+        return _binary(self, other, 'broadcast_mul', '_mul_scalar')
+
+    def __rmul__(self, other):
+        return self.__mul__(other)
+
+    def __imul__(self, other):
+        out = self.__mul__(other)
+        self._set_data(out._data, out._node, out._out_idx)
+        return self
+
+    def __truediv__(self, other):
+        return _binary(self, other, 'broadcast_div', '_div_scalar')
+
+    def __rtruediv__(self, other):
+        return _scalar(self, other, '_rdiv_scalar')
+
+    def __itruediv__(self, other):
+        out = self.__truediv__(other)
+        self._set_data(out._data, out._node, out._out_idx)
+        return self
+
+    def __mod__(self, other):
+        return _binary(self, other, 'broadcast_mod', '_mod_scalar')
+
+    def __rmod__(self, other):
+        return _scalar(self, other, '_rmod_scalar')
+
+    def __pow__(self, other):
+        return _binary(self, other, 'broadcast_power', '_power_scalar')
+
+    def __rpow__(self, other):
+        return _scalar(self, other, '_rpower_scalar')
+
+    def __neg__(self):
+        return invoke('negative', [self], {})
+
+    def __abs__(self):
+        return invoke('abs', [self], {})
+
+    def __eq__(self, other):
+        return _binary(self, other, 'broadcast_equal', '_equal_scalar')
+
+    def __ne__(self, other):
+        return _binary(self, other, 'broadcast_not_equal', '_not_equal_scalar')
+
+    def __gt__(self, other):
+        return _binary(self, other, 'broadcast_greater', '_greater_scalar')
+
+    def __ge__(self, other):
+        return _binary(self, other, 'broadcast_greater_equal', '_greater_equal_scalar')
+
+    def __lt__(self, other):
+        return _binary(self, other, 'broadcast_lesser', '_lesser_scalar')
+
+    def __le__(self, other):
+        return _binary(self, other, 'broadcast_lesser_equal', '_lesser_equal_scalar')
+
+    def __hash__(self):
+        return id(self)
+
+    # -- common method forms of ops ---------------------------------------
+    def reshape(self, *shape, **kwargs):
+        if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
+            shape = tuple(shape[0])
+        shape = kwargs.get('shape', shape)
+        return invoke('Reshape', [self], {'shape': tuple(shape)})
+
+    def reshape_like(self, other):
+        return invoke('reshape_like', [self, other], {})
+
+    def broadcast_to(self, shape):
+        return invoke('broadcast_to', [self], {'shape': tuple(shape)})
+
+    def transpose(self, *axes):
+        if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
+            axes = tuple(axes[0])
+        return invoke('transpose', [self], {'axes': axes} if axes else {})
+
+    @property
+    def T(self):
+        return self.transpose()
+
+    def flatten(self):
+        return invoke('Flatten', [self], {})
+
+    def expand_dims(self, axis):
+        return invoke('expand_dims', [self], {'axis': axis})
+
+    def squeeze(self, axis=None):
+        return invoke('squeeze', [self], {'axis': axis})
+
+    def swapaxes(self, dim1, dim2):
+        return invoke('SwapAxis', [self], {'dim1': dim1, 'dim2': dim2})
+
+    def split(self, num_outputs, axis=1, squeeze_axis=False):
+        return invoke('SliceChannel', [self],
+                      {'num_outputs': num_outputs, 'axis': axis,
+                       'squeeze_axis': squeeze_axis})
+
+    def slice(self, begin, end, step=None):
+        return invoke('slice', [self], {'begin': tuple(begin), 'end': tuple(end),
+                                        'step': tuple(step) if step else None})
+
+    def slice_axis(self, axis, begin, end):
+        return invoke('slice_axis', [self], {'axis': axis, 'begin': begin, 'end': end})
+
+    def take(self, indices, axis=0, mode='clip'):
+        return invoke('take', [self, indices], {'axis': axis, 'mode': mode})
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0, dtype='float32'):
+        return invoke('one_hot', [self], {'depth': depth, 'on_value': on_value,
+                                          'off_value': off_value, 'dtype': dtype})
+
+    def pick(self, index, axis=-1, keepdims=False):
+        return invoke('pick', [self, index], {'axis': axis, 'keepdims': keepdims})
+
+    def clip(self, a_min, a_max):
+        return invoke('clip', [self], {'a_min': a_min, 'a_max': a_max})
+
+    def tile(self, reps):
+        return invoke('tile', [self], {'reps': tuple(reps)})
+
+    def repeat(self, repeats, axis=None):
+        return invoke('repeat', [self], {'repeats': repeats, 'axis': axis})
+
+    def flip(self, axis):
+        return invoke('reverse', [self], {'axis': (axis,) if isinstance(axis, int) else tuple(axis)})
+
+    def pad(self, mode, pad_width, constant_value=0):
+        return invoke('Pad', [self], {'mode': mode, 'pad_width': tuple(pad_width),
+                                      'constant_value': constant_value})
+
+    def sort(self, axis=-1, is_ascend=True):
+        return invoke('sort', [self], {'axis': axis, 'is_ascend': is_ascend})
+
+    def argsort(self, axis=-1, is_ascend=True, dtype='float32'):
+        return invoke('argsort', [self], {'axis': axis, 'is_ascend': is_ascend,
+                                          'dtype': dtype})
+
+    def topk(self, axis=-1, k=1, ret_typ='indices', is_ascend=False):
+        return invoke('topk', [self], {'axis': axis, 'k': k, 'ret_typ': ret_typ,
+                                       'is_ascend': is_ascend})
+
+    def dot(self, other, transpose_a=False, transpose_b=False):
+        return invoke('dot', [self, other], {'transpose_a': transpose_a,
+                                             'transpose_b': transpose_b})
+
+    def as_jax(self):
+        """Escape hatch to the raw jax.Array (TPU-native extension)."""
+        return self._data
+
+
+def _reduce_method(name):
+    def method(self, axis=None, keepdims=False, **kwargs):
+        attrs = {'axis': axis if axis is None or isinstance(axis, int)
+                 else tuple(axis), 'keepdims': keepdims}
+        attrs.update(kwargs)
+        return invoke(name, [self], attrs)
+    method.__name__ = name
+    return method
+
+
+def _unary_method(name):
+    def method(self, **kwargs):
+        return invoke(name, [self], kwargs)
+    method.__name__ = name
+    return method
+
+
+for _n in ['sum', 'nansum', 'prod', 'nanprod', 'mean', 'max', 'min', 'norm',
+           'argmax', 'argmin']:
+    setattr(NDArray, _n, _reduce_method(_n))
+for _n in ['abs', 'sign', 'round', 'rint', 'fix', 'floor', 'ceil', 'trunc',
+           'sin', 'cos', 'tan', 'arcsin', 'arccos', 'arctan', 'degrees',
+           'radians', 'sinh', 'cosh', 'tanh', 'arcsinh', 'arccosh', 'arctanh',
+           'exp', 'expm1', 'log', 'log10', 'log2', 'log1p', 'sqrt', 'rsqrt',
+           'cbrt', 'square', 'reciprocal', 'relu', 'sigmoid', 'softmax',
+           'log_softmax', 'zeros_like', 'ones_like', 'sign']:
+    setattr(NDArray, _n, _unary_method(_n))
+
+
+# ---------------------------------------------------------------------------
+# invoke — the imperative call path
+# ---------------------------------------------------------------------------
+
+def _freeze_key(key):
+    """Make an indexing key hashable for the attr dict."""
+    if isinstance(key, tuple):
+        return tuple(_freeze_key(k) for k in key)
+    if isinstance(key, slice):
+        return ('__slice__', key.start, key.stop, key.step)
+    if isinstance(key, (jnp.ndarray, np.ndarray)):
+        return ('__array__', tuple(np.asarray(key).ravel().tolist()),
+                tuple(key.shape))
+    return key
+
+
+def _thaw_key(key):
+    if isinstance(key, tuple):
+        if len(key) == 4 and key[0] == '__slice__':
+            return slice(key[1], key[2], key[3])
+        if len(key) == 3 and key[0] == '__array__':
+            return np.array(key[1]).reshape(key[2]).astype(np.int64)
+        return tuple(_thaw_key(k) for k in key)
+    return key
+
+
+@_reg.register('_slice_like_getitem', differentiable=True)
+def _slice_like_getitem(attrs, x):
+    return x[_thaw_key(attrs['key'])]
+
+
+def _parent_entry(arr):
+    if arr._node is not None:
+        return (arr._node, arr._out_idx)
+    if arr._leaf is not None:
+        return (arr._leaf, 0)
+    return (None, 0)
+
+
+def invoke(op_name, inputs, attrs=None, out=None):
+    """Execute a registered op imperatively.
+
+    Reference call stack (SURVEY.md §3.1): generated fn → _imperative_invoke →
+    MXImperativeInvoke → SetShapeType/SetDependency → PushFCompute →
+    Engine::PushAsync. Here: cached jit closure + (if recording) jax.vjp;
+    JAX's async dispatch replaces the engine push.
+    """
+    op = _reg.get(op_name)
+    attrs = normalize_attrs(attrs or {})
+    if op.train_aware:
+        attrs['__is_train__'] = _ag.is_training()
+
+    arrays = [i._data for i in inputs]
+    n_real = len(arrays)
+    if op.needs_rng:
+        arrays.append(_random.next_key())
+
+    ctx = inputs[0]._ctx if inputs else current_context()
+
+    recording = _ag.is_recording() and op.differentiable and any(
+        i._node is not None or i._leaf is not None for i in inputs)
+
+    f = _reg.jitted(op_name, attrs)
+    node = None
+    if recording:
+        outs, vjp_fn = jax.vjp(f, *arrays)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+        parents = [_parent_entry(i) for i in inputs]
+        if op.needs_rng:
+            parents.append((None, 0))
+        node = _ag.record_op(vjp_fn, parents, len(outs_t), n_real)
+        node.head_ids = [(o.shape, o.dtype) for o in outs_t]
+    else:
+        outs = f(*arrays)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+
+    # write mutated aux outputs back into their input NDArrays
+    # (reference: FMutateInputs / aux states, op_attr_types.h)
+    for in_idx, out_idx in op.mutate_inputs.items():
+        if out_idx < len(outs_t):
+            inputs[in_idx]._data = outs_t[out_idx]
+
+    n_vis = op.n_visible_outputs(attrs)
+    results = []
+    for i in range(n_vis):
+        r = NDArray(outs_t[i], ctx)
+        r._node = node
+        r._out_idx = i
+        results.append(r)
+        _track(outs_t[i])
+
+    if out is not None:
+        outs_list = out if isinstance(out, (list, tuple)) else [out]
+        for dst, src in zip(outs_list, results):
+            dst._set_data(src._data, src._node, src._out_idx)
+        return out
+
+    if n_vis == 1:
+        return results[0]
+    return results
+
+
+def imperative_invoke(op_name, *inputs, **kwargs):
+    out = kwargs.pop('out', None)
+    return invoke(op_name, list(inputs), kwargs, out)
+
+
+def _binary(lhs, rhs, op_broadcast, op_scalar):
+    if isinstance(rhs, NDArray):
+        return invoke(op_broadcast, [lhs, rhs], {})
+    if isinstance(rhs, numeric_types):
+        return invoke(op_scalar, [lhs], {'scalar': float(rhs)})
+    raise TypeError('type %s not supported' % str(type(rhs)))
+
+
+def _scalar(lhs, rhs, op_scalar):
+    return invoke(op_scalar, [lhs], {'scalar': float(rhs)})
+
+
+# ---------------------------------------------------------------------------
+# creation
+# ---------------------------------------------------------------------------
+
+def from_jax(data, ctx=None):
+    return NDArray(data, ctx)
+
+
+def array(source_array, ctx=None, dtype=None):
+    """Reference ndarray.py:1988 mx.nd.array."""
+    ctx = ctx if ctx is not None else current_context()
+    if isinstance(source_array, NDArray):
+        src = source_array.asnumpy()
+    else:
+        src = np.asarray(source_array)
+    if dtype is None:
+        dtype = src.dtype
+        if dtype == np.float64:
+            dtype = np.float32
+        elif dtype == np.int64:  # x64 stays off for TPU perf
+            dtype = np.int32
+    d = np_dtype(dtype)
+    data = jax.device_put(jnp.asarray(src, dtype=d), ctx.jax_device())
+    return NDArray(data, ctx)
+
+
+def empty(shape, ctx=None, dtype='float32'):
+    return zeros(shape, ctx, dtype)
+
+
+def zeros(shape, ctx=None, dtype='float32', **kwargs):
+    ctx = ctx if ctx is not None else current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.device_put(jnp.zeros(shape, dtype=np_dtype(dtype)), ctx.jax_device())
+    return NDArray(data, ctx)
+
+
+def ones(shape, ctx=None, dtype='float32', **kwargs):
+    ctx = ctx if ctx is not None else current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.device_put(jnp.ones(shape, dtype=np_dtype(dtype)), ctx.jax_device())
+    return NDArray(data, ctx)
+
+
+def full(shape, val, ctx=None, dtype='float32', out=None):
+    ctx = ctx if ctx is not None else current_context()
+    if isinstance(shape, int):
+        shape = (shape,)
+    data = jax.device_put(jnp.full(shape, val, dtype=np_dtype(dtype)), ctx.jax_device())
+    res = NDArray(data, ctx)
+    if out is not None:
+        out._set_data(res._data)
+        return out
+    return res
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype='float32'):
+    ctx = ctx if ctx is not None else current_context()
+    arr = jnp.arange(start, stop, step, dtype=np_dtype(dtype))
+    if repeat > 1:
+        arr = jnp.repeat(arr, repeat)
+    return NDArray(jax.device_put(arr, ctx.jax_device()), ctx)
+
+
+def concatenate(arrays, axis=0, always_copy=True):
+    return invoke('Concat', list(arrays), {'dim': axis, 'num_args': len(arrays)})
+
+
+def stack(*arrays, **kwargs):
+    axis = kwargs.get('axis', 0)
+    arrs = list(arrays[0]) if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)) else list(arrays)
+    return invoke('stack', arrs, {'axis': axis, 'num_args': len(arrs)})
+
+
+def moveaxis(tensor, source, destination):
+    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+
+
+def onehot_encode(indices, out):
+    depth = out.shape[1]
+    res = invoke('one_hot', [indices], {'depth': depth})
+    out._set_data(res._data)
+    return out

@@ -1,0 +1,58 @@
+"""Auto-generation of the nd.* operator namespace from the registry.
+
+Reference: python/mxnet/ndarray/op.py:52-174 (_make_ndarray_function reads op
+introspection from MXSymbolGetAtomicSymbolInfo and synthesizes python
+functions at import time). Same design, one process: functions are generated
+from the in-process registry.
+"""
+import functools
+
+from ..ops import registry as _reg
+from .ndarray import NDArray, invoke
+
+__all__ = ['make_nd_function', 'install_ops']
+
+
+def make_nd_function(op_name):
+    op = _reg.get(op_name)
+
+    def fn(*args, **kwargs):
+        out = kwargs.pop('out', None)
+        kwargs.pop('name', None)
+        inputs = []
+        pos_inputs = [a for a in args if isinstance(a, NDArray)]
+        if op.variadic:
+            inputs = pos_inputs
+            if op.key_var_num_args and op.key_var_num_args not in kwargs:
+                kwargs[op.key_var_num_args] = len(inputs)
+            attrs = kwargs
+        else:
+            named = {}
+            for k in list(kwargs):
+                if k in op.input_names and isinstance(kwargs[k], NDArray):
+                    named[k] = kwargs.pop(k)
+            attrs = kwargs
+            pos_iter = iter(pos_inputs)
+            for name in op.input_names:
+                if name in named:
+                    inputs.append(named[name])
+                else:
+                    nxt = next(pos_iter, None)
+                    if nxt is None:
+                        break
+                    inputs.append(nxt)
+        return invoke(op_name, inputs, attrs, out)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def install_ops(namespace):
+    """Install one generated function per registered op into ``namespace``."""
+    for name in _reg.list_ops():
+        if name.startswith('_slice_like'):
+            continue
+        namespace[name] = make_nd_function(name)
+        # public aliases for leading-underscore arithmetic helpers
+    return namespace

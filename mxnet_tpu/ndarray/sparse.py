@@ -1,0 +1,165 @@
+"""Sparse NDArray storage types — row_sparse and csr.
+
+Reference: python/mxnet/ndarray/sparse.py (RowSparseNDArray:780,
+CSRNDArray:998) + include/mxnet/ndarray.h:82-87 (kRowSparseStorage,
+kCSRStorage, aux tensors).
+
+TPU-native stance (SURVEY.md §7 hard-part 4): XLA has no native sparse
+tensors, so these are *structured dense* containers — data + index aux
+arrays, exactly the reference's aux-tensor layout — with gather/scatter
+lowerings for the ops that matter (dot(csr, dense), sparse_retain,
+row-sparse update in optimizers/kvstore) and explicit densification
+(`tostype('default')`) elsewhere.
+"""
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..context import current_context
+from .ndarray import NDArray, array as _dense_array
+
+__all__ = ['RowSparseNDArray', 'CSRNDArray', 'row_sparse_array', 'csr_matrix',
+           'BaseSparseNDArray']
+
+
+class BaseSparseNDArray:
+    def __init__(self, shape, ctx=None, dtype='float32'):
+        self._shape = tuple(shape)
+        self._ctx = ctx or current_context()
+        self._dtype = np.dtype(dtype) if dtype != 'bfloat16' else dtype
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def context(self):
+        return self._ctx
+
+    ctx = context
+
+    def asnumpy(self):
+        return self.tostype('default').asnumpy()
+
+    def wait_to_read(self):
+        pass
+
+    def __repr__(self):
+        return '<%s %s @%s>' % (type(self).__name__,
+                                'x'.join(map(str, self._shape)), self._ctx)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """rows `indices` hold `data`; all other rows are zero."""
+
+    stype = 'row_sparse'
+
+    def __init__(self, data, indices, shape, ctx=None):
+        super().__init__(shape, ctx, data.dtype)
+        self.data = data          # NDArray (nnz_rows, *shape[1:])
+        self.indices = indices    # NDArray int64 (nnz_rows,)
+
+    def tostype(self, stype):
+        if stype == 'row_sparse':
+            return self
+        if stype != 'default':
+            raise ValueError(stype)
+        dense = jnp.zeros(self._shape, dtype=self.data._data.dtype)
+        dense = dense.at[self.indices._data.astype(jnp.int32)].set(self.data._data)
+        return NDArray(dense, self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = self.tostype('default')._data
+            return other
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+                                self._shape, other)
+
+    def retain(self, row_ids):
+        return retain(self, row_ids)
+
+    def __add__(self, other):
+        return self.tostype('default') + (
+            other.tostype('default') if isinstance(other, BaseSparseNDArray) else other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    stype = 'csr'
+
+    def __init__(self, data, indptr, indices, shape, ctx=None):
+        super().__init__(shape, ctx, data.dtype)
+        self.data = data
+        self.indptr = indptr
+        self.indices = indices
+
+    def tostype(self, stype):
+        if stype == 'csr':
+            return self
+        if stype != 'default':
+            raise ValueError(stype)
+        import scipy.sparse as sp  # scipy ships with jax
+        m = sp.csr_matrix((self.data.asnumpy(), self.indices.asnumpy().astype(np.int64),
+                           self.indptr.asnumpy().astype(np.int64)), shape=self._shape)
+        return _dense_array(m.toarray(), self._ctx)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray):
+            other._data = self.tostype('default')._data
+            return other
+        return CSRNDArray(self.data.copy(), self.indptr.copy(),
+                          self.indices.copy(), self._shape, other)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype='float32'):
+    """Reference sparse.py row_sparse_array: from (data, indices) or dense."""
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        data = data if isinstance(data, NDArray) else _dense_array(np.asarray(data, dtype=dtype), ctx)
+        indices = indices if isinstance(indices, NDArray) else \
+            _dense_array(np.asarray(indices, dtype=np.int64), ctx, dtype='int64')
+        if shape is None:
+            nrows = int(indices.asnumpy().max()) + 1 if indices.size else 0
+            shape = (nrows,) + data.shape[1:]
+        return RowSparseNDArray(data, indices, shape, ctx)
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1, dtype=dtype)
+    nz = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+    return RowSparseNDArray(_dense_array(dense[nz], ctx),
+                            _dense_array(nz.astype(np.int64), ctx, dtype='int64'),
+                            dense.shape, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype='float32'):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        data = data if isinstance(data, NDArray) else _dense_array(np.asarray(data, dtype=dtype), ctx)
+        indices = indices if isinstance(indices, NDArray) else \
+            _dense_array(np.asarray(indices, dtype=np.int64), ctx, dtype='int64')
+        indptr = indptr if isinstance(indptr, NDArray) else \
+            _dense_array(np.asarray(indptr, dtype=np.int64), ctx, dtype='int64')
+        return CSRNDArray(data, indptr, indices, shape, ctx)
+    import scipy.sparse as sp
+    dense = np.asarray(arg1.asnumpy() if isinstance(arg1, NDArray) else arg1, dtype=dtype)
+    m = sp.csr_matrix(dense)
+    return CSRNDArray(_dense_array(m.data, ctx),
+                      _dense_array(m.indptr.astype(np.int64), ctx, dtype='int64'),
+                      _dense_array(m.indices.astype(np.int64), ctx, dtype='int64'),
+                      dense.shape, ctx)
+
+
+def retain(rsp, row_ids):
+    """Reference sparse_retain op (tensor/sparse_retain.cc)."""
+    want = row_ids.asnumpy().astype(np.int64)
+    have = rsp.indices.asnumpy().astype(np.int64)
+    pos = {r: i for i, r in enumerate(have)}
+    keep = [r for r in want if r in pos]
+    sel = np.array([pos[r] for r in keep], dtype=np.int64)
+    data = rsp.data.asnumpy()[sel] if len(sel) else \
+        np.zeros((0,) + rsp.shape[1:], dtype=rsp.data.asnumpy().dtype)
+    return RowSparseNDArray(_dense_array(data, rsp._ctx),
+                            _dense_array(np.asarray(keep, dtype=np.int64),
+                                         rsp._ctx, dtype='int64'),
+                            rsp.shape, rsp._ctx)

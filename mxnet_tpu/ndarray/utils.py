@@ -1,0 +1,42 @@
+"""NDArray serialization.
+
+Reference: include/mxnet/ndarray.h:361-373 NDArray::Save/Load (versioned
+binary) + python/mxnet/ndarray/utils.py save/load (dict/list of arrays).
+
+Format here: a single .npz container with a manifest — functionally
+equivalent (dict/list round-trip, dtype/shape preserved); the on-disk bytes
+differ from the reference's dmlc::Stream format by design (no CUDA/mshadow
+layout baggage).
+"""
+import numpy as np
+
+from .ndarray import NDArray, array
+
+__all__ = ['save', 'load']
+
+_LIST_KEY = '__mxtpu_list__%d'
+
+
+def save(fname, data):
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        arrays = {k: v.asnumpy() for k, v in data.items()}
+        np.savez(fname, __format__='dict', **arrays)
+    elif isinstance(data, (list, tuple)):
+        arrays = {_LIST_KEY % i: v.asnumpy() for i, v in enumerate(data)}
+        np.savez(fname, __format__='list', **arrays)
+    else:
+        raise ValueError('data must be NDArray, list or dict')
+
+
+def load(fname):
+    with np.load(fname, allow_pickle=False) as f:
+        fmt = str(f['__format__'])
+        keys = [k for k in f.files if k != '__format__']
+        if fmt == 'list':
+            out = []
+            for i in range(len(keys)):
+                out.append(array(f[_LIST_KEY % i]))
+            return out
+        return {k: array(f[k]) for k in keys}

@@ -1,0 +1,17 @@
+"""Operator library — importing this package registers all ops.
+
+Reference analog: the static-initializer op registrations across
+src/operator/*.cc collected by the NNVM registry at library load.
+"""
+from . import registry
+from . import elemwise            # noqa: F401
+from . import reduce_ops          # noqa: F401
+from . import shape_ops           # noqa: F401
+from . import nn                  # noqa: F401
+from . import linalg_sort         # noqa: F401
+from . import random_ops          # noqa: F401
+from . import optimizer_ops       # noqa: F401
+from . import rnn_ops             # noqa: F401
+from . import contrib_ops         # noqa: F401
+
+from .registry import register, get, list_ops, exists

@@ -1,0 +1,374 @@
+"""Contrib + vision-specific ops.
+
+Reference: src/operator/contrib/ (ctc_loss, count_sketch, fft, dequantize,
+multibox_*, proposal), roi_pooling.cc, spatial_transformer.cc,
+bilinear_sampler.cc, grid_generator.cc, correlation.cc.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_alias
+
+
+# ---------------------------------------------------------------------------
+# ROIPooling — reference src/operator/roi_pooling.cc
+# ---------------------------------------------------------------------------
+@register('ROIPooling', input_names=['data', 'rois'],
+          param_defaults={'pooled_size': (0, 0), 'spatial_scale': 1.0})
+def _roi_pooling(attrs, data, rois):
+    ph, pw = attrs['pooled_size']
+    scale = attrs.get('spatial_scale', 1.0)
+    N, C, H, W = data.shape
+
+    def pool_one(roi):
+        batch = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[batch]
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+        out = jnp.full((C, ph, pw), -jnp.inf, dtype=data.dtype)
+        for py in range(ph):
+            for px in range(pw):
+                ys_lo = y1 + (py * rh) // ph
+                ys_hi = y1 + ((py + 1) * rh + ph - 1) // ph
+                xs_lo = x1 + (px * rw) // pw
+                xs_hi = x1 + ((px + 1) * rw + pw - 1) // pw
+                mask = ((ys[:, None] >= ys_lo) & (ys[:, None] < ys_hi) &
+                        (xs[None, :] >= xs_lo) & (xs[None, :] < xs_hi))
+                vals = jnp.where(mask[None], img, -jnp.inf)
+                out = out.at[:, py, px].set(jnp.max(vals, axis=(1, 2)))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return jax.vmap(pool_one)(rois)
+
+
+# ---------------------------------------------------------------------------
+# BilinearSampler / GridGenerator / SpatialTransformer
+# ---------------------------------------------------------------------------
+def _bilinear_sample(data, grid):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) with coords in [-1,1]."""
+    N, C, H, W = data.shape
+    gx = (grid[:, 0] + 1) * (W - 1) / 2
+    gy = (grid[:, 1] + 1) * (H - 1) / 2
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def gather(img, yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1))
+        vals = img[:, yi, xi]
+        return jnp.where(valid[None], vals, 0.0)
+
+    def sample_one(img, x0_, y0_, wx_, wy_):
+        v00 = gather(img, y0_, x0_)
+        v01 = gather(img, y0_, x0_ + 1)
+        v10 = gather(img, y0_ + 1, x0_)
+        v11 = gather(img, y0_ + 1, x0_ + 1)
+        return (v00 * (1 - wx_)[None] * (1 - wy_)[None] +
+                v01 * wx_[None] * (1 - wy_)[None] +
+                v10 * (1 - wx_)[None] * wy_[None] +
+                v11 * wx_[None] * wy_[None])
+
+    return jax.vmap(sample_one)(data, x0, y0, wx, wy)
+
+
+@register('BilinearSampler', input_names=['data', 'grid'])
+def _bilinear_sampler(attrs, data, grid):
+    return _bilinear_sample(data, grid)
+
+
+@register('GridGenerator', input_names=['data'],
+          param_defaults={'transform_type': 'affine', 'target_shape': (0, 0)})
+def _grid_generator(attrs, data):
+    th, tw = attrs['target_shape']
+    if attrs.get('transform_type', 'affine') == 'affine':
+        N = data.shape[0]
+        theta = data.reshape(N, 2, 3)
+        ys = jnp.linspace(-1, 1, th)
+        xs = jnp.linspace(-1, 1, tw)
+        gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+        ones = jnp.ones_like(gx)
+        coords = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum('nij,jk->nik', theta, coords)  # (N,2,HW)
+        return out.reshape(N, 2, th, tw)
+    # warp type: data is flow field (N,2,H,W)
+    N, _, H, W = data.shape
+    ys = jnp.arange(H, dtype=data.dtype)
+    xs = jnp.arange(W, dtype=data.dtype)
+    gy, gx = jnp.meshgrid(ys, xs, indexing='ij')
+    fx = (data[:, 0] + gx) * 2 / max(W - 1, 1) - 1
+    fy = (data[:, 1] + gy) * 2 / max(H - 1, 1) - 1
+    return jnp.stack([fx, fy], axis=1)
+
+
+@register('SpatialTransformer', input_names=['data', 'loc'],
+          param_defaults={'target_shape': (0, 0), 'transform_type': 'affine',
+                          'sampler_type': 'bilinear'})
+def _spatial_transformer(attrs, data, loc):
+    grid = _grid_generator({'transform_type': 'affine',
+                            'target_shape': attrs['target_shape']}, loc)
+    return _bilinear_sample(data, grid)
+
+
+# ---------------------------------------------------------------------------
+# Correlation — reference correlation.cc (FlowNet-style)
+# ---------------------------------------------------------------------------
+@register('Correlation', input_names=['data1', 'data2'],
+          param_defaults={'kernel_size': 1, 'max_displacement': 1, 'stride1': 1,
+                          'stride2': 1, 'pad_size': 0, 'is_multiply': True})
+def _correlation(attrs, a, b):
+    d = int(attrs.get('max_displacement', 1))
+    s2 = int(attrs.get('stride2', 1))
+    mult = attrs.get('is_multiply', True)
+    shifts = range(-d, d + 1, s2)
+    outs = []
+    for dy in shifts:
+        for dx in shifts:
+            shifted = jnp.roll(b, (dy, dx), axis=(2, 3))
+            if mult:
+                corr = jnp.mean(a * shifted, axis=1)
+            else:
+                corr = jnp.mean(jnp.abs(a - shifted), axis=1)
+            outs.append(corr)
+    return jnp.stack(outs, axis=1)
+
+
+# ---------------------------------------------------------------------------
+# contrib: FFT / count_sketch / dequantize / CTC
+# ---------------------------------------------------------------------------
+@register('_contrib_fft', param_defaults={'compute_size': 128})
+def _fft(attrs, x):
+    """Reference contrib/fft.cc — output interleaves re/im along last dim."""
+    y = jnp.fft.fft(x.astype(jnp.complex64), axis=-1)
+    out = jnp.stack([y.real, y.imag], axis=-1)
+    return out.reshape(x.shape[:-1] + (2 * x.shape[-1],)).astype(x.dtype)
+
+
+@register('_contrib_ifft', param_defaults={'compute_size': 128})
+def _ifft(attrs, x):
+    n = x.shape[-1] // 2
+    xr = x.reshape(x.shape[:-1] + (n, 2))
+    y = jnp.fft.ifft(xr[..., 0] + 1j * xr[..., 1], axis=-1)
+    return (y.real * n).astype(x.dtype)
+
+
+@register('_contrib_count_sketch', input_names=['data', 'h', 's'],
+          param_defaults={'out_dim': 0, 'processing_batch_size': 32})
+def _count_sketch(attrs, data, h, s):
+    out_dim = int(attrs['out_dim'])
+    idx = h.ravel().astype(jnp.int32)
+    sign = s.ravel()
+    out = jnp.zeros(data.shape[:-1] + (out_dim,), dtype=data.dtype)
+    return out.at[..., idx].add(data * sign)
+
+
+@register('_contrib_dequantize', input_names=['data', 'min_range', 'max_range'],
+          param_defaults={'out_type': 'float32'}, differentiable=False)
+def _dequantize(attrs, data, min_range, max_range):
+    qmin = float(jnp.iinfo(jnp.int8).min) if data.dtype == jnp.int8 else 0.0
+    qmax = float(jnp.iinfo(jnp.int8).max) if data.dtype == jnp.int8 else 255.0
+    scale = (max_range - min_range) / (qmax - qmin)
+    return (data.astype(jnp.float32) - qmin) * scale + min_range
+
+
+@register('_contrib_CTCLoss', input_names=['data', 'label'],
+          param_defaults={'use_data_lengths': False, 'use_label_lengths': False,
+                          'blank_label': 'first'})
+def _ctc_loss(attrs, data, label):
+    """Reference contrib/ctc_loss.cc (warp-ctc). Forward-backward in log
+    space via lax.scan; blank index 0 ('first' convention)."""
+    T, N, V = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    labels = label.astype(jnp.int32)  # (N, L)
+    L = labels.shape[1]
+    # extended label seq: blank interleaved — length 2L+1
+    S = 2 * L + 1
+    ext = jnp.zeros((N, S), dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(labels)
+    lab_len = jnp.sum(labels > 0, axis=1) if not attrs.get('use_label_lengths') \
+        else jnp.sum(labels >= 0, axis=1)
+    ext_len = 2 * lab_len + 1
+
+    neg_inf = -1e10
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+    alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2], 1)[:, 0])
+
+    same = jnp.concatenate([jnp.zeros((N, 2), bool),
+                            ext[:, 2:] == ext[:, :-2]], axis=1)
+    is_blank = (ext == 0)
+
+    def step(alpha, logp_t):
+        a1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+        a2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+        a2 = jnp.where(is_blank | same, neg_inf, a2)
+        merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
+        emit = jnp.take_along_axis(logp_t, ext, axis=1)
+        new_alpha = merged + emit
+        return new_alpha, None
+
+    alphaT, _ = jax.lax.scan(step, alpha0, logp[1:])
+    idx_last = jnp.clip(ext_len - 1, 0, S - 1)
+    idx_prev = jnp.clip(ext_len - 2, 0, S - 1)
+    ll = jnp.logaddexp(
+        jnp.take_along_axis(alphaT, idx_last[:, None], 1)[:, 0],
+        jnp.take_along_axis(alphaT, idx_prev[:, None], 1)[:, 0])
+    return -ll
+
+
+register_alias('ctc_loss', '_contrib_CTCLoss')
+
+
+# ---------------------------------------------------------------------------
+# MultiBox family (SSD) — reference contrib/multibox_prior.cc,
+# multibox_target.cc, multibox_detection.cc
+# ---------------------------------------------------------------------------
+@register('_contrib_MultiBoxPrior',
+          param_defaults={'sizes': (1.0,), 'ratios': (1.0,), 'clip': False,
+                          'steps': (-1.0, -1.0), 'offsets': (0.5, 0.5)},
+          differentiable=False)
+def _multibox_prior(attrs, data):
+    H, W = data.shape[2], data.shape[3]
+    sizes = attrs.get('sizes', (1.0,))
+    ratios = attrs.get('ratios', (1.0,))
+    steps = attrs.get('steps', (-1.0, -1.0))
+    offs = attrs.get('offsets', (0.5, 0.5))
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H) + offs[0]) * step_y
+    cx = (jnp.arange(W) + offs[1]) * step_x
+    cyg, cxg = jnp.meshgrid(cy, cx, indexing='ij')
+    boxes = []
+    # anchor set: sizes[0] with each ratio + each size with ratio[0]
+    combos = [(sizes[0], r) for r in ratios] + \
+             [(s, ratios[0]) for s in sizes[1:]]
+    for s, r in combos:
+        w = s * jnp.sqrt(r) / 2
+        h = s / jnp.sqrt(r) / 2
+        boxes.append(jnp.stack([cxg - w, cyg - h, cxg + w, cyg + h], axis=-1))
+    out = jnp.stack(boxes, axis=2).reshape(-1, 4)
+    if attrs.get('clip', False):
+        out = jnp.clip(out, 0, 1)
+    return out[None]
+
+
+def _box_iou(a, b):
+    """a (A,4), b (B,4) corner boxes → (A,B)."""
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = jnp.maximum((a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1]), 0)
+    area_b = jnp.maximum((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]), 0)
+    return inter / jnp.maximum(area_a[:, None] + area_b[None] - inter, 1e-12)
+
+
+@register('_contrib_MultiBoxTarget',
+          input_names=['anchor', 'label', 'cls_pred'],
+          param_defaults={'overlap_threshold': 0.5, 'ignore_label': -1.0,
+                          'negative_mining_ratio': -1.0,
+                          'negative_mining_thresh': 0.5, 'minimum_negative_samples': 0,
+                          'variances': (0.1, 0.1, 0.2, 0.2)},
+          num_outputs=3, differentiable=False)
+def _multibox_target(attrs, anchor, label, cls_pred):
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    var = attrs.get('variances', (0.1, 0.1, 0.2, 0.2))
+    thresh = attrs.get('overlap_threshold', 0.5)
+
+    def per_sample(lab):
+        valid = lab[:, 0] >= 0
+        gt = lab[:, 1:5]
+        iou = _box_iou(anchors, gt)  # (A, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        matched = best_iou > thresh
+        # force-match the best anchor for each gt
+        best_anchor = jnp.argmax(iou, axis=0)  # (M,)
+        force = jnp.zeros(A, bool).at[best_anchor].set(valid)
+        matched = matched | force
+        cls = jnp.where(matched, lab[best_gt, 0] + 1, 0.0)
+        g = gt[best_gt]
+        acx = (anchors[:, 0] + anchors[:, 2]) / 2
+        acy = (anchors[:, 1] + anchors[:, 3]) / 2
+        aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-8)
+        ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-8)
+        gcx = (g[:, 0] + g[:, 2]) / 2
+        gcy = (g[:, 1] + g[:, 3]) / 2
+        gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+        gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+        tx = (gcx - acx) / aw / var[0]
+        ty = (gcy - acy) / ah / var[1]
+        tw = jnp.log(gw / aw) / var[2]
+        th = jnp.log(gh / ah) / var[3]
+        loc_t = jnp.stack([tx, ty, tw, th], axis=-1)
+        loc_t = jnp.where(matched[:, None], loc_t, 0.0).ravel()
+        loc_mask = jnp.where(matched[:, None],
+                             jnp.ones((A, 4)), 0.0).ravel()
+        return loc_t, loc_mask, cls
+
+    loc_t, loc_m, cls_t = jax.vmap(per_sample)(label)
+    return loc_t, loc_m, cls_t
+
+
+@register('_contrib_MultiBoxDetection',
+          input_names=['cls_prob', 'loc_pred', 'anchor'],
+          param_defaults={'clip': True, 'threshold': 0.01, 'background_id': 0,
+                          'nms_threshold': 0.5, 'force_suppress': False,
+                          'variances': (0.1, 0.1, 0.2, 0.2), 'nms_topk': -1},
+          differentiable=False)
+def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
+    var = attrs.get('variances', (0.1, 0.1, 0.2, 0.2))
+    anchors = anchor.reshape(-1, 4)
+    A = anchors.shape[0]
+    nms_thresh = attrs.get('nms_threshold', 0.5)
+    score_thresh = attrs.get('threshold', 0.01)
+
+    acx = (anchors[:, 0] + anchors[:, 2]) / 2
+    acy = (anchors[:, 1] + anchors[:, 3]) / 2
+    aw = anchors[:, 2] - anchors[:, 0]
+    ah = anchors[:, 3] - anchors[:, 1]
+
+    def per_sample(probs, locs):
+        l = locs.reshape(-1, 4)
+        cx = l[:, 0] * var[0] * aw + acx
+        cy = l[:, 1] * var[1] * ah + acy
+        w = jnp.exp(l[:, 2] * var[2]) * aw
+        h = jnp.exp(l[:, 3] * var[3]) * ah
+        boxes = jnp.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], -1)
+        if attrs.get('clip', True):
+            boxes = jnp.clip(boxes, 0, 1)
+        scores = probs[1:]  # drop background row; (C-1, A)
+        cls_id = jnp.argmax(scores, axis=0).astype(jnp.float32)
+        score = jnp.max(scores, axis=0)
+        keep_score = score > score_thresh
+        order = jnp.argsort(-score)
+        boxes_s = boxes[order]
+        iou = _box_iou(boxes_s, boxes_s)
+        same_cls = cls_id[order][:, None] == cls_id[order][None, :]
+        if attrs.get('force_suppress', False):
+            same_cls = jnp.ones_like(same_cls)
+        sup = (iou > nms_thresh) & same_cls & \
+            (jnp.arange(A)[:, None] > jnp.arange(A)[None, :])
+        suppressed = jnp.any(sup & keep_score[order][None, :] * True, axis=1)
+        valid = keep_score[order] & ~suppressed
+        out_id = jnp.where(valid, cls_id[order], -1.0)
+        return jnp.concatenate([out_id[:, None], score[order][:, None],
+                                boxes_s], axis=1)
+
+    return jax.vmap(per_sample)(cls_prob, loc_pred)
+
+
+@register('_contrib_box_iou', input_names=['lhs', 'rhs'],
+          param_defaults={'format': 'corner'}, differentiable=False)
+def _box_iou_op(attrs, lhs, rhs):
+    return _box_iou(lhs.reshape(-1, 4), rhs.reshape(-1, 4))

@@ -1,0 +1,201 @@
+"""Elementwise unary/binary/scalar operator families.
+
+Reference: src/operator/tensor/elemwise_unary_op.{cc,cu},
+elemwise_binary_op.cc, elemwise_binary_broadcast_op*.cc,
+elemwise_binary_scalar_op*.cc, mshadow_op.h (scalar functors).
+
+Everything lowers to jnp primitives; XLA fuses chains of these into single
+VPU kernels, which replaces the reference's Kernel<OP,xpu>::Launch
+(mxnet_op.h:217) hand-rolled elementwise launcher.
+"""
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import erf as _erf, gammaln as _gammaln
+
+from .registry import register, register_alias
+
+_F32_EPS = 1e-20
+
+
+def _u(name, f, differentiable=True, aliases=()):
+    @register(name, differentiable=differentiable)
+    def op(attrs, x, _f=f):
+        return _f(x)
+    for a in aliases:
+        register_alias(a, name)
+    return op
+
+
+# unary math (reference elemwise_unary_op.cc registration list)
+_u('abs', jnp.abs)
+_u('sign', jnp.sign)
+_u('round', jnp.round)
+_u('rint', jnp.rint)
+_u('ceil', jnp.ceil)
+_u('floor', jnp.floor)
+_u('trunc', jnp.trunc)
+_u('fix', jnp.fix)
+_u('square', jnp.square)
+_u('sqrt', jnp.sqrt)
+_u('rsqrt', lambda x: jax.lax.rsqrt(x))
+_u('cbrt', jnp.cbrt)
+_u('rcbrt', lambda x: 1.0 / jnp.cbrt(x))
+_u('exp', jnp.exp)
+_u('log', jnp.log)
+_u('log10', jnp.log10)
+_u('log2', jnp.log2)
+_u('log1p', jnp.log1p)
+_u('expm1', jnp.expm1)
+_u('sin', jnp.sin)
+_u('cos', jnp.cos)
+_u('tan', jnp.tan)
+_u('arcsin', jnp.arcsin)
+_u('arccos', jnp.arccos)
+_u('arctan', jnp.arctan)
+_u('sinh', jnp.sinh)
+_u('cosh', jnp.cosh)
+_u('tanh', jnp.tanh)
+_u('arcsinh', jnp.arcsinh)
+_u('arccosh', jnp.arccosh)
+_u('arctanh', jnp.arctanh)
+_u('degrees', jnp.degrees)
+_u('radians', jnp.radians)
+_u('negative', jnp.negative)
+_u('reciprocal', lambda x: 1.0 / x)
+_u('sigmoid', jax.nn.sigmoid)
+_u('softsign', lambda x: x / (1.0 + jnp.abs(x)))
+_u('relu', jax.nn.relu)
+_u('erf', _erf)
+_u('gamma', lambda x: jnp.exp(_gammaln(x)))
+_u('gammaln', _gammaln)
+_u('logical_not', lambda x: (x == 0).astype(x.dtype))
+_u('zeros_like', jnp.zeros_like, differentiable=False)
+_u('ones_like', jnp.ones_like, differentiable=False)
+_u('identity', lambda x: x, aliases=('_copy', 'stop_gradient_off'))
+register_alias('_identity_with_attr_like_rhs', 'identity')
+
+
+@register('BlockGrad')
+def _block_grad(attrs, x):
+    """Reference: elemwise_unary_op.cc BlockGrad / stop_gradient."""
+    return jax.lax.stop_gradient(x)
+
+
+register_alias('stop_gradient', 'BlockGrad')
+
+
+@register('Cast', differentiable=True)
+def _cast(attrs, x):
+    from ..base import np_dtype
+    return x.astype(np_dtype(attrs['dtype']))
+
+
+register_alias('cast', 'Cast')
+
+
+# binary broadcast family (reference elemwise_binary_broadcast_op_basic.cc)
+def _b(name, f, differentiable=True, elem_alias=None):
+    @register(name, input_names=['lhs', 'rhs'], differentiable=differentiable)
+    def op(attrs, lhs, rhs, _f=f):
+        return _f(lhs, rhs)
+    if elem_alias:
+        register_alias(elem_alias, name)
+    return op
+
+
+_b('broadcast_add', jnp.add, elem_alias='elemwise_add')
+register_alias('_plus', 'broadcast_add')
+register_alias('_add', 'broadcast_add')
+_b('broadcast_sub', jnp.subtract, elem_alias='elemwise_sub')
+register_alias('_minus', 'broadcast_sub')
+register_alias('_sub', 'broadcast_sub')
+_b('broadcast_mul', jnp.multiply, elem_alias='elemwise_mul')
+register_alias('_mul', 'broadcast_mul')
+_b('broadcast_div', jnp.divide, elem_alias='elemwise_div')
+register_alias('_div', 'broadcast_div')
+_b('broadcast_mod', jnp.mod)
+_b('broadcast_power', jnp.power)
+register_alias('_power', 'broadcast_power')
+register_alias('pow', 'broadcast_power')
+_b('broadcast_maximum', jnp.maximum)
+_b('broadcast_minimum', jnp.minimum)
+_b('broadcast_hypot', jnp.hypot)
+_b('_maximum', jnp.maximum)
+_b('_minimum', jnp.minimum)
+
+
+def _cmp(name, f):
+    @register(name, input_names=['lhs', 'rhs'], differentiable=False)
+    def op(attrs, lhs, rhs, _f=f):
+        return _f(lhs, rhs).astype(lhs.dtype)
+    return op
+
+
+_cmp('broadcast_equal', jnp.equal)
+_cmp('broadcast_not_equal', jnp.not_equal)
+_cmp('broadcast_greater', jnp.greater)
+_cmp('broadcast_greater_equal', jnp.greater_equal)
+_cmp('broadcast_lesser', jnp.less)
+_cmp('broadcast_lesser_equal', jnp.less_equal)
+_cmp('broadcast_logical_and', lambda a, b: jnp.logical_and(a != 0, b != 0))
+_cmp('broadcast_logical_or', lambda a, b: jnp.logical_or(a != 0, b != 0))
+_cmp('broadcast_logical_xor', lambda a, b: jnp.logical_xor(a != 0, b != 0))
+
+
+# scalar family (reference elemwise_binary_scalar_op_basic.cc)
+def _s(name, f, differentiable=True):
+    @register(name, param_defaults={'scalar': 0.0}, differentiable=differentiable)
+    def op(attrs, x, _f=f):
+        return _f(x, jnp.asarray(attrs['scalar'], dtype=x.dtype))
+    return op
+
+
+_s('_plus_scalar', jnp.add)
+_s('_minus_scalar', jnp.subtract)
+_s('_rminus_scalar', lambda x, s: s - x)
+_s('_mul_scalar', jnp.multiply)
+_s('_div_scalar', jnp.divide)
+_s('_rdiv_scalar', lambda x, s: s / x)
+_s('_mod_scalar', jnp.mod)
+_s('_rmod_scalar', lambda x, s: jnp.mod(s, x))
+_s('_power_scalar', jnp.power)
+_s('_rpower_scalar', lambda x, s: jnp.power(s, x))
+_s('_maximum_scalar', jnp.maximum)
+_s('_minimum_scalar', jnp.minimum)
+_s('_hypot_scalar', jnp.hypot)
+
+
+def _scmp(name, f):
+    @register(name, param_defaults={'scalar': 0.0}, differentiable=False)
+    def op(attrs, x, _f=f):
+        return _f(x, attrs['scalar']).astype(x.dtype)
+    return op
+
+
+_scmp('_equal_scalar', jnp.equal)
+_scmp('_not_equal_scalar', jnp.not_equal)
+_scmp('_greater_scalar', jnp.greater)
+_scmp('_greater_equal_scalar', jnp.greater_equal)
+_scmp('_lesser_scalar', jnp.less)
+_scmp('_lesser_equal_scalar', jnp.less_equal)
+
+
+@register('smooth_l1', param_defaults={'scalar': 1.0})
+def _smooth_l1(attrs, x):
+    """Reference: elemwise_binary_scalar_op_extended.cc smooth_l1."""
+    sigma2 = attrs.get('scalar', 1.0) ** 2
+    absx = jnp.abs(x)
+    return jnp.where(absx < 1.0 / sigma2, 0.5 * sigma2 * x * x, absx - 0.5 / sigma2)
+
+
+@register('add_n', variadic=True, key_var_num_args='num_args')
+def _add_n(attrs, *xs):
+    """Reference: elemwise_sum.cc add_n/ElementWiseSum."""
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+register_alias('ElementWiseSum', 'add_n')
+register_alias('_sum', 'add_n')

@@ -1,0 +1,200 @@
+"""Linear algebra + ordering ops.
+
+Reference: src/operator/tensor/dot.cc (dot/batch_dot incl. transpose flags),
+la_op.cc (linalg_gemm/gemm2/potrf/potri/trsm/trmm/syrk/sumlogdiag),
+ordering_op.cc (sort/argsort/topk).
+
+dot/batch_dot lower to lax.dot_general → MXU. Orderings lower to lax.sort /
+lax.top_k.
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register, register_alias
+
+
+@register('dot', input_names=['lhs', 'rhs'],
+          param_defaults={'transpose_a': False, 'transpose_b': False})
+def _dot(attrs, lhs, rhs):
+    ta, tb = attrs.get('transpose_a', False), attrs.get('transpose_b', False)
+    a = lhs.T if ta and lhs.ndim == 2 else (jnp.transpose(lhs) if ta else lhs)
+    b = rhs.T if tb and rhs.ndim == 2 else (jnp.transpose(rhs) if tb else rhs)
+    if a.ndim == 1 and b.ndim == 1:
+        return jnp.dot(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+    # MXNet dot: contract last axis of a with first axis of b
+    return jnp.tensordot(a, b, axes=([a.ndim - 1], [0]),
+                         preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+@register('batch_dot', input_names=['lhs', 'rhs'],
+          param_defaults={'transpose_a': False, 'transpose_b': False})
+def _batch_dot(attrs, lhs, rhs):
+    a = jnp.swapaxes(lhs, -1, -2) if attrs.get('transpose_a', False) else lhs
+    b = jnp.swapaxes(rhs, -1, -2) if attrs.get('transpose_b', False) else rhs
+    return jnp.matmul(a, b, preferred_element_type=jnp.float32).astype(a.dtype)
+
+
+@register('khatri_rao', variadic=True, key_var_num_args='num_args')
+def _khatri_rao(attrs, *mats):
+    """Reference contrib krprod.cc — column-wise Kronecker product."""
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum('ik,jk->ijk', out, m).reshape(-1, out.shape[1])
+    return out
+
+
+# linalg_* family (la_op.cc); operate on batched trailing 2D matrices
+@register('_linalg_gemm', input_names=['A', 'B', 'C'],
+          param_defaults={'transpose_a': False, 'transpose_b': False,
+                          'alpha': 1.0, 'beta': 1.0, 'axis': -2})
+def _linalg_gemm(attrs, A, B, C):
+    a = jnp.swapaxes(A, -1, -2) if attrs.get('transpose_a', False) else A
+    b = jnp.swapaxes(B, -1, -2) if attrs.get('transpose_b', False) else B
+    return attrs.get('alpha', 1.0) * jnp.matmul(a, b) + attrs.get('beta', 1.0) * C
+
+
+register_alias('linalg_gemm', '_linalg_gemm')
+
+
+@register('_linalg_gemm2', input_names=['A', 'B'],
+          param_defaults={'transpose_a': False, 'transpose_b': False,
+                          'alpha': 1.0})
+def _linalg_gemm2(attrs, A, B):
+    a = jnp.swapaxes(A, -1, -2) if attrs.get('transpose_a', False) else A
+    b = jnp.swapaxes(B, -1, -2) if attrs.get('transpose_b', False) else B
+    return attrs.get('alpha', 1.0) * jnp.matmul(a, b)
+
+
+register_alias('linalg_gemm2', '_linalg_gemm2')
+
+
+@register('_linalg_potrf')
+def _linalg_potrf(attrs, A):
+    return jnp.linalg.cholesky(A)
+
+
+register_alias('linalg_potrf', '_linalg_potrf')
+
+
+@register('_linalg_potri')
+def _linalg_potri(attrs, A):
+    L = A
+    n = L.shape[-1]
+    eye = jnp.broadcast_to(jnp.eye(n, dtype=L.dtype), L.shape)
+    Linv = jax.scipy.linalg.solve_triangular(L, eye, lower=True)
+    return jnp.matmul(jnp.swapaxes(Linv, -1, -2), Linv)
+
+
+register_alias('linalg_potri', '_linalg_potri')
+
+
+@register('_linalg_trsm', input_names=['A', 'B'],
+          param_defaults={'transpose': False, 'rightside': False, 'alpha': 1.0,
+                          'lower': True})
+def _linalg_trsm(attrs, A, B):
+    t = attrs.get('transpose', False)
+    lower = attrs.get('lower', True)
+    a = jnp.swapaxes(A, -1, -2) if t else A
+    lo = (not lower) if t else lower
+    if attrs.get('rightside', False):
+        x = jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(a, -1, -2), jnp.swapaxes(B, -1, -2), lower=not lo)
+        sol = jnp.swapaxes(x, -1, -2)
+    else:
+        sol = jax.scipy.linalg.solve_triangular(a, B, lower=lo)
+    return attrs.get('alpha', 1.0) * sol
+
+
+register_alias('linalg_trsm', '_linalg_trsm')
+
+
+@register('_linalg_trmm', input_names=['A', 'B'],
+          param_defaults={'transpose': False, 'rightside': False, 'alpha': 1.0,
+                          'lower': True})
+def _linalg_trmm(attrs, A, B):
+    a = jnp.swapaxes(A, -1, -2) if attrs.get('transpose', False) else A
+    tri = jnp.tril(a) if attrs.get('lower', True) != attrs.get('transpose', False) else jnp.triu(a)
+    if attrs.get('rightside', False):
+        return attrs.get('alpha', 1.0) * jnp.matmul(B, tri)
+    return attrs.get('alpha', 1.0) * jnp.matmul(tri, B)
+
+
+register_alias('linalg_trmm', '_linalg_trmm')
+
+
+@register('_linalg_syrk', param_defaults={'transpose': False, 'alpha': 1.0})
+def _linalg_syrk(attrs, A):
+    a = jnp.swapaxes(A, -1, -2) if attrs.get('transpose', False) else A
+    return attrs.get('alpha', 1.0) * jnp.matmul(a, jnp.swapaxes(a, -1, -2))
+
+
+register_alias('linalg_syrk', '_linalg_syrk')
+
+
+@register('_linalg_sumlogdiag')
+def _linalg_sumlogdiag(attrs, A):
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+register_alias('linalg_sumlogdiag', '_linalg_sumlogdiag')
+
+
+# ---------------------------------------------------------------------------
+# ordering — reference ordering_op.cc
+# ---------------------------------------------------------------------------
+@register('sort', param_defaults={'axis': -1, 'is_ascend': True})
+def _sort(attrs, x):
+    ax = attrs.get('axis', -1)
+    if ax is None:
+        x, ax = x.ravel(), 0
+    y = jnp.sort(x, axis=int(ax))
+    if not attrs.get('is_ascend', True):
+        y = jnp.flip(y, int(ax))
+    return y
+
+
+@register('argsort', param_defaults={'axis': -1, 'is_ascend': True,
+                                     'dtype': 'float32'},
+          differentiable=False)
+def _argsort(attrs, x):
+    ax = attrs.get('axis', -1)
+    if ax is None:
+        x, ax = x.ravel(), 0
+    idx = jnp.argsort(x, axis=int(ax))
+    if not attrs.get('is_ascend', True):
+        idx = jnp.flip(idx, int(ax))
+    return idx.astype(jnp.float32)
+
+
+def _topk_num_outputs(attrs):
+    return 2 if attrs.get('ret_typ', 'indices') == 'both' else 1
+
+
+@register('topk', num_outputs=_topk_num_outputs, differentiable=False,
+          param_defaults={'axis': -1, 'k': 1, 'ret_typ': 'indices',
+                          'is_ascend': False, 'dtype': 'float32'})
+def _topk(attrs, x):
+    ax = attrs.get('axis', -1)
+    if ax is None:
+        x, ax = x.ravel(), 0
+    ax = int(ax) % x.ndim
+    k = int(attrs.get('k', 1))
+    ascend = attrs.get('is_ascend', False)
+    xm = jnp.moveaxis(x, ax, -1)
+    vals, idx = jax.lax.top_k(-xm if ascend else xm, k)
+    if ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax)
+    idx = jnp.moveaxis(idx, -1, ax)
+    ret = attrs.get('ret_typ', 'indices')
+    if ret == 'value':
+        return vals
+    if ret == 'both':
+        return vals, idx.astype(jnp.float32)
+    if ret == 'mask':
+        mask = jnp.zeros_like(jnp.moveaxis(x, ax, -1))
+        mask = mask.at[..., :].set(0)
+        onehots = jax.nn.one_hot(jnp.moveaxis(idx, ax, -1), x.shape[ax],
+                                 dtype=x.dtype).sum(-2)
+        return jnp.moveaxis(onehots, -1, ax)
+    return idx.astype(jnp.float32)

@@ -1,0 +1,136 @@
+"""Fused optimizer update ops.
+
+Reference: src/operator/optimizer_op.cc — sgd_update, sgd_mom_update,
+mp_sgd_update/mp_sgd_mom_update (fp16 master weights), adam_update,
+rmsprop_update, rmspropalex_update, ftrl_update.
+
+These mutate weight/state inputs in the reference (FMutateInputs); here each
+returns the updated tensors and invoke() writes them back — under jit the
+whole update fuses into one HBM-bandwidth-bound kernel per parameter.
+"""
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _rescale_clip(grad, attrs):
+    g = grad * attrs.get('rescale_grad', 1.0)
+    c = attrs.get('clip_gradient', -1.0)
+    if c is not None and c > 0:
+        g = jnp.clip(g, -c, c)
+    return g
+
+
+@register('sgd_update', input_names=['weight', 'grad'],
+          param_defaults={'lr': 0.01, 'wd': 0.0, 'rescale_grad': 1.0,
+                          'clip_gradient': -1.0},
+          mutate_inputs={0: 0}, differentiable=False)
+def _sgd_update(attrs, weight, grad):
+    g = _rescale_clip(grad, attrs)
+    return weight - attrs['lr'] * (g + attrs.get('wd', 0.0) * weight)
+
+
+@register('sgd_mom_update', input_names=['weight', 'grad', 'mom'],
+          param_defaults={'lr': 0.01, 'momentum': 0.0, 'wd': 0.0,
+                          'rescale_grad': 1.0, 'clip_gradient': -1.0},
+          mutate_inputs={0: 0, 2: 1}, num_visible_outputs=1, num_outputs=2,
+          differentiable=False)
+def _sgd_mom_update(attrs, weight, grad, mom):
+    g = _rescale_clip(grad, attrs)
+    new_mom = attrs.get('momentum', 0.0) * mom - \
+        attrs['lr'] * (g + attrs.get('wd', 0.0) * weight)
+    return weight + new_mom, new_mom
+
+
+@register('mp_sgd_update', input_names=['weight', 'grad', 'weight32'],
+          param_defaults={'lr': 0.01, 'wd': 0.0, 'rescale_grad': 1.0,
+                          'clip_gradient': -1.0},
+          mutate_inputs={0: 0, 2: 1}, num_visible_outputs=1, num_outputs=2,
+          differentiable=False)
+def _mp_sgd_update(attrs, weight, grad, weight32):
+    g = _rescale_clip(grad.astype(jnp.float32), attrs)
+    w32 = weight32 - attrs['lr'] * (g + attrs.get('wd', 0.0) * weight32)
+    return w32.astype(weight.dtype), w32
+
+
+@register('mp_sgd_mom_update',
+          input_names=['weight', 'grad', 'mom', 'weight32'],
+          param_defaults={'lr': 0.01, 'momentum': 0.0, 'wd': 0.0,
+                          'rescale_grad': 1.0, 'clip_gradient': -1.0},
+          mutate_inputs={0: 0, 2: 1, 3: 2}, num_visible_outputs=1,
+          num_outputs=3, differentiable=False)
+def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
+    g = _rescale_clip(grad.astype(jnp.float32), attrs)
+    new_mom = attrs.get('momentum', 0.0) * mom - \
+        attrs['lr'] * (g + attrs.get('wd', 0.0) * weight32)
+    w32 = weight32 + new_mom
+    return w32.astype(weight.dtype), new_mom, w32
+
+
+@register('adam_update', input_names=['weight', 'grad', 'mean', 'var'],
+          param_defaults={'lr': 0.001, 'beta1': 0.9, 'beta2': 0.999,
+                          'epsilon': 1e-8, 'wd': 0.0, 'rescale_grad': 1.0,
+                          'clip_gradient': -1.0},
+          mutate_inputs={0: 0, 2: 1, 3: 2}, num_visible_outputs=1,
+          num_outputs=3, differentiable=False)
+def _adam_update(attrs, weight, grad, mean, var):
+    g = _rescale_clip(grad, attrs) + attrs.get('wd', 0.0) * weight
+    b1, b2 = attrs.get('beta1', 0.9), attrs.get('beta2', 0.999)
+    m = b1 * mean + (1 - b1) * g
+    v = b2 * var + (1 - b2) * jnp.square(g)
+    w = weight - attrs['lr'] * m / (jnp.sqrt(v) + attrs.get('epsilon', 1e-8))
+    return w, m, v
+
+
+@register('rmsprop_update', input_names=['weight', 'grad', 'n'],
+          param_defaults={'lr': 0.001, 'gamma1': 0.95, 'epsilon': 1e-8,
+                          'wd': 0.0, 'rescale_grad': 1.0,
+                          'clip_gradient': -1.0, 'clip_weights': -1.0},
+          mutate_inputs={0: 0, 2: 1}, num_visible_outputs=1, num_outputs=2,
+          differentiable=False)
+def _rmsprop_update(attrs, weight, grad, n):
+    g = _rescale_clip(grad, attrs) + attrs.get('wd', 0.0) * weight
+    g1 = attrs.get('gamma1', 0.95)
+    new_n = (1 - g1) * jnp.square(g) + g1 * n
+    w = weight - attrs['lr'] * g / jnp.sqrt(new_n + attrs.get('epsilon', 1e-8))
+    cw = attrs.get('clip_weights', -1.0)
+    if cw and cw > 0:
+        w = jnp.clip(w, -cw, cw)
+    return w, new_n
+
+
+@register('rmspropalex_update',
+          input_names=['weight', 'grad', 'n', 'g', 'delta'],
+          param_defaults={'lr': 0.001, 'gamma1': 0.95, 'gamma2': 0.9,
+                          'epsilon': 1e-8, 'wd': 0.0, 'rescale_grad': 1.0,
+                          'clip_gradient': -1.0, 'clip_weights': -1.0},
+          mutate_inputs={0: 0, 2: 1, 3: 2, 4: 3}, num_visible_outputs=1,
+          num_outputs=4, differentiable=False)
+def _rmspropalex_update(attrs, weight, grad, n, g_state, delta):
+    grd = _rescale_clip(grad, attrs) + attrs.get('wd', 0.0) * weight
+    g1, g2 = attrs.get('gamma1', 0.95), attrs.get('gamma2', 0.9)
+    new_n = (1 - g1) * jnp.square(grd) + g1 * n
+    new_g = (1 - g1) * grd + g1 * g_state
+    new_delta = g2 * delta - attrs['lr'] * grd / \
+        jnp.sqrt(new_n - jnp.square(new_g) + attrs.get('epsilon', 1e-8))
+    w = weight + new_delta
+    cw = attrs.get('clip_weights', -1.0)
+    if cw and cw > 0:
+        w = jnp.clip(w, -cw, cw)
+    return w, new_n, new_g, new_delta
+
+
+@register('ftrl_update', input_names=['weight', 'grad', 'z', 'n'],
+          param_defaults={'lr': 0.1, 'lamda1': 0.01, 'beta': 1.0, 'wd': 0.0,
+                          'rescale_grad': 1.0, 'clip_gradient': -1.0},
+          mutate_inputs={0: 0, 2: 1, 3: 2}, num_visible_outputs=1,
+          num_outputs=3, differentiable=False)
+def _ftrl_update(attrs, weight, grad, z, n):
+    g = _rescale_clip(grad, attrs)
+    lr, l1 = attrs['lr'], attrs.get('lamda1', 0.01)
+    beta, wd = attrs.get('beta', 1.0), attrs.get('wd', 0.0)
+    new_z = z + g - (jnp.sqrt(n + jnp.square(g)) - jnp.sqrt(n)) / lr * weight
+    new_n = n + jnp.square(g)
+    w = (jnp.sign(new_z) * l1 - new_z) / \
+        ((beta + jnp.sqrt(new_n)) / lr + wd) * (jnp.abs(new_z) > l1)
+    return w, new_z, new_n

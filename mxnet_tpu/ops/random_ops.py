@@ -1,0 +1,177 @@
+"""Random sampling ops.
+
+Reference: src/operator/random/sample_op.cc (uniform/normal/gamma/
+exponential/poisson/negative_binomial/generalized_negative_binomial) and
+multisample_op.cc / sample_multinomial_op.cc.
+
+Each op takes the PRNG key as its trailing array argument (needs_rng=True),
+so the op body is pure and jittable — the TPU-native replacement for the
+per-device mshadow::Random resource (src/resource.cc:84).
+"""
+import jax
+import jax.numpy as jnp
+
+from ..base import np_dtype
+from .registry import register, register_alias
+
+
+def _shape(attrs):
+    s = attrs.get('shape', ())
+    if isinstance(s, int):
+        return (s,)
+    return tuple(s) if s else ()
+
+
+def _dt(attrs):
+    d = attrs.get('dtype', 'float32')
+    if d in (None, 'None'):
+        d = 'float32'
+    return np_dtype(d)
+
+
+@register('_random_uniform', input_names=[], needs_rng=True,
+          differentiable=False,
+          param_defaults={'low': 0.0, 'high': 1.0, 'shape': (), 'dtype': 'float32'})
+def _uniform(attrs, key):
+    return jax.random.uniform(key, _shape(attrs), dtype=_dt(attrs),
+                              minval=attrs.get('low', 0.0),
+                              maxval=attrs.get('high', 1.0))
+
+
+register_alias('uniform', '_random_uniform')
+register_alias('random_uniform', '_random_uniform')
+
+
+@register('_random_normal', input_names=[], needs_rng=True,
+          differentiable=False,
+          param_defaults={'loc': 0.0, 'scale': 1.0, 'shape': (), 'dtype': 'float32'})
+def _normal(attrs, key):
+    return attrs.get('loc', 0.0) + attrs.get('scale', 1.0) * \
+        jax.random.normal(key, _shape(attrs), dtype=_dt(attrs))
+
+
+register_alias('normal', '_random_normal')
+register_alias('random_normal', '_random_normal')
+
+
+@register('_random_gamma', input_names=[], needs_rng=True, differentiable=False,
+          param_defaults={'alpha': 1.0, 'beta': 1.0, 'shape': (), 'dtype': 'float32'})
+def _gamma(attrs, key):
+    return jax.random.gamma(key, attrs.get('alpha', 1.0), _shape(attrs),
+                            dtype=_dt(attrs)) * attrs.get('beta', 1.0)
+
+
+register_alias('random_gamma', '_random_gamma')
+
+
+@register('_random_exponential', input_names=[], needs_rng=True,
+          differentiable=False,
+          param_defaults={'lam': 1.0, 'shape': (), 'dtype': 'float32'})
+def _exponential(attrs, key):
+    return jax.random.exponential(key, _shape(attrs), dtype=_dt(attrs)) / \
+        attrs.get('lam', 1.0)
+
+
+register_alias('random_exponential', '_random_exponential')
+
+
+@register('_random_poisson', input_names=[], needs_rng=True,
+          differentiable=False,
+          param_defaults={'lam': 1.0, 'shape': (), 'dtype': 'float32'})
+def _poisson(attrs, key):
+    return jax.random.poisson(key, attrs.get('lam', 1.0), _shape(attrs)).astype(_dt(attrs))
+
+
+register_alias('random_poisson', '_random_poisson')
+
+
+@register('_random_negative_binomial', input_names=[], needs_rng=True,
+          differentiable=False,
+          param_defaults={'k': 1, 'p': 1.0, 'shape': (), 'dtype': 'float32'})
+def _negbinomial(attrs, key):
+    k, p = attrs.get('k', 1), attrs.get('p', 1.0)
+    # NB(k,p) = Poisson(Gamma(k, (1-p)/p))
+    kg, kp = jax.random.split(key)
+    lam = jax.random.gamma(kg, k, _shape(attrs)) * ((1 - p) / max(p, 1e-6))
+    return jax.random.poisson(kp, lam, _shape(attrs)).astype(_dt(attrs))
+
+
+register_alias('random_negative_binomial', '_random_negative_binomial')
+
+
+@register('_random_generalized_negative_binomial', input_names=[],
+          needs_rng=True, differentiable=False,
+          param_defaults={'mu': 1.0, 'alpha': 1.0, 'shape': (), 'dtype': 'float32'})
+def _gen_negbinomial(attrs, key):
+    mu, alpha = attrs.get('mu', 1.0), attrs.get('alpha', 1.0)
+    kg, kp = jax.random.split(key)
+    shape_k = 1.0 / max(alpha, 1e-6)
+    lam = jax.random.gamma(kg, shape_k, _shape(attrs)) * (mu * alpha)
+    return jax.random.poisson(kp, lam, _shape(attrs)).astype(_dt(attrs))
+
+
+register_alias('random_generalized_negative_binomial',
+               '_random_generalized_negative_binomial')
+
+
+@register('_sample_multinomial', input_names=['data'], needs_rng=True,
+          differentiable=False,
+          param_defaults={'shape': (), 'get_prob': False, 'dtype': 'int32'})
+def _sample_multinomial(attrs, data, key):
+    """Reference sample_multinomial_op.cc — categorical draw per row."""
+    n = attrs.get('shape', ()) or ()
+    if isinstance(n, int):
+        n = (n,)
+    logits = jnp.log(jnp.maximum(data, 1e-20))
+    out_shape = data.shape[:-1] + tuple(n)
+    draws = jax.random.categorical(
+        key, logits[..., None, :] if n else logits,
+        axis=-1, shape=out_shape if n else data.shape[:-1])
+    return draws.astype(_dt({'dtype': attrs.get('dtype', 'int32')}))
+
+
+register_alias('sample_multinomial', '_sample_multinomial')
+
+
+@register('_shuffle', needs_rng=True, differentiable=False)
+def _shuffle(attrs, data, key):
+    return jax.random.permutation(key, data, axis=0)
+
+
+register_alias('shuffle', '_shuffle')
+
+
+def _elemwise_sample(name, sampler, in_names):
+    """sample_uniform etc: per-element distribution params (multisample_op.cc)."""
+    @register(name, input_names=in_names, needs_rng=True, differentiable=False,
+              param_defaults={'shape': (), 'dtype': 'float32'})
+    def op(attrs, *args):
+        key = args[-1]
+        params = args[:-1]
+        extra = _shape(attrs)
+        out_shape = params[0].shape + extra
+        bparams = [jnp.reshape(p, p.shape + (1,) * len(extra)) for p in params]
+        return sampler(key, bparams, out_shape).astype(_dt(attrs))
+    return op
+
+
+_elemwise_sample('_sample_uniform',
+                 lambda key, p, s: p[0] + (p[1] - p[0]) * jax.random.uniform(key, s),
+                 ['low', 'high'])
+register_alias('sample_uniform', '_sample_uniform')
+_elemwise_sample('_sample_normal',
+                 lambda key, p, s: p[0] + p[1] * jax.random.normal(key, s),
+                 ['mu', 'sigma'])
+register_alias('sample_normal', '_sample_normal')
+_elemwise_sample('_sample_gamma',
+                 lambda key, p, s: jax.random.gamma(key, jnp.broadcast_to(p[0], s)) * p[1],
+                 ['alpha', 'beta'])
+register_alias('sample_gamma', '_sample_gamma')
+_elemwise_sample('_sample_exponential',
+                 lambda key, p, s: jax.random.exponential(key, s) / p[0],
+                 ['lam'])
+register_alias('sample_exponential', '_sample_exponential')
+_elemwise_sample('_sample_poisson',
+                 lambda key, p, s: jax.random.poisson(key, jnp.broadcast_to(p[0], s)).astype(jnp.float32),
+                 ['lam'])
+register_alias('sample_poisson', '_sample_poisson')

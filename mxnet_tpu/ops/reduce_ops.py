@@ -1,0 +1,108 @@
+"""Reduction & broadcast-axis ops.
+
+Reference: src/operator/tensor/broadcast_reduce_op_value.cc and
+broadcast_reduce-inl.h — sum/mean/prod/max/min/norm/argmax/argmin + the
+broadcast_axis/broadcast_to pair used by the gradient of reductions.
+"""
+import jax.numpy as jnp
+
+from .registry import register, register_alias
+
+
+def _axis(attrs):
+    ax = attrs.get('axis', None)
+    if ax is None or ax == ():
+        return None
+    if isinstance(ax, (list, tuple)):
+        return tuple(ax) if len(ax) else None
+    return int(ax)
+
+
+def _r(name, f, differentiable=True, aliases=()):
+    @register(name, param_defaults={'axis': None, 'keepdims': False,
+                                    'exclude': False},
+              differentiable=differentiable)
+    def op(attrs, x, _f=f):
+        ax = _axis(attrs)
+        if attrs.get('exclude', False) and ax is not None:
+            axes = (ax,) if isinstance(ax, int) else ax
+            ax = tuple(i for i in range(x.ndim) if i not in
+                       tuple(a % x.ndim for a in axes))
+        return _f(x, axis=ax, keepdims=bool(attrs.get('keepdims', False)))
+    for a in aliases:
+        register_alias(a, name)
+    return op
+
+
+_r('sum', jnp.sum, aliases=('sum_axis',))
+_r('mean', jnp.mean)
+_r('prod', jnp.prod)
+_r('nansum', jnp.nansum)
+_r('nanprod', jnp.nanprod)
+_r('max', jnp.max, aliases=('max_axis',))
+_r('min', jnp.min, aliases=('min_axis',))
+
+
+@register('norm', param_defaults={'axis': None, 'keepdims': False, 'ord': 2})
+def _norm(attrs, x):
+    ax = _axis(attrs)
+    ordv = attrs.get('ord', 2)
+    if ordv == 1:
+        return jnp.sum(jnp.abs(x), axis=ax, keepdims=bool(attrs.get('keepdims', False)))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax,
+                            keepdims=bool(attrs.get('keepdims', False))))
+
+
+def _arg(name, f):
+    @register(name, param_defaults={'axis': None, 'keepdims': False},
+              differentiable=False)
+    def op(attrs, x, _f=f):
+        ax = attrs.get('axis', None)
+        if ax is None:
+            res = _f(x.ravel(), axis=0)
+            if attrs.get('keepdims', False):
+                res = res.reshape((1,) * x.ndim)
+            return res.astype(jnp.float32)
+        res = _f(x, axis=int(ax))
+        if attrs.get('keepdims', False):
+            res = jnp.expand_dims(res, int(ax))
+        return res.astype(jnp.float32)
+    return op
+
+
+_arg('argmax', jnp.argmax)
+_arg('argmin', jnp.argmin)
+
+
+@register('argmax_channel', differentiable=False)
+def _argmax_channel(attrs, x):
+    return jnp.argmax(x, axis=1).astype(jnp.float32)
+
+
+@register('broadcast_axis', param_defaults={'axis': (), 'size': ()})
+def _broadcast_axis(attrs, x):
+    axes = attrs.get('axis', ())
+    sizes = attrs.get('size', ())
+    if isinstance(axes, int):
+        axes, sizes = (axes,), (sizes,)
+    shape = list(x.shape)
+    for a, s in zip(axes, sizes):
+        shape[a] = s
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+register_alias('broadcast_axes', 'broadcast_axis')
+
+
+@register('broadcast_to', param_defaults={'shape': ()})
+def _broadcast_to(attrs, x):
+    tgt = list(attrs['shape'])
+    for i, s in enumerate(tgt):
+        if s == 0:
+            tgt[i] = x.shape[i]
+    return jnp.broadcast_to(x, tuple(tgt))
+
+
+@register('broadcast_like', input_names=['lhs', 'rhs'])
+def _broadcast_like(attrs, lhs, rhs):
+    return jnp.broadcast_to(lhs, rhs.shape)

@@ -1,0 +1,122 @@
+"""Single operator registry — the NNVM Op registry re-imagined for XLA.
+
+Reference: nnvm Op registry + include/mxnet/op_attr_types.h (FCompute,
+FResourceRequest, mutable inputs) and src/nnvm/legacy_op_util.cc (which
+bridged two registries — here there is deliberately ONE registry, per
+SURVEY.md §2.1 N7's note).
+
+Each op declares a pure JAX implementation ``fn(attrs, *arrays)``; everything
+else (shape/type inference, gradient, kernel fusion, memory planning) is
+derived by tracing/compiling that function with XLA — the whole
+attach-op/plan-memory pass pipeline of src/executor collapses into jax.jit.
+
+Conventions:
+- ``fn`` returns a single array or a tuple of arrays.
+- ops mutating inputs in the reference (BatchNorm moving stats — see
+  include/mxnet/op_attr_types.h FMutateInputs) declare ``mutate_inputs``:
+  a dict {input_index: extra_output_index}; the invoke layer writes those
+  extra outputs back into the input NDArrays, preserving the reference's
+  aux-state semantics under a functional compiler.
+- ``train_aware`` ops receive ``__is_train__`` in attrs.
+- ``needs_rng`` ops receive a uint32 PRNG key as their LAST array argument.
+"""
+import functools
+
+import jax
+
+from ..base import normalize_attrs, attr_key
+
+__all__ = ['OpDef', 'register', 'get', 'list_ops', 'jitted']
+
+_OPS = {}
+
+
+class OpDef:
+    def __init__(self, name, fn, num_outputs=1, input_names=None,
+                 param_defaults=None, differentiable=True, variadic=False,
+                 mutate_inputs=None, needs_rng=False, num_visible_outputs=None,
+                 train_aware=False, aux_inputs=(), key_var_num_args=None,
+                 doc=None):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs  # int or callable(attrs)->int
+        self.input_names = input_names or ['data']
+        self.param_defaults = param_defaults or {}
+        self.differentiable = differentiable
+        self.variadic = variadic  # takes *args (Concat/add_n style)
+        self.mutate_inputs = mutate_inputs or {}
+        self.needs_rng = needs_rng
+        self.num_visible_outputs = num_visible_outputs  # int or callable
+        self.train_aware = train_aware
+        self.aux_inputs = tuple(aux_inputs)  # names of inputs that are aux states
+        self.key_var_num_args = key_var_num_args  # attr naming the input count
+        self.doc = doc or (fn.__doc__ or '')
+
+    def n_outputs(self, attrs):
+        n = self.num_outputs
+        return n(attrs) if callable(n) else n
+
+    def n_visible_outputs(self, attrs):
+        n = self.num_visible_outputs
+        if n is None:
+            return self.n_outputs(attrs)
+        return n(attrs) if callable(n) else n
+
+    def arg_names(self, attrs=None, num_args=None):
+        """Input names; variadic ops expand arg0..argN-1."""
+        if self.variadic:
+            n = num_args if num_args is not None else 0
+            return ['arg%d' % i for i in range(n)]
+        return list(self.input_names)
+
+
+def register(name, **kwargs):
+    """Decorator registering ``fn(attrs, *arrays)`` as operator ``name``."""
+    def deco(fn):
+        op = OpDef(name, fn, **kwargs)
+        _OPS[name] = op
+        return fn
+    return deco
+
+
+def register_alias(alias, name):
+    _OPS[alias] = _OPS[name]
+
+
+def get(name):
+    op = _OPS.get(name)
+    if op is None:
+        raise KeyError('operator %r is not registered' % (name,))
+    return op
+
+
+def exists(name):
+    return name in _OPS
+
+
+def list_ops():
+    return sorted(_OPS)
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_impl(name, akey):
+    op = _OPS[name]
+    attrs = dict(akey)
+
+    def f(*arrays):
+        return op.fn(attrs, *arrays)
+    f.__name__ = name
+    return jax.jit(f)
+
+
+def jitted(name, attrs):
+    """Cached jit-compiled closure for (op, attrs). jax.jit adds the
+    shape/dtype-keyed cache on top — together these are the CachedOp
+    (src/c_api/c_api_ndarray.cc:628) analog for the eager path."""
+    return _jitted_impl(name, attr_key(normalize_attrs(attrs)))
+
+
+def apply_op(name, attrs, *arrays):
+    """Uncached direct application (used inside symbol executors where the
+    surrounding graph is already being traced under one jit)."""
+    return _OPS[name].fn(attrs, *arrays)

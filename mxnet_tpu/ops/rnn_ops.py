@@ -1,0 +1,159 @@
+"""Fused multi-layer RNN op.
+
+Reference: src/operator/rnn-inl.h (RNNParam :118, rnn_param_size/weight
+layout) + cudnn_rnn-inl.h:40 (the only real implementation in 0.11 — the CPU
+path is an empty TODO, rnn-inl.h:124-153). This rebuild provides a complete
+implementation on every backend: per-layer ``jax.lax.scan`` over time, which
+XLA compiles into a fused loop with MXU-tiled gate matmuls.
+
+Weight layout matches the cuDNN canonical order the reference uses
+(i2h weights, h2h weights per layer/direction, then i2h/h2h biases), so
+FusedRNNCell.unfuse()-style round trips hold.
+Gate order: LSTM [i, f, g, o]; GRU [r, z, n].
+"""
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+
+def _gates(mode):
+    return {'rnn_relu': 1, 'rnn_tanh': 1, 'lstm': 4, 'gru': 3}[mode]
+
+
+def rnn_param_size(num_layers, state_size, input_size, bidirectional, mode):
+    """Total flat parameter count (reference rnn-inl.h GetParamSize)."""
+    g = _gates(mode)
+    dirs = 2 if bidirectional else 1
+    size = 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else state_size * dirs
+        size += dirs * g * state_size * (isz + state_size)   # W + R
+    size += num_layers * dirs * g * state_size * 2           # biases
+    return size
+
+
+def _unpack(params, num_layers, state_size, input_size, dirs, mode):
+    g = _gates(mode)
+    H = state_size
+    ws, offset = [], 0
+    for layer in range(num_layers):
+        isz = input_size if layer == 0 else H * dirs
+        layer_ws = []
+        for d in range(dirs):
+            W = params[offset:offset + g * H * isz].reshape(g * H, isz)
+            offset += g * H * isz
+            R = params[offset:offset + g * H * H].reshape(g * H, H)
+            offset += g * H * H
+            layer_ws.append((W, R))
+        ws.append(layer_ws)
+    bs = []
+    for layer in range(num_layers):
+        layer_bs = []
+        for d in range(dirs):
+            bW = params[offset:offset + g * H]
+            offset += g * H
+            bR = params[offset:offset + g * H]
+            offset += g * H
+            layer_bs.append((bW, bR))
+        bs.append(layer_bs)
+    return ws, bs
+
+
+def _cell_step(mode, H):
+    if mode == 'lstm':
+        def step(carry, gates_x, R, bR):
+            h, c = carry
+            gates = gates_x + jnp.dot(h, R.T) + bR
+            i = jax.nn.sigmoid(gates[:, 0 * H:1 * H])
+            f = jax.nn.sigmoid(gates[:, 1 * H:2 * H])
+            g = jnp.tanh(gates[:, 2 * H:3 * H])
+            o = jax.nn.sigmoid(gates[:, 3 * H:4 * H])
+            c_new = f * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+        return step
+    if mode == 'gru':
+        def step(carry, gates_x, R, bR):
+            h, _ = carry
+            rz_x = gates_x[:, :2 * H]
+            n_x = gates_x[:, 2 * H:]
+            rz_h = jnp.dot(h, R[:2 * H].T) + bR[:2 * H]
+            r = jax.nn.sigmoid(rz_x[:, :H] + rz_h[:, :H])
+            z = jax.nn.sigmoid(rz_x[:, H:] + rz_h[:, H:])
+            n = jnp.tanh(n_x + r * (jnp.dot(h, R[2 * H:].T) + bR[2 * H:]))
+            h_new = (1 - z) * n + z * h
+            return (h_new, h_new), h_new
+        return step
+    act = jax.nn.relu if mode == 'rnn_relu' else jnp.tanh
+
+    def step(carry, gates_x, R, bR):
+        h, _ = carry
+        h_new = act(gates_x + jnp.dot(h, R.T) + bR)
+        return (h_new, h_new), h_new
+    return step
+
+
+def _run_layer(x, W, R, bW, bR, h0, c0, mode, H, reverse=False):
+    """One direction of one layer. x: (T, N, I) → (T, N, H)."""
+    # hoist the input projection out of the scan: one big MXU matmul
+    gates_x = jnp.einsum('tni,gi->tng', x, W) + bW
+    step = _cell_step(mode, H)
+
+    def body(carry, gx):
+        return step(carry, gx, R, bR)
+
+    (hT, cT), ys = jax.lax.scan(body, (h0, c0), gates_x, reverse=reverse)
+    if reverse:
+        pass  # lax.scan(reverse=True) already emits outputs in input order
+    return ys, hT, cT
+
+
+@register('RNN', input_names=['data', 'parameters', 'state', 'state_cell'],
+          param_defaults={'state_size': 0, 'num_layers': 1,
+                          'bidirectional': False, 'mode': 'lstm', 'p': 0.0,
+                          'state_outputs': False, 'lstm_state_clip_min': None,
+                          'lstm_state_clip_max': None},
+          num_outputs=lambda attrs: (3 if attrs.get('mode') == 'lstm' else 2)
+          if attrs.get('state_outputs', False) else 1,
+          needs_rng=True, train_aware=True)
+def _rnn(attrs, data, parameters, state, *rest):
+    mode = attrs.get('mode', 'lstm')
+    key = rest[-1]
+    state_cell = rest[0] if (mode == 'lstm' and len(rest) > 1) else None
+    H = int(attrs['state_size'])
+    L = int(attrs.get('num_layers', 1))
+    dirs = 2 if attrs.get('bidirectional', False) else 1
+    p = attrs.get('p', 0.0)
+    training = attrs.get('__is_train__', False)
+
+    T, N, I = data.shape
+    ws, bs = _unpack(parameters, L, H, I, dirs, mode)
+
+    x = data
+    h_out, c_out = [], []
+    for layer in range(L):
+        outs = []
+        for d in range(dirs):
+            W, R = ws[layer][d]
+            bW, bR = bs[layer][d]
+            idx = layer * dirs + d
+            h0 = state[idx]
+            c0 = state_cell[idx] if state_cell is not None else jnp.zeros_like(h0)
+            ys, hT, cT = _run_layer(x, W, R, bW, bR, h0, c0, mode, H,
+                                    reverse=(d == 1))
+            outs.append(ys)
+            h_out.append(hT)
+            c_out.append(cT)
+        x = outs[0] if dirs == 1 else jnp.concatenate(outs, axis=-1)
+        if p > 0 and training and layer < L - 1:
+            key, sub = jax.random.split(key)
+            mask = jax.random.bernoulli(sub, 1 - p, x.shape)
+            x = jnp.where(mask, x / (1 - p), 0.0)
+
+    outputs = [x]
+    if attrs.get('state_outputs', False):
+        outputs.append(jnp.stack(h_out))
+        if mode == 'lstm':
+            outputs.append(jnp.stack(c_out))
+    return tuple(outputs) if len(outputs) > 1 else outputs[0]

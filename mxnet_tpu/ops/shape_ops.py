@@ -1,0 +1,352 @@
+"""Shape-manipulation, indexing, init and control-flow ops.
+
+Reference: src/operator/tensor/matrix_op.cc (Reshape/transpose/slice/clip/
+repeat/tile/flip/Concat/stack), indexing_op.cc (take/one_hot/pick/
+batch_take/gather_nd/Embedding grad path), init_op.cc (zeros/ones/arange),
+control_flow.cc (where), src/operator/{concat,slice_channel,swapaxis,pad,
+crop,upsampling}-inl.h.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..base import np_dtype
+from .registry import register, register_alias
+
+
+@register('Reshape', param_defaults={'shape': (), 'reverse': False})
+def _reshape(attrs, x):
+    """Reference matrix_op.cc Reshape incl. special codes 0,-1,-2,-3,-4
+    (matrix_op-inl.h InferReshapeShape)."""
+    target = list(attrs['shape'])
+    if attrs.get('reverse', False):
+        # reverse semantics: match trailing dims first
+        src = list(x.shape)[::-1]
+        tgt = target[::-1]
+        out = _infer_reshape(src, tgt)
+        out = out[::-1]
+    else:
+        out = _infer_reshape(list(x.shape), target)
+    return jnp.reshape(x, tuple(out))
+
+
+def _infer_reshape(src, target):
+    out = []
+    src_idx = 0
+    i = 0
+    while i < len(target):
+        t = target[i]
+        if t == 0:
+            out.append(src[src_idx]); src_idx += 1
+        elif t == -1:
+            out.append(-1); src_idx += 1
+        elif t == -2:
+            out.extend(src[src_idx:]); src_idx = len(src)
+        elif t == -3:
+            out.append(src[src_idx] * src[src_idx + 1]); src_idx += 2
+        elif t == -4:
+            a, b = target[i + 1], target[i + 2]
+            cur = src[src_idx]
+            if a == -1:
+                a = cur // b
+            if b == -1:
+                b = cur // a
+            out.extend([a, b]); src_idx += 1; i += 2
+        else:
+            out.append(t); src_idx += 1
+        i += 1
+    # resolve a single -1
+    if -1 in out:
+        known = int(np.prod([d for d in out if d != -1])) or 1
+        total = int(np.prod(src)) if src else 1
+        out[out.index(-1)] = total // known
+    return out
+
+
+register_alias('reshape', 'Reshape')
+
+
+@register('reshape_like', input_names=['lhs', 'rhs'])
+def _reshape_like(attrs, lhs, rhs):
+    return jnp.reshape(lhs, rhs.shape)
+
+
+@register('Flatten')
+def _flatten(attrs, x):
+    return jnp.reshape(x, (x.shape[0], -1))
+
+
+register_alias('flatten', 'Flatten')
+
+
+@register('transpose', param_defaults={'axes': ()})
+def _transpose(attrs, x):
+    axes = attrs.get('axes', ())
+    return jnp.transpose(x, axes if axes else None)
+
+
+@register('expand_dims', param_defaults={'axis': 0})
+def _expand_dims(attrs, x):
+    return jnp.expand_dims(x, int(attrs['axis']))
+
+
+@register('squeeze', param_defaults={'axis': None})
+def _squeeze(attrs, x):
+    ax = attrs.get('axis', None)
+    if isinstance(ax, int):
+        ax = (ax,)
+    return jnp.squeeze(x, ax)
+
+
+@register('SwapAxis', param_defaults={'dim1': 0, 'dim2': 0})
+def _swapaxis(attrs, x):
+    return jnp.swapaxes(x, int(attrs['dim1']), int(attrs['dim2']))
+
+
+register_alias('swapaxes', 'SwapAxis')
+
+
+@register('slice', param_defaults={'begin': (), 'end': (), 'step': None})
+def _slice(attrs, x):
+    begin, end = attrs['begin'], attrs['end']
+    step = attrs.get('step', None) or (None,) * len(begin)
+    idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
+    return x[idx]
+
+
+register_alias('crop', 'slice')
+
+
+@register('slice_axis', param_defaults={'axis': 0, 'begin': 0, 'end': None})
+def _slice_axis(attrs, x):
+    ax = int(attrs['axis']) % x.ndim
+    idx = [slice(None)] * x.ndim
+    idx[ax] = slice(attrs['begin'], attrs['end'])
+    return x[tuple(idx)]
+
+
+@register('slice_like', input_names=['data', 'shape_like'],
+          param_defaults={'axes': ()})
+def _slice_like(attrs, x, like):
+    axes = attrs.get('axes', ()) or tuple(range(x.ndim))
+    idx = [slice(None)] * x.ndim
+    for a in axes:
+        idx[a] = slice(0, like.shape[a])
+    return x[tuple(idx)]
+
+
+@register('clip', param_defaults={'a_min': 0.0, 'a_max': 0.0})
+def _clip(attrs, x):
+    return jnp.clip(x, attrs['a_min'], attrs['a_max'])
+
+
+@register('repeat', param_defaults={'repeats': 1, 'axis': None})
+def _repeat(attrs, x):
+    return jnp.repeat(x, int(attrs['repeats']), axis=attrs.get('axis', None))
+
+
+@register('tile', param_defaults={'reps': ()})
+def _tile(attrs, x):
+    return jnp.tile(x, attrs['reps'])
+
+
+@register('reverse', param_defaults={'axis': ()})
+def _reverse(attrs, x):
+    ax = attrs['axis']
+    return jnp.flip(x, (ax,) if isinstance(ax, int) else tuple(ax))
+
+
+register_alias('flip', 'reverse')
+
+
+@register('Concat', variadic=True, key_var_num_args='num_args',
+          param_defaults={'dim': 1, 'num_args': 0})
+def _concat(attrs, *xs):
+    """Reference src/operator/concat-inl.h."""
+    return jnp.concatenate(xs, axis=int(attrs.get('dim', 1)))
+
+
+register_alias('concat', 'Concat')
+
+
+@register('stack', variadic=True, key_var_num_args='num_args',
+          param_defaults={'axis': 0, 'num_args': 0})
+def _stack(attrs, *xs):
+    return jnp.stack(xs, axis=int(attrs.get('axis', 0)))
+
+
+def _num_slice_outputs(attrs):
+    return int(attrs.get('num_outputs', 1))
+
+
+@register('SliceChannel', num_outputs=_num_slice_outputs,
+          param_defaults={'num_outputs': 1, 'axis': 1, 'squeeze_axis': False})
+def _slice_channel(attrs, x):
+    """Reference src/operator/slice_channel-inl.h."""
+    n = int(attrs['num_outputs'])
+    ax = int(attrs.get('axis', 1))
+    parts = jnp.split(x, n, axis=ax)
+    if attrs.get('squeeze_axis', False):
+        parts = [jnp.squeeze(p, ax) for p in parts]
+    return tuple(parts)
+
+
+register_alias('split', 'SliceChannel')
+
+
+@register('where', input_names=['condition', 'x', 'y'])
+def _where(attrs, cond, x, y):
+    """Reference src/operator/tensor/control_flow.cc."""
+    if cond.ndim < x.ndim and cond.ndim == 1:
+        cond = cond.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(cond != 0, x, y)
+
+
+@register('take', input_names=['a', 'indices'],
+          param_defaults={'axis': 0, 'mode': 'clip'})
+def _take(attrs, a, indices):
+    """Reference indexing_op.cc take."""
+    mode = attrs.get('mode', 'clip')
+    idx = indices.astype(jnp.int32)
+    ax = int(attrs.get('axis', 0))
+    if mode == 'wrap':
+        idx = jnp.mod(idx, a.shape[ax])
+    return jnp.take(a, idx, axis=ax, mode='clip')
+
+
+@register('batch_take', input_names=['a', 'indices'])
+def _batch_take(attrs, a, indices):
+    idx = indices.astype(jnp.int32).ravel()
+    return a[jnp.arange(a.shape[0]), idx]
+
+
+@register('Embedding', input_names=['data', 'weight'],
+          param_defaults={'input_dim': 0, 'output_dim': 0, 'dtype': 'float32'})
+def _embedding(attrs, data, weight):
+    """Reference indexing_op.cc Embedding (lookup = take on rows)."""
+    return jnp.take(weight, data.astype(jnp.int32), axis=0, mode='clip')
+
+
+@register('one_hot', param_defaults={'depth': 0, 'on_value': 1.0,
+                                     'off_value': 0.0, 'dtype': 'float32'},
+          differentiable=False)
+def _one_hot(attrs, indices):
+    return jax.nn.one_hot(indices.astype(jnp.int32), int(attrs['depth']),
+                          dtype=np_dtype(attrs.get('dtype', 'float32'))) * \
+        (attrs.get('on_value', 1.0) - attrs.get('off_value', 0.0)) + \
+        attrs.get('off_value', 0.0)
+
+
+@register('pick', input_names=['data', 'index'],
+          param_defaults={'axis': -1, 'keepdims': False})
+def _pick(attrs, data, index):
+    ax = int(attrs.get('axis', -1)) % data.ndim
+    idx = index.astype(jnp.int32)
+    picked = jnp.take_along_axis(data, jnp.expand_dims(idx, ax), axis=ax)
+    if not attrs.get('keepdims', False):
+        picked = jnp.squeeze(picked, ax)
+    return picked
+
+
+@register('gather_nd', input_names=['data', 'indices'])
+def _gather_nd(attrs, data, indices):
+    idx = tuple(indices.astype(jnp.int32))
+    return data[idx]
+
+
+@register('scatter_nd', input_names=['data', 'indices'],
+          param_defaults={'shape': ()})
+def _scatter_nd(attrs, data, indices):
+    out = jnp.zeros(attrs['shape'], dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32))
+    return out.at[idx].add(data)
+
+
+@register('Pad', param_defaults={'mode': 'constant', 'pad_width': (),
+                                 'constant_value': 0.0})
+def _pad(attrs, x):
+    """Reference src/operator/pad.cc."""
+    pw = attrs['pad_width']
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode = attrs.get('mode', 'constant')
+    if mode == 'constant':
+        return jnp.pad(x, pairs, mode='constant',
+                       constant_values=attrs.get('constant_value', 0.0))
+    if mode == 'edge':
+        return jnp.pad(x, pairs, mode='edge')
+    return jnp.pad(x, pairs, mode='reflect')
+
+
+register_alias('pad', 'Pad')
+
+
+@register('_zeros', param_defaults={'shape': (), 'dtype': 'float32'},
+          differentiable=False, input_names=[])
+def _zeros_op(attrs, *a):
+    return jnp.zeros(attrs['shape'], dtype=np_dtype(attrs.get('dtype', 'float32')))
+
+
+@register('_ones', param_defaults={'shape': (), 'dtype': 'float32'},
+          differentiable=False, input_names=[])
+def _ones_op(attrs, *a):
+    return jnp.ones(attrs['shape'], dtype=np_dtype(attrs.get('dtype', 'float32')))
+
+
+@register('_arange', param_defaults={'start': 0, 'stop': None, 'step': 1.0,
+                                     'repeat': 1, 'dtype': 'float32'},
+          differentiable=False, input_names=[])
+def _arange_op(attrs, *a):
+    arr = jnp.arange(attrs.get('start', 0), attrs.get('stop'),
+                     attrs.get('step', 1.0),
+                     dtype=np_dtype(attrs.get('dtype', 'float32')))
+    r = int(attrs.get('repeat', 1))
+    return jnp.repeat(arr, r) if r > 1 else arr
+
+
+@register('UpSampling', variadic=True, key_var_num_args='num_args',
+          param_defaults={'scale': 1, 'sample_type': 'nearest',
+                          'num_args': 1, 'num_filter': 0})
+def _upsampling(attrs, *xs):
+    """Reference src/operator/upsampling-inl.h (nearest mode)."""
+    scale = int(attrs['scale'])
+    outs = []
+    for x in xs:
+        y = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+        outs.append(y)
+    if len(outs) == 1:
+        return outs[0]
+    return jnp.concatenate(outs, axis=1)
+
+
+@register('Crop', variadic=True, key_var_num_args='num_args',
+          param_defaults={'offset': (0, 0), 'h_w': (0, 0),
+                          'center_crop': False, 'num_args': 1})
+def _crop(attrs, *xs):
+    """Reference src/operator/crop-inl.h (NCHW spatial crop)."""
+    x = xs[0]
+    if len(xs) == 2:
+        h, w = xs[1].shape[2], xs[1].shape[3]
+    else:
+        h, w = attrs['h_w']
+    if attrs.get('center_crop', False):
+        oh = (x.shape[2] - h) // 2
+        ow = (x.shape[3] - w) // 2
+    else:
+        oh, ow = attrs.get('offset', (0, 0))
+    return x[:, :, oh:oh + h, ow:ow + w]
+
+
+@register('space_to_depth', param_defaults={'block_size': 1})
+def _space_to_depth(attrs, x):
+    b = int(attrs['block_size'])
+    n, c, h, w = x.shape
+    y = x.reshape(n, c, h // b, b, w // b, b)
+    return y.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+
+
+@register('depth_to_space', param_defaults={'block_size': 1})
+def _depth_to_space(attrs, x):
+    b = int(attrs['block_size'])
+    n, c, h, w = x.shape
+    y = x.reshape(n, b, b, c // (b * b), h, w)
+    return y.transpose(0, 3, 4, 1, 5, 2).reshape(n, c // (b * b), h * b, w * b)

@@ -1,0 +1,31 @@
+"""Global PRNG state — stateful seed API over JAX's stateless keys.
+
+Reference: python/mxnet/random.py (mx.random.seed) + src/resource.cc:84
+(per-device seedable mshadow PRNG pools). TPU-native: a process-global
+counter-split key; every random op consumes one fresh subkey, passed to the
+op as a trailing array argument so the op itself stays pure/jittable.
+"""
+import threading
+
+import jax
+import numpy as _np
+
+__all__ = ['seed', 'next_key']
+
+_lock = threading.Lock()
+_key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+
+
+def seed(seed_state):
+    """Seed all device RNG streams (reference random.py:30 mx.random.seed)."""
+    global _key
+    with _lock:
+        _key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split one subkey off the global stream."""
+    global _key
+    with _lock:
+        _key, sub = jax.random.split(_key)
+        return sub
