@@ -1,0 +1,450 @@
+"""Executor — a bound, XLA-compiled symbol graph.
+
+Reference: include/mxnet/executor.h:52-153 + src/executor/graph_executor.cc.
+The reference's Init pipeline (InitFullGraph → gradient pass → AssignContext
+→ PlanMemory → AttachOpExecs → InitCachedOps → bulk segments,
+graph_executor.cc:917-1336) collapses here into tracing ONE pure function
+over the argument arrays and letting jax.jit/XLA do gradient (via vjp),
+scheduling, fusion, and memory planning (SURVEY.md §3.2 "TPU mapping").
+
+Two execution modes:
+- compiled (default): forward and forward+backward are each one jitted XLA
+  computation. When is_train=True the forward is LAZY — Module's
+  forward→backward sequence runs a single fused fwd+bwd computation.
+- staged: used when group2ctx (manual model parallelism) or a monitor
+  callback is active — per-node eager interpretation with device_put at
+  ctx_group boundaries (reference AssignContext + _CrossDeviceCopy,
+  graph_executor.cc:309-423) and per-op observability (ExecuteMonCallback,
+  graph_executor.cc:1398).
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, np_dtype
+from .context import Context
+from . import random as _random
+from .ndarray.ndarray import NDArray, zeros as nd_zeros, from_jax
+from .ops import registry as _reg
+
+__all__ = ['Executor', 'simple_bind']
+
+
+def _entry_key(node, idx):
+    return (id(node), idx)
+
+
+class _GraphProgram:
+    """Compiled form of a symbol: canonical input orders + a pure runner."""
+
+    def __init__(self, symbol):
+        self.symbol = symbol
+        self.topo = symbol._topo()
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.outputs = list(symbol._outputs)
+        self._aux_set = set(self.aux_names)
+
+    def make_runner(self):
+        """Build run(arg_arrays, aux_arrays, key, is_train) ->
+        (outputs, new_aux). Pure; jit-compiled by the executor."""
+        topo = self.topo
+        arg_index = {n: i for i, n in enumerate(self.arg_names)}
+        aux_index = {n: i for i, n in enumerate(self.aux_names)}
+        outputs = self.outputs
+
+        def run(arg_arrays, aux_arrays, key, is_train):
+            env = {}
+            new_aux = dict()
+            for ni, node in enumerate(topo):
+                if node.is_variable():
+                    if node.name in aux_index:
+                        env[_entry_key(node, 0)] = aux_arrays[aux_index[node.name]]
+                    else:
+                        env[_entry_key(node, 0)] = arg_arrays[arg_index[node.name]]
+                    continue
+                op = node.opdef()
+                attrs = dict(node.attrs)
+                if op.train_aware:
+                    attrs['__is_train__'] = is_train
+                ins = [env[_entry_key(p, i)] for p, i in node.inputs]
+                if op.needs_rng:
+                    ins.append(jax.random.fold_in(key, ni))
+                outs = op.fn(attrs, *ins)
+                if not isinstance(outs, (tuple, list)):
+                    outs = (outs,)
+                for i, o in enumerate(outs):
+                    env[_entry_key(node, i)] = o
+                # collect aux updates (BatchNorm moving stats)
+                for in_idx, out_idx in op.mutate_inputs.items():
+                    if in_idx < len(node.inputs):
+                        src, _ = node.inputs[in_idx]
+                        if src.is_variable() and src.name in aux_index:
+                            new_aux[aux_index[src.name]] = outs[out_idx]
+            out_arrays = tuple(env[_entry_key(n, i)] for n, i in outputs)
+            aux_out = tuple(new_aux.get(i, aux_arrays[i])
+                            for i in range(len(self.aux_names)))
+            return out_arrays, aux_out
+
+        return run
+
+
+class Executor:
+    """Reference executor.py:45 wrapper + graph_executor.cc in one."""
+
+    def __init__(self, symbol, ctx, args, args_grad=None, grad_req='write',
+                 aux_states=None, group2ctx=None):
+        self._symbol = symbol
+        self._ctx = ctx if isinstance(ctx, Context) else Context(ctx)
+        self._prog = _GraphProgram(symbol)
+        self._group2ctx = group2ctx
+        self._monitor = None
+        self._monitor_all = False
+
+        self.arg_arrays = self._canon_args(args, self._prog.arg_names, 'args')
+        self.aux_arrays = self._canon_args(aux_states or [],
+                                           self._prog.aux_names, 'aux_states')
+        self.arg_dict = dict(zip(self._prog.arg_names, self.arg_arrays))
+        self.aux_dict = dict(zip(self._prog.aux_names, self.aux_arrays))
+
+        # grad bookkeeping
+        if isinstance(grad_req, str):
+            self._grad_req = {n: grad_req for n in self._prog.arg_names}
+        elif isinstance(grad_req, (list, tuple)):
+            self._grad_req = dict(zip(self._prog.arg_names, grad_req))
+        else:
+            self._grad_req = {n: grad_req.get(n, 'null')
+                              for n in self._prog.arg_names}
+        if args_grad is None:
+            self.grad_arrays = [None] * len(self.arg_arrays)
+        else:
+            self.grad_arrays = self._canon_args(args_grad, self._prog.arg_names,
+                                                'args_grad', allow_missing=True)
+        self.grad_dict = {n: g for n, g in zip(self._prog.arg_names,
+                                               self.grad_arrays)}
+        self._grad_names = [n for n in self._prog.arg_names
+                            if self._grad_req.get(n, 'null') != 'null'
+                            and self.grad_dict.get(n) is not None]
+
+        run = self._prog.make_runner()
+        self._fwd = jax.jit(functools.partial(run), static_argnums=(3,))
+        grad_idx = tuple(self._prog.arg_names.index(n) for n in self._grad_names)
+
+        def fwd_bwd(arg_arrays, aux_arrays, key, head_grads):
+            def f(wrt):
+                full = list(arg_arrays)
+                for i, gi in enumerate(grad_idx):
+                    full[gi] = wrt[i]
+                outs, new_aux = run(tuple(full), aux_arrays, key, True)
+                return outs, new_aux
+
+            wrt = tuple(arg_arrays[gi] for gi in grad_idx)
+            (outs, new_aux), vjp = jax.vjp(f, wrt)
+            zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
+            (grads,) = vjp((head_grads, zero_aux))
+            return outs, new_aux, grads
+
+        self._fwd_bwd = jax.jit(fwd_bwd)
+        self._run_eager = run
+
+        self.outputs_cached = None
+        self._pending = None  # (arg jax arrays, aux jax arrays, key) for lazy train fwd
+
+    def _canon_args(self, args, names, what, allow_missing=False):
+        if isinstance(args, dict):
+            out = []
+            for n in names:
+                if n in args:
+                    out.append(args[n])
+                elif allow_missing:
+                    out.append(None)
+                else:
+                    raise MXNetError('missing %s: %s' % (what, n))
+            return out
+        args = list(args)
+        if len(args) != len(names):
+            raise MXNetError('length of %s (%d) != expected (%d: %s)'
+                             % (what, len(args), len(names), names))
+        return args
+
+    # -- forward ----------------------------------------------------------
+    def forward(self, is_train=False, **kwargs):
+        """Reference executor.py:89 / GraphExecutor::Forward."""
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                if isinstance(v, NDArray):
+                    self.arg_dict[k]._data = v._data
+                else:
+                    self.arg_dict[k]._data = jnp.asarray(np.asarray(v))
+        if self._use_staged():
+            return self._forward_staged(is_train)
+
+        arg_data = tuple(a._data for a in self.arg_arrays)
+        aux_data = tuple(a._data for a in self.aux_arrays)
+        key = _random.next_key()
+        if is_train and self._grad_names:
+            # defer: backward will run the fused fwd+bwd computation
+            self._pending = (arg_data, aux_data, key)
+            self.outputs_cached = None
+            return self._lazy_outputs()
+        self._pending = None
+        outs, new_aux = self._fwd(arg_data, aux_data, key, bool(is_train))
+        if is_train:
+            self._write_aux(new_aux)
+        self.outputs_cached = [from_jax(o, self._ctx) for o in outs]
+        return self.outputs_cached
+
+    def _lazy_outputs(self):
+        self._out_handles = [from_jax(None, self._ctx)
+                             for _ in self._prog.outputs]
+        self._materialized = False
+        return _LazyOutputs(self)
+
+    def _materialize(self):
+        if self._pending is None:
+            return
+        arg_data, aux_data, key = self._pending
+        outs, new_aux = self._fwd(arg_data, aux_data, key, True)
+        self._write_aux(new_aux)
+        for h, o in zip(self._out_handles, outs):
+            h._data = o
+        self.outputs_cached = self._out_handles
+        self._pending = None
+
+    def _write_aux(self, new_aux):
+        for a, v in zip(self.aux_arrays, new_aux):
+            a._data = v
+
+    @property
+    def outputs(self):
+        if self._pending is not None:
+            self._materialize()
+        if self.outputs_cached is None:
+            self.forward(False)
+        return self.outputs_cached
+
+    # -- backward ---------------------------------------------------------
+    def backward(self, out_grads=None, is_train=True):
+        """Reference GraphExecutor::Backward (graph_executor.cc:93)."""
+        if self._use_staged():
+            return self._backward_staged(out_grads)
+        if self._pending is not None:
+            arg_data, aux_data, key = self._pending
+        else:
+            arg_data = tuple(a._data for a in self.arg_arrays)
+            aux_data = tuple(a._data for a in self.aux_arrays)
+            key = _random.next_key()
+        heads = self._head_grads(out_grads, arg_data, aux_data)
+        outs, new_aux, grads = self._fwd_bwd(arg_data, aux_data, key, heads)
+        self._write_aux(new_aux)
+        if self._pending is not None:
+            for h, o in zip(self._out_handles, outs):
+                h._data = o
+            self.outputs_cached = self._out_handles
+            self._pending = None
+        else:
+            self.outputs_cached = [from_jax(o, self._ctx) for o in outs]
+        self._assign_grads(grads)
+
+    def _head_grads(self, out_grads, arg_data, aux_data):
+        if out_grads is None:
+            shapes = self._out_shapes(arg_data, aux_data)
+            return tuple(jnp.ones(s, d) for s, d in shapes)
+        if isinstance(out_grads, NDArray):
+            out_grads = [out_grads]
+        return tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                     for g in out_grads)
+
+    @functools.lru_cache(maxsize=8)
+    def _out_shapes_cached(self, shapes_key):
+        return None
+
+    def _out_shapes(self, arg_data, aux_data):
+        outs = jax.eval_shape(lambda a, x: self._run_eager(a, x, jnp.zeros((2,), jnp.uint32), True)[0],
+                              arg_data, aux_data)
+        return [(o.shape, o.dtype) for o in outs]
+
+    def _assign_grads(self, grads):
+        for name, g in zip(self._grad_names, grads):
+            dst = self.grad_dict[name]
+            req = self._grad_req[name]
+            if req == 'add':
+                dst._data = dst._data + g.astype(dst._data.dtype)
+            else:
+                dst._data = g.astype(dst._data.dtype)
+
+    # -- staged (group2ctx / monitor) mode --------------------------------
+    def _use_staged(self):
+        return self._group2ctx is not None or self._monitor is not None
+
+    def _node_device(self, node):
+        if self._group2ctx:
+            grp = node.attr_dict.get('ctx_group')
+            if grp and grp in self._group2ctx:
+                return self._group2ctx[grp].jax_device()
+        return self._ctx.jax_device()
+
+    def _forward_staged(self, is_train):
+        env = {}
+        prog = self._prog
+        aux_index = {n: i for i, n in enumerate(prog.aux_names)}
+        arg_index = {n: i for i, n in enumerate(prog.arg_names)}
+        for ni, node in enumerate(prog.topo):
+            dev = self._node_device(node)
+            if node.is_variable():
+                src = (self.aux_arrays[aux_index[node.name]] if node.name in aux_index
+                       else self.arg_arrays[arg_index[node.name]])
+                env[_entry_key(node, 0)] = jax.device_put(src._data, dev)
+                continue
+            op = node.opdef()
+            attrs = dict(node.attrs)
+            if op.train_aware:
+                attrs['__is_train__'] = bool(is_train)
+            ins = [jax.device_put(env[_entry_key(p, i)], dev)
+                   for p, i in node.inputs]
+            if op.needs_rng:
+                ins.append(_random.next_key())
+            outs = op.fn(attrs, *ins)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for i, o in enumerate(outs):
+                env[_entry_key(node, i)] = o
+            if self._monitor is not None:
+                nvis = op.n_visible_outputs(node.attrs)
+                for i in range(nvis):
+                    self._monitor(node.name if nvis == 1 else
+                                  '%s_%d' % (node.name, i),
+                                  from_jax(outs[i], self._ctx))
+            if is_train:
+                for in_idx, out_idx in op.mutate_inputs.items():
+                    src, _ = node.inputs[in_idx]
+                    if src.is_variable() and src.name in aux_index:
+                        self.aux_arrays[aux_index[src.name]]._data = outs[out_idx]
+        self.outputs_cached = [from_jax(env[_entry_key(n, i)], self._ctx)
+                               for n, i in prog.outputs]
+        self._staged_env_inputs = None
+        return self.outputs_cached
+
+    def _backward_staged(self, out_grads):
+        # eager vjp over the pure runner (device movement handled by jax)
+        arg_data = tuple(a._data for a in self.arg_arrays)
+        aux_data = tuple(a._data for a in self.aux_arrays)
+        key = _random.next_key()
+        grad_idx = tuple(self._prog.arg_names.index(n) for n in self._grad_names)
+
+        def f(wrt):
+            full = list(arg_data)
+            for i, gi in enumerate(grad_idx):
+                full[gi] = wrt[i]
+            outs, _ = self._run_eager(tuple(full), aux_data, key, True)
+            return outs
+
+        wrt = tuple(arg_data[gi] for gi in grad_idx)
+        outs, vjp = jax.vjp(f, wrt)
+        if out_grads is None:
+            heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            heads = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                          for g in out_grads)
+        (grads,) = vjp(heads)
+        self.outputs_cached = [from_jax(o, self._ctx) for o in outs]
+        self._assign_grads(grads)
+
+    # -- misc API ---------------------------------------------------------
+    def set_monitor_callback(self, callback, monitor_all=False):
+        """Reference executor.h:148 SetMonitorCallback; forces staged mode."""
+        self._monitor = callback
+        self._monitor_all = monitor_all
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._data = arr._data.astype(
+                    self.arg_dict[name]._data.dtype)
+            elif not allow_extra_params:
+                raise ValueError('Found name "%s" that is not in the arguments' % name)
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._data = arr._data.astype(
+                        self.aux_dict[name]._data.dtype)
+                elif not allow_extra_params:
+                    raise ValueError('Found name "%s" that is not in the auxiliary states' % name)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new input shapes; XLA recompiles (cached per shape)."""
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, sh in zip(self._prog.arg_names, arg_shapes):
+            cur = self.arg_dict[name]
+            if tuple(cur.shape) == tuple(sh):
+                new_args[name] = cur
+            else:
+                new_args[name] = nd_zeros(sh, ctx=self._ctx,
+                                          dtype=str(cur._data.dtype))
+        new_aux = {}
+        for name, sh in zip(self._prog.aux_names, aux_shapes):
+            cur = self.aux_dict[name]
+            new_aux[name] = cur if tuple(cur.shape) == tuple(sh) else \
+                nd_zeros(sh, ctx=self._ctx, dtype=str(cur._data.dtype))
+        grads = None
+        if any(g is not None for g in self.grad_arrays):
+            grads = {n: nd_zeros(new_args[n].shape, ctx=self._ctx)
+                     for n in self._grad_names}
+        return Executor(self._symbol, self._ctx, new_args, grads,
+                        self._grad_req, new_aux, group2ctx=self._group2ctx)
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+
+class _LazyOutputs(list):
+    """List proxy that materializes the deferred training forward on access."""
+
+    def __init__(self, executor):
+        super().__init__(executor._out_handles)
+        self._exec = executor
+
+    def __getitem__(self, i):
+        self._exec._materialize()
+        return super().__getitem__(i)
+
+    def __iter__(self):
+        self._exec._materialize()
+        return super().__iter__()
+
+
+def simple_bind(symbol, ctx, grad_req='write', type_dict=None, group2ctx=None,
+                shared_exec=None, **kwargs):
+    """Reference symbol.py:1250 Symbol.simple_bind: infer shapes, allocate."""
+    arg_shapes, out_shapes, aux_shapes = symbol.infer_shape(**kwargs)
+    if arg_shapes is None:
+        raise MXNetError('cannot infer shapes')
+    type_dict = type_dict or {}
+    arg_names = symbol.list_arguments()
+    aux_names = symbol.list_auxiliary_states()
+    args = {}
+    for name, sh in zip(arg_names, arg_shapes):
+        dt = str(np_dtype(type_dict.get(name, 'float32')))
+        args[name] = nd_zeros(sh, ctx=ctx, dtype=dt)
+    aux = {}
+    for name, sh in zip(aux_names, aux_shapes):
+        aux[name] = nd_zeros(sh, ctx=ctx)
+    grads = None
+    req_of = (lambda n: grad_req) if isinstance(grad_req, str) else \
+        (lambda n: grad_req[arg_names.index(n)] if isinstance(grad_req, (list, tuple))
+         else grad_req.get(n, 'null'))
+    if grad_req != 'null':
+        grads = {}
+        for name, sh in zip(arg_names, arg_shapes):
+            if req_of(name) != 'null':
+                grads[name] = nd_zeros(sh, ctx=ctx)
+    return Executor(symbol, ctx, args, grads, grad_req, aux,
+                    group2ctx=group2ctx)

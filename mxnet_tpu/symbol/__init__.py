@@ -1,0 +1,18 @@
+"""mx.sym — symbolic namespace (reference python/mxnet/symbol/__init__.py)."""
+from .symbol import Symbol, Variable, var, Group, load, load_json, create
+from . import register as _register
+
+_register.install_ops(globals())
+
+
+def zeros(shape, dtype='float32', **kwargs):
+    return _register.make_sym_function('_zeros')(shape=tuple(shape) if not isinstance(shape, int) else (shape,), dtype=dtype, **kwargs)
+
+
+def ones(shape, dtype='float32', **kwargs):
+    return _register.make_sym_function('_ones')(shape=tuple(shape) if not isinstance(shape, int) else (shape,), dtype=dtype, **kwargs)
+
+
+def arange(start, stop=None, step=1.0, repeat=1, dtype='float32', **kwargs):
+    return _register.make_sym_function('_arange')(start=start, stop=stop, step=step,
+                                                  repeat=repeat, dtype=dtype, **kwargs)

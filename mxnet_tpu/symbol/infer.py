@@ -1,0 +1,230 @@
+"""Shape/type inference over a Symbol graph.
+
+Reference: src/executor/infer_graph_attr_pass.cc:302-338 (InferShape/
+InferType fixpoint over per-op FInferShape) — the piece of the reference's
+bind pipeline that must stay host-side even in the XLA world, because
+simple_bind allocates parameter arrays before any tracing happens.
+
+Design: forward topo walk with jax.eval_shape per node; unknown *parameter*
+shapes are filled by per-op hooks keyed on the data input's shape + attrs
+(the practically-used direction of the reference's bidirectional solver).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, np_dtype
+from ..ops import registry as _reg
+
+__all__ = ['infer_shapes', 'infer_types', 'param_shape_hook']
+
+_PARAM_HOOKS = {}
+
+
+def param_shape_hook(op_name):
+    def deco(fn):
+        _PARAM_HOOKS[op_name] = fn
+        return fn
+    return deco
+
+
+@param_shape_hook('FullyConnected')
+def _fc_params(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    flat = int(np.prod(data[1:])) if attrs.get('flatten', True) else data[-1]
+    n = int(attrs['num_hidden'])
+    out = {'weight': (n, flat)}
+    if not attrs.get('no_bias', False):
+        out['bias'] = (n,)
+    return out
+
+
+@param_shape_hook('Convolution')
+def _conv_params(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    nf = int(attrs['num_filter'])
+    g = int(attrs.get('num_group', 1))
+    kernel = tuple(attrs['kernel'])
+    out = {'weight': (nf, data[1] // g) + kernel}
+    if not attrs.get('no_bias', False):
+        out['bias'] = (nf,)
+    return out
+
+
+@param_shape_hook('Deconvolution')
+def _deconv_params(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    nf = int(attrs['num_filter'])
+    g = int(attrs.get('num_group', 1))
+    kernel = tuple(attrs['kernel'])
+    out = {'weight': (data[1], nf // g) + kernel}
+    if not attrs.get('no_bias', True):
+        out['bias'] = (nf,)
+    return out
+
+
+@param_shape_hook('BatchNorm')
+def _bn_params(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    ax = int(attrs.get('axis', 1)) % len(data)
+    c = data[ax]
+    return {'gamma': (c,), 'beta': (c,), 'moving_mean': (c,), 'moving_var': (c,)}
+
+
+@param_shape_hook('InstanceNorm')
+def _in_params(attrs, in_shapes):
+    data = in_shapes[0]
+    return {'gamma': (data[1],), 'beta': (data[1],)} if data else {}
+
+
+@param_shape_hook('LayerNorm')
+def _ln_params(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    ax = int(attrs.get('axis', -1)) % len(data)
+    return {'gamma': (data[ax],), 'beta': (data[ax],)}
+
+
+@param_shape_hook('Embedding')
+def _emb_params(attrs, in_shapes):
+    return {'weight': (int(attrs['input_dim']), int(attrs['output_dim']))}
+
+
+@param_shape_hook('LeakyReLU')
+def _lrelu_params(attrs, in_shapes):
+    if attrs.get('act_type') == 'prelu' and in_shapes[0]:
+        return {'gamma': (in_shapes[0][1],)}
+    return {}
+
+
+@param_shape_hook('RNN')
+def _rnn_params(attrs, in_shapes):
+    from ..ops.rnn_ops import rnn_param_size
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    H = int(attrs['state_size'])
+    L = int(attrs.get('num_layers', 1))
+    bi = bool(attrs.get('bidirectional', False))
+    dirs = 2 if bi else 1
+    mode = attrs.get('mode', 'lstm')
+    n = rnn_param_size(L, H, data[2], bi, mode)
+    out = {'parameters': (n,), 'state': (L * dirs, data[1], H)}
+    if mode == 'lstm':
+        out['state_cell'] = (L * dirs, data[1], H)
+    return out
+
+
+def _node_arg_name(node, i):
+    op = node.opdef()
+    names = op.input_names
+    return names[i] if i < len(names) else 'arg%d' % i
+
+
+def infer_shapes(symbol, known, partial=False, known_types=None):
+    """Returns (arg_shapes, out_shapes, aux_shapes) in canonical orders."""
+    known_types = known_types or {}
+    shapes = {}   # id(node) -> tuple per output
+    var_shape = {}
+
+    for n in symbol._topo():
+        if n.is_variable():
+            s = known.get(n.name)
+            if s is None and '__shape__' in n.attr_dict:
+                import ast
+                s = tuple(ast.literal_eval(n.attr_dict['__shape__']))
+            var_shape[n.name] = tuple(s) if s is not None else None
+            shapes[id(n)] = [var_shape[n.name]]
+            continue
+        op = n.opdef()
+        in_shapes = []
+        for (p, idx) in n.inputs:
+            sh = shapes.get(id(p))
+            in_shapes.append(sh[idx] if sh is not None and sh[idx] is not None else None)
+        # fill unknown parameter-variable shapes via hook
+        hook = _PARAM_HOOKS.get(n.op)
+        if hook is not None:
+            fills = hook(n.attrs, in_shapes)
+            for i, (p, idx) in enumerate(n.inputs):
+                if in_shapes[i] is None and p.is_variable():
+                    want = fills.get(_node_arg_name(n, i))
+                    if want is not None:
+                        var_shape[p.name] = tuple(int(x) for x in want)
+                        shapes[id(p)] = [var_shape[p.name]]
+                        in_shapes[i] = var_shape[p.name]
+        if any(s is None for s in in_shapes):
+            if partial:
+                shapes[id(n)] = [None] * op.n_outputs(n.attrs)
+                continue
+            missing = [_node_arg_name(n, i) for i, s in enumerate(in_shapes) if s is None]
+            raise MXNetError('cannot infer shape for inputs %s of node %s(%s)'
+                             % (missing, n.name, n.op))
+        out_shapes = _eval_node_shape(n, in_shapes, known_types)
+        shapes[id(n)] = out_shapes
+
+    args = symbol.list_arguments()
+    auxs = symbol.list_auxiliary_states()
+    arg_shapes = [var_shape.get(a) for a in args]
+    aux_shapes = [var_shape.get(a) for a in auxs]
+    out_shapes = []
+    for node, idx in symbol._outputs:
+        s = shapes.get(id(node))
+        out_shapes.append(s[idx] if s else None)
+    return arg_shapes, out_shapes, aux_shapes
+
+
+def _eval_node_shape(n, in_shapes, known_types):
+    op = n.opdef()
+    attrs = dict(n.attrs)
+    if op.train_aware:
+        attrs['__is_train__'] = False
+    specs = [jax.ShapeDtypeStruct(s, np_dtype(known_types.get(None, 'float32')))
+             for s in in_shapes]
+    if op.needs_rng:
+        specs.append(jax.ShapeDtypeStruct((2,), np.uint32))
+
+    def f(*arrays):
+        return op.fn(attrs, *arrays)
+    try:
+        out = jax.eval_shape(f, *specs)
+    except Exception as e:
+        raise MXNetError('shape inference failed at %s(%s) with inputs %s: %s'
+                         % (n.name, n.op, in_shapes, e))
+    if not isinstance(out, (tuple, list)):
+        out = (out,)
+    return [tuple(o.shape) for o in out]
+
+
+def infer_types(symbol, known):
+    dtypes = {}
+    var_dtype = {}
+    for n in symbol._topo():
+        if n.is_variable():
+            t = known.get(n.name)
+            if t is None and '__dtype__' in n.attr_dict:
+                t = n.attr_dict['__dtype__']
+            var_dtype[n.name] = np_dtype(t) if t is not None else np.dtype('float32')
+            dtypes[id(n)] = [var_dtype[n.name]]
+            continue
+        in_dtypes = [dtypes[id(p)][i] for (p, i) in n.inputs]
+        # forward propagate: result dtype = first floating input (simplified)
+        out_t = in_dtypes[0] if in_dtypes else np.dtype('float32')
+        if n.op == 'Cast':
+            out_t = np_dtype(n.attrs['dtype'])
+        op = n.opdef()
+        dtypes[id(n)] = [out_t] * op.n_outputs(n.attrs)
+    args = symbol.list_arguments()
+    auxs = symbol.list_auxiliary_states()
+    outs = [dtypes[id(node)][idx] for node, idx in symbol._outputs]
+    return ([var_dtype.get(a, np.dtype('float32')) for a in args], outs,
+            [var_dtype.get(a, np.dtype('float32')) for a in auxs])

@@ -1,0 +1,30 @@
+"""Auto-generation of the sym.* operator namespace.
+
+Reference: python/mxnet/symbol/op.py:65 (_make_atomic_symbol_function) —
+same introspection-driven generation as the nd namespace, producing symbol
+composers instead of imperative calls.
+"""
+from ..ops import registry as _reg
+from .symbol import Symbol, _invoke_sym
+
+__all__ = ['make_sym_function', 'install_ops']
+
+
+def make_sym_function(op_name):
+    op = _reg.get(op_name)
+
+    def fn(*args, **kwargs):
+        inputs = [a for a in args if isinstance(a, Symbol)]
+        return _invoke_sym(op_name, inputs, kwargs)
+
+    fn.__name__ = op_name
+    fn.__doc__ = op.doc
+    return fn
+
+
+def install_ops(namespace):
+    for name in _reg.list_ops():
+        if name.startswith('_slice_like'):
+            continue
+        namespace[name] = make_sym_function(name)
+    return namespace
