@@ -17,3 +17,35 @@ from . import random
 from .random import seed  # noqa: F401
 from . import autograd
 from . import engine
+from . import symbol
+from . import symbol as sym
+from .symbol import Symbol
+from . import attribute
+from .attribute import AttrScope
+from . import executor
+from . import initializer
+from .initializer import init  # noqa: F401
+from . import optimizer
+from . import optimizer as opt
+from . import lr_scheduler
+from . import metric
+from . import callback
+from . import io
+from . import recordio
+from . import kvstore
+from . import kvstore as kv
+from . import model
+from .model import save_checkpoint, load_checkpoint
+from . import module
+from . import module as mod
+from . import rnn
+from . import monitor
+from .monitor import Monitor
+from . import profiler
+from . import visualization
+from . import visualization as viz
+from . import test_utils
+from . import registry
+from .executor_manager import DataParallelExecutorManager  # noqa: F401
+from . import operator
+from .operator import CustomOp, CustomOpProp

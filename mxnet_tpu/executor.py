@@ -251,21 +251,23 @@ class Executor:
 
     def _head_grads(self, out_grads, arg_data, aux_data):
         if out_grads is None:
-            shapes = self._out_shapes(arg_data, aux_data)
-            return tuple(jnp.ones(s, d) for s, d in shapes)
+            return tuple(jnp.ones(s, d)
+                         for s, d in self._out_shapes(arg_data, aux_data))
         if isinstance(out_grads, NDArray):
             out_grads = [out_grads]
         return tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
                      for g in out_grads)
 
-    @functools.lru_cache(maxsize=8)
-    def _out_shapes_cached(self, shapes_key):
-        return None
-
     def _out_shapes(self, arg_data, aux_data):
+        key = tuple((a.shape, str(a.dtype)) for a in arg_data)
+        cached = getattr(self, '_out_shapes_memo', None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
         outs = jax.eval_shape(lambda a, x: self._run_eager(a, x, jnp.zeros((2,), jnp.uint32), True)[0],
                               arg_data, aux_data)
-        return [(o.shape, o.dtype) for o in outs]
+        res = [(o.shape, o.dtype) for o in outs]
+        self._out_shapes_memo = (key, res)
+        return res
 
     def _assign_grads(self, grads):
         for name, g in zip(self._grad_names, grads):
@@ -363,17 +365,20 @@ class Executor:
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
+        dev = self._ctx.jax_device()
         for name, arr in arg_params.items():
             if name in self.arg_dict:
-                self.arg_dict[name]._data = arr._data.astype(
-                    self.arg_dict[name]._data.dtype)
+                dst = self.arg_dict[name]
+                dst._data = jax.device_put(
+                    arr._data.astype(dst._data.dtype), dev)
             elif not allow_extra_params:
                 raise ValueError('Found name "%s" that is not in the arguments' % name)
         if aux_params:
             for name, arr in aux_params.items():
                 if name in self.aux_dict:
-                    self.aux_dict[name]._data = arr._data.astype(
-                        self.aux_dict[name]._data.dtype)
+                    dst = self.aux_dict[name]
+                    dst._data = jax.device_put(
+                        arr._data.astype(dst._data.dtype), dev)
                 elif not allow_extra_params:
                     raise ValueError('Found name "%s" that is not in the auxiliary states' % name)
 

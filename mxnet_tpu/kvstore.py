@@ -1,0 +1,180 @@
+"""KVStore — key-value parameter store with device/dist tiers.
+
+Reference: include/mxnet/kvstore.h:45-394 + src/kvstore/ (KVStoreLocal
+kvstore_local.h:49, Comm device tier comm.h:40, KVStoreDist kvstore_dist.h:52)
++ python/mxnet/kvstore.py:76.
+
+TPU-native mapping (SURVEY.md §5.8): the reference's device tier is
+reduce-to-one-GPU + broadcast; here the aggregation happens as one fused XLA
+computation on the merge device, and when the caller is inside a pjit'd step
+the same API lowers to jax.lax.psum over the mesh (parallel/collectives.py).
+The dist tier (multi-host parameter server over ZMQ in the reference) is
+provided by kvstore_dist.py over TCP sockets with the same worker/server/
+scheduler role split (DMLC_ROLE env protocol preserved).
+"""
+import os
+import pickle
+
+import numpy as np
+
+import jax
+
+from . import optimizer as opt
+from .ndarray import NDArray, zeros
+from .base import MXNetError
+
+__all__ = ['KVStore', 'create']
+
+
+def _ctx_group_key(arrs):
+    return tuple(id(a) for a in arrs)
+
+
+class KVStore:
+    """Reference kvstore.py:76 — Init/Push/Pull over string or int keys."""
+
+    def __init__(self, kv_type='local'):
+        self.type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._str_keys = {}
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            if k in self._store:
+                continue
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = vv.copy() if isinstance(vv, NDArray) else vv
+
+    def push(self, key, value, priority=0):
+        """Reduce value(s) per key; run updater or store the merged grad
+        (reference kvstore_local.h:149 PushImpl)."""
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, (list, tuple)):
+                vlist = [vlist]
+            merged = self._reduce(vlist)
+            if self._updater is not None:
+                self._updater(_updater_key(k), merged, self._store[k])
+            else:
+                self._store[k] = merged
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        """Broadcast stored value to out array(s) (kvstore_local.h:188)."""
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            src = self._store[k]
+            for o in olist:
+                o._data = jax.device_put(src._data, o.context.jax_device())
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Reference kvstore_local.h:203 PullRowSparseImpl."""
+        from .ndarray.sparse import RowSparseNDArray, row_sparse_array, retain
+        assert out is not None and row_ids is not None
+        keys, outs = _key_value(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rids in zip(keys, outs, row_ids if isinstance(row_ids, list) else [row_ids]):
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            src = self._store[k]
+            if isinstance(src, RowSparseNDArray):
+                res = retain(src, rids)
+            else:
+                rows = rids.asnumpy().astype(np.int64)
+                res = row_sparse_array((src[rows], rows), shape=src.shape)
+            for o in olist:
+                if isinstance(o, RowSparseNDArray):
+                    o.data, o.indices = res.data, res.indices
+                else:
+                    res.copyto(o)
+
+    def _reduce(self, vlist):
+        """Device-tier reduce (comm.h CommDevice::Reduce :477): gather the
+        shards onto the merge device and let XLA sum them in one kernel."""
+        from .ndarray.sparse import BaseSparseNDArray
+        if isinstance(vlist[0], BaseSparseNDArray):
+            dense = [v.tostype('default') for v in vlist]
+            vlist = dense
+        if len(vlist) == 1:
+            return vlist[0].copy()
+        dev = vlist[0].context.jax_device()
+        import jax.numpy as jnp
+        total = vlist[0]._data
+        for v in vlist[1:]:
+            total = total + jax.device_put(v._data, dev)
+        out = NDArray(total, vlist[0].context)
+        return out
+
+    # -- optimizer plumbing ----------------------------------------------
+    def set_updater(self, updater):
+        """Reference kvstore.py:460 _set_updater."""
+        self._updater = updater
+
+    def set_optimizer(self, optimizer):
+        """Reference kvstore.py:349 — on dist, ships the pickled optimizer to
+        the servers; locally installs it as the updater."""
+        self._optimizer = optimizer
+        self.set_updater(opt.get_updater(optimizer))
+
+    # -- cluster topology (single-process defaults) -----------------------
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    def barrier(self):
+        pass
+
+    def _barrier(self):
+        self.barrier()
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+    # -- optimizer state checkpointing (reference kvstore.py:433) ---------
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        assert self._updater is not None, 'Cannot save states for distributed training'
+        with open(fname, 'wb') as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        assert self._updater is not None, 'Cannot load states for distributed training'
+        with open(fname, 'rb') as fin:
+            self._updater.set_states(fin.read())
+
+
+def _updater_key(k):
+    if isinstance(k, str) and k.isdigit():
+        return int(k)
+    return k
+
+
+def _key_value(key, value):
+    if isinstance(key, (str, int)):
+        return [key], [value]
+    assert len(key) == len(value)
+    return list(key), list(value)
+
+
+def create(name='local'):
+    """Reference kvstore.cc:34-60 factory: local | device | dist_sync |
+    dist_device_sync | dist_async."""
+    if not isinstance(name, str):
+        raise TypeError('name must be a string')
+    if 'dist' in name:
+        from .kvstore_dist import KVStoreDist
+        return KVStoreDist(name)
+    if name in ('local', 'device', 'local_allreduce_cpu',
+                'local_allreduce_device'):
+        return KVStore(name)
+    raise MXNetError('unknown KVStore type %s' % name)

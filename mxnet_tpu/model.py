@@ -1,0 +1,167 @@
+"""Checkpointing + kvstore training helpers.
+
+Reference: python/mxnet/model.py (967 LoC) — save_checkpoint:340 /
+load_checkpoint:370 ({prefix}-symbol.json + {prefix}-{epoch:04d}.params with
+arg:/aux: key prefixes), and the kvstore helpers Module/Gluon build on:
+_create_kvstore:57, _initialize_kvstore:96, _update_params(_on_kvstore):105.
+"""
+import logging
+from collections import namedtuple
+
+from . import io
+from . import ndarray as nd
+from . import symbol as sym
+from . import optimizer as opt
+from . import metric
+from . import kvstore as kvs
+
+BatchEndParam = namedtuple('BatchEndParams',
+                           ['epoch', 'nbatch', 'eval_metric', 'locals'])
+
+
+def _create_kvstore(kvstore, num_device, arg_params):
+    """Reference model.py:57 — returns (kvstore, update_on_kvstore)."""
+    update_on_kvstore = True
+    if kvstore is None:
+        kv = None
+    elif isinstance(kvstore, kvs.KVStore):
+        kv = kvstore
+    elif isinstance(kvstore, str):
+        if num_device == 1 and 'dist' not in kvstore:
+            kv = None
+        else:
+            kv = kvs.create(kvstore)
+            if kvstore == 'local':
+                max_size = max(np.prod(param.shape) for param in arg_params.values()) \
+                    if arg_params else 0
+                if max_size > 1024 * 1024 * 16:
+                    update_on_kvstore = False
+    else:
+        raise TypeError('kvstore must be KVStore, string or None')
+    if kv is None:
+        update_on_kvstore = False
+    return (kv, update_on_kvstore)
+
+
+import numpy as np  # noqa: E402 (used above lazily)
+
+
+def _initialize_kvstore(kvstore, param_arrays, arg_params, param_names,
+                        update_on_kvstore):
+    """Reference model.py:96."""
+    for idx, param_on_devs in enumerate(param_arrays):
+        name = param_names[idx]
+        kvstore.init(name, arg_params[name])
+        if update_on_kvstore:
+            kvstore.pull(name, param_on_devs, priority=-idx)
+
+
+def _update_params_on_kvstore(param_arrays, grad_arrays, kvstore, param_names):
+    """Reference model.py:105 — push grads, pull updated weights."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        name = param_names[index]
+        kvstore.push(name, grad_list, priority=-index)
+        kvstore.pull(name, arg_list, priority=-index)
+
+
+def _update_params(param_arrays, grad_arrays, updater, num_device,
+                   kvstore=None, param_names=None):
+    """Reference model.py:117 — aggregate on kvstore, update locally."""
+    for index, pair in enumerate(zip(param_arrays, grad_arrays)):
+        arg_list, grad_list = pair
+        if grad_list[0] is None:
+            continue
+        if kvstore:
+            name = param_names[index]
+            kvstore.push(name, grad_list, priority=-index)
+            kvstore.pull(name, grad_list, priority=-index)
+        for k, p in enumerate(zip(arg_list, grad_list)):
+            w, g = p
+            updater(index * num_device + k, g, w)
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Reference model.py:340."""
+    if symbol is not None:
+        symbol.save('%s-symbol.json' % prefix)
+    save_dict = {('arg:%s' % k): v for k, v in arg_params.items()}
+    save_dict.update({('aux:%s' % k): v for k, v in aux_params.items()})
+    param_name = '%s-%04d.params' % (prefix, epoch)
+    nd.save(param_name, save_dict)
+    logging.info('Saved checkpoint to "%s"', param_name)
+
+
+def load_checkpoint(prefix, epoch):
+    """Reference model.py:370. Returns (symbol, arg_params, aux_params)."""
+    symbol = sym.load('%s-symbol.json' % prefix)
+    save_dict = nd.load('%s-%04d.params' % (prefix, epoch))
+    arg_params = {}
+    aux_params = {}
+    for k, v in save_dict.items():
+        tp, name = k.split(':', 1)
+        if tp == 'arg':
+            arg_params[name] = v
+        if tp == 'aux':
+            aux_params[name] = v
+    return (symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Deprecated legacy API (reference model.py FeedForward) — kept as a
+    thin shim over Module for API completeness."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None, epoch_size=None,
+                 optimizer='sgd', initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, allow_extra_params=False,
+                 begin_epoch=0, **kwargs):
+        from .module import Module
+        from .initializer import Uniform
+        self.symbol = symbol
+        self.ctx = ctx
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer or Uniform(0.01)
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self.kwargs = kwargs
+        self._module = None
+
+    def fit(self, X, y=None, eval_data=None, eval_metric='acc', kvstore='local',
+            batch_end_callback=None, epoch_end_callback=None, logger=None,
+            work_load_list=None, monitor=None, eval_end_callback=None,
+            eval_batch_end_callback=None):
+        from .module import Module
+        if not isinstance(X, io.DataIter):
+            X = io.NDArrayIter(X, y, batch_size=128, shuffle=True)
+        self._module = Module(self.symbol,
+                              data_names=[d[0] for d in X.provide_data],
+                              label_names=[l[0] for l in X.provide_label],
+                              context=self.ctx or [])
+        self._module.fit(X, eval_data=eval_data, eval_metric=eval_metric,
+                         kvstore=kvstore, initializer=self.initializer,
+                         arg_params=self.arg_params, aux_params=self.aux_params,
+                         optimizer=self.optimizer, optimizer_params=self.kwargs,
+                         begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                         batch_end_callback=batch_end_callback,
+                         epoch_end_callback=epoch_end_callback)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    def predict(self, X, num_batch=None):
+        if not isinstance(X, io.DataIter):
+            X = io.NDArrayIter(X, batch_size=128)
+        return self._module.predict(X, num_batch=num_batch).asnumpy()
+
+    @staticmethod
+    def load(prefix, epoch, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return FeedForward(symbol, ctx=ctx, arg_params=arg_params,
+                           aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    def save(self, prefix, epoch=None):
+        save_checkpoint(prefix, epoch or self.num_epoch, self.symbol,
+                        self.arg_params, self.aux_params or {})

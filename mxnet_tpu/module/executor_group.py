@@ -1,0 +1,264 @@
+"""DataParallelExecutorGroup — the data-parallel heart of Module.
+
+Reference: python/mxnet/module/executor_group.py:99 (decide_slices:233 splits
+the batch over contexts by workload, _bind_ith_exec:584 per-device
+simple_bind with shared memory pool, forward/backward fan-out,
+_merge_multi_context:75).
+
+TPU note: on a mesh the idiomatic path is ONE pjit over all chips
+(parallel/), which Module uses when given a single tpu context with a mesh;
+this class preserves the reference's explicit per-context semantics for
+multi-context CPU/TPU lists (and the multi-device-without-cluster tests).
+"""
+import logging
+
+import numpy as np
+
+from .. import context as ctx_mod
+from .. import ndarray as nd
+from ..io import DataDesc
+from ..executor import Executor
+
+__all__ = ['DataParallelExecutorGroup']
+
+
+def _load_general(data, targets, major_axis):
+    """Load a list of batch arrays into per-device slices (reference :33)."""
+    for d_src, d_targets in zip(data, targets):
+        if isinstance(d_targets, nd.NDArray):
+            d_src.copyto(d_targets)
+        else:
+            for slice_idx, d_dst in d_targets:
+                d_src_np = d_src.asnumpy()[slice_idx.start:slice_idx.stop]
+                d_dst._data = nd.array(d_src_np, ctx=d_dst.context)._data
+
+
+def _merge_multi_context(outputs, major_axis):
+    """Concat per-device outputs along the batch axis (reference :75)."""
+    rets = []
+    for tensors, axis in zip(outputs, major_axis):
+        if axis >= 0 and len(tensors) > 1:
+            rets.append(nd.concatenate(tensors, axis=axis))
+        else:
+            rets.append(tensors[0])
+    return rets
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req='write', state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.workload = workload or [1] * len(contexts)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = fixed_param_names or []
+        self.state_names = state_names or []
+        self.logger = logger
+
+        if grad_req != 'null' and for_training:
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = 'null' if k in self.fixed_param_names \
+                        else grad_req
+                elif k in [d.name if isinstance(d, DataDesc) else d[0]
+                           for d in data_shapes]:
+                    self.grad_req[k] = grad_req if inputs_need_grad else 'null'
+                else:
+                    self.grad_req[k] = 'null'
+        else:
+            self.grad_req = {k: 'null' for k in self.arg_names}
+
+        self.execs = []
+        self.slices = None
+        self.data_shapes = None
+        self.label_shapes = None
+        self.data_layouts = None
+        self.label_layouts = None
+        self.output_layouts = [0] * len(symbol.list_outputs())
+        self.batch_size = None
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    def decide_slices(self, data_shapes):
+        """Reference :233 — split batch_size over contexts by workload."""
+        assert len(data_shapes) > 0
+        major_axis = [DataDesc.get_batch_axis(getattr(d, 'layout', 'NCHW'))
+                      for d in data_shapes]
+        for (name, shape), axis in zip(
+                [(d.name, d.shape) if isinstance(d, DataDesc) else d
+                 for d in data_shapes], major_axis):
+            if axis == -1:
+                continue
+            batch_size = shape[axis]
+            if self.batch_size is not None:
+                assert batch_size == self.batch_size, \
+                    ('all data must have the same batch size: batch_size = %d,'
+                     ' but %s has shape %s') % (self.batch_size, name, shape)
+            else:
+                self.batch_size = batch_size
+                total = sum(self.workload[:len(self.contexts)])
+                chunks = [self.batch_size * w // total for w in
+                          self.workload[:len(self.contexts)]]
+                rem = self.batch_size - sum(chunks)
+                for i in range(rem):
+                    chunks[i] += 1
+                starts = np.cumsum([0] + chunks)
+                self.slices = [slice(starts[i], starts[i + 1])
+                               for i in range(len(self.contexts))]
+        return major_axis
+
+    def _sliced_shape(self, shapes, i, major_axis):
+        sliced = []
+        for (name, shape), axis in zip(
+                [(d.name, d.shape) if isinstance(d, DataDesc) else d
+                 for d in shapes], major_axis):
+            shape = list(shape)
+            if axis >= 0:
+                shape[axis] = self.slices[i].stop - self.slices[i].start
+            sliced.append(DataDesc(name, tuple(shape)))
+        return sliced
+
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.data_layouts = self.decide_slices(data_shapes)
+        if label_shapes is not None and len(label_shapes) > 0:
+            self.label_layouts = self.decide_slices(label_shapes)
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        self.execs = []
+        for i in range(len(self.contexts)):
+            self.execs.append(self._bind_ith_exec(i, data_shapes, label_shapes,
+                                                  shared_group))
+
+        self.data_arrays = [[(self.slices[i], e.arg_dict[name])
+                             for i, e in enumerate(self.execs)]
+                            for name, _ in [(d.name, d.shape) if isinstance(d, DataDesc)
+                                            else d for d in data_shapes]]
+        if label_shapes is not None and len(label_shapes) > 0:
+            self.label_arrays = [[(self.slices[i], e.arg_dict[name])
+                                  for i, e in enumerate(self.execs)]
+                                 for name, _ in [(d.name, d.shape) if isinstance(d, DataDesc)
+                                                 else d for d in label_shapes]]
+        else:
+            self.label_arrays = None
+
+        self.param_arrays = [[e.arg_dict[name] for e in self.execs]
+                             for name in self.param_names]
+        if self.for_training:
+            self.grad_arrays = [[e.grad_dict.get(name) for e in self.execs]
+                                for name in self.param_names]
+        else:
+            self.grad_arrays = [[None] * len(self.execs)
+                                for _ in self.param_names]
+        data_names = [d.name if isinstance(d, DataDesc) else d[0]
+                      for d in data_shapes]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [[e.grad_dict[name] for e in self.execs]
+                                      for name in data_names]
+        self.aux_arrays = [[e.aux_dict[name] for e in self.execs]
+                           for name in self.aux_names]
+
+    def _bind_ith_exec(self, i, data_shapes, label_shapes, shared_group):
+        """Reference :584 — per-device simple_bind."""
+        shapes = self._sliced_shape(data_shapes, i, self.data_layouts)
+        if label_shapes is not None and len(label_shapes) > 0:
+            shapes = shapes + self._sliced_shape(label_shapes, i,
+                                                 self.label_layouts)
+        input_shapes = {d.name: d.shape for d in shapes}
+        return self.symbol.simple_bind(self.contexts[i],
+                                       grad_req=self.grad_req,
+                                       **input_shapes)
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and label_shapes == self.label_shapes:
+            return
+        self.batch_size = None
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        for e in self.execs:
+            e.copy_params_from(arg_params, aux_params,
+                               allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params, aux_params):
+        """Reference :420 — weights averaged... actually copied from dev 0."""
+        for name, block in zip(self.param_names, self.param_arrays):
+            weight = block[0]
+            weight.copyto(arg_params[name]) if False else None
+            arg_params[name]._data = block[0]._data
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            aux_params[name]._data = block[0]._data
+
+    def forward(self, data_batch, is_train=None):
+        _load_general(data_batch.data, self.data_arrays, self.data_layouts)
+        if is_train is None:
+            is_train = self.for_training
+        if self.label_arrays is not None and data_batch.label:
+            _load_general(data_batch.label, self.label_arrays,
+                          self.label_layouts)
+        for e in self.execs:
+            e.forward(is_train=is_train)
+
+    def get_output_shapes(self):
+        outputs = self.execs[0].outputs
+        shapes = [out.shape for out in outputs]
+        concat_shapes = []
+        for key, the_shape, axis in zip(self.symbol.list_outputs(), shapes,
+                                        self.output_layouts):
+            the_shape = list(the_shape)
+            if axis >= 0:
+                the_shape[axis] = self.batch_size
+            concat_shapes.append((key, tuple(the_shape)))
+        return concat_shapes
+
+    def get_outputs(self, merge_multi_context=True):
+        outputs = [[exec_.outputs[i] for exec_ in self.execs]
+                   for i in range(len(self.execs[0].outputs))]
+        if merge_multi_context:
+            outputs = _merge_multi_context(outputs, self.output_layouts)
+        return outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        if merge_multi_context:
+            return _merge_multi_context(self.input_grad_arrays,
+                                        self.data_layouts)
+        return self.input_grad_arrays
+
+    def backward(self, out_grads=None):
+        assert self.for_training, 're-bind with for_training=True to run backward'
+        for i, exec_ in enumerate(self.execs):
+            out_grads_slice = None
+            if out_grads is not None:
+                out_grads_slice = []
+                for grad, axis in zip(out_grads, self.output_layouts):
+                    if axis >= 0:
+                        og = nd.array(grad.asnumpy()[self.slices[i]],
+                                      ctx=self.contexts[i])
+                    else:
+                        og = grad.as_in_context(self.contexts[i]) \
+                            if grad.context != self.contexts[i] else grad
+                    out_grads_slice.append(og)
+            exec_.backward(out_grads=out_grads_slice)
+
+    def update_metric(self, eval_metric, labels):
+        for texec, islice in zip(self.execs, self.slices):
+            labels_slice = []
+            for label in labels:
+                if islice.stop - islice.start == label.shape[0]:
+                    labels_slice.append(label)
+                else:
+                    labels_slice.append(
+                        nd.array(label.asnumpy()[islice]))
+            eval_metric.update(labels_slice, texec.outputs)
+
+    def install_monitor(self, mon):
+        for e in self.execs:
+            mon.install(e)

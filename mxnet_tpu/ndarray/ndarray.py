@@ -562,16 +562,22 @@ def from_jax(data, ctx=None):
 def array(source_array, ctx=None, dtype=None):
     """Reference ndarray.py:1988 mx.nd.array."""
     ctx = ctx if ctx is not None else current_context()
+    keep_dtype = isinstance(source_array, (np.ndarray, NDArray))
     if isinstance(source_array, NDArray):
         src = source_array.asnumpy()
     else:
         src = np.asarray(source_array)
     if dtype is None:
-        dtype = src.dtype
-        if dtype == np.float64:
+        # reference ndarray.py: python lists default to float32; numpy
+        # arrays keep their dtype (64-bit narrowed: x64 stays off for TPU)
+        if not keep_dtype:
             dtype = np.float32
-        elif dtype == np.int64:  # x64 stays off for TPU perf
-            dtype = np.int32
+        else:
+            dtype = src.dtype
+            if dtype == np.float64:
+                dtype = np.float32
+            elif dtype == np.int64:
+                dtype = np.int32
     d = np_dtype(dtype)
     data = jax.device_put(jnp.asarray(src, dtype=d), ctx.jax_device())
     return NDArray(data, ctx)

@@ -21,6 +21,15 @@ def make_nd_function(op_name):
         kwargs.pop('name', None)
         inputs = []
         pos_inputs = [a for a in args if isinstance(a, NDArray)]
+        # scalar positional args map onto declared params in order
+        # (matches the generated-signature convention of ndarray/op.py)
+        pos_attrs = [a for a in args if not isinstance(a, NDArray)]
+        if pos_attrs:
+            for pname in op.param_defaults:
+                if not pos_attrs:
+                    break
+                if pname not in kwargs:
+                    kwargs[pname] = pos_attrs.pop(0)
         if op.variadic:
             inputs = pos_inputs
             if op.key_var_num_args and op.key_var_num_args not in kwargs:

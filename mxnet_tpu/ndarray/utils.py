@@ -22,12 +22,14 @@ def save(fname, data):
         data = [data]
     if isinstance(data, dict):
         arrays = {k: v.asnumpy() for k, v in data.items()}
-        np.savez(fname, __format__='dict', **arrays)
+        fmt = 'dict'
     elif isinstance(data, (list, tuple)):
         arrays = {_LIST_KEY % i: v.asnumpy() for i, v in enumerate(data)}
-        np.savez(fname, __format__='list', **arrays)
+        fmt = 'list'
     else:
         raise ValueError('data must be NDArray, list or dict')
+    with open(fname, 'wb') as f:  # savez would append .npz to a str path
+        np.savez(f, __format__=fmt, **arrays)
 
 
 def load(fname):

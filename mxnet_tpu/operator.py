@@ -1,0 +1,104 @@
+"""Custom operators defined in Python.
+
+Reference: python/mxnet/operator.py (880 LoC): CustomOp/CustomOpProp
+registered via MXCustomOpRegister (src/operator/custom/custom.cc runs the
+python callbacks on a dedicated thread). Here custom ops run on the host
+directly — they receive/return NDArrays and participate in the imperative
+tape and the symbolic executor's staged mode.
+"""
+import numpy as np
+
+from .ndarray import NDArray, array, zeros
+from .ops import registry as _reg
+
+__all__ = ['CustomOp', 'CustomOpProp', 'register', 'get_all_registered_operators']
+
+_CUSTOM_OPS = {}
+
+
+class CustomOp:
+    """Base class for custom python operators (reference operator.py:508)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError()
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError()
+
+    def assign(self, dst, req, src):
+        if req == 'null':
+            return
+        if req in ('write', 'inplace'):
+            dst[:] = src
+        elif req == 'add':
+            dst[:] = dst + src
+
+
+class CustomOpProp:
+    """Reference operator.py:667 — declares shapes/types and creates the op."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
+            [] if not self.list_auxiliary_states() else []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), \
+            [in_type[0]] * len(self.list_auxiliary_states())
+
+    def list_outputs(self):
+        return ['output']
+
+    def list_arguments(self):
+        return ['data']
+
+    def list_auxiliary_states(self):
+        return []
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+    def create_operator(self, ctx, in_shapes, in_dtypes):
+        raise NotImplementedError()
+
+
+def register(reg_name):
+    """Reference operator.py register decorator: makes the op callable as
+    mx.nd.Custom(..., op_type=reg_name) / mx.sym.Custom(...)."""
+    def do_register(prop_cls):
+        _CUSTOM_OPS[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_all_registered_operators():
+    return list(_CUSTOM_OPS)
+
+
+@_reg.register('Custom', variadic=True, key_var_num_args='num_args',
+               differentiable=False)
+def _custom_fn(attrs, *arrays):
+    """Host-python bridge: executes the CustomOp eagerly via pure_callback
+    is NOT used — Custom ops run outside jit in the imperative path and in
+    the executor's staged mode (reference runs them on a dedicated thread,
+    custom.cc:380-405, ExecType::kLocal)."""
+    op_type = attrs['op_type']
+    prop = _CUSTOM_OPS[op_type]()
+    in_nd = [NDArray(a, None) for a in arrays]
+    out_shapes = prop.infer_shape([list(a.shape) for a in arrays])[1]
+    out_nd = [zeros(tuple(s)) for s in out_shapes]
+    op = prop.create_operator(None, [a.shape for a in arrays],
+                              [a.dtype for a in arrays])
+    op.forward(is_train=attrs.get('__is_train__', False),
+               req=['write'] * len(out_nd), in_data=in_nd, out_data=out_nd,
+               aux=[])
+    if len(out_nd) == 1:
+        return out_nd[0]._data
+    return tuple(o._data for o in out_nd)

@@ -34,7 +34,7 @@ _u('rint', jnp.rint)
 _u('ceil', jnp.ceil)
 _u('floor', jnp.floor)
 _u('trunc', jnp.trunc)
-_u('fix', jnp.fix)
+_u('fix', jnp.trunc)
 _u('square', jnp.square)
 _u('sqrt', jnp.sqrt)
 _u('rsqrt', lambda x: jax.lax.rsqrt(x))

@@ -1,0 +1,82 @@
+"""Profiler — Chrome-trace / TensorBoard profiling control.
+
+Reference: python/mxnet/profiler.py (108 LoC: profiler_set_config/
+set_state/dump_profile) over src/engine/profiler.{h,cc} which emitted
+Chrome trace-event JSON.
+
+TPU-native: delegates to the JAX/XLA profiler (jax.profiler), which captures
+device traces viewable in TensorBoard/Perfetto — same role, richer data.
+A lightweight host-side op-timeline (chrome trace JSON) is kept for parity
+with the reference's output format.
+"""
+import json
+import os
+import threading
+import time
+
+import jax
+
+__all__ = ['profiler_set_config', 'profiler_set_state', 'dump_profile',
+           'Profiler']
+
+_state = {'mode': 'symbolic', 'filename': 'profile.json', 'running': False,
+          'events': [], 'jax_dir': None}
+_lock = threading.Lock()
+
+
+def profiler_set_config(mode='symbolic', filename='profile.json'):
+    """Reference profiler.py:25. mode: 'symbolic' or 'all'."""
+    _state['mode'] = mode
+    _state['filename'] = filename
+
+
+def profiler_set_state(state='stop'):
+    """Reference profiler.py:42. state: 'run' or 'stop'."""
+    with _lock:
+        if state == 'run' and not _state['running']:
+            _state['running'] = True
+            _state['events'] = []
+            _state['start'] = time.time()
+            jax_dir = os.path.splitext(_state['filename'])[0] + '_xla'
+            try:
+                jax.profiler.start_trace(jax_dir)
+                _state['jax_dir'] = jax_dir
+            except Exception:
+                _state['jax_dir'] = None
+        elif state == 'stop' and _state['running']:
+            _state['running'] = False
+            if _state['jax_dir']:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+
+
+def record_event(name, start_us, end_us, category='operator'):
+    """Host-side event hook (engine profiler OprExecStat analog)."""
+    if _state['running']:
+        _state['events'].append({'name': name, 'cat': category, 'ph': 'X',
+                                 'ts': start_us, 'dur': end_us - start_us,
+                                 'pid': os.getpid(), 'tid': threading.get_ident()})
+
+
+def dump_profile():
+    """Reference profiler.py:57 — writes Chrome trace-event JSON."""
+    with open(_state['filename'], 'w') as f:
+        json.dump({'traceEvents': _state['events'],
+                   'displayTimeUnit': 'ms'}, f)
+
+
+class Profiler:
+    """Context manager convenience (TPU-native extension)."""
+
+    def __init__(self, mode='all', filename='profile.json'):
+        profiler_set_config(mode, filename)
+
+    def __enter__(self):
+        profiler_set_state('run')
+        return self
+
+    def __exit__(self, *args):
+        profiler_set_state('stop')
+        dump_profile()

@@ -1,0 +1,215 @@
+"""RecordIO — packed record format + image pack/unpack helpers.
+
+Reference: python/mxnet/recordio.py (456 LoC: MXRecordIO, MXIndexedRecordIO,
+IRHeader pack/unpack/pack_img/unpack_img) and dmlc-core's recordio stream
+(magic-delimited records) used by src/io/iter_image_recordio*.cc.
+
+Binary layout per record (dmlc recordio): uint32 magic 0xced7230a,
+uint32 lrecord (upper 3 bits cflag, lower 29 bits length), payload,
+padded to 4-byte boundary. Image records carry an IRHeader
+(uint32 flag, float32 label, uint64 id, uint64 id2) before the payload.
+"""
+import os
+import struct
+from collections import namedtuple
+
+import numpy as np
+
+__all__ = ['MXRecordIO', 'MXIndexedRecordIO', 'IRHeader', 'pack', 'unpack',
+           'pack_img', 'unpack_img']
+
+_kMagic = 0xced7230a
+
+IRHeader = namedtuple('HeaderType', ['flag', 'label', 'id', 'id2'])
+_IR_FORMAT = 'IfQQ'
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+class MXRecordIO:
+    """Sequential record reader/writer (reference recordio.py:28)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.is_open = False
+        self.open()
+
+    def open(self):
+        if self.flag == 'w':
+            self.handle = open(self.uri, 'wb')
+            self.writable = True
+        elif self.flag == 'r':
+            self.handle = open(self.uri, 'rb')
+            self.writable = False
+        else:
+            raise ValueError('Invalid flag %s' % self.flag)
+        self.pid = os.getpid()
+        self.is_open = True
+
+    def close(self):
+        if self.is_open:
+            self.handle.close()
+            self.is_open = False
+            self.pid = None
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d['handle'] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        if self.is_open:
+            self.is_open = False
+            self.open()
+
+    def write(self, buf):
+        assert self.writable
+        length = len(buf)
+        self.handle.write(struct.pack('<II', _kMagic, length & 0x1fffffff))
+        self.handle.write(buf)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.write(b'\x00' * pad)
+
+    def read(self):
+        assert not self.writable
+        head = self.handle.read(8)
+        if len(head) < 8:
+            return None
+        magic, lrec = struct.unpack('<II', head)
+        if magic != _kMagic:
+            raise IOError('Invalid RecordIO magic number')
+        length = lrec & 0x1fffffff
+        buf = self.handle.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.handle.read(pad)
+        return buf
+
+    def tell(self):
+        return self.handle.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Keyed random access via a .idx sidecar (reference recordio.py:142)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == 'r' and os.path.exists(self.idx_path):
+            with open(self.idx_path) as fidx:
+                for line in fidx:
+                    parts = line.strip().split('\t')
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.is_open and self.writable and self.idx:
+            with open(self.idx_path, 'w') as fidx:
+                for key in self.keys:
+                    fidx.write('%s\t%d\n' % (str(key), self.idx[key]))
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.handle.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+def pack(header, s):
+    """Pack a header + byte payload (reference recordio.py:297)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        hdr = struct.pack(_IR_FORMAT, 0, float(header.label), header.id, header.id2)
+        return hdr + s
+    label = np.asarray(header.label, dtype=np.float32)
+    hdr = struct.pack(_IR_FORMAT, label.size, 0.0, header.id, header.id2)
+    return hdr + label.tobytes() + s
+
+
+def unpack(s):
+    """Reference recordio.py:322."""
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = np.frombuffer(s[:header.flag * 4], dtype=np.float32)
+        header = header._replace(label=label)
+        s = s[header.flag * 4:]
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt='.raw'):
+    """Pack an image array. '.raw' stores uint8 CHW pixels + shape prefix
+    (hermetic, no codec dependency); '.jpg'/'.png' require pillow."""
+    img = np.asarray(img)
+    if img_fmt == '.raw':
+        shape = np.asarray(img.shape, dtype=np.int32)
+        payload = b'RAW0' + struct.pack('<I', len(shape)) + shape.tobytes() + \
+            img.astype(np.uint8).tobytes()
+        return pack(header, payload)
+    try:
+        from PIL import Image
+        import io as _io
+    except ImportError:
+        raise ImportError('pack_img with %s requires pillow; use .raw' % img_fmt)
+    buf = _io.BytesIO()
+    Image.fromarray(img).save(buf, format=img_fmt.lstrip('.').upper(),
+                              quality=quality)
+    return pack(header, buf.getvalue())
+
+
+def unpack_img(s, iscolor=-1, data_shape=None):
+    header, payload = unpack(s)
+    if payload[:4] == b'RAW0':
+        ndim = struct.unpack('<I', payload[4:8])[0]
+        shape = np.frombuffer(payload[8:8 + 4 * ndim], dtype=np.int32)
+        img = np.frombuffer(payload[8 + 4 * ndim:], dtype=np.uint8)
+        img = img.reshape(tuple(shape))
+    else:
+        try:
+            from PIL import Image
+            import io as _io
+            img = np.asarray(Image.open(_io.BytesIO(payload)))
+            if img.ndim == 3:
+                img = img.transpose(2, 0, 1)
+        except ImportError:
+            raise ImportError('JPEG/PNG decode requires pillow; '
+                              'use .raw packed records')
+    if data_shape is not None and tuple(img.shape) != tuple(data_shape):
+        if img.ndim == 2 and len(data_shape) == 3 and data_shape[0] == 1:
+            img = img[None]
+        elif img.size == int(np.prod(data_shape)):
+            img = img.reshape(data_shape)
+    return header, img

@@ -481,7 +481,7 @@ def _invoke_sym(op_name, input_syms, kwargs):
         final_name = NameManager.current().get(name, op_name.lstrip('_'))
         needed = op.arg_names(kwargs)
         if op_name in ('FullyConnected', 'Convolution', 'Deconvolution') and \
-                kwargs.get('no_bias', False):
+                kwargs.get('no_bias', op.param_defaults.get('no_bias', False)):
             needed = [n for n in needed if n != 'bias']
         if op_name == 'LeakyReLU':
             needed = ['data', 'gamma'] if kwargs.get('act_type') == 'prelu' else ['data']

@@ -1,0 +1,316 @@
+"""Testing utilities — the assertion core the whole test suite builds on.
+
+Reference: python/mxnet/test_utils.py (1,317 LoC): assert_almost_equal with
+per-dtype tolerances, check_numeric_gradient (finite differences vs symbolic
+backward), check_symbolic_forward/backward, check_consistency (one symbol run
+on several ctx/dtype combos, outputs & grads cross-compared — the CPU-vs-GPU
+test became CPU-vs-TPU here), rand_ndarray, simple_forward helpers.
+"""
+import numpy as np
+
+from . import ndarray as nd
+from . import symbol as sym
+from .context import Context, cpu, current_context
+from .ndarray import NDArray, array
+
+__all__ = ['default_context', 'set_default_context', 'rand_shape_2d',
+           'rand_shape_3d', 'rand_ndarray', 'assert_almost_equal',
+           'almost_equal', 'same', 'check_numeric_gradient',
+           'check_symbolic_forward', 'check_symbolic_backward',
+           'check_consistency', 'simple_forward', 'rand_np']
+
+_default_ctx = None
+
+
+def default_context():
+    return _default_ctx if _default_ctx is not None else current_context()
+
+
+def set_default_context(ctx):
+    global _default_ctx
+    _default_ctx = ctx
+
+
+def default_dtype():
+    return np.float32
+
+
+def rand_np(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (np.random.randint(1, dim0 + 1), np.random.randint(1, dim1 + 1),
+            np.random.randint(1, dim2 + 1))
+
+
+def rand_ndarray(shape, stype='default', density=None, dtype=None):
+    if stype == 'default':
+        return array(np.random.uniform(-1, 1, shape), dtype=dtype)
+    from .ndarray.sparse import row_sparse_array, csr_matrix
+    density = 0.5 if density is None else density
+    dense = np.random.uniform(-1, 1, shape)
+    mask = np.random.uniform(0, 1, shape) < density
+    dense = dense * mask
+    if stype == 'row_sparse':
+        return row_sparse_array(dense.astype(dtype or np.float32))
+    if stype == 'csr':
+        return csr_matrix(dense.astype(dtype or np.float32))
+    raise ValueError(stype)
+
+
+def same(a, b):
+    return np.array_equal(a, b)
+
+
+def almost_equal(a, b, rtol=None, atol=None):
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    return np.allclose(a, b, rtol=rtol, atol=atol)
+
+
+def _as_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=('a', 'b')):
+    a, b = _as_np(a), _as_np(b)
+    rtol = 1e-5 if rtol is None else rtol
+    atol = 1e-20 if atol is None else atol
+    if almost_equal(a, b, rtol, atol):
+        return
+    index = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) \
+        if a.shape else ()
+    rel = np.abs(a - b) / (np.abs(b) + atol)
+    raise AssertionError(
+        'Items are not equal:\nError %f exceeds tolerance rtol=%f, atol=%f.'
+        ' Location of maximum error: %s, %s=%f, %s=%f'
+        % (float(rel.max()), rtol, atol, str(index), names[0],
+           float(a[index]) if a.shape else float(a), names[1],
+           float(b[index]) if b.shape else float(b)))
+
+
+def simple_forward(sym_, ctx=None, is_train=False, **inputs):
+    ctx = ctx or default_context()
+    inputs = {k: array(v) for k, v in inputs.items()}
+    exe = sym_.bind(ctx, args=inputs)
+    exe.forward(is_train=is_train)
+    outputs = [x.asnumpy() for x in exe.outputs]
+    if len(outputs) == 1:
+        outputs = outputs[0]
+    return outputs
+
+
+def _parse_location(symbol, location, ctx):
+    assert isinstance(location, (dict, list, tuple))
+    if isinstance(location, dict):
+        if set(location.keys()) != set(symbol.list_arguments()):
+            raise ValueError('Symbol arguments and keys of the given location '
+                             'do not match. symbol args:%s, location.keys():%s'
+                             % (str(set(symbol.list_arguments())),
+                                str(set(location.keys()))))
+    else:
+        location = {k: v for k, v in zip(symbol.list_arguments(), location)}
+    return {k: array(v, ctx=ctx) if isinstance(v, np.ndarray) else
+            (v.copyto(ctx) if isinstance(v, NDArray) else v)
+            for k, v in location.items()}
+
+
+def _parse_aux_states(symbol, aux_states, ctx):
+    if aux_states is None:
+        return {}
+    if isinstance(aux_states, dict):
+        return {k: array(v, ctx=ctx) if isinstance(v, np.ndarray) else v
+                for k, v in aux_states.items()}
+    return {k: array(v, ctx=ctx) for k, v in
+            zip(symbol.list_auxiliary_states(), aux_states)}
+
+
+def numeric_grad(executor, location, aux_states=None, eps=1e-4,
+                 use_forward_train=True):
+    """Central finite differences (reference test_utils.py numeric_grad)."""
+    approx_grads = {k: np.zeros(v.shape, dtype=np.float32)
+                    for k, v in location.items()}
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k in location:
+        old_value = location[k].copy()
+        for i in range(int(np.prod(old_value.shape))):
+            idx = np.unravel_index(i, old_value.shape) if old_value.shape else ()
+            # +eps
+            pert = old_value.copy()
+            pert[idx] += eps
+            executor.arg_dict[k][:] = pert
+            executor.forward(is_train=use_forward_train)
+            f_peps = executor.outputs[0].asnumpy().sum()
+            # -eps
+            pert[idx] -= 2 * eps
+            executor.arg_dict[k][:] = pert
+            executor.forward(is_train=use_forward_train)
+            f_neps = executor.outputs[0].asnumpy().sum()
+            approx_grads[k][idx] = (f_peps - f_neps) / (2 * eps)
+        executor.arg_dict[k][:] = old_value
+    return approx_grads
+
+
+def check_numeric_gradient(symbol, location, aux_states=None,
+                           numeric_eps=1e-3, rtol=1e-2, atol=None,
+                           grad_nodes=None, use_forward_train=True, ctx=None):
+    """Finite differences vs the executor's backward (reference
+    test_utils.py check_numeric_gradient)."""
+    ctx = ctx or default_context()
+    location = _parse_location(symbol, location, ctx)
+    location_np = {k: v.asnumpy() for k, v in location.items()}
+    aux = _parse_aux_states(symbol, aux_states, ctx)
+
+    if grad_nodes is None:
+        grad_nodes = [k for k in symbol.list_arguments()
+                      if not k.endswith('label')]
+    grad_req = {k: ('write' if k in grad_nodes else 'null')
+                for k in symbol.list_arguments()}
+
+    input_shapes = {k: v.shape for k, v in location.items()}
+    executor = symbol.simple_bind(ctx, grad_req=grad_req, **input_shapes)
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k, v in aux.items():
+        executor.aux_dict[k][:] = v
+
+    executor.forward(is_train=True)
+    assert len(executor.outputs) == 1, \
+        'check_numeric_gradient only supports single-output symbols'
+    executor.backward(out_grads=[nd.ones(executor.outputs[0].shape, ctx=ctx)])
+    symbolic_grads = {k: executor.grad_dict[k].asnumpy() for k in grad_nodes}
+
+    numeric_gradients = numeric_grad(
+        executor, {k: location_np[k] for k in grad_nodes},
+        eps=numeric_eps, use_forward_train=use_forward_train)
+
+    for name in grad_nodes:
+        fd_grad = numeric_gradients[name]
+        sym_grad = symbolic_grads[name]
+        assert_almost_equal(fd_grad, sym_grad, rtol, atol or 1e-4,
+                            ('NUMERICAL_%s' % name, 'BACKWARD_%s' % name))
+
+
+def check_symbolic_forward(symbol, location, expected, rtol=1e-4, atol=None,
+                           aux_states=None, ctx=None):
+    ctx = ctx or default_context()
+    location = _parse_location(symbol, location, ctx)
+    aux = _parse_aux_states(symbol, aux_states, ctx)
+    input_shapes = {k: v.shape for k, v in location.items()}
+    executor = symbol.simple_bind(ctx, grad_req='null', **input_shapes)
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k, v in aux.items():
+        executor.aux_dict[k][:] = v
+    executor.forward(is_train=False)
+    outputs = [x.asnumpy() for x in executor.outputs]
+    for out, exp in zip(outputs, expected):
+        assert_almost_equal(out, exp, rtol, atol or 1e-20,
+                            ('EXPECTED', 'FORWARD'))
+    return outputs
+
+
+def check_symbolic_backward(symbol, location, out_grads, expected, rtol=1e-5,
+                            atol=None, aux_states=None, grad_req='write',
+                            ctx=None):
+    ctx = ctx or default_context()
+    location = _parse_location(symbol, location, ctx)
+    aux = _parse_aux_states(symbol, aux_states, ctx)
+    if isinstance(expected, (list, tuple)):
+        expected = {k: v for k, v in zip(symbol.list_arguments(), expected)}
+    input_shapes = {k: v.shape for k, v in location.items()}
+    executor = symbol.simple_bind(ctx, grad_req=grad_req, **input_shapes)
+    for k, v in location.items():
+        executor.arg_dict[k][:] = v
+    for k, v in aux.items():
+        executor.aux_dict[k][:] = v
+    executor.forward(is_train=True)
+    out_grads = [array(v, ctx=ctx) if isinstance(v, np.ndarray) else v
+                 for v in out_grads]
+    executor.backward(out_grads)
+    grads = {k: v.asnumpy() for k, v in executor.grad_dict.items()
+             if v is not None}
+    for name in expected:
+        if name in grads:
+            assert_almost_equal(grads[name], expected[name], rtol,
+                                atol or 1e-20,
+                                ('BACKWARD_%s' % name, 'EXPECTED_%s' % name))
+    return grads
+
+
+def check_consistency(sym_, ctx_list, scale=1.0, grad_req='write',
+                      arg_params=None, aux_params=None, tol=None,
+                      raise_on_err=True, ground_truth=None):
+    """Run one symbol on several ctx/dtype combos and cross-compare outputs
+    and gradients (reference test_utils.py check_consistency — the CPU-vs-GPU
+    test pattern, here CPU-vs-TPU / dtype-vs-dtype)."""
+    if tol is None:
+        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+               np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
+               np.dtype(np.int32): 0}
+
+    assert len(ctx_list) > 1
+    if isinstance(sym_, sym.Symbol):
+        sym_ = [sym_] * len(ctx_list)
+    else:
+        assert len(sym_) == len(ctx_list)
+
+    output_points = None
+    exe_list = []
+    for s, ctx in zip(sym_, ctx_list):
+        ctx = dict(ctx)
+        the_ctx = ctx.pop('ctx')
+        type_dict = ctx.pop('type_dict', {})
+        exe = s.simple_bind(the_ctx, grad_req=grad_req, type_dict=type_dict,
+                            **ctx)
+        exe_list.append(exe)
+
+    # shared random init
+    arg_params = arg_params or {}
+    np.random.seed(0)
+    args0 = exe_list[0].arg_dict
+    init = {k: (arg_params[k] if k in arg_params else
+                np.random.normal(size=v.shape, scale=scale))
+            for k, v in args0.items()}
+    for exe in exe_list:
+        for k, v in init.items():
+            exe.arg_dict[k][:] = v
+        if aux_params:
+            for k, v in aux_params.items():
+                exe.aux_dict[k][:] = v
+
+    dtypes = [np.dtype(exe.outputs[0].asnumpy().dtype) for exe in exe_list]
+    max_idx = np.argmax([t.itemsize for t in dtypes])
+
+    for exe in exe_list:
+        exe.forward(is_train=(grad_req != 'null'))
+        if grad_req != 'null':
+            exe.backward(exe.outputs)
+
+    gt = ground_truth
+    if gt is None:
+        gt = {'outputs': [o.asnumpy() for o in exe_list[max_idx].outputs]}
+        if grad_req != 'null':
+            gt['grads'] = {k: v.asnumpy() for k, v in
+                           exe_list[max_idx].grad_dict.items() if v is not None}
+
+    for i, exe in enumerate(exe_list):
+        if i == max_idx:
+            continue
+        t = max(tol[dtypes[i]], tol[dtypes[max_idx]])
+        for o, o_gt in zip(exe.outputs, gt['outputs']):
+            assert_almost_equal(o.asnumpy(), o_gt, rtol=t, atol=t)
+        if grad_req != 'null':
+            for name, g in exe.grad_dict.items():
+                if g is not None and name in gt['grads']:
+                    assert_almost_equal(g.asnumpy(), gt['grads'][name],
+                                        rtol=t, atol=t)
+    return gt

@@ -1,0 +1,146 @@
+"""Network visualization / summary.
+
+Reference: python/mxnet/visualization.py — print_summary (per-layer
+params table) and plot_network (graphviz; gated on availability here).
+"""
+import json
+
+__all__ = ['print_summary', 'plot_network']
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=(.44, .64, .74, 1.)):
+    """Reference visualization.py:26 print_summary."""
+    show_shape = False
+    shape_dict = {}
+    if shape is not None:
+        show_shape = True
+        interals = symbol.get_internals()
+        _, out_shapes, _ = interals.infer_shape(**shape)
+        if out_shapes is None:
+            raise ValueError('Input shape is incomplete')
+        shape_dict = dict(zip(interals.list_outputs(), out_shapes))
+    conf = json.loads(symbol.tojson())
+    nodes = conf['nodes']
+    if positions[-1] <= 1:
+        positions = [int(line_length * p) for p in positions]
+    to_display = ['Layer (type)', 'Output Shape', 'Param #', 'Previous Layer']
+
+    def print_row(fields, positions):
+        line = ''
+        for i, field in enumerate(fields):
+            line += str(field)
+            line = line[:positions[i]]
+            line += ' ' * (positions[i] - len(line))
+        print(line)
+
+    print('_' * line_length)
+    print_row(to_display, positions)
+    print('=' * line_length)
+
+    total_params = [0]
+
+    def print_layer_summary(node, out_shape):
+        op = node['op']
+        pre_node = []
+        pre_filter = 0
+        if op != 'null':
+            inputs = node['inputs']
+            for item in inputs:
+                input_node = nodes[item[0]]
+                input_name = input_node['name']
+                if input_node['op'] != 'null' or item[0] in heads:
+                    pre_node.append(input_name)
+                    if show_shape:
+                        key = input_name + '_output' if input_node['op'] != 'null' \
+                            else input_name
+                        if key in shape_dict:
+                            shape = shape_dict[key][1:]
+                            pre_filter = pre_filter + int(shape[0]) if shape else 0
+        cur_param = 0
+        attrs = node.get('attrs', {})
+        if op == 'Convolution':
+            num_group = int(attrs.get('num_group', '1'))
+            kernel = eval(attrs['kernel']) if isinstance(attrs.get('kernel'), str) \
+                else attrs.get('kernel', ())
+            import numpy as _np
+            cur_param = pre_filter * int(attrs['num_filter']) // num_group * \
+                int(_np.prod(kernel))
+            if attrs.get('no_bias') not in ('True', True):
+                cur_param += int(attrs['num_filter'])
+        elif op == 'FullyConnected':
+            if attrs.get('no_bias') in ('True', True):
+                cur_param = pre_filter * int(attrs['num_hidden'])
+            else:
+                cur_param = (pre_filter + 1) * int(attrs['num_hidden'])
+        elif op == 'BatchNorm':
+            key = node['name'] + '_output'
+            if show_shape and key in shape_dict:
+                num_filter = shape_dict[key][1]
+                cur_param = int(num_filter) * 2
+        first_connection = '' if not pre_node else pre_node[0]
+        fields = [node['name'] + '(' + op + ')',
+                  'x'.join([str(x) for x in out_shape]),
+                  cur_param, first_connection]
+        print_row(fields, positions)
+        if len(pre_node) > 1:
+            for i in range(1, len(pre_node)):
+                fields = ['', '', '', pre_node[i]]
+                print_row(fields, positions)
+        return cur_param
+
+    heads = set(conf['arg_nodes'])
+    for i, node in enumerate(nodes):
+        out_shape = []
+        op = node['op']
+        if op == 'null' and i > 0:
+            continue
+        if op != 'null' or i in heads:
+            if show_shape:
+                key = node['name'] + '_output' if op != 'null' else node['name']
+                if key in shape_dict:
+                    out_shape = shape_dict[key][1:]
+        total_params[0] += print_layer_summary(node, out_shape)
+        if i == len(nodes) - 1:
+            print('=' * line_length)
+        else:
+            print('_' * line_length)
+    print('Total params: {params}'.format(params=total_params[0]))
+    print('_' * line_length)
+
+
+def plot_network(symbol, title='plot', save_format='pdf', shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Reference visualization.py plot_network (graphviz)."""
+    try:
+        from graphviz import Digraph
+    except ImportError:
+        raise ImportError('plot_network requires graphviz; '
+                          'use print_summary instead')
+    conf = json.loads(symbol.tojson())
+    nodes = conf['nodes']
+    dot = Digraph(name=title)
+    for i, node in enumerate(nodes):
+        name = node['name']
+        if node['op'] == 'null':
+            if hide_weights and (name.endswith('_weight') or
+                                 name.endswith('_bias') or
+                                 name.endswith('_gamma') or
+                                 name.endswith('_beta') or
+                                 name.endswith('moving_mean') or
+                                 name.endswith('moving_var')):
+                continue
+            dot.node(name=name, label=name, shape='oval')
+        else:
+            dot.node(name=name, label='%s\n%s' % (name, node['op']),
+                     shape='box')
+        for item in node.get('inputs', []):
+            input_node = nodes[item[0]]
+            if input_node['op'] == 'null' and hide_weights and (
+                    input_node['name'].endswith('_weight') or
+                    input_node['name'].endswith('_bias') or
+                    input_node['name'].endswith('_gamma') or
+                    input_node['name'].endswith('_beta') or
+                    'moving' in input_node['name']):
+                continue
+            dot.edge(input_node['name'], name)
+    return dot

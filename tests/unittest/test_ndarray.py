@@ -1,0 +1,234 @@
+"""NDArray tests (reference tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    b = nd.zeros((3, 4))
+    assert (b.asnumpy() == 0).all()
+    c = nd.ones((2, 3), dtype='int32')
+    assert c.dtype == np.int32
+    d = nd.full((2, 2), 7.5)
+    assert (d.asnumpy() == 7.5).all()
+    e = nd.arange(0, 10, 2)
+    assert_almost_equal(e.asnumpy(), np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arithmetic():
+    a = nd.array([[1., 2.], [3., 4.]])
+    b = nd.array([[5., 6.], [7., 8.]])
+    assert_almost_equal((a + b).asnumpy(), np.array([[6, 8], [10, 12]]))
+    assert_almost_equal((a - b).asnumpy(), np.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal((a * b).asnumpy(), np.array([[5, 12], [21, 32]]))
+    assert_almost_equal((b / a).asnumpy(), np.array([[5, 3], [7 / 3., 2]]))
+    assert_almost_equal((a + 1).asnumpy(), a.asnumpy() + 1)
+    assert_almost_equal((1 + a).asnumpy(), a.asnumpy() + 1)
+    assert_almost_equal((2 - a).asnumpy(), 2 - a.asnumpy())
+    assert_almost_equal((2 / a).asnumpy(), 2 / a.asnumpy())
+    assert_almost_equal((a ** 2).asnumpy(), a.asnumpy() ** 2)
+    assert_almost_equal((-a).asnumpy(), -a.asnumpy())
+    assert_almost_equal(abs(-a).asnumpy(), a.asnumpy())
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    a += 1
+    assert (a.asnumpy() == 2).all()
+    a *= 3
+    assert (a.asnumpy() == 6).all()
+    a -= 2
+    assert (a.asnumpy() == 4).all()
+    a /= 4
+    assert (a.asnumpy() == 1).all()
+
+
+def test_setitem_getitem():
+    a = nd.zeros((3, 4))
+    a[:] = 5
+    assert (a.asnumpy() == 5).all()
+    a[1] = 2
+    assert (a.asnumpy()[1] == 2).all()
+    a[2, 3] = 9
+    assert a.asnumpy()[2, 3] == 9
+    b = a[1:3]
+    assert b.shape == (2, 4)
+    x = nd.array(np.arange(12).reshape(3, 4))
+    assert_almost_equal(x[1].asnumpy(), np.arange(12).reshape(3, 4)[1])
+
+
+def test_comparison():
+    a = nd.array([1., 2., 3.])
+    b = nd.array([3., 2., 1.])
+    assert_almost_equal((a == b).asnumpy(), [0, 1, 0])
+    assert_almost_equal((a > b).asnumpy(), [0, 0, 1])
+    assert_almost_equal((a >= 2).asnumpy(), [0, 1, 1])
+    assert_almost_equal((a < b).asnumpy(), [1, 0, 0])
+
+
+def test_reshape_transpose():
+    a = nd.array(np.arange(24).reshape(2, 3, 4))
+    assert a.reshape((6, 4)).shape == (6, 4)
+    assert a.reshape((-1, 4)).shape == (6, 4)
+    assert a.reshape(6, 4).shape == (6, 4)
+    assert a.T.shape == (4, 3, 2)
+    assert a.transpose(1, 0, 2).shape == (3, 2, 4)
+    assert a.flatten().shape == (2, 12)
+    assert a.expand_dims(0).shape == (1, 2, 3, 4)
+    assert a.swapaxes(0, 2).shape == (4, 3, 2)
+
+
+def test_reshape_special_codes():
+    # MXNet special reshape codes 0, -1, -2, -3, -4 (matrix_op-inl.h)
+    a = nd.zeros((2, 3, 4))
+    assert nd.Reshape(a, shape=(0, -1)).shape == (2, 12)
+    assert nd.Reshape(a, shape=(-2,)).shape == (2, 3, 4)
+    assert nd.Reshape(a, shape=(-3, 4)).shape == (6, 4)
+    assert nd.Reshape(a, shape=(2, -4, -1, 3, 4)).shape == (2, 1, 3, 4)
+
+
+def test_reduce():
+    a_np = np.random.rand(2, 3, 4).astype(np.float32)
+    a = nd.array(a_np)
+    assert_almost_equal(a.sum().asnumpy(), a_np.sum(), rtol=1e-4)
+    assert_almost_equal(a.sum(axis=1).asnumpy(), a_np.sum(1), rtol=1e-4)
+    assert_almost_equal(a.mean(axis=(0, 2)).asnumpy(), a_np.mean((0, 2)), rtol=1e-4)
+    assert_almost_equal(a.max().asnumpy(), a_np.max())
+    assert_almost_equal(a.min(axis=2, keepdims=True).asnumpy(),
+                        a_np.min(2, keepdims=True))
+    assert_almost_equal(nd.argmax(a, axis=1).asnumpy(), a_np.argmax(1))
+    assert_almost_equal(a.norm().asnumpy(), np.linalg.norm(a_np.ravel()),
+                        rtol=1e-4)
+
+
+def test_dot():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32)
+    c = nd.dot(nd.array(a), nd.array(b))
+    assert_almost_equal(c.asnumpy(), a.dot(b), rtol=1e-4)
+    # transpose flags
+    ct = nd.dot(nd.array(a.T), nd.array(b), transpose_a=True)
+    assert_almost_equal(ct.asnumpy(), a.dot(b), rtol=1e-4)
+    # batch_dot
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    y = np.random.rand(2, 4, 5).astype(np.float32)
+    z = nd.batch_dot(nd.array(x), nd.array(y))
+    assert_almost_equal(z.asnumpy(), np.matmul(x, y), rtol=1e-4)
+
+
+def test_concat_split_stack():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    c = nd.concatenate([a, b], axis=0)
+    assert c.shape == (4, 3)
+    c2 = nd.Concat(a, b, dim=1)
+    assert c2.shape == (2, 6)
+    parts = nd.split(nd.array(np.arange(12).reshape(2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_take_onehot_pick():
+    w = nd.array(np.arange(12).reshape(4, 3))
+    idx = nd.array([0, 2])
+    assert_almost_equal(nd.take(w, idx).asnumpy(),
+                        np.arange(12).reshape(4, 3)[[0, 2]])
+    oh = nd.one_hot(nd.array([0, 2]), depth=3)
+    assert_almost_equal(oh.asnumpy(), [[1, 0, 0], [0, 0, 1]])
+    data = nd.array([[1., 2.], [3., 4.]])
+    p = nd.pick(data, nd.array([0, 1]), axis=1)
+    assert_almost_equal(p.asnumpy(), [1, 4])
+
+
+def test_sort_topk():
+    a_np = np.random.rand(3, 5).astype(np.float32)
+    a = nd.array(a_np)
+    assert_almost_equal(nd.sort(a, axis=1).asnumpy(), np.sort(a_np, 1))
+    assert_almost_equal(nd.sort(a, axis=1, is_ascend=False).asnumpy(),
+                        -np.sort(-a_np, 1))
+    tk = nd.topk(a, k=2, axis=1, ret_typ='value')
+    assert_almost_equal(tk.asnumpy(), -np.sort(-a_np, 1)[:, :2])
+
+
+def test_clip_unary():
+    a_np = np.random.randn(4, 4).astype(np.float32)
+    a = nd.array(a_np)
+    assert_almost_equal(nd.clip(a, -0.5, 0.5).asnumpy(),
+                        np.clip(a_np, -0.5, 0.5))
+    assert_almost_equal(nd.exp(a).asnumpy(), np.exp(a_np), rtol=1e-4)
+    assert_almost_equal(nd.sigmoid(a).asnumpy(), 1 / (1 + np.exp(-a_np)), rtol=1e-4)
+    assert_almost_equal(nd.relu(a).asnumpy(), np.maximum(a_np, 0))
+    assert_almost_equal(nd.square(a).asnumpy(), a_np ** 2, rtol=1e-4)
+    assert_almost_equal(nd.sqrt(nd.abs(a)).asnumpy(), np.sqrt(np.abs(a_np)), rtol=1e-4)
+
+
+def test_copy_context():
+    a = nd.ones((2, 2), ctx=mx.cpu(0))
+    b = a.copyto(mx.cpu(1))
+    assert b.context == mx.cpu(1)
+    assert (b.asnumpy() == 1).all()
+    c = a.as_in_context(mx.cpu(0))
+    assert c is a
+    d = a.copy()
+    d[:] = 5
+    assert (a.asnumpy() == 1).all()
+
+
+def test_astype():
+    a = nd.ones((2, 2))
+    b = a.astype('int32')
+    assert b.dtype == np.int32
+    c = a.astype('float16')
+    assert c.dtype == np.float16
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / 'nd.params')
+    a = nd.array(np.random.rand(3, 3))
+    b = nd.array(np.random.rand(2,))
+    nd.save(fname, {'a': a, 'b': b})
+    loaded = nd.load(fname)
+    assert_almost_equal(loaded['a'].asnumpy(), a.asnumpy())
+    assert_almost_equal(loaded['b'].asnumpy(), b.asnumpy())
+    nd.save(fname, [a, b])
+    la = nd.load(fname)
+    assert_almost_equal(la[0].asnumpy(), a.asnumpy())
+
+
+def test_wait_sync():
+    a = nd.ones((100, 100))
+    b = nd.dot(a, a)
+    b.wait_to_read()
+    nd.waitall()
+    assert b.asnumpy()[0, 0] == 100
+
+
+def test_broadcast():
+    a = nd.array(np.arange(6).reshape(2, 3, 1))
+    assert nd.broadcast_to(a, shape=(2, 3, 4)).shape == (2, 3, 4)
+    assert nd.broadcast_axis(a, axis=2, size=5).shape == (2, 3, 5)
+    x = nd.ones((2, 1)) + nd.ones((1, 3))
+    assert x.shape == (2, 3)
+
+
+def test_random():
+    mx.random.seed(7)
+    a = nd.random.uniform(0, 1, shape=(50, 50))
+    b = nd.random.uniform(0, 1, shape=(50, 50))
+    assert not np.allclose(a.asnumpy(), b.asnumpy())
+    mx.random.seed(7)
+    a2 = nd.random.uniform(0, 1, shape=(50, 50))
+    assert_almost_equal(a.asnumpy(), a2.asnumpy())
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.asnumpy().mean())) < 0.2
+    g = nd.random.gamma(2.0, 2.0, shape=(500,))
+    assert g.asnumpy().min() >= 0

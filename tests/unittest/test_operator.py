@@ -1,0 +1,336 @@
+"""Operator tests incl. numeric gradient checks.
+
+Reference: tests/python/unittest/test_operator.py (4,010 LoC) — the core
+pattern: check_numeric_gradient + check_symbolic_forward/backward per op.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sym
+from mxnet_tpu.test_utils import (assert_almost_equal, check_numeric_gradient,
+                                  check_symbolic_forward, check_consistency)
+
+
+def test_fullyconnected():
+    data = sym.Variable('data')
+    fc = sym.FullyConnected(data, num_hidden=4, name='fc')
+    x = np.random.rand(5, 3).astype(np.float32)
+    w = np.random.rand(4, 3).astype(np.float32)
+    b = np.random.rand(4).astype(np.float32)
+    check_symbolic_forward(fc, {'data': x, 'fc_weight': w, 'fc_bias': b},
+                           [x.dot(w.T) + b], rtol=1e-4, atol=1e-5)
+    check_numeric_gradient(fc, {'data': x, 'fc_weight': w, 'fc_bias': b},
+                           numeric_eps=1e-2, rtol=0.1, atol=1e-2)
+
+
+def test_activation_grads():
+    for act in ['relu', 'sigmoid', 'tanh', 'softrelu', 'softsign']:
+        data = sym.Variable('data')
+        s = sym.Activation(data, act_type=act)
+        x = np.random.uniform(0.2, 1, (3, 4)).astype(np.float32)
+        check_numeric_gradient(s, {'data': x}, numeric_eps=1e-3, rtol=0.05,
+                               atol=1e-3)
+
+
+def test_elemwise_grads():
+    for op in ['exp', 'log', 'sqrt', 'square', 'tanh', 'sigmoid']:
+        data = sym.Variable('data')
+        s = getattr(sym, op)(data)
+        x = np.random.uniform(0.5, 2, (3, 3)).astype(np.float32)
+        check_numeric_gradient(s, {'data': x}, numeric_eps=1e-3, rtol=0.05,
+                               atol=1e-3)
+
+
+def test_binary_broadcast_grad():
+    lhs = sym.Variable('lhs')
+    rhs = sym.Variable('rhs')
+    s = sym.broadcast_mul(lhs, rhs)
+    a = np.random.rand(3, 4).astype(np.float32) + 0.5
+    b = np.random.rand(3, 1).astype(np.float32) + 0.5
+    check_numeric_gradient(s, {'lhs': a, 'rhs': b}, numeric_eps=1e-2,
+                           rtol=0.05, atol=1e-2)
+
+
+def test_convolution():
+    data = sym.Variable('data')
+    conv = sym.Convolution(data, kernel=(3, 3), num_filter=2, pad=(1, 1),
+                           name='conv')
+    x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+    arg_shapes, out_shapes, _ = conv.infer_shape(data=(2, 3, 5, 5))
+    assert out_shapes[0] == (2, 2, 5, 5)
+    assert arg_shapes[1] == (2, 3, 3, 3)
+    w = np.random.rand(2, 3, 3, 3).astype(np.float32) * 0.1
+    b = np.zeros(2, dtype=np.float32)
+    # compare against explicit correlation
+    import scipy.signal
+    ref = np.zeros((2, 2, 5, 5), dtype=np.float32)
+    for n in range(2):
+        for f in range(2):
+            for c in range(3):
+                ref[n, f] += scipy.signal.correlate(x[n, c], w[f, c], 'same')
+    check_symbolic_forward(conv, {'data': x, 'conv_weight': w, 'conv_bias': b},
+                           [ref], rtol=1e-3, atol=1e-4)
+
+
+def test_convolution_grad():
+    data = sym.Variable('data')
+    conv = sym.Convolution(data, kernel=(2, 2), num_filter=2, name='conv',
+                           no_bias=True)
+    x = np.random.rand(1, 2, 4, 4).astype(np.float32)
+    w = np.random.rand(2, 2, 2, 2).astype(np.float32)
+    check_numeric_gradient(conv, {'data': x, 'conv_weight': w},
+                           numeric_eps=1e-2, rtol=0.1, atol=1e-2)
+
+
+def test_deconvolution_shape():
+    data = sym.Variable('data')
+    deconv = sym.Deconvolution(data, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                               num_filter=3, name='deconv')
+    _, out_shapes, _ = deconv.infer_shape(data=(1, 2, 8, 8))
+    assert out_shapes[0] == (1, 3, 16, 16)
+
+
+def test_pooling():
+    data = sym.Variable('data')
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    for ptype in ['max', 'avg', 'sum']:
+        pool = sym.Pooling(data, kernel=(2, 2), stride=(2, 2), pool_type=ptype)
+        ex = pool.simple_bind(mx.cpu(), data=(1, 1, 4, 4))
+        ex.arg_dict['data'][:] = x
+        out = ex.forward()[0].asnumpy()
+        blocks = x.reshape(1, 1, 2, 2, 2, 2).transpose(0, 1, 2, 4, 3, 5)
+        if ptype == 'max':
+            ref = blocks.max((4, 5))
+        elif ptype == 'avg':
+            ref = blocks.mean((4, 5))
+        else:
+            ref = blocks.sum((4, 5))
+        assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+    gp = sym.Pooling(data, global_pool=True, pool_type='avg', kernel=(1, 1))
+    ex = gp.simple_bind(mx.cpu(), data=(1, 1, 4, 4))
+    ex.arg_dict['data'][:] = x
+    assert_almost_equal(ex.forward()[0].asnumpy(),
+                        x.mean((2, 3), keepdims=True), rtol=1e-4)
+
+
+def test_batchnorm_train_stats():
+    data = sym.Variable('data')
+    bn = sym.BatchNorm(data, name='bn', fix_gamma=False, momentum=0.5)
+    ex = bn.simple_bind(mx.cpu(), data=(8, 3, 4, 4))
+    assert bn.list_auxiliary_states() == ['bn_moving_mean', 'bn_moving_var']
+    x = np.random.randn(8, 3, 4, 4).astype(np.float32) * 2 + 1
+    ex.arg_dict['data'][:] = x
+    ex.arg_dict['bn_gamma'][:] = 1
+    ex.arg_dict['bn_beta'][:] = 0
+    ex.aux_dict['bn_moving_var'][:] = 1
+    out = ex.forward(is_train=True)
+    _ = ex.outputs[0].asnumpy()
+    # normalized output: per-channel mean 0, var 1
+    o = ex.outputs[0].asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-3
+    assert abs(o.var(axis=(0, 2, 3)) - 1).max() < 1e-2
+    # moving stats updated toward batch stats
+    mm = ex.aux_dict['bn_moving_mean'].asnumpy()
+    assert abs(mm - 0.5 * x.mean(axis=(0, 2, 3))).max() < 1e-3
+    # inference mode uses moving stats
+    ex.forward(is_train=False)
+    o2 = ex.outputs[0].asnumpy()
+    assert not np.allclose(o, o2)
+
+
+def test_softmax_output_grad():
+    data = sym.Variable('data')
+    label = sym.Variable('label')
+    s = sym.SoftmaxOutput(data, label, name='sm')
+    x = np.random.randn(4, 5).astype(np.float32)
+    lab = np.array([0, 1, 2, 3], dtype=np.float32)
+    ex = s.simple_bind(mx.cpu(), data=(4, 5), label=(4,),
+                       grad_req={'data': 'write', 'label': 'null'})
+    ex.arg_dict['data'][:] = x
+    ex.arg_dict['label'][:] = lab
+    ex.forward(is_train=True)
+    ex.backward()
+    softmax = np.exp(x - x.max(1, keepdims=True))
+    softmax /= softmax.sum(1, keepdims=True)
+    expected = softmax.copy()
+    expected[np.arange(4), lab.astype(int)] -= 1
+    assert_almost_equal(ex.grad_dict['data'].asnumpy(), expected, rtol=1e-4,
+                        atol=1e-5)
+
+
+def test_dropout():
+    data = sym.Variable('data')
+    d = sym.Dropout(data, p=0.5)
+    ex = d.simple_bind(mx.cpu(), data=(200, 200))
+    ex.arg_dict['data'][:] = 1
+    out = ex.forward(is_train=True)[0].asnumpy()
+    frac = (out == 0).mean()
+    assert 0.4 < frac < 0.6
+    kept = out[out != 0]
+    assert_almost_equal(kept, np.full_like(kept, 2.0))
+    out_inf = ex.forward(is_train=False)[0].asnumpy()
+    assert_almost_equal(out_inf, np.ones((200, 200)))
+
+
+def test_embedding():
+    data = sym.Variable('data')
+    emb = sym.Embedding(data, input_dim=10, output_dim=4, name='emb')
+    arg_shapes, out_shapes, _ = emb.infer_shape(data=(3, 2))
+    assert arg_shapes[1] == (10, 4)
+    assert out_shapes[0] == (3, 2, 4)
+    ex = emb.simple_bind(mx.cpu(), data=(3, 2))
+    w = np.random.rand(10, 4).astype(np.float32)
+    ex.arg_dict['emb_weight'][:] = w
+    ex.arg_dict['data'][:] = [[0, 1], [2, 3], [9, 0]]
+    out = ex.forward()[0].asnumpy()
+    assert_almost_equal(out, w[np.array([[0, 1], [2, 3], [9, 0]])])
+
+
+def test_leaky_relu_variants():
+    x = np.random.randn(3, 4).astype(np.float32)
+    for act in ['leaky', 'elu']:
+        data = sym.Variable('data')
+        s = sym.LeakyReLU(data, act_type=act, slope=0.25)
+        ex = s.simple_bind(mx.cpu(), data=(3, 4))
+        ex.arg_dict['data'][:] = x
+        out = ex.forward()[0].asnumpy()
+        if act == 'leaky':
+            ref = np.where(x > 0, x, 0.25 * x)
+        else:
+            ref = np.where(x > 0, x, 0.25 * (np.exp(x) - 1))
+        assert_almost_equal(out, ref, rtol=1e-4, atol=1e-6)
+
+
+def test_regression_outputs():
+    x = np.random.rand(4, 3).astype(np.float32)
+    y = np.random.rand(4, 3).astype(np.float32)
+    for op_name, fwd in [('LinearRegressionOutput', lambda v: v),
+                         ('LogisticRegressionOutput',
+                          lambda v: 1 / (1 + np.exp(-v)))]:
+        data = sym.Variable('data')
+        label = sym.Variable('label')
+        s = getattr(sym, op_name)(data, label)
+        ex = s.simple_bind(mx.cpu(), data=(4, 3), label=(4, 3),
+                           grad_req={'data': 'write', 'label': 'null'})
+        ex.arg_dict['data'][:] = x
+        ex.arg_dict['label'][:] = y
+        ex.forward(is_train=True)
+        assert_almost_equal(ex.outputs[0].asnumpy(), fwd(x), rtol=1e-4,
+                            atol=1e-5)
+        ex.backward()
+        assert_almost_equal(ex.grad_dict['data'].asnumpy(),
+                            (fwd(x) - y) / 4, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 3, 2).astype(np.float32)  # (T, N, C)
+    slen = np.array([2, 4, 3], dtype=np.float32)
+    out = nd.SequenceMask(nd.array(x), nd.array(slen),
+                          use_sequence_length=True, value=-1)
+    o = out.asnumpy()
+    assert (o[2:, 0] == -1).all() and (o[3:, 2] == -1).all()
+    assert_almost_equal(o[:2, 0], x[:2, 0])
+    last = nd.SequenceLast(nd.array(x), nd.array(slen),
+                           use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(slen),
+                             use_sequence_length=True)
+    assert_almost_equal(rev.asnumpy()[0, 0], x[1, 0])
+    assert_almost_equal(rev.asnumpy()[1, 0], x[0, 0])
+
+
+def test_where():
+    cond = nd.array([[1., 0.], [0., 1.]])
+    x = nd.ones((2, 2)) * 2
+    y = nd.ones((2, 2)) * 3
+    out = nd.where(cond, x, y)
+    assert_almost_equal(out.asnumpy(), [[2, 3], [3, 2]])
+
+
+def test_rnn_op_shapes():
+    T, N, I, H = 5, 3, 4, 6
+    data = sym.Variable('data')
+    r = sym.RNN(data, state_size=H, num_layers=2, mode='lstm',
+                state_outputs=True, name='rnn')
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+    psize = rnn_param_size(2, H, I, False, 'lstm')
+    arg_shapes, out_shapes, _ = r.infer_shape(data=(T, N, I))
+    args = r.list_arguments()
+    assert arg_shapes[args.index('rnn_parameters')] == (psize,)
+    assert out_shapes[0] == (T, N, H)
+    assert out_shapes[1] == (2, N, H)
+    assert out_shapes[2] == (2, N, H)
+
+
+def test_rnn_op_forward_lstm_vs_manual():
+    """LSTM fused op matches a hand-rolled single-layer LSTM."""
+    T, N, I, H = 3, 2, 4, 5
+    from mxnet_tpu.ops.rnn_ops import rnn_param_size
+    psize = rnn_param_size(1, H, I, False, 'lstm')
+    params = np.random.uniform(-0.5, 0.5, (psize,)).astype(np.float32)
+    x = np.random.rand(T, N, I).astype(np.float32)
+    h0 = np.zeros((1, N, H), dtype=np.float32)
+    c0 = np.zeros((1, N, H), dtype=np.float32)
+    out = nd.RNN(nd.array(x), nd.array(params), nd.array(h0), nd.array(c0),
+                 state_size=H, num_layers=1, mode='lstm')
+    W = params[:4 * H * I].reshape(4 * H, I)
+    R = params[4 * H * I:4 * H * I + 4 * H * H].reshape(4 * H, H)
+    bW = params[4 * H * (I + H):4 * H * (I + H) + 4 * H]
+    bR = params[4 * H * (I + H) + 4 * H:]
+
+    def sigmoid(v):
+        return 1 / (1 + np.exp(-v))
+    h, c = h0[0], c0[0]
+    outs = []
+    for t in range(T):
+        g = x[t].dot(W.T) + h.dot(R.T) + bW + bR
+        i = sigmoid(g[:, :H])
+        f = sigmoid(g[:, H:2 * H])
+        gg = np.tanh(g[:, 2 * H:3 * H])
+        o = sigmoid(g[:, 3 * H:])
+        c = f * c + i * gg
+        h = o * np.tanh(c)
+        outs.append(h)
+    assert_almost_equal(out.asnumpy(), np.stack(outs), rtol=1e-4, atol=1e-5)
+
+
+def test_check_consistency_dtype():
+    data = sym.Variable('data')
+    fc = sym.FullyConnected(data, num_hidden=8, name='fc')
+    check_consistency(fc, [{'ctx': mx.cpu(0), 'data': (4, 6),
+                            'type_dict': {'data': np.float32}},
+                           {'ctx': mx.cpu(1), 'data': (4, 6),
+                            'type_dict': {'data': np.float32}}])
+
+
+def test_layernorm():
+    data = sym.Variable('data')
+    ln = sym.LayerNorm(data, name='ln')
+    x = np.random.randn(4, 6).astype(np.float32)
+    ex = ln.simple_bind(mx.cpu(), data=(4, 6))
+    ex.arg_dict['data'][:] = x
+    ex.arg_dict['ln_gamma'][:] = 1
+    ex.arg_dict['ln_beta'][:] = 0
+    o = ex.forward()[0].asnumpy()
+    assert abs(o.mean(-1)).max() < 1e-4
+    assert abs(o.var(-1) - 1).max() < 1e-2
+
+
+def test_upsampling():
+    x = nd.array(np.arange(4).reshape(1, 1, 2, 2))
+    up = nd.UpSampling(x, scale=2, sample_type='nearest')
+    assert up.shape == (1, 1, 4, 4)
+    assert_almost_equal(up.asnumpy()[0, 0], [[0, 0, 1, 1], [0, 0, 1, 1],
+                                             [2, 2, 3, 3], [2, 2, 3, 3]])
+
+
+def test_ctc_loss():
+    # uniform logits: loss = -log(sum of valid paths * p^T)
+    T, N, V = 4, 2, 3
+    data = np.zeros((T, N, V), dtype=np.float32)
+    label = np.array([[1, 2], [1, 0]], dtype=np.float32)
+    loss = nd.invoke('_contrib_CTCLoss', [nd.array(data), nd.array(label)], {})
+    assert loss.shape == (N,)
+    assert (loss.asnumpy() > 0).all()
