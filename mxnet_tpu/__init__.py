@@ -39,6 +39,7 @@ from .model import save_checkpoint, load_checkpoint
 from . import module
 from . import module as mod
 from . import rnn
+from . import gluon
 from . import monitor
 from .monitor import Monitor
 from . import profiler

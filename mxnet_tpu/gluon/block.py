@@ -336,6 +336,8 @@ class HybridBlock(Block):
                        for a in source_nds + aux_nds]
 
             def wrapped_vjp(cotangents):
+                if not isinstance(cotangents, tuple):
+                    cotangents = (cotangents,)
                 (grads,) = vjp_fn(cotangents)
                 return grads
             node = autograd.record_op(wrapped_vjp, parents,

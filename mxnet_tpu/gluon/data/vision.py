@@ -1,0 +1,112 @@
+"""Gluon vision datasets.
+
+Reference: python/mxnet/gluon/data/vision.py — MNIST, FashionMNIST, CIFAR10.
+Reads the standard on-disk formats when present; falls back to the same
+hermetic synthetic generator as io.MNISTIter so training tests run with
+zero network egress.
+"""
+import os
+import gzip
+import struct
+
+import numpy as np
+
+from ... import ndarray as nd
+from ...io import synthetic_mnist
+from .dataset import Dataset
+
+__all__ = ['MNIST', 'FashionMNIST', 'CIFAR10']
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, train, transform):
+        self._root = os.path.expanduser(root)
+        self._train = train
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError()
+
+
+class MNIST(_DownloadedDataset):
+    """Reference vision.py:33."""
+
+    _base = 'train'
+
+    def _get_data(self):
+        if self._train:
+            data_file = os.path.join(self._root, 'train-images-idx3-ubyte.gz')
+            label_file = os.path.join(self._root, 'train-labels-idx1-ubyte.gz')
+        else:
+            data_file = os.path.join(self._root, 't10k-images-idx3-ubyte.gz')
+            label_file = os.path.join(self._root, 't10k-labels-idx1-ubyte.gz')
+        if os.path.exists(data_file):
+            with gzip.open(label_file, 'rb') as fin:
+                struct.unpack('>II', fin.read(8))
+                label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)
+            with gzip.open(data_file, 'rb') as fin:
+                struct.unpack('>IIII', fin.read(16))
+                data = np.frombuffer(fin.read(), dtype=np.uint8)
+                data = data.reshape(len(label), 28, 28, 1)
+        else:
+            imgs, labels = synthetic_mnist(6000 if self._train else 1000,
+                                           seed=0 if self._train else 1)
+            data = (imgs * 255).astype(np.uint8).reshape(-1, 28, 28, 1)
+            label = labels.astype(np.int32)
+        self._data = [nd.array(x, dtype=np.uint8) for x in data]
+        self._label = label
+
+    def __init__(self, root='~/.mxnet/datasets/mnist', train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root='~/.mxnet/datasets/fashion-mnist', train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """Reference vision.py:83."""
+
+    def __init__(self, root='~/.mxnet/datasets/cifar10', train=True,
+                 transform=None):
+        super().__init__(root, train, transform)
+
+    def _read_batch(self, filename):
+        with open(filename, 'rb') as fin:
+            data = np.frombuffer(fin.read(), dtype=np.uint8).reshape(-1, 3072 + 1)
+        return data[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1), \
+            data[:, 0].astype(np.int32)
+
+    def _get_data(self):
+        if self._train:
+            files = [os.path.join(self._root, 'data_batch_%d.bin' % i)
+                     for i in range(1, 6)]
+        else:
+            files = [os.path.join(self._root, 'test_batch.bin')]
+        if all(os.path.exists(f) for f in files):
+            data, label = zip(*(self._read_batch(f) for f in files))
+            data = np.concatenate(data)
+            label = np.concatenate(label)
+        else:
+            protos = np.random.RandomState(99).rand(10, 32, 32, 3).astype(np.float32)
+            rng = np.random.RandomState(0 if self._train else 1)
+            n = 5000 if self._train else 1000
+            label = rng.randint(0, 10, n).astype(np.int32)
+            data = np.clip(protos[label] + 0.25 * rng.randn(n, 32, 32, 3), 0, 1)
+            data = (data * 255).astype(np.uint8)
+        self._data = [nd.array(x, dtype=np.uint8) for x in data]
+        self._label = label

@@ -1,0 +1,8 @@
+"""String → Initializer resolution shared by gluon layers."""
+from ...initializer import Zero, One
+
+
+def init_by_name(init):
+    if init is None or not isinstance(init, str):
+        return init
+    return {'zeros': Zero(), 'ones': One()}.get(init, init)

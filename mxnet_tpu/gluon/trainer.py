@@ -1,0 +1,156 @@
+"""Gluon Trainer — applies an Optimizer to a set of Parameters.
+
+Reference: python/mxnet/gluon/trainer.py:26 (_init_kvstore:95, step:116 —
+push grads / pull weights when update_on_kvstore, else pull grads + local
+updaters per device).
+"""
+from .. import optimizer as opt
+from ..model import _create_kvstore
+from .parameter import ParameterDict, Parameter
+
+__all__ = ['Trainer']
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore='device'):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise ValueError(
+                'First argument must be a list or dict of Parameters, '
+                'got %s.' % (type(params)))
+        self._params = []
+        for param in params:
+            if not isinstance(param, Parameter):
+                raise ValueError(
+                    'First argument must be a list or dict of Parameters, '
+                    'got list of %s.' % (type(param)))
+            if param.grad_req != 'null':
+                self._params.append(param)
+        self._scale = float(optimizer_params.get('rescale_grad', 1.0)) \
+            if optimizer_params else 1.0
+        self._contexts = self._check_contexts()
+        self._init_optimizer(optimizer, optimizer_params or {})
+        self._kv_initialized = False
+        self._kvstore = kvstore
+
+    def _check_contexts(self):
+        contexts = None
+        for param in self._params:
+            ctx = param.list_ctx()
+            assert contexts is None or contexts == ctx, \
+                'All Parameters must be initialized on the same set of contexts, ' \
+                'but Parameter %s is initialized on %s while previous Parameters ' \
+                'are initialized on %s.' % (param.name, str(ctx), str(contexts))
+            contexts = ctx
+        return contexts
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, \
+                'optimizer_params must be None if optimizer is an Optimizer ' \
+                'instance'
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._updaters = [opt.get_updater(self._optimizer)
+                          for _ in self._contexts]
+
+    def _init_kvstore(self):
+        """Reference trainer.py:95."""
+        arg_arrays = {param.name: param.data(self._contexts[0])
+                      for param in self._params}
+        kvstore, update_on_kvstore = _create_kvstore(
+            self._kvstore, len(self._contexts), arg_arrays)
+        if kvstore:
+            if 'dist' in kvstore.type:
+                update_on_kvstore = False
+            for i, param in enumerate(self._params):
+                param_arrays = param.list_data()
+                kvstore.init(i, param_arrays[0])
+                if update_on_kvstore:
+                    kvstore.pull(i, param_arrays, priority=-i)
+            if update_on_kvstore:
+                kvstore.set_optimizer(self._optimizer)
+            self._kvstore = kvstore
+            self._update_on_kvstore = update_on_kvstore
+        else:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Reference trainer.py:116."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+
+        self._optimizer.rescale_grad = self._scale / batch_size
+
+        for i, param in enumerate(self._params):
+            if param.grad_req == 'null':
+                continue
+            if not ignore_stale_grad:
+                for data in param.list_data():
+                    if data._fresh_grad:
+                        raise UserWarning(
+                            'Gradient of Parameter `%s` on context %s has not '
+                            'been updated by backward since last `step`. This '
+                            'could mean a bug in your model that made it only '
+                            'use a subset of the Parameters (Blocks) for this '
+                            'iteration. If you are intentionally only using a '
+                            'subset, call step with ignore_stale_grad=True to '
+                            'suppress this warning and skip updating of '
+                            'Parameters with stale gradient' % (
+                                param.name, str(data.context)))
+            if self._kvstore:
+                self._kvstore.push(i, param.list_grad(), priority=-i)
+                if self._update_on_kvstore:
+                    self._kvstore.pull(i, param.list_data(), priority=-i)
+                    continue
+                self._kvstore.pull(i, param.list_grad(), priority=-i)
+
+            for upd, arr, grad in zip(self._updaters, param.list_data(),
+                                      param.list_grad()):
+                if not ignore_stale_grad or not arr._fresh_grad:
+                    upd(i, grad, arr)
+                    arr._fresh_grad = True
+        # reset for next iteration's staleness tracking
+        for param in self._params:
+            for data in param.list_data():
+                data._fresh_grad = True
+
+    def save_states(self, fname):
+        """Reference trainer.py:162."""
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, 'wb') as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        """Reference trainer.py:178."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore._updater.optimizer
+        else:
+            with open(fname, 'rb') as f:
+                states = f.read()
+            for updater in self._updaters:
+                updater.set_states(states)
+                updater.optimizer = self._updaters[0].optimizer
+            self._optimizer = self._updaters[0].optimizer

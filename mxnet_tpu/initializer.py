@@ -62,7 +62,8 @@ class Initializer:
             _INIT_REGISTRY[klass.lower()](**kwargs)._init_weight(desc, arr)
             return
         name = str(desc)
-        if name.endswith('weight'):
+        if name.endswith('weight') or name.endswith('parameters'):
+            # 'parameters' = fused-RNN flat vector (ops/rnn_ops.py layout)
             self._init_weight(name, arr)
         elif name.endswith('bias'):
             self._init_bias(name, arr)

@@ -143,6 +143,8 @@ def infer_shapes(symbol, known, partial=False, known_types=None):
             if s is None and '__shape__' in n.attr_dict:
                 import ast
                 s = tuple(ast.literal_eval(n.attr_dict['__shape__']))
+            if s is not None and any(d == 0 for d in s):
+                s = None  # 0-dims mean "unknown" (MXNet convention)
             var_shape[n.name] = tuple(s) if s is not None else None
             shapes[id(n)] = [var_shape[n.name]]
             continue

@@ -1,0 +1,243 @@
+"""Gluon tests (reference tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter('weight', shape=(10, 10))
+    p.initialize(init='xavier' if False else None, ctx=[mx.cpu(0), mx.cpu(1)])
+    assert len(p.list_data()) == 2
+    assert len(p.list_grad()) == 2
+    assert p.data(mx.cpu(1)).context == mx.cpu(1)
+    assert p.data(mx.cpu(0)).shape == (10, 10)
+    assert p.var().name == 'weight'
+
+
+def test_paramdict():
+    params = gluon.ParameterDict('net_')
+    params.get('weight', shape=(10, 10))
+    assert list(params.keys()) == ['net_weight']
+    params.initialize(ctx=mx.cpu())
+    params.save('/tmp/test_paramdict.params')
+    params.load('/tmp/test_paramdict.params', mx.cpu())
+
+
+def test_dense():
+    model = nn.Dense(128, activation='tanh', in_units=10, flatten=False,
+                     prefix='test1_')
+    inputs = mx.sym.Variable('data')
+    outputs = model(inputs)
+    assert set(model.collect_params().keys()) == {'test1_weight', 'test1_bias'}
+    assert outputs.list_outputs() == ['test1_tanh_fwd_output'] or \
+        len(outputs.list_outputs()) == 1
+    args, outs, auxs = outputs.infer_shape(data=(2, 3, 10))
+    assert outs == [(2, 3, 128)]
+
+    model = nn.Dense(128, activation='relu', in_units=30, flatten=True,
+                     prefix='test2_')
+    inputs = mx.sym.Variable('data')
+    outputs = model(inputs)
+    assert set(model.collect_params().keys()) == {'test2_weight', 'test2_bias'}
+    args, outs, auxs = outputs.infer_shape(data=(17, 2, 5, 3))
+    assert outs == [(17, 128)]
+
+
+def test_basic_workflow():
+    model = nn.Sequential()
+    model.add(nn.Dense(128, activation='tanh', in_units=784))
+    model.add(nn.Dropout(0.5))
+    model.add(nn.Dense(64, activation='tanh', in_units=128))
+    model.add(nn.Dense(32, in_units=64))
+    model.initialize()
+
+    x = mx.nd.random.uniform(shape=(32, 784))
+    out = model(x)
+    assert out.shape == (32, 32)
+
+    # backward through the whole net
+    with mx.autograd.record():
+        out = model(x)
+        loss = mx.nd.sum(out)
+    loss.backward()
+    for _, p in model.collect_params().items():
+        assert abs(p.grad().asnumpy()).sum() > 0 or p.name.endswith('bias')
+
+
+def test_hybrid_consistency():
+    """Hybridized and imperative execution must agree."""
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation='relu'))
+        net.add(nn.Dense(8))
+    net.initialize()
+    x = mx.nd.random.normal(shape=(4, 12))
+    out_imperative = net(x).asnumpy()
+    net.hybridize()
+    out_hybrid = net(x).asnumpy()
+    assert_almost_equal(out_imperative, out_hybrid, rtol=1e-4, atol=1e-5)
+
+
+def test_hybrid_training_matches():
+    np.random.seed(0)
+    x = mx.nd.random.normal(shape=(8, 12))
+    label = mx.nd.array(np.random.randint(0, 4, (8,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def make_net():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(16, activation='relu'))
+            net.add(nn.Dense(4))
+        return net
+
+    net1 = make_net()
+    net1.initialize()
+    net1(x)  # materialize deferred shapes before saving
+    net1.save_params('/tmp/hybrid_match.params')
+    net2 = make_net()
+    net2.load_params('/tmp/hybrid_match.params')
+    net2.hybridize()
+
+    with mx.autograd.record():
+        l1 = loss_fn(net1(x), label)
+    l1.backward()
+    with mx.autograd.record():
+        l2 = loss_fn(net2(x), label)
+    l2.backward()
+    for (k1, p1), (k2, p2) in zip(sorted(net1.collect_params().items()),
+                                  sorted(net2.collect_params().items())):
+        assert_almost_equal(p1.grad().asnumpy(), p2.grad().asnumpy(),
+                            rtol=1e-4, atol=1e-5)
+
+
+def test_trainer_sgd():
+    net = nn.Dense(1, in_units=2, use_bias=False)
+    net.initialize()
+    net.weight.set_data(mx.nd.array([[1., 2.]]))
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': 0.5})
+    x = mx.nd.array([[1., 1.]])
+    with mx.autograd.record():
+        y = net(x)
+    y.backward()
+    trainer.step(1)
+    # w -= 0.5 * grad; grad = x = [1,1]
+    assert_almost_equal(net.weight.data().asnumpy(), [[0.5, 1.5]], rtol=1e-5,
+                        atol=1e-6)
+
+
+def test_conv_layers():
+    x = mx.nd.random.normal(shape=(2, 3, 10, 10))
+    conv = nn.Conv2D(8, 3, padding=1)
+    conv.initialize()
+    assert conv(x).shape == (2, 8, 10, 10)
+
+    pool = nn.MaxPool2D(2, 2)
+    assert pool(x).shape == (2, 3, 5, 5)
+
+    gap = nn.GlobalAvgPool2D()
+    assert gap(x).shape == (2, 3, 1, 1)
+
+    deconv = nn.Conv2DTranspose(4, 4, strides=2, padding=1)
+    deconv.initialize()
+    assert deconv(x).shape == (2, 4, 20, 20)
+
+
+def test_batchnorm_layer():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.random.normal(shape=(8, 4, 3, 3), scale=5)
+    with mx.autograd.record():
+        out = bn(x)
+    o = out.asnumpy()
+    assert abs(o.mean(axis=(0, 2, 3))).max() < 1e-2
+    # running stats moved
+    assert abs(bn.running_mean.data().asnumpy()).sum() > 0
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    x = mx.nd.array([[1, 2], [3, 4]])
+    assert emb(x).shape == (2, 2, 4)
+
+
+def test_losses():
+    output = mx.nd.random.normal(shape=(4, 5))
+    label = mx.nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(output, label)
+    lsm = output.asnumpy() - output.asnumpy().max(1, keepdims=True)
+    lsm = lsm - np.log(np.exp(lsm).sum(1, keepdims=True))
+    expected = -lsm[np.arange(4), label.asnumpy().astype(int)]
+    assert_almost_equal(l.asnumpy(), expected, rtol=1e-4, atol=1e-5)
+
+    pred = mx.nd.random.uniform(shape=(4, 3))
+    target = mx.nd.random.uniform(shape=(4, 3))
+    l2 = gluon.loss.L2Loss()(pred, target)
+    assert_almost_equal(l2.asnumpy(),
+                        0.5 * ((pred.asnumpy() - target.asnumpy()) ** 2).mean(1),
+                        rtol=1e-4, atol=1e-5)
+    l1 = gluon.loss.L1Loss()(pred, target)
+    assert_almost_equal(l1.asnumpy(),
+                        np.abs(pred.asnumpy() - target.asnumpy()).mean(1),
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_split_and_load():
+    x = mx.nd.random.uniform(shape=(8, 3))
+    splits = gluon.utils.split_and_load(x, [mx.cpu(0), mx.cpu(1)])
+    assert len(splits) == 2
+    assert splits[0].shape == (4, 3)
+    assert splits[1].context == mx.cpu(1)
+    merged = np.concatenate([s.asnumpy() for s in splits])
+    assert_almost_equal(merged, x.asnumpy())
+
+
+def test_data_loader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.random.uniform(size=(32, 5)).astype(np.float32)
+    y = np.random.randint(0, 2, (32,)).astype(np.float32)
+    dataset = ArrayDataset(mx.nd.array(X), mx.nd.array(y))
+    loader = DataLoader(dataset, batch_size=8)
+    count = 0
+    for data, label in loader:
+        assert data.shape == (8, 5)
+        assert label.shape == (8,)
+        count += 1
+    assert count == 4
+
+
+def test_rnn_layers_shapes():
+    for layer, h in [(gluon.rnn.RNN(8, 2), 8), (gluon.rnn.LSTM(8, 2), 8),
+                     (gluon.rnn.GRU(8, 2), 8)]:
+        layer.initialize()
+        x = mx.nd.random.normal(shape=(3, 4, 5))
+        out = layer(x)
+        assert out.shape == (3, 4, h)
+        states = layer.begin_state(4)
+        out, new_states = layer(x, states)
+        assert out.shape == (3, 4, h)
+        assert len(new_states) == len(states)
+
+
+def test_symbol_block():
+    data = mx.sym.Variable('data')
+    net_sym = mx.sym.FullyConnected(data, name='fc', num_hidden=6)
+    net = gluon.SymbolBlock(net_sym, data)
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 4))
+    assert net(x).shape == (2, 6)
+
+
+def test_model_zoo_tiny_forward():
+    from mxnet_tpu.gluon.model_zoo import get_model
+    x = mx.nd.random.normal(shape=(1, 3, 32, 32))
+    for name in ['resnet18_v1', 'resnet18_v2', 'squeezenet1.1']:
+        net = get_model(name, classes=10)
+        net.initialize()
+        assert net(x).shape == (1, 10), name
