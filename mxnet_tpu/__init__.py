@@ -50,3 +50,4 @@ from . import registry
 from .executor_manager import DataParallelExecutorManager  # noqa: F401
 from . import operator
 from .operator import CustomOp, CustomOpProp
+from . import parallel

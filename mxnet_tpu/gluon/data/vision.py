@@ -51,7 +51,7 @@ class MNIST(_DownloadedDataset):
         else:
             data_file = os.path.join(self._root, 't10k-images-idx3-ubyte.gz')
             label_file = os.path.join(self._root, 't10k-labels-idx1-ubyte.gz')
-        if os.path.exists(data_file):
+        if os.path.exists(data_file) and os.path.exists(label_file):
             with gzip.open(label_file, 'rb') as fin:
                 struct.unpack('>II', fin.read(8))
                 label = np.frombuffer(fin.read(), dtype=np.uint8).astype(np.int32)

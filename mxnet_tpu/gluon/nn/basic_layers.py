@@ -263,8 +263,4 @@ class HybridLambda(HybridBlock):
         return self._func(F, x, *args)
 
 
-def _init_by_name(init):
-    if init is None or not isinstance(init, str):
-        return init
-    from ...initializer import Zero, One, Constant
-    return {'zeros': Zero(), 'ones': One()}.get(init, init)
+from ..rnn.basic_init import init_by_name as _init_by_name  # noqa: E402

@@ -1,0 +1,39 @@
+"""parallel — SPMD distribution over TPU device meshes.
+
+This package is the TPU-native replacement for the reference's entire
+distribution story (SURVEY.md §2.3, §5.8):
+
+- ``src/kvstore/comm.h`` device-tier reduce/broadcast  → XLA collectives
+  over the ICI mesh (:mod:`collectives`).
+- ``DataParallelExecutorGroup`` (module/executor_group.py:99) batch
+  slicing  → one pjit'd step with the batch sharded on the ``dp`` mesh
+  axis (:mod:`data_parallel`).
+- ``AttrScope(ctx_group)`` manual model parallelism  → sharding
+  annotations (:mod:`sharding`) and a real micro-batch pipeline schedule
+  (:mod:`pipeline`) — new capability, absent in the reference.
+- Long sequences: the reference buckets (BucketingModule); here sequence/
+  context parallelism via ring attention over ``ppermute``
+  (:mod:`ring_attention`) — new capability.
+"""
+from .mesh import DeviceMesh, make_mesh, local_mesh
+from .collectives import (allreduce, allgather, reduce_scatter, ring_permute,
+                          alltoall, axis_index, axis_size, pbroadcast)
+from .sharding import (ShardingPlan, data_parallel_plan, constrain,
+                       shard_params, replicate_params)
+from .data_parallel import make_train_step, ShardedTrainer
+from .ring_attention import (ring_attention, blockwise_attention,
+                             ulysses_attention, make_ring_attention,
+                             attention_reference)
+from .pipeline import PipelineStage, pipeline_apply, stack_stage_params
+
+__all__ = [
+    'DeviceMesh', 'make_mesh', 'local_mesh',
+    'allreduce', 'allgather', 'reduce_scatter', 'ring_permute', 'alltoall',
+    'axis_index', 'axis_size', 'pbroadcast',
+    'ShardingPlan', 'data_parallel_plan', 'constrain', 'shard_params',
+    'replicate_params',
+    'make_train_step', 'ShardedTrainer',
+    'ring_attention', 'blockwise_attention', 'ulysses_attention',
+    'make_ring_attention', 'attention_reference',
+    'PipelineStage', 'pipeline_apply', 'stack_stage_params',
+]

@@ -1,0 +1,80 @@
+"""Collectives — the TPU replacement for the reference's Comm/ps-lite tiers.
+
+Reference mapping (SURVEY.md §5.8):
+- ``CommDevice::Reduce`` + ``Broadcast`` (src/kvstore/comm.h:460-540,
+  reduce-to-one-GPU then copy back)  →  :func:`allreduce` = ``lax.psum``,
+  compiled by XLA into a ring/tree over ICI.
+- ``KVStoreDist`` ZPush/ZPull striping over servers
+  (src/kvstore/kvstore_dist.h:430-468)  →  :func:`reduce_scatter` +
+  :func:`allgather` (the two halves of a sharded allreduce).
+- There is no analog of ``ppermute`` in the reference — it is the TPU
+  primitive behind ring attention and pipeline transfer.
+
+All functions must be called inside a mesh-axis context (shard_map /
+pjit with named axes); ``axis`` is the mesh axis name.
+"""
+import jax
+from jax import lax
+
+__all__ = ['allreduce', 'allgather', 'reduce_scatter', 'ring_permute',
+           'alltoall', 'axis_index', 'axis_size', 'pbroadcast']
+
+
+def allreduce(x, axis, op='sum'):
+    """Allreduce over a mesh axis. op in {sum, mean, max, min}."""
+    if op == 'sum':
+        return lax.psum(x, axis)
+    if op == 'mean':
+        return lax.pmean(x, axis)
+    if op == 'max':
+        return lax.pmax(x, axis)
+    if op == 'min':
+        return lax.pmin(x, axis)
+    raise ValueError('unknown reduce op %r' % (op,))
+
+
+def allgather(x, axis, concat_dim=0, tiled=True):
+    """Gather shards from every device along `axis`, concatenated on
+    ``concat_dim`` (tiled=True) or stacked on a new leading dim."""
+    return lax.all_gather(x, axis, axis=concat_dim, tiled=tiled)
+
+
+def reduce_scatter(x, axis, scatter_dim=0):
+    """Sum over the axis, leaving each device its own shard — the
+    bandwidth-optimal half of an allreduce (allreduce = reduce_scatter
+    + allgather). Grad sync for sharded optimizers (ZeRO-style)."""
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_dim, tiled=True)
+
+
+def ring_permute(x, axis, shift=1):
+    """Send this device's value to its neighbour `shift` steps around the
+    ring; receive from the opposite neighbour. The transport under ring
+    attention and pipeline stage hand-off."""
+    n = lax.psum(1, axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def alltoall(x, axis, split_dim, concat_dim):
+    """Transpose data across the axis: split `split_dim` n ways, exchange,
+    concat on `concat_dim`. The Ulysses attention primitive (heads↔seq)."""
+    return lax.all_to_all(x, axis, split_axis=split_dim,
+                          concat_axis=concat_dim, tiled=True)
+
+
+def pbroadcast(x, axis, src=0):
+    """Broadcast from `src` device along the axis (select + psum)."""
+    idx = lax.axis_index(axis)
+    masked = jax.tree_util.tree_map(
+        lambda v: jax.numpy.where(idx == src, v, jax.numpy.zeros_like(v)), x)
+    return jax.tree_util.tree_map(lambda v: lax.psum(v, axis), masked)
+
+
+def axis_index(axis):
+    """This device's coordinate along the mesh axis (≙ kvstore rank)."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis):
+    """Number of devices along the mesh axis (≙ kvstore num_workers)."""
+    return lax.psum(1, axis)

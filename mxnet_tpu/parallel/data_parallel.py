@@ -1,0 +1,138 @@
+"""SPMD train step — the TPU form of DataParallelExecutorGroup + KVStore.
+
+In the reference one training step is: slice batch over devices
+(executor_group.py:233 decide_slices), run per-device executors, push
+grads to KVStore (reduce on merge GPU, comm.h:460), run the updater,
+pull weights back (§3.3 call stack). Here ALL of that is one jitted XLA
+computation over the mesh:
+
+- the global batch is sharded on the ``dp`` (and ``sp``) axes,
+- the loss is averaged over the *global* batch, so jax's autodiff
+  emits the gradient ``psum`` exactly where the KVStore push was —
+  compiled into the step, overlapped with backprop by XLA's scheduler
+  (the reference got this overlap from engine priorities,
+  kvstore.py:139 ``priority=-index``),
+- the optimizer update runs sharded in the same computation
+  ("update_on_kvstore" fused, SURVEY.md §7 step 6),
+- parameter buffers are donated, so weights are updated in place in
+  device memory (the reference's kWriteInplace).
+"""
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .mesh import DeviceMesh
+from .sharding import ShardingPlan, data_parallel_plan, shard_params
+
+__all__ = ['make_train_step', 'ShardedTrainer', 'sgd_rule', 'adam_rule']
+
+
+# ---------------------------------------------------------------------------
+# Functional optimizer rules: (param, grad, state, step) -> (param, state).
+# Pure-jnp counterparts of the fused update ops (ops/optimizer_ops.py,
+# reference src/operator/optimizer_op.cc) usable inside one jitted step.
+# ---------------------------------------------------------------------------
+
+def sgd_rule(lr=0.01, momentum=0.0, wd=0.0):
+    def init(param):
+        return jnp.zeros_like(param) if momentum else ()
+
+    def update(param, grad, state, step):
+        grad = grad + wd * param
+        if momentum:
+            state = momentum * state - lr * grad
+            return param + state, state
+        return param - lr * grad, state
+    return init, update
+
+
+def adam_rule(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0):
+    def init(param):
+        return (jnp.zeros_like(param), jnp.zeros_like(param))
+
+    def update(param, grad, state, step):
+        grad = grad + wd * param
+        m, v = state
+        m = beta1 * m + (1 - beta1) * grad
+        v = beta2 * v + (1 - beta2) * jnp.square(grad)
+        t = step + 1
+        mhat = m / (1 - beta1 ** t)
+        vhat = v / (1 - beta2 ** t)
+        return param - lr * mhat / (jnp.sqrt(vhat) + epsilon), (m, v)
+    return init, update
+
+
+def make_train_step(loss_fn, mesh, optimizer=None, plan=None,
+                    batch_spec=('dp',), donate=True):
+    """Compile ``loss_fn`` into a sharded step over the mesh.
+
+    loss_fn(params, batch, key) -> scalar loss (mean over the batch), or
+    (loss, aux) pytree. Returns (init_state, step) where
+    step(state, batch, key) -> (state, loss[, aux]) runs as ONE XLA
+    computation with grads synced by construction.
+    """
+    plan = plan or data_parallel_plan()
+    opt_init, opt_update = optimizer if optimizer is not None else sgd_rule()
+
+    has_aux = getattr(loss_fn, 'has_aux', False)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=has_aux)
+
+    batch_sharding = mesh.sharding(*batch_spec)
+    repl = mesh.replicated()
+
+    def init_state(params):
+        params = shard_params(params, mesh, plan)
+        opt_states = {k: opt_init(v) for k, v in params.items()}
+        return {'params': params, 'opt': opt_states,
+                'step': jnp.zeros((), jnp.int32)}
+
+    def step(state, batch, key):
+        out, grads = grad_fn(state['params'], batch, key)
+        loss, aux = out if has_aux else (out, None)
+        t = state['step']
+        new_params, new_opt = {}, {}
+        for k, p in state['params'].items():
+            new_params[k], new_opt[k] = opt_update(p, grads[k], state['opt'][k], t)
+        new_state = {'params': new_params, 'opt': new_opt, 'step': t + 1}
+        return (new_state, loss, aux) if has_aux else (new_state, loss)
+
+    jstep = jax.jit(step,
+                    in_shardings=(None, batch_sharding, repl),
+                    donate_argnums=(0,) if donate else ())
+    return init_state, jstep
+
+
+class ShardedTrainer:
+    """Mesh-wide trainer: the gluon.Trainer / Module.fit step on SPMD.
+
+    >>> trainer = ShardedTrainer(loss_fn, params, mesh, adam_rule(1e-3))
+    >>> loss = trainer.step(batch)          # one fused XLA computation
+    """
+
+    def __init__(self, loss_fn, params, mesh, optimizer=None, plan=None,
+                 batch_spec=('dp',), seed=0):
+        if not isinstance(mesh, DeviceMesh):
+            mesh = DeviceMesh(mesh)
+        self.mesh = mesh
+        self._init, self._step = make_train_step(
+            loss_fn, mesh, optimizer=optimizer, plan=plan,
+            batch_spec=batch_spec)
+        self.state = self._init(params)
+        self._key = jax.random.PRNGKey(seed)
+        self._has_aux = getattr(loss_fn, 'has_aux', False)
+
+    def step(self, batch):
+        self._key, sub = jax.random.split(self._key)
+        out = self._step(self.state, batch, sub)
+        if self._has_aux:
+            self.state, loss, aux = out
+            return loss, aux
+        self.state, loss = out
+        return loss
+
+    @property
+    def params(self):
+        return self.state['params']

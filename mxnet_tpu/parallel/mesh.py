@@ -1,0 +1,106 @@
+"""Device mesh — named topology every parallel strategy hangs off.
+
+The reference enumerates flat device lists (``ctx=[mx.gpu(0)..]``,
+module/executor_group.py decide_slices); on TPU the topology is a named
+N-D mesh and the strategy is expressed per-axis. Axis-name conventions
+used across this package:
+
+- ``dp``: data parallel (batch dimension)
+- ``tp``: tensor parallel (weight matrices split)
+- ``pp``: pipeline parallel (layer stages)
+- ``sp``: sequence/context parallel (ring attention)
+- ``ep``: expert parallel (MoE)
+
+Any subset may be present; missing axes just mean size 1.
+"""
+import collections
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+__all__ = ['DeviceMesh', 'make_mesh', 'local_mesh']
+
+AXIS_ORDER = ('pp', 'dp', 'ep', 'sp', 'tp')  # outer→inner: put tp on the
+# fastest (innermost/ICI-nearest) axis, pp on the slowest — matches how
+# XLA lays device ids out so tp collectives ride nearest-neighbour ICI.
+
+
+class DeviceMesh:
+    """A named mesh of devices plus helpers to build shardings on it.
+
+    Thin, picklable-metadata wrapper over ``jax.sharding.Mesh``; all
+    sharded compilation in this package goes through one of these.
+    """
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    @property
+    def axis_names(self):
+        return tuple(self.mesh.axis_names)
+
+    @property
+    def shape(self):
+        return dict(self.mesh.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(list(self.mesh.shape.values()))) if self.mesh.shape else 1
+
+    def axis_size(self, name):
+        return int(self.mesh.shape.get(name, 1))
+
+    def has_axis(self, name):
+        return name in self.mesh.axis_names and self.axis_size(name) > 1
+
+    def sharding(self, *spec):
+        """NamedSharding from a PartitionSpec-style tuple.
+
+        ``mesh.sharding('dp', None)`` shards dim0 on dp, replicates dim1."""
+        return NamedSharding(self.mesh, PartitionSpec(*spec))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def __enter__(self):
+        self._cm = self.mesh
+        self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+    def __repr__(self):
+        return 'DeviceMesh(%s)' % (', '.join('%s=%d' % kv for kv in self.mesh.shape.items()))
+
+
+def make_mesh(axes, devices=None):
+    """Build a DeviceMesh from ``{'dp': 4, 'tp': 2}``-style axis sizes.
+
+    Axes are laid out in AXIS_ORDER (pp outermost, tp innermost) so that
+    the highest-bandwidth (most frequent) collectives map to adjacent
+    devices. Total size must divide the device count; remaining devices
+    are an error (be explicit about what you use).
+    """
+    # size-1 axes are kept: a topology-agnostic ShardingPlan naming 'tp'
+    # must degrade to replicated on a tp=1 mesh, not crash on a missing axis
+    axes = {k: int(v) for k, v in axes.items() if int(v) >= 1} or {'dp': 1}
+    names = tuple(sorted(axes, key=lambda n: AXIS_ORDER.index(n) if n in AXIS_ORDER else 99))
+    sizes = tuple(axes[n] for n in names)
+    total = int(np.prod(sizes))
+    if devices is None:
+        devices = jax.devices()
+    if total > len(devices):
+        raise ValueError('mesh %s needs %d devices, have %d' % (axes, total, len(devices)))
+    dev_array = np.asarray(devices[:total]).reshape(sizes)
+    return DeviceMesh(Mesh(dev_array, names))
+
+
+def local_mesh(n=None, axis='dp'):
+    """1-D mesh over the first n local devices (all by default)."""
+    devices = jax.devices()
+    if n is None:
+        n = len(devices)
+    return make_mesh({axis: n}, devices)

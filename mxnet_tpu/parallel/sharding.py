@@ -1,0 +1,95 @@
+"""Sharding plans — name-pattern rules mapping parameters to mesh axes.
+
+Replaces the reference's per-device parameter replicas
+(gluon/parameter.py list_data — one full copy per GPU) and manual
+``ctx_group`` placement (attribute.py AttrScope) with declarative rules:
+a plan is an ordered list of (regex, PartitionSpec) pairs; first match
+wins; no match ⇒ replicated.
+
+Megatron-style tensor parallelism for Dense layers is two rules:
+    ('.*_up_weight',   P('tp', None))   # column split: output features
+    ('.*_down_weight', P(None, 'tp'))   # row split: input features
+XLA then inserts the single psum after the row-split matmul.
+"""
+import re
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ['ShardingPlan', 'data_parallel_plan', 'constrain',
+           'shard_params', 'replicate_params']
+
+P = PartitionSpec
+
+
+class ShardingPlan:
+    """Ordered (pattern → PartitionSpec) rules for a parameter pytree."""
+
+    def __init__(self, rules=(), default=P()):
+        self.rules = [(re.compile(pat), spec if isinstance(spec, PartitionSpec)
+                       else P(*spec)) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, name, shape=None, mesh=None):
+        for pat, spec in self.rules:
+            if pat.fullmatch(name):
+                return self._fit(spec, shape, mesh)
+        return self._fit(self.default, shape, mesh)
+
+    @staticmethod
+    def _fit(spec, shape, mesh=None):
+        # Best-effort fit: trim the spec to the array rank (one rule covers
+        # e.g. both the weight and its 1-d bias) and drop axes that don't
+        # divide the dimension (a (64, 1) head weight under P(None, 'tp')
+        # stays replicated on dim 1 instead of erroring in device_put).
+        if shape is None:
+            return spec
+        t = list(spec)[:len(shape)]
+        if mesh is not None:
+            for i, ax in enumerate(t):
+                if ax is None:
+                    continue
+                axes = ax if isinstance(ax, tuple) else (ax,)
+                n = 1
+                for a in axes:
+                    n *= mesh.axis_size(a) if hasattr(mesh, 'axis_size') else int(mesh.shape[a])
+                if shape[i] % n:
+                    t[i] = None
+        return P(*t)
+
+    def shardings(self, mesh, params):
+        """{name: array-like} → {name: NamedSharding}."""
+        return {k: NamedSharding(mesh.mesh, self.spec_for(k, getattr(v, 'shape', None), mesh))
+                for k, v in params.items()}
+
+    def extended(self, rules):
+        plan = ShardingPlan(default=self.default)
+        plan.rules = [(re.compile(p), s if isinstance(s, PartitionSpec) else P(*s))
+                      for p, s in rules] + list(self.rules)
+        return plan
+
+
+def data_parallel_plan():
+    """Pure DP: every parameter replicated; only the batch is sharded."""
+    return ShardingPlan()
+
+
+def constrain(x, mesh, *spec):
+    """In-jit sharding annotation (lax.with_sharding_constraint) — how a
+    traced step pins activations to mesh axes."""
+    return jax.lax.with_sharding_constraint(x, mesh.sharding(*spec))
+
+
+def shard_params(params, mesh, plan=None):
+    """Place a {name: jax.Array} dict onto the mesh per the plan."""
+    plan = plan or data_parallel_plan()
+    out = {}
+    for k, v in params.items():
+        out[k] = jax.device_put(
+            v, NamedSharding(mesh.mesh,
+                             plan.spec_for(k, getattr(v, 'shape', None), mesh)))
+    return out
+
+
+def replicate_params(params, mesh):
+    return shard_params(params, mesh, ShardingPlan())

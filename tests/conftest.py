@@ -2,17 +2,26 @@
 
 This is the TPU-world analog of the reference's multiple-cpu-context testing
 (tests/python/unittest/test_multi_device_exec.py uses mx.cpu(1), mx.cpu(2));
-XLA_FLAGS=--xla_force_host_platform_device_count=8 gives 8 independent CPU devices
-so sharding/mesh/kvstore paths are exercised without TPU hardware.
+XLA_FLAGS=--xla_force_host_platform_device_count=8 gives 8 independent CPU
+devices so sharding/mesh/kvstore paths are exercised without TPU hardware.
+
+NOTE: the environment may pre-import jax with a TPU platform pinned via
+JAX_PLATFORMS (sitecustomize). Setting env vars here is then too late — the
+platform must be forced through jax.config, which works any time before the
+first backend initialization.
 """
 import os
 
-os.environ.setdefault('JAX_PLATFORMS', 'cpu')
 _flags = os.environ.get('XLA_FLAGS', '')
 if '--xla_force_host_platform_device_count' not in _flags:
     os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
-# full-f32 matmul/conv so finite-difference gradient checks are meaningful
-# (the default bf16-grade MXU precision is what bench/production uses)
+os.environ['JAX_PLATFORMS'] = 'cpu'
+
 import jax  # noqa: E402
 
+jax.config.update('jax_platforms', 'cpu')
+# full-f32 matmul/conv so finite-difference gradient checks are meaningful
+# (the default bf16-grade MXU precision is what bench/production uses)
 jax.config.update('jax_default_matmul_precision', 'float32')
+
+assert len(jax.devices()) == 8, 'virtual 8-device CPU mesh failed to come up'
