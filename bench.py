@@ -1,0 +1,110 @@
+"""Headline benchmark: ResNet-50 training throughput (images/sec).
+
+Mirrors the reference's `train_imagenet.py` perf table config
+(docs/how_to/perf.md:150-190, batch 32, synthetic data): one full
+training step — forward, softmax CE, backward, SGD-momentum update,
+BatchNorm stat updates — compiled to a single donated-buffer XLA
+computation via the Gluon hybridize path.
+
+vs_baseline divides by the strongest single-GPU reference number:
+P100 batch-32 ResNet-50 training at 181.53 img/s (BASELINE.md).
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_IMG_S = 181.53  # P100, batch 32, docs/how_to/perf.md:150-190
+BATCH = 32
+WARMUP_STEPS = 3
+BENCH_STEPS = 20
+
+
+def build_train_step():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    net = resnet50_v1()
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.zeros((BATCH, 3, 224, 224))
+    net._deferred_infer_init(x)
+    net._build_cache(x)
+
+    prog = net._cached_prog
+    runner = prog.make_runner()
+    arg_names = prog.arg_names
+    data_idx = [i for i, n in enumerate(arg_names) if n == 'data']
+    assert len(data_idx) == 1
+    data_idx = data_idx[0]
+
+    ctx = x.context
+    arg_arrays = []
+    for kind, src in net._cached_arg_sources:
+        arg_arrays.append(x._data if kind == 'data' else src.data(ctx)._data)
+    aux_arrays = tuple(p.data(ctx)._data for p in net._cached_aux_sources)
+
+    lr, momentum, wd = 0.1, 0.9, 1e-4
+
+    def step(args, aux, vel, images, labels, key):
+        def loss_fn(args):
+            a = list(args)
+            a[data_idx] = images
+            outs, new_aux = runner(tuple(a), aux, key, True)
+            logits = outs[0]
+            lse = jax.nn.logsumexp(logits, -1)
+            gold = jnp.take_along_axis(logits, labels[:, None], -1)[:, 0]
+            return jnp.mean(lse - gold), new_aux
+
+        (loss, new_aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(args)
+        new_args, new_vel = [], []
+        for i, (a, g, v) in enumerate(zip(args, grads, vel)):
+            if i == data_idx:
+                new_args.append(a)
+                new_vel.append(v)
+                continue
+            g = g + wd * a
+            v = momentum * v - lr * g
+            new_args.append(a + v)
+            new_vel.append(v)
+        return tuple(new_args), new_aux, tuple(new_vel), loss
+
+    jstep = jax.jit(step, donate_argnums=(0, 1, 2))
+
+    vel = tuple(jnp.zeros_like(a) for a in arg_arrays)
+    rng = np.random.RandomState(0)
+    images = jnp.asarray(rng.standard_normal((BATCH, 3, 224, 224)),
+                         jnp.float32)
+    labels = jnp.asarray(rng.randint(0, 1000, (BATCH,)), jnp.int32)
+    key = jax.random.PRNGKey(0)
+    return jstep, tuple(arg_arrays), aux_arrays, vel, images, labels, key
+
+
+def main():
+    jstep, args, aux, vel, images, labels, key = build_train_step()
+    for _ in range(WARMUP_STEPS):
+        args, aux, vel, loss = jstep(args, aux, vel, images, labels, key)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(BENCH_STEPS):
+        args, aux, vel, loss = jstep(args, aux, vel, images, labels, key)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    img_s = BENCH_STEPS * BATCH / dt
+    print(json.dumps({
+        'metric': 'resnet50_train_throughput',
+        'value': round(img_s, 2),
+        'unit': 'images/sec',
+        'vs_baseline': round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == '__main__':
+    main()

@@ -12,6 +12,8 @@ BatchNorm follows the aux-state protocol: it RETURNS updated moving stats as
 extra outputs and the invoke layer writes them back (op_attr_types.h
 FMutateInputs analog).
 """
+import numpy as _np
+
 import jax
 import jax.numpy as jnp
 
@@ -157,15 +159,14 @@ def _pooling(attrs, data):
 
     if ptype == 'max':
         init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
-        return jax.lax.reduce_window(data, jnp.asarray(init, data.dtype),
+        return jax.lax.reduce_window(data, _np.asarray(init, data.dtype),
                                      jax.lax.max, window, strides, pads)
     if ptype in ('avg', 'sum'):
-        s = jax.lax.reduce_window(data, jnp.asarray(0, data.dtype),
+        s = jax.lax.reduce_window(data, _np.asarray(0, data.dtype),
                                   jax.lax.add, window, strides, pads)
         if ptype == 'sum':
             return s
         # count_include_pad=True (the reference default for avg pooling)
-        import numpy as _np
         return s / _np.prod(kernel)
     raise ValueError('unknown pool_type ' + ptype)
 
@@ -315,7 +316,7 @@ def _lrn(attrs, x):
     half = nsize // 2
     sq_pad = jnp.pad(sq, [(0, 0), (half, half)] + [(0, 0)] * (x.ndim - 2))
     window = (1, nsize) + (1,) * (x.ndim - 2)
-    ssum = jax.lax.reduce_window(sq_pad, jnp.asarray(0, x.dtype), jax.lax.add,
+    ssum = jax.lax.reduce_window(sq_pad, _np.asarray(0, x.dtype), jax.lax.add,
                                  window, (1,) * x.ndim,
                                  [(0, 0)] * x.ndim)
     return x * jnp.power(knorm + alpha / nsize * ssum, -beta)

@@ -25,6 +25,8 @@ from .ring_attention import (ring_attention, blockwise_attention,
                              ulysses_attention, make_ring_attention,
                              attention_reference)
 from .pipeline import PipelineStage, pipeline_apply, stack_stage_params
+from .five_d import (TransformerConfig, full_mesh, make_5d_train_step,
+                     make_loss_fn as make_5d_loss_fn)
 
 __all__ = [
     'DeviceMesh', 'make_mesh', 'local_mesh',
@@ -36,4 +38,6 @@ __all__ = [
     'ring_attention', 'blockwise_attention', 'ulysses_attention',
     'make_ring_attention', 'attention_reference',
     'PipelineStage', 'pipeline_apply', 'stack_stage_params',
+    'TransformerConfig', 'full_mesh', 'make_5d_train_step',
+    'make_5d_loss_fn',
 ]

@@ -17,8 +17,6 @@ computation over the mesh:
 - parameter buffers are donated, so weights are updated in place in
   device memory (the reference's kWriteInplace).
 """
-import functools
-
 import numpy as np
 
 import jax

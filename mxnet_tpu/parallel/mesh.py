@@ -13,8 +13,6 @@ used across this package:
 
 Any subset may be present; missing axes just mean size 1.
 """
-import collections
-
 import numpy as np
 
 import jax
@@ -86,7 +84,9 @@ def make_mesh(axes, devices=None):
     """
     # size-1 axes are kept: a topology-agnostic ShardingPlan naming 'tp'
     # must degrade to replicated on a tp=1 mesh, not crash on a missing axis
-    axes = {k: int(v) for k, v in axes.items() if int(v) >= 1} or {'dp': 1}
+    if any(int(v) < 1 for v in axes.values()):
+        raise ValueError('mesh axis sizes must be >= 1, got %s' % (axes,))
+    axes = {k: int(v) for k, v in axes.items()} or {'dp': 1}
     names = tuple(sorted(axes, key=lambda n: AXIS_ORDER.index(n) if n in AXIS_ORDER else 99))
     sizes = tuple(axes[n] for n in names)
     total = int(np.prod(sizes))

@@ -25,7 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ['ring_attention', 'blockwise_attention', 'ulysses_attention',
-           'attention_reference']
+           'make_ring_attention', 'attention_reference']
 
 _NEG = -1e30
 

@@ -11,10 +11,11 @@ platform must be forced through jax.config, which works any time before the
 first backend initialization.
 """
 import os
+import re
 
 _flags = os.environ.get('XLA_FLAGS', '')
-if '--xla_force_host_platform_device_count' not in _flags:
-    os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
+_flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '', _flags)
+os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
 os.environ['JAX_PLATFORMS'] = 'cpu'
 
 import jax  # noqa: E402

@@ -87,7 +87,6 @@ def test_data_parallel_matches_single_device():
         loss = trainer.step((jnp.asarray(X), jnp.asarray(Y)))
         pred = X @ w_ref + b_ref
         gw = 2 * X.T @ (pred - Y) / (64 * 4)
-        gb = 2 * (pred - Y).mean(0) / 4 * 1  # d/db of mean over all elems
         gb = 2 * (pred - Y).sum(0) / (64 * 4)
         w_ref -= 0.1 * gw
         b_ref -= 0.1 * gb
@@ -135,8 +134,6 @@ def test_blockwise_attention(causal):
 @pytest.mark.parametrize('impl', ['ring', 'ulysses'])
 @pytest.mark.parametrize('causal', [False, True])
 def test_ring_attention_matches_reference(impl, causal):
-    if impl == 'ulysses' and causal:
-        causal = True  # supported as well
     rng = np.random.RandomState(3)
     B, T, H, D = 2, 32, 4, 8
     q = jnp.asarray(rng.randn(B, T, H, D), jnp.float32)
