@@ -12,6 +12,7 @@ P100 batch-32 ResNet-50 training at 181.53 img/s (BASELINE.md).
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 import json
+import sys
 import time
 
 import numpy as np
@@ -85,11 +86,19 @@ def build_train_step():
     return jstep, tuple(arg_arrays), aux_arrays, vel, images, labels, key
 
 
+def _log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
 def main():
+    t = time.perf_counter()
     jstep, args, aux, vel, images, labels, key = build_train_step()
+    _log('[bench] build+init: %.1fs' % (time.perf_counter() - t))
+    t = time.perf_counter()
     for _ in range(WARMUP_STEPS):
         args, aux, vel, loss = jstep(args, aux, vel, images, labels, key)
     jax.block_until_ready(loss)
+    _log('[bench] compile+warmup: %.1fs' % (time.perf_counter() - t))
 
     t0 = time.perf_counter()
     for _ in range(BENCH_STEPS):

@@ -9,15 +9,125 @@ WaitForVar semantics, a bulk/naive mode switch (MXNET_ENGINE_TYPE), and a
 host-side work queue for genuinely stateful host tasks (IO prefetch,
 checkpoint writes) — see io.py's prefetcher for its use.
 """
+import ctypes
+import itertools
 import os
 import queue
 import threading
+import traceback
 
 import jax
 
-__all__ = ['push', 'wait_for_var', 'wait_for_all', 'engine_type', 'set_bulk_size']
+from . import _native
+
+__all__ = ['push', 'wait_for_var', 'wait_for_all', 'engine_type',
+           'set_bulk_size', 'Engine']
 
 _engine_type = os.environ.get('MXNET_ENGINE_TYPE', 'ThreadedEngine')
+
+
+class Engine:
+    """Native async dependency engine (src/engine.cc, reference
+    include/mxnet/engine.h:93-268).
+
+    Ops declare read (`const_vars`) / write (`mutable_vars`) sets over
+    opaque vars; per var, writers serialize and order against readers in
+    arrival order, and independent ops run concurrently on the worker
+    pool. This schedules host-side work (IO decode, prefetch, checkpoint
+    writes) — device compute goes through XLA.
+
+    >>> eng = Engine()
+    >>> v = eng.new_var()
+    >>> eng.push(task, mutable_vars=[v], priority=1, name='decode')
+    >>> eng.wait_for_var(v)
+    """
+
+    def __init__(self, num_workers=None):
+        lib = _native.get_lib()
+        if lib is None:
+            raise RuntimeError('native runtime unavailable '
+                               '(g++ missing or MXTPU_NO_NATIVE set)')
+        if num_workers is None:
+            num_workers = int(os.environ.get('MXNET_CPU_WORKER_NTHREADS', 4))
+        if naive():
+            num_workers = 0  # inline synchronous execution
+        self._lib = lib
+        self._h = ctypes.c_void_p()
+        _native.check_call(lib.MXTEngineCreate(num_workers,
+                                               ctypes.byref(self._h)))
+        self._cb_lock = threading.Lock()
+        self._callbacks = {}
+        self._ids = itertools.count(1)
+
+        def _run(param):
+            key = param or 0
+            with self._cb_lock:
+                fn = self._callbacks.pop(key, None)
+            if fn is None:
+                return
+            try:
+                fn()
+            except Exception:  # never propagate into the C worker
+                traceback.print_exc()
+
+        self._trampoline = _native.SYNC_FN(_run)
+        self._tramp_ptr = ctypes.cast(self._trampoline, ctypes.c_void_p)
+
+    def new_var(self):
+        v = ctypes.c_void_p()
+        _native.check_call(self._lib.MXTEngineNewVar(self._h,
+                                                     ctypes.byref(v)))
+        return v
+
+    def delete_var(self, var):
+        _native.check_call(self._lib.MXTEngineDeleteVar(self._h, var))
+
+    def push(self, fn, const_vars=(), mutable_vars=(), priority=0,
+             name='host_op'):
+        key = next(self._ids)
+        with self._cb_lock:
+            self._callbacks[key] = fn
+        cv = (ctypes.c_void_p * max(1, len(const_vars)))(*const_vars)
+        mv = (ctypes.c_void_p * max(1, len(mutable_vars)))(*mutable_vars)
+        _native.check_call(self._lib.MXTEnginePushSync(
+            self._h, self._tramp_ptr, key,
+            cv, len(const_vars), mv, len(mutable_vars),
+            priority, name.encode()))
+
+    def wait_for_var(self, var):
+        _native.check_call(self._lib.MXTEngineWaitForVar(self._h, var))
+
+    def wait_for_all(self):
+        _native.check_call(self._lib.MXTEngineWaitForAll(self._h))
+
+    def pending_ops(self):
+        n = ctypes.c_int64()
+        _native.check_call(self._lib.MXTEnginePendingOps(self._h,
+                                                         ctypes.byref(n)))
+        return n.value
+
+    def __del__(self):
+        try:
+            if getattr(self, '_h', None):
+                self._lib.MXTEngineFree(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+
+_global_engine = None
+_global_engine_lock = threading.Lock()
+
+
+def get_engine():
+    """Process-global native engine (Engine::Get(), engine.h:200);
+    None when the native runtime is unavailable."""
+    global _global_engine
+    if _global_engine is None and _native.available():
+        with _global_engine_lock:
+            if _global_engine is None:
+                _global_engine = Engine()
+    return _global_engine
 
 
 def engine_type():
@@ -69,9 +179,32 @@ class _HostWorker:
 _worker = _HostWorker()
 
 
+_host_serial_var = None
+
+
 def push(fn, sync=False):
-    """Push a host-side task; returns an Event completing when done."""
-    ev = _worker.push(fn)
+    """Push a host-side task; returns an Event completing when done.
+
+    Tasks run serialized in submission order (they may share handles —
+    checkpoint writers, prefetch state): on the native engine they all
+    write one shared var, which its scheduler serializes; the Python
+    fallback is a single worker thread."""
+    global _host_serial_var
+    eng = get_engine() if not naive() else None
+    if eng is not None:
+        if _host_serial_var is None:
+            _host_serial_var = eng.new_var()
+        ev = threading.Event()
+
+        def task():
+            try:
+                fn()
+            finally:
+                ev.set()
+
+        eng.push(task, mutable_vars=[_host_serial_var], name='host_task')
+    else:
+        ev = _worker.push(fn)
     if sync:
         ev.wait()
     return ev

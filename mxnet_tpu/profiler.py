@@ -32,6 +32,10 @@ def profiler_set_config(mode='symbolic', filename='profile.json'):
 
 def profiler_set_state(state='stop'):
     """Reference profiler.py:42. state: 'run' or 'stop'."""
+    from . import _native
+    lib = _native.get_lib()
+    if lib is not None:  # native engine-op spans (src/profiler.cc)
+        lib.MXTProfilerSetState(1 if state == 'run' else 0)
     with _lock:
         if state == 'run' and not _state['running']:
             _state['running'] = True
@@ -61,10 +65,27 @@ def record_event(name, start_us, end_us, category='operator'):
 
 
 def dump_profile():
-    """Reference profiler.py:57 — writes Chrome trace-event JSON."""
+    """Reference profiler.py:57 — writes Chrome trace-event JSON (python
+    events merged with the native engine's op spans)."""
+    # drain python events (the native dump below also drains its buffer,
+    # so repeated dumps are symmetric: each event appears exactly once)
+    events = list(_state['events'])
+    _state['events'] = []
+    from . import _native
+    lib = _native.get_lib()
+    if lib is not None:
+        import tempfile
+        with tempfile.NamedTemporaryFile('r', suffix='.json',
+                                         delete=False) as tmp:
+            path = tmp.name
+        try:
+            if lib.MXTProfilerDump(path.encode()) == 0:
+                with open(path) as f:
+                    events.extend(json.load(f).get('traceEvents', []))
+        finally:
+            os.unlink(path)
     with open(_state['filename'], 'w') as f:
-        json.dump({'traceEvents': _state['events'],
-                   'displayTimeUnit': 'ms'}, f)
+        json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
 
 
 class Profiler:

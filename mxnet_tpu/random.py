@@ -13,7 +13,9 @@ import numpy as _np
 __all__ = ['seed', 'next_key']
 
 _lock = threading.Lock()
-_key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+# lazy: creating a key initializes the jax backend, which must not happen
+# at import time (slow/fragile through the TPU tunnel)
+_key = None
 
 
 def seed(seed_state):
@@ -27,5 +29,7 @@ def next_key():
     """Split one subkey off the global stream."""
     global _key
     with _lock:
+        if _key is None:
+            _key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
         _key, sub = jax.random.split(_key)
         return sub

@@ -9,11 +9,14 @@ uint32 lrecord (upper 3 bits cflag, lower 29 bits length), payload,
 padded to 4-byte boundary. Image records carry an IRHeader
 (uint32 flag, float32 label, uint64 id, uint64 id2) before the payload.
 """
+import ctypes
 import os
 import struct
 from collections import namedtuple
 
 import numpy as np
+
+from . import _native
 
 __all__ = ['MXRecordIO', 'MXIndexedRecordIO', 'IRHeader', 'pack', 'unpack',
            'pack_img', 'unpack_img']
@@ -36,20 +39,38 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        lib = _native.get_lib()
         if self.flag == 'w':
-            self.handle = open(self.uri, 'wb')
             self.writable = True
         elif self.flag == 'r':
-            self.handle = open(self.uri, 'rb')
             self.writable = False
         else:
             raise ValueError('Invalid flag %s' % self.flag)
+        if lib is not None:
+            # native reader/writer (src/recordio.cc)
+            self._lib = lib
+            self._nh = ctypes.c_void_p()
+            create = (lib.MXTRecordIOWriterCreate if self.writable
+                      else lib.MXTRecordIOReaderCreate)
+            _native.check_call(create(self.uri.encode(),
+                                      ctypes.byref(self._nh)))
+            self.handle = None
+        else:
+            self._lib = None
+            self._nh = None
+            self.handle = open(self.uri, 'wb' if self.writable else 'rb')
         self.pid = os.getpid()
         self.is_open = True
 
     def close(self):
         if self.is_open:
-            self.handle.close()
+            if self._nh is not None:
+                free = (self._lib.MXTRecordIOWriterFree if self.writable
+                        else self._lib.MXTRecordIOReaderFree)
+                _native.check_call(free(self._nh))
+                self._nh = None
+            else:
+                self.handle.close()
             self.is_open = False
             self.pid = None
 
@@ -66,6 +87,8 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d['handle'] = None
+        d['_lib'] = None
+        d['_nh'] = None
         return d
 
     def __setstate__(self, d):
@@ -76,6 +99,10 @@ class MXRecordIO:
 
     def write(self, buf):
         assert self.writable
+        if self._nh is not None:
+            _native.check_call(self._lib.MXTRecordIOWriterWrite(
+                self._nh, bytes(buf), len(buf)))
+            return
         length = len(buf)
         self.handle.write(struct.pack('<II', _kMagic, length & 0x1fffffff))
         self.handle.write(buf)
@@ -85,6 +112,14 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
+        if self._nh is not None:
+            out = ctypes.c_void_p()
+            ln = ctypes.c_size_t()
+            _native.check_call(self._lib.MXTRecordIOReaderNext(
+                self._nh, ctypes.byref(out), ctypes.byref(ln)))
+            if ln.value == ctypes.c_size_t(-1).value:
+                return None
+            return ctypes.string_at(out, ln.value) if ln.value else b''
         head = self.handle.read(8)
         if len(head) < 8:
             return None
@@ -99,7 +134,20 @@ class MXRecordIO:
         return buf
 
     def tell(self):
+        if self._nh is not None:
+            out = ctypes.c_size_t()
+            fn = (self._lib.MXTRecordIOWriterTell if self.writable
+                  else self._lib.MXTRecordIOReaderTell)
+            _native.check_call(fn(self._nh, ctypes.byref(out)))
+            return out.value
         return self.handle.tell()
+
+    def seek_pos(self, pos):
+        assert not self.writable
+        if self._nh is not None:
+            _native.check_call(self._lib.MXTRecordIOReaderSeek(self._nh, pos))
+        else:
+            self.handle.seek(pos)
 
 
 class MXIndexedRecordIO(MXRecordIO):
@@ -134,7 +182,7 @@ class MXIndexedRecordIO(MXRecordIO):
 
     def seek(self, idx):
         assert not self.writable
-        self.handle.seek(self.idx[idx])
+        self.seek_pos(self.idx[idx])
 
     def read_idx(self, idx):
         self.seek(idx)

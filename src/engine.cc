@@ -1,0 +1,420 @@
+/*
+ * engine.cc — async read/write-set dependency scheduler.
+ *
+ * TPU-native rebuild of src/engine/threaded_engine.{h,cc} +
+ * threaded_engine_perdevice.cc. The reference schedules *all* compute
+ * through this structure; here XLA owns device scheduling, so the
+ * engine's job is host-side async work (IO decode/prefetch, checkpoint
+ * writes, KVStore host ops) with the same semantics:
+ *
+ * - ops declare const_vars (reads) and mutable_vars (writes)
+ *   (reference engine.h:93-268 PushAsync);
+ * - per var, writers are serialized and ordered against readers in
+ *   arrival order (reference threaded_engine.h:111-213 ThreadedVar's
+ *   VersionedVarBlock list);
+ * - ops become ready when every var grants access (OprBlock wait
+ *   counter, threaded_engine.h:62-89), then run on a worker pool
+ *   ordered by (-priority, fifo seq) — the reference's priority queue
+ *   (kvstore pushes grads with priority=-index so front layers sync
+ *   first, kvstore.py:139);
+ * - WaitForVar pushes a read op that signals (threaded_engine.cc:332);
+ * - MXTPU_ENGINE_WORKERS<=0 or num_workers==0 degrades to synchronous
+ *   execution (the reference's NaiveEngine, engine.cc:32-48).
+ */
+#include <algorithm>
+#include <atomic>
+#include <condition_variable>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <queue>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "mxtpu.h"
+
+namespace mxtpu {
+
+void ProfilerRecordOpr(const std::string &name, int64_t start_us,
+                       int64_t end_us, int thread_id);
+bool ProfilerRunning();
+int64_t NowUS();
+
+namespace engine {
+
+struct Opr;
+
+// Per-variable dependency queue (reference ThreadedVar).
+struct Var {
+  std::mutex mu;
+  // pending ops in arrival order; .second = is_write
+  std::deque<std::pair<Opr *, bool>> pending;
+  int running_reads = 0;
+  bool running_write = false;
+  bool to_delete = false;  // set by the scheduled delete op
+};
+
+struct Opr {
+  std::function<void(CompletionHandle)> fn;  // calls complete itself if async
+  std::vector<Var *> reads;
+  std::vector<Var *> writes;
+  std::atomic<int> wait{0};
+  int priority = 0;
+  bool async = false;
+  std::string name;
+  class Engine *engine = nullptr;
+};
+
+class Engine {
+ public:
+  explicit Engine(int num_workers) {
+    if (num_workers < 0) num_workers = 0;
+    num_workers_ = num_workers;
+    for (int i = 0; i < num_workers; ++i) {
+      workers_.emplace_back([this, i] { WorkerLoop(i); });
+    }
+  }
+
+  ~Engine() {
+    WaitForAll();
+    {
+      std::lock_guard<std::mutex> lk(qmu_);
+      shutdown_ = true;
+    }
+    qcv_.notify_all();
+    for (auto &t : workers_) t.join();
+  }
+
+  Var *NewVar() { return new Var(); }
+
+  void Push(std::function<void(CompletionHandle)> fn,
+            const std::vector<Var *> &reads,
+            const std::vector<Var *> &writes, int priority, bool async,
+            const char *name) {
+    auto *opr = new Opr();
+    opr->fn = std::move(fn);
+    opr->engine = this;
+    // dedupe and drop reads that are also writes (reference engine.h
+    // :249-267 deduplication helper; duplicate vars would deadlock the
+    // grant accounting)
+    std::set<Var *> wset(writes.begin(), writes.end());
+    std::set<Var *> rset;
+    for (Var *v : reads)
+      if (!wset.count(v)) rset.insert(v);
+    opr->reads.assign(rset.begin(), rset.end());
+    opr->writes.assign(wset.begin(), wset.end());
+    opr->priority = priority;
+    opr->async = async;
+    if (name) opr->name = name;
+    pending_.fetch_add(1, std::memory_order_relaxed);
+
+    int deps = static_cast<int>(opr->reads.size() + opr->writes.size());
+    opr->wait.store(deps + 1, std::memory_order_relaxed);  // +1 = push guard
+    for (Var *v : opr->reads) RequestAccess(opr, v, false);
+    for (Var *v : opr->writes) RequestAccess(opr, v, true);
+    // release push guard; if all vars granted already, schedule now
+    if (opr->wait.fetch_sub(1, std::memory_order_acq_rel) == 1) Schedule(opr);
+  }
+
+  void DeleteVar(Var *var) {
+    // mark first, then schedule a write op; the var is freed when its
+    // final access (this op or a later-granted one) releases
+    {
+      std::lock_guard<std::mutex> lk(var->mu);
+      var->to_delete = true;
+    }
+    Push([](CompletionHandle) {}, {}, {var}, 0, false, "DeleteVariable");
+  }
+
+  void WaitForVar(Var *var) {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    Push(
+        [&](CompletionHandle) {
+          std::lock_guard<std::mutex> lk(mu);
+          done = true;
+          cv.notify_all();
+        },
+        {var}, {}, 0x7fffffff, false, "WaitForVar");
+    std::unique_lock<std::mutex> lk(mu);
+    cv.wait(lk, [&] { return done; });
+  }
+
+  void WaitForAll() {
+    std::unique_lock<std::mutex> lk(finish_mu_);
+    finish_cv_.wait(lk, [this] {
+      return pending_.load(std::memory_order_acquire) == 0;
+    });
+  }
+
+  int64_t PendingOps() { return pending_.load(std::memory_order_acquire); }
+
+  void OnComplete(Opr *opr) {
+    for (Var *v : opr->reads) ReleaseRead(v);
+    for (Var *v : opr->writes) ReleaseWrite(v);
+    delete opr;
+    if (pending_.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+      std::lock_guard<std::mutex> lk(finish_mu_);
+      finish_cv_.notify_all();
+    }
+  }
+
+ private:
+  void RequestAccess(Opr *opr, Var *v, bool write) {
+    bool granted = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      if (write) {
+        if (!v->running_write && v->running_reads == 0 &&
+            v->pending.empty()) {
+          v->running_write = true;
+          granted = true;
+        } else {
+          v->pending.emplace_back(opr, true);
+        }
+      } else {
+        if (!v->running_write && v->pending.empty()) {
+          ++v->running_reads;
+          granted = true;
+        } else {
+          v->pending.emplace_back(opr, false);
+        }
+      }
+    }
+    if (granted) Grant(opr);
+  }
+
+  void Grant(Opr *opr) {
+    if (opr->wait.fetch_sub(1, std::memory_order_acq_rel) == 1)
+      Schedule(opr);
+  }
+
+  void ReleaseRead(Var *v) {
+    std::vector<Opr *> to_grant;
+    bool del = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      --v->running_reads;
+      DrainLocked(v, &to_grant);
+      del = Deletable(v);
+    }
+    for (Opr *o : to_grant) Grant(o);
+    if (del) delete v;
+  }
+
+  void ReleaseWrite(Var *v) {
+    std::vector<Opr *> to_grant;
+    bool del = false;
+    {
+      std::lock_guard<std::mutex> lk(v->mu);
+      v->running_write = false;
+      DrainLocked(v, &to_grant);
+      del = Deletable(v);
+    }
+    for (Opr *o : to_grant) Grant(o);
+    if (del) delete v;
+  }
+
+  static bool Deletable(Var *v) {
+    return v->to_delete && v->pending.empty() && v->running_reads == 0 &&
+           !v->running_write;
+  }
+
+  // grant from the front of the queue: a run of readers, or one writer
+  static void DrainLocked(Var *v, std::vector<Opr *> *out) {
+    while (!v->pending.empty()) {
+      auto [opr, is_write] = v->pending.front();
+      if (is_write) {
+        if (v->running_reads == 0 && !v->running_write) {
+          v->running_write = true;
+          v->pending.pop_front();
+          out->push_back(opr);
+        }
+        break;  // writer blocks everything behind it
+      }
+      if (v->running_write) break;
+      ++v->running_reads;
+      v->pending.pop_front();
+      out->push_back(opr);
+    }
+  }
+
+  void Schedule(Opr *opr) {
+    if (num_workers_ == 0) {  // NaiveEngine: run inline
+      Execute(opr, -1);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(qmu_);
+      ready_.push(Entry{opr, seq_++});
+    }
+    qcv_.notify_one();
+  }
+
+  struct Entry {
+    Opr *opr;
+    uint64_t seq;
+    bool operator<(const Entry &o) const {
+      if (opr->priority != o.opr->priority)
+        return opr->priority < o.opr->priority;  // max-heap on priority
+      return seq > o.seq;                        // FIFO within priority
+    }
+  };
+
+  void Execute(Opr *opr, int thread_id) {
+    bool prof = ProfilerRunning();
+    int64_t t0 = prof ? NowUS() : 0;
+    std::string name = prof ? opr->name : std::string();
+    if (opr->async) {
+      // fn may call MXTEngineOprComplete inline, freeing opr — no
+      // member access after this call; the recorded span is submit time
+      opr->fn(reinterpret_cast<CompletionHandle>(opr));
+      if (prof) ProfilerRecordOpr(name, t0, NowUS(), thread_id);
+    } else {
+      opr->fn(nullptr);
+      if (prof) ProfilerRecordOpr(name, t0, NowUS(), thread_id);
+      OnComplete(opr);
+    }
+  }
+
+  void WorkerLoop(int tid) {
+    for (;;) {
+      Opr *opr = nullptr;
+      {
+        std::unique_lock<std::mutex> lk(qmu_);
+        qcv_.wait(lk, [this] { return shutdown_ || !ready_.empty(); });
+        if (shutdown_ && ready_.empty()) return;
+        opr = ready_.top().opr;
+        ready_.pop();
+      }
+      Execute(opr, tid);
+    }
+  }
+
+  int num_workers_;
+  std::vector<std::thread> workers_;
+  std::mutex qmu_;
+  std::condition_variable qcv_;
+  std::priority_queue<Entry> ready_;
+  uint64_t seq_ = 0;
+  bool shutdown_ = false;
+  std::atomic<int64_t> pending_{0};
+  std::mutex finish_mu_;
+  std::condition_variable finish_cv_;
+};
+
+}  // namespace engine
+}  // namespace mxtpu
+
+/* ---------------- C API ---------------- */
+
+namespace {
+thread_local std::string g_last_error;
+}  // namespace
+
+void MXTSetLastError(const char *msg) { g_last_error = msg ? msg : ""; }
+
+extern "C" const char *MXTGetLastError() { return g_last_error.c_str(); }
+
+#define API_BEGIN() try {
+#define API_END()                        \
+  }                                      \
+  catch (const std::exception &e) {      \
+    g_last_error = e.what();             \
+    return -1;                           \
+  }                                      \
+  catch (...) {                          \
+    g_last_error = "unknown native error"; \
+    return -1;                           \
+  }                                      \
+  return 0;
+
+using mxtpu::engine::Engine;
+using mxtpu::engine::Opr;
+using mxtpu::engine::Var;
+
+extern "C" int MXTEngineCreate(int num_workers, EngineHandle *out) {
+  API_BEGIN();
+  *out = new Engine(num_workers);
+  API_END();
+}
+
+extern "C" int MXTEngineFree(EngineHandle h) {
+  API_BEGIN();
+  delete static_cast<Engine *>(h);
+  API_END();
+}
+
+extern "C" int MXTEngineNewVar(EngineHandle h, VarHandle *out) {
+  API_BEGIN();
+  *out = static_cast<Engine *>(h)->NewVar();
+  API_END();
+}
+
+extern "C" int MXTEngineDeleteVar(EngineHandle h, VarHandle var) {
+  API_BEGIN();
+  static_cast<Engine *>(h)->DeleteVar(static_cast<Var *>(var));
+  API_END();
+}
+
+static int PushImpl(EngineHandle h, std::function<void(CompletionHandle)> fn,
+                    VarHandle *const_vars, int num_const,
+                    VarHandle *mutable_vars, int num_mutable, int priority,
+                    const char *name, bool async) {
+  API_BEGIN();
+  std::vector<Var *> reads, writes;
+  for (int i = 0; i < num_const; ++i)
+    reads.push_back(static_cast<Var *>(const_vars[i]));
+  for (int i = 0; i < num_mutable; ++i)
+    writes.push_back(static_cast<Var *>(mutable_vars[i]));
+  static_cast<Engine *>(h)->Push(std::move(fn), reads, writes, priority,
+                                 async, name);
+  API_END();
+}
+
+extern "C" int MXTEnginePushSync(EngineHandle h, MXTSyncFn fn, void *param,
+                                 VarHandle *const_vars, int num_const,
+                                 VarHandle *mutable_vars, int num_mutable,
+                                 int priority, const char *opr_name) {
+  return PushImpl(
+      h, [fn, param](CompletionHandle) { fn(param); }, const_vars, num_const,
+      mutable_vars, num_mutable, priority, opr_name, false);
+}
+
+extern "C" int MXTEnginePushAsync(EngineHandle h, MXTAsyncFn fn, void *param,
+                                  VarHandle *const_vars, int num_const,
+                                  VarHandle *mutable_vars, int num_mutable,
+                                  int priority, const char *opr_name) {
+  return PushImpl(
+      h, [fn, param](CompletionHandle c) { fn(param, c); }, const_vars,
+      num_const, mutable_vars, num_mutable, priority, opr_name, true);
+}
+
+extern "C" int MXTEngineOprComplete(CompletionHandle token) {
+  API_BEGIN();
+  Opr *opr = static_cast<Opr *>(token);
+  opr->engine->OnComplete(opr);
+  API_END();
+}
+
+extern "C" int MXTEngineWaitForVar(EngineHandle h, VarHandle var) {
+  API_BEGIN();
+  static_cast<Engine *>(h)->WaitForVar(static_cast<Var *>(var));
+  API_END();
+}
+
+extern "C" int MXTEngineWaitForAll(EngineHandle h) {
+  API_BEGIN();
+  static_cast<Engine *>(h)->WaitForAll();
+  API_END();
+}
+
+extern "C" int MXTEnginePendingOps(EngineHandle h, int64_t *out) {
+  API_BEGIN();
+  *out = static_cast<Engine *>(h)->PendingOps();
+  API_END();
+}

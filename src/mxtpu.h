@@ -1,0 +1,93 @@
+/*
+ * mxtpu.h — C ABI of the TPU-native runtime library.
+ *
+ * The TPU-native counterpart of include/mxnet/c_api.h (reference: 146
+ * MXNET_DLL functions, opaque handles, int return codes, thread-local
+ * MXGetLastError). Device compute goes through XLA from Python; this
+ * native layer owns what the reference keeps native around its device
+ * kernels: the dependency engine (include/mxnet/engine.h:93-268), the
+ * pooled storage manager (include/mxnet/storage.h), the RecordIO packed
+ * stream (dmlc-core recordio, python/mxnet/recordio.py framing), and the
+ * chrome-trace profiler (src/engine/profiler.h).
+ */
+#ifndef MXTPU_H_
+#define MXTPU_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *EngineHandle;
+typedef void *VarHandle;
+typedef void *CompletionHandle;
+typedef void *RecordIOHandle;
+
+/* Every call returns 0 on success, -1 on failure (message via
+ * MXTGetLastError — reference c_api_error.cc). */
+const char *MXTGetLastError();
+
+/* ---- Engine: async read/write-set dependency scheduler (ref N1) ---- */
+/* fn runs on a worker thread. Sync ops complete on return; async ops
+ * receive a completion handle and must call MXTEngineOprComplete. */
+typedef void (*MXTSyncFn)(void *param);
+typedef void (*MXTAsyncFn)(void *param, CompletionHandle on_complete);
+
+int MXTEngineCreate(int num_workers, EngineHandle *out);
+int MXTEngineFree(EngineHandle h);
+int MXTEngineNewVar(EngineHandle h, VarHandle *out);
+/* Delete is itself scheduled as a write op (reference engine.h
+ * DeleteVariable: "delete after all pending ops complete"). */
+int MXTEngineDeleteVar(EngineHandle h, VarHandle var);
+int MXTEnginePushSync(EngineHandle h, MXTSyncFn fn, void *param,
+                      VarHandle *const_vars, int num_const,
+                      VarHandle *mutable_vars, int num_mutable,
+                      int priority, const char *opr_name);
+int MXTEnginePushAsync(EngineHandle h, MXTAsyncFn fn, void *param,
+                       VarHandle *const_vars, int num_const,
+                       VarHandle *mutable_vars, int num_mutable,
+                       int priority, const char *opr_name);
+int MXTEngineOprComplete(CompletionHandle token);
+int MXTEngineWaitForVar(EngineHandle h, VarHandle var);
+int MXTEngineWaitForAll(EngineHandle h);
+/* pending op count (for tests / shutdown diagnostics) */
+int MXTEnginePendingOps(EngineHandle h, int64_t *out);
+
+/* ---- Storage: pooled, aligned host allocator (ref N2) ---- */
+int MXTStorageAlloc(size_t nbytes, void **out);
+int MXTStorageFree(void *ptr);           /* returns block to the pool */
+int MXTStorageDirectFree(void *ptr);     /* bypasses the pool */
+int MXTStorageReleaseAll();              /* drop all pooled blocks */
+/* stats: [0] bytes live, [1] bytes pooled, [2] alloc calls,
+ * [3] pool hits */
+int MXTStorageStats(int64_t stats[4]);
+
+/* ---- RecordIO: dmlc framed record stream (ref N12) ---- */
+int MXTRecordIOWriterCreate(const char *path, RecordIOHandle *out);
+int MXTRecordIOWriterWrite(RecordIOHandle h, const char *buf, size_t len);
+int MXTRecordIOWriterTell(RecordIOHandle h, size_t *out);
+int MXTRecordIOWriterFree(RecordIOHandle h);
+int MXTRecordIOReaderCreate(const char *path, RecordIOHandle *out);
+/* *out points into an internal buffer valid until the next call. Sets
+ * *len = SIZE_MAX (i.e. (size_t)-1) at end of stream. */
+int MXTRecordIOReaderNext(RecordIOHandle h, const char **out, size_t *len);
+int MXTRecordIOReaderSeek(RecordIOHandle h, size_t pos);
+int MXTRecordIOReaderTell(RecordIOHandle h, size_t *out);
+int MXTRecordIOReaderFree(RecordIOHandle h);
+
+/* ---- Profiler: chrome trace-event JSON (ref N16) ---- */
+int MXTProfilerSetState(int running);
+/* records engine op execution spans when running; explicit events may
+ * be added from any thread */
+int MXTProfilerAddEvent(const char *name, const char *category,
+                        int64_t start_us, int64_t end_us);
+int MXTProfilerDump(const char *path);
+int64_t MXTNowUS();
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXTPU_H_ */

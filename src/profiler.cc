@@ -1,0 +1,154 @@
+/*
+ * profiler.cc — chrome trace-event profiler.
+ *
+ * TPU-native rebuild of src/engine/profiler.{h,cc}: the reference
+ * records OprExecStat (name, start/end µs, thread, device) inside
+ * ThreadedEngine::ExecuteOprBlock and dumps chrome://tracing JSON
+ * (profiler.h:106-127 DumpProfile/EmitEvent). Here the engine records
+ * host-op spans the same way; device-side tracing belongs to the JAX/XLA
+ * profiler, and the python layer (mxnet_tpu/profiler.py) merges both
+ * streams into one trace file.
+ */
+#include <atomic>
+#include <chrono>
+#include <cstdio>
+#include <mutex>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu.h"
+
+namespace mxtpu {
+
+int64_t NowUS() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+namespace profiler {
+
+struct Event {
+  std::string name;
+  std::string category;
+  int64_t start_us;
+  int64_t end_us;
+  int thread_id;
+};
+
+class Profiler {
+ public:
+  static Profiler *Get() {
+    static Profiler inst;
+    return &inst;
+  }
+
+  void SetState(bool running) { running_.store(running); }
+  bool Running() const { return running_.load(std::memory_order_relaxed); }
+
+  void Add(Event e) {
+    std::lock_guard<std::mutex> lk(mu_);
+    events_.push_back(std::move(e));
+  }
+
+  static std::string JsonEscape(const std::string &s) {
+    std::string out;
+    out.reserve(s.size());
+    for (char c : s) {
+      switch (c) {
+        case '"': out += "\\\""; break;
+        case '\\': out += "\\\\"; break;
+        case '\n': out += "\\n"; break;
+        case '\t': out += "\\t"; break;
+        case '\r': out += "\\r"; break;
+        default:
+          if (static_cast<unsigned char>(c) < 0x20) {
+            char buf[8];
+            std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+            out += buf;
+          } else {
+            out += c;
+          }
+      }
+    }
+    return out;
+  }
+
+  void Dump(const char *path) {
+    std::vector<Event> events;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      events.swap(events_);
+    }
+    FILE *fp = std::fopen(path, "w");
+    if (!fp) throw std::runtime_error(std::string("cannot open ") + path);
+    std::fprintf(fp, "{\n\"traceEvents\": [\n");
+    bool first = true;
+    for (const auto &e : events) {
+      if (!first) std::fprintf(fp, ",\n");
+      first = false;
+      std::fprintf(fp,
+                   "{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"X\","
+                   "\"ts\":%lld,\"dur\":%lld,\"pid\":0,\"tid\":%d}",
+                   JsonEscape(e.name).c_str(),
+                   JsonEscape(e.category).c_str(),
+                   static_cast<long long>(e.start_us),
+                   static_cast<long long>(e.end_us - e.start_us),
+                   e.thread_id);
+    }
+    std::fprintf(fp, "\n],\n\"displayTimeUnit\": \"ms\"\n}\n");
+    std::fclose(fp);
+  }
+
+ private:
+  std::atomic<bool> running_{false};
+  std::mutex mu_;
+  std::vector<Event> events_;
+};
+
+}  // namespace profiler
+
+bool ProfilerRunning() { return profiler::Profiler::Get()->Running(); }
+
+void ProfilerRecordOpr(const std::string &name, int64_t start_us,
+                       int64_t end_us, int thread_id) {
+  profiler::Profiler::Get()->Add(
+      {name.empty() ? "op" : name, "operator", start_us, end_us, thread_id});
+}
+
+}  // namespace mxtpu
+
+void MXTSetLastError(const char *msg);
+
+#define API_BEGIN() try {
+#define API_END()                  \
+  }                                \
+  catch (const std::exception &e) { \
+    MXTSetLastError(e.what());     \
+    return -1;                     \
+  }                                \
+  return 0;
+
+extern "C" int MXTProfilerSetState(int running) {
+  API_BEGIN();
+  mxtpu::profiler::Profiler::Get()->SetState(running != 0);
+  API_END();
+}
+
+extern "C" int MXTProfilerAddEvent(const char *name, const char *category,
+                                   int64_t start_us, int64_t end_us) {
+  API_BEGIN();
+  mxtpu::profiler::Profiler::Get()->Add(
+      {name ? name : "event", category ? category : "misc", start_us, end_us,
+       0});
+  API_END();
+}
+
+extern "C" int MXTProfilerDump(const char *path) {
+  API_BEGIN();
+  mxtpu::profiler::Profiler::Get()->Dump(path);
+  API_END();
+}
+
+extern "C" int64_t MXTNowUS() { return mxtpu::NowUS(); }

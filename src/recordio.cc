@@ -1,0 +1,163 @@
+/*
+ * recordio.cc — dmlc RecordIO framed stream, byte-compatible with
+ * python/mxnet/recordio.py (and mxnet_tpu/recordio.py):
+ *   uint32 magic 0xced7230a, uint32 lrecord (upper 3 bits cflag, lower
+ *   29 bits length), payload, zero-padded to a 4-byte boundary.
+ * Reference: dmlc-core recordio consumed by src/io/iter_image_recordio*.cc;
+ * this native reader is what the threaded data pipeline iterates.
+ */
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "mxtpu.h"
+
+namespace mxtpu {
+namespace recordio {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+class Writer {
+ public:
+  explicit Writer(const char *path) : fp_(std::fopen(path, "wb")) {
+    if (!fp_) throw std::runtime_error(std::string("cannot open ") + path);
+  }
+  ~Writer() {
+    if (fp_) std::fclose(fp_);
+  }
+
+  void Write(const char *buf, size_t len) {
+    if (len >= (1u << 29))
+      throw std::runtime_error("record too large (>= 2^29 bytes)");
+    uint32_t head[2] = {kMagic, static_cast<uint32_t>(len) & 0x1fffffffu};
+    if (std::fwrite(head, 4, 2, fp_) != 2)
+      throw std::runtime_error("recordio write failed");
+    if (len && std::fwrite(buf, 1, len, fp_) != len)
+      throw std::runtime_error("recordio write failed");
+    static const char zeros[4] = {0, 0, 0, 0};
+    size_t pad = (4 - len % 4) % 4;
+    if (pad && std::fwrite(zeros, 1, pad, fp_) != pad)
+      throw std::runtime_error("recordio write failed");
+  }
+
+  size_t Tell() { return static_cast<size_t>(std::ftell(fp_)); }
+
+ private:
+  FILE *fp_;
+};
+
+class Reader {
+ public:
+  explicit Reader(const char *path) : fp_(std::fopen(path, "rb")) {
+    if (!fp_) throw std::runtime_error(std::string("cannot open ") + path);
+  }
+  ~Reader() {
+    if (fp_) std::fclose(fp_);
+  }
+
+  // returns false at clean EOF — including a truncated (<8 byte) tail
+  // from a killed writer, matching the python fallback's len(head)<8
+  // check; throws only on a corrupt magic in a full header
+  bool Next(const char **out, size_t *len) {
+    uint32_t head[2];
+    size_t got = std::fread(head, 4, 2, fp_);
+    if (got < 2) return false;
+    if (head[0] != kMagic)
+      throw std::runtime_error("invalid RecordIO magic");
+    size_t n = head[1] & 0x1fffffffu;
+    buf_.resize(n);
+    if (n && std::fread(buf_.data(), 1, n, fp_) != n)
+      throw std::runtime_error("truncated RecordIO record");
+    size_t pad = (4 - n % 4) % 4;
+    if (pad) std::fseek(fp_, static_cast<long>(pad), SEEK_CUR);
+    *out = buf_.data();
+    *len = n;
+    return true;
+  }
+
+  void Seek(size_t pos) { std::fseek(fp_, static_cast<long>(pos), SEEK_SET); }
+  size_t Tell() { return static_cast<size_t>(std::ftell(fp_)); }
+
+ private:
+  FILE *fp_;
+  std::vector<char> buf_;
+};
+
+}  // namespace recordio
+}  // namespace mxtpu
+
+void MXTSetLastError(const char *msg);
+
+#define API_BEGIN() try {
+#define API_END()                  \
+  }                                \
+  catch (const std::exception &e) { \
+    MXTSetLastError(e.what());     \
+    return -1;                     \
+  }                                \
+  return 0;
+
+using mxtpu::recordio::Reader;
+using mxtpu::recordio::Writer;
+
+extern "C" int MXTRecordIOWriterCreate(const char *path, RecordIOHandle *out) {
+  API_BEGIN();
+  *out = new Writer(path);
+  API_END();
+}
+
+extern "C" int MXTRecordIOWriterWrite(RecordIOHandle h, const char *buf,
+                                      size_t len) {
+  API_BEGIN();
+  static_cast<Writer *>(h)->Write(buf, len);
+  API_END();
+}
+
+extern "C" int MXTRecordIOWriterTell(RecordIOHandle h, size_t *out) {
+  API_BEGIN();
+  *out = static_cast<Writer *>(h)->Tell();
+  API_END();
+}
+
+extern "C" int MXTRecordIOWriterFree(RecordIOHandle h) {
+  API_BEGIN();
+  delete static_cast<Writer *>(h);
+  API_END();
+}
+
+extern "C" int MXTRecordIOReaderCreate(const char *path, RecordIOHandle *out) {
+  API_BEGIN();
+  *out = new Reader(path);
+  API_END();
+}
+
+extern "C" int MXTRecordIOReaderNext(RecordIOHandle h, const char **out,
+                                     size_t *len) {
+  API_BEGIN();
+  if (!static_cast<Reader *>(h)->Next(out, len)) {
+    *out = nullptr;
+    *len = static_cast<size_t>(-1);
+  }
+  API_END();
+}
+
+extern "C" int MXTRecordIOReaderSeek(RecordIOHandle h, size_t pos) {
+  API_BEGIN();
+  static_cast<Reader *>(h)->Seek(pos);
+  API_END();
+}
+
+extern "C" int MXTRecordIOReaderTell(RecordIOHandle h, size_t *out) {
+  API_BEGIN();
+  *out = static_cast<Reader *>(h)->Tell();
+  API_END();
+}
+
+extern "C" int MXTRecordIOReaderFree(RecordIOHandle h) {
+  API_BEGIN();
+  delete static_cast<Reader *>(h);
+  API_END();
+}

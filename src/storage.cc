@@ -1,0 +1,159 @@
+/*
+ * storage.cc — pooled, aligned host storage manager.
+ *
+ * TPU-native rebuild of src/storage/storage.cc + pooled_storage_manager.h
+ * (reference GPUPooledStorageManager: size-bucketed free lists so repeated
+ * alloc/free of the same shapes never hits the system allocator). On TPU
+ * the device pool belongs to the XLA runtime; this manager serves the
+ * host side: staging buffers for IO decode, RecordIO batch assembly, and
+ * pinned-style scratch for host<->device transfers.
+ */
+#include <cstdint>
+#include <cstdlib>
+#include <mutex>
+#include <new>
+#include <stdexcept>
+#include <unordered_map>
+#include <vector>
+
+#include "mxtpu.h"
+
+namespace mxtpu {
+namespace storage {
+
+constexpr size_t kAlign = 64;  // cache line; also good for dma staging
+
+class PooledStorage {
+ public:
+  static PooledStorage *Get() {
+    static PooledStorage inst;
+    return &inst;
+  }
+
+  void *Alloc(size_t nbytes) {
+    size_t bucket = RoundUp(nbytes);
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      ++alloc_calls_;
+      auto it = pool_.find(bucket);
+      if (it != pool_.end() && !it->second.empty()) {
+        void *p = it->second.back();
+        it->second.pop_back();
+        pooled_bytes_ -= bucket;
+        live_bytes_ += bucket;
+        ++pool_hits_;
+        size_of_[p] = bucket;
+        return p;
+      }
+    }
+    void *p = ::aligned_alloc(kAlign, bucket);
+    if (!p) throw std::bad_alloc();
+    std::lock_guard<std::mutex> lk(mu_);
+    live_bytes_ += bucket;
+    size_of_[p] = bucket;
+    return p;
+  }
+
+  void Free(void *p) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = size_of_.find(p);
+    if (it == size_of_.end())
+      throw std::runtime_error("MXTStorageFree: unknown pointer");
+    size_t bucket = it->second;
+    size_of_.erase(it);
+    live_bytes_ -= bucket;
+    pooled_bytes_ += bucket;
+    pool_[bucket].push_back(p);
+  }
+
+  void DirectFree(void *p) {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      auto it = size_of_.find(p);
+      if (it == size_of_.end())
+        throw std::runtime_error("MXTStorageDirectFree: unknown pointer");
+      live_bytes_ -= it->second;
+      size_of_.erase(it);
+    }
+    ::free(p);
+  }
+
+  void ReleaseAll() {
+    std::lock_guard<std::mutex> lk(mu_);
+    for (auto &kv : pool_)
+      for (void *p : kv.second) ::free(p);
+    pool_.clear();
+    pooled_bytes_ = 0;
+  }
+
+  void Stats(int64_t out[4]) {
+    std::lock_guard<std::mutex> lk(mu_);
+    out[0] = static_cast<int64_t>(live_bytes_);
+    out[1] = static_cast<int64_t>(pooled_bytes_);
+    out[2] = alloc_calls_;
+    out[3] = pool_hits_;
+  }
+
+ private:
+  // next power of two, min 256B — same shape-bucketing idea as the
+  // reference's pool (pooled_storage_manager.h:46)
+  static size_t RoundUp(size_t n) {
+    size_t b = 256;
+    while (b < n) b <<= 1;
+    return b;
+  }
+
+  std::mutex mu_;
+  std::unordered_map<size_t, std::vector<void *>> pool_;
+  std::unordered_map<void *, size_t> size_of_;
+  size_t live_bytes_ = 0;
+  size_t pooled_bytes_ = 0;
+  int64_t alloc_calls_ = 0;
+  int64_t pool_hits_ = 0;
+};
+
+}  // namespace storage
+}  // namespace mxtpu
+
+void MXTSetLastError(const char *msg);
+
+#define API_BEGIN() try {
+#define API_END()                  \
+  }                                \
+  catch (const std::exception &e) { \
+    MXTSetLastError(e.what());     \
+    return -1;                     \
+  }                                \
+  return 0;
+
+using mxtpu::storage::PooledStorage;
+
+extern "C" int MXTStorageAlloc(size_t nbytes, void **out) {
+  API_BEGIN();
+  *out = PooledStorage::Get()->Alloc(nbytes);
+  API_END();
+}
+
+extern "C" int MXTStorageFree(void *ptr) {
+  API_BEGIN();
+  PooledStorage::Get()->Free(ptr);
+  API_END();
+}
+
+extern "C" int MXTStorageDirectFree(void *ptr) {
+  API_BEGIN();
+  PooledStorage::Get()->DirectFree(ptr);
+  API_END();
+}
+
+extern "C" int MXTStorageReleaseAll() {
+  API_BEGIN();
+  PooledStorage::Get()->ReleaseAll();
+  API_END();
+}
+
+extern "C" int MXTStorageStats(int64_t stats[4]) {
+  API_BEGIN();
+  PooledStorage::Get()->Stats(stats);
+  API_END();
+}
