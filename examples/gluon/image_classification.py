@@ -1,0 +1,80 @@
+"""Gluon imperative/hybrid training — BASELINE config #3.
+
+Mirrors example/gluon/image_classification.py in the reference: a
+model_zoo network (ResNet-v2 et al), `hybridize()` to compile the whole
+forward+backward to one XLA computation, gluon Trainer + autograd.
+Synthetic dataset keeps the run hermetic.
+
+    python image_classification.py --model resnet18_v2 --epochs 2
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='resnet18_v2')
+    parser.add_argument('--epochs', type=int, default=2)
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--image-size', type=int, default=32)
+    parser.add_argument('--classes', type=int, default=10)
+    parser.add_argument('--samples', type=int, default=512)
+    parser.add_argument('--lr', type=float, default=0.05)
+    parser.add_argument('--no-hybridize', action='store_true')
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    # synthetic, class-separable image set
+    rng = np.random.RandomState(0)
+    protos = rng.rand(args.classes, 3, args.image_size, args.image_size)
+    labels = rng.randint(0, args.classes, args.samples)
+    images = (protos[labels] +
+              0.2 * rng.randn(args.samples, 3, args.image_size,
+                              args.image_size)).astype('float32')
+    data = mx.io.NDArrayIter(images, labels.astype('float32'),
+                             batch_size=args.batch_size, shuffle=True)
+
+    net = vision.get_model(args.model, classes=args.classes)
+    net.initialize(mx.init.Xavier(magnitude=2))
+    if not args.no_hybridize:
+        net.hybridize()
+
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': args.lr, 'momentum': 0.9,
+                             'wd': 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        data.reset()
+        metric.reset()
+        tic = time.time()
+        n = 0
+        for batch in data:
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            n += args.batch_size
+        name, acc = metric.get()
+        logging.info('epoch %d: %s=%.4f (%.1f samples/s)', epoch, name, acc,
+                     n / (time.time() - tic))
+    return metric.get()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,53 @@
+"""Inference scoring throughput (images/sec) — the reference's
+`benchmark_score.py` (docs/how_to/perf.md:115-146 table).
+
+Scores model_zoo networks at several batch sizes on synthetic data with
+the hybridized (fully compiled) forward.
+
+    python benchmark_score.py --model resnet50_v1 --batch-sizes 1,32
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def score(model, batch_size, image_size=224, repeats=20):
+    net = vision.get_model(model, classes=1000)
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = nd.array(np.random.standard_normal(
+        (batch_size, 3, image_size, image_size)).astype('float32'))
+    out = net(x)
+    out.wait_to_read()  # compile
+    tic = time.time()
+    for _ in range(repeats):
+        out = net(x)
+    out.wait_to_read()
+    return repeats * batch_size / (time.time() - tic)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--model', default='resnet50_v1')
+    parser.add_argument('--batch-sizes', default='1,32')
+    parser.add_argument('--image-size', type=int, default=224)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    for bs in (int(b) for b in args.batch_sizes.split(',')):
+        ips = score(args.model, bs, args.image_size)
+        logging.info('model %s batch %d: %.1f images/sec',
+                     args.model, bs, ips)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,90 @@
+"""Symbolic ResNet v1.5/v2 builder.
+
+Mirrors the role of example/image-classification/symbols/resnet.py in
+the reference (residual units + stage layout per depth); written against
+the mxnet_tpu Symbol API.
+"""
+import mxnet_tpu as mx
+
+# depth -> (bottleneck?, units per stage)
+_CONFIGS = {
+    18: (False, [2, 2, 2, 2]),
+    34: (False, [3, 4, 6, 3]),
+    50: (True, [3, 4, 6, 3]),
+    101: (True, [3, 4, 23, 3]),
+    152: (True, [3, 8, 36, 3]),
+}
+
+
+def residual_unit(data, num_filter, stride, dim_match, name, bottleneck):
+    if bottleneck:
+        bn1 = mx.sym.BatchNorm(data=data, fix_gamma=False, name=name + '_bn1')
+        act1 = mx.sym.Activation(data=bn1, act_type='relu', name=name + '_relu1')
+        conv1 = mx.sym.Convolution(data=act1, num_filter=num_filter // 4,
+                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                                   no_bias=True, name=name + '_conv1')
+        bn2 = mx.sym.BatchNorm(data=conv1, fix_gamma=False, name=name + '_bn2')
+        act2 = mx.sym.Activation(data=bn2, act_type='relu', name=name + '_relu2')
+        conv2 = mx.sym.Convolution(data=act2, num_filter=num_filter // 4,
+                                   kernel=(3, 3), stride=stride, pad=(1, 1),
+                                   no_bias=True, name=name + '_conv2')
+        bn3 = mx.sym.BatchNorm(data=conv2, fix_gamma=False, name=name + '_bn3')
+        act3 = mx.sym.Activation(data=bn3, act_type='relu', name=name + '_relu3')
+        conv3 = mx.sym.Convolution(data=act3, num_filter=num_filter,
+                                   kernel=(1, 1), stride=(1, 1), pad=(0, 0),
+                                   no_bias=True, name=name + '_conv3')
+        body = conv3
+        shortcut_from = act1
+    else:
+        bn1 = mx.sym.BatchNorm(data=data, fix_gamma=False, name=name + '_bn1')
+        act1 = mx.sym.Activation(data=bn1, act_type='relu', name=name + '_relu1')
+        conv1 = mx.sym.Convolution(data=act1, num_filter=num_filter,
+                                   kernel=(3, 3), stride=stride, pad=(1, 1),
+                                   no_bias=True, name=name + '_conv1')
+        bn2 = mx.sym.BatchNorm(data=conv1, fix_gamma=False, name=name + '_bn2')
+        act2 = mx.sym.Activation(data=bn2, act_type='relu', name=name + '_relu2')
+        body = mx.sym.Convolution(data=act2, num_filter=num_filter,
+                                  kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                                  no_bias=True, name=name + '_conv2')
+        shortcut_from = act1
+    if dim_match:
+        shortcut = data
+    else:
+        shortcut = mx.sym.Convolution(data=shortcut_from,
+                                      num_filter=num_filter, kernel=(1, 1),
+                                      stride=stride, no_bias=True,
+                                      name=name + '_sc')
+    return body + shortcut
+
+
+def get_symbol(num_classes=1000, num_layers=50, image_shape='3,224,224',
+               **kwargs):
+    bottleneck, units = _CONFIGS[num_layers]
+    channels = [int(x) for x in image_shape.split(',')][0]  # noqa: F841
+    filters = ([64, 256, 512, 1024, 2048] if bottleneck
+               else [64, 64, 128, 256, 512])
+
+    data = mx.sym.Variable('data')
+    body = mx.sym.Convolution(data=data, num_filter=filters[0],
+                              kernel=(7, 7), stride=(2, 2), pad=(3, 3),
+                              no_bias=True, name='conv0')
+    body = mx.sym.BatchNorm(data=body, fix_gamma=False, name='bn0')
+    body = mx.sym.Activation(data=body, act_type='relu', name='relu0')
+    body = mx.sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                          pad=(1, 1), pool_type='max')
+
+    for stage, n_units in enumerate(units):
+        stride = (1, 1) if stage == 0 else (2, 2)
+        body = residual_unit(body, filters[stage + 1], stride, False,
+                             'stage%d_unit1' % (stage + 1), bottleneck)
+        for unit in range(n_units - 1):
+            body = residual_unit(body, filters[stage + 1], (1, 1), True,
+                                 'stage%d_unit%d' % (stage + 1, unit + 2),
+                                 bottleneck)
+    bn1 = mx.sym.BatchNorm(data=body, fix_gamma=False, name='bn1')
+    relu1 = mx.sym.Activation(data=bn1, act_type='relu', name='relu1')
+    pool1 = mx.sym.Pooling(data=relu1, global_pool=True, kernel=(7, 7),
+                           pool_type='avg', name='pool1')
+    flat = mx.sym.Flatten(data=pool1)
+    fc1 = mx.sym.FullyConnected(data=flat, num_hidden=num_classes, name='fc1')
+    return mx.sym.SoftmaxOutput(data=fc1, name='softmax')

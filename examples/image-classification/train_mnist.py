@@ -1,0 +1,92 @@
+"""Train LeNet / MLP on MNIST with the Module API — BASELINE config #1.
+
+Mirrors example/image-classification/train_mnist.py in the reference:
+symbolic network definition, MNISTIter, Module.fit with kvstore,
+Speedometer + checkpoint callbacks. Runs hermetically (synthetic MNIST)
+when the idx files are absent.
+
+    python train_mnist.py --network lenet --num-epochs 5
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+
+def mlp():
+    """Reference example/image-classification/symbols/mlp.py."""
+    data = mx.sym.Variable('data')
+    data = mx.sym.Flatten(data=data)
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=128, name='fc1')
+    act1 = mx.sym.Activation(data=fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(data=act1, num_hidden=64, name='fc2')
+    act2 = mx.sym.Activation(data=fc2, act_type='relu', name='relu2')
+    fc3 = mx.sym.FullyConnected(data=act2, num_hidden=10, name='fc3')
+    return mx.sym.SoftmaxOutput(data=fc3, name='softmax')
+
+
+def lenet():
+    """Reference example/image-classification/symbols/lenet.py."""
+    data = mx.sym.Variable('data')
+    conv1 = mx.sym.Convolution(data=data, kernel=(5, 5), num_filter=20)
+    act1 = mx.sym.Activation(data=conv1, act_type='tanh')
+    pool1 = mx.sym.Pooling(data=act1, pool_type='max', kernel=(2, 2),
+                           stride=(2, 2))
+    conv2 = mx.sym.Convolution(data=pool1, kernel=(5, 5), num_filter=50)
+    act2 = mx.sym.Activation(data=conv2, act_type='tanh')
+    pool2 = mx.sym.Pooling(data=act2, pool_type='max', kernel=(2, 2),
+                           stride=(2, 2))
+    flat = mx.sym.Flatten(data=pool2)
+    fc1 = mx.sym.FullyConnected(data=flat, num_hidden=500)
+    act3 = mx.sym.Activation(data=fc1, act_type='tanh')
+    fc2 = mx.sym.FullyConnected(data=act3, num_hidden=10)
+    return mx.sym.SoftmaxOutput(data=fc2, name='softmax')
+
+
+def main():
+    parser = argparse.ArgumentParser(description='train mnist')
+    parser.add_argument('--network', default='mlp',
+                        choices=('mlp', 'lenet'))
+    parser.add_argument('--batch-size', type=int, default=64)
+    parser.add_argument('--num-epochs', type=int, default=3)
+    parser.add_argument('--lr', type=float, default=0.1)
+    parser.add_argument('--kv-store', default='local')
+    parser.add_argument('--data-dir', default='data')
+    parser.add_argument('--model-prefix', default=None)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    flat = args.network == 'mlp'
+    train = mx.io.MNISTIter(
+        image=os.path.join(args.data_dir, 'train-images-idx3-ubyte'),
+        label=os.path.join(args.data_dir, 'train-labels-idx1-ubyte'),
+        batch_size=args.batch_size, flat=flat, shuffle=True)
+    val = mx.io.MNISTIter(
+        image=os.path.join(args.data_dir, 't10k-images-idx3-ubyte'),
+        label=os.path.join(args.data_dir, 't10k-labels-idx1-ubyte'),
+        batch_size=args.batch_size, flat=flat, shuffle=False)
+
+    net = mlp() if args.network == 'mlp' else lenet()
+    mod = mx.mod.Module(symbol=net, context=mx.current_context())
+    cb = [mx.callback.Speedometer(args.batch_size, 50)]
+    epoch_cb = (mx.callback.do_checkpoint(args.model_prefix)
+                if args.model_prefix else None)
+    mod.fit(train, eval_data=val, eval_metric='acc',
+            optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9},
+            kvstore=args.kv_store,
+            initializer=mx.init.Xavier(),
+            batch_end_callback=cb, epoch_end_callback=epoch_cb,
+            num_epoch=args.num_epochs)
+    score = mod.score(val, mx.metric.Accuracy())
+    for name, acc in score:
+        logging.info('final validation %s = %.4f', name, acc)
+    return score
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,88 @@
+"""LSTM language model with BucketingModule — BASELINE config #4.
+
+Mirrors example/rnn/lstm_bucketing.py in the reference: variable-length
+sentences bucketed by length (SURVEY.md §5.7), one Module per bucket
+sharing the master parameters, Perplexity metric. Uses synthetic
+sentences when no PTB files are present (zero-egress hermetic run).
+
+    python lstm_bucketing.py --num-epochs 3
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+BUCKETS = [8, 16, 24, 32]
+
+
+def synthetic_sentences(n, vocab, seed=0):
+    """Markov-chain sentences so the LM has learnable structure."""
+    rng = np.random.RandomState(seed)
+    trans = rng.dirichlet(np.ones(vocab) * 0.1, size=vocab)
+    out = []
+    for _ in range(n):
+        length = rng.randint(5, BUCKETS[-1] + 1)
+        s = [rng.randint(1, vocab)]
+        for _ in range(length - 1):
+            s.append(int(rng.choice(vocab, p=trans[s[-1]])))
+        out.append(s)
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--num-epochs', type=int, default=3)
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--num-hidden', type=int, default=100)
+    parser.add_argument('--num-embed', type=int, default=64)
+    parser.add_argument('--num-layers', type=int, default=2)
+    parser.add_argument('--vocab', type=int, default=100)
+    parser.add_argument('--lr', type=float, default=0.1)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    train_iter = mx.rnn.BucketSentenceIter(
+        synthetic_sentences(2000, args.vocab), args.batch_size,
+        buckets=BUCKETS, invalid_label=0)
+
+    stack = mx.rnn.SequentialRNNCell()
+    for i in range(args.num_layers):
+        stack.add(mx.rnn.LSTMCell(num_hidden=args.num_hidden,
+                                  prefix='lstm_l%d_' % i))
+
+    def sym_gen(seq_len):
+        data = mx.sym.Variable('data')
+        label = mx.sym.Variable('softmax_label')
+        embed = mx.sym.Embedding(data=data, input_dim=args.vocab,
+                                 output_dim=args.num_embed, name='embed')
+        stack.reset()
+        outputs, states = stack.unroll(seq_len, inputs=embed,
+                                       merge_outputs=True)
+        pred = mx.sym.Reshape(outputs, shape=(-1, args.num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=args.vocab,
+                                     name='pred')
+        label = mx.sym.Reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(data=pred, label=label, name='softmax')
+        return pred, ('data',), ('softmax_label',)
+
+    model = mx.mod.BucketingModule(
+        sym_gen=sym_gen, default_bucket_key=train_iter.default_bucket_key,
+        context=mx.current_context())
+
+    model.fit(train_iter, eval_metric=mx.metric.Perplexity(ignore_label=None),
+              optimizer='sgd',
+              optimizer_params={'learning_rate': args.lr, 'momentum': 0.9},
+              initializer=mx.init.Xavier(factor_type='in', magnitude=2.34),
+              num_epoch=args.num_epochs,
+              batch_end_callback=mx.callback.Speedometer(args.batch_size, 20))
+    return model
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,142 @@
+"""SSD object detection — BASELINE config #5.
+
+Compact counterpart of the reference's example/ssd (VGG16-SSD): a conv
+backbone with multi-scale heads, MultiBoxPrior anchors, MultiBoxTarget
+training targets, and MultiBoxDetection NMS decode at inference — all
+through the contrib ops (ops/contrib_ops.py, reference
+src/operator/contrib/multibox_*.cc). Trains on synthetic box data so the
+run is hermetic.
+
+    python train_ssd.py --epochs 2
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+IMG = 64
+NUM_CLASSES = 3  # foreground classes
+MAX_OBJS = 4
+
+
+def conv_block(data, num_filter, name, stride=(1, 1)):
+    c = mx.sym.Convolution(data=data, num_filter=num_filter, kernel=(3, 3),
+                           stride=stride, pad=(1, 1), name=name + '_conv')
+    b = mx.sym.BatchNorm(data=c, name=name + '_bn')
+    return mx.sym.Activation(data=b, act_type='relu', name=name + '_relu')
+
+
+def ssd_symbol(mode='train'):
+    data = mx.sym.Variable('data')
+    label = mx.sym.Variable('label')
+
+    body = conv_block(data, 16, 'b1')
+    body = mx.sym.Pooling(data=body, kernel=(2, 2), stride=(2, 2),
+                          pool_type='max')          # 32x32
+    body = conv_block(body, 32, 'b2')
+    scale1 = mx.sym.Pooling(data=body, kernel=(2, 2), stride=(2, 2),
+                            pool_type='max')        # 16x16
+    scale1 = conv_block(scale1, 64, 'b3')
+    scale2 = conv_block(scale1, 64, 'b4', stride=(2, 2))   # 8x8
+
+    preds, anchors = [], []
+    cfg = [(scale1, (0.2, 0.35), (1.0, 2.0, 0.5)),
+           (scale2, (0.4, 0.6), (1.0, 2.0, 0.5))]
+    num_anchors_per = len(cfg[0][2]) + len(cfg[0][1]) - 1
+    for i, (feat, sizes, ratios) in enumerate(cfg):
+        anc = mx.sym.contrib.MultiBoxPrior(feat, sizes=sizes, ratios=ratios,
+                                           clip=True,
+                                           name='anchors%d' % i)
+        pred = mx.sym.Convolution(
+            data=feat, num_filter=num_anchors_per * (NUM_CLASSES + 1 + 4),
+            kernel=(3, 3), pad=(1, 1), name='pred%d' % i)
+        # [B, A*(C+1+4), H, W] -> [B, H*W*A, C+1+4]
+        pred = mx.sym.transpose(pred, axes=(0, 2, 3, 1))
+        pred = mx.sym.Reshape(pred, shape=(0, -1, NUM_CLASSES + 1 + 4))
+        preds.append(pred)
+        anchors.append(mx.sym.Reshape(anc, shape=(0, -1, 4)))
+    pred = mx.sym.Concat(*preds, dim=1)
+    anchor = mx.sym.Concat(*anchors, dim=1)
+    cls_pred = mx.sym.slice_axis(pred, axis=2, begin=0, end=NUM_CLASSES + 1)
+    loc_pred = mx.sym.Reshape(
+        mx.sym.slice_axis(pred, axis=2, begin=NUM_CLASSES + 1,
+                          end=NUM_CLASSES + 1 + 4), shape=(0, -1))
+    # MultiBoxTarget expects cls_pred as [B, C+1, A]
+    cls_pred_t = mx.sym.transpose(cls_pred, axes=(0, 2, 1))
+
+    if mode == 'train':
+        loc_t, loc_m, cls_t = mx.sym.contrib.MultiBoxTarget(
+            anchor, label, cls_pred_t, overlap_threshold=0.5,
+            name='multibox_target')
+        cls_loss = mx.sym.SoftmaxOutput(data=cls_pred_t, label=cls_t,
+                                        multi_output=True,
+                                        use_ignore=True, ignore_label=-1,
+                                        normalization='valid',
+                                        name='cls_prob')
+        loc_diff = loc_m * (loc_pred - loc_t)
+        loc_loss = mx.sym.MakeLoss(mx.sym.smooth_l1(loc_diff, scalar=1.0),
+                                   grad_scale=1.0, name='loc_loss')
+        return mx.sym.Group([cls_loss, loc_loss,
+                             mx.sym.BlockGrad(cls_t, name='cls_label')])
+    cls_prob = mx.sym.softmax(cls_pred_t, axis=1)
+    return mx.sym.contrib.MultiBoxDetection(cls_prob, loc_pred, anchor,
+                                            nms_threshold=0.5,
+                                            name='detection')
+
+
+def synthetic_detection_data(n, seed=0):
+    """Images with colored rectangles; label [n, MAX_OBJS, 5] =
+    (cls, xmin, ymin, xmax, ymax) normalized, -1 padding."""
+    rng = np.random.RandomState(seed)
+    images = np.zeros((n, 3, IMG, IMG), np.float32)
+    labels = -np.ones((n, MAX_OBJS, 5), np.float32)
+    for i in range(n):
+        for j in range(rng.randint(1, MAX_OBJS + 1)):
+            cls = rng.randint(0, NUM_CLASSES)
+            w, h = rng.uniform(0.2, 0.5, 2)
+            x0 = rng.uniform(0, 1 - w)
+            y0 = rng.uniform(0, 1 - h)
+            xi0, yi0 = int(x0 * IMG), int(y0 * IMG)
+            xi1, yi1 = int((x0 + w) * IMG), int((y0 + h) * IMG)
+            images[i, cls, yi0:yi1, xi0:xi1] = 1.0
+            labels[i, j] = (cls, x0, y0, x0 + w, y0 + h)
+        images[i] += 0.1 * rng.randn(3, IMG, IMG)
+    return images, labels
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--epochs', type=int, default=2)
+    parser.add_argument('--batch-size', type=int, default=16)
+    parser.add_argument('--samples', type=int, default=128)
+    parser.add_argument('--lr', type=float, default=0.05)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    images, labels = synthetic_detection_data(args.samples)
+    train = mx.io.NDArrayIter(images, labels, batch_size=args.batch_size,
+                              shuffle=True, label_name='label')
+
+    net = ssd_symbol('train')
+    mod = mx.mod.Module(net, label_names=('label',),
+                        context=mx.current_context())
+    mod.fit(train,
+            eval_metric=mx.metric.Loss(output_names=['loc_loss_output']),
+            optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9,
+                              'wd': 5e-4},
+            initializer=mx.init.Xavier(),
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 4),
+            num_epoch=args.epochs)
+    logging.info('SSD training complete')
+    return mod
+
+
+if __name__ == '__main__':
+    main()

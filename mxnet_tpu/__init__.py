@@ -24,7 +24,7 @@ from . import attribute
 from .attribute import AttrScope
 from . import executor
 from . import initializer
-from .initializer import init  # noqa: F401
+from . import initializer as init  # mx.init.Xavier() etc. (reference alias)
 from . import optimizer
 from . import optimizer as opt
 from . import lr_scheduler

@@ -81,3 +81,5 @@ def negative(data):
 
 def true_divide(lhs, rhs):
     return divide(lhs, rhs)
+
+from . import contrib  # noqa: E402,F401  (mx.nd.contrib.*)

@@ -1,0 +1,13 @@
+"""contrib namespace — experimental ops (reference ndarray/contrib.py).
+
+Exposes every registered ``_contrib_*`` operator without the prefix:
+``nd.contrib.MultiBoxPrior`` ≙ the reference's
+mx.nd.contrib.MultiBoxPrior (src/operator/contrib/).
+"""
+from ..ops import registry as _reg
+from .register import make_nd_function as _make
+
+for _name in _reg.list_ops():
+    if _name.startswith('_contrib_'):
+        globals()[_name[len('_contrib_'):]] = _make(_name)
+del _name

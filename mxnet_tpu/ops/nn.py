@@ -508,13 +508,13 @@ def _make_loss_cvjp(x, scale):
 
 
 def _make_loss_fwd(x, scale):
-    return x, (scale, x.shape, x.dtype)
+    # residual must be a jax pytree: carry the broadcast gradient itself
+    # (shape/dtype objects are not valid leaves)
+    return x, jnp.broadcast_to(jnp.asarray(scale, x.dtype), x.shape)
 
 
 def _make_loss_bwd(res, g):
-    scale, shape, dtype = res
-    s = jnp.broadcast_to(jnp.asarray(scale, dtype), shape)
-    return (s, None)
+    return (res, None)
 
 
 _make_loss_cvjp.defvjp(_make_loss_fwd, _make_loss_bwd)

@@ -40,7 +40,10 @@ class OpDef:
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
-        self.input_names = input_names or ['data']
+        # an explicit [] means a nullary op (_zeros, _arange, samplers);
+        # only None falls back to the single-'data' convention
+        self.input_names = (['data'] if input_names is None
+                            else list(input_names))
         self.param_defaults = param_defaults or {}
         self.differentiable = differentiable
         self.variadic = variadic  # takes *args (Concat/add_n style)

@@ -350,3 +350,19 @@ def _depth_to_space(attrs, x):
     n, c, h, w = x.shape
     y = x.reshape(n, b, b, c // (b * b), h, w)
     return y.transpose(0, 3, 4, 1, 5, 2).reshape(n, c // (b * b), h * b, w * b)
+
+
+@register('_state_zeros', param_defaults={'shape': (), 'dtype': 'float32',
+                                          'batch_axis': 0},
+          input_names=['data'], differentiable=False)
+def _state_zeros_op(attrs, data):
+    """RNN begin-state zeros with the batch dim taken from `data`.
+
+    The reference leaves batch as 0 in state_info shapes (rnn_cell.py
+    state_info {'shape': (0, H)}) and lets bidirectional shape inference
+    fill it; here inference is forward-only (jax.eval_shape), so the
+    state explicitly depends on the input symbol instead."""
+    shape = tuple(int(d) for d in attrs['shape'])
+    b = data.shape[int(attrs.get('batch_axis', 0))]
+    out = tuple(b if d == 0 else d for d in shape)
+    return jnp.zeros(out, dtype=np_dtype(attrs.get('dtype', 'float32')))

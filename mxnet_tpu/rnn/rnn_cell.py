@@ -125,7 +125,7 @@ class BaseRNNCell:
         self.reset()
         inputs, _ = _normalize_sequence(length, inputs, layout, False)
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = _batch_states(self, inputs[0], batch_axis=0)
         states = begin_state
         outputs = []
         for i in range(length):
@@ -134,6 +134,22 @@ class BaseRNNCell:
         outputs, _ = _normalize_sequence(length, outputs, layout,
                                          merge_outputs)
         return outputs, states
+
+
+def _batch_states(cell, ref_sym, batch_axis=0):
+    """begin_state with batch taken from ``ref_sym`` via _state_zeros, so
+    forward shape inference resolves the reference's 0-batch convention."""
+    def func(name, shape=(), dtype='float32', **kwargs):
+        return symbol._state_zeros(ref_sym, name=name, shape=tuple(shape),
+                                   dtype=dtype, batch_axis=batch_axis)
+    return cell.begin_state(func=func)
+
+
+def _unroll_ref_input(length, inputs, layout):
+    """A (symbol, batch_axis) pair naming where the batch dim lives."""
+    if isinstance(inputs, Symbol):
+        return inputs, layout.find('N')
+    return inputs[0], 0
 
 
 def _normalize_sequence(length, inputs, layout, merge, in_layout=None):
@@ -341,7 +357,7 @@ class FusedRNNCell(BaseRNNCell):
         if axis == 1:
             inputs = symbol.swapaxes(inputs, dim1=0, dim2=1)
         if begin_state is None:
-            begin_state = self.begin_state()
+            begin_state = _batch_states(self, inputs, batch_axis=1)
         states = begin_state
 
         if self._mode == 'lstm':
@@ -446,7 +462,8 @@ class SequentialRNNCell(BaseRNNCell):
         self.reset()
         num_cells = len(self._cells)
         if begin_state is None:
-            begin_state = self.begin_state()
+            ref, b_axis = _unroll_ref_input(length, inputs, layout)
+            begin_state = _batch_states(self, ref, batch_axis=b_axis)
         p = 0
         next_states = []
         for i, cell in enumerate(self._cells):
@@ -494,10 +511,10 @@ class ModifierCell(BaseRNNCell):
     def state_info(self):
         return self.base_cell.state_info
 
-    def begin_state(self, init_sym=symbol.zeros, **kwargs):
+    def begin_state(self, func=symbol.zeros, **kwargs):
         assert not self._modified
         self.base_cell._modified = False
-        begin = self.base_cell.begin_state(init_sym, **kwargs)
+        begin = self.base_cell.begin_state(func=func, **kwargs)
         self.base_cell._modified = True
         return begin
 
@@ -613,7 +630,8 @@ class BidirectionalCell(BaseRNNCell):
         self.reset()
         inputs, axis = _normalize_sequence(length, inputs, layout, False)
         if begin_state is None:
-            begin_state = self.begin_state()
+            ref, b_axis = _unroll_ref_input(length, inputs, layout)
+            begin_state = _batch_states(self, ref, batch_axis=b_axis)
         states = begin_state
         l_cell, r_cell = self._cells
         l_outputs, l_states = l_cell.unroll(

@@ -16,3 +16,5 @@ def ones(shape, dtype='float32', **kwargs):
 def arange(start, stop=None, step=1.0, repeat=1, dtype='float32', **kwargs):
     return _register.make_sym_function('_arange')(start=start, stop=stop, step=step,
                                                   repeat=repeat, dtype=dtype, **kwargs)
+
+from . import contrib  # noqa: E402,F401  (mx.sym.contrib.*)

@@ -1,0 +1,53 @@
+"""Examples stay runnable — the reference CI runs example scripts the
+same way (Jenkinsfile tutorial/test_all.sh stages).
+
+Each example runs as a subprocess at its smallest config on the virtual
+CPU mesh; success = exit 0 (each script asserts/<logs> its own training
+behavior).
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+
+CASES = [
+    ('image-classification/train_mnist.py',
+     ['--num-epochs', '1', '--network', 'mlp']),
+    ('image-classification/train_imagenet.py',
+     ['--num-layers', '18', '--image-shape', '3,32,32', '--num-classes', '5',
+      '--samples', '32', '--batch-size', '16', '--benchmark', '1']),
+    ('ssd/train_ssd.py', ['--epochs', '1', '--samples', '32',
+                          '--batch-size', '16']),
+    ('rnn/lstm_bucketing.py',
+     ['--num-epochs', '1', '--batch-size', '16', '--num-hidden', '32',
+      '--num-embed', '16', '--num-layers', '1', '--vocab', '50']),
+    ('parallel/train_5d_transformer.py',
+     ['--pp', '2', '--dp', '2', '--tp', '2', '--steps', '3', '--seq', '8',
+      '--d-model', '16', '--batch', '4', '--vocab', '32']),
+    ('gluon/image_classification.py',
+     ['--model', 'resnet18_v1', '--epochs', '1', '--samples', '64',
+      '--image-size', '16', '--batch-size', '16']),
+]
+
+
+@pytest.mark.parametrize('script,args', CASES,
+                         ids=[c[0].replace('/', '_') for c in CASES])
+def test_example_runs(script, args):
+    env = dict(os.environ)
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = ROOT
+    # JAX_PLATFORMS may be overridden by sitecustomize; force via -c shim
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import sys, runpy; sys.argv=[%r]+%r;"
+        "runpy.run_path(%r, run_name='__main__')"
+        % (script, args, os.path.join(ROOT, 'examples', script)))
+    proc = subprocess.run([sys.executable, '-c', code], env=env,
+                          capture_output=True, text=True, timeout=540,
+                          cwd=os.path.join(ROOT, 'examples',
+                                           os.path.dirname(script)))
+    assert proc.returncode == 0, proc.stderr[-3000:]
