@@ -31,6 +31,7 @@ from . import lr_scheduler
 from . import metric
 from . import callback
 from . import io
+from . import image
 from . import recordio
 from . import kvstore
 from . import kvstore as kv

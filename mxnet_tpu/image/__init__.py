@@ -1,0 +1,16 @@
+from .image import (Augmenter, ResizeAug, ForceResizeAug, RandomCropAug,
+                    CenterCropAug, HorizontalFlipAug, CastAug,
+                    ColorNormalizeAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, RandomOrderAug,
+                    CreateAugmenter, ImageIter, imresize, imdecode,
+                    resize_short, fixed_crop, random_crop, center_crop,
+                    color_normalize, scale_down)
+from . import detection  # noqa: F401
+
+__all__ = ['Augmenter', 'ResizeAug', 'ForceResizeAug', 'RandomCropAug',
+           'CenterCropAug', 'HorizontalFlipAug', 'CastAug',
+           'ColorNormalizeAug', 'BrightnessJitterAug', 'ContrastJitterAug',
+           'SaturationJitterAug', 'RandomOrderAug', 'CreateAugmenter',
+           'ImageIter', 'imresize', 'imdecode', 'resize_short', 'fixed_crop',
+           'random_crop', 'center_crop', 'color_normalize', 'scale_down',
+           'detection']

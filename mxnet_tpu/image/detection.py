@@ -1,0 +1,162 @@
+"""Detection augmenters + iterator (reference python/mxnet/image/
+detection.py: DetAugmenter classes and ImageDetIter).
+
+Labels are [N, 5]: (cls, xmin, ymin, xmax, ymax) normalized to [0, 1],
+-1 rows are padding — the MultiBoxTarget convention
+(ops/contrib_ops.py)."""
+import random
+
+import numpy as np
+
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray.ndarray import array as nd_array
+from .image import (Augmenter, imresize, ImageIter, resize_short,
+                    HorizontalFlipAug)
+
+__all__ = ['DetAugmenter', 'DetHorizontalFlipAug', 'DetRandomCropAug',
+           'DetBorderAug', 'CreateDetAugmenter', 'ImageDetIter']
+
+
+class DetAugmenter:
+    """Detection augmenter: __call__(src, label) -> (src, label)."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    def __init__(self, p=0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if random.random() < self.p:
+            src = src[:, ::-1]
+            valid = label[:, 0] >= 0
+            x0 = label[:, 1].copy()
+            label[:, 1] = np.where(valid, 1.0 - label[:, 3], label[:, 1])
+            label[:, 3] = np.where(valid, 1.0 - x0, label[:, 3])
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """Random crop keeping boxes with center inside the crop
+    (reference detection.py DetRandomCropAug, simplified)."""
+
+    def __init__(self, min_scale=0.5, max_trials=10):
+        self.min_scale = min_scale
+        self.max_trials = max_trials
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_trials):
+            s = random.uniform(self.min_scale, 1.0)
+            cw, ch = int(w * s), int(h * s)
+            x0 = random.randint(0, w - cw)
+            y0 = random.randint(0, h - ch)
+            nx0, ny0 = x0 / w, y0 / h
+            valid = label[:, 0] >= 0
+            cx = (label[:, 1] + label[:, 3]) / 2
+            cy = (label[:, 2] + label[:, 4]) / 2
+            keep = valid & (cx > nx0) & (cx < nx0 + s) & \
+                (cy > ny0) & (cy < ny0 + s)
+            if not keep.any():
+                continue
+            out = label.copy()
+            out[~keep] = -1
+            for col, off, scale in ((1, nx0, s), (3, nx0, s),
+                                    (2, ny0, s), (4, ny0, s)):
+                out[keep, col] = np.clip((out[keep, col] - off) / scale, 0, 1)
+            return src[y0:y0 + ch, x0:x0 + cw], out
+        return src, label
+
+
+class DetBorderAug(DetAugmenter):
+    """Pad to square with value fill, rescaling boxes."""
+
+    def __init__(self, fill=127):
+        self.fill = fill
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        side = max(h, w)
+        out = np.full((side, side, src.shape[2]), self.fill, src.dtype)
+        out[:h, :w] = src
+        valid = label[:, 0] >= 0
+        label[valid, 1] *= w / side
+        label[valid, 3] *= w / side
+        label[valid, 2] *= h / side
+        label[valid, 4] *= h / side
+        return out, label
+
+
+def CreateDetAugmenter(data_shape, rand_crop=0, rand_mirror=False,
+                       rand_pad=0, **kwargs):
+    """Reference detection.py CreateDetAugmenter (core subset)."""
+    augs = []
+    if rand_pad:
+        augs.append(DetBorderAug())
+    if rand_crop:
+        augs.append(DetRandomCropAug())
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    return augs
+
+
+class ImageDetIter(ImageIter):
+    """Detection iterator: batches (data, [B, max_objs, 5] labels)
+    (reference detection.py ImageDetIter / src/io/
+    iter_image_det_recordio.cc)."""
+
+    def __init__(self, batch_size, data_shape, images, labels,
+                 aug_list=None, data_name='data', label_name='label',
+                 shuffle=False, **kwargs):
+        # images: [N, H, W, C] float; labels: [N, max_objs, 5]
+        self._images = images
+        self._labels = labels
+        DataIter.__init__(self, batch_size)
+        self.data_shape = tuple(data_shape)
+        self.shuffle = shuffle
+        self.data_name = data_name
+        self.label_name = label_name
+        self.auglist = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape, **kwargs)
+        self._order = list(range(len(images)))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size,) + self._labels.shape[1:])]
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self._order)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor + self.batch_size > len(self._order):
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = np.empty((self.batch_size, c, h, w), np.float32)
+        label = np.empty((self.batch_size,) + self._labels.shape[1:],
+                         np.float32)
+        for i in range(self.batch_size):
+            j = self._order[self._cursor + i]
+            img = np.asarray(self._images[j], np.float32)
+            lab = np.array(self._labels[j], np.float32)
+            for aug in self.auglist:
+                img, lab = aug(img, lab)
+            if img.shape[:2] != (h, w):
+                img = imresize(img, w, h)
+            data[i] = img.transpose(2, 0, 1)[:c]
+            label[i] = lab
+        self._cursor += self.batch_size
+        return DataBatch(data=[nd_array(data)], label=[nd_array(label)],
+                         pad=0, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

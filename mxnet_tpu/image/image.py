@@ -1,0 +1,370 @@
+"""Image pipeline — pure-python/numpy ImageIter + Augmenter classes.
+
+Reference: python/mxnet/image/image.py:975 (ImageIter with Augmenter
+pipeline, :482-871 augmenter classes) and the OpenCV-backed src/io image
+ops. Here decode/resize run on numpy (bilinear; pillow when available
+for JPEG), augmentation composes the same Augmenter objects, and batches
+come out as NDArrays in NCHW. Heavy lifting (normalize etc.) stays in
+numpy to keep the TPU free for the training step; the iterator plugs
+into PrefetchingIter (io.py) for engine-backed double buffering.
+
+Images are HWC float32 throughout augmentation (the reference's
+convention), transposed to CHW at batching.
+"""
+import logging
+import os
+import random
+
+import numpy as np
+
+from ..io import DataIter, DataBatch, DataDesc
+from ..ndarray.ndarray import NDArray, array as nd_array
+from .. import recordio
+
+__all__ = ['ImageIter', 'Augmenter', 'CreateAugmenter']
+
+
+def imdecode(buf, to_rgb=True, flag=1):
+    """Decode an image buffer. JPEG/PNG need pillow; raw numpy buffers
+    (pack_img '.raw' format) decode natively (reference mx.image.imdecode
+    backed by src/io/image_io.cc)."""
+    try:
+        from PIL import Image
+        import io as _io
+        img = np.asarray(Image.open(_io.BytesIO(buf)).convert('RGB'))
+        return img.astype(np.float32)
+    except Exception:
+        arr = np.frombuffer(buf, dtype=np.uint8)
+        side = int(round((arr.size // 3) ** 0.5))
+        if side * side * 3 == arr.size:
+            return arr.reshape(side, side, 3).astype(np.float32)
+        raise ValueError('cannot decode image buffer (pillow unavailable '
+                         'and not a square raw buffer)')
+
+
+def imresize(src, w, h, interp=1):
+    """Bilinear resize HWC numpy image (reference mx.image.imresize)."""
+    sh, sw = src.shape[:2]
+    if (sh, sw) == (h, w):
+        return src
+    ys = (np.arange(h) + 0.5) * sh / h - 0.5
+    xs = (np.arange(w) + 0.5) * sw / w - 0.5
+    y0 = np.clip(np.floor(ys).astype(int), 0, sh - 1)
+    x0 = np.clip(np.floor(xs).astype(int), 0, sw - 1)
+    y1 = np.clip(y0 + 1, 0, sh - 1)
+    x1 = np.clip(x0 + 1, 0, sw - 1)
+    wy = np.clip(ys - y0, 0, 1)[:, None, None]
+    wx = np.clip(xs - x0, 0, 1)[None, :, None]
+    img = src.astype(np.float32)
+    top = img[y0][:, x0] * (1 - wx) + img[y0][:, x1] * wx
+    bot = img[y1][:, x0] * (1 - wx) + img[y1][:, x1] * wx
+    return top * (1 - wy) + bot * wy
+
+
+def resize_short(src, size, interp=1):
+    """Resize so the shorter side equals size (reference image.py:90)."""
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=1):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = random.randint(0, max(0, w - new_w))
+    y0 = random.randint(0, max(0, h - new_h))
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=1):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max(0, (w - new_w) // 2)
+    y0 = max(0, (h - new_h) // 2)
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+def scale_down(src_size, size):
+    """Scale size down to fit within src_size keeping the ratio."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = w * sh // h, sh
+    if sw < w:
+        w, h = sw, h * sw // w
+    return w, h
+
+
+class Augmenter:
+    """Image augmenter base (reference image.py:482)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            return src[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __call__(self, src):
+        return src.astype(np.float32)
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.contrast, self.contrast)
+        gray = (src * self.coef).sum() * 3.0 / src.size
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class SaturationJitterAug(Augmenter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+        self.coef = np.array([[[0.299, 0.587, 0.114]]], np.float32)
+
+    def __call__(self, src):
+        alpha = 1.0 + random.uniform(-self.saturation, self.saturation)
+        gray = (src * self.coef).sum(axis=2, keepdims=True)
+        return src * alpha + gray * (1.0 - alpha)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=mean, std=std)
+        self.mean = np.asarray(mean, np.float32) if mean is not None else None
+        self.std = np.asarray(std, np.float32) if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean if self.mean is not None else 0,
+                               self.std)
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        random.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py:871)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    jitters = []
+    if brightness:
+        jitters.append(BrightnessJitterAug(brightness))
+    if contrast:
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitters.append(SaturationJitterAug(saturation))
+    if jitters:
+        auglist.append(RandomOrderAug(jitters))
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53], np.float32)
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375], np.float32)
+    if mean is not None and np.any(np.asarray(mean) > 0):
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Pure-python image iterator over .rec or an image list
+    (reference image.py:975 ImageIter).
+
+    >>> it = ImageIter(32, (3, 224, 224), path_imgrec='train.rec',
+    ...                rand_crop=True, rand_mirror=True)
+    """
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root='',
+                 shuffle=False, aug_list=None, imglist=None,
+                 data_name='data', label_name='softmax_label', **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist is not None, \
+            'one of path_imgrec / path_imglist / imglist is required'
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self._records = []  # (label, raw-buffer or path)
+
+        if path_imgrec:
+            rec = recordio.MXRecordIO(path_imgrec, 'r')
+            while True:
+                item = rec.read()
+                if item is None:
+                    break
+                header, buf = recordio.unpack(item)
+                self._records.append((np.float32(header.label), buf))
+            rec.close()
+        else:
+            entries = imglist
+            if path_imglist:
+                entries = []
+                with open(path_imglist) as f:
+                    for line in f:
+                        parts = line.strip().split('\t')
+                        entries.append([float(x) for x in parts[1:-1]] +
+                                       [parts[-1]])
+            for e in entries:
+                label, fname = (np.float32(e[0]) if len(e) == 2
+                                else np.asarray(e[:-1], np.float32)), e[-1]
+                self._records.append((label, os.path.join(path_root, fname)))
+
+        if aug_list is None:
+            aug_list = CreateAugmenter(data_shape, **kwargs)
+        self.auglist = aug_list
+        self.data_name = data_name
+        self.label_name = label_name
+        self._order = list(range(len(self._records)))
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name, (self.batch_size,))]
+
+    def reset(self):
+        if self.shuffle:
+            random.shuffle(self._order)
+        self._cursor = 0
+
+    def _load(self, rec):
+        label, src = rec
+        if isinstance(src, bytes):
+            img = imdecode(src)
+        else:
+            img = imdecode(open(src, 'rb').read())
+        return label, img
+
+    def next(self):
+        if self._cursor + self.batch_size > len(self._records):
+            raise StopIteration
+        data = np.empty((self.batch_size,) + self.data_shape, np.float32)
+        label = np.empty((self.batch_size,), np.float32)
+        for i in range(self.batch_size):
+            lab, img = self._load(
+                self._records[self._order[self._cursor + i]])
+            for aug in self.auglist:
+                img = aug(img)
+            c, h, w = self.data_shape
+            if img.shape[:2] != (h, w):
+                img = imresize(img, w, h)
+            data[i] = img.transpose(2, 0, 1)[:c]
+            label[i] = np.float32(lab) if np.ndim(lab) == 0 else lab[0]
+        self._cursor += self.batch_size
+        return DataBatch(data=[nd_array(data)], label=[nd_array(label)],
+                         pad=0, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -15,3 +15,6 @@ from . import rnn_ops             # noqa: F401
 from . import contrib_ops         # noqa: F401
 
 from .registry import register, get, list_ops, exists
+from . import pallas_kernels      # noqa: F401  (TPU kernels for hot ops)
+from .pallas_kernels import (flash_attention, fused_rmsnorm,  # noqa: F401
+                             fused_layernorm, softmax_xent)
