@@ -52,3 +52,9 @@ from .executor_manager import DataParallelExecutorManager  # noqa: F401
 from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import parallel
+
+# Server/scheduler processes block in their role loop here and exit with the
+# job (reference python/mxnet/kvstore_server.py:75).
+from .kvstore_server import init_server_module_if_needed as _init_kv_server
+_init_kv_server()
+del _init_kv_server

@@ -1,0 +1,82 @@
+"""Wire protocol for the distributed KVStore (worker/server/scheduler).
+
+Reference: ps-lite's ZMQ transport as used by src/kvstore/kvstore_dist.h:52
+and kvstore_dist_server.h:109. The reference ships messages over ZeroMQ with
+zero-copy SArrays; here the transport is length-prefixed pickled tuples over
+TCP sockets — tensors travel as (shape, dtype, raw bytes) triples so the
+payload is a single contiguous buffer either way.
+
+Env protocol (reference include/mxnet/kvstore.h:244-301, tools/launch.py):
+DMLC_ROLE in {worker, server, scheduler}; DMLC_PS_ROOT_URI / DMLC_PS_ROOT_PORT
+locate the scheduler; DMLC_NUM_WORKER / DMLC_NUM_SERVER size the cluster.
+"""
+import pickle
+import socket
+import struct
+
+import numpy as np
+
+_LEN = struct.Struct('>Q')
+
+
+def send_msg(sock, obj):
+    """Length-prefixed pickle. One writer per socket at a time."""
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_msg(sock):
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return bytes(buf)
+
+
+def pack_array(arr):
+    arr = np.ascontiguousarray(arr)
+    return (arr.shape, arr.dtype.str, arr.tobytes())
+
+
+def unpack_array(triple):
+    shape, dtype, raw = triple
+    return np.frombuffer(raw, dtype=np.dtype(dtype)).reshape(shape).copy()
+
+
+def connect(host, port, timeout=60.0):
+    deadline = __import__('time').monotonic() + timeout
+    last = None
+    while True:
+        try:
+            sock = socket.create_connection((host, int(port)), timeout=5.0)
+            sock.settimeout(None)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            return sock
+        except OSError as e:
+            last = e
+            if __import__('time').monotonic() > deadline:
+                raise ConnectionError(
+                    'cannot reach %s:%s after %.0fs: %s'
+                    % (host, port, timeout, last))
+            __import__('time').sleep(0.2)
+
+
+def listener(host='0.0.0.0', port=0):
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind((host, port))
+    srv.listen(128)
+    return srv, srv.getsockname()[1]

@@ -193,7 +193,11 @@ def push(fn, sync=False):
     eng = get_engine() if not naive() else None
     if eng is not None:
         if _host_serial_var is None:
-            _host_serial_var = eng.new_var()
+            # under the engine lock: two first-use racers must not each
+            # mint a distinct serial var (that would unserialize them)
+            with _global_engine_lock:
+                if _host_serial_var is None:
+                    _host_serial_var = eng.new_var()
         ev = threading.Event()
 
         def task():

@@ -1,0 +1,337 @@
+"""Distributed KVStore worker tier.
+
+Reference: src/kvstore/kvstore_dist.h:52 (KVStoreDist : KVStoreLocal).
+Semantics preserved: `dist_sync` / `dist_device_sync` defer push acks on the
+server until every worker has contributed (the synchronous-SGD barrier);
+`dist_async` applies per push. Key sharding follows EncodeKey
+(kvstore_dist.h:430-468): arrays smaller than MXTPU_KVSTORE_BIGARRAY_BOUND
+(default 1 MB, reference MXNET_KVSTORE_BIGARRAY_BOUND) go whole to one
+hashed server (key*9973 % n); larger arrays are striped evenly over *all*
+servers so aggregate bandwidth scales with the server count.
+
+Asynchrony: the reference makes ZPush/ZPull engine ops; here each server
+connection gets a dedicated comm thread with a FIFO queue, so `push` returns
+immediately and `pull` rides the same queue (per-server ordering ≙ the
+engine's per-var ordering). `priority` is accepted for API compatibility.
+
+Standalone mode: without the DMLC_* cluster env (no launcher), a scheduler
+and one server are spun up as in-process threads so `mx.kv.create
+('dist_sync')` works as a 1-worker cluster — handy for tests and parity with
+the reference's single-machine `dist` fallback.
+"""
+import atexit
+import os
+import pickle
+import threading
+
+import numpy as np
+
+import jax
+
+from .base import MXNetError
+from .kvstore import KVStore, _key_value
+from .ndarray import NDArray
+from ._dist_proto import (send_msg, recv_msg, pack_array, unpack_array,
+                          connect)
+
+__all__ = ['KVStoreDist']
+
+_BIGARRAY_BOUND = int(os.environ.get(
+    'MXTPU_KVSTORE_BIGARRAY_BOUND',
+    os.environ.get('MXNET_KVSTORE_BIGARRAY_BOUND', 1 << 20)))
+
+
+class _Future:
+    def __init__(self):
+        self._ev = threading.Event()
+        self.value = None
+
+    def set(self, value):
+        self.value = value
+        self._ev.set()
+
+    def wait(self):
+        self._ev.wait()
+        if isinstance(self.value, Exception):
+            raise self.value
+        return self.value
+
+
+class _ServerConn:
+    """One comm thread + socket per server; FIFO request/reply."""
+
+    def __init__(self, addr):
+        self.sock = connect(*addr)
+        self._q = []
+        self._err = None
+        self._cv = threading.Condition()
+        self._th = threading.Thread(target=self._loop, daemon=True)
+        self._th.start()
+
+    def submit(self, msg):
+        if self._err is not None:
+            raise RuntimeError('kvstore server error: %s' % self._err)
+        fut = _Future()
+        with self._cv:
+            self._q.append((msg, fut))
+            self._cv.notify()
+        return fut
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._q:
+                    self._cv.wait()
+                msg, fut = self._q.pop(0)
+            if msg is None:
+                return
+            try:
+                send_msg(self.sock, msg)
+                reply = recv_msg(self.sock)
+                # fire-and-forget pushes never await their future; a
+                # server-side failure must still surface on the next op
+                if (isinstance(reply, tuple) and reply
+                        and reply[0] == 'error'):
+                    self._err = reply[1]
+                fut.set(reply)
+            except OSError as e:
+                fut.set(e)
+
+    def close(self):
+        with self._cv:
+            self._q.append((None, _Future()))
+            self._cv.notify()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class KVStoreDist(KVStore):
+    """Reference kvstore_dist.h:52 — worker side of the parameter server."""
+
+    def __init__(self, kv_type='dist_sync'):
+        super().__init__(kv_type)
+        self._standalone = None
+        if 'DMLC_PS_ROOT_URI' in os.environ:
+            root = (os.environ['DMLC_PS_ROOT_URI'],
+                    os.environ['DMLC_PS_ROOT_PORT'])
+            self._num_workers = int(os.environ.get('DMLC_NUM_WORKER', 1))
+            self._num_servers = int(os.environ.get('DMLC_NUM_SERVER', 1))
+        else:
+            root = self._start_standalone()
+            self._num_workers = self._num_servers = 1
+        host = os.environ.get('DMLC_NODE_HOST', '127.0.0.1')
+        self._sched = connect(*root)
+        self._sched_lock = threading.Lock()
+        send_msg(self._sched, ('register', 'worker', (host, 0)))
+        topo = recv_msg(self._sched)
+        assert topo and topo[0] == 'topology', topo
+        self._rank = topo[1]
+        self._conns = [_ServerConn(a) for a in topo[2]]
+        self._sync = '_async' not in kv_type
+        self._key_meta = {}  # key -> (shape, dtype)
+        if self._rank == 0:
+            self._command_all('set_sync_mode', self._sync)
+        self.barrier()
+        atexit.register(self._finalize)
+
+    def _start_standalone(self):
+        """In-process 1-worker cluster (no launcher present)."""
+        from .kvstore_server import Scheduler, KVStoreServer
+        sched = Scheduler(1, 1)
+        addr = ('127.0.0.1', sched.port)
+        threading.Thread(target=sched.run, daemon=True).start()
+        server = KVStoreServer()
+        server.num_workers = 1
+        threading.Thread(target=server.run, args=(addr,),
+                         daemon=True).start()
+        self._standalone = (sched, server)
+        return addr
+
+    # -- topology --------------------------------------------------------
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._num_workers
+
+    def barrier(self):
+        """Global worker barrier via the scheduler (ps::Postoffice)."""
+        with self._sched_lock:
+            send_msg(self._sched, ('barrier', 'worker'))
+            reply = recv_msg(self._sched)
+        assert reply and reply[0] == 'barrier_done', reply
+
+    def _finalize(self):
+        try:
+            with self._sched_lock:
+                send_msg(self._sched, ('finalize',))
+        except OSError:
+            pass
+        for c in self._conns:
+            c.close()
+
+    # -- key sharding (EncodeKey, kvstore_dist.h:430-468) ----------------
+    def _shards(self, key, shape, dtype):
+        nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
+        n = len(self._conns)
+        size = int(np.prod(shape))
+        if nbytes < _BIGARRAY_BOUND or n == 1 or size < n:
+            sid = (_hash_key(key) * 9973) % n
+            return [(sid, str(key), slice(0, size))]
+        out = []
+        chunk = (size + n - 1) // n
+        for s in range(n):
+            lo, hi = s * chunk, min(size, (s + 1) * chunk)
+            if lo >= hi:
+                break
+            out.append((s, '%s#%d' % (key, s), slice(lo, hi)))
+        return out
+
+    # -- init/push/pull --------------------------------------------------
+    def init(self, key, value):
+        keys, values = _key_value(key, value)
+        for k, v in zip(keys, values):
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            arr = vv.asnumpy() if isinstance(vv, NDArray) else np.asarray(vv)
+            self._key_meta[k] = (arr.shape, arr.dtype)
+            if self._rank == 0:
+                flat = arr.reshape(-1)
+                futs = [self._conns[sid].submit(
+                            ('init', skey, pack_array(flat[sl])))
+                        for sid, skey, sl in self._shards(
+                            k, arr.shape, arr.dtype)]
+                for f in futs:
+                    f.wait()
+        self.barrier()
+
+    def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray
+        keys, values = _key_value(key, value)
+        for k, vlist in zip(keys, values):
+            if not isinstance(vlist, (list, tuple)):
+                vlist = [vlist]
+            if isinstance(vlist[0], RowSparseNDArray):
+                self._push_row_sparse(k, vlist)
+                continue
+            merged = self._reduce(vlist).asnumpy()
+            if k not in self._key_meta:
+                self._key_meta[k] = (merged.shape, merged.dtype)
+            flat = merged.reshape(-1)
+            for sid, skey, sl in self._shards(k, merged.shape, merged.dtype):
+                self._conns[sid].submit(('push', skey, pack_array(flat[sl])))
+
+    def _push_row_sparse(self, k, vlist):
+        """Row-sparse grads go whole to the key's home server (the
+        reference stripes per-row key ranges; one home server preserves
+        the API semantics — see module docstring)."""
+        idx, vals = _merge_row_sparse(vlist)
+        if k in self._key_meta:
+            shape, dtype = self._key_meta[k]
+            if len(self._shards(k, shape, dtype)) > 1:
+                raise MXNetError(
+                    'row_sparse key %r exceeds the big-array bound and was '
+                    'striped at init; raise MXTPU_KVSTORE_BIGARRAY_BOUND '
+                    'for sparse keys' % (k,))
+        sid = (_hash_key(k) * 9973) % len(self._conns)
+        self._conns[sid].submit(
+            ('push_rsp', str(k), pack_array(idx), pack_array(vals)))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        assert out is not None
+        keys, outs = _key_value(key, out)
+        for k, olist in zip(keys, outs):
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            shape, dtype = self._key_meta.get(
+                k, (olist[0].shape, olist[0].dtype))
+            shards = self._shards(k, shape, dtype)
+            futs = [(sl, self._conns[sid].submit(('pull', skey)))
+                    for sid, skey, sl in shards]
+            flat = np.empty(int(np.prod(shape)), dtype)
+            for sl, f in futs:
+                reply = f.wait()
+                assert reply and reply[0] == 'arr', reply
+                flat[sl] = unpack_array(reply[1]).reshape(-1)
+            arr = flat.reshape(shape)
+            for o in olist:
+                o._data = jax.device_put(
+                    arr.astype(o.dtype), o.context.jax_device())
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        from .ndarray.sparse import RowSparseNDArray, row_sparse_array
+        assert out is not None and row_ids is not None
+        keys, outs = _key_value(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(keys)
+        for k, olist, rids in zip(
+                keys, outs,
+                row_ids if isinstance(row_ids, list) else [row_ids]):
+            if not isinstance(olist, (list, tuple)):
+                olist = [olist]
+            rows = np.unique(rids.asnumpy().astype(np.int64))
+            sid = (_hash_key(k) * 9973) % len(self._conns)
+            row_shape = tuple(self._key_meta[k][0][1:])
+            reply = self._conns[sid].submit(
+                ('pull_rsp', str(k), pack_array(rows), row_shape)).wait()
+            assert reply and reply[0] == 'arr', reply
+            vals = unpack_array(reply[1])
+            shape, _ = self._key_meta[k]
+            res = row_sparse_array((vals, rows), shape=shape)
+            for o in olist:
+                if isinstance(o, RowSparseNDArray):
+                    o.data, o.indices = res.data, res.indices
+                else:
+                    res.copyto(o)
+
+    # -- server commands (reference kvstore.py:349-393) ------------------
+    def set_optimizer(self, optimizer):
+        """Ship the pickled optimizer to the servers; updates then run
+        server-side (update_on_kvstore)."""
+        if self._rank == 0:
+            self._command_all('set_optimizer', pickle.dumps(optimizer))
+        self.barrier()
+        self._optimizer = optimizer
+        self._updater = None
+
+    def _send_command_to_servers(self, head, body):
+        self._command_all(head, body)
+
+    def _command_all(self, head, body):
+        futs = [c.submit(('cmd', head, body)) for c in self._conns]
+        for f in futs:
+            f.wait()
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        raise RuntimeError('Cannot save states for distributed training '
+                           '(they live on the servers)')
+
+    def load_optimizer_states(self, fname):
+        raise RuntimeError('Cannot load states for distributed training')
+
+
+def _hash_key(key):
+    try:
+        return int(key)
+    except (TypeError, ValueError):
+        return abs(hash(str(key)))
+
+
+def _merge_row_sparse(vlist):
+    """Sum a list of row-sparse shards into one (indices, values) pair."""
+    all_idx = np.concatenate([v.indices.asnumpy().astype(np.int64)
+                              for v in vlist])
+    uniq = np.unique(all_idx)
+    pos = {r: i for i, r in enumerate(uniq)}
+    width = vlist[0].data.shape[1:]
+    vals = np.zeros((len(uniq),) + tuple(width),
+                    vlist[0].data.asnumpy().dtype)
+    for v in vlist:
+        vi = v.indices.asnumpy().astype(np.int64)
+        vd = v.data.asnumpy()
+        for j, r in enumerate(vi):
+            vals[pos[r]] += vd[j]
+    return uniq, vals

@@ -1,0 +1,354 @@
+"""KVStore server + scheduler roles for distributed training.
+
+Reference: src/kvstore/kvstore_dist_server.h:109 (KVStoreDistServer —
+sync-barrier merge in MergeBuf/ApplyUpdates:144-209, server-side optimizer
+re-instantiated from a pickled command, python/mxnet/kvstore_server.py:28-75)
+and ps-lite's Postoffice scheduler (rank assignment, barriers).
+
+The server applies updates with numpy-backed NDArrays on the host — gradient
+aggregation across *machines* is bandwidth-bound host work in the reference
+too (pinned-CPU merge); the TPU stays dedicated to the worker's compute.
+
+Roles are selected by DMLC_ROLE at import time of mxnet_tpu (reference
+kvstore_server.py:75 _init_kvstore_server_module): 'server' and 'scheduler'
+processes block in their loop and exit with the job.
+"""
+import os
+import pickle
+import socket
+import sys
+import threading
+
+import numpy as np
+
+
+def _dbg(msg):
+    if os.environ.get('MXTPU_KVSTORE_DEBUG'):
+        print('[kvserver pid=%d] %s' % (os.getpid(), msg),
+              file=sys.stderr, flush=True)
+
+from ._dist_proto import (send_msg, recv_msg, pack_array, unpack_array,
+                          connect, listener)
+
+__all__ = ['KVStoreServer', 'Scheduler', 'run_scheduler', 'run_server',
+           'init_server_module_if_needed']
+
+
+class Scheduler:
+    """Rendezvous + barrier service (ps-lite Postoffice role).
+
+    Protocol: every node connects and sends ('register', role); once
+    DMLC_NUM_WORKER workers and DMLC_NUM_SERVER servers are in, each gets
+    ('topology', rank, [server addresses]). The connection then serves
+    ('barrier', group) requests — replies ('barrier_done',) to all members
+    once the whole group has entered — and ('finalize',) notifications;
+    when every worker finalizes, servers get ('stop',) and the scheduler
+    exits.
+    """
+
+    def __init__(self, num_workers, num_servers, port=None):
+        self.num_workers = num_workers
+        self.num_servers = num_servers
+        port = port if port is not None else int(
+            os.environ.get('DMLC_PS_ROOT_PORT', 0))
+        self.sock, self.port = listener(port=port)
+        self._lock = threading.Lock()
+        self._registered = {'worker': [], 'server': []}
+        self._ready = threading.Event()
+        self._barrier = {}  # group -> list of waiting conns
+        self._finalized = 0
+        self._threads = []
+
+    def run(self):
+        total = self.num_workers + self.num_servers
+        conns = []
+        while len(conns) < total:
+            conn, _ = self.sock.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conns.append(conn)
+            th = threading.Thread(target=self._serve, args=(conn,),
+                                  daemon=True)
+            th.start()
+            self._threads.append(th)
+        self._ready.wait()
+        for th in self._threads:
+            th.join()
+
+    def _serve(self, conn):
+        msg = recv_msg(conn)
+        if not msg or msg[0] != 'register':
+            conn.close()
+            return
+        role = msg[1]
+        addr = msg[2] if len(msg) > 2 else None
+        with self._lock:
+            rank = len(self._registered[role])
+            self._registered[role].append((conn, addr))
+            done = (len(self._registered['worker']) == self.num_workers and
+                    len(self._registered['server']) == self.num_servers)
+        if done:
+            with self._lock:
+                servers = [a for _, a in self._registered['server']]
+                for r, (c, _) in enumerate(self._registered['server']):
+                    send_msg(c, ('topology', r, servers))
+                for r, (c, _) in enumerate(self._registered['worker']):
+                    send_msg(c, ('topology', r, servers))
+            self._ready.set()
+        self._ready.wait()
+        while True:
+            msg = recv_msg(conn)
+            if msg is None:
+                return
+            kind = msg[0]
+            if kind == 'barrier':
+                self._enter_barrier(msg[1], conn)
+            elif kind == 'finalize':
+                if self._worker_finalized():
+                    return
+            else:
+                send_msg(conn, ('error', 'unknown message %r' % (kind,)))
+
+    def _enter_barrier(self, group, conn):
+        sizes = {'worker': self.num_workers, 'server': self.num_servers,
+                 'all': self.num_workers + self.num_servers}
+        with self._lock:
+            waiters = self._barrier.setdefault(group, [])
+            waiters.append(conn)
+            if len(waiters) < sizes[group]:
+                return
+            self._barrier[group] = []
+            release = list(waiters)
+        for c in release:
+            send_msg(c, ('barrier_done',))
+
+    def _worker_finalized(self):
+        with self._lock:
+            self._finalized += 1
+            if self._finalized < self.num_workers:
+                return False
+            servers = [c for c, _ in self._registered['server']]
+        for c in servers:
+            try:
+                send_msg(c, ('stop',))
+            except OSError:
+                pass
+        return True
+
+
+class KVStoreServer:
+    """One parameter-server shard (kvstore_dist_server.h:109).
+
+    dist_sync: pushes for a key accumulate in a merge buffer and the push
+    *replies are deferred* until all DMLC_NUM_WORKER workers have pushed —
+    that deferred ack is the synchronous-SGD barrier (ApplyUpdates:175).
+    With an optimizer installed (pickled via a 'set_optimizer' command,
+    reference kvstore.py:349-393) the merged gradient updates the stored
+    weight; without one the merged sum *becomes* the stored value.
+
+    dist_async: each push applies immediately and acks immediately
+    (kvstore_dist_server.h:389-401).
+    """
+
+    def __init__(self):
+        self.store = {}            # key -> np.ndarray
+        self.sync_mode = False
+        self.updater = None
+        self._lock = threading.Lock()
+        self._merge = {}           # key -> (buf, [conns awaiting ack])
+        self.num_workers = int(os.environ.get('DMLC_NUM_WORKER', 1))
+        self._stop = threading.Event()
+
+    # -- role entry ------------------------------------------------------
+    def run(self, sched_addr=None):
+        sock, port = listener()
+        host = os.environ.get('DMLC_NODE_HOST', _local_host())
+        if sched_addr is None:
+            sched_addr = (os.environ['DMLC_PS_ROOT_URI'],
+                          os.environ['DMLC_PS_ROOT_PORT'])
+        sched = connect(*sched_addr)
+        send_msg(sched, ('register', 'server', (host, port)))
+        topo = recv_msg(sched)
+        assert topo and topo[0] == 'topology', topo
+        self.rank = topo[1]
+        threading.Thread(target=self._watch_scheduler, args=(sched,),
+                         daemon=True).start()
+        sock.settimeout(0.5)
+        while not self._stop.is_set():
+            try:
+                conn, _ = sock.accept()
+            except socket.timeout:
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+        sock.close()
+
+    def _watch_scheduler(self, sched):
+        while True:
+            msg = recv_msg(sched)
+            if msg is None or msg[0] == 'stop':
+                self._stop.set()
+                return
+
+    # -- request handling ------------------------------------------------
+    def _serve(self, conn):
+        while not self._stop.is_set():
+            msg = recv_msg(conn)
+            if msg is None:
+                return
+            _dbg('recv %s %s' % (msg[0], msg[1] if len(msg) > 1 else ''))
+            try:
+                self.handle(msg, conn)
+            except Exception as e:  # noqa: BLE001 — must not kill the conn
+                _dbg('handler error: %r' % e)
+                try:
+                    send_msg(conn, ('error', repr(e)))
+                except OSError:
+                    return
+            _dbg('done %s' % msg[0])
+
+    def handle(self, msg, conn):
+        kind = msg[0]
+        if kind == 'init':
+            _, key, triple = msg
+            with self._lock:
+                if key not in self.store:
+                    self.store[key] = unpack_array(triple)
+            send_msg(conn, ('ok',))
+        elif kind == 'push':
+            self._handle_push(msg[1], unpack_array(msg[2]), conn)
+        elif kind == 'push_rsp':
+            indices = unpack_array(msg[2])
+            values = unpack_array(msg[3])
+            self._handle_push(msg[1], (indices, values), conn, sparse=True)
+        elif kind == 'pull':
+            with self._lock:
+                arr = self.store[msg[1]]
+            send_msg(conn, ('arr', pack_array(arr)))
+        elif kind == 'pull_rsp':
+            # stored values are flat (init ships flattened stripes); view
+            # them as rows of the requested width before gathering
+            rows = unpack_array(msg[2]).astype(np.int64)
+            row_shape = tuple(msg[3])
+            with self._lock:
+                vals = self.store[msg[1]].reshape(
+                    (-1,) + row_shape)[rows]
+            send_msg(conn, ('arr', pack_array(vals)))
+        elif kind == 'cmd':
+            self._handle_command(msg[1], msg[2])
+            send_msg(conn, ('ok',))
+        else:
+            send_msg(conn, ('error', 'unknown message %r' % (kind,)))
+
+    def _handle_push(self, key, grad, conn, sparse=False):
+        if not self.sync_mode:
+            with self._lock:
+                self._apply(key, self._densify(key, grad, sparse))
+            send_msg(conn, ('ok',))
+            return
+        with self._lock:
+            dense = self._densify(key, grad, sparse)
+            buf, waiters = self._merge.get(key, (None, []))
+            buf = dense if buf is None else buf + dense
+            waiters.append(conn)
+            if len(waiters) < self.num_workers:
+                self._merge[key] = (buf, waiters)
+                return
+            self._merge.pop(key, None)
+            self._apply(key, buf)
+            release = list(waiters)
+        for c in release:
+            send_msg(c, ('ok',))
+
+    def _densify(self, key, grad, sparse):
+        if not sparse:
+            return grad
+        indices, values = grad
+        dense = np.zeros_like(self.store[key])
+        # scatter through a row-shaped view — the store itself is flat
+        view = dense.reshape((-1,) + values.shape[1:])
+        np.add.at(view, indices.astype(np.int64), values)
+        return dense
+
+    def _apply(self, key, merged):
+        """ApplyUpdates (kvstore_dist_server.h:175): optimizer if set,
+        else the merged sum replaces the stored value."""
+        if self.updater is None:
+            self.store[key] = merged
+            return
+        from .ndarray import NDArray
+        from .context import cpu
+        import jax.numpy as jnp
+        w = NDArray(jnp.asarray(self.store[key]), cpu())
+        g = NDArray(jnp.asarray(merged), cpu())
+        self.updater(_int_key(key), g, w)
+        self.store[key] = np.asarray(w.asnumpy())
+
+    def _handle_command(self, head, body):
+        if head == 'set_optimizer':
+            from . import optimizer as opt
+            optimizer = pickle.loads(body)
+            self.updater = opt.get_updater(optimizer)
+        elif head == 'set_sync_mode':
+            self.sync_mode = bool(body)
+        elif head == 'stop':
+            self._stop.set()
+        else:
+            raise ValueError('unknown server command %r' % (head,))
+
+
+def _int_key(key):
+    base = key.split('#', 1)[0] if isinstance(key, str) else key
+    try:
+        return int(base)
+    except (TypeError, ValueError):
+        return base
+
+
+def _local_host():
+    return os.environ.get('DMLC_LOCAL_HOST', '127.0.0.1')
+
+
+def run_scheduler():
+    sched = Scheduler(int(os.environ['DMLC_NUM_WORKER']),
+                      int(os.environ['DMLC_NUM_SERVER']))
+    sched.run()
+
+
+def run_server():
+    KVStoreServer().run()
+
+
+def init_server_module_if_needed():
+    """Reference kvstore_server.py:75 — server/scheduler processes take over
+    when mxnet is imported, and the process exits with the role loop.
+
+    The loop runs on a NON-daemon thread that first re-imports mxnet_tpu:
+    that import blocks until the interpreter's in-progress import of the
+    package (we are called from __init__.py) completes. Blocking the import
+    itself would deadlock the server: handling 'set_optimizer' unpickles an
+    optimizer, and pickle's __import__ of mxnet_tpu.optimizer waits on the
+    parent package's import lock.
+    """
+    role = os.environ.get('DMLC_ROLE', '')
+    if role not in ('server', 'scheduler'):
+        return
+    # Server/scheduler are host-side roles (reference: CPU processes next
+    # to ps-lite) — never let them grab the accelerator; in particular a
+    # single-chip TPU must stay dedicated to the workers.
+    try:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    except Exception:
+        pass
+
+    def role_main():
+        import mxnet_tpu  # noqa: F401 — wait for the package import to finish
+        if role == 'server':
+            run_server()
+        else:
+            run_scheduler()
+        os._exit(0)
+
+    threading.Thread(target=role_main, name='kvstore-' + role,
+                     daemon=False).start()

@@ -37,8 +37,14 @@ def _interpret():
 # Flash attention
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, causal, scale, blk_q, blk_k):
-    """Grid: (batch*heads, Tq/blk_q). K/V streamed in blk_k tiles."""
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, causal, scale, blk_q, blk_k,
+                  offset):
+    """Grid: (batch*heads, Tq/blk_q). K/V streamed in blk_k tiles.
+
+    `offset` = Tk - Tq aligns the causal mask bottom-right (decode
+    convention): query row i may see key cols <= i + offset — identical
+    to the oracle's tril(ones(Tq, Tk), Tk - Tq) in _flash_ref.
+    """
     q = q_ref[0].astype(jnp.float32) * scale          # [blk_q, D]
     Tk = k_ref.shape[1]
     qi = pl.program_id(1)
@@ -51,7 +57,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, causal, scale, blk_q, blk_k):
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            mask = (qi * blk_q + rows) >= (start * blk_k + cols)
+            mask = (qi * blk_q + rows + offset) >= (start * blk_k + cols)
             s = jnp.where(mask, s, _NEG)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
         corr = jnp.exp(m - m_new)
@@ -63,8 +69,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, causal, scale, blk_q, blk_k):
 
     total = Tk // blk_k
     if causal:
-        # K blocks strictly after this q block's last row are fully masked
-        n_blocks = jnp.minimum(pl.cdiv((qi + 1) * blk_q, blk_k), total)
+        # K blocks strictly after this q block's last visible col are
+        # fully masked: last visible col = (qi+1)*blk_q - 1 + offset
+        n_blocks = jnp.clip(pl.cdiv((qi + 1) * blk_q + offset, blk_k),
+                            0, total)
     else:
         n_blocks = total
     acc = jnp.zeros((blk_q, v_ref.shape[2]), jnp.float32)
@@ -77,6 +85,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, causal, scale, blk_q, blk_k):
 def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
     B, Tq, H, D = q.shape
     Tk = k.shape[1]
+    if causal and Tq > Tk:
+        # bottom-right alignment gives the first Tq-Tk query rows zero
+        # visible keys (softmax over empty set — NaN in the oracle);
+        # reject rather than return silently-wrong finite values
+        raise ValueError('causal attention requires Tq <= Tk '
+                         '(got Tq=%d, Tk=%d)' % (Tq, Tk))
     blk_q = min(blk_q, Tq)
     blk_k = min(blk_k, Tk)
     if Tq % blk_q or Tk % blk_k:
@@ -88,7 +102,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
     vh = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
 
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
-                               blk_q=blk_q, blk_k=blk_k)
+                               blk_q=blk_q, blk_k=blk_k, offset=Tk - Tq)
     out = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // blk_q),

@@ -104,9 +104,30 @@ class MXRecordIO:
                 self._nh, bytes(buf), len(buf)))
             return
         length = len(buf)
-        self.handle.write(struct.pack('<II', _kMagic, length & 0x1fffffff))
-        self.handle.write(buf)
-        pad = (4 - length % 4) % 4
+        if length >= 1 << 29:
+            raise ValueError('RecordIO only accepts records < 2^29 bytes')
+        buf = bytes(buf)
+        # dmlc magic-escape: the payload is split at 4-aligned occurrences
+        # of the magic word (dropped on write, re-inserted on read) so a
+        # reader can always resync on magic. cflag: 0=whole, 1=begin,
+        # 2=middle, 3=end (upper 3 bits of lrecord).
+        lower = (length >> 2) << 2
+        hits = np.flatnonzero(
+            np.frombuffer(buf[:lower], dtype='<u4') == _kMagic) * 4
+        if len(hits) == 0:
+            self._write_chunk(0, buf)
+            return
+        dptr = 0
+        for j, i in enumerate(hits):
+            self._write_chunk(1 if j == 0 else 2, buf[dptr:i])
+            dptr = int(i) + 4
+        self._write_chunk(3, buf[dptr:])
+
+    def _write_chunk(self, cflag, data):
+        self.handle.write(struct.pack(
+            '<II', _kMagic, (cflag << 29) | (len(data) & 0x1fffffff)))
+        self.handle.write(data)
+        pad = (4 - len(data) % 4) % 4
         if pad:
             self.handle.write(b'\x00' * pad)
 
@@ -120,6 +141,28 @@ class MXRecordIO:
             if ln.value == ctypes.c_size_t(-1).value:
                 return None
             return ctypes.string_at(out, ln.value) if ln.value else b''
+        got = self._read_chunk()
+        if got is None:
+            return None
+        cflag, buf = got
+        if cflag == 0:
+            return buf
+        if cflag != 1:
+            raise IOError('RecordIO stream begins mid multi-part record')
+        out = bytearray(buf)
+        magic_bytes = struct.pack('<I', _kMagic)
+        while True:
+            got = self._read_chunk()
+            if got is None:
+                raise IOError('truncated multi-part RecordIO record')
+            cflag, buf = got
+            if cflag not in (2, 3):
+                raise IOError('bad continuation flag %d' % cflag)
+            out += magic_bytes + buf
+            if cflag == 3:
+                return bytes(out)
+
+    def _read_chunk(self):
         head = self.handle.read(8)
         if len(head) < 8:
             return None
@@ -128,10 +171,14 @@ class MXRecordIO:
             raise IOError('Invalid RecordIO magic number')
         length = lrec & 0x1fffffff
         buf = self.handle.read(length)
+        if len(buf) < length:
+            # full header but short payload (writer killed mid-record):
+            # corrupt, not clean EOF — match the native reader's error
+            raise IOError('truncated RecordIO record')
         pad = (4 - length % 4) % 4
         if pad:
             self.handle.read(pad)
-        return buf
+        return lrec >> 29, buf
 
     def tell(self):
         if self._nh is not None:

@@ -113,8 +113,16 @@ class Engine {
 
     int deps = static_cast<int>(opr->reads.size() + opr->writes.size());
     opr->wait.store(deps + 1, std::memory_order_relaxed);  // +1 = push guard
-    for (Var *v : opr->reads) RequestAccess(opr, v, false);
-    for (Var *v : opr->writes) RequestAccess(opr, v, true);
+    {
+      // registration of the whole read/write set is atomic wrt other
+      // pushes: without this, two concurrently-pushed ops with crossing
+      // sets (op1 r:A w:B, op2 r:B w:A — possible since ctypes releases
+      // the GIL) can each hold a grant the other's write needs, a silent
+      // scheduler deadlock. The reference registers from one thread.
+      std::lock_guard<std::mutex> plk(push_mu_);
+      for (Var *v : opr->reads) RequestAccess(opr, v, false);
+      for (Var *v : opr->writes) RequestAccess(opr, v, true);
+    }
     // release push guard; if all vars granted already, schedule now
     if (opr->wait.fetch_sub(1, std::memory_order_acq_rel) == 1) Schedule(opr);
   }
@@ -298,6 +306,7 @@ class Engine {
   int num_workers_;
   std::vector<std::thread> workers_;
   std::mutex qmu_;
+  std::mutex push_mu_;  // serializes dependency registration (see Push)
   std::condition_variable qcv_;
   std::priority_queue<Entry> ready_;
   uint64_t seq_ = 0;

@@ -29,10 +29,36 @@ class Writer {
     if (fp_) std::fclose(fp_);
   }
 
+  // dmlc magic-escape framing: the payload is split at 4-aligned
+  // occurrences of the magic word (dropped on write, re-inserted on
+  // read) so a reader can always resync on magic. cflag in the upper
+  // 3 bits of lrecord: 0=whole, 1=begin, 2=middle, 3=end.
   void Write(const char *buf, size_t len) {
     if (len >= (1u << 29))
       throw std::runtime_error("record too large (>= 2^29 bytes)");
-    uint32_t head[2] = {kMagic, static_cast<uint32_t>(len) & 0x1fffffffu};
+    size_t lower = (len >> 2) << 2;
+    std::vector<size_t> hits;
+    for (size_t i = 0; i < lower; i += 4) {
+      uint32_t w;
+      std::memcpy(&w, buf + i, 4);
+      if (w == kMagic) hits.push_back(i);
+    }
+    if (hits.empty()) {
+      WriteChunk(0, buf, len);
+      return;
+    }
+    size_t dptr = 0;
+    for (size_t j = 0; j < hits.size(); ++j) {
+      WriteChunk(j == 0 ? 1 : 2, buf + dptr, hits[j] - dptr);
+      dptr = hits[j] + 4;
+    }
+    WriteChunk(3, buf + dptr, len - dptr);
+  }
+
+  void WriteChunk(uint32_t cflag, const char *buf, size_t len) {
+    uint32_t head[2] = {kMagic,
+                        (cflag << 29) |
+                            (static_cast<uint32_t>(len) & 0x1fffffffu)};
     if (std::fwrite(head, 4, 2, fp_) != 2)
       throw std::runtime_error("recordio write failed");
     if (len && std::fwrite(buf, 1, len, fp_) != len)
@@ -60,21 +86,49 @@ class Reader {
 
   // returns false at clean EOF — including a truncated (<8 byte) tail
   // from a killed writer, matching the python fallback's len(head)<8
-  // check; throws only on a corrupt magic in a full header
+  // check; throws only on a corrupt magic in a full header.
+  // Multi-part records (cflag 1/2/3) are reassembled with the escaped
+  // magic word re-inserted at each part boundary (dmlc recordio).
   bool Next(const char **out, size_t *len) {
+    uint32_t cflag;
+    if (!NextChunk(&buf_, &cflag)) return false;
+    if (cflag == 0) {
+      *out = buf_.data();
+      *len = buf_.size();
+      return true;
+    }
+    if (cflag != 1)
+      throw std::runtime_error("RecordIO stream begins mid multi-part record");
+    while (true) {
+      std::vector<char> part;
+      uint32_t cf;
+      if (!NextChunk(&part, &cf))
+        throw std::runtime_error("truncated multi-part RecordIO record");
+      if (cf != 2 && cf != 3)
+        throw std::runtime_error("bad RecordIO continuation flag");
+      const char *magic = reinterpret_cast<const char *>(&kMagic);
+      buf_.insert(buf_.end(), magic, magic + 4);
+      buf_.insert(buf_.end(), part.begin(), part.end());
+      if (cf == 3) break;
+    }
+    *out = buf_.data();
+    *len = buf_.size();
+    return true;
+  }
+
+  bool NextChunk(std::vector<char> *out, uint32_t *cflag) {
     uint32_t head[2];
     size_t got = std::fread(head, 4, 2, fp_);
     if (got < 2) return false;
     if (head[0] != kMagic)
       throw std::runtime_error("invalid RecordIO magic");
+    *cflag = head[1] >> 29;
     size_t n = head[1] & 0x1fffffffu;
-    buf_.resize(n);
-    if (n && std::fread(buf_.data(), 1, n, fp_) != n)
+    out->resize(n);
+    if (n && std::fread(out->data(), 1, n, fp_) != n)
       throw std::runtime_error("truncated RecordIO record");
     size_t pad = (4 - n % 4) % 4;
     if (pad) std::fseek(fp_, static_cast<long>(pad), SEEK_CUR);
-    *out = buf_.data();
-    *len = n;
     return true;
   }
 

@@ -26,3 +26,8 @@ jax.config.update('jax_platforms', 'cpu')
 jax.config.update('jax_default_matmul_precision', 'float32')
 
 assert len(jax.devices()) == 8, 'virtual 8-device CPU mesh failed to come up'
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        'markers', 'slow: multi-process / long-running integration test')

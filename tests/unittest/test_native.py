@@ -178,6 +178,51 @@ def test_recordio_native_python_cross_compat(tmp_path):
     r.close()
 
 
+def test_recordio_multipart_magic_escape(tmp_path):
+    """Payloads containing the magic word at 4-aligned offsets are split
+    into kBegin/kMiddle/kEnd chunks (dmlc recordio escape) and reassembled
+    on read — native and python implementations interchangeable."""
+    import struct
+    magic = struct.pack('<I', 0xced7230a)
+    recs = [
+        magic * 3,                        # all-magic payload
+        b'abcd' + magic + b'efgh',        # aligned embedded magic
+        b'ab' + magic + b'cdef',          # unaligned — must NOT split
+        b'x' * 4 + magic + b'y' * 7 + magic,  # magic at the tail
+        b'plain',
+    ]
+    for use_native in (True, False):
+        path = str(tmp_path / ('m%d.rec' % use_native))
+        w = recordio.MXRecordIO(path, 'w')
+        if not use_native:
+            w.close()
+            w._nh = None
+            w._lib = None
+            w.handle = open(path, 'wb')
+            w.is_open = True
+            w.writable = True
+        for r in recs:
+            w.write(r)
+        w.close() if use_native else w.handle.close()
+        for read_native in (True, False):
+            r = recordio.MXRecordIO(path, 'r')
+            if not read_native:
+                if r._nh is not None:
+                    r.close()
+                r._nh = None
+                r._lib = None
+                r.handle = open(path, 'rb')
+                r.is_open = True
+                r.writable = False
+            got = [r.read() for _ in range(len(recs))]
+            assert got == recs, (use_native, read_native)
+            assert r.read() is None
+            if read_native:
+                r.close()
+            else:
+                r.handle.close()
+
+
 def test_indexed_recordio_native(tmp_path):
     path = str(tmp_path / 'b.rec')
     idx = str(tmp_path / 'b.idx')

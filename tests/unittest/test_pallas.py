@@ -21,10 +21,10 @@ def _rand(*shape, seed=0):
 
 
 @pytest.mark.parametrize('causal', [False, True])
-@pytest.mark.parametrize('Tq,Tk', [(64, 64), (32, 128)])
+@pytest.mark.parametrize('Tq,Tk', [(64, 64), (32, 128), (16, 32)])
 def test_flash_attention_forward(causal, Tq, Tk):
-    if causal and Tq != Tk:
-        pytest.skip('causal decode offsets covered by ring tests')
+    """Includes causal decode shapes (Tq != Tk): the kernel mask must be
+    bottom-right aligned like the oracle's tril(..., Tk - Tq)."""
     q = _rand(2, Tq, 4, 16, seed=0)
     k = _rand(2, Tk, 4, 16, seed=1)
     v = _rand(2, Tk, 4, 16, seed=2)
@@ -34,10 +34,11 @@ def test_flash_attention_forward(causal, Tq, Tk):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_flash_attention_grad():
-    q = _rand(1, 32, 2, 8, seed=0)
-    k = _rand(1, 32, 2, 8, seed=1)
-    v = _rand(1, 32, 2, 8, seed=2)
+@pytest.mark.parametrize('Tq,Tk', [(32, 32), (16, 32)])
+def test_flash_attention_grad(Tq, Tk):
+    q = _rand(1, Tq, 2, 8, seed=0)
+    k = _rand(1, Tk, 2, 8, seed=1)
+    v = _rand(1, Tk, 2, 8, seed=2)
 
     def loss_flash(q, k, v):
         return jnp.sum(pk.flash_attention(q, k, v, True, None, 16, 16) ** 2)

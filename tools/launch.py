@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Launch a distributed KVStore job: scheduler + servers + workers.
+
+Reference: tools/launch.py:29-47 (delegates to the dmlc-core tracker for
+ssh/mpi/yarn/local). This implements the `local` launcher — every role runs
+as a local subprocess with the DMLC_* env protocol
+(include/mxnet/kvstore.h:244-301):
+
+    python tools/launch.py -n 4 -s 2 python my_training_script.py
+
+Server and scheduler processes just `import mxnet_tpu`; the role loop in
+kvstore_server.init_server_module_if_needed takes over (reference
+python/mxnet/kvstore_server.py:75).
+"""
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description='Launch a distributed job')
+    ap.add_argument('-n', '--num-workers', type=int, required=True)
+    ap.add_argument('-s', '--num-servers', type=int, default=None,
+                    help='default: same as --num-workers')
+    ap.add_argument('--launcher', choices=['local'], default='local')
+    ap.add_argument('--sync-dst-dir', default=None,
+                    help='accepted for reference CLI compat; unused locally')
+    ap.add_argument('command', nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error('no command given')
+    num_servers = (args.num_servers if args.num_servers is not None
+                   else args.num_workers)
+
+    base_env = dict(os.environ)
+    base_env.update({
+        'DMLC_PS_ROOT_URI': '127.0.0.1',
+        'DMLC_PS_ROOT_PORT': str(free_port()),
+        'DMLC_NUM_WORKER': str(args.num_workers),
+        'DMLC_NUM_SERVER': str(num_servers),
+    })
+    # role processes must be able to import mxnet_tpu from any cwd
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    base_env['PYTHONPATH'] = (repo + os.pathsep + base_env['PYTHONPATH']
+                              if base_env.get('PYTHONPATH') else repo)
+    role_cmd = [sys.executable, '-c', 'import mxnet_tpu']
+
+    procs, workers = [], []
+    try:
+        for role, count, cmd in [('scheduler', 1, role_cmd),
+                                 ('server', num_servers, role_cmd),
+                                 ('worker', args.num_workers, args.command)]:
+            for i in range(count):
+                env = dict(base_env)
+                env['DMLC_ROLE'] = role
+                p = subprocess.Popen(cmd, env=env)
+                procs.append(p)
+                if role == 'worker':
+                    workers.append(p)
+        rc = 0
+        for p in workers:
+            p.wait()
+            rc = rc or p.returncode
+        for p in procs:
+            if p not in workers:
+                try:
+                    p.wait(timeout=20)
+                except subprocess.TimeoutExpired:
+                    p.terminate()
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+
+if __name__ == '__main__':
+    sys.exit(main())
