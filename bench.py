@@ -136,7 +136,7 @@ def build_train_step():
     import jax.numpy as jnp
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
     from mxnet_tpu.executor import _GraphProgram
-    from mxnet_tpu.ops.registry import get_op
+    from mxnet_tpu.ops.registry import get as get_op
 
     net = resnet50_v1()
     net.hybridize()

@@ -33,6 +33,31 @@ from .ops import registry as _reg
 __all__ = ['Executor', 'simple_bind']
 
 
+def mirror_wrap(f):
+    """Gradient-memory tradeoff ≙ XLA rematerialization.
+
+    Reference: MXNET_BACKWARD_DO_MIRROR (graph_executor.cc:273-287) marks
+    cheap forward nodes for recompute in backward. Here the same knob is a
+    jax.checkpoint policy applied to the whole traced forward:
+      MXTPU_BACKWARD_DO_MIRROR=1     full remat (max memory saving)
+      MXTPU_BACKWARD_DO_MIRROR=dots  keep matmul results, recompute the rest
+                                     (closest to the reference's heuristic
+                                     of mirroring everything but convolution
+                                     and dot outputs)
+    The legacy MXNET_ spelling is honored too. Loss and gradients are
+    bit-identical either way — only the memory/time tradeoff changes.
+    """
+    import os
+    val = os.environ.get('MXTPU_BACKWARD_DO_MIRROR',
+                         os.environ.get('MXNET_BACKWARD_DO_MIRROR', '0'))
+    if val in ('', '0', 'false', 'False'):
+        return f
+    if val == 'dots':
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        return jax.checkpoint(f, policy=policy)
+    return jax.checkpoint(f)
+
+
 def _entry_key(node, idx):
     return (id(node), idx)
 
@@ -142,7 +167,7 @@ class Executor:
                 return outs, new_aux
 
             wrt = tuple(arg_arrays[gi] for gi in grad_idx)
-            (outs, new_aux), vjp = jax.vjp(f, wrt)
+            (outs, new_aux), vjp = jax.vjp(mirror_wrap(f), wrt)
             zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
             (grads,) = vjp((head_grads, zero_aux))
             return outs, new_aux, grads

@@ -245,13 +245,10 @@ class HybridBlock(Block):
             grouped_inputs = _regroup(inputs, self._in_format)[0]
             params = {i: j.var() for i, j in self._reg_params.items()}
             with self.name_scope():
-                out = self.hybrid_forward(symbol, *grouped_inputs if
-                                          isinstance(grouped_inputs, list)
-                                          else [grouped_inputs], **params) \
-                    if False else self.hybrid_forward(
-                        symbol,
-                        *(grouped_inputs if isinstance(grouped_inputs, (list, tuple))
-                          else (grouped_inputs,)), **params)
+                out = self.hybrid_forward(
+                    symbol,
+                    *(grouped_inputs if isinstance(grouped_inputs, (list, tuple))
+                      else (grouped_inputs,)), **params)
             out, self._out_format = _flatten(out)
             self._cached_graph = inputs, symbol.Group(out)
         return self._cached_graph
@@ -276,6 +273,9 @@ class HybridBlock(Block):
         runner = self._cached_prog.make_runner()
         n_data = len(inputs)
 
+        from ..executor import mirror_wrap
+
+        @mirror_wrap
         def pure_fn(all_arrays, key):
             data_names = [i.name for i in inputs]
             arg_names = self._cached_prog.arg_names

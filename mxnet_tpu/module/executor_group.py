@@ -190,8 +190,6 @@ class DataParallelExecutorGroup:
     def get_params(self, arg_params, aux_params):
         """Reference :420 — weights averaged... actually copied from dev 0."""
         for name, block in zip(self.param_names, self.param_arrays):
-            weight = block[0]
-            weight.copyto(arg_params[name]) if False else None
             arg_params[name]._data = block[0]._data
         for name, block in zip(self.aux_names, self.aux_arrays):
             aux_params[name]._data = block[0]._data

@@ -282,6 +282,10 @@ def _instance_norm(attrs, x, gamma, beta):
 def _layer_norm(attrs, x, gamma, beta):
     ax = int(attrs.get('axis', -1)) % x.ndim
     eps = attrs.get('eps', 1e-5)
+    if ax == x.ndim - 1:
+        from . import pallas_kernels as pk
+        if pk.use_fused():
+            return pk.fused_layernorm(x, gamma, beta, eps)
     x32 = x.astype(jnp.float32)
     mean = jnp.mean(x32, axis=ax, keepdims=True)
     var = jnp.var(x32, axis=ax, keepdims=True)
@@ -345,7 +349,12 @@ def _softmax(attrs, x):
     t = attrs.get('temperature', None)
     if t:
         x = x / t
-    return jax.nn.softmax(x, axis=int(attrs.get('axis', -1)))
+    ax = int(attrs.get('axis', -1)) % x.ndim
+    if ax == x.ndim - 1:
+        from . import pallas_kernels as pk
+        if pk.use_fused():
+            return pk.fused_softmax(x)
+    return jax.nn.softmax(x, axis=ax)
 
 
 @register('log_softmax', param_defaults={'axis': -1, 'temperature': None})
@@ -365,8 +374,12 @@ def _softmax_activation(attrs, x):
 
 @register('softmax_cross_entropy', input_names=['data', 'label'])
 def _softmax_cross_entropy(attrs, data, label):
-    logp = jax.nn.log_softmax(data, axis=-1)
     lab = label.astype(jnp.int32)
+    from . import pallas_kernels as pk
+    if pk.use_fused():
+        # fused logsumexp+gather — never materializes softmax in HBM
+        return pk.softmax_xent(data, lab).sum().astype(data.dtype)
+    logp = jax.nn.log_softmax(data, axis=-1)
     picked = jnp.take_along_axis(logp, lab[:, None], axis=-1)
     return -jnp.sum(picked)
 
@@ -472,8 +485,7 @@ def _make_regression(name, fwd, bwd):
     def op_bwd(grad_scale, res, g):
         out, label = res
         n = out.shape[0]
-        return (bwd(out, label) * grad_scale / n * out.size // n * n / out.size * 1.0
-                if False else bwd(out, label) * (grad_scale / n), None)
+        return (bwd(out, label) * (grad_scale / n), None)
 
     op.defvjp(op_fwd, op_bwd)
 

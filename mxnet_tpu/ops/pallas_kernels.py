@@ -23,8 +23,17 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ['flash_attention', 'fused_rmsnorm', 'fused_layernorm',
-           'softmax_xent']
+__all__ = ['flash_attention', 'flash_attention_lse', 'fused_rmsnorm',
+           'fused_layernorm', 'fused_softmax', 'softmax_xent']
+
+
+def use_fused():
+    """Dispatch policy for the registry ops: real kernels on TPU; on CPU
+    the jnp formulations are faster than interpret-mode pallas, so the
+    fused path is opt-in there (MXTPU_FORCE_PALLAS=1, used in tests)."""
+    import os
+    return (jax.default_backend() == 'tpu'
+            or bool(os.environ.get('MXTPU_FORCE_PALLAS')))
 
 _NEG = -1e30
 
@@ -37,8 +46,8 @@ def _interpret():
 # Flash attention
 # ---------------------------------------------------------------------------
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, causal, scale, blk_q, blk_k,
-                  offset):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, causal, scale, blk_q,
+                  blk_k, offset):
     """Grid: (batch*heads, Tq/blk_q). K/V streamed in blk_k tiles.
 
     `offset` = Tk - Tq aligns the causal mask bottom-right (decode
@@ -80,6 +89,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, causal, scale, blk_q, blk_k,
     l = jnp.zeros((blk_q, 1), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m, l))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    # log-sum-exp of the scaled scores per query row — lets callers (ring
+    # attention) merge normalized per-chunk outputs exactly
+    lse_ref[0] = (m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)))
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
@@ -103,7 +115,7 @@ def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
 
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
                                blk_q=blk_q, blk_k=blk_k, offset=Tk - Tq)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(B * H, Tq // blk_q),
         in_specs=[
@@ -111,11 +123,15 @@ def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
             pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+        out_specs=[pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
+                   pl.BlockSpec((1, blk_q), lambda b, i: (b, i))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, Tq), jnp.float32)],
         interpret=_interpret(),
     )(qh, kh, vh)
-    return out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    out = out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    lse = lse.reshape(B, H, Tq)
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -123,6 +139,16 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128):
     """Memory-efficient attention; shapes [B, T, H, D] like
     ring_attention.attention_reference (its numeric oracle)."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_fwd_impl(q, k, v, causal, s, block_q, block_k)[0]
+
+
+def flash_attention_lse(q, k, v, causal=False, scale=None, block_q=128,
+                        block_k=128):
+    """flash_attention that also returns the per-row log-sum-exp
+    [B, H, Tq] — the merge statistic ring attention needs to combine
+    normalized chunk outputs exactly. Forward-only (no custom vjp);
+    differentiate through the ring's recompute path instead."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
     return _flash_fwd_impl(q, k, v, causal, s, block_q, block_k)
 
@@ -139,7 +165,8 @@ def _flash_ref(q, k, v, causal, scale):
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
     s = scale if scale is not None else q.shape[-1] ** -0.5
-    return _flash_fwd_impl(q, k, v, causal, s, block_q, block_k), (q, k, v)
+    out, _ = _flash_fwd_impl(q, k, v, causal, s, block_q, block_k)
+    return out, (q, k, v)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, res, g):
@@ -249,6 +276,36 @@ def _ln_bwd(eps, res, g):
 
 
 fused_layernorm.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# Fused row softmax
+# ---------------------------------------------------------------------------
+
+def _softmax_kernel(x_ref, o_ref):
+    x = x_ref[:].astype(jnp.float32)
+    m = x.max(axis=-1, keepdims=True)
+    e = jnp.exp(x - m)
+    o_ref[:] = (e / e.sum(axis=-1, keepdims=True)).astype(o_ref.dtype)
+
+
+@jax.custom_vjp
+def fused_softmax(x):
+    """Last-axis softmax in one VMEM pass (max+exp+sum+div fused)."""
+    return _norm_call(_softmax_kernel, (), x)
+
+
+def _softmax_fwd(x):
+    y = fused_softmax(x)
+    return y, y
+
+
+def _softmax_bwd(y, g):
+    # d/dx softmax = y * (g - sum(g*y)) along the row
+    return (y * (g - jnp.sum(g * y, axis=-1, keepdims=True)),)
+
+
+fused_softmax.defvjp(_softmax_fwd, _softmax_bwd)
 
 
 # ---------------------------------------------------------------------------

@@ -101,7 +101,8 @@ def blockwise_attention(q, k, v, block_size=512, causal=False, scale=None):
     return _finalize(acc, l)
 
 
-def ring_attention(q, k, v, axis='sp', causal=False, scale=None):
+def ring_attention(q, k, v, axis='sp', causal=False, scale=None,
+                   use_flash=True, block_q=128, block_k=128):
     """Ring attention over the ``axis`` mesh axis (call under shard_map).
 
     Each device holds the local sequence chunk of q/k/v
@@ -109,6 +110,12 @@ def ring_attention(q, k, v, axis='sp', causal=False, scale=None):
     every q chunk has attended to the full sequence. Communication is
     sp-1 ppermutes of the local K/V — bandwidth-optimal and overlapped
     with compute by XLA (latency hiding via the ring schedule).
+
+    The local q×chunk block runs on the Pallas flash kernel
+    (ops/pallas_kernels.flash_attention_lse — online softmax in VMEM);
+    per-chunk normalized outputs are merged exactly via the kernel's
+    log-sum-exp. Pass ``use_flash=False`` for the plain-jnp accumulator
+    (used as the cross-check oracle in tests).
 
     causal=True assumes chunks are laid out in sequence order along the
     axis (chunk c owns positions [c*T_local, (c+1)*T_local)).
@@ -121,21 +128,56 @@ def ring_attention(q, k, v, axis='sp', causal=False, scale=None):
 
     qpos = jnp.arange(Tl)
 
-    def body(step, carry):
-        kk, vv, acc, m, l = carry
-        src = (my - step) % n                     # whose chunk we hold now
-        if causal:
-            # block-level causal: q chunk `my` vs k chunk `src`
-            kpos = jnp.arange(Tl)
-            gq = my * Tl + qpos                   # global positions
-            gk = src * Tl + kpos
-            mask = (gq[:, None] >= gk[None, :])[None, None]
-        else:
-            mask = None
-        acc, m, l = _block_accum(q, kk, vv, (acc, m, l), scale, mask)
-        kk = lax.ppermute(kk, axis, perm)
-        vv = lax.ppermute(vv, axis, perm)
-        return kk, vv, acc, m, l
+    if use_flash:
+        from ..ops.pallas_kernels import flash_attention_lse
+
+        def body(step, carry):
+            kk, vv, acc, m, l = carry
+            src = (my - step) % n                 # whose chunk we hold now
+            if causal:
+                # diagonal chunk: causal flash; earlier chunks: full
+                # attention; later chunks: computed then discarded (w=0)
+                o, lse = lax.cond(
+                    src == my,
+                    lambda: flash_attention_lse(q, kk, vv, True, scale,
+                                                block_q, block_k),
+                    lambda: flash_attention_lse(q, kk, vv, False, scale,
+                                                block_q, block_k))
+                valid = src <= my
+                lse = jnp.where(valid, lse, _NEG)
+            else:
+                valid = True
+                o, lse = flash_attention_lse(q, kk, vv, False, scale,
+                                             block_q, block_k)
+            # exact merge of normalized chunk outputs via their lse
+            m_new = jnp.maximum(m, lse)
+            corr = jnp.exp(m - m_new)
+            w = jnp.exp(lse - m_new)              # [B,H,Tl]
+            # a discarded chunk meeting a still-empty accumulator gives
+            # exp(_NEG - _NEG) = 1: force its weight to zero explicitly
+            w = jnp.where(valid, w, 0.0)
+            acc = (acc * corr.transpose(0, 2, 1)[..., None] +
+                   o * w.transpose(0, 2, 1)[..., None])
+            l = l * corr + w
+            kk = lax.ppermute(kk, axis, perm)
+            vv = lax.ppermute(vv, axis, perm)
+            return kk, vv, acc, m_new, l
+    else:
+        def body(step, carry):
+            kk, vv, acc, m, l = carry
+            src = (my - step) % n                 # whose chunk we hold now
+            if causal:
+                # block-level causal: q chunk `my` vs k chunk `src`
+                kpos = jnp.arange(Tl)
+                gq = my * Tl + qpos               # global positions
+                gk = src * Tl + kpos
+                mask = (gq[:, None] >= gk[None, :])[None, None]
+            else:
+                mask = None
+            acc, m, l = _block_accum(q, kk, vv, (acc, m, l), scale, mask)
+            kk = lax.ppermute(kk, axis, perm)
+            vv = lax.ppermute(vv, axis, perm)
+            return kk, vv, acc, m, l
 
     init = (k, v,
             jnp.zeros_like(q),

@@ -257,11 +257,7 @@ class LSTMCell(BaseRNNCell):
                                          name='%sc' % name)
         out_gate = symbol.Activation(slice_gates[3], act_type='sigmoid',
                                      name='%so' % name)
-        next_c = symbol._invoke_sym('elemwise_add',
-                                    [forget_gate * states[1],
-                                     in_gate * in_transform],
-                                    {'name': '%sstate' % name}) \
-            if False else forget_gate * states[1] + in_gate * in_transform
+        next_c = forget_gate * states[1] + in_gate * in_transform
         next_h = out_gate * symbol.Activation(next_c, act_type='tanh')
         return next_h, [next_h, next_c]
 
@@ -552,8 +548,7 @@ class ZoneoutCell(ModifierCell):
         next_output, next_states = cell(inputs, states)
 
         def mask(p, like):
-            return symbol.Dropout(symbol._invoke_sym(
-                '_ones', [], {'shape': (0,)}) if False else like * 0 + 1, p=p)
+            return symbol.Dropout(like * 0 + 1, p=p)
 
         prev_output = self.prev_output if self.prev_output is not None \
             else next_output * 0

@@ -1,0 +1,74 @@
+"""Gradient-memory tradeoff (remat) — MXTPU_BACKWARD_DO_MIRROR.
+
+Reference: MXNET_BACKWARD_DO_MIRROR (graph_executor.cc:273-287) and the
+memory/speed tradeoff documented in BASELINE.md. The XLA form is
+jax.checkpoint over the traced forward; this asserts the semantics are
+unchanged: loss and gradients bit-for-tol identical with mirroring on.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def _bound_exec():
+    data = mx.sym.Variable('data')
+    w1 = mx.sym.Variable('w1')
+    w2 = mx.sym.Variable('w2')
+    h = mx.sym.Activation(mx.sym.dot(data, w1), act_type='tanh')
+    out = mx.sym.sum(mx.sym.dot(h, w2) ** 2)
+    rng = np.random.RandomState(0)
+    args = {'data': mx.nd.array(rng.standard_normal((8, 16))),
+            'w1': mx.nd.array(rng.standard_normal((16, 32)) * 0.1),
+            'w2': mx.nd.array(rng.standard_normal((32, 4)) * 0.1)}
+    grads = {n: mx.nd.zeros(a.shape) for n, a in args.items()}
+    return out.bind(mx.cpu(), args=args, args_grad=grads, grad_req='write')
+
+
+@pytest.mark.parametrize('mode', ['1', 'dots'])
+def test_mirror_matches_plain(mode, monkeypatch):
+    monkeypatch.delenv('MXTPU_BACKWARD_DO_MIRROR', raising=False)
+    e0 = _bound_exec()
+    e0.forward(is_train=True)
+    e0.backward()
+    out0 = e0.outputs[0].asnumpy()
+    g0 = {n: g.asnumpy().copy() for n, g in e0.grad_dict.items()}
+
+    monkeypatch.setenv('MXTPU_BACKWARD_DO_MIRROR', mode)
+    e1 = _bound_exec()
+    e1.forward(is_train=True)
+    e1.backward()
+    np.testing.assert_allclose(e1.outputs[0].asnumpy(), out0,
+                               rtol=1e-6, atol=1e-6)
+    for n, g in e1.grad_dict.items():
+        np.testing.assert_allclose(g.asnumpy(), g0[n],
+                                   rtol=1e-6, atol=1e-6, err_msg=n)
+
+
+def test_mirror_gluon_hybrid(monkeypatch):
+    from mxnet_tpu import gluon
+
+    def run():
+        net = gluon.nn.Dense(4, in_units=8)
+        net.initialize(mx.init.One())
+        net.hybridize()
+        x = mx.nd.array(np.arange(16, dtype='float32').reshape(2, 8))
+        with mx.autograd.record():
+            y = net(x)
+            L = (y * y).sum()
+        L.backward()
+        # key by param-name suffix: the global name counter differs
+        # between the two net instances (dense0_ vs dense1_)
+        return (L.asnumpy(),
+                {k.split('_', 1)[-1]: v.grad().asnumpy().copy()
+                 for k, v in net.collect_params().items()})
+
+    monkeypatch.delenv('MXTPU_BACKWARD_DO_MIRROR', raising=False)
+    l0, g0 = run()
+    monkeypatch.setenv('MXTPU_BACKWARD_DO_MIRROR', '1')
+    l1, g1 = run()
+    np.testing.assert_allclose(l1, l0, rtol=1e-6)
+    for k in g0:
+        np.testing.assert_allclose(g1[k], g0[k], rtol=1e-6, err_msg=k)

@@ -98,6 +98,95 @@ def test_softmax_xent():
                                rtol=1e-4, atol=1e-4)
 
 
+def test_flash_attention_lse():
+    """The lse output must equal logsumexp of the scaled scores — it is
+    the exact merge statistic ring attention relies on."""
+    q = _rand(2, 32, 2, 16, seed=20)
+    k = _rand(2, 32, 2, 16, seed=21)
+    v = _rand(2, 32, 2, 16, seed=22)
+    out, lse = pk.flash_attention_lse(q, k, v, False, None, 16, 16)
+    scale = 16 ** -0.5
+    s = jnp.einsum('bqhd,bkhd->bhqk', q * scale, k)
+    np.testing.assert_allclose(np.asarray(lse),
+                               np.asarray(jax.nn.logsumexp(s, -1)),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(attention_reference(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_softmax():
+    x = _rand(32, 40, seed=23)
+    y = pk.fused_softmax(x)
+    np.testing.assert_allclose(np.asarray(y),
+                               np.asarray(jax.nn.softmax(x, -1)),
+                               rtol=1e-5, atol=1e-6)
+    g1 = jax.grad(lambda x: (pk.fused_softmax(x) ** 2).sum())(x)
+    g2 = jax.grad(lambda x: (jax.nn.softmax(x, -1) ** 2).sum())(x)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_registry_ops_dispatch_to_pallas(monkeypatch):
+    """LayerNorm / softmax / softmax_cross_entropy invoke the fused
+    kernels when the dispatch policy is on (TPU, or forced here) and
+    match their jnp formulations."""
+    from mxnet_tpu.ops.registry import get
+    x = _rand(8, 32, seed=24)
+    gamma = _rand(32, seed=25)
+    beta = _rand(32, seed=26)
+    labels = jnp.asarray(np.random.RandomState(27).randint(0, 32, 8),
+                         jnp.int32)
+    plain = {
+        'LayerNorm': get('LayerNorm').fn({}, x, gamma, beta),
+        'softmax': get('softmax').fn({}, x),
+        'xent': get('softmax_cross_entropy').fn({}, x, labels),
+    }
+    monkeypatch.setenv('MXTPU_FORCE_PALLAS', '1')
+    assert pk.use_fused()
+    fused = {
+        'LayerNorm': get('LayerNorm').fn({}, x, gamma, beta),
+        'softmax': get('softmax').fn({}, x),
+        'xent': get('softmax_cross_entropy').fn({}, x, labels),
+    }
+    for name in plain:
+        np.testing.assert_allclose(np.asarray(fused[name]),
+                                   np.asarray(plain[name]),
+                                   rtol=1e-5, atol=1e-5, err_msg=name)
+
+
+def test_ring_flash_vs_plain_accumulator():
+    """ring_attention's flash path (default) against its plain-jnp
+    accumulator on the same mesh — bit-for-tol identical merges."""
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    import functools as ft
+    mesh = make_mesh({'sp': 4})
+    q = _rand(2, 64, 2, 16, seed=30)
+    k = _rand(2, 64, 2, 16, seed=31)
+    v = _rand(2, 64, 2, 16, seed=32)
+    spec = P(None, 'sp', None, None)
+    for causal in (False, True):
+        outs = {}
+        for use_flash in (True, False):
+            fn = ft.partial(shard_map,
+                            mesh=mesh.mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec, check_vma=False)(
+                lambda q, k, v, uf=use_flash, c=causal: ring_attention(
+                    q, k, v, axis='sp', causal=c, use_flash=uf,
+                    block_q=16, block_k=16))
+            outs[use_flash] = fn(q, k, v)
+        ref = attention_reference(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(outs[True]),
+                                   np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+        np.testing.assert_allclose(np.asarray(outs[True]),
+                                   np.asarray(outs[False]),
+                                   rtol=2e-5, atol=2e-5)
+
+
 def test_flash_inside_jit_and_vs_blockwise():
     from mxnet_tpu.parallel.ring_attention import blockwise_attention
     q = _rand(2, 64, 2, 16, seed=10)
