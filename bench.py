@@ -11,16 +11,16 @@ vs_baseline divides by the strongest single-GPU reference number:
 P100 batch-32 ResNet-50 training at 181.53 img/s (BASELINE.md).
 
 Robustness (round-2 hardening): prints a heartbeat before the first
-device touch, probes backend init in a watchdog thread with a timeout,
-retries with backoff on transient init errors, and falls back to CPU
-(marked in the output) rather than hanging silently.
+device touch, probes the backend in a throwaway subprocess (a hung TPU
+tunnel can never wedge this process's backend lock), retries with
+backoff on transient init errors, and falls back to CPU (marked in the
+output) rather than hanging silently.
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 import json
 import os
 import sys
-import threading
 import time
 
 import numpy as np
@@ -28,8 +28,8 @@ import numpy as np
 BASELINE_IMG_S = 181.53  # P100, batch 32, docs/how_to/perf.md:150-190
 BATCH = int(os.environ.get('MXTPU_BENCH_BATCH', '32'))
 WARMUP_STEPS = 3
-INIT_ATTEMPTS = 3
-INIT_TIMEOUT_S = 240.0
+INIT_ATTEMPTS = int(os.environ.get('MXTPU_BENCH_INIT_ATTEMPTS', '3'))
+INIT_TIMEOUT_S = float(os.environ.get('MXTPU_BENCH_INIT_TIMEOUT', '240'))
 INIT_BACKOFF_S = 15.0
 
 # Peak dense bf16 FLOP/s per chip, by device_kind substring.
@@ -57,76 +57,69 @@ def _clear_backends():
         pass
 
 
-def _probe_devices(timeout_s, label):
-    """jax.devices() in a watchdog thread. Returns devices, raises the
-    probe's error, or returns None on timeout (probe thread abandoned —
-    note it may still hold jax's backend-init lock)."""
-    import jax
-    result = {}
-
-    def probe():
-        try:
-            result['devices'] = jax.devices()
-        except Exception as e:  # noqa: BLE001 — report any init failure
-            result['error'] = e
-
-    th = threading.Thread(target=probe, daemon=True)
+def _probe_subprocess(timeout_s):
+    """Probe the default backend in a THROWAWAY subprocess so a hung TPU
+    runtime/tunnel can never wedge this process's backend-init lock.
+    Returns 'ok', 'error: ...', or 'timeout'."""
+    import subprocess
+    code = ('import jax; d = jax.devices(); '
+            'print("PROBE_OK", d[0].platform, flush=True)')
+    try:
+        proc = subprocess.Popen([sys.executable, '-c', code],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+    except OSError as e:
+        return 'error: %s' % e
     t0 = time.perf_counter()
-    th.start()
-    while th.is_alive():
-        th.join(timeout=10.0)
-        if th.is_alive():
+    while True:
+        try:
+            out, _ = proc.communicate(timeout=10.0)
+            if 'PROBE_OK' in (out or ''):
+                return 'ok'
+            tail = (out or '').strip().splitlines()
+            return 'error: %s' % (tail[-1] if tail else 'rc=%d'
+                                  % proc.returncode)
+        except subprocess.TimeoutExpired:
             waited = time.perf_counter() - t0
-            _log('  ...%s still initializing (%.0fs)' % (label, waited))
+            _log('  ...probe still initializing (%.0fs)' % waited)
             if waited > timeout_s:
-                _log('  %s init TIMED OUT after %.0fs' % (label, waited))
-                return None
-    if 'error' in result:
-        raise result['error']
-    return result['devices']
+                proc.kill()
+                return 'timeout'
 
 
 def init_backend():
-    """Initialize the JAX backend with heartbeats, a watchdog timeout,
-    retries, and a CPU fallback. Returns (devices, platform_note).
-    Exits fast with a clear message rather than hanging silently."""
+    """Initialize the JAX backend safely. The default platform is probed
+    in a subprocess first (with heartbeats + timeout + retries); only a
+    healthy backend is then initialized in-process. On persistent failure
+    the in-process backend — never touched so far — flips cleanly to CPU.
+    Returns (devices, platform_note)."""
     import jax
-    timed_out = False
     for attempt in range(1, INIT_ATTEMPTS + 1):
-        _log('backend init attempt %d/%d (timeout %ds)...'
+        _log('backend probe attempt %d/%d (timeout %ds)...'
              % (attempt, INIT_ATTEMPTS, INIT_TIMEOUT_S))
         t0 = time.perf_counter()
-        try:
-            devs = _probe_devices(INIT_TIMEOUT_S, 'backend')
-        except Exception as e:  # noqa: BLE001
-            _log('  backend init failed: %s' % e)
-            if attempt < INIT_ATTEMPTS:
-                _log('  retrying in %.0fs' % INIT_BACKOFF_S)
-                time.sleep(INIT_BACKOFF_S)
-                _clear_backends()
-                continue
-            break
-        if devs is None:
-            # hung probe still holds jax's backend-init lock; retrying or
-            # falling back in-process would block on that same lock
-            timed_out = True
-            break
-        _log('backend up in %.1fs: %s' % (time.perf_counter() - t0, devs))
-        return devs, devs[0].platform
+        status = _probe_subprocess(INIT_TIMEOUT_S)
+        if status == 'ok':
+            _log('probe healthy in %.1fs; initializing in-process'
+                 % (time.perf_counter() - t0))
+            devs = jax.devices()
+            _log('backend up: %s' % devs)
+            return devs, devs[0].platform
+        _log('  probe result: %s' % status)
+        if attempt < INIT_ATTEMPTS:
+            _log('  retrying in %.0fs' % INIT_BACKOFF_S)
+            time.sleep(INIT_BACKOFF_S)
     # Fall back to CPU so the harness still yields a (marked) number.
+    # Safe: this process has never initialized a backend, so no wedged
+    # lock — the config flip takes effect cleanly.
     _log('falling back to CPU backend')
     jax.config.update('jax_platforms', 'cpu')
     _clear_backends()
     try:
-        devs = _probe_devices(60.0 if timed_out else 300.0, 'cpu fallback')
+        devs = jax.devices()
     except Exception as e:  # noqa: BLE001
         _log('FATAL: cpu fallback failed: %s' % e)
         sys.exit(1)
-    if devs is None:
-        _log('FATAL: backend init is wedged (a hung probe thread holds '
-             "jax's backend lock); cannot recover in-process. "
-             'The TPU runtime/tunnel is unavailable — retry later.')
-        os._exit(1)
     _log('cpu backend up: %s' % devs)
     return devs, 'cpu(fallback)'
 
@@ -168,7 +161,12 @@ def build_train_step():
         def loss_fn(bf16_args):
             a = list(bf16_args)
             a[data_idx] = images
-            outs, new_aux = runner(tuple(a), aux, key, True)
+            # aux (BN running stats) also feed the graph in bf16 — fp32
+            # aux would promote activations to fp32 mid-network; the
+            # UPDATED stats are stored back as fp32 masters below
+            aux_bf16 = tuple(x.astype(jnp.bfloat16) for x in aux)
+            outs, new_aux = runner(tuple(a), aux_bf16, key, True)
+            new_aux = tuple(x.astype(jnp.float32) for x in new_aux)
             logits = outs[0].astype(jnp.float32)
             lse = jax.nn.logsumexp(logits, -1)
             gold = jnp.take_along_axis(logits, labels[:, None], -1)[:, 0]

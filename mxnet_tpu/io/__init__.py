@@ -22,7 +22,8 @@ from ..ndarray import NDArray, array
 from ..base import MXNetError
 
 __all__ = ['DataDesc', 'DataBatch', 'DataIter', 'NDArrayIter', 'CSVIter',
-           'MNISTIter', 'ResizeIter', 'PrefetchingIter', 'ImageRecordIter']
+           'MNISTIter', 'ResizeIter', 'PrefetchingIter', 'ImageRecordIter',
+           'LibSVMIter']
 
 
 class DataDesc(namedtuple('DataDesc', ['name', 'shape'])):
@@ -437,6 +438,102 @@ class CSVIter(NDArrayIter):
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle='pad' if round_batch else 'discard',
                          data_name='data', label_name='label')
+
+
+class LibSVMIter(DataIter):
+    """Reference src/io/iter_libsvm.cc — sparse libsvm text format.
+
+    Each line: ``label [label...] idx:value idx:value ...`` (indices
+    0-based like the reference's default). Batches come out as
+    CSRNDArray data (the sparse path the reference feeds to sparse
+    FullyConnected / linear models) with dense label arrays. An optional
+    separate ``label_libsvm`` file provides multi-dim sparse labels,
+    densified per batch.
+    """
+
+    def __init__(self, data_libsvm, data_shape, label_libsvm=None,
+                 label_shape=None, batch_size=1, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape) if not isinstance(
+            data_shape, int) else (data_shape,)
+        ncol = int(np.prod(self.data_shape))
+        rows, labels = self._parse(data_libsvm, ncol)
+        self._csr = rows                     # scipy csr [N, ncol]
+        if label_libsvm is not None:
+            lab_ncol = int(np.prod(label_shape)) if label_shape else 1
+            lab, _ = self._parse(label_libsvm, lab_ncol, labels_inline=False)
+            self._labels = np.asarray(lab.todense(), np.float32)
+        else:
+            self._labels = np.asarray(labels, np.float32)
+        self.num_data = self._csr.shape[0]
+        if self.num_data < batch_size:
+            raise ValueError('fewer rows (%d) than batch_size (%d)'
+                             % (self.num_data, batch_size))
+        self.round_batch = round_batch
+        self.provide_data = [DataDesc('data', (batch_size,) + self.data_shape)]
+        lshape = (batch_size,) if self._labels.ndim == 1 else \
+            (batch_size,) + self._labels.shape[1:]
+        self.provide_label = [DataDesc('label', lshape)]
+        self.reset()
+
+    @staticmethod
+    def _parse(path, ncol, labels_inline=True):
+        import scipy.sparse as sp
+        data, indices, indptr, labels = [], [], [0], []
+        with open(path) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                i = 0
+                if labels_inline:
+                    labels.append(float(parts[0]))
+                    i = 1
+                for tok in parts[i:]:
+                    idx, val = tok.split(':')
+                    indices.append(int(idx))
+                    data.append(float(val))
+                indptr.append(len(data))
+        mat = sp.csr_matrix(
+            (np.asarray(data, np.float32),
+             np.asarray(indices, np.int64), np.asarray(indptr, np.int64)),
+            shape=(len(indptr) - 1, ncol))
+        return mat, np.asarray(labels, np.float32)
+
+    def reset(self):
+        self._cursor = 0
+
+    def iter_next(self):
+        if self._cursor + self.batch_size <= self.num_data:
+            return True
+        if self.round_batch and self._cursor < self.num_data:
+            return True
+        return False
+
+    def next(self):
+        if not self.iter_next():
+            raise StopIteration
+        from ..ndarray.sparse import csr_matrix as _csr_nd
+        start = self._cursor
+        stop = start + self.batch_size
+        pad = 0
+        if stop <= self.num_data:
+            sub = self._csr[start:stop]
+            lab = self._labels[start:stop]
+        else:  # wrap-around pad (reference round_batch semantics)
+            pad = stop - self.num_data
+            import scipy.sparse as sp
+            sub = sp.vstack([self._csr[start:], self._csr[:pad]]).tocsr()
+            lab = np.concatenate([self._labels[start:], self._labels[:pad]])
+        self._cursor = stop
+        data = _csr_nd((sub.data, sub.indices, sub.indptr),
+                       shape=(self.batch_size,) + self.data_shape)
+        from .. import ndarray as _nd
+        return DataBatch(data=[data], label=[_nd.array(lab)], pad=pad,
+                         index=None)
+
+    def getpad(self):
+        return 0
 
 
 class ImageRecordIter(DataIter):

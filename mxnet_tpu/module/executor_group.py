@@ -5,21 +5,27 @@ the batch over contexts by workload, _bind_ith_exec:584 per-device
 simple_bind with shared memory pool, forward/backward fan-out,
 _merge_multi_context:75).
 
-TPU note: on a mesh the idiomatic path is ONE pjit over all chips
-(parallel/), which Module uses when given a single tpu context with a mesh;
-this class preserves the reference's explicit per-context semantics for
-multi-context CPU/TPU lists (and the multi-device-without-cluster tests).
+TPU note: when the context list is homogeneous (the common data-parallel
+case) Module uses :class:`SPMDExecutorGroup` instead — ONE GSPMD
+computation over a jax Mesh of the devices, with the gradient all-reduce
+compiled into the step (the reference's KVStore push becomes a psum by
+construction). This class keeps the reference's explicit per-context
+semantics for heterogeneous/unequal-workload setups and as the fallback.
 """
 import logging
+import os
 
 import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from ..io import DataDesc
 from ..executor import Executor
 
-__all__ = ['DataParallelExecutorGroup']
+__all__ = ['DataParallelExecutorGroup', 'SPMDExecutorGroup']
 
 
 def _load_general(data, targets, major_axis):
@@ -256,6 +262,179 @@ class DataParallelExecutorGroup:
                     labels_slice.append(
                         nd.array(label.asnumpy()[islice]))
             eval_metric.update(labels_slice, texec.outputs)
+
+    def install_monitor(self, mon):
+        for e in self.execs:
+            mon.install(e)
+
+
+class SPMDExecutorGroup:
+    """GSPMD form of DataParallelExecutorGroup: one executor, one mesh.
+
+    The reference's per-batch step is slice → per-device executors →
+    KVStore reduce → update → broadcast (§3.3). Here the full-batch
+    symbol is bound ONCE and its fused fwd+bwd jit runs over a 1-d
+    ``dp`` Mesh of the bound contexts: data/label arrays carry a
+    batch-sharded NamedSharding, parameters a replicated one, and XLA's
+    partitioner inserts the gradient all-reduce exactly where the
+    reference pushed to the KVStore — compiled into the step and
+    overlapped with backprop. Gradients surface already merged, so
+    Module's update (or kvstore push/pull) runs the optimizer once per
+    parameter instead of once per device.
+
+    Exposes the DataParallelExecutorGroup surface Module relies on, with
+    single-entry per-device lists (there is one logical executor).
+    """
+
+    @staticmethod
+    def eligible(contexts, workload, batch_size, symbol):
+        if os.environ.get('MXTPU_NO_SPMD_MODULE'):
+            return False
+        if len(contexts) < 2:
+            return False
+        if len({c.device_type for c in contexts}) != 1:
+            return False
+        if workload and len(set(workload[:len(contexts)])) != 1:
+            return False  # unequal workloads need explicit slices
+        if batch_size % len(contexts):
+            return False  # NamedSharding needs an even batch split
+        try:
+            devs = {c.jax_device() for c in contexts}
+        except Exception:  # noqa: BLE001 — unresolvable device → fallback
+            return False
+        return len(devs) == len(contexts)
+
+    def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
+                 param_names, for_training, inputs_need_grad,
+                 shared_group=None, logger=logging, fixed_param_names=None,
+                 grad_req='write', state_names=None):
+        self.param_names = param_names
+        self.arg_names = symbol.list_arguments()
+        self.aux_names = symbol.list_auxiliary_states()
+        self.symbol = symbol
+        self.contexts = contexts
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.fixed_param_names = fixed_param_names or []
+        self.logger = logger
+        self.output_layouts = [0] * len(symbol.list_outputs())
+
+        self.mesh = Mesh(np.array([c.jax_device() for c in contexts]),
+                         ('dp',))
+        self._shard_data = NamedSharding(self.mesh, P('dp'))
+        self._replicate = NamedSharding(self.mesh, P())
+
+        self._data_names = [d.name if isinstance(d, DataDesc) else d[0]
+                            for d in data_shapes]
+        self._label_names = [] if not label_shapes else \
+            [d.name if isinstance(d, DataDesc) else d[0] for d in label_shapes]
+
+        if grad_req != 'null' and for_training:
+            self.grad_req = {}
+            for k in self.arg_names:
+                if k in self.param_names:
+                    self.grad_req[k] = 'null' if k in self.fixed_param_names \
+                        else grad_req
+                elif k in self._data_names:
+                    self.grad_req[k] = grad_req if inputs_need_grad else 'null'
+                else:
+                    self.grad_req[k] = 'null'
+        else:
+            self.grad_req = {k: 'null' for k in self.arg_names}
+
+        self.bind_exec(data_shapes, label_shapes, shared_group)
+
+    # -- binding ---------------------------------------------------------
+    def bind_exec(self, data_shapes, label_shapes, shared_group=None,
+                  reshape=False):
+        self.data_shapes = data_shapes
+        self.label_shapes = label_shapes
+        shapes = {(d.name if isinstance(d, DataDesc) else d[0]):
+                  (d.shape if isinstance(d, DataDesc) else d[1])
+                  for d in data_shapes}
+        if label_shapes:
+            shapes.update({(d.name if isinstance(d, DataDesc) else d[0]):
+                           (d.shape if isinstance(d, DataDesc) else d[1])
+                           for d in label_shapes})
+        self.batch_size = next(iter(shapes.values()))[0]
+        exec_ = self.symbol.simple_bind(self.contexts[0],
+                                        grad_req=self.grad_req, **shapes)
+        self.execs = [exec_]
+        self.slices = [slice(0, self.batch_size)]
+        self.param_arrays = [[exec_.arg_dict[n]] for n in self.param_names]
+        self.grad_arrays = [[exec_.grad_dict.get(n)] for n in
+                            self.param_names] if self.for_training else \
+            [[None] for _ in self.param_names]
+        if self.inputs_need_grad:
+            self.input_grad_arrays = [[exec_.grad_dict[n]]
+                                      for n in self._data_names]
+        self.aux_arrays = [[exec_.aux_dict[n]] for n in self.aux_names]
+
+    def reshape(self, data_shapes, label_shapes):
+        if data_shapes == self.data_shapes and \
+                label_shapes == self.label_shapes:
+            return
+        self.bind_exec(data_shapes, label_shapes, reshape=True)
+
+    # -- params ----------------------------------------------------------
+    def set_params(self, arg_params, aux_params, allow_extra=False):
+        self.execs[0].copy_params_from(arg_params, aux_params,
+                                       allow_extra_params=allow_extra)
+        self._place_replicated()
+
+    def get_params(self, arg_params, aux_params):
+        for name, block in zip(self.param_names, self.param_arrays):
+            arg_params[name]._data = block[0]._data
+        for name, block in zip(self.aux_names, self.aux_arrays):
+            aux_params[name]._data = block[0]._data
+
+    def _place_replicated(self):
+        """Pin every non-data array to the replicated mesh sharding so
+        GSPMD sees params/aux as broadcast and grads come out psum'd."""
+        e = self.execs[0]
+        skip = set(self._data_names) | set(self._label_names)
+        for name, arr in e.arg_dict.items():
+            if name not in skip:
+                arr._data = jax.device_put(arr._data, self._replicate)
+        for arr in e.aux_dict.values():
+            arr._data = jax.device_put(arr._data, self._replicate)
+
+    # -- step ------------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        e = self.execs[0]
+        if is_train is None:
+            is_train = self.for_training
+        for name, src in zip(self._data_names, data_batch.data):
+            e.arg_dict[name]._data = jax.device_put(src._data,
+                                                    self._shard_data)
+        if self._label_names and data_batch.label:
+            for name, src in zip(self._label_names, data_batch.label):
+                e.arg_dict[name]._data = jax.device_put(src._data,
+                                                        self._shard_data)
+        self._place_replicated()
+        e.forward(is_train=is_train)
+
+    def backward(self, out_grads=None):
+        assert self.for_training, \
+            're-bind with for_training=True to run backward'
+        self.execs[0].backward(out_grads=out_grads)
+
+    # -- results ---------------------------------------------------------
+    def get_output_shapes(self):
+        return [(key, out.shape) for key, out in
+                zip(self.symbol.list_outputs(), self.execs[0].outputs)]
+
+    def get_outputs(self, merge_multi_context=True):
+        outs = self.execs[0].outputs
+        return list(outs) if merge_multi_context else [[o] for o in outs]
+
+    def get_input_grads(self, merge_multi_context=True):
+        assert self.inputs_need_grad
+        grads = [g[0] for g in self.input_grad_arrays]
+        return grads if merge_multi_context else self.input_grad_arrays
+
+    def update_metric(self, eval_metric, labels):
+        eval_metric.update(labels, self.execs[0].outputs)
 
     def install_monitor(self, mon):
         for e in self.execs:

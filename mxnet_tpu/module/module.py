@@ -15,7 +15,7 @@ from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore, load_checkpoint,
                      save_checkpoint)
 from .base_module import BaseModule, _check_input_names, _parse_data_desc
-from .executor_group import DataParallelExecutorGroup
+from .executor_group import DataParallelExecutorGroup, SPMDExecutorGroup
 
 __all__ = ['Module']
 
@@ -210,7 +210,14 @@ class Module(BaseModule):
             self.data_names, self.label_names, data_shapes, label_shapes)
 
         shared_group = None
-        self._exec_group = DataParallelExecutorGroup(
+        # homogeneous multi-device lists lower to ONE GSPMD computation
+        # over a dp mesh (grad all-reduce compiled into the step); the
+        # per-context loop remains for unequal workloads / odd batches
+        batch_axis_size = self._data_shapes[0].shape[0]
+        group_cls = SPMDExecutorGroup if SPMDExecutorGroup.eligible(
+            self._context, self._work_load_list, batch_axis_size,
+            self._symbol) else DataParallelExecutorGroup
+        self._exec_group = group_cls(
             self._symbol, self._context, self._work_load_list,
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group, logger=self.logger,

@@ -175,10 +175,11 @@ class NDArray:
         return invoke('Cast', [self], {'dtype': str(dtype)})
 
     def tostype(self, stype):
-        if stype != 'default':
-            raise NotImplementedError('sparse storage is provided by '
-                                      'mxnet_tpu.ndarray.sparse')
-        return self
+        """Reference cast_storage: dense → row_sparse / csr containers."""
+        if stype == 'default':
+            return self
+        from .sparse import cast_storage
+        return cast_storage(self, stype)
 
     # -- autograd ---------------------------------------------------------
     def attach_grad(self, grad_req='write', stype=None):
@@ -579,6 +580,13 @@ def array(source_array, ctx=None, dtype=None):
             elif dtype == np.int64:
                 dtype = np.int32
     d = np_dtype(dtype)
+    if not jax.config.jax_enable_x64 and d is not None:
+        # jax silently truncates 64-bit dtypes when x64 is off; request
+        # the narrowed dtype up front to keep the conversion warning-free
+        if np.dtype(d) == np.int64:
+            d = np.int32
+        elif np.dtype(d) == np.float64:
+            d = np.float32
     data = jax.device_put(jnp.asarray(src, dtype=d), ctx.jax_device())
     return NDArray(data, ctx)
 

@@ -85,11 +85,13 @@ def _conv_nd(data, weight, stride, dilate, pad, groups):
     dn = jax.lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ('NCHW', 'OIHW', 'NCHW') if nd == 2 else ('NCDHW', 'OIDHW', 'NCDHW'))
+    # no preferred_element_type: jax's conv transpose rule can't mix an
+    # f32 cotangent with bf16 operands, and XLA:TPU accumulates bf16
+    # convs in f32 on the MXU regardless
     return jax.lax.conv_general_dilated(
         data, weight, window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
-        dimension_numbers=dn, feature_group_count=groups,
-        preferred_element_type=jnp.float32).astype(data.dtype)
+        dimension_numbers=dn, feature_group_count=groups).astype(data.dtype)
 
 
 @register('Deconvolution', input_names=['data', 'weight', 'bias'],

@@ -143,14 +143,45 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
     return _flash_fwd_impl(q, k, v, causal, s, block_q, block_k)[0]
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_lse(q, k, v, causal=False, scale=None, block_q=128,
                         block_k=128):
     """flash_attention that also returns the per-row log-sum-exp
     [B, H, Tq] — the merge statistic ring attention needs to combine
-    normalized chunk outputs exactly. Forward-only (no custom vjp);
-    differentiate through the ring's recompute path instead."""
+    normalized chunk outputs exactly. Backward recomputes via the
+    reference formulation (flash-paper strategy), with the lse cotangent
+    folded in (ring attention's merge weights depend on lse)."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
     return _flash_fwd_impl(q, k, v, causal, s, block_q, block_k)
+
+
+def _flash_lse_ref(q, k, v, causal, scale):
+    """(out, lse) in plain jnp — the differentiable oracle for the
+    kernel's backward."""
+    s = jnp.einsum('bqhd,bkhd->bhqk', q * scale, k)
+    if causal:
+        Tq, Tk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Tq, Tk), bool), Tk - Tq)
+        s = jnp.where(mask, s, _NEG)
+    lse = jax.nn.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    return jnp.einsum('bhqk,bkhd->bqhd', p, v), lse
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k):
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_fwd_impl(q, k, v, causal, s, block_q, block_k), (q, k, v)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(lambda q, k, v: _flash_lse_ref(q, k, v, causal, s),
+                     q, k, v)
+    return vjp(g)
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 def _flash_ref(q, k, v, causal, scale):

@@ -1,0 +1,127 @@
+"""Module API tests, incl. the SPMD data-parallel path.
+
+Reference: tests/python/unittest/test_module.py. The multi-device cases
+use the 8-device CPU mesh the way the reference uses multiple cpu()
+contexts (test_multi_device_exec.py); the SPMD group must match the
+single-device results bit-for-tol.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.module.executor_group import (DataParallelExecutorGroup,
+                                             SPMDExecutorGroup)
+
+
+def _mlp():
+    data = mx.sym.Variable('data')
+    label = mx.sym.Variable('softmax_label')
+    h = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    h = mx.sym.Activation(h, act_type='relu')
+    out = mx.sym.FullyConnected(h, num_hidden=4, name='fc2')
+    return mx.sym.SoftmaxOutput(out, label, name='softmax')
+
+
+def _fixed_params():
+    rng = np.random.RandomState(42)
+    return {
+        'fc1_weight': mx.nd.array(rng.standard_normal((16, 8)) * 0.1),
+        'fc1_bias': mx.nd.zeros((16,)),
+        'fc2_weight': mx.nd.array(rng.standard_normal((4, 16)) * 0.1),
+        'fc2_bias': mx.nd.zeros((4,)),
+    }
+
+
+def _train(contexts, n_batches=4, batch=32):
+    rng = np.random.RandomState(7)
+    X = rng.standard_normal((n_batches * batch, 8)).astype('float32')
+    Y = rng.randint(0, 4, n_batches * batch).astype('float32')
+    mod = mx.mod.Module(_mlp(), context=contexts)
+    mod.bind(data_shapes=[('data', (batch, 8))],
+             label_shapes=[('softmax_label', (batch,))])
+    mod.set_params({k: v.copy() for k, v in _fixed_params().items()}, {},
+                   allow_missing=False)
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params={'learning_rate': 0.1,
+                                         'momentum': 0.9})
+    from mxnet_tpu.io import DataBatch
+    for i in range(n_batches):
+        sl = slice(i * batch, (i + 1) * batch)
+        mod.forward(DataBatch(data=[mx.nd.array(X[sl])],
+                              label=[mx.nd.array(Y[sl])]), is_train=True)
+        mod.backward()
+        mod.update()
+    arg, aux = mod.get_params()
+    return mod, {k: v.asnumpy().copy() for k, v in arg.items()}
+
+
+class TestSPMDModule:
+    def test_spmd_group_selected(self):
+        mod, _ = _train([mx.cpu(i) for i in range(8)], n_batches=1)
+        assert isinstance(mod._exec_group, SPMDExecutorGroup)
+
+    def test_fallback_on_odd_batch(self):
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(3)])
+        mod.bind(data_shapes=[('data', (32, 8))],
+                 label_shapes=[('softmax_label', (32,))])
+        assert isinstance(mod._exec_group, DataParallelExecutorGroup)
+
+    def test_spmd_matches_single_device(self):
+        _, single = _train([mx.cpu(0)])
+        _, spmd = _train([mx.cpu(i) for i in range(8)])
+        for k in single:
+            np.testing.assert_allclose(spmd[k], single[k],
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+
+    def test_spmd_matches_looped_group(self):
+        import os
+        _, spmd = _train([mx.cpu(i) for i in range(4)])
+        os.environ['MXTPU_NO_SPMD_MODULE'] = '1'
+        try:
+            _, looped = _train([mx.cpu(i) for i in range(4)])
+        finally:
+            del os.environ['MXTPU_NO_SPMD_MODULE']
+        for k in spmd:
+            np.testing.assert_allclose(spmd[k], looped[k],
+                                       rtol=1e-5, atol=1e-6, err_msg=k)
+
+    def test_spmd_outputs_and_metric(self):
+        batch = 16
+        mod = mx.mod.Module(_mlp(), context=[mx.cpu(i) for i in range(8)])
+        mod.bind(data_shapes=[('data', (batch, 8))],
+                 label_shapes=[('softmax_label', (batch,))])
+        mod.set_params(_fixed_params(), {})
+        from mxnet_tpu.io import DataBatch
+        rng = np.random.RandomState(3)
+        x = mx.nd.array(rng.standard_normal((batch, 8)).astype('float32'))
+        y = mx.nd.array(rng.randint(0, 4, batch).astype('float32'))
+        mod.forward(DataBatch(data=[x], label=[y]), is_train=False)
+        outs = mod.get_outputs()
+        assert outs[0].shape == (batch, 4)
+        probs = outs[0].asnumpy()
+        np.testing.assert_allclose(probs.sum(-1), np.ones(batch), rtol=1e-5)
+        metric = mx.metric.create('acc')
+        mod.update_metric(metric, [y])
+        assert 0.0 <= metric.get()[1] <= 1.0
+
+
+class TestModuleBasics:
+    def test_fit_ndarrayiter(self):
+        """End-to-end Module.fit with kvstore over the SPMD group."""
+        rng = np.random.RandomState(0)
+        X = rng.standard_normal((128, 8)).astype('float32')
+        Y = (X[:, 0] > 0).astype('float32')
+        it = mx.io.NDArrayIter(X, Y, batch_size=32, label_name='softmax_label')
+        data = mx.sym.Variable('data')
+        label = mx.sym.Variable('softmax_label')
+        out = mx.sym.FullyConnected(data, num_hidden=2)
+        net = mx.sym.SoftmaxOutput(out, label, name='softmax')
+        mod = mx.mod.Module(net, context=[mx.cpu(i) for i in range(4)])
+        mod.fit(it, num_epoch=4,
+                optimizer_params={'learning_rate': 0.5},
+                initializer=mx.init.Xavier(),
+                eval_metric='acc')
+        it.reset()
+        metric = mx.metric.create('acc')
+        mod.score(it, metric)
+        assert metric.get()[1] > 0.8, metric.get()

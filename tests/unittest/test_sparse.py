@@ -1,0 +1,220 @@
+"""Sparse NDArray + sparse op invariants.
+
+Reference: tests/python/unittest/test_sparse_ndarray.py and
+test_sparse_operator.py — creation round trips, cast_storage both ways,
+sparse_retain, square_sum, dot(csr, dense) / dot(csrᵀ, dense)→rsp,
+elemwise add, CSR slicing, LibSVMIter, and the kvstore row_sparse path.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse as sp
+
+
+def _rand_rsp(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.standard_normal(shape).astype('float32')
+    mask = rng.uniform(size=shape[0]) < density
+    dense[~mask] = 0
+    return dense, sp.row_sparse_array(dense)
+
+
+def _rand_csr(shape, density=0.3, seed=0):
+    rng = np.random.RandomState(seed)
+    dense = rng.standard_normal(shape).astype('float32')
+    dense[rng.uniform(size=shape) >= density] = 0
+    return dense, sp.csr_matrix(dense)
+
+
+class TestCreation:
+    def test_rsp_round_trip(self):
+        dense, rsp = _rand_rsp((10, 4))
+        assert rsp.stype == 'row_sparse'
+        np.testing.assert_allclose(rsp.asnumpy(), dense)
+        # (data, indices) construction
+        rsp2 = sp.row_sparse_array((rsp.data, rsp.indices), shape=(10, 4))
+        np.testing.assert_allclose(rsp2.asnumpy(), dense)
+
+    def test_csr_round_trip(self):
+        dense, csr = _rand_csr((8, 6))
+        assert csr.stype == 'csr'
+        np.testing.assert_allclose(csr.asnumpy(), dense)
+        csr2 = sp.csr_matrix((csr.data, csr.indices, csr.indptr),
+                             shape=(8, 6))
+        np.testing.assert_allclose(csr2.asnumpy(), dense)
+
+    def test_zeros(self):
+        z = sp.zeros('row_sparse', (5, 3))
+        assert z.asnumpy().sum() == 0 and z.shape == (5, 3)
+        z = sp.zeros('csr', (5, 3))
+        assert z.asnumpy().sum() == 0
+
+    def test_scipy_array(self):
+        import scipy.sparse as ssp
+        m = ssp.random(6, 5, density=0.4, format='csr',
+                       random_state=0, dtype=np.float32)
+        nd = sp.array(m)
+        np.testing.assert_allclose(nd.asnumpy(), m.toarray(), rtol=1e-6)
+
+
+class TestCastStorage:
+    @pytest.mark.parametrize('stype', ['row_sparse', 'csr'])
+    def test_dense_to_sparse_and_back(self, stype):
+        dense, _ = _rand_csr((7, 5), seed=3)
+        nd = mx.nd.array(dense)
+        assert nd.stype == 'default'
+        casted = sp.cast_storage(nd, stype)
+        assert casted.stype == stype
+        np.testing.assert_allclose(casted.asnumpy(), dense)
+        back = sp.cast_storage(casted, 'default')
+        assert back.stype == 'default'
+        np.testing.assert_allclose(back.asnumpy(), dense)
+
+    def test_nd_tostype(self):
+        dense, _ = _rand_csr((4, 4), seed=5)
+        assert mx.nd.array(dense).tostype('csr').stype == 'csr'
+        assert mx.nd.array(dense).tostype('row_sparse').stype == 'row_sparse'
+
+
+class TestSparseRetain:
+    def test_retain_subset(self):
+        dense, rsp = _rand_rsp((12, 3), density=0.5, seed=7)
+        keep = mx.nd.array(np.array([0, 3, 5, 11], np.float32))
+        out = sp.sparse_retain(rsp, keep)
+        assert out.stype == 'row_sparse'
+        expected = np.zeros_like(dense)
+        for r in (0, 3, 5, 11):
+            expected[r] = dense[r]
+        np.testing.assert_allclose(out.asnumpy(), expected)
+
+    def test_retain_missing_rows_ok(self):
+        _, rsp = _rand_rsp((6, 2), density=0.3, seed=8)
+        out = sp.sparse_retain(rsp, np.arange(6))
+        np.testing.assert_allclose(out.asnumpy(), rsp.asnumpy())
+
+
+class TestSquareSum:
+    def test_all(self):
+        dense, rsp = _rand_rsp((9, 4), seed=9)
+        out = sp.square_sum(rsp)
+        np.testing.assert_allclose(float(out.asnumpy()),
+                                   (dense ** 2).sum(), rtol=1e-5)
+
+    def test_axis1_keepdims_rsp_out(self):
+        dense, rsp = _rand_rsp((9, 4), seed=10)
+        out = sp.square_sum(rsp, axis=1, keepdims=True)
+        assert out.stype == 'row_sparse'
+        np.testing.assert_allclose(out.asnumpy(),
+                                   (dense ** 2).sum(1, keepdims=True),
+                                   rtol=1e-5)
+
+    def test_axis0_dense_out(self):
+        dense, rsp = _rand_rsp((9, 4), seed=11)
+        out = sp.square_sum(rsp, axis=0)
+        assert out.stype == 'default'
+        np.testing.assert_allclose(out.asnumpy(), (dense ** 2).sum(0),
+                                   rtol=1e-5)
+
+
+class TestSparseDot:
+    def test_csr_dense(self):
+        a, csr = _rand_csr((6, 8), seed=12)
+        b = np.random.RandomState(13).standard_normal((8, 5)).astype('f4')
+        out = sp.dot(csr, mx.nd.array(b))
+        assert out.stype == 'default'
+        np.testing.assert_allclose(out.asnumpy(), a @ b, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_csr_T_dense_gives_rsp(self):
+        a, csr = _rand_csr((6, 8), seed=14)
+        b = np.random.RandomState(15).standard_normal((6, 3)).astype('f4')
+        out = sp.dot(csr, mx.nd.array(b), transpose_a=True)
+        assert out.stype == 'row_sparse'
+        np.testing.assert_allclose(out.asnumpy(), a.T @ b, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_dense_fallback(self):
+        a = np.random.RandomState(16).standard_normal((4, 4)).astype('f4')
+        out = sp.dot(mx.nd.array(a), mx.nd.array(a))
+        np.testing.assert_allclose(out.asnumpy(), a @ a, rtol=1e-5)
+
+
+class TestElemwise:
+    def test_rsp_add_rsp(self):
+        d1, r1 = _rand_rsp((10, 3), seed=17)
+        d2, r2 = _rand_rsp((10, 3), seed=18)
+        out = r1 + r2
+        assert out.stype == 'row_sparse'
+        np.testing.assert_allclose(out.asnumpy(), d1 + d2, rtol=1e-6)
+
+    def test_rsp_scalar_mul(self):
+        d, r = _rand_rsp((10, 3), seed=19)
+        out = r * 2.5
+        assert out.stype == 'row_sparse'
+        np.testing.assert_allclose(out.asnumpy(), d * 2.5, rtol=1e-6)
+
+
+class TestCSRSlice:
+    def test_row_slice(self):
+        dense, csr = _rand_csr((10, 6), seed=20)
+        sub = csr[2:7]
+        assert sub.stype == 'csr' and sub.shape == (5, 6)
+        np.testing.assert_allclose(sub.asnumpy(), dense[2:7])
+
+    def test_single_row(self):
+        dense, csr = _rand_csr((10, 6), seed=21)
+        np.testing.assert_allclose(csr[4].asnumpy(), dense[4:5])
+
+
+class TestLibSVMIter:
+    def _write_libsvm(self, path, dense, labels):
+        with open(path, 'w') as f:
+            for row, lab in zip(dense, labels):
+                toks = ['%g' % lab]
+                for j, v in enumerate(row):
+                    if v != 0:
+                        toks.append('%d:%g' % (j, v))
+                f.write(' '.join(toks) + '\n')
+
+    def test_batches(self, tmp_path):
+        rng = np.random.RandomState(22)
+        dense = rng.standard_normal((10, 6)).astype('f4')
+        dense[rng.uniform(size=dense.shape) > 0.4] = 0
+        labels = rng.randint(0, 2, 10).astype('f4')
+        p = str(tmp_path / 'a.libsvm')
+        self._write_libsvm(p, dense, labels)
+        it = mx.io.LibSVMIter(data_libsvm=p, data_shape=(6,), batch_size=4)
+        got_rows, got_labels = [], []
+        for batch in it:
+            assert batch.data[0].stype == 'csr'
+            arr = batch.data[0].asnumpy()
+            n = 4 - batch.pad
+            got_rows.append(arr[:n])
+            got_labels.append(batch.label[0].asnumpy()[:n])
+        got = np.concatenate(got_rows)
+        np.testing.assert_allclose(got, dense[:len(got)], rtol=1e-5)
+        np.testing.assert_allclose(np.concatenate(got_labels),
+                                   labels[:len(got)])
+        # reset + second epoch identical
+        it.reset()
+        again = next(it).data[0].asnumpy()
+        np.testing.assert_allclose(again, dense[:4], rtol=1e-5)
+
+
+class TestKVStoreRowSparse:
+    def test_local_row_sparse_pull(self):
+        kv = mx.kv.create('local')
+        shape = (8, 3)
+        kv.init('w', mx.nd.zeros(shape))
+        dense = np.arange(24, dtype='f4').reshape(shape)
+        kv.push('w', mx.nd.array(dense))
+        out = sp.zeros('row_sparse', shape)
+        rid = mx.nd.array(np.array([1, 5], 'f4'))
+        kv.row_sparse_pull('w', out=out, row_ids=rid)
+        got = out.asnumpy()
+        expected = np.zeros(shape, 'f4')
+        expected[[1, 5]] = dense[[1, 5]]
+        np.testing.assert_allclose(got, expected)
