@@ -1,0 +1,322 @@
+/*
+ * MxNetCpp.h — header-only C++ frontend over the C ABI (N20).
+ *
+ * Reference: cpp-package/include/mxnet-cpp/ (NDArray/Symbol/Executor/
+ * KVStore/Optimizer wrappers over c_api.h, ~3k LoC across 20 headers).
+ * Single-header here: the C ABI already carries the graph machinery, so
+ * the C++ layer is RAII handles + ergonomic operators, which is all the
+ * reference's was.
+ */
+#ifndef MXNET_TPU_CPP_MXNETCPP_H_
+#define MXNET_TPU_CPP_MXNETCPP_H_
+
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "../../../include/mxnet_tpu/c_api.h"
+
+namespace mxnet {
+namespace cpp {
+
+inline void Check(int rc) {
+  if (rc != 0) throw std::runtime_error(MXGetLastError());
+}
+
+/* reference: cpp-package/include/mxnet-cpp/base.h DeviceType */
+enum class DeviceType : int { kCPU = 1, kGPU = 2, kTPU = 6 };
+
+struct Context {
+  DeviceType type;
+  int id;
+  Context(DeviceType t = DeviceType::kCPU, int i = 0) : type(t), id(i) {}
+  static Context cpu(int id = 0) { return Context(DeviceType::kCPU, id); }
+  static Context tpu(int id = 0) { return Context(DeviceType::kTPU, id); }
+  static Context gpu(int id = 0) { return Context(DeviceType::kGPU, id); }
+};
+
+struct Shape : public std::vector<mx_uint> {
+  using std::vector<mx_uint>::vector;
+};
+
+/* reference: op_map.h — creator lookup table built once */
+class OpMap {
+ public:
+  static AtomicSymbolCreator Get(const std::string &name) {
+    static std::map<std::string, AtomicSymbolCreator> *map_ = [] {
+      auto *m = new std::map<std::string, AtomicSymbolCreator>();
+      mx_uint n;
+      AtomicSymbolCreator *creators;
+      Check(MXSymbolListAtomicSymbolCreators(&n, &creators));
+      for (mx_uint i = 0; i < n; ++i) {
+        const char *cname;
+        Check(MXSymbolGetAtomicSymbolName(creators[i], &cname));
+        (*m)[cname] = creators[i];
+      }
+      return m;
+    }();
+    auto it = map_->find(name);
+    if (it == map_->end())
+      throw std::runtime_error("unknown operator " + name);
+    return it->second;
+  }
+};
+
+class NDArray {
+ public:
+  NDArray() : handle_(nullptr) {}
+  explicit NDArray(NDArrayHandle h) : handle_(h) {}
+  NDArray(const Shape &shape, const Context &ctx, int dtype = 0) {
+    NDArrayHandle h;
+    Check(MXNDArrayCreateEx(shape.data(), (mx_uint)shape.size(),
+                            (int)ctx.type, ctx.id, 0, dtype, &h));
+    handle_ = h;
+  }
+  NDArray(const std::vector<float> &data, const Shape &shape,
+          const Context &ctx) : NDArray(shape, ctx) {
+    SyncCopyFromCPU(data.data(), data.size());
+  }
+  NDArray(const NDArray &) = delete;
+  NDArray &operator=(const NDArray &) = delete;
+  NDArray(NDArray &&o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  NDArray &operator=(NDArray &&o) noexcept {
+    if (this != &o) { Free(); handle_ = o.handle_; o.handle_ = nullptr; }
+    return *this;
+  }
+  ~NDArray() { Free(); }
+
+  void SyncCopyFromCPU(const float *data, size_t size) {
+    Check(MXNDArraySyncCopyFromCPU(handle_, data, size));
+  }
+  void SyncCopyToCPU(float *data, size_t size) const {
+    Check(MXNDArraySyncCopyToCPU(handle_, data, size));
+  }
+  std::vector<float> AsVector() const {
+    std::vector<float> out(Size());
+    SyncCopyToCPU(out.data(), out.size());
+    return out;
+  }
+  Shape GetShape() const {
+    mx_uint ndim;
+    const mx_uint *dims;
+    Check(MXNDArrayGetShape(handle_, &ndim, &dims));
+    return Shape(dims, dims + ndim);
+  }
+  size_t Size() const {
+    size_t n = 1;
+    for (auto d : GetShape()) n *= d;
+    return n;
+  }
+  int GetDType() const {
+    int dt;
+    Check(MXNDArrayGetDType(handle_, &dt));
+    return dt;
+  }
+  static void WaitAll() { Check(MXNDArrayWaitAll()); }
+
+  NDArrayHandle GetHandle() const { return handle_; }
+
+ private:
+  void Free() { if (handle_) MXNDArrayFree(handle_); }
+  NDArrayHandle handle_;
+};
+
+class Symbol {
+ public:
+  Symbol() : handle_(nullptr) {}
+  explicit Symbol(SymbolHandle h) : handle_(h) {}
+  static Symbol Variable(const std::string &name) {
+    SymbolHandle h;
+    Check(MXSymbolCreateVariable(name.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol Load(const std::string &fname) {
+    SymbolHandle h;
+    Check(MXSymbolCreateFromFile(fname.c_str(), &h));
+    return Symbol(h);
+  }
+  static Symbol FromJSON(const std::string &json) {
+    SymbolHandle h;
+    Check(MXSymbolCreateFromJSON(json.c_str(), &h));
+    return Symbol(h);
+  }
+  /* reference Operator::CreateSymbol — atomic create + compose */
+  static Symbol Create(const std::string &op, const std::string &name,
+                       const std::vector<std::string> &param_keys,
+                       const std::vector<std::string> &param_vals,
+                       const std::vector<std::string> &input_keys,
+                       const std::vector<const Symbol *> &inputs) {
+    std::vector<const char *> pk, pv, ik;
+    for (auto &s : param_keys) pk.push_back(s.c_str());
+    for (auto &s : param_vals) pv.push_back(s.c_str());
+    for (auto &s : input_keys) ik.push_back(s.c_str());
+    std::vector<SymbolHandle> ih;
+    for (auto *s : inputs) ih.push_back(s->GetHandle());
+    SymbolHandle h;
+    Check(MXSymbolCreateAtomicSymbol(OpMap::Get(op), (mx_uint)pk.size(),
+                                     pk.data(), pv.data(), &h));
+    Check(MXSymbolCompose(h, name.c_str(), (mx_uint)ih.size(),
+                          ik.empty() ? nullptr : ik.data(), ih.data()));
+    return Symbol(h);
+  }
+
+  Symbol(const Symbol &) = delete;
+  Symbol &operator=(const Symbol &) = delete;
+  Symbol(Symbol &&o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
+  Symbol &operator=(Symbol &&o) noexcept {
+    if (this != &o) { Free(); handle_ = o.handle_; o.handle_ = nullptr; }
+    return *this;
+  }
+  ~Symbol() { Free(); }
+
+  std::vector<std::string> ListArguments() const {
+    return StrList(&MXSymbolListArguments);
+  }
+  std::vector<std::string> ListOutputs() const {
+    return StrList(&MXSymbolListOutputs);
+  }
+  std::string ToJSON() const {
+    const char *json;
+    Check(MXSymbolSaveToJSON(handle_, &json));
+    return json;
+  }
+  void Save(const std::string &fname) const {
+    Check(MXSymbolSaveToFile(handle_, fname.c_str()));
+  }
+  SymbolHandle GetHandle() const { return handle_; }
+
+ private:
+  void Free() { if (handle_) MXSymbolFree(handle_); }
+  std::vector<std::string> StrList(
+      int (*fn)(SymbolHandle, mx_uint *, const char ***)) const {
+    mx_uint n;
+    const char **strs;
+    Check(fn(handle_, &n, &strs));
+    return std::vector<std::string>(strs, strs + n);
+  }
+  SymbolHandle handle_;
+};
+
+/* reference: operator.h — named-parameter builder over Symbol::Create */
+class Operator {
+ public:
+  explicit Operator(const std::string &op) : op_(op) {}
+  Operator &SetParam(const std::string &k, const std::string &v) {
+    param_keys_.push_back(k);
+    param_vals_.push_back(v);
+    return *this;
+  }
+  Operator &SetParam(const std::string &k, const char *v) {
+    return SetParam(k, std::string(v));
+  }
+  template <typename T>
+  Operator &SetParam(const std::string &k, const T &v) {
+    return SetParam(k, std::to_string(v));
+  }
+  Operator &SetInput(const std::string &k, const Symbol &s) {
+    input_keys_.push_back(k);
+    inputs_.push_back(&s);
+    return *this;
+  }
+  Symbol CreateSymbol(const std::string &name = "") {
+    return Symbol::Create(op_, name, param_keys_, param_vals_, input_keys_,
+                          inputs_);
+  }
+
+ private:
+  std::string op_;
+  std::vector<std::string> param_keys_, param_vals_, input_keys_;
+  std::vector<const Symbol *> inputs_;
+};
+
+class Executor {
+ public:
+  Executor(const Symbol &symbol, const Context &ctx,
+           std::vector<NDArray> *in_args,
+           std::vector<NDArray> *arg_grads = nullptr,
+           const std::vector<mx_uint> &grad_reqs = {}) {
+    std::vector<NDArrayHandle> args, grads;
+    for (auto &a : *in_args) args.push_back(a.GetHandle());
+    if (arg_grads)
+      for (auto &g : *arg_grads) grads.push_back(g.GetHandle());
+    else
+      grads.assign(args.size(), nullptr);
+    std::vector<mx_uint> reqs = grad_reqs;
+    if (reqs.empty()) reqs.assign(args.size(), arg_grads ? 1 : 0);
+    ExecutorHandle h;
+    Check(MXExecutorBind(symbol.GetHandle(), (int)ctx.type, ctx.id,
+                         (mx_uint)args.size(), args.data(), grads.data(),
+                         reqs.data(), 0, nullptr, &h));
+    handle_ = h;
+  }
+  Executor(const Executor &) = delete;
+  Executor &operator=(const Executor &) = delete;
+  ~Executor() { if (handle_) MXExecutorFree(handle_); }
+
+  void Forward(bool is_train) {
+    Check(MXExecutorForward(handle_, is_train ? 1 : 0));
+  }
+  void Backward(const std::vector<NDArray> &head_grads = {}) {
+    std::vector<NDArrayHandle> hg;
+    for (auto &g : head_grads) hg.push_back(g.GetHandle());
+    Check(MXExecutorBackward(handle_, (mx_uint)hg.size(),
+                             hg.empty() ? nullptr : hg.data()));
+  }
+  std::vector<NDArray> Outputs() {
+    mx_uint n;
+    NDArrayHandle *outs;
+    Check(MXExecutorOutputs(handle_, &n, &outs));
+    std::vector<NDArray> result;
+    for (mx_uint i = 0; i < n; ++i) result.emplace_back(outs[i]);
+    return result;
+  }
+
+ private:
+  ExecutorHandle handle_;
+};
+
+class KVStore {
+ public:
+  explicit KVStore(const std::string &type = "local") {
+    KVStoreHandle h;
+    Check(MXKVStoreCreate(type.c_str(), &h));
+    handle_ = h;
+  }
+  KVStore(const KVStore &) = delete;
+  KVStore &operator=(const KVStore &) = delete;
+  ~KVStore() { if (handle_) MXKVStoreFree(handle_); }
+
+  void Init(int key, const NDArray &val) {
+    NDArrayHandle vh = val.GetHandle();
+    Check(MXKVStoreInit(handle_, 1, &key, &vh));
+  }
+  void Push(int key, const NDArray &val, int priority = 0) {
+    NDArrayHandle vh = val.GetHandle();
+    Check(MXKVStorePush(handle_, 1, &key, &vh, priority));
+  }
+  void Pull(int key, NDArray *out, int priority = 0) {
+    NDArrayHandle oh = out->GetHandle();
+    Check(MXKVStorePull(handle_, 1, &key, &oh, priority));
+  }
+  int GetRank() const {
+    int r;
+    Check(MXKVStoreGetRank(handle_, &r));
+    return r;
+  }
+  int GetNumWorkers() const {
+    int n;
+    Check(MXKVStoreGetGroupSize(handle_, &n));
+    return n;
+  }
+
+ private:
+  KVStoreHandle handle_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  /* MXNET_TPU_CPP_MXNETCPP_H_ */

@@ -1,0 +1,265 @@
+/*
+ * c_api.h — C ABI of the TPU-native framework (N13).
+ *
+ * Reference: include/mxnet/c_api.h (146 MXNET_DLL functions). Same
+ * contract: opaque handles, int return codes (0 ok / -1 error with the
+ * message via MXGetLastError, thread-local), caller-visible strings and
+ * shape buffers owned by the library in thread-local storage, valid
+ * until the next call on the same thread.
+ *
+ * TPU-native design: the reference's C API fronts its C++ core; this
+ * framework's core is the XLA runtime hosted by CPython, so the library
+ * embeds the interpreter (initialized lazily on first call) and each
+ * entry point delegates to mxnet_tpu._c_api_impl. The data plane is
+ * unchanged — XLA executables on device — the C frontier carries
+ * control and host buffers only, exactly like the reference's.
+ */
+#ifndef MXNET_TPU_C_API_H_
+#define MXNET_TPU_C_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+#include <stddef.h>
+#include <stdbool.h>
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+
+typedef void *NDArrayHandle;
+typedef const void *FunctionHandle;
+typedef void *AtomicSymbolCreator;
+typedef void *SymbolHandle;
+typedef void *ExecutorHandle;
+typedef void *DataIterHandle;
+typedef void *KVStoreHandle;
+typedef void *RecordIOHandle;
+typedef void *CachedOpHandle;
+
+/*! Return the last error message on this thread (empty string if none). */
+const char *MXGetLastError();
+
+/* ------------------------------------------------------------- misc -- */
+int MXGetVersion(int *out);
+int MXRandomSeed(int seed);
+int MXNotifyShutdown();
+int MXSetNumOMPThreads(int thread_num);
+int MXSetProfilerConfig(int mode, const char *filename);
+int MXSetProfilerState(int state);
+int MXDumpProfile();
+
+/* ---------------------------------------------------------- ndarray -- */
+int MXNDArrayCreateNone(NDArrayHandle *out);
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out);
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out);
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size);
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size);
+int MXNDArrayWaitToRead(NDArrayHandle handle);
+int MXNDArrayWaitToWrite(NDArrayHandle handle);
+int MXNDArrayWaitAll();
+int MXNDArrayFree(NDArrayHandle handle);
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out);
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out);
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out);
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata);
+int MXNDArrayGetDType(NDArrayHandle handle, int *out);
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out);
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id);
+/*! Host mirror of the device buffer (fp32 for bf16 arrays); valid until
+ *  MXNDArrayFree(handle). */
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata);
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys);
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names);
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf);
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out);
+
+/* -------------------------------------------------------- operators -- */
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
+/*! Invoke an operator imperatively. If *num_outputs > 0, *outputs holds
+ *  caller-provided output handles; otherwise the library allocates them. */
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals);
+
+/* --------------------------------------------------------- autograd -- */
+int MXAutogradSetIsRecording(int is_recording, int *prev);
+int MXAutogradSetIsTraining(int is_training, int *prev);
+int MXAutogradIsRecording(bool *curr);
+int MXAutogradIsTraining(bool *curr);
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array,
+                            NDArrayHandle *grad_handles);
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph);
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int train_mode);
+
+/* --------------------------------------------------------- cachedop -- */
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
+int MXFreeCachedOp(CachedOpHandle handle);
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs);
+
+/* ----------------------------------------------------------- symbol -- */
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array);
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name);
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args,
+                                const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type);
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator,
+                               mx_uint num_param, const char **keys,
+                               const char **vals, SymbolHandle *out);
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out);
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out);
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out);
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out);
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname);
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json);
+int MXSymbolFree(SymbolHandle symbol);
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str);
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success);
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success);
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value);
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out);
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array);
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array);
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array);
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out);
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out);
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args);
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out);
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data,
+                       mx_uint *out_shape_size, const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data,
+                       mx_uint *aux_shape_size, const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data, int *complete);
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete);
+
+/* --------------------------------------------------------- executor -- */
+int MXExecutorFree(ExecutorHandle handle);
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str);
+int MXExecutorForward(ExecutorHandle handle, int is_train);
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads);
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out);
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out);
+
+/* ---------------------------------------------------------- data io -- */
+int MXListDataIters(mx_uint *out_size, DataIterHandle **out_array);
+int MXDataIterGetIterInfo(DataIterHandle creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions);
+int MXDataIterCreateIter(DataIterHandle creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out);
+int MXDataIterFree(DataIterHandle handle);
+int MXDataIterNext(DataIterHandle handle, int *out);
+int MXDataIterBeforeFirst(DataIterHandle handle);
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+
+/* ---------------------------------------------------------- kvstore -- */
+int MXKVStoreCreate(const char *type, KVStoreHandle *out);
+int MXKVStoreFree(KVStoreHandle handle);
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals);
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority);
+int MXKVStoreGetType(KVStoreHandle handle, const char **type);
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret);
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret);
+int MXKVStoreIsWorkerNode(int *ret);
+int MXKVStoreIsServerNode(int *ret);
+int MXKVStoreIsSchedulerNode(int *ret);
+int MXKVStoreBarrier(KVStoreHandle handle);
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
+                            int *number);
+int MXKVStoreRunServer(KVStoreHandle handle);
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body);
+
+/* --------------------------------------------------------- recordio -- */
+/* Native framed stream (src/recordio.cc) — no interpreter involved. */
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOWriterFree(RecordIOHandle handle);
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size);
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos);
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out);
+int MXRecordIOReaderFree(RecordIOHandle handle);
+/* *size set to (size_t)-1 at end of stream. */
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size);
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXNET_TPU_C_API_H_ */

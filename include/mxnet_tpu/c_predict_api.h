@@ -1,0 +1,71 @@
+/*
+ * c_predict_api.h — standalone inference ABI (N19).
+ *
+ * Reference: include/mxnet/c_predict_api.h (MXPredCreate family, 12
+ * functions) — the "amalgamation" deployment surface: load a saved
+ * symbol json + param blob, feed fp32 inputs, read fp32 outputs, no
+ * Python at the call site. Same contract here; the interpreter is an
+ * implementation detail embedded inside the library.
+ */
+#ifndef MXNET_TPU_C_PREDICT_API_H_
+#define MXNET_TPU_C_PREDICT_API_H_
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+#include <stdint.h>
+#include <stddef.h>
+
+typedef uint32_t mx_uint;
+typedef float mx_float;
+typedef void *PredictorHandle;
+typedef void *NDListHandle;
+
+const char *MXGetLastError();
+
+/*!
+ * Create a predictor from a symbol json string and a parameter blob
+ * (the byte contents of a `.params` file saved by this framework or
+ * written via MXNDArraySave).
+ * input_keys/input_shape_indptr/input_shape_data describe the named
+ * input shapes, CSR-style, as in the reference.
+ */
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out);
+
+/*! Same, keeping only the listed output heads. */
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id,
+                           mx_uint num_input_nodes, const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes,
+                           const char **output_keys, PredictorHandle *out);
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim);
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size);
+int MXPredForward(PredictorHandle handle);
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size);
+int MXPredFree(PredictorHandle handle);
+
+/*! Load an NDArray-save blob as a list of named fp32 arrays. */
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length);
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim);
+int MXNDListFree(NDListHandle handle);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* MXNET_TPU_C_PREDICT_API_H_ */

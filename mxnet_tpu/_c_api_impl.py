@@ -1,0 +1,758 @@
+"""Python side of the C ABI bridge.
+
+Reference: include/mxnet/c_api.h (146 MXNET_DLL entry points over opaque
+handles) and src/c_api/c_api.cc / c_api_symbolic.cc / c_api_executor.cc.
+
+Design (TPU-native): the reference's C API fronts a C++ core; here the
+core is the JAX/XLA runtime hosted by CPython, so the C ABI
+(src/c_api.cc) embeds the interpreter and delegates each entry point to
+one helper in this module. Handles crossing the ABI are CPython object
+pointers (ref-counted by the C layer); device compute still runs through
+XLA, so nothing is lost relative to the reference's dispatch path — the
+C frontier is control-plane only, exactly like the reference's (its data
+plane is cudnn/mshadow kernels; ours is XLA executables).
+
+Helpers accept/return only simple types (int/float/str/bytes/lists/
+tuples and handle objects) so the C marshalling layer stays mechanical.
+"""
+import pickle
+
+import numpy as np
+
+# Lazy imports: embedding apps call MXPredCreate before anything else and
+# must not pay package-import cost twice.
+from . import ndarray as _nd_mod
+from .ndarray import NDArray
+from .ndarray.ndarray import invoke as _nd_invoke, waitall as _nd_waitall
+from .ndarray import utils as _nd_utils
+from .context import Context
+from .ops import registry as _op_reg
+from .symbol import Symbol, Variable as _sym_var
+from .symbol.symbol import _invoke_sym, load_json as _sym_load_json
+from . import autograd as _autograd
+from . import kvstore as _kvstore_mod
+from . import random as _random_mod
+from . import profiler as _profiler_mod
+
+_DTYPE_TO_CODE = {'float32': 0, 'float64': 1, 'float16': 2, 'uint8': 3,
+                  'int32': 4, 'int8': 5, 'int64': 6, 'bfloat16': 7}
+_CODE_TO_DTYPE = {v: k for k, v in _DTYPE_TO_CODE.items()}
+_DEVTYPE = {1: 'cpu', 2: 'gpu', 3: 'cpu_pinned', 6: 'tpu'}
+_DEVTYPE_R = {'cpu': 1, 'gpu': 2, 'cpu_pinned': 3, 'tpu': 6}
+_STYPE = {'default': 0, 'row_sparse': 1, 'csr': 2}
+
+
+def _ctx(dev_type, dev_id):
+    name = _DEVTYPE.get(int(dev_type), 'cpu')
+    if name == 'cpu_pinned':
+        name = 'cpu'
+    return Context(name, int(dev_id))
+
+
+# ---------------------------------------------------------------- misc --
+
+def random_seed(seed):
+    _random_mod.seed(int(seed))
+    return 0
+
+
+def notify_shutdown():
+    _nd_waitall()
+    return 0
+
+
+def profiler_set_config(mode, filename):
+    _profiler_mod.profiler_set_config(mode=mode, filename=filename)
+    return 0
+
+
+def profiler_set_state(state):
+    _profiler_mod.profiler_set_state('run' if int(state) else 'stop')
+    return 0
+
+
+def profiler_dump():
+    _profiler_mod.dump_profile()
+    return 0
+
+
+# ------------------------------------------------------------- ndarray --
+
+def nd_create_none():
+    return NDArray(np.zeros((), dtype=np.float32))
+
+
+def nd_create(shape, dev_type, dev_id, delay_alloc, dtype_code):
+    dtype = _CODE_TO_DTYPE[int(dtype_code)]
+    if dtype == 'bfloat16':
+        import jax.numpy as jnp
+        import jax
+        data = jnp.zeros(tuple(shape), dtype=jnp.bfloat16)
+        return NDArray(data, ctx=_ctx(dev_type, dev_id))
+    return _nd_mod.zeros(tuple(shape), ctx=_ctx(dev_type, dev_id),
+                         dtype=dtype)
+
+
+def nd_sync_copy_from_bytes(handle, buf, dtype_code):
+    dtype = _CODE_TO_DTYPE[int(dtype_code)]
+    np_dtype = np.float32 if dtype == 'bfloat16' else np.dtype(dtype)
+    arr = np.frombuffer(buf, dtype=np_dtype).reshape(handle.shape)
+    handle[:] = arr if handle.ndim else _nd_mod.array(arr.reshape(()))
+    return 0
+
+
+def nd_sync_copy_to_bytes(handle):
+    npy = handle.asnumpy()
+    if npy.dtype.name == 'bfloat16':
+        npy = npy.astype(np.float32)
+    return npy.tobytes()
+
+
+def nd_wait_to_read(handle):
+    handle.wait_to_read()
+    return 0
+
+
+def nd_wait_all():
+    _nd_waitall()
+    return 0
+
+
+def nd_shape(handle):
+    return tuple(int(d) for d in handle.shape)
+
+
+def nd_dtype(handle):
+    return _DTYPE_TO_CODE.get(str(handle.dtype), 0)
+
+
+def nd_stype(handle):
+    return _STYPE.get(handle.stype, 0)
+
+
+def nd_context(handle):
+    c = handle.context
+    return (_DEVTYPE_R.get(c.device_type, 1), c.device_id)
+
+
+def nd_slice(handle, begin, end):
+    return handle[int(begin):int(end)]
+
+
+def nd_at(handle, idx):
+    return handle[int(idx)]
+
+
+def nd_reshape(handle, shape):
+    return handle.reshape(tuple(shape))
+
+
+def nd_save(fname, handles, keys):
+    if keys:
+        _nd_utils.save(fname, dict(zip(keys, handles)))
+    else:
+        _nd_utils.save(fname, list(handles))
+    return 0
+
+
+def nd_load(fname):
+    data = _nd_utils.load(fname)
+    if isinstance(data, dict):
+        keys = list(data.keys())
+        return keys, [data[k] for k in keys]
+    return [], list(data)
+
+
+def nd_save_raw_bytes(handle):
+    npy = handle.asnumpy()
+    if npy.dtype.name == 'bfloat16':
+        npy = npy.astype(np.float32)
+    header = pickle.dumps((npy.shape, npy.dtype.str))
+    return len(header).to_bytes(8, 'little') + header + npy.tobytes()
+
+
+def nd_load_from_raw_bytes(buf):
+    hlen = int.from_bytes(buf[:8], 'little')
+    shape, dtype = pickle.loads(buf[8:8 + hlen])
+    npy = np.frombuffer(buf[8 + hlen:], dtype=np.dtype(dtype)).reshape(shape)
+    return _nd_mod.array(npy)
+
+
+# Host mirror buffers for MXNDArrayGetData: NDArray is __slots__'d, so
+# pinned numpy views live here, keyed by handle id, until MXNDArrayFree.
+_HOST_MIRRORS = {}
+
+
+def nd_data_ptr(handle):
+    npy = handle.asnumpy()
+    if npy.dtype.name == 'bfloat16':
+        npy = npy.astype(np.float32)
+    npy = np.ascontiguousarray(npy)
+    _HOST_MIRRORS[id(handle)] = npy
+    return npy.ctypes.data
+
+
+def nd_free(handle):
+    _HOST_MIRRORS.pop(id(handle), None)
+    return 0
+
+
+def nd_get_grad(handle):
+    return handle.grad
+
+
+def nd_detach(handle):
+    return handle.detach()
+
+
+# ----------------------------------------------------------- operators --
+
+def list_all_op_names():
+    return sorted(_op_reg.list_ops())
+
+
+def op_info(name):
+    op = _op_reg.get(name)
+    arg_names = list(op.input_names) + list(op.param_defaults)
+    arg_types = (['NDArray-or-Symbol'] * len(op.input_names)
+                 + ['string'] * len(op.param_defaults))
+    arg_descs = [''] * len(arg_names)
+    return (name, op.doc or '', arg_names, arg_types, arg_descs,
+            op.key_var_num_args or '', '')
+
+
+def imperative_invoke(name, inputs, keys, vals, num_out_provided, outputs):
+    attrs = dict(zip(keys, vals))
+    out = None
+    if num_out_provided:
+        out = outputs if len(outputs) > 1 else outputs[0]
+    res = _nd_invoke(name, list(inputs), attrs, out)
+    if isinstance(res, (list, tuple)):
+        return list(res)
+    return [res]
+
+
+# ------------------------------------------------------------ autograd --
+
+def autograd_set_recording(flag):
+    prev = _autograd.is_recording()
+    _autograd.set_recording(bool(flag))
+    return int(prev)
+
+
+def autograd_set_training(flag):
+    prev = _autograd.is_training()
+    _autograd.set_training(bool(flag))
+    return int(prev)
+
+
+def autograd_is_recording():
+    return int(_autograd.is_recording())
+
+
+def autograd_is_training():
+    return int(_autograd.is_training())
+
+
+def autograd_mark_variables(arrays, grad_reqs, grads):
+    for arr, req in zip(arrays, grad_reqs):
+        req_name = {0: 'null', 1: 'write', 2: 'add'}.get(int(req), 'write')
+        arr.attach_grad(grad_req=req_name)
+    return 0
+
+
+def autograd_backward(outputs, head_grads, retain_graph, train_mode):
+    _autograd.backward(list(outputs),
+                       head_grads=None if not head_grads else list(head_grads),
+                       retain_graph=bool(retain_graph),
+                       train_mode=bool(train_mode))
+    return 0
+
+
+# ------------------------------------------------------------- symbols --
+
+class _AtomicSymbol:
+    """An op + attrs awaiting composition (MXSymbolCreateAtomicSymbol
+    result before MXSymbolCompose — reference nnvm Symbol::CreateFunctor)."""
+
+    __slots__ = ('op', 'attrs')
+
+    def __init__(self, op, attrs):
+        self.op = op
+        self.attrs = attrs
+
+
+def symbol_create_atomic(op_name, keys, vals):
+    if not _op_reg.exists(op_name):
+        raise ValueError('unknown operator %s' % op_name)
+    return _AtomicSymbol(op_name, dict(zip(keys, vals)))
+
+
+# MXSymbolCompose mutates in place in the reference (nnvm symbols are
+# mutable); ours are immutable, so composed results live here, keyed by
+# handle id, purged by symbol_free (called from MXSymbolFree).
+_COMPOSED = {}
+
+
+def symbol_compose(handle, name, keys, args):
+    """Compose an atomic symbol with its inputs → real Symbol."""
+    if isinstance(handle, _AtomicSymbol):
+        attrs = dict(handle.attrs)
+        if name:
+            attrs['name'] = name
+        if keys:
+            # keyword symbol args map onto the op's declared input names,
+            # in declaration order; leftovers are attrs
+            op = _op_reg.get(handle.op)
+            kw = {k: _as_symbol(a) for k, a in zip(keys, args)}
+            inputs = [kw.pop(n) for n in op.input_names if n in kw]
+            attrs.update(kw)
+            return _invoke_sym(handle.op, inputs, attrs)
+        return _invoke_sym(handle.op, [_as_symbol(a) for a in args], attrs)
+    sym = _as_symbol(handle)
+    if keys:
+        return sym(**{k: _as_symbol(a) for k, a in zip(keys, args)})
+    return sym(*[_as_symbol(a) for a in args])
+
+
+def symbol_compose_inplace(handle, name, keys, args):
+    _COMPOSED[id(handle)] = symbol_compose(handle, name, keys, args)
+    return 0
+
+
+def symbol_free(handle):
+    _COMPOSED.pop(id(handle), None)
+    return 0
+
+
+def _as_symbol(handle):
+    composed = _COMPOSED.get(id(handle))
+    if composed is not None:
+        return composed
+    if isinstance(handle, _AtomicSymbol):
+        return _invoke_sym(handle.op, [], dict(handle.attrs))
+    return handle
+
+
+def symbol_create_variable(name):
+    return _sym_var(name)
+
+
+def symbol_create_group(handles):
+    from .symbol import Group
+    return Group([_as_symbol(h) for h in handles])
+
+
+def symbol_from_json(json_str):
+    return _sym_load_json(json_str)
+
+
+def symbol_from_file(fname):
+    from .symbol import load as _sym_load
+    return _sym_load(fname)
+
+
+def symbol_to_json(handle):
+    return _as_symbol(handle).tojson()
+
+
+def symbol_save_file(handle, fname):
+    _as_symbol(handle).save(fname)
+    return 0
+
+
+def symbol_copy(handle):
+    import copy
+    return copy.copy(_as_symbol(handle))
+
+
+def symbol_print(handle):
+    return repr(_as_symbol(handle))
+
+
+def symbol_get_name(handle):
+    name = _as_symbol(handle).name
+    return name if name is not None else ''
+
+
+def symbol_get_attr(handle, key):
+    v = _as_symbol(handle).attr(key)
+    return v if v is not None else None
+
+
+def symbol_set_attr(handle, key, value):
+    _as_symbol(handle)._set_attr(**{key: value})
+    return 0
+
+
+def symbol_list_attr(handle):
+    d = _as_symbol(handle).attr_dict()
+    flat = []
+    for node_name, attrs in d.items():
+        for k, v in attrs.items():
+            flat.append('%s$%s' % (node_name, k))
+            flat.append(str(v))
+    return flat
+
+
+def symbol_list_arguments(handle):
+    return _as_symbol(handle).list_arguments()
+
+
+def symbol_list_outputs(handle):
+    return _as_symbol(handle).list_outputs()
+
+
+def symbol_list_aux(handle):
+    return _as_symbol(handle).list_auxiliary_states()
+
+
+def symbol_get_internals(handle):
+    return _as_symbol(handle).get_internals()
+
+
+def symbol_get_children(handle):
+    return _as_symbol(handle).get_children()
+
+
+def symbol_get_output(handle, index):
+    return _as_symbol(handle)[int(index)]
+
+
+def symbol_grad(handle, wrt):
+    return _as_symbol(handle).gradient(list(wrt))
+
+
+def _shape_kwargs(keys, arg_ind, arg_data):
+    kwargs = {}
+    for i, k in enumerate(keys):
+        kwargs[k] = tuple(arg_data[arg_ind[i]:arg_ind[i + 1]])
+    return kwargs
+
+
+def symbol_infer_shape(handle, keys, arg_ind, arg_data, partial):
+    sym = _as_symbol(handle)
+    kwargs = _shape_kwargs(keys, arg_ind, arg_data)
+    if partial:
+        arg_shapes, out_shapes, aux_shapes = sym.infer_shape_partial(**kwargs)
+    else:
+        arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**kwargs)
+    def pack(shapes):
+        return [tuple(int(d) for d in s) if s is not None else ()
+                for s in (shapes or [])]
+    return pack(arg_shapes), pack(out_shapes), pack(aux_shapes)
+
+
+def symbol_infer_type(handle, keys, dtype_codes):
+    sym = _as_symbol(handle)
+    kwargs = {k: _CODE_TO_DTYPE[int(c)] for k, c in zip(keys, dtype_codes)}
+    arg_t, out_t, aux_t = sym.infer_type(**kwargs)
+    def pack(ts):
+        return [_DTYPE_TO_CODE.get(str(np.dtype(t).name) if t is not None
+                                   else '', -1) if t is not None else -1
+                for t in (ts or [])]
+    return pack(arg_t), pack(out_t), pack(aux_t)
+
+
+# ----------------------------------------------------------- executors --
+
+def executor_bind(sym_handle, dev_type, dev_id, args, arg_grads, grad_reqs,
+                  aux_states):
+    sym = _as_symbol(sym_handle)
+    ctx = _ctx(dev_type, dev_id)
+    req_names = {0: 'null', 1: 'write', 3: 'add'}
+    arg_names = sym.list_arguments()
+    args_map = dict(zip(arg_names, args))
+    grads_map = {n: g for n, g in zip(arg_names, arg_grads or [])
+                 if g is not None}
+    reqs = {n: req_names.get(int(r), 'write')
+            for n, r in zip(arg_names, grad_reqs or [])} or 'write'
+    aux_map = dict(zip(sym.list_auxiliary_states(), aux_states or []))
+    return sym.bind(ctx, args_map, args_grad=grads_map or None,
+                    grad_req=reqs, aux_states=aux_map or None)
+
+
+def executor_forward(handle, is_train):
+    handle.forward(is_train=bool(is_train))
+    return 0
+
+
+def executor_backward(handle, out_grads):
+    handle.backward(out_grads=list(out_grads) if out_grads else None)
+    return 0
+
+
+def executor_outputs(handle):
+    return list(handle.outputs)
+
+
+def executor_print(handle):
+    return repr(handle)
+
+
+# ------------------------------------------------------------ cachedop --
+
+class _CachedOp:
+    """MXCreateCachedOp: a symbol specialized for repeated imperative calls
+    (reference src/imperative/cached_op.cc). Here: bind-once + jit reuse
+    keyed on input shapes, via Symbol.eval machinery."""
+
+    def __init__(self, sym):
+        self.sym = _as_symbol(sym)
+        self._cache = {}
+
+    def __call__(self, inputs):
+        names = self.sym.list_arguments()
+        key = tuple((a.shape, str(a.dtype)) for a in inputs)
+        ex = self._cache.get(key)
+        if ex is None:
+            ctx = inputs[0].context if inputs else Context('cpu', 0)
+            ex = self.sym.bind(ctx, dict(zip(names, inputs)),
+                               grad_req='null')
+            self._cache[key] = ex
+        else:
+            ex.copy_params_from(dict(zip(names, inputs)),
+                                allow_extra_params=True)
+        ex.forward(is_train=False)
+        return list(ex.outputs)
+
+
+def cached_op_create(sym_handle):
+    return _CachedOp(sym_handle)
+
+
+def cached_op_invoke(handle, inputs):
+    return handle(list(inputs))
+
+
+# ------------------------------------------------------------- kvstore --
+
+def kv_create(type_name):
+    return _kvstore_mod.create(type_name)
+
+
+def kv_init(handle, keys, values):
+    handle.init(list(keys), list(values))
+    return 0
+
+
+def kv_push(handle, keys, values, priority):
+    handle.push(list(keys), list(values), priority=int(priority))
+    return 0
+
+
+def kv_pull(handle, keys, outs, priority):
+    handle.pull(list(keys), out=list(outs), priority=int(priority))
+    return 0
+
+
+def kv_type(handle):
+    return handle.type
+
+
+def kv_rank(handle):
+    return handle.rank
+
+
+def kv_group_size(handle):
+    return handle.num_workers
+
+
+def kv_barrier(handle):
+    if hasattr(handle, '_barrier'):
+        handle._barrier()
+    return 0
+
+
+def kv_num_dead_node(handle, node_id):
+    if hasattr(handle, 'num_dead_node'):
+        return handle.num_dead_node(int(node_id))
+    return 0
+
+
+def kv_run_server(handle):
+    """MXKVStoreRunServer — blocks in the server role loop."""
+    from . import kvstore_server
+    kvstore_server.run_server()
+    return 0
+
+
+def kv_send_command(handle, cmd_id, cmd_body):
+    if hasattr(handle, '_send_command_to_servers'):
+        handle._send_command_to_servers(int(cmd_id), cmd_body)
+    return 0
+
+
+# ------------------------------------------------------------- dataio --
+
+_ITER_CLASSES = None
+
+
+def _iter_classes():
+    global _ITER_CLASSES
+    if _ITER_CLASSES is None:
+        from . import io as _io
+        _ITER_CLASSES = {
+            'MNISTIter': _io.MNISTIter,
+            'CSVIter': _io.CSVIter,
+            'ImageRecordIter': _io.ImageRecordIter,
+            'LibSVMIter': _io.LibSVMIter,
+        }
+    return _ITER_CLASSES
+
+
+def list_data_iters():
+    return sorted(_iter_classes().keys())
+
+
+def data_iter_create(name, keys, vals):
+    cls = _iter_classes()[name]
+    kwargs = {}
+    for k, v in zip(keys, vals):
+        try:
+            kwargs[k] = eval(v, {'__builtins__': {}})  # noqa: S307 — numeric/tuple literals
+        except Exception:
+            kwargs[k] = v
+    return iter(cls(**kwargs))
+
+
+class _IterState:
+    __slots__ = ('it', 'batch')
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+
+def iter_state_new(it):
+    return _IterState(it)
+
+
+def data_iter_next(handle):
+    try:
+        handle.batch = next(handle.it)
+        return 1
+    except StopIteration:
+        return 0
+
+
+def data_iter_before_first(handle):
+    handle.it.reset()
+    return 0
+
+
+def data_iter_get_data(handle):
+    return handle.batch.data[0]
+
+
+def data_iter_get_label(handle):
+    return handle.batch.label[0]
+
+
+def data_iter_get_pad(handle):
+    return int(handle.batch.pad or 0)
+
+
+# ------------------------------------------------------------- predict --
+
+class _Predictor:
+    """MXPredCreate state (reference src/c_api/c_predict_api.cc:57-177):
+    symbol json + param blob → bound inference executor."""
+
+    def __init__(self, symbol_json, param_bytes, dev_type, dev_id,
+                 input_keys, input_shapes, output_keys=None):
+        import io as _pyio
+        sym = _sym_load_json(symbol_json)
+        if output_keys:
+            outs = sym.list_outputs()
+            picked = []
+            for k in output_keys:
+                name = k if k.endswith('_output') else k + '_output'
+                idx = outs.index(name) if name in outs else outs.index(k)
+                picked.append(sym[idx])
+            from .symbol import Group
+            sym = Group(picked) if len(picked) > 1 else picked[0]
+        self.sym = sym
+        # param blob: NDArray save format (arg:/aux: prefixed dict)
+        params = {}
+        if param_bytes:
+            import tempfile, os
+            with tempfile.NamedTemporaryFile(delete=False) as f:
+                f.write(param_bytes)
+                tmp = f.name
+            try:
+                loaded = _nd_utils.load(tmp)
+            finally:
+                os.unlink(tmp)
+            for k, v in (loaded.items() if isinstance(loaded, dict) else []):
+                params[k.split(':', 1)[-1]] = v
+        ctx = _ctx(dev_type, dev_id)
+        shapes = dict(zip(input_keys, [tuple(s) for s in input_shapes]))
+        arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+        arg_names = sym.list_arguments()
+        aux_names = sym.list_auxiliary_states()
+        self.input_keys = list(input_keys)
+        args = {}
+        for name, shp in zip(arg_names, arg_shapes):
+            if name in params:
+                args[name] = params[name].as_in_context(ctx)
+            else:
+                args[name] = _nd_mod.zeros(shp, ctx=ctx)
+        aux = {}
+        for name, shp in zip(aux_names, aux_shapes or []):
+            if name in params:
+                aux[name] = params[name].as_in_context(ctx)
+            else:
+                aux[name] = _nd_mod.zeros(shp, ctx=ctx)
+        self.executor = sym.bind(ctx, args, grad_req='null',
+                                 aux_states=aux or None)
+        self.args = args
+
+    def set_input(self, key, buf, shape):
+        arr = np.frombuffer(buf, dtype=np.float32).reshape(shape)
+        self.args[key][:] = arr
+        return 0
+
+    def forward(self):
+        self.executor.forward(is_train=False)
+        return 0
+
+    def get_output_shape(self, index):
+        out = self.executor.outputs[int(index)]
+        return tuple(int(d) for d in out.shape)
+
+    def get_output(self, index):
+        out = self.executor.outputs[int(index)]
+        npy = out.asnumpy()
+        if npy.dtype != np.float32:
+            npy = npy.astype(np.float32)
+        return npy.tobytes()
+
+
+def pred_create(symbol_json, param_bytes, dev_type, dev_id, input_keys,
+                input_shapes, output_keys=None):
+    return _Predictor(symbol_json, param_bytes, dev_type, dev_id,
+                      input_keys, input_shapes, output_keys)
+
+
+def nd_list_create(buf):
+    """MXNDListCreate: load an NDArray-save blob → (keys, arrays)."""
+    import tempfile, os
+    with tempfile.NamedTemporaryFile(delete=False) as f:
+        f.write(buf)
+        tmp = f.name
+    try:
+        loaded = _nd_utils.load(tmp)
+    finally:
+        os.unlink(tmp)
+    if isinstance(loaded, dict):
+        keys = list(loaded.keys())
+        return keys, [loaded[k] for k in keys]
+    return [''] * len(loaded), list(loaded)
+
+
+def nd_list_get(keys, arrays, index):
+    i = int(index)
+    arr = arrays[i]
+    npy = arr.asnumpy().astype(np.float32)
+    return keys[i], npy.tobytes(), tuple(int(d) for d in npy.shape)

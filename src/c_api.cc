@@ -1,0 +1,1309 @@
+/*
+ * c_api.cc — C ABI over the CPython-hosted XLA core.
+ *
+ * Reference: src/c_api/c_api.cc, c_api_symbolic.cc, c_api_executor.cc
+ * (handle marshalling + thread-local error/return storage around the
+ * C++ core). Here the core is mxnet_tpu (JAX/XLA); the library embeds
+ * the interpreter lazily and each entry point calls one helper in
+ * mxnet_tpu._c_api_impl, holding the GIL only for the call. Handles
+ * are new references to CPython objects; MX*Free drops them.
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_api.h"
+#include "mxtpu.h"
+
+namespace {
+
+thread_local std::string last_error;
+
+/* thread-local return storage (reference: MXAPIThreadLocalEntry) */
+struct RetStore {
+  std::vector<std::string> strings;
+  std::vector<const char *> cptrs;
+  std::vector<mx_uint> shape;
+  std::vector<int> ints;
+  std::vector<void *> handles;
+  std::string blob;
+  /* CSR shape returns for InferShape */
+  std::vector<mx_uint> ndims[3];
+  std::vector<std::vector<mx_uint>> dims[3];
+  std::vector<const mx_uint *> dptr[3];
+  std::vector<int> types[3];
+};
+thread_local RetStore ret;
+
+PyObject *bridge = nullptr;  /* mxnet_tpu._c_api_impl, owned */
+std::once_flag init_flag;
+bool init_ok = false;
+bool we_initialized_python = false;
+
+void InitPython() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    we_initialized_python = true;
+  }
+  PyGILState_STATE g = PyGILState_Ensure();
+  bridge = PyImport_ImportModule("mxnet_tpu._c_api_impl");
+  if (bridge == nullptr) {
+    PyObject *t, *v, *tb;
+    PyErr_Fetch(&t, &v, &tb);
+    PyObject *s = v ? PyObject_Str(v) : nullptr;
+    last_error = std::string("failed to import mxnet_tpu._c_api_impl: ") +
+                 (s && PyUnicode_Check(s) ? PyUnicode_AsUTF8(s) : "?");
+    Py_XDECREF(s); Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+  } else {
+    init_ok = true;
+  }
+  if (we_initialized_python) {
+    /* release the GIL so any thread can PyGILState_Ensure later */
+    PyGILState_Release(g);
+    PyEval_SaveThread();
+  } else {
+    PyGILState_Release(g);
+  }
+}
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() { state = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+int Fail() {
+  PyObject *t = nullptr, *v = nullptr, *tb = nullptr;
+  PyErr_Fetch(&t, &v, &tb);
+  PyErr_NormalizeException(&t, &v, &tb);
+  PyObject *s = v ? PyObject_Str(v) : nullptr;
+  last_error = (s && PyUnicode_Check(s)) ? PyUnicode_AsUTF8(s)
+                                         : "unknown python error";
+  Py_XDECREF(s); Py_XDECREF(t); Py_XDECREF(v); Py_XDECREF(tb);
+  return -1;
+}
+
+bool Ensure() {
+  std::call_once(init_flag, InitPython);
+  if (!init_ok && last_error.empty())
+    last_error = "mxnet_tpu C API: interpreter init failed";
+  return init_ok;
+}
+
+/* Call bridge.<fn>(args tuple). Returns new ref or nullptr. */
+PyObject *CallV(const char *fn, PyObject *args /* stolen */) {
+  PyObject *f = PyObject_GetAttrString(bridge, fn);
+  if (f == nullptr) { Py_XDECREF(args); return nullptr; }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+PyObject *HandleList(int n, void *const *handles) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i) {
+    PyObject *h = handles && handles[i] ? (PyObject *)handles[i] : Py_None;
+    Py_INCREF(h);
+    PyList_SET_ITEM(l, i, h);
+  }
+  return l;
+}
+
+PyObject *StrList(int n, const char *const *strs) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyUnicode_FromString(strs && strs[i] ? strs[i] : ""));
+  return l;
+}
+
+PyObject *IntList(int n, const int *v) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromLong(v ? v[i] : 0));
+  return l;
+}
+
+PyObject *UIntList(int n, const mx_uint *v) {
+  PyObject *l = PyList_New(n);
+  for (int i = 0; i < n; ++i)
+    PyList_SET_ITEM(l, i, PyLong_FromUnsignedLong(v ? v[i] : 0));
+  return l;
+}
+
+/* Store a python str list into thread-local storage; returns char**. */
+const char **StoreStrList(PyObject *list, mx_uint *out_size) {
+  Py_ssize_t n = PySequence_Size(list);
+  ret.strings.clear();
+  ret.strings.reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(list, i);
+    ret.strings.emplace_back(PyUnicode_Check(it) ? PyUnicode_AsUTF8(it) : "");
+    Py_DECREF(it);
+  }
+  ret.cptrs.clear();
+  for (auto &s : ret.strings) ret.cptrs.push_back(s.c_str());
+  *out_size = (mx_uint)n;
+  return ret.cptrs.data();
+}
+
+void **StoreHandleList(PyObject *list, mx_uint *out_size) {
+  Py_ssize_t n = PySequence_Size(list);
+  ret.handles.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(list, i); /* new ref, kept */
+    ret.handles.push_back((void *)it);
+  }
+  *out_size = (mx_uint)n;
+  return ret.handles.data();
+}
+
+#define API_BEGIN() \
+  if (!Ensure()) return -1; \
+  Gil gil_;
+#define CHECK_PY(r) if ((r) == nullptr) return Fail();
+
+}  // namespace
+
+/* shared with c_predict_api.cc */
+namespace mxtpu_capi {
+bool EnsureBridge() { return Ensure(); }
+PyObject *Bridge() { return bridge; }
+int FailFromPython() { return Fail(); }
+void SetError(const std::string &msg) { last_error = msg; }
+}  // namespace mxtpu_capi
+
+extern "C" {
+
+const char *MXGetLastError() { return last_error.c_str(); }
+
+/* ------------------------------------------------------------- misc -- */
+
+int MXGetVersion(int *out) { *out = 20000; return 0; }
+
+int MXRandomSeed(int seed) {
+  API_BEGIN();
+  PyObject *r = CallV("random_seed", Py_BuildValue("(i)", seed));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXNotifyShutdown() {
+  API_BEGIN();
+  PyObject *r = CallV("notify_shutdown", PyTuple_New(0));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXSetNumOMPThreads(int) { return 0; }
+
+int MXSetProfilerConfig(int mode, const char *filename) {
+  API_BEGIN();
+  PyObject *r = CallV("profiler_set_config",
+                      Py_BuildValue("(is)", mode, filename));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXSetProfilerState(int state) {
+  API_BEGIN();
+  PyObject *r = CallV("profiler_set_state", Py_BuildValue("(i)", state));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXDumpProfile() {
+  API_BEGIN();
+  PyObject *r = CallV("profiler_dump", PyTuple_New(0));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+/* ---------------------------------------------------------- ndarray -- */
+
+int MXNDArrayCreateNone(NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_create_none", PyTuple_New(0));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+static int CreateImpl(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *shp = UIntList((int)ndim, shape);
+  PyObject *r = CallV("nd_create", Py_BuildValue("(Niiii)", shp, dev_type,
+                                                 dev_id, delay_alloc, dtype));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArrayCreate(const mx_uint *shape, mx_uint ndim, int dev_type,
+                    int dev_id, int delay_alloc, NDArrayHandle *out) {
+  return CreateImpl(shape, ndim, dev_type, dev_id, delay_alloc, 0, out);
+}
+
+int MXNDArrayCreateEx(const mx_uint *shape, mx_uint ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype,
+                      NDArrayHandle *out) {
+  return CreateImpl(shape, ndim, dev_type, dev_id, delay_alloc, dtype, out);
+}
+
+int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
+                             size_t size) {
+  API_BEGIN();
+  PyObject *h = (PyObject *)handle;
+  PyObject *dt = CallV("nd_dtype", Py_BuildValue("(O)", h));
+  CHECK_PY(dt);
+  long dtype = PyLong_AsLong(dt);
+  Py_DECREF(dt);
+  /* size is an element count in the reference ABI */
+  static const size_t esize[] = {4, 8, 2, 1, 4, 1, 8, 2};
+  size_t nbytes = size * esize[dtype < 8 ? dtype : 0];
+  /* bf16 device arrays take fp32 host data (GetData mirrors fp32 out) */
+  int host_dtype = (int)dtype;
+  if (dtype == 7) { host_dtype = 0; nbytes = size * 4; }
+  PyObject *buf = PyBytes_FromStringAndSize((const char *)data, nbytes);
+  PyObject *r = CallV("nd_sync_copy_from_bytes",
+                      Py_BuildValue("(ONi)", h, buf, host_dtype));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_sync_copy_to_bytes",
+                      Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  char *buf; Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) { Py_DECREF(r); return Fail(); }
+  size_t want = len; /* bridge returns exactly shape-sized fp32/typed buffer */
+  (void)size;
+  std::memcpy(data, buf, want);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToRead(NDArrayHandle handle) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_wait_to_read", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayWaitToWrite(NDArrayHandle handle) {
+  return MXNDArrayWaitToRead(handle);
+}
+
+int MXNDArrayWaitAll() {
+  API_BEGIN();
+  PyObject *r = CallV("nd_wait_all", PyTuple_New(0));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayFree(NDArrayHandle handle) {
+  if (handle == nullptr) return 0;
+  API_BEGIN();
+  PyObject *r = CallV("nd_free", Py_BuildValue("(O)", (PyObject *)handle));
+  Py_XDECREF(r);
+  if (r == nullptr) PyErr_Clear();
+  Py_DECREF((PyObject *)handle);
+  return 0;
+}
+
+static int UnaryHandleOp(const char *fn, NDArrayHandle h, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV(fn, Py_BuildValue("(O)", (PyObject *)h));
+  CHECK_PY(r);
+  if (r == Py_None) { Py_DECREF(r); *out = nullptr; return 0; }
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArraySlice(NDArrayHandle handle, mx_uint begin, mx_uint end,
+                   NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_slice", Py_BuildValue("(OII)", (PyObject *)handle,
+                                                begin, end));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArrayAt(NDArrayHandle handle, mx_uint idx, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_at", Py_BuildValue("(OI)", (PyObject *)handle, idx));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArrayReshape(NDArrayHandle handle, int ndim, const int *dims,
+                     NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *shp = IntList(ndim, dims);
+  PyObject *r = CallV("nd_reshape", Py_BuildValue("(ON)", (PyObject *)handle, shp));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArrayGetShape(NDArrayHandle handle, mx_uint *out_dim,
+                      const mx_uint **out_pdata) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_shape", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  Py_ssize_t n = PyTuple_Size(r);
+  ret.shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    ret.shape.push_back((mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  *out_dim = (mx_uint)n;
+  *out_pdata = ret.shape.data();
+  return 0;
+}
+
+static int IntGetter(const char *fn, void *handle, int *out) {
+  API_BEGIN();
+  PyObject *r = CallV(fn, Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetDType(NDArrayHandle handle, int *out) {
+  return IntGetter("nd_dtype", handle, out);
+}
+
+int MXNDArrayGetStorageType(NDArrayHandle handle, int *out) {
+  return IntGetter("nd_stype", handle, out);
+}
+
+int MXNDArrayGetContext(NDArrayHandle handle, int *out_dev_type,
+                        int *out_dev_id) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_context", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out_dev_type = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 0));
+  *out_dev_id = (int)PyLong_AsLong(PyTuple_GET_ITEM(r, 1));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetData(NDArrayHandle handle, void **out_pdata) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_data_ptr", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out_pdata = (void *)PyLong_AsUnsignedLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetGrad(NDArrayHandle handle, NDArrayHandle *out) {
+  return UnaryHandleOp("nd_get_grad", handle, out);
+}
+
+int MXNDArrayDetach(NDArrayHandle handle, NDArrayHandle *out) {
+  return UnaryHandleOp("nd_detach", handle, out);
+}
+
+int MXNDArraySave(const char *fname, mx_uint num_args, NDArrayHandle *args,
+                  const char **keys) {
+  API_BEGIN();
+  PyObject *hl = HandleList((int)num_args, args);
+  PyObject *kl = keys ? StrList((int)num_args, keys) : (Py_INCREF(Py_None), Py_None);
+  PyObject *r = CallV("nd_save", Py_BuildValue("(sNN)", fname, hl, kl));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayLoad(const char *fname, mx_uint *out_size,
+                  NDArrayHandle **out_arr, mx_uint *out_name_size,
+                  const char ***out_names) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_load", Py_BuildValue("(s)", fname));
+  CHECK_PY(r);
+  PyObject *keys = PyTuple_GET_ITEM(r, 0);
+  PyObject *arrs = PyTuple_GET_ITEM(r, 1);
+  *out_names = StoreStrList(keys, out_name_size);
+  *out_arr = (NDArrayHandle *)StoreHandleList(arrs, out_size);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
+                          const char **out_buf) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_save_raw_bytes", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  char *buf; Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) { Py_DECREF(r); return Fail(); }
+  ret.blob.assign(buf, len);
+  Py_DECREF(r);
+  *out_size = (size_t)ret.blob.size();
+  *out_buf = ret.blob.data();
+  return 0;
+}
+
+int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
+                              NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *b = PyBytes_FromStringAndSize((const char *)buf, (Py_ssize_t)size);
+  PyObject *r = CallV("nd_load_from_raw_bytes", Py_BuildValue("(N)", b));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+/* -------------------------------------------------------- operators -- */
+
+/* op-name table doubles as the AtomicSymbolCreator registry (handles are
+ * pointers to interned names, as in the reference where creators are
+ * nnvm::Op*). */
+static std::vector<std::string> *op_names = nullptr;
+
+static int EnsureOpNames() {
+  if (op_names != nullptr) return 0;
+  PyObject *r = CallV("list_all_op_names", PyTuple_New(0));
+  if (r == nullptr) return Fail();
+  auto *names = new std::vector<std::string>();
+  Py_ssize_t n = PySequence_Size(r);
+  names->reserve(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    names->push_back(PyUnicode_AsUTF8(it));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  op_names = names;
+  return 0;
+}
+
+int MXListAllOpNames(mx_uint *out_size, const char ***out_array) {
+  API_BEGIN();
+  if (EnsureOpNames() != 0) return -1;
+  ret.cptrs.clear();
+  for (auto &s : *op_names) ret.cptrs.push_back(s.c_str());
+  *out_size = (mx_uint)op_names->size();
+  *out_array = ret.cptrs.data();
+  return 0;
+}
+
+int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
+                                     AtomicSymbolCreator **out_array) {
+  API_BEGIN();
+  if (EnsureOpNames() != 0) return -1;
+  ret.handles.clear();
+  for (auto &s : *op_names) ret.handles.push_back((void *)&s);
+  *out_size = (mx_uint)op_names->size();
+  *out_array = (AtomicSymbolCreator *)ret.handles.data();
+  return 0;
+}
+
+static const char *CreatorName(AtomicSymbolCreator creator) {
+  return ((const std::string *)creator)->c_str();
+}
+
+int MXSymbolGetAtomicSymbolName(AtomicSymbolCreator creator,
+                                const char **name) {
+  *name = CreatorName(creator);
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(AtomicSymbolCreator creator,
+                                const char **name, const char **description,
+                                mx_uint *num_args, const char ***arg_names,
+                                const char ***arg_type_infos,
+                                const char ***arg_descriptions,
+                                const char **key_var_num_args,
+                                const char **return_type) {
+  API_BEGIN();
+  PyObject *r = CallV("op_info", Py_BuildValue("(s)", CreatorName(creator)));
+  CHECK_PY(r);
+  /* (name, doc, arg_names, arg_types, arg_descs, key_var_num_args, rtype) */
+  ret.strings.clear();
+  ret.cptrs.clear();
+  auto keep = [&](PyObject *o) {
+    ret.strings.emplace_back(PyUnicode_Check(o) ? PyUnicode_AsUTF8(o) : "");
+  };
+  keep(PyTuple_GET_ITEM(r, 0));
+  keep(PyTuple_GET_ITEM(r, 1));
+  keep(PyTuple_GET_ITEM(r, 5));
+  keep(PyTuple_GET_ITEM(r, 6));
+  PyObject *an = PyTuple_GET_ITEM(r, 2);
+  PyObject *at = PyTuple_GET_ITEM(r, 3);
+  PyObject *ad = PyTuple_GET_ITEM(r, 4);
+  Py_ssize_t n = PySequence_Size(an);
+  for (PyObject *lst : {an, at, ad})
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *it = PySequence_GetItem(lst, i);
+      keep(it);
+      Py_DECREF(it);
+    }
+  Py_DECREF(r);
+  /* pointers into ret.strings (stable until next call on this thread) */
+  *name = ret.strings[0].c_str();
+  *description = ret.strings[1].c_str();
+  *key_var_num_args = ret.strings[2].c_str();
+  if (return_type) *return_type = ret.strings[3].c_str();
+  *num_args = (mx_uint)n;
+  for (size_t i = 4; i < ret.strings.size(); ++i)
+    ret.cptrs.push_back(ret.strings[i].c_str());
+  *arg_names = ret.cptrs.data();
+  *arg_type_infos = ret.cptrs.data() + n;
+  *arg_descriptions = ret.cptrs.data() + 2 * n;
+  return 0;
+}
+
+int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, int num_params,
+                       const char **param_keys, const char **param_vals) {
+  API_BEGIN();
+  PyObject *ins = HandleList(num_inputs, inputs);
+  PyObject *keys = StrList(num_params, param_keys);
+  PyObject *vals = StrList(num_params, param_vals);
+  int n_provided = (*num_outputs > 0 && *outputs != nullptr) ? *num_outputs : 0;
+  PyObject *outs = HandleList(n_provided, (void **)(n_provided ? *outputs : nullptr));
+  PyObject *r = CallV("imperative_invoke",
+                      Py_BuildValue("(sNNNiN)", CreatorName(creator), ins,
+                                    keys, vals, n_provided, outs));
+  CHECK_PY(r);
+  mx_uint n = 0;
+  if (n_provided == 0) {
+    *outputs = (NDArrayHandle *)StoreHandleList(r, &n);
+    *num_outputs = (int)n;
+  } else {
+    *num_outputs = (int)PySequence_Size(r);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+/* --------------------------------------------------------- autograd -- */
+
+int MXAutogradSetIsRecording(int is_recording, int *prev) {
+  API_BEGIN();
+  PyObject *r = CallV("autograd_set_recording", Py_BuildValue("(i)", is_recording));
+  CHECK_PY(r);
+  if (prev) *prev = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradSetIsTraining(int is_training, int *prev) {
+  API_BEGIN();
+  PyObject *r = CallV("autograd_set_training", Py_BuildValue("(i)", is_training));
+  CHECK_PY(r);
+  if (prev) *prev = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsRecording(bool *curr) {
+  API_BEGIN();
+  PyObject *r = CallV("autograd_is_recording", PyTuple_New(0));
+  CHECK_PY(r);
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradIsTraining(bool *curr) {
+  API_BEGIN();
+  PyObject *r = CallV("autograd_is_training", PyTuple_New(0));
+  CHECK_PY(r);
+  *curr = PyLong_AsLong(r) != 0;
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradMarkVariables(mx_uint num_var, NDArrayHandle *var_handles,
+                            mx_uint *reqs_array, NDArrayHandle *grad_handles) {
+  API_BEGIN();
+  PyObject *vars = HandleList((int)num_var, var_handles);
+  PyObject *reqs = PyList_New(num_var);
+  for (mx_uint i = 0; i < num_var; ++i)
+    PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+  PyObject *grads = HandleList((int)num_var, grad_handles);
+  PyObject *r = CallV("autograd_mark_variables",
+                      Py_BuildValue("(NNN)", vars, reqs, grads));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
+                         NDArrayHandle *ograd_handles, int retain_graph,
+                         int train_mode) {
+  API_BEGIN();
+  PyObject *outs = HandleList((int)num_output, output_handles);
+  PyObject *ogs = ograd_handles
+                      ? HandleList((int)num_output, ograd_handles)
+                      : PyList_New(0);
+  PyObject *r = CallV("autograd_backward",
+                      Py_BuildValue("(NNii)", outs, ogs, retain_graph, train_mode));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
+                       NDArrayHandle *ograd_handles, int retain_graph) {
+  return MXAutogradBackwardEx(num_output, output_handles, ograd_handles,
+                              retain_graph, 1);
+}
+
+/* --------------------------------------------------------- cachedop -- */
+
+int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("cached_op_create", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out = (CachedOpHandle)r;
+  return 0;
+}
+
+int MXFreeCachedOp(CachedOpHandle handle) {
+  if (handle) { Gil g; Py_DECREF((PyObject *)handle); }
+  return 0;
+}
+
+int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
+                     NDArrayHandle *inputs, int *num_outputs,
+                     NDArrayHandle **outputs) {
+  API_BEGIN();
+  PyObject *ins = HandleList(num_inputs, inputs);
+  PyObject *r = CallV("cached_op_invoke",
+                      Py_BuildValue("(ON)", (PyObject *)handle, ins));
+  CHECK_PY(r);
+  mx_uint n = 0;
+  *outputs = (NDArrayHandle *)StoreHandleList(r, &n);
+  *num_outputs = (int)n;
+  Py_DECREF(r);
+  return 0;
+}
+
+/* ----------------------------------------------------------- symbol -- */
+
+int MXSymbolCreateAtomicSymbol(AtomicSymbolCreator creator, mx_uint num_param,
+                               const char **keys, const char **vals,
+                               SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *kl = StrList((int)num_param, keys);
+  PyObject *vl = StrList((int)num_param, vals);
+  PyObject *r = CallV("symbol_create_atomic",
+                      Py_BuildValue("(sNN)", CreatorName(creator), kl, vl));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolCreateVariable(const char *name, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_create_variable", Py_BuildValue("(s)", name));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolCreateGroup(mx_uint num_symbols, SymbolHandle *symbols,
+                        SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *l = HandleList((int)num_symbols, symbols);
+  PyObject *r = CallV("symbol_create_group", Py_BuildValue("(N)", l));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolCreateFromFile(const char *fname, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_from_file", Py_BuildValue("(s)", fname));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolCreateFromJSON(const char *json, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_from_json", Py_BuildValue("(s)", json));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolSaveToFile(SymbolHandle symbol, const char *fname) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_save_file",
+                      Py_BuildValue("(Os)", (PyObject *)symbol, fname));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+static int StrGetter(const char *fn, void *handle, const char **out) {
+  PyObject *r = CallV(fn, Py_BuildValue("(O)", (PyObject *)handle));
+  if (r == nullptr) return Fail();
+  ret.blob = PyUnicode_Check(r) ? PyUnicode_AsUTF8(r) : "";
+  Py_DECREF(r);
+  *out = ret.blob.c_str();
+  return 0;
+}
+
+int MXSymbolSaveToJSON(SymbolHandle symbol, const char **out_json) {
+  API_BEGIN();
+  return StrGetter("symbol_to_json", symbol, out_json);
+}
+
+int MXSymbolFree(SymbolHandle symbol) {
+  if (symbol == nullptr) return 0;
+  API_BEGIN();
+  PyObject *r = CallV("symbol_free", Py_BuildValue("(O)", (PyObject *)symbol));
+  Py_XDECREF(r);
+  if (r == nullptr) PyErr_Clear();
+  Py_DECREF((PyObject *)symbol);
+  return 0;
+}
+
+int MXSymbolCopy(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_copy", Py_BuildValue("(O)", (PyObject *)symbol));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolPrint(SymbolHandle symbol, const char **out_str) {
+  API_BEGIN();
+  return StrGetter("symbol_print", symbol, out_str);
+}
+
+int MXSymbolGetName(SymbolHandle symbol, const char **out, int *success) {
+  API_BEGIN();
+  if (StrGetter("symbol_get_name", symbol, out) != 0) return -1;
+  *success = (**out != '\0');
+  return 0;
+}
+
+int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
+                    int *success) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_get_attr",
+                      Py_BuildValue("(Os)", (PyObject *)symbol, key));
+  CHECK_PY(r);
+  if (r == Py_None) {
+    *success = 0; *out = nullptr;
+  } else {
+    ret.blob = PyUnicode_AsUTF8(r);
+    *out = ret.blob.c_str();
+    *success = 1;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_set_attr",
+                      Py_BuildValue("(Oss)", (PyObject *)symbol, key, value));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+static int StrListGetter(const char *fn, void *handle, mx_uint *out_size,
+                         const char ***out) {
+  PyObject *r = CallV(fn, Py_BuildValue("(O)", (PyObject *)handle));
+  if (r == nullptr) return Fail();
+  *out = StoreStrList(r, out_size);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
+                     const char ***out) {
+  API_BEGIN();
+  return StrListGetter("symbol_list_attr", symbol, out_size, out);
+}
+
+int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
+                          const char ***out_str_array) {
+  API_BEGIN();
+  return StrListGetter("symbol_list_arguments", symbol, out_size, out_str_array);
+}
+
+int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
+                        const char ***out_str_array) {
+  API_BEGIN();
+  return StrListGetter("symbol_list_outputs", symbol, out_size, out_str_array);
+}
+
+int MXSymbolListAuxiliaryStates(SymbolHandle symbol, mx_uint *out_size,
+                                const char ***out_str_array) {
+  API_BEGIN();
+  return StrListGetter("symbol_list_aux", symbol, out_size, out_str_array);
+}
+
+int MXSymbolGetInternals(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_get_internals", Py_BuildValue("(O)", (PyObject *)symbol));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolGetChildren(SymbolHandle symbol, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_get_children", Py_BuildValue("(O)", (PyObject *)symbol));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolGetOutput(SymbolHandle symbol, mx_uint index, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_get_output",
+                      Py_BuildValue("(OI)", (PyObject *)symbol, index));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXSymbolCompose(SymbolHandle sym, const char *name, mx_uint num_args,
+                    const char **keys, SymbolHandle *args) {
+  API_BEGIN();
+  /* The reference mutates the nnvm symbol in place (compose returns
+   * void and the caller keeps using `sym`). Our Symbol is immutable, so
+   * the bridge records handle→composed in a side table consulted by
+   * every other symbol_* helper (purged by MXSymbolFree). */
+  PyObject *kl = keys ? StrList((int)num_args, keys) : PyList_New(0);
+  PyObject *al = HandleList((int)num_args, args);
+  PyObject *r = CallV("symbol_compose_inplace",
+                      Py_BuildValue("(OsNN)", (PyObject *)sym,
+                                    name ? name : "", kl, al));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXSymbolGrad(SymbolHandle sym, mx_uint num_wrt, const char **wrt,
+                 SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *wl = StrList((int)num_wrt, wrt);
+  PyObject *r = CallV("symbol_grad", Py_BuildValue("(ON)", (PyObject *)sym, wl));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+static int InferShapeImpl(SymbolHandle sym, mx_uint num_args, const char **keys,
+                          const mx_uint *arg_ind_ptr,
+                          const mx_uint *arg_shape_data, int which_partial,
+                          mx_uint *sizes[3], const mx_uint **ndims[3],
+                          const mx_uint ***datas[3], int *complete) {
+  PyObject *kl = StrList((int)num_args, keys);
+  PyObject *ind = UIntList((int)num_args + 1, arg_ind_ptr);
+  mx_uint total = num_args ? arg_ind_ptr[num_args] : 0;
+  PyObject *dat = UIntList((int)total, arg_shape_data);
+  PyObject *r = CallV("symbol_infer_shape",
+                      Py_BuildValue("(ONNNi)", (PyObject *)sym, kl, ind, dat,
+                                    which_partial));
+  if (r == nullptr) return Fail();
+  for (int part = 0; part < 3; ++part) {
+    PyObject *shapes = PyTuple_GET_ITEM(r, part);
+    Py_ssize_t n = PySequence_Size(shapes);
+    ret.ndims[part].clear();
+    ret.dims[part].assign((size_t)n, {});
+    ret.dptr[part].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *s = PySequence_GetItem(shapes, i);
+      Py_ssize_t d = PySequence_Size(s);
+      ret.ndims[part].push_back((mx_uint)d);
+      for (Py_ssize_t j = 0; j < d; ++j) {
+        PyObject *x = PySequence_GetItem(s, j);
+        ret.dims[part][i].push_back((mx_uint)PyLong_AsUnsignedLong(x));
+        Py_DECREF(x);
+      }
+      Py_DECREF(s);
+    }
+    for (auto &v : ret.dims[part]) ret.dptr[part].push_back(v.data());
+    *sizes[part] = (mx_uint)n;
+    *ndims[part] = ret.ndims[part].data();
+    *datas[part] = ret.dptr[part].data();
+  }
+  Py_DECREF(r);
+  *complete = 1;
+  return 0;
+}
+
+int MXSymbolInferShape(SymbolHandle sym, mx_uint num_args, const char **keys,
+                       const mx_uint *arg_ind_ptr, const mx_uint *arg_shape_data,
+                       mx_uint *in_shape_size, const mx_uint **in_shape_ndim,
+                       const mx_uint ***in_shape_data, mx_uint *out_shape_size,
+                       const mx_uint **out_shape_ndim,
+                       const mx_uint ***out_shape_data, mx_uint *aux_shape_size,
+                       const mx_uint **aux_shape_ndim,
+                       const mx_uint ***aux_shape_data, int *complete) {
+  API_BEGIN();
+  mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint **ndims[3] = {in_shape_ndim, out_shape_ndim, aux_shape_ndim};
+  const mx_uint ***datas[3] = {in_shape_data, out_shape_data, aux_shape_data};
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data, 0,
+                        sizes, ndims, datas, complete);
+}
+
+int MXSymbolInferShapePartial(SymbolHandle sym, mx_uint num_args,
+                              const char **keys, const mx_uint *arg_ind_ptr,
+                              const mx_uint *arg_shape_data,
+                              mx_uint *in_shape_size,
+                              const mx_uint **in_shape_ndim,
+                              const mx_uint ***in_shape_data,
+                              mx_uint *out_shape_size,
+                              const mx_uint **out_shape_ndim,
+                              const mx_uint ***out_shape_data,
+                              mx_uint *aux_shape_size,
+                              const mx_uint **aux_shape_ndim,
+                              const mx_uint ***aux_shape_data, int *complete) {
+  API_BEGIN();
+  mx_uint *sizes[3] = {in_shape_size, out_shape_size, aux_shape_size};
+  const mx_uint **ndims[3] = {in_shape_ndim, out_shape_ndim, aux_shape_ndim};
+  const mx_uint ***datas[3] = {in_shape_data, out_shape_data, aux_shape_data};
+  return InferShapeImpl(sym, num_args, keys, arg_ind_ptr, arg_shape_data, 1,
+                        sizes, ndims, datas, complete);
+}
+
+int MXSymbolInferType(SymbolHandle sym, mx_uint num_args, const char **keys,
+                      const int *arg_type_data, mx_uint *in_type_size,
+                      const int **in_type_data, mx_uint *out_type_size,
+                      const int **out_type_data, mx_uint *aux_type_size,
+                      const int **aux_type_data, int *complete) {
+  API_BEGIN();
+  PyObject *kl = StrList((int)num_args, keys);
+  PyObject *tl = IntList((int)num_args, arg_type_data);
+  PyObject *r = CallV("symbol_infer_type",
+                      Py_BuildValue("(ONN)", (PyObject *)sym, kl, tl));
+  CHECK_PY(r);
+  mx_uint *sizes[3] = {in_type_size, out_type_size, aux_type_size};
+  const int **datas[3] = {in_type_data, out_type_data, aux_type_data};
+  for (int part = 0; part < 3; ++part) {
+    PyObject *ts = PyTuple_GET_ITEM(r, part);
+    Py_ssize_t n = PySequence_Size(ts);
+    ret.types[part].clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      PyObject *x = PySequence_GetItem(ts, i);
+      ret.types[part].push_back((int)PyLong_AsLong(x));
+      Py_DECREF(x);
+    }
+    *sizes[part] = (mx_uint)n;
+    *datas[part] = ret.types[part].data();
+  }
+  Py_DECREF(r);
+  *complete = 1;
+  return 0;
+}
+
+/* --------------------------------------------------------- executor -- */
+
+int MXExecutorFree(ExecutorHandle handle) {
+  if (handle) { Gil g; Py_DECREF((PyObject *)handle); }
+  return 0;
+}
+
+int MXExecutorPrint(ExecutorHandle handle, const char **out_str) {
+  API_BEGIN();
+  return StrGetter("executor_print", handle, out_str);
+}
+
+int MXExecutorForward(ExecutorHandle handle, int is_train) {
+  API_BEGIN();
+  PyObject *r = CallV("executor_forward",
+                      Py_BuildValue("(Oi)", (PyObject *)handle, is_train));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBackward(ExecutorHandle handle, mx_uint len,
+                       NDArrayHandle *head_grads) {
+  API_BEGIN();
+  PyObject *hl = HandleList((int)len, head_grads);
+  PyObject *r = CallV("executor_backward",
+                      Py_BuildValue("(ON)", (PyObject *)handle, hl));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorOutputs(ExecutorHandle handle, mx_uint *out_size,
+                      NDArrayHandle **out) {
+  API_BEGIN();
+  PyObject *r = CallV("executor_outputs", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out = (NDArrayHandle *)StoreHandleList(r, out_size);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                   mx_uint len, NDArrayHandle *in_args,
+                   NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                   mx_uint aux_states_len, NDArrayHandle *aux_states,
+                   ExecutorHandle *out) {
+  API_BEGIN();
+  PyObject *args = HandleList((int)len, in_args);
+  PyObject *grads = HandleList((int)len, arg_grad_store);
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i)
+    PyList_SET_ITEM(reqs, i,
+                    PyLong_FromUnsignedLong(grad_req_type ? grad_req_type[i] : 1));
+  PyObject *aux = HandleList((int)aux_states_len, aux_states);
+  PyObject *r = CallV("executor_bind",
+                      Py_BuildValue("(OiiNNNN)", (PyObject *)symbol_handle,
+                                    dev_type, dev_id, args, grads, reqs, aux));
+  CHECK_PY(r);
+  *out = (ExecutorHandle)r;
+  return 0;
+}
+
+/* ---------------------------------------------------------- data io -- */
+
+static std::vector<std::string> *iter_names = nullptr;
+
+static int EnsureIterNames() {
+  if (iter_names) return 0;
+  PyObject *r = CallV("list_data_iters", PyTuple_New(0));
+  if (r == nullptr) return Fail();
+  auto *names = new std::vector<std::string>();
+  Py_ssize_t n = PySequence_Size(r);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *it = PySequence_GetItem(r, i);
+    names->push_back(PyUnicode_AsUTF8(it));
+    Py_DECREF(it);
+  }
+  Py_DECREF(r);
+  iter_names = names;
+  return 0;
+}
+
+int MXListDataIters(mx_uint *out_size, DataIterHandle **out_array) {
+  API_BEGIN();
+  if (EnsureIterNames() != 0) return -1;
+  ret.handles.clear();
+  for (auto &s : *iter_names) ret.handles.push_back((void *)&s);
+  *out_size = (mx_uint)iter_names->size();
+  *out_array = ret.handles.data();
+  return 0;
+}
+
+int MXDataIterGetIterInfo(DataIterHandle creator, const char **name,
+                          const char **description, mx_uint *num_args,
+                          const char ***arg_names,
+                          const char ***arg_type_infos,
+                          const char ***arg_descriptions) {
+  *name = ((const std::string *)creator)->c_str();
+  *description = "";
+  *num_args = 0;
+  static const char *empty = nullptr;
+  *arg_names = &empty;
+  *arg_type_infos = &empty;
+  *arg_descriptions = &empty;
+  return 0;
+}
+
+int MXDataIterCreateIter(DataIterHandle creator, mx_uint num_param,
+                         const char **keys, const char **vals,
+                         DataIterHandle *out) {
+  API_BEGIN();
+  PyObject *kl = StrList((int)num_param, keys);
+  PyObject *vl = StrList((int)num_param, vals);
+  PyObject *it = CallV("data_iter_create",
+                       Py_BuildValue("(sNN)",
+                                     ((const std::string *)creator)->c_str(),
+                                     kl, vl));
+  CHECK_PY(it);
+  PyObject *st = CallV("iter_state_new", Py_BuildValue("(N)", it));
+  CHECK_PY(st);
+  *out = (DataIterHandle)st;
+  return 0;
+}
+
+int MXDataIterFree(DataIterHandle handle) {
+  if (handle) { Gil g; Py_DECREF((PyObject *)handle); }
+  return 0;
+}
+
+int MXDataIterNext(DataIterHandle handle, int *out) {
+  return IntGetter("data_iter_next", handle, out);
+}
+
+int MXDataIterBeforeFirst(DataIterHandle handle) {
+  API_BEGIN();
+  PyObject *r = CallV("data_iter_before_first", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out) {
+  return UnaryHandleOp("data_iter_get_data", handle, out);
+}
+
+int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out) {
+  return UnaryHandleOp("data_iter_get_label", handle, out);
+}
+
+int MXDataIterGetPadNum(DataIterHandle handle, int *pad) {
+  return IntGetter("data_iter_get_pad", handle, pad);
+}
+
+/* ---------------------------------------------------------- kvstore -- */
+
+int MXKVStoreCreate(const char *type, KVStoreHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_create", Py_BuildValue("(s)", type));
+  CHECK_PY(r);
+  *out = (KVStoreHandle)r;
+  return 0;
+}
+
+int MXKVStoreFree(KVStoreHandle handle) {
+  if (handle) { Gil g; Py_DECREF((PyObject *)handle); }
+  return 0;
+}
+
+static int KVKeysVals(const char *fn, KVStoreHandle handle, mx_uint num,
+                      const int *keys, NDArrayHandle *vals, int priority) {
+  PyObject *kl = IntList((int)num, keys);
+  PyObject *vl = HandleList((int)num, vals);
+  PyObject *r = CallV(fn, Py_BuildValue("(ONNi)", (PyObject *)handle, kl, vl,
+                                        priority));
+  if (r == nullptr) return Fail();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInit(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals) {
+  API_BEGIN();
+  PyObject *kl = IntList((int)num, keys);
+  PyObject *vl = HandleList((int)num, vals);
+  PyObject *r = CallV("kv_init", Py_BuildValue("(ONN)", (PyObject *)handle, kl, vl));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePush(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  return KVKeysVals("kv_push", handle, num, keys, vals, priority);
+}
+
+int MXKVStorePull(KVStoreHandle handle, mx_uint num, const int *keys,
+                  NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  return KVKeysVals("kv_pull", handle, num, keys, vals, priority);
+}
+
+int MXKVStoreGetType(KVStoreHandle handle, const char **type) {
+  API_BEGIN();
+  return StrGetter("kv_type", handle, type);
+}
+
+int MXKVStoreGetRank(KVStoreHandle handle, int *ret_) {
+  return IntGetter("kv_rank", handle, ret_);
+}
+
+int MXKVStoreGetGroupSize(KVStoreHandle handle, int *ret_) {
+  return IntGetter("kv_group_size", handle, ret_);
+}
+
+static int RoleIs(const char *role, int *ret_) {
+  const char *r = getenv("DMLC_ROLE");
+  *ret_ = (r != nullptr && std::strcmp(r, role) == 0) ? 1 : 0;
+  return 0;
+}
+
+int MXKVStoreIsWorkerNode(int *ret_) {
+  const char *r = getenv("DMLC_ROLE");
+  *ret_ = (r == nullptr || std::strcmp(r, "worker") == 0) ? 1 : 0;
+  return 0;
+}
+
+int MXKVStoreIsServerNode(int *ret_) { return RoleIs("server", ret_); }
+
+int MXKVStoreIsSchedulerNode(int *ret_) { return RoleIs("scheduler", ret_); }
+
+int MXKVStoreBarrier(KVStoreHandle handle) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_barrier", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id, int *number) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_num_dead_node",
+                      Py_BuildValue("(Oi)", (PyObject *)handle, node_id));
+  CHECK_PY(r);
+  *number = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreRunServer(KVStoreHandle handle) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_run_server", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
+                                   const char *cmd_body) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_send_command",
+                      Py_BuildValue("(Ois)", (PyObject *)handle, cmd_id,
+                                    cmd_body));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+/* --------------------------------------------------------- recordio -- */
+/* Pure native path — delegates to the runtime library (src/recordio.cc),
+ * no interpreter involved. */
+
+int MXRecordIOWriterCreate(const char *uri, RecordIOHandle *out) {
+  return MXTRecordIOWriterCreate(uri, out);
+}
+
+int MXRecordIOWriterFree(RecordIOHandle handle) {
+  return MXTRecordIOWriterFree(handle);
+}
+
+int MXRecordIOWriterWriteRecord(RecordIOHandle handle, const char *buf,
+                                size_t size) {
+  return MXTRecordIOWriterWrite(handle, buf, size);
+}
+
+int MXRecordIOWriterTell(RecordIOHandle handle, size_t *pos) {
+  return MXTRecordIOWriterTell(handle, pos);
+}
+
+int MXRecordIOReaderCreate(const char *uri, RecordIOHandle *out) {
+  return MXTRecordIOReaderCreate(uri, out);
+}
+
+int MXRecordIOReaderFree(RecordIOHandle handle) {
+  return MXTRecordIOReaderFree(handle);
+}
+
+int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
+                               size_t *size) {
+  return MXTRecordIOReaderNext(handle, buf, size);
+}
+
+int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
+  return MXTRecordIOReaderSeek(handle, pos);
+}
+
+}  /* extern "C" */

@@ -1,0 +1,253 @@
+/*
+ * c_predict_api.cc — standalone inference ABI (N19).
+ *
+ * Reference: src/c_api/c_predict_api.cc (predictor = symbol json +
+ * param blob → bound executor; fp32 in/out). Delegates to the
+ * _Predictor class in mxnet_tpu._c_api_impl through the same embedded
+ * interpreter as c_api.cc.
+ */
+#include <Python.h>
+
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include "../include/mxnet_tpu/c_predict_api.h"
+
+/* shared with c_api.cc */
+extern "C" const char *MXGetLastError();
+
+namespace mxtpu_capi {
+/* defined in c_api.cc */
+bool EnsureBridge();
+PyObject *Bridge();
+int FailFromPython();
+void SetError(const std::string &msg);
+}  // namespace mxtpu_capi
+
+namespace {
+
+using mxtpu_capi::Bridge;
+using mxtpu_capi::EnsureBridge;
+using mxtpu_capi::FailFromPython;
+
+struct Gil {
+  PyGILState_STATE state;
+  Gil() { state = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(state); }
+};
+
+thread_local std::vector<mx_uint> pred_shape;
+
+struct NDList {
+  PyObject *keys;    /* list[str] */
+  PyObject *arrays;  /* list[NDArray] */
+  /* per-entry materialized returns for MXNDListGet */
+  std::string cur_key;
+  std::string cur_data;
+  std::vector<mx_uint> cur_shape;
+};
+
+#define PRED_BEGIN() \
+  if (!EnsureBridge()) return -1; \
+  Gil gil_;
+#define CHECK_PYP(r) if ((r) == nullptr) return FailFromPython();
+
+PyObject *CallBridge(const char *fn, PyObject *args /* stolen */) {
+  PyObject *f = PyObject_GetAttrString(Bridge(), fn);
+  if (f == nullptr) { Py_XDECREF(args); return nullptr; }
+  PyObject *r = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  return r;
+}
+
+int CreateImpl(const char *symbol_json_str, const void *param_bytes,
+               int param_size, int dev_type, int dev_id,
+               mx_uint num_input_nodes, const char **input_keys,
+               const mx_uint *input_shape_indptr,
+               const mx_uint *input_shape_data, mx_uint num_output_nodes,
+               const char **output_keys, PredictorHandle *out) {
+  PRED_BEGIN();
+  PyObject *keys = PyList_New(num_input_nodes);
+  PyObject *shapes = PyList_New(num_input_nodes);
+  for (mx_uint i = 0; i < num_input_nodes; ++i) {
+    PyList_SET_ITEM(keys, i, PyUnicode_FromString(input_keys[i]));
+    mx_uint b = input_shape_indptr[i], e = input_shape_indptr[i + 1];
+    PyObject *s = PyList_New(e - b);
+    for (mx_uint j = b; j < e; ++j)
+      PyList_SET_ITEM(s, j - b, PyLong_FromUnsignedLong(input_shape_data[j]));
+    PyList_SET_ITEM(shapes, i, s);
+  }
+  PyObject *outs;
+  if (num_output_nodes > 0) {
+    outs = PyList_New(num_output_nodes);
+    for (mx_uint i = 0; i < num_output_nodes; ++i)
+      PyList_SET_ITEM(outs, i, PyUnicode_FromString(output_keys[i]));
+  } else {
+    outs = Py_None;
+    Py_INCREF(outs);
+  }
+  PyObject *blob = PyBytes_FromStringAndSize(
+      (const char *)param_bytes, param_bytes ? param_size : 0);
+  PyObject *r = CallBridge(
+      "pred_create", Py_BuildValue("(sNiiNNN)", symbol_json_str, blob,
+                                   dev_type, dev_id, keys, shapes, outs));
+  CHECK_PYP(r);
+  *out = (PredictorHandle)r;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXPredCreate(const char *symbol_json_str, const void *param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 mx_uint num_input_nodes, const char **input_keys,
+                 const mx_uint *input_shape_indptr,
+                 const mx_uint *input_shape_data, PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                    input_shape_data, 0, nullptr, out);
+}
+
+int MXPredCreatePartialOut(const char *symbol_json_str,
+                           const void *param_bytes, int param_size,
+                           int dev_type, int dev_id, mx_uint num_input_nodes,
+                           const char **input_keys,
+                           const mx_uint *input_shape_indptr,
+                           const mx_uint *input_shape_data,
+                           mx_uint num_output_nodes, const char **output_keys,
+                           PredictorHandle *out) {
+  return CreateImpl(symbol_json_str, param_bytes, param_size, dev_type,
+                    dev_id, num_input_nodes, input_keys, input_shape_indptr,
+                    input_shape_data, num_output_nodes, output_keys, out);
+}
+
+int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
+                         mx_uint **shape_data, mx_uint *shape_ndim) {
+  PRED_BEGIN();
+  PyObject *r = PyObject_CallMethod((PyObject *)handle, "get_output_shape",
+                                    "I", index);
+  CHECK_PYP(r);
+  Py_ssize_t n = PyTuple_Size(r);
+  pred_shape.clear();
+  for (Py_ssize_t i = 0; i < n; ++i)
+    pred_shape.push_back((mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+  Py_DECREF(r);
+  *shape_data = pred_shape.data();
+  *shape_ndim = (mx_uint)n;
+  return 0;
+}
+
+int MXPredSetInput(PredictorHandle handle, const char *key,
+                   const mx_float *data, mx_uint size) {
+  PRED_BEGIN();
+  PyObject *buf = PyBytes_FromStringAndSize((const char *)data,
+                                            (Py_ssize_t)size * 4);
+  /* shape comes from the bound input array: pass flat, bridge reshapes */
+  PyObject *arr_shape = PyObject_GetAttrString((PyObject *)handle, "args");
+  if (arr_shape == nullptr) { Py_DECREF(buf); return FailFromPython(); }
+  PyObject *arr = PyDict_GetItemString(arr_shape, key); /* borrowed */
+  Py_DECREF(arr_shape);
+  PyObject *shape = arr ? PyObject_GetAttrString(arr, "shape") : nullptr;
+  if (shape == nullptr) {
+    Py_DECREF(buf);
+    mxtpu_capi::SetError(std::string("unknown input key: ") + key);
+    return -1;
+  }
+  PyObject *r = PyObject_CallMethod((PyObject *)handle, "set_input", "sNN",
+                                    key, buf, shape);
+  CHECK_PYP(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXPredForward(PredictorHandle handle) {
+  PRED_BEGIN();
+  PyObject *r = PyObject_CallMethod((PyObject *)handle, "forward", nullptr);
+  CHECK_PYP(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
+                    mx_uint size) {
+  PRED_BEGIN();
+  PyObject *r = PyObject_CallMethod((PyObject *)handle, "get_output", "I",
+                                    index);
+  CHECK_PYP(r);
+  char *buf; Py_ssize_t len;
+  if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) {
+    Py_DECREF(r);
+    return FailFromPython();
+  }
+  if ((size_t)len > (size_t)size * 4) {
+    Py_DECREF(r);
+    mxtpu_capi::SetError("MXPredGetOutput: buffer too small");
+    return -1;
+  }
+  std::memcpy(data, buf, (size_t)len);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXPredFree(PredictorHandle handle) {
+  if (handle) { Gil g; Py_DECREF((PyObject *)handle); }
+  return 0;
+}
+
+int MXNDListCreate(const char *nd_file_bytes, int nd_file_size,
+                   NDListHandle *out, mx_uint *out_length) {
+  PRED_BEGIN();
+  PyObject *blob = PyBytes_FromStringAndSize(nd_file_bytes, nd_file_size);
+  PyObject *r = CallBridge("nd_list_create", Py_BuildValue("(N)", blob));
+  CHECK_PYP(r);
+  auto *lst = new NDList();
+  lst->keys = PyTuple_GET_ITEM(r, 0);
+  Py_INCREF(lst->keys);
+  lst->arrays = PyTuple_GET_ITEM(r, 1);
+  Py_INCREF(lst->arrays);
+  *out_length = (mx_uint)PySequence_Size(lst->arrays);
+  Py_DECREF(r);
+  *out = (NDListHandle)lst;
+  return 0;
+}
+
+int MXNDListGet(NDListHandle handle, mx_uint index, const char **out_key,
+                const mx_float **out_data, const mx_uint **out_shape,
+                mx_uint *out_ndim) {
+  PRED_BEGIN();
+  auto *lst = (NDList *)handle;
+  PyObject *r = CallBridge(
+      "nd_list_get", Py_BuildValue("(OOI)", lst->keys, lst->arrays, index));
+  CHECK_PYP(r);
+  /* (key, fp32 bytes, shape tuple) */
+  lst->cur_key = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+  char *buf; Py_ssize_t len;
+  PyBytes_AsStringAndSize(PyTuple_GET_ITEM(r, 1), &buf, &len);
+  lst->cur_data.assign(buf, len);
+  PyObject *shape = PyTuple_GET_ITEM(r, 2);
+  lst->cur_shape.clear();
+  for (Py_ssize_t i = 0; i < PyTuple_Size(shape); ++i)
+    lst->cur_shape.push_back(
+        (mx_uint)PyLong_AsUnsignedLong(PyTuple_GET_ITEM(shape, i)));
+  Py_DECREF(r);
+  *out_key = lst->cur_key.c_str();
+  *out_data = (const mx_float *)lst->cur_data.data();
+  *out_shape = lst->cur_shape.data();
+  *out_ndim = (mx_uint)lst->cur_shape.size();
+  return 0;
+}
+
+int MXNDListFree(NDListHandle handle) {
+  if (handle) {
+    Gil g;
+    auto *lst = (NDList *)handle;
+    Py_XDECREF(lst->keys);
+    Py_XDECREF(lst->arrays);
+    delete lst;
+  }
+  return 0;
+}
+
+}  /* extern "C" */

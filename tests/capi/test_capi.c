@@ -1,0 +1,429 @@
+/*
+ * Pure-C exercise of the embedding ABI (N13 + N19) — no Python at the
+ * call site. Mirrors the reference's C API usage patterns:
+ * amalgamation/jni consumers drive the MXPred functions, cpp-package
+ * drives the MXSymbol, MXExecutor and MXNDArray families.
+ *
+ * Run with PYTHONPATH pointing at the repo root; exits 0 on success,
+ * prints the failing check and exits 1 otherwise.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <math.h>
+
+#include "../../include/mxnet_tpu/c_api.h"
+#include "../../include/mxnet_tpu/c_predict_api.h"
+
+#define CHECK(cond)                                                        \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      fprintf(stderr, "FAIL %s:%d: %s — last_error: %s\n", __FILE__,       \
+              __LINE__, #cond, MXGetLastError());                          \
+      exit(1);                                                             \
+    }                                                                      \
+  } while (0)
+
+#define CHECK_OK(call) CHECK((call) == 0)
+
+static void test_ndarray_imperative(void) {
+  mx_uint shape[2] = {2, 3};
+  NDArrayHandle a, b;
+  CHECK_OK(MXNDArrayCreate(shape, 2, 1, 0, 0, &a));
+  CHECK_OK(MXNDArrayCreate(shape, 2, 1, 0, 0, &b));
+
+  float data[6] = {1, 2, 3, 4, 5, 6};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(a, data, 6));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(b, data, 6));
+
+  mx_uint ndim;
+  const mx_uint *dims;
+  CHECK_OK(MXNDArrayGetShape(a, &ndim, &dims));
+  CHECK(ndim == 2 && dims[0] == 2 && dims[1] == 3);
+
+  int dtype, dev_type, dev_id;
+  CHECK_OK(MXNDArrayGetDType(a, &dtype));
+  CHECK(dtype == 0);
+  CHECK_OK(MXNDArrayGetContext(a, &dev_type, &dev_id));
+  CHECK(dev_type == 1);
+
+  /* imperative invoke: elemwise add */
+  mx_uint n_ops;
+  const char **op_names;
+  CHECK_OK(MXListAllOpNames(&n_ops, &op_names));
+  CHECK(n_ops > 200);
+
+  mx_uint n_creators;
+  AtomicSymbolCreator *creators;
+  CHECK_OK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+  CHECK(n_creators == n_ops);
+  AtomicSymbolCreator plus = NULL, fc = NULL, flatten = NULL;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *name;
+    CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, "elemwise_add") == 0 || strcmp(name, "_plus") == 0)
+      if (plus == NULL) plus = creators[i];
+    if (strcmp(name, "FullyConnected") == 0) fc = creators[i];
+    if (strcmp(name, "Flatten") == 0) flatten = creators[i];
+  }
+  CHECK(plus != NULL && fc != NULL && flatten != NULL);
+
+  NDArrayHandle ins[2] = {a, b};
+  int num_out = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK_OK(MXImperativeInvoke(plus, 2, ins, &num_out, &outs, 0, NULL, NULL));
+  CHECK(num_out == 1);
+
+  float result[6];
+  CHECK_OK(MXNDArraySyncCopyToCPU(outs[0], result, 6));
+  for (int i = 0; i < 6; ++i) CHECK(fabsf(result[i] - 2 * data[i]) < 1e-6f);
+
+  /* host mirror pointer */
+  void *pdata;
+  CHECK_OK(MXNDArrayGetData(outs[0], &pdata));
+  CHECK(fabsf(((float *)pdata)[3] - 8.0f) < 1e-6f);
+
+  /* slice/at/reshape */
+  NDArrayHandle row;
+  CHECK_OK(MXNDArrayAt(a, 1, &row));
+  CHECK_OK(MXNDArrayGetShape(row, &ndim, &dims));
+  CHECK(ndim == 1 && dims[0] == 3);
+
+  int new_dims[2] = {3, 2};
+  NDArrayHandle reshaped;
+  CHECK_OK(MXNDArrayReshape(a, 2, new_dims, &reshaped));
+  CHECK_OK(MXNDArrayGetShape(reshaped, &ndim, &dims));
+  CHECK(dims[0] == 3 && dims[1] == 2);
+
+  CHECK_OK(MXNDArrayWaitAll());
+  CHECK_OK(MXNDArrayFree(row));
+  CHECK_OK(MXNDArrayFree(reshaped));
+  CHECK_OK(MXNDArrayFree(outs[0]));
+  CHECK_OK(MXNDArrayFree(a));
+  CHECK_OK(MXNDArrayFree(b));
+  printf("ndarray+imperative ok\n");
+}
+
+static void test_symbol_executor(void) {
+  /* x -> FullyConnected(num_hidden=4) with explicit weight/bias */
+  SymbolHandle x, w, bias, fc;
+  CHECK_OK(MXSymbolCreateVariable("x", &x));
+  CHECK_OK(MXSymbolCreateVariable("w", &w));
+  CHECK_OK(MXSymbolCreateVariable("bias", &bias));
+
+  mx_uint n_creators;
+  AtomicSymbolCreator *creators;
+  CHECK_OK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+  AtomicSymbolCreator fc_creator = NULL;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *name;
+    CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, "FullyConnected") == 0) fc_creator = creators[i];
+  }
+  CHECK(fc_creator != NULL);
+
+  const char *keys[1] = {"num_hidden"};
+  const char *vals[1] = {"4"};
+  CHECK_OK(MXSymbolCreateAtomicSymbol(fc_creator, 1, keys, vals, &fc));
+
+  const char *arg_keys[3] = {"data", "weight", "bias"};
+  SymbolHandle args[3] = {x, w, bias};
+  CHECK_OK(MXSymbolCompose(fc, "fc1", 3, arg_keys, args));
+
+  mx_uint n_args;
+  const char **arg_names;
+  CHECK_OK(MXSymbolListArguments(fc, &n_args, &arg_names));
+  CHECK(n_args == 3);
+
+  mx_uint n_outs;
+  const char **out_names;
+  CHECK_OK(MXSymbolListOutputs(fc, &n_outs, &out_names));
+  CHECK(n_outs == 1);
+
+  /* infer shape from x=(2,3) */
+  const char *ikeys[1] = {"x"};
+  mx_uint indptr[2] = {0, 2};
+  mx_uint sdata[2] = {2, 3};
+  mx_uint in_sz, out_sz, aux_sz;
+  const mx_uint *in_nd, *out_nd, *aux_nd;
+  const mx_uint **in_sd, **out_sd, **aux_sd;
+  int complete;
+  CHECK_OK(MXSymbolInferShape(fc, 1, ikeys, indptr, sdata, &in_sz, &in_nd,
+                              &in_sd, &out_sz, &out_nd, &out_sd, &aux_sz,
+                              &aux_nd, &aux_sd, &complete));
+  CHECK(out_sz == 1 && out_nd[0] == 2 && out_sd[0][0] == 2 &&
+        out_sd[0][1] == 4);
+  /* weight inferred (4,3) */
+  CHECK(in_sz == 3 && in_sd[1][0] == 4 && in_sd[1][1] == 3);
+
+  /* json round trip */
+  const char *json;
+  CHECK_OK(MXSymbolSaveToJSON(fc, &json));
+  SymbolHandle fc2;
+  CHECK_OK(MXSymbolCreateFromJSON(json, &fc2));
+  mx_uint n_args2;
+  const char **arg_names2;
+  CHECK_OK(MXSymbolListArguments(fc2, &n_args2, &arg_names2));
+  CHECK(n_args2 == 3);
+
+  /* bind + forward: y = x @ w.T + b */
+  mx_uint xs[2] = {2, 3}, ws[2] = {4, 3}, bs[1] = {4};
+  NDArrayHandle ax, aw, ab;
+  CHECK_OK(MXNDArrayCreate(xs, 2, 1, 0, 0, &ax));
+  CHECK_OK(MXNDArrayCreate(ws, 2, 1, 0, 0, &aw));
+  CHECK_OK(MXNDArrayCreate(bs, 1, 1, 0, 0, &ab));
+  float xd[6] = {1, 0, 0, 0, 1, 0};
+  float wd[12] = {1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12};
+  float bd[4] = {0.5f, 0.5f, 0.5f, 0.5f};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(ax, xd, 6));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(aw, wd, 12));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(ab, bd, 4));
+
+  NDArrayHandle in_args[3] = {ax, aw, ab};
+  NDArrayHandle grad_store[3] = {NULL, NULL, NULL};
+  mx_uint grad_req[3] = {0, 0, 0};
+  ExecutorHandle exec;
+  CHECK_OK(MXExecutorBind(fc, 1, 0, 3, in_args, grad_store, grad_req, 0,
+                          NULL, &exec));
+  CHECK_OK(MXExecutorForward(exec, 0));
+  mx_uint n_exec_outs;
+  NDArrayHandle *exec_outs;
+  CHECK_OK(MXExecutorOutputs(exec, &n_exec_outs, &exec_outs));
+  CHECK(n_exec_outs == 1);
+  float y[8];
+  CHECK_OK(MXNDArraySyncCopyToCPU(exec_outs[0], y, 8));
+  /* row0 = w[:,0] + 0.5 = [1.5, 4.5, 7.5, 10.5] */
+  CHECK(fabsf(y[0] - 1.5f) < 1e-5f && fabsf(y[3] - 10.5f) < 1e-5f);
+  /* row1 = w[:,1] + 0.5 = [2.5, 5.5, 8.5, 11.5] */
+  CHECK(fabsf(y[4] - 2.5f) < 1e-5f && fabsf(y[7] - 11.5f) < 1e-5f);
+
+  CHECK_OK(MXExecutorFree(exec));
+  CHECK_OK(MXSymbolFree(fc));
+  CHECK_OK(MXSymbolFree(fc2));
+  CHECK_OK(MXNDArrayFree(ax));
+  CHECK_OK(MXNDArrayFree(aw));
+  CHECK_OK(MXNDArrayFree(ab));
+  printf("symbol+executor ok\n");
+}
+
+static void test_predict(void) {
+  /* build and save a net + params via the C API, then run MXPred */
+  SymbolHandle x, fc;
+  CHECK_OK(MXSymbolCreateVariable("data", &x));
+  mx_uint n_creators;
+  AtomicSymbolCreator *creators;
+  CHECK_OK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+  AtomicSymbolCreator fc_creator = NULL;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *name;
+    CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, "FullyConnected") == 0) fc_creator = creators[i];
+  }
+  const char *keys[1] = {"num_hidden"};
+  const char *vals[1] = {"2"};
+  CHECK_OK(MXSymbolCreateAtomicSymbol(fc_creator, 1, keys, vals, &fc));
+  const char *ck[1] = {"data"};
+  SymbolHandle cargs[1] = {x};
+  CHECK_OK(MXSymbolCompose(fc, "out", 1, ck, cargs));
+
+  const char *json;
+  CHECK_OK(MXSymbolSaveToJSON(fc, &json));
+  char *json_copy = strdup(json);
+
+  /* params: weight (2,3) identity-ish, bias (2,) */
+  mx_uint ws[2] = {2, 3}, bs[1] = {2};
+  NDArrayHandle aw, ab;
+  CHECK_OK(MXNDArrayCreate(ws, 2, 1, 0, 0, &aw));
+  CHECK_OK(MXNDArrayCreate(bs, 1, 1, 0, 0, &ab));
+  float wd[6] = {1, 0, 0, 0, 1, 0};
+  float bd[2] = {10, 20};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(aw, wd, 6));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(ab, bd, 2));
+  NDArrayHandle params[2] = {aw, ab};
+  const char *pnames[2] = {"arg:out_weight", "arg:out_bias"};
+  const char *param_path = "/tmp/capi_test.params";
+  CHECK_OK(MXNDArraySave(param_path, 2, params, pnames));
+
+  /* read param file back as bytes */
+  FILE *f = fopen(param_path, "rb");
+  CHECK(f != NULL);
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *blob = (char *)malloc(fsize);
+  CHECK(fread(blob, 1, fsize, f) == (size_t)fsize);
+  fclose(f);
+
+  /* NDList sanity over the same blob */
+  NDListHandle ndlist;
+  mx_uint ndlist_len;
+  CHECK_OK(MXNDListCreate(blob, (int)fsize, &ndlist, &ndlist_len));
+  CHECK(ndlist_len == 2);
+  const char *k0;
+  const mx_float *d0;
+  const mx_uint *s0;
+  mx_uint nd0;
+  CHECK_OK(MXNDListGet(ndlist, 0, &k0, &d0, &s0, &nd0));
+  CHECK_OK(MXNDListFree(ndlist));
+
+  const char *input_keys[1] = {"data"};
+  mx_uint indptr[2] = {0, 2};
+  mx_uint sdata[2] = {1, 3};
+  PredictorHandle pred;
+  CHECK_OK(MXPredCreate(json_copy, blob, (int)fsize, 1, 0, 1, input_keys,
+                        indptr, sdata, &pred));
+  free(blob);
+  free(json_copy);
+
+  mx_uint *oshape, ondim;
+  CHECK_OK(MXPredGetOutputShape(pred, 0, &oshape, &ondim));
+  CHECK(ondim == 2 && oshape[0] == 1 && oshape[1] == 2);
+
+  float input[3] = {7, 8, 9};
+  CHECK_OK(MXPredSetInput(pred, "data", input, 3));
+  CHECK_OK(MXPredForward(pred));
+  float output[2];
+  CHECK_OK(MXPredGetOutput(pred, 0, output, 2));
+  CHECK(fabsf(output[0] - 17.0f) < 1e-5f);  /* 7*1 + 10 */
+  CHECK(fabsf(output[1] - 28.0f) < 1e-5f);  /* 8*1 + 20 */
+  CHECK_OK(MXPredFree(pred));
+  CHECK_OK(MXSymbolFree(fc));
+  CHECK_OK(MXNDArrayFree(aw));
+  CHECK_OK(MXNDArrayFree(ab));
+  printf("predict ok\n");
+}
+
+static void test_autograd(void) {
+  mx_uint shape[1] = {3};
+  NDArrayHandle v;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &v));
+  float data[3] = {1, 2, 3};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(v, data, 3));
+  mx_uint reqs[1] = {1};
+  NDArrayHandle grads[1] = {NULL};
+  NDArrayHandle vars[1] = {v};
+  CHECK_OK(MXAutogradMarkVariables(1, vars, reqs, grads));
+
+  int prev;
+  CHECK_OK(MXAutogradSetIsRecording(1, &prev));
+  bool rec;
+  CHECK_OK(MXAutogradIsRecording(&rec));
+  CHECK(rec);
+
+  mx_uint n_creators;
+  AtomicSymbolCreator *creators;
+  CHECK_OK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+  AtomicSymbolCreator mul = NULL;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *name;
+    CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, "elemwise_mul") == 0 || strcmp(name, "_mul") == 0)
+      if (mul == NULL) mul = creators[i];
+  }
+  CHECK(mul != NULL);
+  NDArrayHandle ins[2] = {v, v};
+  int num_out = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK_OK(MXImperativeInvoke(mul, 2, ins, &num_out, &outs, 0, NULL, NULL));
+  CHECK_OK(MXAutogradSetIsRecording(0, &prev));
+
+  NDArrayHandle heads[1] = {outs[0]};
+  CHECK_OK(MXAutogradBackwardEx(1, heads, NULL, 0, 1));
+  NDArrayHandle grad;
+  CHECK_OK(MXNDArrayGetGrad(v, &grad));
+  CHECK(grad != NULL);
+  float g[3];
+  CHECK_OK(MXNDArraySyncCopyToCPU(grad, g, 3));
+  for (int i = 0; i < 3; ++i) CHECK(fabsf(g[i] - 2 * data[i]) < 1e-5f);
+
+  CHECK_OK(MXNDArrayFree(grad));
+  CHECK_OK(MXNDArrayFree(outs[0]));
+  CHECK_OK(MXNDArrayFree(v));
+  printf("autograd ok\n");
+}
+
+static void test_kvstore(void) {
+  KVStoreHandle kv;
+  CHECK_OK(MXKVStoreCreate("local", &kv));
+  const char *type;
+  CHECK_OK(MXKVStoreGetType(kv, &type));
+  CHECK(strcmp(type, "local") == 0);
+  int rank, size;
+  CHECK_OK(MXKVStoreGetRank(kv, &rank));
+  CHECK_OK(MXKVStoreGetGroupSize(kv, &size));
+  CHECK(rank == 0 && size == 1);
+
+  mx_uint shape[1] = {4};
+  NDArrayHandle init_val, out_val;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &init_val));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &out_val));
+  float d[4] = {1, 2, 3, 4};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(init_val, d, 4));
+  int keys[1] = {9};
+  NDArrayHandle vals[1] = {init_val};
+  CHECK_OK(MXKVStoreInit(kv, 1, keys, vals));
+  CHECK_OK(MXKVStorePush(kv, 1, keys, vals, 0));
+  NDArrayHandle outs[1] = {out_val};
+  CHECK_OK(MXKVStorePull(kv, 1, keys, outs, 0));
+  float got[4];
+  CHECK_OK(MXNDArraySyncCopyToCPU(out_val, got, 4));
+  /* no updater set: push stores the (device-reduced) value, as in the
+   * reference's default path (kvstore_local.h MergePushValue) */
+  CHECK(fabsf(got[0] - 1.0f) < 1e-5f && fabsf(got[3] - 4.0f) < 1e-5f);
+
+  int worker;
+  CHECK_OK(MXKVStoreIsWorkerNode(&worker));
+  CHECK(worker == 1);
+  CHECK_OK(MXKVStoreFree(kv));
+  CHECK_OK(MXNDArrayFree(init_val));
+  CHECK_OK(MXNDArrayFree(out_val));
+  printf("kvstore ok\n");
+}
+
+static void test_recordio(void) {
+  const char *path = "/tmp/capi_test.rec";
+  RecordIOHandle w;
+  CHECK_OK(MXRecordIOWriterCreate(path, &w));
+  CHECK_OK(MXRecordIOWriterWriteRecord(w, "hello", 5));
+  CHECK_OK(MXRecordIOWriterWriteRecord(w, "tpu-world", 9));
+  CHECK_OK(MXRecordIOWriterFree(w));
+
+  RecordIOHandle r;
+  CHECK_OK(MXRecordIOReaderCreate(path, &r));
+  const char *buf;
+  size_t len;
+  CHECK_OK(MXRecordIOReaderReadRecord(r, &buf, &len));
+  CHECK(len == 5 && memcmp(buf, "hello", 5) == 0);
+  CHECK_OK(MXRecordIOReaderReadRecord(r, &buf, &len));
+  CHECK(len == 9 && memcmp(buf, "tpu-world", 9) == 0);
+  CHECK_OK(MXRecordIOReaderReadRecord(r, &buf, &len));
+  CHECK(len == (size_t)-1);
+  CHECK_OK(MXRecordIOReaderFree(r));
+  printf("recordio ok\n");
+}
+
+static void test_error_path(void) {
+  /* unknown op through the symbol path must fail with a message */
+  SymbolHandle s;
+  CHECK(MXSymbolCreateFromJSON("not json", &s) == -1);
+  CHECK(strlen(MXGetLastError()) > 0);
+  printf("error path ok\n");
+}
+
+int main(void) {
+  int version;
+  CHECK_OK(MXGetVersion(&version));
+  printf("version %d\n", version);
+
+  test_recordio();        /* native-only path first: no interpreter */
+  test_ndarray_imperative();
+  test_symbol_executor();
+  test_predict();
+  test_autograd();
+  test_kvstore();
+  test_error_path();
+  CHECK_OK(MXRandomSeed(42));
+  CHECK_OK(MXNotifyShutdown());
+  printf("ALL C API TESTS PASSED\n");
+  return 0;
+}

@@ -1,0 +1,34 @@
+"""cpp-package (N20): compile and run the pure-C++ MLP example.
+
+Reference: cpp-package/example/mlp.cpp + tests/cpp — a C++ consumer
+building symbols, binding an executor, and training with manual SGD,
+entirely through the C ABI.
+"""
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.mark.slow
+def test_cpp_mlp_example(tmp_path):
+    subprocess.run(['make', '-C', os.path.join(REPO, 'src'),
+                    os.path.join('..', 'lib', 'libmxnet_tpu.so')],
+                   check=True, capture_output=True, text=True)
+    exe = str(tmp_path / 'cpp_mlp')
+    subprocess.run(
+        ['g++', '-std=c++17', '-o', exe,
+         os.path.join(REPO, 'cpp-package', 'example', 'mlp.cpp'),
+         '-I' + os.path.join(REPO, 'cpp-package', 'include'),
+         '-L' + os.path.join(REPO, 'lib'), '-lmxnet_tpu',
+         '-Wl,-rpath,' + os.path.join(REPO, 'lib')],
+        check=True, capture_output=True, text=True)
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env['JAX_PLATFORMS'] = 'cpu'
+    r = subprocess.run([exe], env=env, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, 'cpp mlp failed:\n%s\n%s' % (r.stdout, r.stderr)
+    assert 'cpp-package mlp ok' in r.stdout
