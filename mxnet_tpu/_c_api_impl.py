@@ -28,7 +28,8 @@ from .ndarray import utils as _nd_utils
 from .context import Context
 from .ops import registry as _op_reg
 from .symbol import Symbol, Variable as _sym_var
-from .symbol.symbol import _invoke_sym, load_json as _sym_load_json
+from .symbol.symbol import (_invoke_sym, _parse_attr,
+                            load_json as _sym_load_json)
 from . import autograd as _autograd
 from . import kvstore as _kvstore_mod
 from . import random as _random_mod
@@ -94,18 +95,28 @@ def nd_create(shape, dev_type, dev_id, delay_alloc, dtype_code):
 
 
 def nd_sync_copy_from_bytes(handle, buf, dtype_code):
+    """Raw bytes in the array's wire dtype (bf16 = 2 B/elt via ml_dtypes,
+    exactly the dtype MXNDArrayGetDType reports)."""
     dtype = _CODE_TO_DTYPE[int(dtype_code)]
-    np_dtype = np.float32 if dtype == 'bfloat16' else np.dtype(dtype)
+    np_dtype = np.dtype(dtype)  # ml_dtypes registers 'bfloat16'
+    expect = int(np.prod(handle.shape)) * np_dtype.itemsize
+    if len(buf) != expect:
+        raise ValueError('SyncCopyFromCPU: got %d bytes, array needs %d'
+                         % (len(buf), expect))
     arr = np.frombuffer(buf, dtype=np_dtype).reshape(handle.shape)
+    if dtype == 'bfloat16':
+        import jax.numpy as jnp
+        handle._set_data(jnp.asarray(arr))
+        return 0
     handle[:] = arr if handle.ndim else _nd_mod.array(arr.reshape(()))
     return 0
 
 
 def nd_sync_copy_to_bytes(handle):
-    npy = handle.asnumpy()
-    if npy.dtype.name == 'bfloat16':
-        npy = npy.astype(np.float32)
-    return npy.tobytes()
+    """Raw bytes in the array's own dtype — byte count always equals
+    size * itemsize of the dtype MXNDArrayGetDType reports (asnumpy()
+    upcasts bf16 for python users, so read the device buffer directly)."""
+    return np.ascontiguousarray(np.asarray(handle._data)).tobytes()
 
 
 def nd_wait_to_read(handle):
@@ -222,7 +233,9 @@ def op_info(name):
 
 
 def imperative_invoke(name, inputs, keys, vals, num_out_provided, outputs):
-    attrs = dict(zip(keys, vals))
+    # C callers send every param as a string; recover typed attrs the same
+    # way symbol JSON loading does (tuples, bools, numbers)
+    attrs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
     out = None
     if num_out_provided:
         out = outputs if len(outputs) > 1 else outputs[0]
@@ -255,9 +268,16 @@ def autograd_is_training():
 
 
 def autograd_mark_variables(arrays, grad_reqs, grads):
-    for arr, req in zip(arrays, grad_reqs):
-        req_name = {0: 'null', 1: 'write', 2: 'add'}.get(int(req), 'write')
-        arr.attach_grad(grad_req=req_name)
+    # OpReqType codes: 0=null, 1=write, 2=inplace, 3=add (ndarray.h)
+    req_map = {0: 'null', 1: 'write', 2: 'write', 3: 'add'}
+    for arr, req, grad in zip(arrays, grad_reqs, grads):
+        req_name = req_map.get(int(req), 'write')
+        if grad is not None:
+            # bind the caller's buffer: backward rebinds grad._data in
+            # place, so the C handle observes the gradients directly
+            _autograd.mark_variables([arr], [grad], req_name)
+        else:
+            arr.attach_grad(grad_req=req_name)
     return 0
 
 
@@ -285,7 +305,8 @@ class _AtomicSymbol:
 def symbol_create_atomic(op_name, keys, vals):
     if not _op_reg.exists(op_name):
         raise ValueError('unknown operator %s' % op_name)
-    return _AtomicSymbol(op_name, dict(zip(keys, vals)))
+    return _AtomicSymbol(op_name,
+                         {k: _parse_attr(v) for k, v in zip(keys, vals)})
 
 
 # MXSymbolCompose mutates in place in the reference (nnvm symbols are
@@ -596,6 +617,7 @@ def _iter_classes():
             'MNISTIter': _io.MNISTIter,
             'CSVIter': _io.CSVIter,
             'ImageRecordIter': _io.ImageRecordIter,
+            'ImageDetRecordIter': _io.ImageDetRecordIter,
             'LibSVMIter': _io.LibSVMIter,
         }
     return _ITER_CLASSES
@@ -607,12 +629,7 @@ def list_data_iters():
 
 def data_iter_create(name, keys, vals):
     cls = _iter_classes()[name]
-    kwargs = {}
-    for k, v in zip(keys, vals):
-        try:
-            kwargs[k] = eval(v, {'__builtins__': {}})  # noqa: S307 — numeric/tuple literals
-        except Exception:
-            kwargs[k] = v
+    kwargs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
     return iter(cls(**kwargs))
 
 

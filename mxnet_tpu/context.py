@@ -36,6 +36,16 @@ class Context:
             self.device_id = device_id
         self._jax_device = None
 
+    def __getstate__(self):
+        # the cached jax Device is process-local and unpicklable
+        return {'device_typeid': self.device_typeid,
+                'device_id': self.device_id}
+
+    def __setstate__(self, state):
+        self.device_typeid = state['device_typeid']
+        self.device_id = state['device_id']
+        self._jax_device = None
+
     @property
     def device_type(self):
         return self.devtype2str[self.device_typeid]

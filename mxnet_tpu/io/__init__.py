@@ -23,7 +23,7 @@ from ..base import MXNetError
 
 __all__ = ['DataDesc', 'DataBatch', 'DataIter', 'NDArrayIter', 'CSVIter',
            'MNISTIter', 'ResizeIter', 'PrefetchingIter', 'ImageRecordIter',
-           'LibSVMIter']
+           'ImageDetRecordIter', 'LibSVMIter']
 
 
 class DataDesc(namedtuple('DataDesc', ['name', 'shape'])):
@@ -536,6 +536,33 @@ class LibSVMIter(DataIter):
         return 0
 
 
+def _read_imgrec(path_imgrec, data_shape, scale, means, stds):
+    """Shared RecordIO image loader: decode every record, normalize.
+
+    Returns (data (N,C,H,W) float32, raw label list). Used by both
+    ImageRecordIter and ImageDetRecordIter (reference shares this in
+    ImageRecordIOParser)."""
+    from ..recordio import MXRecordIO, unpack_img
+    record = MXRecordIO(path_imgrec, 'r')
+    images, labels = [], []
+    while True:
+        item = record.read()
+        if item is None:
+            break
+        header, img = unpack_img(item, data_shape=tuple(data_shape))
+        images.append(img)
+        labels.append(header.label)
+    record.close()
+    if not images:
+        raise ValueError('empty record file %s' % path_imgrec)
+    data = np.stack(images).astype(np.float32) * scale
+    mean = np.asarray(means, dtype=np.float32).reshape(3, 1, 1)
+    std = np.asarray(stds, dtype=np.float32).reshape(3, 1, 1)
+    if data.shape[1] == 3:
+        data = (data - mean) / std
+    return data, labels
+
+
 class ImageRecordIter(DataIter):
     """Reference src/io/iter_image_recordio_2.cc — RecordIO image pipeline.
 
@@ -550,23 +577,10 @@ class ImageRecordIter(DataIter):
                  rand_mirror=False, preprocess_threads=4, round_batch=True,
                  **kwargs):
         super().__init__(batch_size)
-        from ..recordio import MXRecordIO, unpack_img
         self.data_shape = tuple(data_shape)
-        self._record = MXRecordIO(path_imgrec, 'r')
-        images, labels = [], []
-        while True:
-            item = self._record.read()
-            if item is None:
-                break
-            header, img = unpack_img(item, data_shape=self.data_shape)
-            images.append(img)
-            labels.append(header.label)
-        self._record.close()
-        data = np.stack(images).astype(np.float32) * scale
-        mean = np.array([mean_r, mean_g, mean_b], dtype=np.float32).reshape(3, 1, 1)
-        std = np.array([std_r, std_g, std_b], dtype=np.float32).reshape(3, 1, 1)
-        if data.shape[1] == 3:
-            data = (data - mean) / std
+        data, labels = _read_imgrec(path_imgrec, self.data_shape, scale,
+                                    (mean_r, mean_g, mean_b),
+                                    (std_r, std_g, std_b))
         label = np.asarray(labels, dtype=np.float32)
         if label_width == 1 and label.ndim > 1:
             label = label[:, 0]
@@ -594,6 +608,126 @@ class ImageRecordIter(DataIter):
                               batch.label, batch.pad, batch.index,
                               provide_data=batch.provide_data,
                               provide_label=batch.provide_label)
+        return batch
+
+    def iter_next(self):
+        return self._inner.iter_next()
+
+
+class ImageDetRecordIter(DataIter):
+    """Detection RecordIO pipeline — reference src/io/iter_image_det_recordio.cc.
+
+    Records are packed by tools/im2rec.py with ``--pack-label`` from a
+    detection .lst: label = [header_width, object_width, (extra header...),
+    then per-object rows of object_width values, conventionally
+    [class_id, xmin, ymin, xmax, ymax, ...]].
+
+    Labels are padded to a common (max_objects, object_width) block with
+    ``label_pad_value`` (reference's DefaultPadLabel), so a batch is one
+    dense (B, max_objects*object_width [+2 header]) array — dynamic object
+    counts never reach the device, which is what XLA needs.
+    """
+
+    @staticmethod
+    def _is_det_header(lab):
+        """Packed-label detection header: [hdr_w>=2, obj_w>=1, ...] with the
+        body an exact multiple of obj_w (iter_image_det_recordio.cc
+        ImageDetLabelMap sanity checks)."""
+        if lab.size < 2:
+            return False
+        hdr_w, ow = float(lab[0]), float(lab[1])
+        if hdr_w < 2 or ow < 1 or hdr_w != int(hdr_w) or ow != int(ow):
+            return False
+        body = lab.size - int(hdr_w)
+        return body >= 0 and body % int(ow) == 0
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=-1,
+                 label_pad_width=-1, label_pad_value=-1.0, shuffle=False,
+                 mean_r=0, mean_g=0, mean_b=0, std_r=1, std_g=1, std_b=1,
+                 scale=1.0, rand_mirror=False, round_batch=True, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        data, raw_labels = _read_imgrec(path_imgrec, self.data_shape, scale,
+                                        (mean_r, mean_g, mean_b),
+                                        (std_r, std_g, std_b))
+
+        # normalize labels to [hdr_w, obj_w, objects...]
+        parsed = []
+        max_objs = 0
+        obj_w = None
+        for rec_i, lab in enumerate(raw_labels):
+            lab = np.atleast_1d(np.asarray(lab, dtype=np.float32))
+            if self._is_det_header(lab):
+                ow = int(lab[1])
+                body = lab[int(lab[0]):]
+            else:  # plain label row: promote to 1 object row
+                ow = max(int(lab.size), 1)
+                body = lab
+            if obj_w is None:
+                obj_w = ow
+            elif ow != obj_w:
+                raise ValueError(
+                    'record %d: inconsistent object width: %d vs %d'
+                    % (rec_i, ow, obj_w))
+            objs = body.reshape(-1, obj_w) if body.size else \
+                np.zeros((0, obj_w), np.float32)
+            parsed.append(objs)
+            max_objs = max(max_objs, objs.shape[0])
+        if label_pad_width > 0:
+            max_objs = max(max_objs, (label_pad_width - 2) // obj_w)
+        self.label_object_width = obj_w
+        self.max_objects = max_objs
+
+        label = np.full((len(parsed), 2 + max_objs * obj_w), label_pad_value,
+                        dtype=np.float32)
+        label[:, 0] = 2.0
+        label[:, 1] = float(obj_w)
+        for i, objs in enumerate(parsed):
+            label[i, 2:2 + objs.size] = objs.ravel()
+
+        self._inner = NDArrayIter(
+            data, label, batch_size=batch_size, shuffle=shuffle,
+            last_batch_handle='pad' if round_batch else 'discard')
+        self._rand_mirror = rand_mirror
+        self._pad_value = label_pad_value
+
+    @property
+    def provide_data(self):
+        return self._inner.provide_data
+
+    @property
+    def provide_label(self):
+        return self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def _mirror_batch(self, batch):
+        """Horizontal flip + x-coordinate label flip (reference
+        DefaultImageDetAugmenter HorizontalFlip: normalized [0,1] coords,
+        xmin' = 1-xmax, xmax' = 1-xmin for [id,xmin,ymin,xmax,ymax,...])."""
+        data = [d.flip(axis=3) if d.ndim == 4 else d for d in batch.data]
+        labels = []
+        for lab_nd in batch.label:
+            lab = lab_nd.asnumpy().copy()
+            ow = self.label_object_width
+            if ow >= 5:
+                objs = lab[:, 2:].reshape(lab.shape[0], -1, ow)
+                valid = objs[:, :, 0] != self._pad_value
+                xmin = objs[:, :, 1].copy()
+                xmax = objs[:, :, 3].copy()
+                objs[:, :, 1] = np.where(valid, 1.0 - xmax, objs[:, :, 1])
+                objs[:, :, 3] = np.where(valid, 1.0 - xmin, objs[:, :, 3])
+                lab[:, 2:] = objs.reshape(lab.shape[0], -1)
+            labels.append(array(lab))
+        return DataBatch(data, labels, batch.pad, batch.index,
+                         provide_data=batch.provide_data,
+                         provide_label=batch.provide_label)
+
+    def next(self):
+        batch = self._inner.next()
+        if self._rand_mirror and np.random.rand() < 0.5:
+            batch = self._mirror_batch(batch)
         return batch
 
     def iter_next(self):

@@ -83,3 +83,14 @@ def true_divide(lhs, rhs):
     return divide(lhs, rhs)
 
 from . import contrib  # noqa: E402,F401  (mx.nd.contrib.*)
+
+
+def __getattr__(name):
+    """Late-binding for ops registered after import (Custom ops, plugins —
+    reference re-runs _init_ops on MXCustomOpRegister)."""
+    from ..ops import registry as _late_reg
+    if _late_reg.exists(name):
+        fn = _register.make_nd_function(name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError('module %r has no attribute %r' % (__name__, name))

@@ -65,6 +65,28 @@ class NDArray:
         self._out_idx = 0
         self._fresh_grad = True
 
+    # pickling carries values only (host numpy + context), never tape or
+    # device state — same contract as the reference's NDArray __reduce__
+    # (python/mxnet/ndarray.py save/load path)
+    def __getstate__(self):
+        npy = np.asarray(self._data)
+        if npy.dtype.name == 'bfloat16':
+            return {'data': npy.astype(np.float32), 'ctx': self._ctx,
+                    'bf16': True}
+        return {'data': npy, 'ctx': self._ctx, 'bf16': False}
+
+    def __setstate__(self, state):
+        import jax.numpy as jnp
+        import jax
+        dtype = jnp.bfloat16 if state.get('bf16') else None
+        data = jnp.asarray(state['data'], dtype=dtype)
+        ctx = state['ctx']
+        try:
+            data = jax.device_put(data, ctx.jax_device())
+        except Exception:
+            pass  # device unavailable in this process: keep default placement
+        self.__init__(data, ctx=ctx)
+
     # -- basic properties -------------------------------------------------
     @property
     def shape(self):
@@ -72,8 +94,9 @@ class NDArray:
 
     @property
     def dtype(self):
-        return np.dtype(self._data.dtype) if self._data.dtype != jnp.bfloat16 \
-            else jnp.bfloat16
+        # np.dtype handles bfloat16 via ml_dtypes and compares equal to
+        # jnp.bfloat16, so one uniform return type (str() -> 'bfloat16')
+        return np.dtype(self._data.dtype)
 
     @property
     def size(self):

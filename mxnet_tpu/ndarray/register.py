@@ -22,8 +22,10 @@ def make_nd_function(op_name):
         inputs = []
         pos_inputs = [a for a in args if isinstance(a, NDArray)]
         # scalar positional args map onto declared params in order
-        # (matches the generated-signature convention of ndarray/op.py)
-        pos_attrs = [a for a in args if not isinstance(a, NDArray)]
+        # (matches the generated-signature convention of ndarray/op.py);
+        # a positional None is an omitted optional input, not a param
+        pos_attrs = [a for a in args
+                     if not isinstance(a, NDArray) and a is not None]
         if pos_attrs:
             for pname in op.param_defaults:
                 if not pos_attrs:

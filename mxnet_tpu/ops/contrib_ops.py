@@ -372,3 +372,308 @@ def _multibox_detection(attrs, cls_prob, loc_pred, anchor):
           param_defaults={'format': 'corner'}, differentiable=False)
 def _box_iou_op(attrs, lhs, rhs):
     return _box_iou(lhs.reshape(-1, 4), rhs.reshape(-1, 4))
+
+
+# ---------------------------------------------------------------------------
+# DeformableConvolution — reference contrib/deformable_convolution-inl.h
+# (deformable_im2col + group gemm). TPU formulation: bilinear gather builds
+# the deformed im2col tensor, one einsum does the group conv on the MXU.
+# ---------------------------------------------------------------------------
+def _bilinear_at(img, y, x):
+    """img (C,H,W); y,x arbitrary same-shaped float coords → (C,) + y.shape.
+    Out-of-range samples contribute 0, matching deformable_im2col's
+    zero-padding behavior."""
+    H, W = img.shape[1], img.shape[2]
+    y0 = jnp.floor(y)
+    x0 = jnp.floor(x)
+    wy = y - y0
+    wx = x - x0
+
+    def g(yy, xx):
+        yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+        xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+        ok = (yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)
+        return jnp.where(ok[None], img[:, yi, xi], 0.0)
+
+    return (g(y0, x0) * ((1 - wy) * (1 - wx))[None] +
+            g(y0, x0 + 1) * ((1 - wy) * wx)[None] +
+            g(y0 + 1, x0) * (wy * (1 - wx))[None] +
+            g(y0 + 1, x0 + 1) * (wy * wx)[None])
+
+
+@register('_contrib_DeformableConvolution',
+          input_names=['data', 'offset', 'weight', 'bias'],
+          param_defaults={'kernel': (1, 1), 'stride': (1, 1), 'dilate': (1, 1),
+                          'pad': (0, 0), 'num_filter': 1, 'num_group': 1,
+                          'num_deformable_group': 1, 'workspace': 1024,
+                          'no_bias': False, 'layout': None})
+def _deformable_convolution(attrs, data, offset, weight, bias=None):
+    kh, kw = attrs['kernel']
+    sh, sw = attrs.get('stride', (1, 1))
+    dh, dw = attrs.get('dilate', (1, 1))
+    ph, pw = attrs.get('pad', (0, 0))
+    G = int(attrs.get('num_group', 1))
+    DG = int(attrs.get('num_deformable_group', 1))
+    N, C, H, W = data.shape
+    F = int(attrs['num_filter'])
+    OH = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    OW = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+
+    # base sampling grid per tap: (KH*KW, OH, OW)
+    oy = jnp.arange(OH) * sh - ph
+    ox = jnp.arange(OW) * sw - pw
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw) * dw,
+                          indexing='ij')
+    base_y = oy[None, :, None] + ky.ravel()[:, None, None]  # (K, OH, 1)
+    base_x = ox[None, None, :] + kx.ravel()[:, None, None].transpose(0, 2, 1)
+    base_y = jnp.broadcast_to(base_y, (kh * kw, OH, OW)).astype(data.dtype)
+    base_x = jnp.broadcast_to(base_x, (kh * kw, OH, OW)).astype(data.dtype)
+
+    cpg = C // DG  # channels per deformable group
+
+    def sample_one(img, off):
+        # img (C,H,W); off (DG*2*K, OH, OW) laid out [dg][ (y,x) per tap ]
+        off = off.reshape(DG, kh * kw, 2, OH, OW)
+
+        def per_dg(img_dg, off_dg):
+            y = base_y + off_dg[:, 0]  # (K, OH, OW)
+            x = base_x + off_dg[:, 1]
+            return _bilinear_at(img_dg, y, x)  # (cpg, K, OH, OW)
+
+        sampled = jax.vmap(per_dg)(img.reshape(DG, cpg, H, W), off)
+        return sampled.reshape(C, kh * kw, OH, OW)
+
+    cols = jax.vmap(sample_one)(data, offset)  # (N, C, K, OH, OW)
+    # group conv: split C and F into G groups, contract (C/G * K) on the MXU
+    cols = cols.reshape(N, G, C // G, kh * kw, OH, OW)
+    wg = weight.reshape(G, F // G, C // G, kh * kw)
+    out = jnp.einsum('ngckhw,gfck->ngfhw', cols, wg,
+                     preferred_element_type=jnp.float32)
+    out = out.reshape(N, F, OH, OW).astype(data.dtype)
+    if bias is not None and not attrs.get('no_bias', False):
+        out = out + bias[None, :, None, None]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# DeformablePSROIPooling — reference contrib/deformable_psroi_pooling-inl.h
+# (position-sensitive score maps + learned per-part offsets, R-FCN style)
+# ---------------------------------------------------------------------------
+@register('_contrib_DeformablePSROIPooling',
+          input_names=['data', 'rois', 'trans'],
+          param_defaults={'spatial_scale': 1.0, 'output_dim': 1,
+                          'group_size': 1, 'pooled_size': 1, 'part_size': 0,
+                          'sample_per_part': 1, 'trans_std': 0.0,
+                          'no_trans': False})
+def _deformable_psroi_pooling(attrs, data, rois, trans=None):
+    scale = float(attrs['spatial_scale'])
+    out_dim = int(attrs['output_dim'])
+    gs = int(attrs['group_size'])
+    ps = int(attrs['pooled_size'])
+    part = int(attrs.get('part_size', 0)) or ps
+    spp = int(attrs.get('sample_per_part', 1))
+    tstd = float(attrs.get('trans_std', 0.0))
+    no_trans = attrs.get('no_trans', False) or trans is None
+    N, C, H, W = data.shape
+
+    iy, ix = jnp.meshgrid(jnp.arange(ps), jnp.arange(ps), indexing='ij')
+    sy, sx = jnp.meshgrid(jnp.arange(spp), jnp.arange(spp), indexing='ij')
+
+    def pool_one(roi, tr):
+        b = roi[0].astype(jnp.int32)
+        # reference rounds rois to the feature grid and enforces min size 0.1
+        x1 = jnp.round(roi[1]) * scale - 0.5
+        y1 = jnp.round(roi[2]) * scale - 0.5
+        x2 = (jnp.round(roi[3]) + 1.0) * scale - 0.5
+        y2 = (jnp.round(roi[4]) + 1.0) * scale - 0.5
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / ps
+        bin_h = rh / ps
+        sub_w = bin_w / spp
+        sub_h = bin_h / spp
+        img = data[b]  # (C, H, W)
+
+        # per-bin learned offset, scaled by roi size (deformable_psroi:
+        # trans (R, 2, part, part), class-agnostic)
+        if no_trans:
+            off_y = jnp.zeros((ps, ps))
+            off_x = jnp.zeros((ps, ps))
+        else:
+            py = (iy * part) // ps
+            px = (ix * part) // ps
+            off_y = tr[0, py, px] * tstd * rh
+            off_x = tr[1, py, px] * tstd * rw
+
+        # sample grid: (ps, ps, spp, spp)
+        yy = (y1 + iy[..., None, None] * bin_h + off_y[..., None, None]
+              + (sy + 0.5) * sub_h)
+        xx = (x1 + ix[..., None, None] * bin_w + off_x[..., None, None]
+              + (sx + 0.5) * sub_w)
+        # reference skips samples outside [-0.5, dim-0.5) and divides by
+        # the in-bounds count only, clamping kept coords to the border
+        valid = ((yy > -0.5) & (yy < H - 0.5) &
+                 (xx > -0.5) & (xx < W - 0.5))
+        yc = jnp.clip(yy, 0.0, H - 1.0)
+        xc = jnp.clip(xx, 0.0, W - 1.0)
+        sampled = _bilinear_at(img, yc, xc)  # (C, ps, ps, spp, spp)
+        count = jnp.maximum(valid.sum(axis=(-2, -1)), 1)
+        avg = (sampled * valid[None]).sum(axis=(-2, -1)) / count[None]
+        # position-sensitive channel selection:
+        # channel(c, bin) = (c*gs + gy)*gs + gx with gy,gx = bin scaled to gs
+        gy = (iy * gs) // ps
+        gx = (ix * gs) // ps
+        chan = (jnp.arange(out_dim)[:, None, None] * gs + gy) * gs + gx
+        return avg[chan, iy[None], ix[None]]  # (out_dim, ps, ps)
+
+    if no_trans:
+        tr_in = jnp.zeros((rois.shape[0], 2, part, part), dtype=data.dtype)
+    else:
+        tr_in = trans
+    return jax.vmap(pool_one)(rois, tr_in)
+
+
+# ---------------------------------------------------------------------------
+# MultiProposal — reference contrib/multi_proposal-inl.h (batched RPN
+# proposal generation: anchor decode + clip + min-size filter + NMS)
+# ---------------------------------------------------------------------------
+def _gen_anchors(feature_stride, scales, ratios):
+    """Base anchors centered on a feature_stride cell (generate_anchors)."""
+    base = jnp.array([0, 0, feature_stride - 1, feature_stride - 1],
+                     dtype=jnp.float32)
+    w = base[2] - base[0] + 1
+    h = base[3] - base[1] + 1
+    cx = base[0] + (w - 1) / 2
+    cy = base[1] + (h - 1) / 2
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = jnp.round(jnp.sqrt(size / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            wss = ws * s
+            hss = hs * s
+            anchors.append(jnp.stack([cx - (wss - 1) / 2, cy - (hss - 1) / 2,
+                                      cx + (wss - 1) / 2, cy + (hss - 1) / 2]))
+    return jnp.stack(anchors)  # (A, 4)
+
+
+@register('_contrib_MultiProposal',
+          input_names=['cls_prob', 'bbox_pred', 'im_info'],
+          param_defaults={'rpn_pre_nms_top_n': 6000, 'rpn_post_nms_top_n': 300,
+                          'threshold': 0.7, 'rpn_min_size': 16,
+                          'scales': (4.0, 8.0, 16.0, 32.0),
+                          'ratios': (0.5, 1.0, 2.0), 'feature_stride': 16,
+                          'output_score': False, 'iou_loss': False},
+          num_outputs=lambda attrs: 2 if attrs.get('output_score') else 1,
+          differentiable=False)
+def _multi_proposal(attrs, cls_prob, bbox_pred, im_info):
+    stride = int(attrs.get('feature_stride', 16))
+    scales = tuple(attrs.get('scales', (4.0, 8.0, 16.0, 32.0)))
+    ratios = tuple(attrs.get('ratios', (0.5, 1.0, 2.0)))
+    pre_n = int(attrs.get('rpn_pre_nms_top_n', 6000))
+    post_n = int(attrs.get('rpn_post_nms_top_n', 300))
+    nms_thresh = float(attrs.get('threshold', 0.7))
+    min_size = float(attrs.get('rpn_min_size', 16))
+
+    N, _, FH, FW = cls_prob.shape
+    A = len(scales) * len(ratios)
+    base = _gen_anchors(stride, scales, ratios)  # (A,4)
+    shift_x = jnp.arange(FW) * stride
+    shift_y = jnp.arange(FH) * stride
+    sy, sx = jnp.meshgrid(shift_y, shift_x, indexing='ij')
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 4)  # (HW,4)
+    anchors = (base[None] + shifts[:, None]).reshape(-1, 4)  # (HW*A,4)
+    K = anchors.shape[0]
+    pre_n = min(pre_n, K)
+    post_n = min(post_n, pre_n)
+
+    def per_image(probs, deltas, info):
+        ih, iw, im_scale = info[0], info[1], info[2]
+        # scores: foreground half, layout (A, H, W) after the first A bg maps
+        fg = probs[A:].reshape(A, FH, FW).transpose(1, 2, 0).reshape(-1)
+        d = deltas.reshape(A, 4, FH, FW).transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = anchors[:, 2] - anchors[:, 0] + 1
+        ah = anchors[:, 3] - anchors[:, 1] + 1
+        acx = anchors[:, 0] + aw * 0.5
+        acy = anchors[:, 1] + ah * 0.5
+        cx = d[:, 0] * aw + acx
+        cy = d[:, 1] * ah + acy
+        w = jnp.exp(jnp.clip(d[:, 2], -10, 10)) * aw
+        h = jnp.exp(jnp.clip(d[:, 3], -10, 10)) * ah
+        x1 = jnp.clip(cx - w * 0.5, 0, iw - 1)
+        y1 = jnp.clip(cy - h * 0.5, 0, ih - 1)
+        x2 = jnp.clip(cx + w * 0.5, 0, iw - 1)
+        y2 = jnp.clip(cy + h * 0.5, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)
+        keep = ((x2 - x1 + 1 >= min_size * im_scale) &
+                (y2 - y1 + 1 >= min_size * im_scale))
+        score = jnp.where(keep, fg, -1.0)
+        order = jnp.argsort(-score)[:pre_n]
+        b = boxes[order]
+        s = score[order]
+        iou = _box_iou(b, b)
+        earlier = jnp.arange(pre_n)[:, None] > jnp.arange(pre_n)[None, :]
+        # greedy NMS as a scan over rank: kept[i] = no earlier kept box
+        # overlaps it above threshold
+        def nms_step(kept, i):
+            sup = jnp.any(kept & earlier[i] & (iou[i] > nms_thresh))
+            kept = kept.at[i].set(~sup & (s[i] > -1.0))
+            return kept, None
+        kept, _ = jax.lax.scan(nms_step, jnp.zeros(pre_n, bool),
+                               jnp.arange(pre_n))
+        # compact kept boxes (in score order) into the first post_n slots;
+        # unfilled tail stays zero, as in the reference's workspace memset
+        rank = jnp.cumsum(kept) - 1
+        sel = kept & (rank < post_n)
+        idx = jnp.clip(rank, 0, post_n - 1)
+        out_boxes = jnp.zeros((post_n, 4), dtype=boxes.dtype).at[idx].add(
+            jnp.where(sel[:, None], b, 0.0))
+        out_scores = jnp.zeros((post_n,), dtype=s.dtype).at[idx].add(
+            jnp.where(sel, s, 0.0))
+        return out_boxes, out_scores
+
+    boxes, scores = jax.vmap(per_image)(cls_prob, bbox_pred, im_info)
+    batch_idx = jnp.repeat(jnp.arange(N, dtype=boxes.dtype), post_n)
+    rois = jnp.concatenate([batch_idx[:, None],
+                            boxes.reshape(N * post_n, 4)], axis=1)
+    if attrs.get('output_score', False):
+        return rois, scores.reshape(N * post_n, 1)
+    return rois
+
+
+@register('_contrib_Proposal',
+          input_names=['cls_prob', 'bbox_pred', 'im_info'],
+          param_defaults={'rpn_pre_nms_top_n': 6000, 'rpn_post_nms_top_n': 300,
+                          'threshold': 0.7, 'rpn_min_size': 16,
+                          'scales': (4.0, 8.0, 16.0, 32.0),
+                          'ratios': (0.5, 1.0, 2.0), 'feature_stride': 16,
+                          'output_score': False, 'iou_loss': False},
+          num_outputs=lambda attrs: 2 if attrs.get('output_score') else 1,
+          differentiable=False)
+def _proposal(attrs, cls_prob, bbox_pred, im_info):
+    """Reference contrib/proposal.cc — single-image form of MultiProposal."""
+    return _multi_proposal(attrs, cls_prob, bbox_pred, im_info)
+
+
+# ---------------------------------------------------------------------------
+# quantize — reference contrib/quantize-inl.h (fp32 → uint8 affine, carries
+# the calibration range through as outputs 1/2); pairs with
+# _contrib_dequantize above.
+# ---------------------------------------------------------------------------
+@register('_contrib_quantize', input_names=['data', 'min_range', 'max_range'],
+          param_defaults={'out_type': 'uint8'}, num_outputs=3,
+          differentiable=False)
+def _quantize(attrs, data, min_range, max_range):
+    out_type = attrs.get('out_type', 'uint8')
+    scale_den = max_range - min_range
+    if out_type == 'int8':
+        # signed path needs true rounding (the reference's +0.5-then-
+        # truncate trick only rounds correctly for non-negative values)
+        scale = 255.0 / scale_den
+        q = jnp.clip(jnp.round((data - min_range) * scale) - 128.0,
+                     -128.0, 127.0)
+        return q.astype(jnp.int8), min_range, max_range
+    scale = 255.0 / scale_den
+    q = jnp.clip(jnp.floor((data - min_range) * scale + 0.5), 0.0, 255.0)
+    return q.astype(jnp.uint8), min_range, max_range

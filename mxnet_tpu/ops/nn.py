@@ -108,7 +108,20 @@ def _deconvolution(attrs, data, weight, bias=None):
     groups = int(attrs.get('num_group', 1))
     adj = tuple(attrs.get('adj') or (0,) * nd)
 
-    # weight layout is (in_ch, out_ch/g, *kernel) in MXNet deconv
+    # weight layout is (in_ch, out_ch/g, *kernel) in MXNet deconv; the
+    # kernel must be spatially flipped: deconv is the input-gradient of
+    # the (correlation-style) forward conv, which correlates against the
+    # reversed kernel (deconvolution-inl.h pack_col2im == conv backward)
+    weight = weight[(slice(None), slice(None)) +
+                    (slice(None, None, -1),) * nd]
+    if groups > 1:
+        # jax wants rhs (C/g, F, *k) with the O dim group-major; mxnet
+        # stores (C, F/g, *k) with groups stacked along C
+        C = weight.shape[0]
+        fpg = weight.shape[1]
+        w = weight.reshape((groups, C // groups, fpg) + kernel)
+        w = jnp.moveaxis(w, 0, 1)  # (C/g, g, F/g, *k)
+        weight = w.reshape((C // groups, groups * fpg) + kernel)
     dn = jax.lax.conv_dimension_numbers(
         data.shape, weight.shape,
         ('NCHW', 'IOHW', 'NCHW') if nd == 2 else ('NCDHW', 'IODHW', 'NCDHW'))

@@ -18,3 +18,13 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype='float32', **kwargs):
                                                   repeat=repeat, dtype=dtype, **kwargs)
 
 from . import contrib  # noqa: E402,F401  (mx.sym.contrib.*)
+
+
+def __getattr__(name):
+    """Late-binding for ops registered after import (mirrors ndarray)."""
+    from ..ops import registry as _late_reg
+    if _late_reg.exists(name):
+        fn = _register.make_sym_function(name)
+        globals()[name] = fn
+        return fn
+    raise AttributeError('module %r has no attribute %r' % (__name__, name))

@@ -56,6 +56,20 @@ def _conv_params(attrs, in_shapes):
     return out
 
 
+@param_shape_hook('_contrib_DeformableConvolution')
+def _deform_conv_params(attrs, in_shapes):
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    nf = int(attrs['num_filter'])
+    g = int(attrs.get('num_group', 1))
+    kernel = tuple(attrs['kernel'])
+    out = {'weight': (nf, data[1] // g) + kernel}
+    if not attrs.get('no_bias', False):
+        out['bias'] = (nf,)
+    return out
+
+
 @param_shape_hook('Deconvolution')
 def _deconv_params(attrs, in_shapes):
     data = in_shapes[0]

@@ -263,29 +263,38 @@ int MXNDArraySyncCopyFromCPU(NDArrayHandle handle, const void *data,
   CHECK_PY(dt);
   long dtype = PyLong_AsLong(dt);
   Py_DECREF(dt);
-  /* size is an element count in the reference ABI */
+  /* size is an element count in the reference ABI; bytes are in the
+   * array's own dtype (bf16 = 2 B/elt, matching MXNDArrayGetDType) */
   static const size_t esize[] = {4, 8, 2, 1, 4, 1, 8, 2};
   size_t nbytes = size * esize[dtype < 8 ? dtype : 0];
-  /* bf16 device arrays take fp32 host data (GetData mirrors fp32 out) */
-  int host_dtype = (int)dtype;
-  if (dtype == 7) { host_dtype = 0; nbytes = size * 4; }
   PyObject *buf = PyBytes_FromStringAndSize((const char *)data, nbytes);
   PyObject *r = CallV("nd_sync_copy_from_bytes",
-                      Py_BuildValue("(ONi)", h, buf, host_dtype));
+                      Py_BuildValue("(ONl)", h, buf, dtype));
   CHECK_PY(r); Py_DECREF(r);
   return 0;
 }
 
 int MXNDArraySyncCopyToCPU(NDArrayHandle handle, void *data, size_t size) {
   API_BEGIN();
+  PyObject *dt = CallV("nd_dtype", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(dt);
+  long dtype = PyLong_AsLong(dt);
+  Py_DECREF(dt);
+  static const size_t esize[] = {4, 8, 2, 1, 4, 1, 8, 2};
+  size_t expect = size * esize[(dtype >= 0 && dtype < 8) ? dtype : 0];
   PyObject *r = CallV("nd_sync_copy_to_bytes",
                       Py_BuildValue("(O)", (PyObject *)handle));
   CHECK_PY(r);
   char *buf; Py_ssize_t len;
   if (PyBytes_AsStringAndSize(r, &buf, &len) != 0) { Py_DECREF(r); return Fail(); }
-  size_t want = len; /* bridge returns exactly shape-sized fp32/typed buffer */
-  (void)size;
-  std::memcpy(data, buf, want);
+  /* size is the caller's element count; refuse mismatches instead of
+   * overrunning the caller's buffer (reference: CHECK_EQ on Size()) */
+  if ((size_t)len != expect) {
+    Py_DECREF(r);
+    last_error = "MXNDArraySyncCopyToCPU: element count/dtype mismatch";
+    return -1;
+  }
+  std::memcpy(data, buf, (size_t)len);
   Py_DECREF(r);
   return 0;
 }

@@ -402,6 +402,101 @@ static void test_recordio(void) {
   printf("recordio ok\n");
 }
 
+static void test_typed_params_and_bf16(void) {
+  /* tuple-valued string params must parse (imperative path) */
+  mx_uint n_creators;
+  AtomicSymbolCreator *creators;
+  CHECK_OK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+  AtomicSymbolCreator conv = NULL;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *name;
+    CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, "Convolution") == 0) conv = creators[i];
+  }
+  CHECK(conv != NULL);
+
+  mx_uint xs[4] = {1, 2, 5, 5}, ws[4] = {3, 2, 2, 2};
+  NDArrayHandle x, w;
+  CHECK_OK(MXNDArrayCreate(xs, 4, 1, 0, 0, &x));
+  CHECK_OK(MXNDArrayCreate(ws, 4, 1, 0, 0, &w));
+  float xd[50], wd[24];
+  for (int i = 0; i < 50; ++i) xd[i] = (float)i * 0.1f;
+  for (int i = 0; i < 24; ++i) wd[i] = 0.5f;
+  CHECK_OK(MXNDArraySyncCopyFromCPU(x, xd, 50));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(w, wd, 24));
+  NDArrayHandle ins[2] = {x, w};
+  const char *pk[3] = {"kernel", "num_filter", "no_bias"};
+  const char *pv[3] = {"(2, 2)", "3", "True"};
+  int num_out = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK_OK(MXImperativeInvoke(conv, 2, ins, &num_out, &outs, 3, pk, pv));
+  mx_uint ndim;
+  const mx_uint *dims;
+  CHECK_OK(MXNDArrayGetShape(outs[0], &ndim, &dims));
+  CHECK(ndim == 4 && dims[1] == 3 && dims[2] == 4 && dims[3] == 4);
+  CHECK_OK(MXNDArrayFree(outs[0]));
+  CHECK_OK(MXNDArrayFree(x));
+  CHECK_OK(MXNDArrayFree(w));
+
+  /* bf16: 2 bytes per element both directions, wrong size rejected */
+  mx_uint bs[1] = {4};
+  NDArrayHandle b;
+  CHECK_OK(MXNDArrayCreateEx(bs, 1, 1, 0, 0, 7, &b));
+  int dt;
+  CHECK_OK(MXNDArrayGetDType(b, &dt));
+  CHECK(dt == 7);
+  uint16_t raw[4] = {0x3f80, 0x4000, 0x4040, 0x4080}; /* 1,2,3,4 in bf16 */
+  CHECK_OK(MXNDArraySyncCopyFromCPU(b, raw, 4));
+  uint16_t back[4] = {0, 0, 0, 0};
+  CHECK_OK(MXNDArraySyncCopyToCPU(b, back, 4));
+  for (int i = 0; i < 4; ++i) CHECK(back[i] == raw[i]);
+  /* element-count mismatch must fail, not overflow */
+  float big[8];
+  CHECK(MXNDArraySyncCopyToCPU(b, big, 8) == -1);
+  CHECK_OK(MXNDArrayFree(b));
+  printf("typed params + bf16 ok\n");
+}
+
+static void test_caller_grad_buffer(void) {
+  /* MXAutogradMarkVariables with a caller-provided grad handle: gradients
+   * must land in that handle (reference ABI contract) */
+  mx_uint shape[1] = {3};
+  NDArrayHandle v, gbuf;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &v));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &gbuf));
+  float data[3] = {1, 2, 3};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(v, data, 3));
+  mx_uint reqs[1] = {1};
+  NDArrayHandle vars[1] = {v};
+  NDArrayHandle grads[1] = {gbuf};
+  CHECK_OK(MXAutogradMarkVariables(1, vars, reqs, grads));
+  int prev;
+  CHECK_OK(MXAutogradSetIsRecording(1, &prev));
+  mx_uint n_creators;
+  AtomicSymbolCreator *creators;
+  CHECK_OK(MXSymbolListAtomicSymbolCreators(&n_creators, &creators));
+  AtomicSymbolCreator mul = NULL;
+  for (mx_uint i = 0; i < n_creators; ++i) {
+    const char *name;
+    CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, "elemwise_mul") == 0) mul = creators[i];
+  }
+  NDArrayHandle ins[2] = {v, v};
+  int num_out = 0;
+  NDArrayHandle *outs = NULL;
+  CHECK_OK(MXImperativeInvoke(mul, 2, ins, &num_out, &outs, 0, NULL, NULL));
+  CHECK_OK(MXAutogradSetIsRecording(0, &prev));
+  NDArrayHandle heads[1] = {outs[0]};
+  CHECK_OK(MXAutogradBackwardEx(1, heads, NULL, 0, 1));
+  float g[3];
+  CHECK_OK(MXNDArraySyncCopyToCPU(gbuf, g, 3));
+  for (int i = 0; i < 3; ++i) CHECK(fabsf(g[i] - 2 * data[i]) < 1e-5f);
+  CHECK_OK(MXNDArrayFree(outs[0]));
+  CHECK_OK(MXNDArrayFree(v));
+  CHECK_OK(MXNDArrayFree(gbuf));
+  printf("caller grad buffer ok\n");
+}
+
 static void test_error_path(void) {
   /* unknown op through the symbol path must fail with a message */
   SymbolHandle s;
@@ -421,6 +516,8 @@ int main(void) {
   test_predict();
   test_autograd();
   test_kvstore();
+  test_typed_params_and_bf16();
+  test_caller_grad_buffer();
   test_error_path();
   CHECK_OK(MXRandomSeed(42));
   CHECK_OK(MXNotifyShutdown());

@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Collective-bandwidth measurement tool.
+
+Reference: tools/bandwidth/measure.py (times kvstore push+pull of
+ResNet/VGG-sized parameter sets across devices and reports GB/s).
+
+TPU-native: the data plane is XLA collectives over the device mesh, so
+this measures what actually carries gradients here — psum (allreduce),
+all_gather and reduce_scatter over a 1-D mesh axis — plus the
+kvstore-level push+pull round for parity with the reference's number.
+
+    python tools/bandwidth.py --sizes 1e6,1e7 --iters 20
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def measure_collectives(sizes, iters, dtype='float32'):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(devs, ('x',))
+    results = []
+    for size in sizes:
+        size = int(size)
+        # per-shard blocks must themselves split n ways (psum_scatter)
+        per_dev = max(size // (n * n), 1) * n
+        x = jnp.ones((n * per_dev,), dtype=dtype)
+
+        def allreduce(v):
+            return jax.lax.psum(v, 'x')
+
+        def allgather(v):
+            return jax.lax.all_gather(v, 'x', tiled=True)
+
+        def reducescatter(v):
+            return jax.lax.psum_scatter(v, 'x', tiled=True)
+
+        cases = {
+            # bus bytes factors per the standard ring-collective cost model
+            'psum': (shard_map(allreduce, mesh=mesh, in_specs=P('x'),
+                               out_specs=P('x')), 2 * (n - 1) / n),
+            'all_gather': (shard_map(allgather, mesh=mesh, in_specs=P('x'),
+                                     out_specs=P(), check_rep=False),
+                           (n - 1) / n),
+            'reduce_scatter': (shard_map(reducescatter, mesh=mesh,
+                                         in_specs=P('x'), out_specs=P('x')),
+                               (n - 1) / n),
+        }
+        nbytes = x.size * x.dtype.itemsize
+        for name, (fn, bus_factor) in cases.items():
+            jfn = jax.jit(fn)
+            jfn(x).block_until_ready()  # compile
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                out = jfn(x)
+            out.block_until_ready()
+            dt = (time.perf_counter() - t0) / iters
+            gbps = nbytes * bus_factor / dt / 1e9
+            results.append({'op': name, 'bytes': nbytes, 'time_ms': dt * 1e3,
+                            'busbw_GBps': gbps})
+            print('%-15s %10d B  %8.3f ms  %8.2f GB/s (bus)' %
+                  (name, nbytes, dt * 1e3, gbps))
+    return results
+
+
+def measure_kvstore(sizes, iters):
+    """Reference measure.py's actual protocol: init + timed push/pull."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create('device')
+    results = []
+    for size in sizes:
+        size = int(size)
+        arr = mx.nd.array(np.ones(size, np.float32))
+        out = mx.nd.zeros((size,))
+        kv.init(0, arr)
+        kv.push(0, arr)
+        kv.pull(0, out=out)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            kv.push(0, arr)
+            kv.pull(0, out=out)
+        out.wait_to_read()
+        dt = (time.perf_counter() - t0) / iters
+        gbps = size * 4 * 2 / dt / 1e9  # push + pull
+        results.append({'op': 'kv_push_pull', 'bytes': size * 4,
+                        'time_ms': dt * 1e3, 'GBps': gbps})
+        print('%-15s %10d B  %8.3f ms  %8.2f GB/s' %
+              ('kv_push_pull', size * 4, dt * 1e3, gbps))
+    return results
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('--sizes', default='1e6,1e7',
+                   help='comma-separated element counts')
+    p.add_argument('--iters', type=int, default=20)
+    p.add_argument('--dtype', default='float32',
+                   choices=['float32', 'bfloat16'])
+    p.add_argument('--kvstore', action='store_true',
+                   help='also time kvstore push+pull (reference protocol)')
+    p.add_argument('--cpu-devices', type=int, default=0,
+                   help='force an N-device virtual CPU mesh (the container '
+                        'pre-pins jax to the TPU backend; env vars alone '
+                        'are too late)')
+    args = p.parse_args(argv)
+    if args.cpu_devices:
+        os.environ['XLA_FLAGS'] = (
+            os.environ.get('XLA_FLAGS', '') +
+            ' --xla_force_host_platform_device_count=%d' % args.cpu_devices)
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+    import jax
+    print('devices: %d x %s' % (len(jax.devices()),
+                                jax.devices()[0].device_kind))
+    sizes = [float(s) for s in args.sizes.split(',')]
+    results = measure_collectives(sizes, args.iters, args.dtype)
+    if args.kvstore:
+        results += measure_kvstore(sizes, args.iters)
+    return results
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,156 @@
+#!/usr/bin/env python3
+"""im2rec — build RecordIO image packs from a .lst listing.
+
+Reference: tools/im2rec.cc + tools/im2rec.py (list-file driven packer:
+``index\\tlabel[\\tlabel...]\\trelative/path`` per line, images resized
+and encoded into IRHeader-framed records, optional .idx for random
+access).
+
+TPU-native pipeline note: the output .rec is consumed by
+ImageRecordIter / ImageDetRecordIter, which batch into dense arrays on
+the host and feed the device whole batches — so this tool is also where
+ragged detection labels get packed (--pack-label writes the
+[header_width, object_width, objects...] label block).
+
+Also supports --make-list to generate a .lst from an image directory.
+"""
+import argparse
+import os
+import random
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.recordio import MXIndexedRecordIO, IRHeader, pack_img  # noqa: E402
+
+_IMG_EXTS = {'.jpg', '.jpeg', '.png', '.bmp', '.npy'}
+
+
+def make_list(args):
+    """Reference im2rec.py make_list: scan a directory into .lst files."""
+    entries = []
+    for root, _, files in sorted(os.walk(args.root)):
+        for fname in sorted(files):
+            if os.path.splitext(fname)[1].lower() in _IMG_EXTS:
+                entries.append(os.path.relpath(os.path.join(root, fname),
+                                               args.root))
+    # label = index of the containing directory, as in the reference
+    dirs = sorted({os.path.dirname(e) for e in entries})
+    dir_label = {d: i for i, d in enumerate(dirs)}
+    if args.shuffle:
+        random.seed(100)
+        random.shuffle(entries)
+    n_test = int(len(entries) * args.test_ratio)
+    n_train = int(len(entries) * args.train_ratio)
+    chunks = {'_test': entries[:n_test], '_train': entries[n_test:n_test + n_train]}
+    if args.train_ratio + args.test_ratio < 1.0:
+        chunks['_val'] = entries[n_test + n_train:]
+    if args.train_ratio == 1.0 and args.test_ratio == 0.0:
+        chunks = {'': entries}
+    for suffix, chunk in chunks.items():
+        if not chunk:
+            continue
+        with open(args.prefix + suffix + '.lst', 'w') as f:
+            for i, e in enumerate(chunk):
+                f.write('%d\t%d\t%s\n' % (i, dir_label[os.path.dirname(e)], e))
+
+
+def read_list(path):
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split('\t')
+            if len(parts) < 3:
+                continue
+            yield int(parts[0]), [float(x) for x in parts[1:-1]], parts[-1]
+
+
+def load_image(path, args):
+    """Load + resize/center-crop to the target edge (reference resize logic)."""
+    if path.endswith('.npy'):
+        img = np.load(path)
+        if img.ndim == 2:
+            img = img[None]
+        elif img.ndim == 3 and img.shape[2] in (1, 3):
+            img = img.transpose(2, 0, 1)
+        return img.astype(np.uint8)
+    from PIL import Image
+    im = Image.open(path).convert('RGB')
+    if args.resize > 0:
+        w, h = im.size
+        if w < h:
+            nw, nh = args.resize, int(h * args.resize / w)
+        else:
+            nw, nh = int(w * args.resize / h), args.resize
+        im = im.resize((nw, nh))
+    if args.center_crop and args.resize > 0:
+        w, h = im.size
+        left = (w - args.resize) // 2
+        top = (h - args.resize) // 2
+        im = im.crop((left, top, left + args.resize, top + args.resize))
+    return np.asarray(im).transpose(2, 0, 1)
+
+
+def write_rec(args):
+    prefix = os.path.splitext(args.prefix)[0]
+    rec = MXIndexedRecordIO(prefix + '.idx', prefix + '.rec', 'w')
+    n = 0
+    for idx, labels, rel in read_list(args.lst):
+        path = os.path.join(args.root, rel)
+        try:
+            img = load_image(path, args)
+        except Exception as e:  # noqa: BLE001 — reference skips bad images
+            print('skipping %s: %s' % (rel, e), file=sys.stderr)
+            continue
+        if args.pack_label:
+            label = np.asarray(labels, dtype=np.float32)
+        elif len(labels) == 1:
+            label = labels[0]
+        else:
+            label = np.asarray(labels, dtype=np.float32)
+        header = IRHeader(0, label, idx, 0)
+        fmt = '.raw' if (args.encoding == 'raw' or path.endswith('.npy')) \
+            else args.encoding
+        if fmt != '.raw' and img.ndim == 3:
+            img = img.transpose(1, 2, 0)  # PIL encoders take HWC
+        rec.write_idx(idx, pack_img(header, img, quality=args.quality,
+                                    img_fmt=fmt))
+        n += 1
+        if n % 1000 == 0:
+            print('packed %d' % n)
+    rec.close()
+    print('wrote %d records to %s.rec' % (n, prefix))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument('prefix', help='prefix for .lst/.rec/.idx files')
+    p.add_argument('root', help='image root directory')
+    p.add_argument('--make-list', action='store_true',
+                   help='generate .lst instead of packing records')
+    p.add_argument('--lst', default=None, help='list file (default prefix.lst)')
+    p.add_argument('--resize', type=int, default=0,
+                   help='resize shorter edge to this')
+    p.add_argument('--center-crop', action='store_true')
+    p.add_argument('--quality', type=int, default=95)
+    p.add_argument('--encoding', default='.jpg',
+                   choices=['.jpg', '.png', 'raw'])
+    p.add_argument('--pack-label', action='store_true',
+                   help='store the full multi-column label (detection .lst)')
+    p.add_argument('--shuffle', action='store_true', default=True)
+    p.add_argument('--no-shuffle', dest='shuffle', action='store_false')
+    p.add_argument('--train-ratio', type=float, default=1.0)
+    p.add_argument('--test-ratio', type=float, default=0.0)
+    args = p.parse_args(argv)
+    if args.lst is None:
+        args.lst = args.prefix + '.lst' if not args.prefix.endswith('.lst') \
+            else args.prefix
+    if args.make_list:
+        make_list(args)
+    else:
+        write_rec(args)
+
+
+if __name__ == '__main__':
+    main()
