@@ -1,0 +1,136 @@
+"""Model-parallel LSTM — layers pinned to different devices.
+
+Reference: example/model-parallel-lstm/lstm.py (each LSTM layer lives on
+its own GPU via `ctx_group`, activations hop devices between layers —
+the manual model-parallelism pattern from SURVEY.md §2.3).
+
+TPU-native: the same `AttrScope(ctx_group=...)` annotations drive the
+staged executor (executor.py `_forward_staged`), which jits each device's
+stage and inserts `device_put` transfers at group boundaries. On a real
+pod you'd prefer the pipelined form (examples/parallel, mx.parallel
+GPipe) — this example exists for parity with the reference's placement
+API.
+
+    python model_parallel_lstm.py --num-layers 4 --steps 40
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+# the virtual 8-device CPU mesh lets this run hermetically
+os.environ.setdefault('XLA_FLAGS', '--xla_force_host_platform_device_count=8')
+
+
+def build_symbol(num_layers, seq_len, num_hidden, num_embed, vocab):
+    """Unrolled LSTM; layer i is annotated ctx_group='layer%d' % i."""
+    data = mx.sym.Variable('data')
+    label = mx.sym.Variable('softmax_label')
+    with mx.AttrScope(ctx_group='layer0'):
+        hidden = mx.sym.Embedding(data=data, input_dim=vocab,
+                                  output_dim=num_embed, name='embed')
+    for i in range(num_layers):
+        with mx.AttrScope(ctx_group='layer%d' % i):
+            cell = mx.rnn.LSTMCell(num_hidden=num_hidden,
+                                   prefix='lstm_l%d_' % i)
+            outputs, _ = cell.unroll(seq_len, inputs=hidden,
+                                     merge_outputs=True, layout='NTC')
+            hidden = outputs
+    with mx.AttrScope(ctx_group='layer%d' % (num_layers - 1)):
+        pred = mx.sym.Reshape(hidden, shape=(-1, num_hidden))
+        pred = mx.sym.FullyConnected(data=pred, num_hidden=vocab,
+                                     name='pred')
+        label_flat = mx.sym.Reshape(label, shape=(-1,))
+        out = mx.sym.SoftmaxOutput(data=pred, label=label_flat,
+                                   normalization='batch', name='softmax')
+    return out
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--num-layers', type=int, default=4)
+    parser.add_argument('--seq-len', type=int, default=16)
+    parser.add_argument('--batch-size', type=int, default=16)
+    parser.add_argument('--num-hidden', type=int, default=64)
+    parser.add_argument('--num-embed', type=int, default=32)
+    parser.add_argument('--vocab', type=int, default=50)
+    parser.add_argument('--steps', type=int, default=40)
+    parser.add_argument('--lr', type=float, default=0.01)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    sym = build_symbol(args.num_layers, args.seq_len, args.num_hidden,
+                       args.num_embed, args.vocab)
+
+    # one context per layer group (cycling over available devices)
+    n_dev = mx.context.num_devices() if hasattr(mx.context, 'num_devices') \
+        else 8
+    group2ctx = {'layer%d' % i: mx.cpu(i % n_dev)
+                 for i in range(args.num_layers)}
+
+    # synthetic Markov data (same learnable structure as lstm_bucketing)
+    rng = np.random.RandomState(0)
+    trans = np.random.RandomState(42).dirichlet(
+        np.ones(args.vocab) * 0.02, size=args.vocab)
+    def batch():
+        x = np.zeros((args.batch_size, args.seq_len), np.float32)
+        for b in range(args.batch_size):
+            x[b, 0] = rng.randint(1, args.vocab)
+            for t in range(1, args.seq_len):
+                x[b, t] = rng.choice(args.vocab, p=trans[int(x[b, t - 1])])
+        y = np.roll(x, -1, axis=1)
+        y[:, -1] = 0
+        return x, y
+
+    arg_shapes, _, _ = sym.infer_shape(
+        data=(args.batch_size, args.seq_len),
+        softmax_label=(args.batch_size, args.seq_len))
+    arg_names = sym.list_arguments()
+    init = mx.init.Xavier()
+    args_map, grads_map = {}, {}
+    for name, shape in zip(arg_names, arg_shapes):
+        arr = mx.nd.zeros(shape)
+        if name not in ('data', 'softmax_label'):
+            init(mx.init.InitDesc(name), arr)
+            grads_map[name] = mx.nd.zeros(shape)
+        args_map[name] = arr
+
+    exe = sym.bind(mx.cpu(0), args_map, args_grad=grads_map,
+                   group2ctx=group2ctx)
+    opt_state = {name: (mx.nd.zeros(g.shape), mx.nd.zeros(g.shape))
+                 for name, g in grads_map.items()}
+
+    first = last = None
+    for step in range(args.steps):
+        x, y = batch()
+        args_map['data'][:] = x
+        args_map['softmax_label'][:] = y
+        exe.forward(is_train=True)
+        probs = exe.outputs[0].asnumpy()
+        nll = -np.log(np.maximum(
+            probs[np.arange(probs.shape[0]), y.ravel().astype(int)],
+            1e-8)).mean()
+        exe.backward()
+        for name, grad in grads_map.items():
+            m, v = opt_state[name]
+            mx.nd.adam_update(args_map[name], grad, m, v,
+                              out=args_map[name], lr=args.lr)
+        if first is None:
+            first = nll
+        last = nll
+        if step % 10 == 0:
+            logging.info('step %d nll %.4f', step, nll)
+    print('model-parallel lstm: nll %.4f -> %.4f over %d layers on %d ctxs'
+          % (first, last, args.num_layers, len(set(str(c) for c in group2ctx.values()))))
+    assert last < first * 0.8, 'did not learn'
+    return last
+
+
+if __name__ == '__main__':
+    main()

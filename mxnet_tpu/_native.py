@@ -105,7 +105,8 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get('MXTPU_NO_NATIVE'):
+        from .config import flags as _flags
+        if _flags.get('MXTPU_NO_NATIVE'):
             return None
         try:
             if _stale():

@@ -1,0 +1,244 @@
+"""Typed, validated configuration: env-flag catalog + parameter structs.
+
+Reference: dmlc-core's parameter.h (`DMLC_DECLARE_FIELD` with defaults,
+ranges and enums, `Init(kwargs)` validation with readable errors) and
+docs/how_to/env_var.md (the catalog of `MXNET_*` environment variables).
+
+Two pieces:
+
+- ``Flag`` / ``flags``: every environment variable the framework reads,
+  declared centrally with type, default, and doc. ``flags.get(name)``
+  parses + validates once and caches; ``flags.describe()`` prints the
+  catalog (the env_var.md equivalent). Reference ``MXNET_*`` spellings
+  are accepted as aliases for the ``MXTPU_*`` names.
+- ``Parameter``/``field``: a small dmlc-Parameter analog for validated
+  option structs (ranges, enums, required fields) used by iterators and
+  tools.
+"""
+import os
+import threading
+
+__all__ = ['Flag', 'FlagRegistry', 'flags', 'Parameter', 'field']
+
+
+class Flag:
+    def __init__(self, name, type_, default, doc, aliases=(), choices=None,
+                 min_value=None, max_value=None):
+        self.name = name
+        self.type = type_
+        self.default = default
+        self.doc = doc
+        self.aliases = tuple(aliases)
+        self.choices = choices
+        self.min_value = min_value
+        self.max_value = max_value
+
+    def parse(self, raw):
+        if raw is None:
+            return self.default
+        try:
+            if self.type is bool:
+                val = raw.strip().lower() not in ('', '0', 'false', 'no')
+            else:
+                val = self.type(raw)
+        except (TypeError, ValueError):
+            raise ValueError(
+                'env %s=%r: expected %s' % (self.name, raw,
+                                            self.type.__name__))
+        if self.choices is not None and val not in self.choices:
+            raise ValueError('env %s=%r: must be one of %s'
+                             % (self.name, raw, sorted(self.choices)))
+        if self.min_value is not None and val < self.min_value:
+            raise ValueError('env %s=%r: must be >= %s'
+                             % (self.name, raw, self.min_value))
+        if self.max_value is not None and val > self.max_value:
+            raise ValueError('env %s=%r: must be <= %s'
+                             % (self.name, raw, self.max_value))
+        return val
+
+
+class FlagRegistry:
+    def __init__(self):
+        self._flags = {}
+        self._cache = {}
+        self._lock = threading.Lock()
+
+    def declare(self, name, type_, default, doc, **kwargs):
+        flag = Flag(name, type_, default, doc, **kwargs)
+        self._flags[name] = flag
+        return flag
+
+    def get(self, name):
+        """Parsed + validated value of a declared flag (cached; reference
+        dmlc::GetEnv but with the declaration enforced)."""
+        with self._lock:
+            if name in self._cache:
+                return self._cache[name]
+            flag = self._flags[name]  # KeyError = undeclared flag: a bug
+            raw = os.environ.get(flag.name)
+            if raw is None:
+                for alias in flag.aliases:
+                    raw = os.environ.get(alias)
+                    if raw is not None:
+                        break
+            val = flag.parse(raw)
+            self._cache[name] = val
+            return val
+
+    def reload(self, name=None):
+        """Drop cached values (tests mutate os.environ)."""
+        with self._lock:
+            if name is None:
+                self._cache.clear()
+            else:
+                self._cache.pop(name, None)
+
+    def describe(self):
+        """The env_var.md catalog as text."""
+        lines = []
+        for name in sorted(self._flags):
+            f = self._flags[name]
+            alias = (' (alias: %s)' % ', '.join(f.aliases)) if f.aliases else ''
+            lines.append('%s [%s, default %r]%s\n    %s'
+                         % (name, f.type.__name__, f.default, alias, f.doc))
+        return '\n'.join(lines)
+
+    def __iter__(self):
+        return iter(self._flags.values())
+
+
+flags = FlagRegistry()
+
+# ---- the catalog (reference: docs/how_to/env_var.md) ----------------------
+flags.declare('MXTPU_ENGINE_WORKERS', int, 4,
+              'Worker threads in the native dependency engine',
+              aliases=('MXNET_CPU_WORKER_NTHREADS',), min_value=1,
+              max_value=512)
+flags.declare('MXTPU_ENGINE_TYPE', str, 'ThreadedEngine',
+              'Engine scheduling mode; NaiveEngine = synchronous debugging '
+              'mode (race detection off the table by construction)',
+              aliases=('MXNET_ENGINE_TYPE',),
+              choices={'NaiveEngine', 'ThreadedEngine',
+                       'ThreadedEnginePerDevice'})
+flags.declare('MXTPU_NO_NATIVE', bool, False,
+              'Skip loading/building the native runtime library '
+              '(pure-python fallbacks for engine/recordio/profiler)')
+flags.declare('MXTPU_BACKWARD_DO_MIRROR', str, '0',
+              "Gradient-memory tradeoff: '1' (or any truthy value) = full "
+              "rematerialization of the forward under jax.checkpoint, "
+              "'dots' = keep matmul results (checkpoint_dots policy), "
+              "'0'/''/'false' = off (legacy spellings honored)",
+              aliases=('MXNET_BACKWARD_DO_MIRROR',))
+flags.declare('MXTPU_FORCE_PALLAS', bool, False,
+              'Dispatch LayerNorm/softmax/attention to the Pallas kernels '
+              'even off-TPU (interpret mode; exercises the kernel path on '
+              'the CPU test mesh)')
+flags.declare('MXTPU_KVSTORE_BIGARRAY_BOUND', int, 1 << 20,
+              'Arrays with >= this many elements are striped across all '
+              'servers on push/pull',
+              aliases=('MXNET_KVSTORE_BIGARRAY_BOUND',), min_value=1)
+flags.declare('MXTPU_KVSTORE_DEBUG', bool, False,
+              'Verbose logging in the distributed kvstore tier')
+flags.declare('MXTPU_NO_SPMD_MODULE', bool, False,
+              'Disable the fused single-program (GSPMD) lowering for '
+              'multi-context Module; fall back to the per-device loop')
+flags.declare('MXTPU_EXEC_BULK_EXEC_MAX_NODE_TRAIN', int, 15,
+              'Max ops bulked into one engine push by the executor',
+              aliases=('MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN',), min_value=1)
+flags.declare('MXTPU_PROFILER_AUTOSTART', bool, False,
+              'Start the profiler at init (reference '
+              'MXNET_PROFILER_AUTOSTART)',
+              aliases=('MXNET_PROFILER_AUTOSTART',))
+
+
+# ---- dmlc::Parameter analog ----------------------------------------------
+
+class _Field:
+    __slots__ = ('name', 'type', 'default', 'required', 'min_value',
+                 'max_value', 'choices', 'doc')
+
+    def __init__(self, type_, default=None, required=False, min_value=None,
+                 max_value=None, choices=None, doc=''):
+        self.name = None  # set by ParameterMeta
+        self.type = type_
+        self.default = default
+        self.required = required
+        self.min_value = min_value
+        self.max_value = max_value
+        self.choices = choices
+        self.doc = doc
+
+    def check(self, value, owner):
+        if value is None:
+            if self.required:
+                raise ValueError('%s: required parameter %r missing'
+                                 % (owner, self.name))
+            return self.default
+        if self.type is bool and isinstance(value, str):
+            value = value.strip().lower() not in ('', '0', 'false', 'no')
+        elif not isinstance(value, self.type):
+            try:
+                value = self.type(value)
+            except (TypeError, ValueError):
+                raise ValueError('%s.%s=%r: expected %s'
+                                 % (owner, self.name, value,
+                                    self.type.__name__))
+        if self.choices is not None and value not in self.choices:
+            raise ValueError('%s.%s=%r: must be one of %s'
+                             % (owner, self.name, value,
+                                sorted(self.choices)))
+        if self.min_value is not None and value < self.min_value:
+            raise ValueError('%s.%s=%r: must be >= %s'
+                             % (owner, self.name, value, self.min_value))
+        if self.max_value is not None and value > self.max_value:
+            raise ValueError('%s.%s=%r: must be <= %s'
+                             % (owner, self.name, value, self.max_value))
+        return value
+
+
+def field(type_, default=None, **kwargs):
+    """Declare a validated field on a Parameter subclass
+    (DMLC_DECLARE_FIELD)."""
+    return _Field(type_, default, **kwargs)
+
+
+class ParameterMeta(type):
+    def __new__(mcls, name, bases, ns):
+        fields = {}
+        for base in bases:
+            fields.update(getattr(base, '_fields', {}))
+        for key, val in list(ns.items()):
+            if isinstance(val, _Field):
+                val.name = key
+                fields[key] = val
+                del ns[key]
+        ns['_fields'] = fields
+        return super().__new__(mcls, name, bases, ns)
+
+
+class Parameter(metaclass=ParameterMeta):
+    """Validated option struct (dmlc::Parameter::Init).
+
+    >>> class ConvParam(Parameter):
+    ...     kernel = field(tuple, required=True)
+    ...     num_filter = field(int, required=True, min_value=1)
+    ...     layout = field(str, 'NCHW', choices={'NCHW', 'NHWC'})
+    >>> p = ConvParam(kernel=(3, 3), num_filter=8)
+    """
+
+    def __init__(self, **kwargs):
+        cls = type(self).__name__
+        unknown = set(kwargs) - set(self._fields)
+        if unknown:
+            raise ValueError('%s: unknown parameter(s) %s'
+                             % (cls, sorted(unknown)))
+        for name, f in self._fields.items():
+            setattr(self, name, f.check(kwargs.get(name), cls))
+
+    def asdict(self):
+        return {name: getattr(self, name) for name in self._fields}
+
+    def __repr__(self):
+        return '%s(%s)' % (type(self).__name__,
+                           ', '.join('%s=%r' % kv
+                                     for kv in sorted(self.asdict().items())))

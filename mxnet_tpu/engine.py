@@ -23,7 +23,8 @@ from . import _native
 __all__ = ['push', 'wait_for_var', 'wait_for_all', 'engine_type',
            'set_bulk_size', 'Engine']
 
-_engine_type = os.environ.get('MXNET_ENGINE_TYPE', 'ThreadedEngine')
+from .config import flags as _flags
+_engine_type = _flags.get('MXTPU_ENGINE_TYPE')
 
 
 class Engine:
@@ -48,7 +49,7 @@ class Engine:
             raise RuntimeError('native runtime unavailable '
                                '(g++ missing or MXTPU_NO_NATIVE set)')
         if num_workers is None:
-            num_workers = int(os.environ.get('MXNET_CPU_WORKER_NTHREADS', 4))
+            num_workers = _flags.get('MXTPU_ENGINE_WORKERS')
         if naive():
             num_workers = 0  # inline synchronous execution
         self._lib = lib

@@ -47,9 +47,9 @@ def mirror_wrap(f):
     The legacy MXNET_ spelling is honored too. Loss and gradients are
     bit-identical either way — only the memory/time tradeoff changes.
     """
-    import os
-    val = os.environ.get('MXTPU_BACKWARD_DO_MIRROR',
-                         os.environ.get('MXNET_BACKWARD_DO_MIRROR', '0'))
+    from .config import flags as _flags
+    _flags.reload('MXTPU_BACKWARD_DO_MIRROR')  # tests toggle it per-case
+    val = _flags.get('MXTPU_BACKWARD_DO_MIRROR')
     if val in ('', '0', 'false', 'False'):
         return f
     if val == 'dots':

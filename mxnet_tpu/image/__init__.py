@@ -1,16 +1,26 @@
 from .image import (Augmenter, ResizeAug, ForceResizeAug, RandomCropAug,
-                    CenterCropAug, HorizontalFlipAug, CastAug,
-                    ColorNormalizeAug, BrightnessJitterAug,
-                    ContrastJitterAug, SaturationJitterAug, RandomOrderAug,
-                    CreateAugmenter, ImageIter, imresize, imdecode,
-                    resize_short, fixed_crop, random_crop, center_crop,
+                    RandomSizedCropAug, CenterCropAug, HorizontalFlipAug,
+                    CastAug, ColorNormalizeAug, BrightnessJitterAug,
+                    ContrastJitterAug, SaturationJitterAug, HueJitterAug,
+                    ColorJitterAug, LightingAug, RandomGrayAug,
+                    RandomOrderAug, CreateAugmenter, ImageIter, imread,
+                    imresize, imdecode, resize_short, fixed_crop,
+                    random_crop, random_size_crop, center_crop,
                     color_normalize, scale_down)
 from . import detection  # noqa: F401
+from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,
+                        DetHorizontalFlipAug, DetRandomCropAug,
+                        DetRandomPadAug, CreateDetAugmenter, ImageDetIter)
 
 __all__ = ['Augmenter', 'ResizeAug', 'ForceResizeAug', 'RandomCropAug',
-           'CenterCropAug', 'HorizontalFlipAug', 'CastAug',
-           'ColorNormalizeAug', 'BrightnessJitterAug', 'ContrastJitterAug',
-           'SaturationJitterAug', 'RandomOrderAug', 'CreateAugmenter',
-           'ImageIter', 'imresize', 'imdecode', 'resize_short', 'fixed_crop',
-           'random_crop', 'center_crop', 'color_normalize', 'scale_down',
-           'detection']
+           'RandomSizedCropAug', 'CenterCropAug', 'HorizontalFlipAug',
+           'CastAug', 'ColorNormalizeAug', 'BrightnessJitterAug',
+           'ContrastJitterAug', 'SaturationJitterAug', 'HueJitterAug',
+           'ColorJitterAug', 'LightingAug', 'RandomGrayAug',
+           'RandomOrderAug', 'CreateAugmenter', 'ImageIter', 'imread',
+           'imresize', 'imdecode', 'resize_short', 'fixed_crop',
+           'random_crop', 'random_size_crop', 'center_crop',
+           'color_normalize', 'scale_down', 'detection', 'DetAugmenter',
+           'DetBorrowAug', 'DetRandomSelectAug', 'DetHorizontalFlipAug',
+           'DetRandomCropAug', 'DetRandomPadAug', 'CreateDetAugmenter',
+           'ImageDetIter']

@@ -13,7 +13,8 @@ from ..ndarray.ndarray import array as nd_array
 from .image import (Augmenter, imresize, ImageIter, resize_short,
                     HorizontalFlipAug)
 
-__all__ = ['DetAugmenter', 'DetHorizontalFlipAug', 'DetRandomCropAug',
+__all__ = ['DetAugmenter', 'DetBorrowAug', 'DetRandomSelectAug',
+           'DetHorizontalFlipAug', 'DetRandomCropAug', 'DetRandomPadAug',
            'DetBorderAug', 'CreateDetAugmenter', 'ImageDetIter']
 
 
@@ -22,6 +23,69 @@ class DetAugmenter:
 
     def __call__(self, src, label):
         raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a plain (image-only) Augmenter for detection pipelines —
+    reference detection.py:63 (color jitter etc. don't move boxes)."""
+
+    def __init__(self, augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly apply one of the given augmenters, or none —
+    reference detection.py:88 (skip_prob gates the whole group)."""
+
+    def __init__(self, aug_list, skip_prob=0.0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if random.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return random.choice(self.aug_list)(src, label)
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Random expansion pad: place the image inside a larger fill canvas
+    and rescale boxes — reference detection.py:323."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w = src.shape[:2]
+        for _ in range(self.max_attempts):
+            ratio = random.uniform(*self.aspect_ratio_range)
+            area = random.uniform(*self.area_range)
+            if area < 1.0:
+                continue
+            nh = int(round(np.sqrt(area * h * w / ratio)))
+            nw = int(round(nh * ratio))
+            if nh < h or nw < w:
+                continue
+            y0 = random.randint(0, nh - h)
+            x0 = random.randint(0, nw - w)
+            canvas = np.empty((nh, nw, src.shape[2]), src.dtype)
+            canvas[:] = np.asarray(self.pad_val, src.dtype)[:src.shape[2]]
+            canvas[y0:y0 + h, x0:x0 + w] = src
+            out = label.copy()
+            valid = out[:, 0] >= 0
+            out[valid, 1] = (out[valid, 1] * w + x0) / nw
+            out[valid, 3] = (out[valid, 3] * w + x0) / nw
+            out[valid, 2] = (out[valid, 2] * h + y0) / nh
+            out[valid, 4] = (out[valid, 4] * h + y0) / nh
+            return canvas, out
+        return src, label
 
 
 class DetHorizontalFlipAug(DetAugmenter):
@@ -90,13 +154,38 @@ class DetBorderAug(DetAugmenter):
 
 
 def CreateDetAugmenter(data_shape, rand_crop=0, rand_mirror=False,
-                       rand_pad=0, **kwargs):
-    """Reference detection.py CreateDetAugmenter (core subset)."""
+                       rand_pad=0, rand_gray=0, brightness=0, contrast=0,
+                       saturation=0, hue=0, pca_noise=0, **kwargs):
+    """Reference detection.py:482 CreateDetAugmenter — color transforms
+    borrowed from the classification set, geometric ones box-aware,
+    rand_crop/rand_pad are application probabilities."""
+    from .image import (BrightnessJitterAug, ContrastJitterAug,
+                        SaturationJitterAug, HueJitterAug, LightingAug,
+                        RandomGrayAug, IMAGENET_PCA_EIGVAL,
+                        IMAGENET_PCA_EIGVEC)
     augs = []
-    if rand_pad:
-        augs.append(DetBorderAug())
-    if rand_crop:
-        augs.append(DetRandomCropAug())
+    jitters = []
+    if brightness:
+        jitters.append(BrightnessJitterAug(brightness))
+    if contrast:
+        jitters.append(ContrastJitterAug(contrast))
+    if saturation:
+        jitters.append(SaturationJitterAug(saturation))
+    if hue:
+        jitters.append(HueJitterAug(hue))
+    for j in jitters:
+        augs.append(DetBorrowAug(j))
+    if pca_noise > 0:
+        augs.append(DetBorrowAug(LightingAug(
+            pca_noise, IMAGENET_PCA_EIGVAL, IMAGENET_PCA_EIGVEC)))
+    if rand_gray > 0:
+        augs.append(DetBorrowAug(RandomGrayAug(rand_gray)))
+    if rand_pad > 0:
+        augs.append(DetRandomSelectAug([DetRandomPadAug()],
+                                       skip_prob=1 - rand_pad))
+    if rand_crop > 0:
+        augs.append(DetRandomSelectAug([DetRandomCropAug()],
+                                       skip_prob=1 - rand_crop))
     if rand_mirror:
         augs.append(DetHorizontalFlipAug(0.5))
     return augs

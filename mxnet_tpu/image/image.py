@@ -103,6 +103,40 @@ def color_normalize(src, mean, std=None):
     return src
 
 
+def imread(filename, to_rgb=True, flag=1):
+    """Read an image file to an HWC uint8 array (reference image.py:44,
+    cv2.imread there; PIL here)."""
+    from PIL import Image
+    img = Image.open(filename)
+    img = img.convert('RGB' if flag else 'L')
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if flag and not to_rgb:
+        arr = arr[:, :, ::-1]  # BGR, the reference's cv2 default
+    return arr
+
+
+def random_size_crop(src, size, min_area, ratio, interp=2):
+    """Random area + aspect-ratio crop (reference image.py:435); falls
+    back to center_crop after 10 failed draws."""
+    h, w = src.shape[0], src.shape[1]
+    area = h * w
+    for _ in range(10):
+        target_area = random.uniform(min_area, 1.0) * area
+        new_ratio = random.uniform(*ratio)
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if random.random() < 0.5:
+            new_h, new_w = new_w, new_h
+        if new_w <= w and new_h <= h:
+            x0 = random.randint(0, w - new_w)
+            y0 = random.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
 def scale_down(src_size, size):
     """Scale size down to fit within src_size keeping the ratio."""
     w, h = size
@@ -218,6 +252,84 @@ class SaturationJitterAug(Augmenter):
         return src * alpha + gray * (1.0 - alpha)
 
 
+class HueJitterAug(Augmenter):
+    """Reference image.py:706 — rotate hue in YIQ space."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = np.array([[0.299, 0.587, 0.114],
+                              [0.596, -0.274, -0.321],
+                              [0.211, -0.523, 0.311]], np.float32)
+        self.ityiq = np.array([[1.0, 0.956, 0.621],
+                               [1.0, -0.272, -0.647],
+                               [1.0, -1.107, 1.705]], np.float32)
+
+    def __call__(self, src):
+        alpha = random.uniform(-self.hue, self.hue)
+        u = np.cos(alpha * np.pi)
+        w = np.sin(alpha * np.pi)
+        bt = np.array([[1.0, 0.0, 0.0],
+                       [0.0, u, -w],
+                       [0.0, w, u]], np.float32)
+        t = np.dot(np.dot(self.ityiq, bt), self.tyiq).T
+        return np.asarray(src, np.float32) @ t
+
+
+# ImageNet RGB PCA decomposition (reference image.py:934, AlexNet lighting)
+IMAGENET_PCA_EIGVAL = np.array([55.46, 4.794, 1.148])
+IMAGENET_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                                [-0.5808, -0.0045, -0.8140],
+                                [-0.5836, -0.6948, 0.4203]])
+
+
+class LightingAug(Augmenter):
+    """Reference image.py:763 — AlexNet-style PCA lighting noise."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = np.dot(self.eigvec * alpha, self.eigval)
+        return np.asarray(src, np.float32) + rgb
+
+
+class RandomGrayAug(Augmenter):
+    """Reference image.py:809 — randomly convert to 3-channel gray."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+        self.mat = np.array([[0.21, 0.21, 0.21],
+                             [0.72, 0.72, 0.72],
+                             [0.07, 0.07, 0.07]], np.float32)
+
+    def __call__(self, src):
+        if random.random() < self.p:
+            src = np.asarray(src, np.float32) @ self.mat
+        return src
+
+
+class RandomSizedCropAug(Augmenter):
+    """Reference image.py:569 — random area + aspect-ratio crop."""
+
+    def __init__(self, size, min_area, ratio, interp=2):
+        super().__init__(size=size, min_area=min_area, ratio=ratio,
+                         interp=interp)
+        self.size = size
+        self.min_area = min_area
+        self.ratio = ratio
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.min_area, self.ratio,
+                                self.interp)[0]
+
+
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         super().__init__(mean=mean, std=std)
@@ -242,15 +354,36 @@ class RandomOrderAug(Augmenter):
         return src
 
 
+class ColorJitterAug(RandomOrderAug):
+    """Reference image.py:740 — brightness/contrast/saturation in random
+    order."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
-                    contrast=0, saturation=0, pca_noise=0, inter_method=2):
-    """Build the standard augmenter list (reference image.py:871)."""
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference image.py:861)."""
     auglist = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop_size = (data_shape[2], data_shape[1])
-    if rand_crop:
+    if rand_resize:
+        assert rand_crop
+        auglist.append(RandomSizedCropAug(crop_size, 0.08, (3.0 / 4.0,
+                                                            4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
         auglist.append(RandomCropAug(crop_size, inter_method))
     else:
         auglist.append(CenterCropAug(crop_size, inter_method))
@@ -266,6 +399,13 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
         jitters.append(SaturationJitterAug(saturation))
     if jitters:
         auglist.append(RandomOrderAug(jitters))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, IMAGENET_PCA_EIGVAL,
+                                   IMAGENET_PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53], np.float32)
     if std is True:

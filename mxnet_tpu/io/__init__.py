@@ -673,12 +673,16 @@ class ImageDetRecordIter(DataIter):
                 np.zeros((0, obj_w), np.float32)
             parsed.append(objs)
             max_objs = max(max_objs, objs.shape[0])
+        # the flat label pads to EXACTLY label_pad_width (or wider if the
+        # data needs it) so train/val iterators built with the same pad
+        # width always shape-match — the request need not be object-aligned
+        width = 2 + max_objs * obj_w
         if label_pad_width > 0:
-            max_objs = max(max_objs, (label_pad_width - 2) // obj_w)
+            width = max(width, label_pad_width)
         self.label_object_width = obj_w
         self.max_objects = max_objs
 
-        label = np.full((len(parsed), 2 + max_objs * obj_w), label_pad_value,
+        label = np.full((len(parsed), width), label_pad_value,
                         dtype=np.float32)
         label[:, 0] = 2.0
         label[:, 1] = float(obj_w)
@@ -712,13 +716,16 @@ class ImageDetRecordIter(DataIter):
             lab = lab_nd.asnumpy().copy()
             ow = self.label_object_width
             if ow >= 5:
-                objs = lab[:, 2:].reshape(lab.shape[0], -1, ow)
+                # only the object-aligned block holds boxes; any extra
+                # label_pad_width tail cells are pure padding
+                end = 2 + self.max_objects * ow
+                objs = lab[:, 2:end].reshape(lab.shape[0], -1, ow)
                 valid = objs[:, :, 0] != self._pad_value
                 xmin = objs[:, :, 1].copy()
                 xmax = objs[:, :, 3].copy()
                 objs[:, :, 1] = np.where(valid, 1.0 - xmax, objs[:, :, 1])
                 objs[:, :, 3] = np.where(valid, 1.0 - xmin, objs[:, :, 3])
-                lab[:, 2:] = objs.reshape(lab.shape[0], -1)
+                lab[:, 2:end] = objs.reshape(lab.shape[0], -1)
             labels.append(array(lab))
         return DataBatch(data, labels, batch.pad, batch.index,
                          provide_data=batch.provide_data,

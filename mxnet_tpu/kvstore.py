@@ -141,6 +141,12 @@ class KVStore:
     def _send_command_to_servers(self, head, body):
         pass
 
+    def num_dead_node(self, node_id=6, timeout=60):
+        """Reference kvstore.h:321-330 get_num_dead_node — always 0 for
+        single-process stores; the dist tier overrides with heartbeat
+        tracking."""
+        return 0
+
     # -- optimizer state checkpointing (reference kvstore.py:433) ---------
     def save_optimizer_states(self, fname, dump_optimizer=False):
         assert self._updater is not None, 'Cannot save states for distributed training'

@@ -23,6 +23,7 @@ import atexit
 import os
 import pickle
 import threading
+import time
 
 import numpy as np
 
@@ -36,9 +37,8 @@ from ._dist_proto import (send_msg, recv_msg, pack_array, unpack_array,
 
 __all__ = ['KVStoreDist']
 
-_BIGARRAY_BOUND = int(os.environ.get(
-    'MXTPU_KVSTORE_BIGARRAY_BOUND',
-    os.environ.get('MXNET_KVSTORE_BIGARRAY_BOUND', 1 << 20)))
+from .config import flags as _flags
+_BIGARRAY_BOUND = _flags.get('MXTPU_KVSTORE_BIGARRAY_BOUND')
 
 
 class _Future:
@@ -131,10 +131,44 @@ class KVStoreDist(KVStore):
         self._conns = [_ServerConn(a) for a in topo[2]]
         self._sync = '_async' not in kv_type
         self._key_meta = {}  # key -> (shape, dtype)
+        self._aux = None     # heartbeat / dead-node channel
+        self._aux_lock = threading.Lock()
+        self._start_heartbeat(root, 'worker')
         if self._rank == 0:
             self._command_all('set_sync_mode', self._sync)
         self.barrier()
         atexit.register(self._finalize)
+
+    # -- failure detection (kvstore.h:321-330) ----------------------------
+    def _start_heartbeat(self, root, role, interval=2.0):
+        try:
+            self._aux = connect(*root)
+            send_msg(self._aux, ('aux', role, self._rank))
+        except OSError:
+            self._aux = None
+            return
+
+        def beat():
+            while True:
+                time.sleep(interval)
+                try:
+                    with self._aux_lock:
+                        send_msg(self._aux, ('heartbeat',))
+                except OSError:
+                    return
+
+        threading.Thread(target=beat, daemon=True).start()
+
+    def num_dead_node(self, node_id=6, timeout=60):
+        """Number of dead nodes in the masked groups (1=scheduler,
+        2=servers, 4=workers — reference kvstore.h get_num_dead_node)."""
+        if self._aux is None:
+            return 0
+        with self._aux_lock:
+            send_msg(self._aux, ('num_dead', int(node_id), float(timeout)))
+            reply = recv_msg(self._aux)
+        assert reply and reply[0] == 'num_dead', reply
+        return int(reply[1])
 
     def _start_standalone(self):
         """In-process 1-worker cluster (no launcher present)."""

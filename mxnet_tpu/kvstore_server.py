@@ -18,6 +18,7 @@ import pickle
 import socket
 import sys
 import threading
+import time
 
 import numpy as np
 
@@ -46,6 +47,11 @@ class Scheduler:
     exits.
     """
 
+    #: ps-lite node-group masks (ps.h kScheduler/kServerGroup/kWorkerGroup)
+    SCHEDULER_GROUP = 1
+    SERVER_GROUP = 2
+    WORKER_GROUP = 4
+
     def __init__(self, num_workers, num_servers, port=None):
         self.num_workers = num_workers
         self.num_servers = num_servers
@@ -58,6 +64,8 @@ class Scheduler:
         self._barrier = {}  # group -> list of waiting conns
         self._finalized = 0
         self._threads = []
+        self._beats = {}    # (role, rank) -> last heartbeat time
+        self._done = threading.Event()
 
     def run(self):
         total = self.num_workers + self.num_servers
@@ -71,8 +79,69 @@ class Scheduler:
             th.start()
             self._threads.append(th)
         self._ready.wait()
+        # keep accepting aux channels (heartbeat / dead-node queries —
+        # reference ps-lite keeps its scheduler port open for control
+        # messages throughout the job)
+        aux_th = threading.Thread(target=self._accept_aux, daemon=True)
+        aux_th.start()
         for th in self._threads:
             th.join()
+        self._done.set()
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    # -- failure detection (kvstore.h:321-330 get_num_dead_node) ---------
+    def _accept_aux(self):
+        self.sock.settimeout(0.5)
+        while not self._done.is_set():
+            try:
+                conn, _ = self.sock.accept()
+            except (socket.timeout, OSError):
+                continue
+            threading.Thread(target=self._serve_aux, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_aux(self, conn):
+        hello = recv_msg(conn)
+        if not hello or hello[0] != 'aux':
+            conn.close()
+            return
+        role, rank = hello[1], hello[2]
+        with self._lock:
+            self._beats[(role, rank)] = time.time()
+        while not self._done.is_set():
+            msg = recv_msg(conn)
+            if msg is None:
+                return
+            if msg[0] == 'heartbeat':
+                with self._lock:
+                    self._beats[(role, rank)] = time.time()
+            elif msg[0] == 'num_dead':
+                send_msg(conn, ('num_dead', self._num_dead(msg[1], msg[2])))
+            else:
+                send_msg(conn, ('error', 'unknown aux message %r' % (msg[0],)))
+
+    def _num_dead(self, node_id, timeout):
+        """Count nodes in the masked groups whose heartbeat is stale.
+
+        A registered node that never opened its aux channel counts as
+        dead once the query arrives (it should have connected at init)."""
+        now = time.time()
+        dead = 0
+        with self._lock:
+            groups = []
+            if node_id & self.WORKER_GROUP:
+                groups.append(('worker', self.num_workers))
+            if node_id & self.SERVER_GROUP:
+                groups.append(('server', self.num_servers))
+            for role, count in groups:
+                for rank in range(count):
+                    beat = self._beats.get((role, rank))
+                    if beat is None or now - beat > timeout:
+                        dead += 1
+        return dead
 
     def _serve(self, conn):
         msg = recv_msg(conn)
@@ -172,6 +241,7 @@ class KVStoreServer:
         self.rank = topo[1]
         threading.Thread(target=self._watch_scheduler, args=(sched,),
                          daemon=True).start()
+        self._start_heartbeat(sched_addr)
         sock.settimeout(0.5)
         while not self._stop.is_set():
             try:
@@ -189,6 +259,23 @@ class KVStoreServer:
             if msg is None or msg[0] == 'stop':
                 self._stop.set()
                 return
+
+    def _start_heartbeat(self, sched_addr, interval=2.0):
+        try:
+            aux = connect(*sched_addr)
+            send_msg(aux, ('aux', 'server', self.rank))
+        except OSError:
+            return
+
+        def beat():
+            while not self._stop.is_set():
+                time.sleep(interval)
+                try:
+                    send_msg(aux, ('heartbeat',))
+                except OSError:
+                    return
+
+        threading.Thread(target=beat, daemon=True).start()
 
     # -- request handling ------------------------------------------------
     def _serve(self, conn):

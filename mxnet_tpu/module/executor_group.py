@@ -288,7 +288,9 @@ class SPMDExecutorGroup:
 
     @staticmethod
     def eligible(contexts, workload, batch_size, symbol):
-        if os.environ.get('MXTPU_NO_SPMD_MODULE'):
+        from ..config import flags as _flags
+        _flags.reload('MXTPU_NO_SPMD_MODULE')  # tests toggle it per-case
+        if _flags.get('MXTPU_NO_SPMD_MODULE'):
             return False
         if len(contexts) < 2:
             return False

@@ -677,3 +677,92 @@ def _quantize(attrs, data, min_range, max_range):
     scale = 255.0 / scale_den
     q = jnp.clip(jnp.floor((data - min_range) * scale + 0.5), 0.0, 255.0)
     return q.astype(jnp.uint8), min_range, max_range
+
+
+# ---------------------------------------------------------------------------
+# PSROIPooling (non-deformable) — reference contrib/psroi_pooling-inl.h:
+# position-sensitive score maps, each bin averages the pixels inside it
+# ---------------------------------------------------------------------------
+@register('_contrib_PSROIPooling', input_names=['data', 'rois'],
+          param_defaults={'spatial_scale': 1.0, 'output_dim': 1,
+                          'pooled_size': 1, 'group_size': 0})
+def _psroi_pooling(attrs, data, rois):
+    scale = float(attrs['spatial_scale'])
+    out_dim = int(attrs['output_dim'])
+    ps = int(attrs['pooled_size'])
+    gs = int(attrs.get('group_size', 0)) or ps
+    N, C, H, W = data.shape
+
+    iy, ix = jnp.meshgrid(jnp.arange(ps), jnp.arange(ps), indexing='ij')
+    ys = jnp.arange(H, dtype=data.dtype)
+    xs = jnp.arange(W, dtype=data.dtype)
+
+    def pool_one(roi):
+        b = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = (jnp.round(roi[3]) + 1.0) * scale
+        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_w = rw / ps
+        bin_h = rh / ps
+        img = data[b]
+        # per-bin pixel masks: pixel p in bin (by,bx) iff floor coords in
+        # [start, end) (reference psroi kernel's floor/ceil bin bounds)
+        y_lo = jnp.floor(y1 + iy * bin_h)[..., None]         # (ps,ps,1)
+        y_hi = jnp.ceil(y1 + (iy + 1) * bin_h)[..., None]
+        x_lo = jnp.floor(x1 + ix * bin_w)[..., None]
+        x_hi = jnp.ceil(x1 + (ix + 1) * bin_w)[..., None]
+        ymask = (ys >= jnp.maximum(y_lo, 0)) & (ys < jnp.minimum(y_hi, H))
+        xmask = (xs >= jnp.maximum(x_lo, 0)) & (xs < jnp.minimum(x_hi, W))
+        mask = ymask[:, :, :, None] & xmask[:, :, None, :]   # (ps,ps,H,W)
+        count = jnp.maximum(mask.sum(axis=(-2, -1)), 1)
+        sums = jnp.einsum('chw,pqhw->cpq', img, mask.astype(data.dtype))
+        avg = sums / count[None]
+        gy = (iy * gs) // ps
+        gx = (ix * gs) // ps
+        chan = (jnp.arange(out_dim)[:, None, None] * gs + gy) * gs + gx
+        return avg[chan, iy[None], ix[None]]
+
+    return jax.vmap(pool_one)(rois)
+
+
+# ---------------------------------------------------------------------------
+# IdentityAttachKLSparseReg — reference identity_attach_KL_sparse_reg-inl.h
+# (identity forward; backward adds the KL-sparseness penalty derivative
+# against a moving average of per-unit activations)
+# ---------------------------------------------------------------------------
+@register('IdentityAttachKLSparseReg',
+          input_names=['data', 'moving_avg'],
+          param_defaults={'sparseness_target': 0.1, 'penalty': 0.001,
+                          'momentum': 0.9},
+          aux_inputs=('moving_avg',), mutate_inputs={1: 1},
+          num_visible_outputs=1, num_outputs=2, train_aware=True)
+def _identity_attach_kl_sparse_reg(attrs, data, moving_avg):
+    t = float(attrs.get('sparseness_target', 0.1))
+    penalty = float(attrs.get('penalty', 0.001))
+    momentum = float(attrs.get('momentum', 0.9))
+    is_train = attrs.get('__is_train__', False)
+
+    flat = data.reshape(data.shape[0], -1)
+    if is_train:
+        avg = jnp.mean(flat, axis=0)
+        new_moving = momentum * moving_avg + (1 - momentum) * avg
+    else:
+        new_moving = moving_avg
+
+    @jax.custom_vjp
+    def ident(x, moving):
+        return x
+
+    def fwd(x, moving):
+        return x, moving
+
+    def bwd(moving, g):
+        reg = penalty * (-t / moving + (1 - t) / (1 - moving))
+        gflat = g.reshape(g.shape[0], -1) + reg[None, :]
+        return gflat.reshape(g.shape).astype(g.dtype), None
+
+    ident.defvjp(fwd, bwd)
+    return ident(data, new_moving), new_moving

@@ -198,3 +198,14 @@ def _topk(attrs, x):
                                  dtype=x.dtype).sum(-2)
         return jnp.moveaxis(onehots, -1, ax)
     return idx.astype(jnp.float32)
+
+
+@register('_linalg_gelqf', num_outputs=2)
+def _linalg_gelqf(attrs, A):
+    """LQ factorization A = L @ Q, Q with orthonormal rows (reference
+    la_op.cc gelqf, outputs [Q, L]); via QR of A^T on the MXU."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2))
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+register_alias('linalg_gelqf', '_linalg_gelqf')

@@ -31,9 +31,10 @@ def use_fused():
     """Dispatch policy for the registry ops: real kernels on TPU; on CPU
     the jnp formulations are faster than interpret-mode pallas, so the
     fused path is opt-in there (MXTPU_FORCE_PALLAS=1, used in tests)."""
-    import os
+    from ..config import flags as _flags
+    _flags.reload('MXTPU_FORCE_PALLAS')  # tests toggle it per-case
     return (jax.default_backend() == 'tpu'
-            or bool(os.environ.get('MXTPU_FORCE_PALLAS')))
+            or _flags.get('MXTPU_FORCE_PALLAS'))
 
 _NEG = -1e30
 

@@ -280,8 +280,10 @@ def pack_img(header, img, quality=95, img_fmt='.raw'):
     except ImportError:
         raise ImportError('pack_img with %s requires pillow; use .raw' % img_fmt)
     buf = _io.BytesIO()
-    Image.fromarray(img).save(buf, format=img_fmt.lstrip('.').upper(),
-                              quality=quality)
+    fmt = img_fmt.lstrip('.').upper()
+    if fmt == 'JPG':
+        fmt = 'JPEG'  # PIL registers only the long name
+    Image.fromarray(img).save(buf, format=fmt, quality=quality)
     return pack(header, buf.getvalue())
 
 

@@ -92,6 +92,15 @@ def main():
     kv.row_sparse_pull(rsp_key, out=rsp_out, row_ids=rid)
     check(rsp_out.tostype('default').asnumpy(), expected, 'row_sparse')
 
+    # --- failure detection (kvstore.h get_num_dead_node) ----------------
+    # every node heartbeats; nothing is dead at a generous timeout
+    assert kv.num_dead_node(node_id=6, timeout=60) == 0, \
+        'live nodes reported dead'
+    # a 0-second timeout marks anything without a *just-now* beat dead;
+    # only assert it doesn't crash and stays within the node count
+    n_dead = kv.num_dead_node(node_id=6, timeout=1e-9)
+    assert 0 <= n_dead <= nw + int(os.environ.get('DMLC_NUM_SERVER', 1))
+
     kv.barrier()
     print('worker %d/%d: all dist_sync invariants passed' % (my_rank, nw),
           flush=True)

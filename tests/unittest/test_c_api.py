@@ -12,6 +12,20 @@ import sys
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+def _clean_env():
+    """Subprocess env for the embedded-interpreter binaries: force CPU and
+    scrub the TPU-plugin vars the test process's jax registration exported
+    (inheriting them makes the child attach the TPU tunnel and sleep-wait
+    on the chip instead of honoring JAX_PLATFORMS=cpu)."""
+    env = {k: v for k, v in os.environ.items()
+           if not (k.startswith('AXON_') or k.startswith('TPU_')
+                   or k.startswith('PALLAS_')
+                   or k in ('_AXON_REGISTERED', 'PJRT_LIBRARY_PATH'))}
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env['JAX_PLATFORMS'] = 'cpu'
+    return env
+
 LIB = os.path.join(REPO, 'lib', 'libmxnet_tpu.so')
 SRC = os.path.join(REPO, 'tests', 'capi', 'test_capi.c')
 
@@ -34,9 +48,7 @@ def _build_driver(tmp_path):
 def test_c_api_driver(tmp_path):
     _build_lib()
     exe = _build_driver(tmp_path)
-    env = dict(os.environ)
-    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
-    env['JAX_PLATFORMS'] = 'cpu'
+    env = _clean_env()
     r = subprocess.run([exe], env=env, capture_output=True, text=True,
                        timeout=600)
     assert r.returncode == 0, 'c api driver failed:\n%s\n%s' % (r.stdout, r.stderr)

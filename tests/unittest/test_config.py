@@ -1,0 +1,120 @@
+"""Config/flag system (aux 5.6): env catalog + dmlc-Parameter analog.
+
+Reference: dmlc-core parameter.h semantics (Init validation, ranges,
+enums, readable errors) and docs/how_to/env_var.md (flag catalog).
+"""
+import pytest
+
+from mxnet_tpu.config import Parameter, field, flags
+
+
+class TestFlags:
+    def test_defaults(self):
+        flags.reload()
+        assert flags.get('MXTPU_ENGINE_WORKERS') == 4
+        assert flags.get('MXTPU_ENGINE_TYPE') == 'ThreadedEngine'
+        assert flags.get('MXTPU_KVSTORE_BIGARRAY_BOUND') == 1 << 20
+
+    def test_env_parse_and_cache(self, monkeypatch):
+        monkeypatch.setenv('MXTPU_ENGINE_WORKERS', '7')
+        flags.reload('MXTPU_ENGINE_WORKERS')
+        assert flags.get('MXTPU_ENGINE_WORKERS') == 7
+        monkeypatch.setenv('MXTPU_ENGINE_WORKERS', '9')
+        # cached until reload
+        assert flags.get('MXTPU_ENGINE_WORKERS') == 7
+        flags.reload('MXTPU_ENGINE_WORKERS')
+        assert flags.get('MXTPU_ENGINE_WORKERS') == 9
+        flags.reload('MXTPU_ENGINE_WORKERS')
+
+    def test_reference_alias(self, monkeypatch):
+        # reference MXNET_* spellings are honored
+        monkeypatch.delenv('MXTPU_KVSTORE_BIGARRAY_BOUND', raising=False)
+        monkeypatch.setenv('MXNET_KVSTORE_BIGARRAY_BOUND', '4096')
+        flags.reload('MXTPU_KVSTORE_BIGARRAY_BOUND')
+        assert flags.get('MXTPU_KVSTORE_BIGARRAY_BOUND') == 4096
+        flags.reload('MXTPU_KVSTORE_BIGARRAY_BOUND')
+
+    def test_validation_errors(self, monkeypatch):
+        monkeypatch.setenv('MXTPU_ENGINE_WORKERS', 'lots')
+        flags.reload('MXTPU_ENGINE_WORKERS')
+        with pytest.raises(ValueError, match='expected int'):
+            flags.get('MXTPU_ENGINE_WORKERS')
+        monkeypatch.setenv('MXTPU_ENGINE_WORKERS', '0')
+        flags.reload('MXTPU_ENGINE_WORKERS')
+        with pytest.raises(ValueError, match='>= 1'):
+            flags.get('MXTPU_ENGINE_WORKERS')
+        monkeypatch.setenv('MXTPU_ENGINE_TYPE', 'WarpEngine')
+        flags.reload('MXTPU_ENGINE_TYPE')
+        with pytest.raises(ValueError, match='one of'):
+            flags.get('MXTPU_ENGINE_TYPE')
+        flags.reload()
+
+    def test_bool_parsing(self, monkeypatch):
+        for raw, want in [('1', True), ('true', True), ('0', False),
+                          ('false', False), ('', False), ('yes', True)]:
+            monkeypatch.setenv('MXTPU_NO_NATIVE', raw)
+            flags.reload('MXTPU_NO_NATIVE')
+            assert flags.get('MXTPU_NO_NATIVE') is want, raw
+        flags.reload()
+
+    def test_undeclared_flag_is_a_bug(self):
+        with pytest.raises(KeyError):
+            flags.get('MXTPU_DOES_NOT_EXIST')
+
+    def test_describe_catalog(self):
+        text = flags.describe()
+        assert 'MXTPU_ENGINE_WORKERS' in text
+        assert 'MXNET_CPU_WORKER_NTHREADS' in text  # alias documented
+        assert 'MXTPU_BACKWARD_DO_MIRROR' in text
+
+
+class TestParameter:
+    def _cls(self):
+        class ConvParam(Parameter):
+            kernel = field(tuple, required=True)
+            num_filter = field(int, required=True, min_value=1)
+            stride = field(tuple, (1, 1))
+            layout = field(str, 'NCHW', choices={'NCHW', 'NHWC'})
+            no_bias = field(bool, False)
+        return ConvParam
+
+    def test_init_defaults_and_required(self):
+        ConvParam = self._cls()
+        p = ConvParam(kernel=(3, 3), num_filter=8)
+        assert p.stride == (1, 1) and p.layout == 'NCHW'
+        with pytest.raises(ValueError, match='required'):
+            ConvParam(kernel=(3, 3))
+
+    def test_validation(self):
+        ConvParam = self._cls()
+        with pytest.raises(ValueError, match='>= 1'):
+            ConvParam(kernel=(3, 3), num_filter=0)
+        with pytest.raises(ValueError, match='one of'):
+            ConvParam(kernel=(3, 3), num_filter=1, layout='CHWN')
+        with pytest.raises(ValueError, match='unknown parameter'):
+            ConvParam(kernel=(3, 3), num_filter=1, kernal=(3, 3))
+
+    def test_coercion(self):
+        ConvParam = self._cls()
+        p = ConvParam(kernel=[3, 3], num_filter='8', no_bias='false')
+        assert p.kernel == (3, 3) and p.num_filter == 8
+        assert p.no_bias is False
+
+    def test_asdict_repr_roundtrip(self):
+        ConvParam = self._cls()
+        p = ConvParam(kernel=(3, 3), num_filter=8)
+        d = p.asdict()
+        assert d['kernel'] == (3, 3)
+        p2 = ConvParam(**d)
+        assert p2.asdict() == d
+        assert 'num_filter=8' in repr(p)
+
+    def test_inheritance_merges_fields(self):
+        class Base(Parameter):
+            a = field(int, 1)
+
+        class Child(Base):
+            b = field(int, 2)
+
+        c = Child(a=5)
+        assert c.a == 5 and c.b == 2

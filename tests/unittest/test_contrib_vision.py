@@ -242,3 +242,58 @@ class TestSymbolIntegration:
         arg_shapes, out_shapes, _ = out.infer_shape(data=(1, 2, 8, 8),
                                                     offset=(1, 18, 8, 8))
         assert out_shapes[0] == (1, 4, 8, 8)
+
+
+class TestPSROIPooling:
+    def test_position_sensitive_channel_selection(self):
+        out_dim, gs, ps = 2, 2, 2
+        C = out_dim * gs * gs
+        data = np.zeros((1, C, 8, 8), np.float32)
+        for c in range(C):
+            data[0, c] = float(c)
+        rois = nd.array(np.array([[0, 0, 0, 7, 7]], np.float32))
+        out = nd.contrib.PSROIPooling(nd.array(data), rois,
+                                      spatial_scale=1.0, output_dim=out_dim,
+                                      pooled_size=ps, group_size=gs)
+        np.testing.assert_allclose(out.asnumpy().ravel(),
+                                   np.arange(C, dtype=np.float32))
+
+    def test_bin_averages_pixels(self):
+        # one channel, known values: top-left bin of a 4x4 roi over an
+        # 4x4 image with ps=2 averages the top-left 2x2 block
+        data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = nd.array(np.array([[0, 0, 0, 3, 3]], np.float32))
+        out = nd.contrib.PSROIPooling(nd.array(data), rois,
+                                      spatial_scale=1.0, output_dim=1,
+                                      pooled_size=2, group_size=1).asnumpy()
+        assert out[0, 0, 0, 0] == pytest.approx(np.mean([0, 1, 4, 5]))
+        assert out[0, 0, 1, 1] == pytest.approx(np.mean([10, 11, 14, 15]))
+
+
+class TestIdentityAttachKLSparseReg:
+    def test_forward_identity_backward_penalty(self):
+        import mxnet_tpu.autograd as ag2
+        x = nd.array(np.random.RandomState(1).rand(4, 3).astype(np.float32)
+                     * 0.5)
+        moving = nd.array(np.full(3, 0.2, np.float32))
+        x.attach_grad()
+        with ag2.record():
+            y = nd.IdentityAttachKLSparseReg(
+                x, moving, sparseness_target=0.1, penalty=0.01, momentum=0.9)
+            loss = y.sum()
+        loss.backward()
+        np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+        m = moving.asnumpy()  # updated in-place via the aux protocol
+        want = 1 + 0.01 * (-0.1 / m + 0.9 / (1 - m))
+        np.testing.assert_allclose(x.grad.asnumpy(),
+                                   np.broadcast_to(want, (4, 3)), rtol=1e-5)
+
+    def test_moving_average_momentum(self):
+        import mxnet_tpu.autograd as ag2
+        x = nd.array(np.full((4, 3), 0.5, np.float32))
+        moving = nd.array(np.full(3, 0.2, np.float32))
+        x.attach_grad()
+        with ag2.record():
+            y = nd.IdentityAttachKLSparseReg(x, moving, momentum=0.9)
+        np.testing.assert_allclose(moving.asnumpy(),
+                                   0.9 * 0.2 + 0.1 * 0.5, rtol=1e-6)

@@ -11,6 +11,20 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+def _clean_env():
+    """Subprocess env for the embedded-interpreter binaries: force CPU and
+    scrub the TPU-plugin vars the test process's jax registration exported
+    (inheriting them makes the child attach the TPU tunnel and sleep-wait
+    on the chip instead of honoring JAX_PLATFORMS=cpu)."""
+    env = {k: v for k, v in os.environ.items()
+           if not (k.startswith('AXON_') or k.startswith('TPU_')
+                   or k.startswith('PALLAS_')
+                   or k in ('_AXON_REGISTERED', 'PJRT_LIBRARY_PATH'))}
+    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
+    env['JAX_PLATFORMS'] = 'cpu'
+    return env
+
+
 
 @pytest.mark.slow
 def test_cpp_mlp_example(tmp_path):
@@ -25,9 +39,7 @@ def test_cpp_mlp_example(tmp_path):
          '-L' + os.path.join(REPO, 'lib'), '-lmxnet_tpu',
          '-Wl,-rpath,' + os.path.join(REPO, 'lib')],
         check=True, capture_output=True, text=True)
-    env = dict(os.environ)
-    env['PYTHONPATH'] = REPO + os.pathsep + env.get('PYTHONPATH', '')
-    env['JAX_PLATFORMS'] = 'cpu'
+    env = _clean_env()
     r = subprocess.run([exe], env=env, capture_output=True, text=True,
                        timeout=600)
     assert r.returncode == 0, 'cpp mlp failed:\n%s\n%s' % (r.stdout, r.stderr)

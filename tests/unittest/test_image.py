@@ -1,0 +1,193 @@
+"""Image augmenters + detection augmenters (aux: image pipeline parity).
+
+Reference: tests/python/unittest/test_image.py patterns — each augmenter
+checked for shape/value invariants, det augmenters for box consistency.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import image as img
+from mxnet_tpu.image import detection as det
+
+
+def _src(h=32, w=48, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, 255, (h, w, 3)).astype(np.float32)
+
+
+class TestBasicOps:
+    def test_resize_short(self):
+        out = img.resize_short(_src(32, 48), 16)
+        assert min(out.shape[:2]) == 16
+        assert out.shape[1] / out.shape[0] == pytest.approx(48 / 32, abs=0.1)
+
+    def test_fixed_and_center_crop(self):
+        src = _src()
+        out = img.fixed_crop(src, 4, 2, 10, 8)
+        np.testing.assert_allclose(out, src[2:10, 4:14])
+        out2, (x0, y0, w, h) = img.center_crop(src, (20, 16))
+        assert out2.shape[:2] == (16, 20)
+        assert (x0, y0) == ((48 - 20) // 2, (32 - 16) // 2)
+
+    def test_random_crop_within_bounds(self):
+        random.seed(0)
+        src = _src()
+        out, (x0, y0, w, h) = img.random_crop(src, (20, 16))
+        assert out.shape[:2] == (16, 20)
+        assert 0 <= x0 <= 48 - 20 and 0 <= y0 <= 32 - 16
+
+    def test_random_size_crop(self):
+        random.seed(1)
+        out, roi = img.random_size_crop(_src(), (20, 16), 0.5,
+                                        (0.75, 1.333))
+        assert out.shape[:2] == (16, 20)
+
+    def test_color_normalize(self):
+        src = _src()
+        mean = np.array([1.0, 2.0, 3.0], np.float32)
+        std = np.array([2.0, 2.0, 2.0], np.float32)
+        out = img.color_normalize(src, mean, std)
+        np.testing.assert_allclose(out, (src - mean) / std, rtol=1e-6)
+
+    def test_imread(self, tmp_path):
+        PIL = pytest.importorskip('PIL')
+        from PIL import Image
+        arr = np.random.RandomState(0).randint(0, 255, (8, 8, 3), np.uint8)
+        p = str(tmp_path / 'x.png')
+        Image.fromarray(arr).save(p)
+        got = img.imread(p)
+        np.testing.assert_array_equal(got, arr)
+        gray = img.imread(p, flag=0)
+        assert gray.shape == (8, 8, 1)
+        bgr = img.imread(p, to_rgb=False)
+        np.testing.assert_array_equal(bgr, arr[:, :, ::-1])
+
+
+class TestAugmenters:
+    def test_brightness_contrast_saturation_shapes(self):
+        random.seed(0)
+        src = _src()
+        for aug in [img.BrightnessJitterAug(0.5), img.ContrastJitterAug(0.5),
+                    img.SaturationJitterAug(0.5), img.HueJitterAug(0.5),
+                    img.RandomGrayAug(1.0),
+                    img.LightingAug(0.1, np.ones(3), np.eye(3))]:
+            out = aug(src.copy())
+            assert out.shape == src.shape, type(aug).__name__
+
+    def test_hue_jitter_zero_is_identity(self):
+        # the published YIQ/inverse matrices are ~0.25%-approximate
+        # inverses, so zero-hue is identity only to that tolerance
+        src = _src()
+        aug = img.HueJitterAug(0.0)
+        np.testing.assert_allclose(aug(src), src, atol=1.0)
+
+    def test_random_gray_makes_channels_equal(self):
+        random.seed(0)
+        out = img.RandomGrayAug(1.0)(_src())
+        np.testing.assert_allclose(out[..., 0], out[..., 1], rtol=1e-5)
+        np.testing.assert_allclose(out[..., 1], out[..., 2], rtol=1e-5)
+
+    def test_color_jitter_composes(self):
+        random.seed(0)
+        aug = img.ColorJitterAug(0.3, 0.3, 0.3)
+        assert len(aug.ts) == 3
+        out = aug(_src())
+        assert out.shape == (32, 48, 3)
+
+    def test_random_sized_crop_aug(self):
+        random.seed(0)
+        aug = img.RandomSizedCropAug((20, 16), 0.3, (0.75, 1.333))
+        out = aug(_src())
+        assert out.shape[:2] == (16, 20)
+
+    def test_create_augmenter_full_set(self):
+        augs = img.CreateAugmenter((3, 16, 16), resize=20, rand_crop=True,
+                                   rand_resize=True, rand_mirror=True,
+                                   mean=True, std=True, brightness=0.1,
+                                   contrast=0.1, saturation=0.1, hue=0.1,
+                                   pca_noise=0.1, rand_gray=0.1)
+        names = [type(a).__name__ for a in augs]
+        for want in ['ResizeAug', 'RandomSizedCropAug', 'HorizontalFlipAug',
+                     'CastAug', 'RandomOrderAug', 'HueJitterAug',
+                     'LightingAug', 'RandomGrayAug', 'ColorNormalizeAug']:
+            assert want in names, names
+        # the chain runs end to end
+        random.seed(0)
+        out = _src(40, 40)
+        for a in augs:
+            out = a(out)
+        assert out.shape == (16, 16, 3)
+
+    def test_augmenter_dumps(self):
+        s = img.ResizeAug(10).dumps()
+        assert 'resizeaug' in s
+
+
+class TestDetAugmenters:
+    def _label(self):
+        # two objects + one pad row; coords normalized
+        return np.array([[0, 0.2, 0.2, 0.4, 0.4],
+                         [1, 0.5, 0.5, 0.9, 0.8],
+                         [-1, -1, -1, -1, -1]], np.float32)
+
+    def test_borrow_aug_leaves_labels(self):
+        random.seed(0)
+        aug = det.DetBorrowAug(img.BrightnessJitterAug(0.5))
+        src, lab = aug(_src(), self._label())
+        np.testing.assert_array_equal(lab, self._label())
+
+    def test_horizontal_flip_flips_boxes(self):
+        aug = det.DetHorizontalFlipAug(p=1.0)
+        src0 = _src()
+        src, lab = aug(src0.copy(), self._label())
+        np.testing.assert_allclose(src, src0[:, ::-1])
+        np.testing.assert_allclose(lab[0, [1, 3]], [0.6, 0.8], rtol=1e-6)
+        assert (lab[2] == -1).all()
+
+    def test_random_pad_keeps_boxes_valid(self):
+        random.seed(0)
+        aug = det.DetRandomPadAug(area_range=(1.5, 2.0))
+        src, lab = aug(_src(), self._label())
+        assert src.shape[0] >= 32 and src.shape[1] >= 48
+        valid = lab[lab[:, 0] >= 0]
+        assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+        # boxes shrink when the canvas grows
+        assert (valid[:, 3] - valid[:, 1] <= 0.4 + 1e-6).all()
+
+    def test_random_select_skip(self):
+        aug = det.DetRandomSelectAug([det.DetHorizontalFlipAug(1.0)],
+                                     skip_prob=1.0)
+        src0 = _src()
+        src, lab = aug(src0.copy(), self._label())
+        np.testing.assert_array_equal(src, src0)
+
+    def test_random_crop_updates_boxes(self):
+        random.seed(3)
+        aug = det.DetRandomCropAug(min_scale=0.7)
+        src, lab = aug(_src(), self._label())
+        valid = lab[lab[:, 0] >= 0]
+        if len(valid):
+            assert (valid[:, 1:] >= 0).all() and (valid[:, 1:] <= 1).all()
+
+    def test_create_det_augmenter(self):
+        augs = det.CreateDetAugmenter((3, 32, 32), rand_crop=0.5,
+                                      rand_pad=0.5, rand_mirror=True,
+                                      brightness=0.1, hue=0.1,
+                                      rand_gray=0.05, pca_noise=0.05)
+        random.seed(0)
+        src, lab = _src(), self._label()
+        for a in augs:
+            src, lab = a(src, lab)
+        assert src.ndim == 3 and lab.shape[1] == 5
+
+    def test_image_det_iter(self):
+        rng = np.random.RandomState(0)
+        images = rng.rand(8, 16, 16, 3).astype(np.float32)
+        labels = np.tile(self._label(), (8, 1, 1))
+        it = det.ImageDetIter(4, (3, 16, 16), images, labels,
+                              rand_mirror=True)
+        batch = next(iter(it))
+        assert batch.data[0].shape == (4, 3, 16, 16)
+        assert batch.label[0].shape == (4, 3, 5)

@@ -160,3 +160,28 @@ def test_det_plain_multilabel_not_misparsed(image_tree):
     # promoted to one object row of width 2, values preserved
     assert it.label_object_width == 2
     assert (lab[:, 2] == 3.0).all() and (lab[:, 3] == 7.0).all()
+
+
+def test_default_jpg_encoding(image_tree):
+    # the tool's default --encoding .jpg must work (PIL wants 'JPEG')
+    prefix = str(image_tree / 'jpgdata')
+    im2rec.main([prefix, str(image_tree), '--make-list'])
+    im2rec.main([prefix, str(image_tree), '--resize', '8', '--center-crop'])
+    it = mio.ImageRecordIter(path_imgrec=prefix + '.rec',
+                             data_shape=(3, 8, 8), batch_size=8)
+    b = next(iter(it))
+    assert b.data[0].shape == (8, 3, 8, 8)
+
+
+def test_det_label_pad_width_exact(image_tree):
+    # width not a multiple of obj_w still pads to EXACTLY the request
+    prefix = str(image_tree / 'det5')
+    _write_det_list(image_tree, prefix)
+    im2rec.main([prefix, str(image_tree), '--lst', prefix + '.lst',
+                 '--resize', '8', '--center-crop', '--encoding', 'raw',
+                 '--pack-label'])
+    it = mio.ImageDetRecordIter(path_imgrec=prefix + '.rec',
+                                data_shape=(3, 8, 8), batch_size=4,
+                                label_pad_width=15)  # (15-2) % 5 != 0
+    b = next(iter(it))
+    assert b.label[0].shape == (4, 15)

@@ -102,6 +102,29 @@ class TestDistKVStore:
         check_diff_to_scalar(out, 2)
         kv.barrier()
 
+    def test_standalone_dist_async(self):
+        """dist_async: pushes apply immediately without the sync barrier
+        (reference kvstore_dist_server.h:389-401 async path) and a
+        server-side optimizer accumulates each push as it lands."""
+        kv = mx.kv.create('dist_async')
+        assert kv.type == 'dist_async'
+        kv.set_optimizer(mx.optimizer.Test(rescale_grad=1.0))
+        kv.init('a', mx.nd.zeros(shape))
+        out = mx.nd.zeros(shape)
+        for i in range(3):
+            kv.push('a', mx.nd.ones(shape))
+            kv.pull('a', out=out)
+        # Test optimizer: weight += grad each push; async → applied by
+        # the time the same worker's pull returns
+        check_diff_to_scalar(out, 3)
+
+    def test_dead_node_query_local_is_zero(self):
+        kv = mx.kv.create('local')
+        assert kv.num_dead_node(node_id=6) == 0
+        kvd = mx.kv.create('dist_sync')
+        # single live in-process cluster: nothing dead at a sane timeout
+        assert kvd.num_dead_node(node_id=6, timeout=60) == 0
+
     @pytest.mark.slow
     def test_launch_4_workers(self):
         """Real multi-process cluster: 4 workers, 2 servers, 1 scheduler

@@ -1022,3 +1022,13 @@ def test_ndarray_pickle_roundtrip():
     b = nd.array(np.ones((2, 2), np.float32)).astype('bfloat16')
     b2 = pickle.loads(pickle.dumps(b))
     assert str(b2.dtype) == 'bfloat16'
+
+
+def test_linalg_gelqf():
+    rng = RNG(42)
+    a = rng.randn(3, 5).astype(np.float32)
+    q, l = nd.linalg_gelqf(nd.array(a))
+    assert q.shape == (3, 5) and l.shape == (3, 3)
+    assert_almost_equal(l.asnumpy() @ q.asnumpy(), a, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(q.asnumpy() @ q.asnumpy().T, np.eye(3),
+                        rtol=1e-4, atol=1e-5)
