@@ -6,7 +6,7 @@ from .image import (Augmenter, ResizeAug, ForceResizeAug, RandomCropAug,
                     RandomOrderAug, CreateAugmenter, ImageIter, imread,
                     imresize, imdecode, resize_short, fixed_crop,
                     random_crop, random_size_crop, center_crop,
-                    color_normalize, scale_down)
+                    color_normalize, scale_down, copyMakeBorder)
 from . import detection  # noqa: F401
 from .detection import (DetAugmenter, DetBorrowAug, DetRandomSelectAug,
                         DetHorizontalFlipAug, DetRandomCropAug,
@@ -20,7 +20,8 @@ __all__ = ['Augmenter', 'ResizeAug', 'ForceResizeAug', 'RandomCropAug',
            'RandomOrderAug', 'CreateAugmenter', 'ImageIter', 'imread',
            'imresize', 'imdecode', 'resize_short', 'fixed_crop',
            'random_crop', 'random_size_crop', 'center_crop',
-           'color_normalize', 'scale_down', 'detection', 'DetAugmenter',
+           'color_normalize', 'scale_down', 'copyMakeBorder',
+           'detection', 'DetAugmenter',
            'DetBorrowAug', 'DetRandomSelectAug', 'DetHorizontalFlipAug',
            'DetRandomCropAug', 'DetRandomPadAug', 'CreateDetAugmenter',
            'ImageDetIter']

@@ -15,6 +15,22 @@ from .sparse import csr_matrix, row_sparse_array
 
 _register.install_ops(globals())
 
+
+def cast_storage(data, stype='default'):
+    """Eager cast_storage — the real container conversion
+    (reference c_api cast_storage → ndarray/sparse.py). The registry op
+    of the same name is the symbol-world identity annotation."""
+    return sparse.cast_storage(data, stype)
+
+
+def sparse_retain(data, indices):
+    """Eager sparse_retain: row_sparse in → row_sparse out
+    (reference sparse_retain-inl.h); dense input uses the registry op's
+    dense lowering (rows outside ``indices`` become zero)."""
+    if isinstance(data, sparse.BaseSparseNDArray):
+        return sparse.retain(data, indices)
+    return invoke('_sparse_retain', [data, indices], {})
+
 # method-style conveniences that MXNet exposes at module level
 from .ndarray import _binary as _nd_binary  # noqa: F401
 

@@ -113,13 +113,16 @@ _b('broadcast_mul', jnp.multiply, elem_alias='elemwise_mul')
 register_alias('_mul', 'broadcast_mul')
 _b('broadcast_div', jnp.divide, elem_alias='elemwise_div')
 register_alias('_div', 'broadcast_div')
+register_alias('_grad_add', 'broadcast_add')
 _b('broadcast_mod', jnp.mod)
+register_alias('_mod', 'broadcast_mod')
 _b('broadcast_power', jnp.power)
 register_alias('_power', 'broadcast_power')
 register_alias('pow', 'broadcast_power')
 _b('broadcast_maximum', jnp.maximum)
 _b('broadcast_minimum', jnp.minimum)
 _b('broadcast_hypot', jnp.hypot)
+register_alias('_hypot', 'broadcast_hypot')
 _b('_maximum', jnp.maximum)
 _b('_minimum', jnp.minimum)
 
@@ -137,6 +140,12 @@ _cmp('broadcast_greater', jnp.greater)
 _cmp('broadcast_greater_equal', jnp.greater_equal)
 _cmp('broadcast_lesser', jnp.less)
 _cmp('broadcast_lesser_equal', jnp.less_equal)
+# same-shape elemwise comparison registrations (reference
+# elemwise_binary_op_logic.cc _equal.._lesser_equal); broadcasting is a
+# superset of the same-shape contract, so these alias the broadcast forms
+for _elem in ('equal', 'not_equal', 'greater', 'greater_equal',
+              'lesser', 'lesser_equal'):
+    register_alias('_' + _elem, 'broadcast_' + _elem)
 _cmp('broadcast_logical_and', lambda a, b: jnp.logical_and(a != 0, b != 0))
 _cmp('broadcast_logical_or', lambda a, b: jnp.logical_or(a != 0, b != 0))
 _cmp('broadcast_logical_xor', lambda a, b: jnp.logical_xor(a != 0, b != 0))

@@ -106,15 +106,42 @@ def _swapaxis(attrs, x):
 register_alias('swapaxes', 'SwapAxis')
 
 
-@register('slice', param_defaults={'begin': (), 'end': (), 'step': None})
-def _slice(attrs, x):
+def _slice_tuple(attrs, ndim):
     begin, end = attrs['begin'], attrs['end']
     step = attrs.get('step', None) or (None,) * len(begin)
     idx = tuple(slice(b, e, s) for b, e, s in zip(begin, end, step))
-    return x[idx]
+    return idx + (slice(None),) * (ndim - len(idx))
+
+
+@register('slice', param_defaults={'begin': (), 'end': (), 'step': None})
+def _slice(attrs, x):
+    return x[_slice_tuple(attrs, x.ndim)]
 
 
 register_alias('crop', 'slice')
+
+
+@register('_slice_assign', input_names=['lhs', 'rhs'],
+          param_defaults={'begin': (), 'end': (), 'step': None})
+def _slice_assign(attrs, lhs, rhs):
+    """Reference matrix_op.cc _slice_assign (alias _crop_assign):
+    functional form of ``lhs[begin:end:step] = rhs``."""
+    return lhs.at[_slice_tuple(attrs, lhs.ndim)].set(rhs)
+
+
+register_alias('_crop_assign', '_slice_assign')
+
+
+@register('_slice_assign_scalar',
+          param_defaults={'scalar': 0.0, 'begin': (), 'end': (), 'step': None})
+def _slice_assign_scalar(attrs, x):
+    """Reference matrix_op.cc _slice_assign_scalar (alias
+    _crop_assign_scalar): ``x[begin:end:step] = scalar``."""
+    return x.at[_slice_tuple(attrs, x.ndim)].set(
+        jnp.asarray(attrs['scalar'], dtype=x.dtype))
+
+
+register_alias('_crop_assign_scalar', '_slice_assign_scalar')
 
 
 @register('slice_axis', param_defaults={'axis': 0, 'begin': 0, 'end': None})

@@ -1,0 +1,54 @@
+"""Registry (symbol-world) forms of the sparse operators.
+
+Reference: src/operator/tensor/cast_storage.cc, sparse_retain.cc.
+
+The real sparse containers (RowSparse/CSR) live at the NDArray layer
+(ndarray/sparse.py) — inside a compiled XLA program every operand is a
+dense jax.Array, because TPU compute is dense-tiled. These registrations
+give Symbol graphs the reference's op surface with faithful *dense
+lowerings*: `cast_storage` is a storage-type annotation (value-identity),
+and `_sparse_retain` zeroes every row not listed in `indices`, which is
+exactly the dense image of the reference's sparse output.
+"""
+import jax.numpy as jnp
+
+from .registry import register, register_alias
+
+
+@register('cast_storage', param_defaults={'stype': 'default'})
+def _cast_storage(attrs, x):
+    """Value-identity in the dense symbol world; the NDArray-layer
+    cast_storage (ndarray/sparse.py) performs the actual container
+    conversion eagerly."""
+    return x
+
+
+@register('_sparse_retain', input_names=['data', 'indices'])
+def _sparse_retain_op(attrs, data, indices):
+    """Dense image of sparse_retain: out[i] = data[i] if i ∈ indices
+    else 0 (reference sparse_retain-inl.h semantics on a row_sparse
+    array whose every row is materialised). Differentiable: the vjp is
+    the same row mask applied to the output gradient (reference
+    _backward_sparse_retain)."""
+    keep = jnp.zeros((data.shape[0],), bool).at[
+        indices.astype(jnp.int32)].set(True, mode='drop')
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)),
+                     data, jnp.zeros_like(data))
+
+
+register_alias('sparse_retain', '_sparse_retain')
+
+
+@register('_square_sum', param_defaults={'axis': None, 'keepdims': False})
+def _square_sum_op(attrs, x):
+    """Dense form of square_sum (reference square_sum-inl.h): Σ x² along
+    `axis`; the row-sparse-aware eager version is ndarray/sparse.py
+    square_sum."""
+    ax = attrs.get('axis', None)
+    if isinstance(ax, (tuple, list)):
+        ax = tuple(int(a) for a in ax)
+        ax = ax if ax else None
+    elif ax is not None:
+        ax = int(ax)
+    return jnp.sum(jnp.square(x), axis=ax,
+                   keepdims=bool(attrs.get('keepdims', False)))

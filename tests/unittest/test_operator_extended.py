@@ -1032,3 +1032,145 @@ def test_linalg_gelqf():
     assert_almost_equal(l.asnumpy() @ q.asnumpy(), a, rtol=1e-4, atol=1e-5)
     assert_almost_equal(q.asnumpy() @ q.asnumpy().T, np.eye(3),
                         rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# elemwise comparison/mod/hypot registrations (reference
+# elemwise_binary_op_logic.cc / _extended.cc _equal.._lesser_equal, _mod,
+# _hypot, _grad_add) and slice assignment (matrix_op.cc _slice_assign)
+# ---------------------------------------------------------------------------
+ELEM_BINARY_CASES = [
+    ('_equal', lambda a, b: (a == b).astype(np.float32)),
+    ('_not_equal', lambda a, b: (a != b).astype(np.float32)),
+    ('_greater', lambda a, b: (a > b).astype(np.float32)),
+    ('_greater_equal', lambda a, b: (a >= b).astype(np.float32)),
+    ('_lesser', lambda a, b: (a < b).astype(np.float32)),
+    ('_lesser_equal', lambda a, b: (a <= b).astype(np.float32)),
+    ('_mod', np.mod),
+    ('_hypot', np.hypot),
+    ('_grad_add', np.add),
+]
+
+
+@pytest.mark.parametrize('op,ref', ELEM_BINARY_CASES,
+                         ids=[c[0] for c in ELEM_BINARY_CASES])
+def test_elemwise_binary_registrations(op, ref):
+    rng = RNG(7)
+    a = np.round(rng.uniform(-3, 3, (4, 5))).astype(np.float32)
+    b = np.round(rng.uniform(-3, 3, (4, 5))).astype(np.float32)
+    b[b == 0] = 1.0  # keep _mod defined
+    got = getattr(nd, op)(nd.array(a), nd.array(b)).asnumpy()
+    assert_almost_equal(got, ref(a, b), rtol=1e-5, atol=1e-6)
+
+
+def test_slice_assign():
+    rng = RNG(8)
+    a = rng.randn(4, 5).astype(np.float32)
+    r = rng.randn(2, 3).astype(np.float32)
+    lhs = nd.array(a)
+    out = nd._slice_assign(lhs, nd.array(r),
+                           begin=(1, 1), end=(3, 4)).asnumpy()
+    want = a.copy()
+    want[1:3, 1:4] = r
+    assert_almost_equal(out, want)
+    # original untouched (functional form)
+    assert_almost_equal(lhs.asnumpy(), a)
+    # _crop_assign is the legacy alias
+    out2 = nd._crop_assign(nd.array(a), nd.array(r),
+                           begin=(1, 1), end=(3, 4)).asnumpy()
+    assert_almost_equal(out2, want)
+
+
+def test_slice_assign_scalar():
+    a = np.arange(20, dtype=np.float32).reshape(4, 5)
+    out = nd._slice_assign_scalar(nd.array(a), scalar=-1.0,
+                                  begin=(0, 2), end=(4, 5)).asnumpy()
+    want = a.copy()
+    want[:, 2:] = -1.0
+    assert_almost_equal(out, want)
+    out2 = nd._crop_assign_scalar(nd.array(a), scalar=3.0,
+                                  begin=(1,), end=(2,)).asnumpy()
+    want2 = a.copy()
+    want2[1:2] = 3.0
+    assert_almost_equal(out2, want2)
+
+
+def test_sparse_retain_registry_op():
+    a = RNG(9).randn(5, 3).astype(np.float32)
+    idx = np.array([0, 3], np.int64)
+    out = nd._sparse_retain(nd.array(a), nd.array(idx)).asnumpy()
+    want = np.zeros_like(a)
+    want[[0, 3]] = a[[0, 3]]
+    assert_almost_equal(out, want)
+    # gradient is the same row mask applied to ograd
+    # (reference _backward_sparse_retain)
+    x = nd.array(a)
+    x.attach_grad()
+    with ag.record():
+        y = nd._sparse_retain(x, nd.array(idx))
+        loss = nd.sum(y)
+    loss.backward()
+    gmask = np.zeros_like(a)
+    gmask[[0, 3]] = 1.0
+    assert_almost_equal(x.grad.asnumpy(), gmask)
+    # the public nd.sparse_retain name accepts the reference's
+    # row_sparse input type and returns a row_sparse result
+    dense = np.zeros((4, 2), np.float32)
+    dense[[1, 3]] = [[1, 2], [3, 4]]
+    rsp = nd.array(dense).tostype('row_sparse')
+    kept = nd.sparse_retain(rsp, nd.array(np.array([3], np.int64)))
+    assert kept.stype == 'row_sparse'
+    want2 = np.zeros_like(dense)
+    want2[3] = dense[3]
+    assert_almost_equal(kept.tostype('default').asnumpy(), want2)
+
+
+def test_cast_storage_and_square_sum_registry_ops():
+    a = RNG(10).randn(3, 4).astype(np.float32)
+    # eager nd.cast_storage performs the real container conversion
+    rsp = nd.cast_storage(nd.array(a), stype='row_sparse')
+    assert rsp.stype == 'row_sparse'
+    assert_almost_equal(rsp.tostype('default').asnumpy(), a)
+    assert_almost_equal(nd.cast_storage(nd.array(a),
+                                        stype='default').asnumpy(), a)
+    # symbol-world cast_storage is a value-identity annotation
+    s = mx.sym.cast_storage(mx.sym.Variable('x'), stype='row_sparse')
+    ex = s.bind(mx.cpu(), {'x': nd.array(a)})
+    assert_almost_equal(ex.forward()[0].asnumpy(), a)
+    got = nd._square_sum(nd.array(a), axis=1).asnumpy()
+    assert_almost_equal(got, (a ** 2).sum(1), rtol=1e-5, atol=1e-6)
+    got0 = nd._square_sum(nd.array(a)).asnumpy()
+    assert_almost_equal(got0, (a ** 2).sum(), rtol=1e-5, atol=1e-6)
+
+
+def test_slice_assign_symbolic():
+    lhs = mx.sym.Variable('lhs')
+    rhs = mx.sym.Variable('rhs')
+    s = mx.sym._slice_assign(lhs, rhs, begin=(0,), end=(1,))
+    a = np.ones((2, 3), np.float32)
+    r = np.full((1, 3), 5.0, np.float32)
+    ex = s.bind(mx.cpu(), {'lhs': nd.array(a), 'rhs': nd.array(r)})
+    out = ex.forward()[0].asnumpy()
+    want = a.copy()
+    want[0:1] = r
+    assert_almost_equal(out, want)
+
+
+def test_copy_make_border():
+    from mxnet_tpu.image.image import copyMakeBorder
+    img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
+    out = copyMakeBorder(img, 1, 2, 3, 4, border_type=0, value=9.0)
+    assert out.shape == (5, 9, 3)
+    assert (out[0] == 9.0).all() and (out[:, 0] == 9.0).all()
+    assert_almost_equal(out[1, 3], img[0, 0])
+    rep = copyMakeBorder(img, 1, 0, 0, 0, border_type=1)
+    assert_almost_equal(rep[0], img[0])
+    # cv2 border codes: 2 reflect (edge doubled), 3 wrap, 4 reflect_101
+    refl = copyMakeBorder(img, 1, 0, 0, 0, border_type=2)
+    assert_almost_equal(refl[0], img[0])
+    wrap = copyMakeBorder(img, 1, 0, 0, 0, border_type=3)
+    assert_almost_equal(wrap[0], img[-1])
+    r101 = copyMakeBorder(img, 1, 0, 0, 0, border_type=4)
+    assert_almost_equal(r101[0], img[1])
+    with pytest.raises(ValueError):
+        copyMakeBorder(img, 1, 0, 0, 0, border_type=7)
