@@ -61,18 +61,19 @@ def imresize(src, w, h, interp=1):
     return top * (1 - wy) + bot * wy
 
 
-def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0.0):
+def copyMakeBorder(src, top, bot, left, right, type=0, values=0.0):  # noqa: A002
     """Pad an HWC image with a border (reference _cvcopyMakeBorder op,
-    src/io/image_io.cc). border_type follows the cv2 codes: 0 constant,
-    1 replicate edge, 2 reflect (edge pixel doubled), 3 wrap,
-    4 reflect_101 (edge pixel not doubled)."""
+    src/io/image_io.cc; keyword names match the reference signature).
+    ``type`` follows the cv2 codes: 0 constant, 1 replicate edge,
+    2 reflect (edge pixel doubled), 3 wrap, 4 reflect_101 (edge pixel
+    not doubled)."""
     pad = ((top, bot), (left, right)) + ((0, 0),) * (src.ndim - 2)
     modes = {1: 'edge', 2: 'symmetric', 3: 'wrap', 4: 'reflect'}
-    if border_type == 0:
-        return np.pad(src, pad, mode='constant', constant_values=value)
-    if border_type not in modes:
-        raise ValueError('unsupported border_type %r' % (border_type,))
-    return np.pad(src, pad, mode=modes[border_type])
+    if type == 0:
+        return np.pad(src, pad, mode='constant', constant_values=values)
+    if type not in modes:
+        raise ValueError('unsupported border type %r' % (type,))
+    return np.pad(src, pad, mode=modes[type])
 
 
 def resize_short(src, size, interp=1):

@@ -1159,18 +1159,37 @@ def test_slice_assign_symbolic():
 def test_copy_make_border():
     from mxnet_tpu.image.image import copyMakeBorder
     img = np.arange(12, dtype=np.float32).reshape(2, 2, 3)
-    out = copyMakeBorder(img, 1, 2, 3, 4, border_type=0, value=9.0)
+    out = copyMakeBorder(img, 1, 2, 3, 4, type=0, values=9.0)
     assert out.shape == (5, 9, 3)
     assert (out[0] == 9.0).all() and (out[:, 0] == 9.0).all()
     assert_almost_equal(out[1, 3], img[0, 0])
-    rep = copyMakeBorder(img, 1, 0, 0, 0, border_type=1)
+    rep = copyMakeBorder(img, 1, 0, 0, 0, type=1)
     assert_almost_equal(rep[0], img[0])
     # cv2 border codes: 2 reflect (edge doubled), 3 wrap, 4 reflect_101
-    refl = copyMakeBorder(img, 1, 0, 0, 0, border_type=2)
+    refl = copyMakeBorder(img, 1, 0, 0, 0, type=2)
     assert_almost_equal(refl[0], img[0])
-    wrap = copyMakeBorder(img, 1, 0, 0, 0, border_type=3)
+    wrap = copyMakeBorder(img, 1, 0, 0, 0, type=3)
     assert_almost_equal(wrap[0], img[-1])
-    r101 = copyMakeBorder(img, 1, 0, 0, 0, border_type=4)
+    r101 = copyMakeBorder(img, 1, 0, 0, 0, type=4)
     assert_almost_equal(r101[0], img[1])
     with pytest.raises(ValueError):
-        copyMakeBorder(img, 1, 0, 0, 0, border_type=7)
+        copyMakeBorder(img, 1, 0, 0, 0, type=7)
+
+
+def test_deconvolution_bf16_backward():
+    """Regression: bf16 Deconvolution under record() must not crash in
+    the conv vjp (f32 cotangent vs bf16 operands)."""
+    rng = RNG(11)
+    x = nd.array(rng.randn(2, 3, 5, 5).astype(np.float32)).astype('bfloat16')
+    w = nd.array((rng.randn(3, 4, 3, 3) * 0.1).astype(np.float32)).astype(
+        'bfloat16')
+    x.attach_grad()
+    w.attach_grad()
+    with ag.record():
+        y = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=4,
+                             no_bias=True)
+        loss = nd.sum(y * y)
+    loss.backward()
+    assert str(x.grad.dtype) == 'bfloat16'
+    assert x.grad.shape == x.shape and w.grad.shape == w.shape
+    assert float(nd.sum(nd.abs(w.grad)).asnumpy()) > 0
