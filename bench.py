@@ -251,10 +251,12 @@ def main():
     for _ in range(WARMUP_STEPS):
         masters, aux, vel, loss = compiled(
             masters, aux, vel, images, labels, key)
-    jax.block_until_ready(loss)
+    # sync via host fetch: on tunneled runtimes block_until_ready can
+    # return before the chain drains; a device->host copy cannot
+    loss_val = float(np.asarray(loss))
     warmup_dt = time.perf_counter() - t
     _log('warmup (%d steps): %.1fs, loss=%.4f'
-         % (WARMUP_STEPS, warmup_dt, float(loss)))
+         % (WARMUP_STEPS, warmup_dt, loss_val))
 
     # Scale the measured run to ~10-30s of wall clock.
     per_step = max(1e-4, warmup_dt / WARMUP_STEPS)
@@ -264,7 +266,7 @@ def main():
     for _ in range(bench_steps):
         masters, aux, vel, loss = compiled(
             masters, aux, vel, images, labels, key)
-    jax.block_until_ready(loss)
+    float(np.asarray(loss))  # host fetch = true barrier (see warmup)
     dt = time.perf_counter() - t0
 
     img_s = bench_steps * BATCH / dt

@@ -51,6 +51,7 @@ from . import registry
 from .executor_manager import DataParallelExecutorManager  # noqa: F401
 from . import operator
 from .operator import CustomOp, CustomOpProp
+from . import rtc
 from . import parallel
 
 # Server/scheduler processes block in their role loop here and exit with the

@@ -171,7 +171,12 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if leaf is not None and h._grad is not None:
                 g = hg._data if hg is not None else jnp.ones_like(h._data)
                 _accumulate_leaf(leaf, g)
-            continue
+                continue
+            # reference MXAutogradBackwardEx errors on heads outside any
+            # recorded graph instead of silently producing no gradients
+            raise ValueError(
+                'cannot run backward: the array is not part of a recorded '
+                'computation graph (compute it inside autograd.record())')
         if node.out_grads is None:
             node.out_grads = [None] * node.n_outputs
         g = hg._data if hg is not None else jnp.ones_like(h._data)

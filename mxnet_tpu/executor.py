@@ -340,10 +340,12 @@ class Executor:
             for i, o in enumerate(outs):
                 env[_entry_key(node, i)] = o
             if self._monitor is not None:
+                # reference entry naming: <node>_output / <node>_output<i>
+                # (what Monitor patterns like '.*output.*' match against)
                 nvis = op.n_visible_outputs(node.attrs)
                 for i in range(nvis):
-                    self._monitor(node.name if nvis == 1 else
-                                  '%s_%d' % (node.name, i),
+                    self._monitor('%s_output' % node.name if nvis == 1 else
+                                  '%s_output%d' % (node.name, i),
                                   from_jax(outs[i], self._ctx))
             if is_train:
                 for in_idx, out_idx in op.mutate_inputs.items():

@@ -109,6 +109,16 @@ def load_checkpoint(prefix, epoch):
     return (symbol, arg_params, aux_params)
 
 
+def _num_samples(X):
+    """Sample count of an NDArrayIter-style source: array, dict of
+    arrays, or list of arrays (batch axis 0)."""
+    if isinstance(X, dict):
+        X = next(iter(X.values()))
+    elif isinstance(X, (list, tuple)):
+        X = X[0]
+    return len(X)
+
+
 class FeedForward:
     """Deprecated legacy API (reference model.py FeedForward) — kept as a
     thin shim over Module for API completeness."""
@@ -127,6 +137,7 @@ class FeedForward:
         self.arg_params = arg_params
         self.aux_params = aux_params
         self.begin_epoch = begin_epoch
+        self.numpy_batch_size = numpy_batch_size
         self.kwargs = kwargs
         self._module = None
 
@@ -136,11 +147,13 @@ class FeedForward:
             eval_batch_end_callback=None):
         from .module import Module
         if not isinstance(X, io.DataIter):
-            X = io.NDArrayIter(X, y, batch_size=128, shuffle=True)
+            X = io.NDArrayIter(X, y, batch_size=min(self.numpy_batch_size,
+                                                    _num_samples(X)),
+                               shuffle=True)
         self._module = Module(self.symbol,
                               data_names=[d[0] for d in X.provide_data],
                               label_names=[l[0] for l in X.provide_label],
-                              context=self.ctx or [])
+                              context=self.ctx)
         self._module.fit(X, eval_data=eval_data, eval_metric=eval_metric,
                          kvstore=kvstore, initializer=self.initializer,
                          arg_params=self.arg_params, aux_params=self.aux_params,
@@ -153,7 +166,8 @@ class FeedForward:
 
     def predict(self, X, num_batch=None):
         if not isinstance(X, io.DataIter):
-            X = io.NDArrayIter(X, batch_size=128)
+            X = io.NDArrayIter(X, batch_size=min(self.numpy_batch_size,
+                                                 _num_samples(X)))
         return self._module.predict(X, num_batch=num_batch).asnumpy()
 
     @staticmethod

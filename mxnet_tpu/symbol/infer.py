@@ -139,6 +139,39 @@ def _rnn_params(attrs, in_shapes):
     return out
 
 
+@param_shape_hook('SoftmaxOutput')
+def _softmax_out_params(attrs, in_shapes):
+    """Reference softmax_output-inl.h label inference from the data
+    shape: (N,) by default; (N, d2, ...) with multi_output (class axis
+    1 removed); data shape minus the last axis with preserve_shape."""
+    data = in_shapes[0]
+    if data is None:
+        return {}
+    if attrs.get('preserve_shape', False):
+        return {'label': tuple(data[:-1])}
+    if attrs.get('multi_output', False):
+        return {'label': (data[0],) + tuple(data[2:])}
+    return {'label': (data[0],)}
+
+
+@param_shape_hook('SVMOutput')
+def _svm_out_params(attrs, in_shapes):
+    data = in_shapes[0]
+    return {'label': (data[0],)} if data else {}
+
+
+def _reg_out_params(attrs, in_shapes):
+    """Regression outputs: label has the data's shape (reference
+    regression_output-inl.h)."""
+    data = in_shapes[0]
+    return {'label': tuple(data)} if data else {}
+
+
+for _name in ('LinearRegressionOutput', 'MAERegressionOutput',
+              'LogisticRegressionOutput'):
+    param_shape_hook(_name)(_reg_out_params)
+
+
 def _node_arg_name(node, i):
     op = node.opdef()
     names = op.input_names

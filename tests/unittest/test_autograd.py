@@ -1,0 +1,136 @@
+"""Autograd frontend scopes and tape semantics.
+
+Reference: tests/python/unittest/test_autograd.py (grad_and_loss, grad,
+training/recording scopes, retain_graph, head grads, detach).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd as ag
+from mxnet_tpu import nd
+
+
+def test_scopes_flags():
+    assert not ag.is_recording()
+    assert not ag.is_training()
+    with ag.record():
+        assert ag.is_recording()
+        assert ag.is_training()
+        with ag.predict_mode():
+            assert ag.is_recording()
+            assert not ag.is_training()
+    with ag.record(train_mode=False):
+        assert ag.is_recording()
+        assert not ag.is_training()
+        with ag.train_mode():
+            assert ag.is_training()
+    with ag.pause():
+        assert not ag.is_recording()
+    assert not ag.is_recording()
+
+
+def test_attach_grad_backward():
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * x + 2 * x
+    y.backward()
+    assert np.allclose(x.grad.asnumpy(), 2 * x.asnumpy() + 2)
+
+
+def test_head_grads():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = 3 * x
+    y.backward(nd.array(np.array([10.0, 100.0], np.float32)))
+    assert np.allclose(x.grad.asnumpy(), [30.0, 300.0])
+
+
+def test_grad_add_req():
+    x = nd.array(np.array([2.0], np.float32))
+    x.attach_grad(grad_req='add')
+    for _ in range(3):
+        with ag.record():
+            y = x * x
+        y.backward()
+    assert np.allclose(x.grad.asnumpy(), 3 * 2 * 2.0)
+
+
+def test_detach_blocks_gradient():
+    x = nd.array(np.array([3.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    # d/dx (const * x) = const = 9
+    assert np.allclose(x.grad.asnumpy(), [9.0])
+
+
+def test_grad_and_loss():
+    def f(a, b):
+        return a * b
+
+    ga = ag.grad_and_loss(f)
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([5.0], np.float32))
+    grads, loss = ga(a, b)
+    assert np.allclose(loss.asnumpy(), [10.0])
+    assert np.allclose(grads[0].asnumpy(), [5.0])
+    assert np.allclose(grads[1].asnumpy(), [2.0])
+
+
+def test_grad_fn():
+    g = ag.grad(lambda x: x * x * x)
+    x = nd.array(np.array([2.0], np.float32))
+    out = g(x)
+    got = (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+    assert np.allclose(got, [12.0])
+
+
+def test_mark_variables():
+    x = nd.array(np.array([4.0], np.float32))
+    gx = nd.zeros((1,))
+    ag.mark_variables([x], [gx])
+    with ag.record():
+        y = nd.sqrt(x)
+    y.backward()
+    assert np.allclose(gx.asnumpy(), [0.25])
+
+
+def test_training_flag_drives_dropout():
+    x = nd.ones((100, 100))
+    with ag.record(train_mode=False):
+        y = nd.Dropout(x, p=0.5)
+    assert np.allclose(y.asnumpy(), x.asnumpy())
+    with ag.record(train_mode=True):
+        z = nd.Dropout(x, p=0.5)
+    # train mode must actually drop (w.h.p.)
+    assert (z.asnumpy() == 0).sum() > 100
+
+
+def test_no_record_no_grad():
+    x = nd.array(np.array([1.0], np.float32))
+    x.attach_grad()
+    y = x * 5  # outside record
+    with pytest.raises(Exception):
+        y.backward()
+
+
+def test_chained_ops_through_nn_layer():
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(3, 4).astype(np.float32))
+    w = nd.array(rng.randn(2, 4).astype(np.float32))
+    b = nd.zeros((2,))
+    for a in (x, w, b):
+        a.attach_grad()
+    with ag.record():
+        y = nd.FullyConnected(x, w, b, num_hidden=2)
+        loss = nd.sum(y * y)
+    loss.backward()
+    yv = x.asnumpy() @ w.asnumpy().T
+    assert np.allclose(x.grad.asnumpy(), 2 * yv @ w.asnumpy(), atol=1e-4)
+    assert np.allclose(w.grad.asnumpy(), 2 * yv.T @ x.asnumpy(), atol=1e-4)
+    assert np.allclose(b.grad.asnumpy(), 2 * yv.sum(0), atol=1e-4)

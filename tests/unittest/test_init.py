@@ -1,0 +1,136 @@
+"""Initializer family behaviors.
+
+Reference: tests/python/unittest/test_init.py plus the initializer
+contract in python/mxnet/initializer.py:726 (name-pattern dispatch,
+variance scaling, serialization).
+"""
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import initializer as ini
+from mxnet_tpu import nd
+
+
+def _init(initializer, name, shape):
+    arr = nd.zeros(shape)
+    initializer(ini.InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_name_pattern_dispatch():
+    init = ini.Uniform(0.1)
+    assert (_init(init, 'fc1_bias', (4,)) == 0).all()
+    assert (_init(init, 'bn_gamma', (4,)) == 1).all()
+    assert (_init(init, 'bn_beta', (4,)) == 0).all()
+    assert (_init(init, 'bn_moving_mean', (4,)) == 0).all()
+    assert (_init(init, 'bn_moving_var', (4,)) == 1).all()
+    w = _init(init, 'fc1_weight', (50, 50))
+    assert np.abs(w).max() <= 0.1 and np.abs(w).std() > 0
+    with pytest.raises(ValueError):
+        _init(init, 'mystery_tensor', (4,))
+
+
+def test_constant_zero_one():
+    assert (_init(ini.Zero(), 'x_weight', (3, 3)) == 0).all()
+    assert (_init(ini.One(), 'x_weight', (3, 3)) == 1).all()
+    assert (_init(ini.Constant(2.5), 'x_weight', (3, 3)) == 2.5).all()
+
+
+def test_normal_stddev():
+    w = _init(ini.Normal(sigma=0.5), 'w_weight', (200, 200))
+    assert abs(w.std() - 0.5) < 0.05
+    assert abs(w.mean()) < 0.05
+
+
+def test_xavier_variants():
+    shape = (100, 400)  # fan_out=100*? for 2d: fan_in = 400, fan_out = 100
+    for rnd_type, factor_type in [('uniform', 'avg'), ('gaussian', 'in'),
+                                  ('uniform', 'out')]:
+        init = ini.Xavier(rnd_type=rnd_type, factor_type=factor_type,
+                          magnitude=3)
+        w = _init(init, 'w_weight', shape)
+        fan_in, fan_out = 400, 100
+        factor = {'avg': (fan_in + fan_out) / 2.0, 'in': fan_in,
+                  'out': fan_out}[factor_type]
+        scale = np.sqrt(3.0 / factor)
+        if rnd_type == 'uniform':
+            assert np.abs(w).max() <= scale + 1e-6
+            assert abs(w.std() - scale / np.sqrt(3)) < 0.15 * scale
+        else:
+            assert abs(w.std() - scale) < 0.15 * scale
+
+
+def test_msra_prelu():
+    w = _init(ini.MSRAPrelu(factor_type='in', slope=0.25), 'w_weight',
+              (64, 128))
+    # variance = 2/((1+slope^2) * fan_in)
+    want_std = np.sqrt(2.0 / (1 + 0.25 ** 2) / 128)
+    assert abs(w.std() - want_std) < 0.25 * want_std
+
+
+def test_orthogonal():
+    w = _init(ini.Orthogonal(scale=1.0), 'w_weight', (32, 64))
+    wwt = w @ w.T
+    assert np.allclose(wwt, np.eye(32), atol=1e-4)
+
+
+def test_bilinear_upsampling_kernel():
+    w = _init(ini.Bilinear(), 'up_weight', (1, 1, 4, 4))
+    k = w[0, 0]
+    assert np.allclose(k, k[::-1, :], atol=1e-6)   # symmetric
+    assert np.allclose(k, k[:, ::-1], atol=1e-6)
+    assert k.max() <= 1.0 and k.min() > 0
+
+
+def test_dumps_roundtrip_via_attr_override():
+    """__init__ attr on an InitDesc overrides the global initializer
+    (reference initializer.py InitDesc attrs protocol)."""
+    glob = ini.Zero()
+    desc = ini.InitDesc('w_weight',
+                        attrs={'__init__': ini.One().dumps()})
+    arr = nd.zeros((3, 3))
+    glob(desc, arr)
+    assert (arr.asnumpy() == 1).all()
+
+
+def test_dumps_json_shape():
+    s = ini.Uniform(0.07).dumps()
+    klass, kwargs = json.loads(s)
+    assert klass == 'uniform'
+    assert abs(kwargs['scale'] - 0.07) < 1e-9
+
+
+def test_mixed():
+    # sub-initializers still apply their own name-pattern dispatch
+    # (reference Mixed :560 — it routes, it does not override)
+    mixed = ini.Mixed(['.*emb_weight', '.*'], [ini.One(), ini.Zero()])
+    a = nd.zeros((4, 4))
+    mixed(ini.InitDesc('emb_weight'), a)
+    b = nd.zeros((4, 4))
+    mixed(ini.InitDesc('fc_weight'), b)
+    assert (a.asnumpy() == 1).all()
+    assert (b.asnumpy() == 0).all()
+    with pytest.raises(ValueError):
+        ini.Mixed(['.*'], [ini.One(), ini.Zero()])
+
+
+def test_load_initializer():
+    params = {'arg:fc_weight': nd.ones((2, 2)) * 3}
+    load = ini.Load(params, default_init=ini.Zero())
+    w = nd.zeros((2, 2))
+    load('fc_weight', w)
+    assert (w.asnumpy() == 3).all()
+    other = nd.zeros((2, 2))
+    load('other_weight', other)
+    assert (other.asnumpy() == 0).all()
+
+
+def test_gluon_initialize_uses_initializer():
+    from mxnet_tpu import gluon
+    net = gluon.nn.Dense(8, in_units=16)
+    net.initialize(ini.Constant(0.125))
+    w = net.weight.data().asnumpy()
+    assert (w == 0.125).all()

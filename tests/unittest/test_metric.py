@@ -1,0 +1,154 @@
+"""Metric family vs numpy oracles.
+
+Reference: tests/python/unittest/test_metric.py plus the metric
+behaviors asserted throughout the reference's training tests
+(python/mxnet/metric.py:1132).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric as mtr
+from mxnet_tpu import nd
+
+
+def _m(name, **kw):
+    m = mtr.create(name, **kw)
+    assert m.name is not None
+    return m
+
+
+def test_create_by_name_and_aliases():
+    for name in ['acc', 'accuracy', 'top_k_accuracy', 'f1', 'mae', 'mse',
+                 'rmse', 'ce', 'nll_loss', 'pearsonr', 'loss']:
+        m = mtr.create(name) if name != 'top_k_accuracy' else \
+            mtr.create(name, top_k=2)
+        assert isinstance(m, mtr.EvalMetric)
+
+
+def test_accuracy():
+    pred = nd.array(np.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]],
+                             np.float32))
+    label = nd.array(np.array([1, 0, 0], np.float32))
+    m = _m('acc')
+    m.update([label], [pred])
+    name, val = m.get()
+    assert name == 'accuracy'
+    assert abs(val - 2.0 / 3.0) < 1e-6
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_top_k_accuracy():
+    pred = nd.array(np.array([[0.1, 0.2, 0.7],
+                              [0.5, 0.4, 0.1],
+                              [0.35, 0.4, 0.25]], np.float32))
+    label = nd.array(np.array([1, 1, 0], np.float32))
+    m = mtr.create('top_k_accuracy', top_k=2)
+    m.update([label], [pred])
+    # top-2 sets: {2,1}, {0,1}, {1,0} -> labels 1,1,0 all hit
+    assert abs(m.get()[1] - 1.0) < 1e-6
+    # top_k=1 is rejected (reference: "use Accuracy instead")
+    with pytest.raises(AssertionError):
+        mtr.create('top_k_accuracy', top_k=1)
+    assert m.get()[0] == 'top_k_accuracy_2'
+
+
+def test_f1():
+    pred = nd.array(np.array([[0.8, 0.2], [0.3, 0.7], [0.4, 0.6],
+                              [0.9, 0.1]], np.float32))
+    label = nd.array(np.array([0, 1, 0, 1], np.float32))
+    m = _m('f1')
+    m.update([label], [pred])
+    # predictions: 0,1,1,0 vs labels 0,1,0,1 -> tp=1 fp=1 fn=1
+    prec = rec = 0.5
+    want = 2 * prec * rec / (prec + rec)
+    assert abs(m.get()[1] - want) < 1e-6
+
+
+def test_perplexity():
+    probs = np.array([[0.5, 0.5], [0.9, 0.1]], np.float32)
+    label = np.array([0, 0], np.float32)
+    m = mtr.create('Perplexity', ignore_label=None)
+    m.update([nd.array(label)], [nd.array(probs)])
+    want = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert abs(m.get()[1] - want) < 1e-4
+
+
+def test_perplexity_ignore_label():
+    probs = np.array([[0.5, 0.5], [0.9, 0.1]], np.float32)
+    label = np.array([0, -1], np.float32)
+    m = mtr.create('Perplexity', ignore_label=-1)
+    m.update([nd.array(label)], [nd.array(probs)])
+    want = np.exp(-np.log(0.5))
+    assert abs(m.get()[1] - want) < 1e-4
+
+
+def test_regression_metrics():
+    pred = np.array([[1.0], [2.0], [3.0]], np.float32)
+    label = np.array([[1.5], [2.0], [2.0]], np.float32)
+    cases = {
+        'mae': np.abs(pred - label).mean(),
+        'mse': ((pred - label) ** 2).mean(),
+        'rmse': np.sqrt(((pred - label) ** 2).mean()),
+    }
+    for name, want in cases.items():
+        m = _m(name)
+        m.update([nd.array(label)], [nd.array(pred)])
+        assert abs(m.get()[1] - want) < 1e-5, name
+
+
+def test_cross_entropy():
+    probs = np.array([[0.2, 0.8], [0.6, 0.4]], np.float32)
+    label = np.array([1, 0], np.float32)
+    m = _m('ce')
+    m.update([nd.array(label)], [nd.array(probs)])
+    want = -(np.log(0.8) + np.log(0.6)) / 2
+    assert abs(m.get()[1] - want) < 1e-5
+
+
+def test_pearson_correlation():
+    rng = np.random.RandomState(0)
+    pred = rng.randn(20).astype(np.float32)
+    label = (2 * pred + 0.1 * rng.randn(20)).astype(np.float32)
+    m = _m('pearsonr')
+    m.update([nd.array(label)], [nd.array(pred)])
+    want = np.corrcoef(pred, label)[0, 1]
+    assert abs(m.get()[1] - want) < 1e-3
+
+
+def test_loss_metric():
+    m = _m('loss')
+    m.update(None, [nd.array(np.array([1.0, 3.0], np.float32))])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+
+
+def test_composite():
+    m = mtr.CompositeEvalMetric([mtr.create('acc'), mtr.create('mse')])
+    pred = nd.array(np.array([[0.3, 0.7], [0.9, 0.1]], np.float32))
+    label = nd.array(np.array([1, 0], np.float32))
+    m.update([label], [pred])
+    names, vals = m.get()
+    assert 'accuracy' in names[0]
+    assert abs(vals[0] - 1.0) < 1e-6
+
+
+def test_custom_metric_and_np():
+    def my_err(label, pred):
+        return float(np.abs(label - pred.argmax(1)).mean())
+
+    m = mtr.np(my_err, name='myerr')
+    pred = nd.array(np.array([[0.3, 0.7], [0.9, 0.1]], np.float32))
+    label = nd.array(np.array([1, 1], np.float32))
+    m.update([label], [pred])
+    assert abs(m.get()[1] - 0.5) < 1e-6
+
+
+def test_metric_str_and_multiple_updates():
+    m = _m('acc')
+    for _ in range(3):
+        m.update([nd.array(np.array([0.0], np.float32))],
+                 [nd.array(np.array([[0.9, 0.1]], np.float32))])
+    assert m.num_inst == 3
+    assert abs(m.get()[1] - 1.0) < 1e-6
+    assert 'accuracy' in str(m).lower() or 'EvalMetric' in str(m)

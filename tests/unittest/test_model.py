@@ -1,0 +1,156 @@
+"""Legacy model API: checkpoints, FeedForward shim, callbacks, monitor,
+visualization.
+
+Reference: python/mxnet/model.py:340-370 (save/load_checkpoint),
+callback.py, monitor.py, tests/python/unittest/test_viz.py.
+"""
+import logging
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp():
+    data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(data, name='fc1', num_hidden=4)
+    net = mx.sym.Activation(net, name='relu1', act_type='relu')
+    net = mx.sym.FullyConnected(net, name='fc2', num_hidden=2)
+    return mx.sym.SoftmaxOutput(net, name='softmax')
+
+
+def _params():
+    rng = np.random.RandomState(0)
+    return (
+        {'fc1_weight': nd.array(rng.randn(4, 6).astype(np.float32)),
+         'fc1_bias': nd.zeros((4,)),
+         'fc2_weight': nd.array(rng.randn(2, 4).astype(np.float32)),
+         'fc2_bias': nd.zeros((2,))},
+        {},
+    )
+
+
+def test_save_load_checkpoint_roundtrip():
+    net = _mlp()
+    arg_params, aux_params = _params()
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, 'model')
+        mx.model.save_checkpoint(prefix, 3, net, arg_params, aux_params)
+        assert os.path.exists(prefix + '-symbol.json')
+        assert os.path.exists(prefix + '-0003.params')
+        sym2, args2, auxs2 = mx.model.load_checkpoint(prefix, 3)
+        assert sym2.tojson() == net.tojson()
+        for k, v in arg_params.items():
+            np.testing.assert_allclose(args2[k].asnumpy(), v.asnumpy())
+        assert auxs2 == {}
+
+
+def test_module_checkpoint_epoch_callback():
+    from mxnet_tpu.module import Module
+    from mxnet_tpu.io import NDArrayIter
+    rng = np.random.RandomState(1)
+    X = rng.randn(16, 6).astype(np.float32)
+    y = (rng.rand(16) > 0.5).astype(np.float32)
+    mod = Module(_mlp(), data_names=['data'], label_names=['softmax_label'])
+    it = NDArrayIter(X, y, batch_size=8, label_name='softmax_label')
+    with tempfile.TemporaryDirectory() as d:
+        prefix = os.path.join(d, 'mod')
+        mod.fit(it, num_epoch=2, batch_end_callback=None,
+                epoch_end_callback=mx.callback.do_checkpoint(prefix),
+                optimizer_params={'learning_rate': 0.1})
+        assert os.path.exists(prefix + '-0001.params')
+        assert os.path.exists(prefix + '-0002.params')
+        sym2, args2, _ = mx.model.load_checkpoint(prefix, 2)
+        assert 'fc1_weight' in args2
+
+
+def test_feedforward_shim():
+    rng = np.random.RandomState(2)
+    X = rng.randn(32, 6).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ff = mx.model.FeedForward(_mlp(), num_epoch=3,
+                              optimizer='sgd',
+                              learning_rate=0.5, numpy_batch_size=16)
+    ff.fit(X, y)
+    preds = ff.predict(X)
+    assert preds.shape == (32, 2)
+    assert np.allclose(preds.sum(1), 1.0, atol=1e-4)
+
+
+def test_speedometer_and_log_metric():
+    from mxnet_tpu.callback import Speedometer, log_train_metric
+    from mxnet_tpu.metric import create as create_metric
+
+    class P:  # BatchEndParam shim
+        def __init__(self, nbatch):
+            self.epoch = 0
+            self.nbatch = nbatch
+            self.eval_metric = create_metric('acc')
+            self.locals = None
+
+    s = Speedometer(batch_size=8, frequent=2, auto_reset=False)
+    lt = log_train_metric(2)
+    for i in range(1, 5):
+        p = P(i)
+        p.eval_metric.update(
+            [nd.array(np.array([0.0], np.float32))],
+            [nd.array(np.array([[0.9, 0.1]], np.float32))])
+        s(p)
+        lt(p)
+
+
+def test_monitor_collects_op_stats():
+    from mxnet_tpu.monitor import Monitor
+    net = _mlp()
+    arg_params, _ = _params()
+    rng = np.random.RandomState(3)
+    args = dict(arg_params)
+    args['data'] = nd.array(rng.randn(2, 6).astype(np.float32))
+    args['softmax_label'] = nd.array(np.array([0, 1], np.float32))
+    ex = net.bind(mx.cpu(), args)
+    mon = Monitor(1, pattern='.*output.*')
+    mon.install(ex)
+    mon.tic()
+    ex.forward(is_train=True)
+    res = mon.toc()
+    assert len(res) > 0
+    for (batch, name, stat) in res:
+        assert 'output' in name
+
+
+def test_print_summary_runs():
+    from mxnet_tpu.visualization import print_summary
+    lines = []
+    import builtins
+    old_print = builtins.print
+    builtins.print = lambda *a, **k: lines.append(' '.join(str(x) for x in a))
+    try:
+        print_summary(_mlp(), shape={'data': (1, 6)})
+    finally:
+        builtins.print = old_print
+    text = '\n'.join(lines)
+    assert 'fc1' in text and 'Total params' in text
+
+
+def test_plot_network_graph_structure():
+    from mxnet_tpu.visualization import plot_network
+    dot = plot_network(_mlp(), shape={'data': (1, 6)})
+    src = getattr(dot, 'source', None) or str(dot)
+    assert 'fc1' in src and 'softmax' in src
+
+
+def test_feedforward_dict_input_batch_size():
+    """Regression: dict/list inputs must count samples, not keys."""
+    rng = np.random.RandomState(4)
+    X = {'data': rng.randn(32, 6).astype(np.float32)}
+    y = (X['data'][:, 0] > 0).astype(np.float32)
+    ff = mx.model.FeedForward(_mlp(), num_epoch=1, optimizer='sgd',
+                              learning_rate=0.1, numpy_batch_size=16)
+    ff.fit(X, y)
+    assert ff._module._exec_group.batch_size == 16
+    preds = ff.predict({'data': X['data']})
+    assert preds.shape == (32, 2)

@@ -125,3 +125,60 @@ class TestModuleBasics:
         metric = mx.metric.create('acc')
         mod.score(it, metric)
         assert metric.get()[1] > 0.8, metric.get()
+
+
+class TestPythonModule:
+    """Reference tests/python/unittest/test_module.py
+    test_module_input_grads pattern: a python loss module terminates a
+    pipeline and hands back a hand-written gradient."""
+
+    def test_python_loss_module_default_grad(self):
+        from mxnet_tpu.io import DataBatch
+        from mxnet_tpu.module import PythonLossModule
+        mod = PythonLossModule()
+        mod.bind(data_shapes=[('data', (4, 3))])
+        scores = mx.nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+        mod.forward(DataBatch(data=[scores], label=None))
+        out = mod.get_outputs()[0].asnumpy()
+        assert np.allclose(out, scores.asnumpy())
+        mod.backward()
+        g = mod.get_input_grads()[0].asnumpy()
+        assert np.allclose(g, np.ones((4, 3), np.float32))
+
+    def test_python_loss_module_custom_grad(self):
+        from mxnet_tpu.io import DataBatch
+        from mxnet_tpu.module import PythonLossModule
+
+        def ce_grad(scores, labels):
+            p = mx.nd.softmax(scores)
+            onehot = mx.nd.one_hot(labels, 3)
+            return p - onehot
+
+        mod = PythonLossModule(grad_func=ce_grad)
+        mod.bind(data_shapes=[('data', (2, 3))],
+                 label_shapes=[('softmax_label', (2,))])
+        scores = mx.nd.array(np.array([[2.0, 1.0, 0.0],
+                                       [0.0, 1.0, 2.0]], np.float32))
+        labels = mx.nd.array(np.array([0, 2], np.float32))
+        mod.forward(DataBatch(data=[scores], label=[labels]), is_train=True)
+        mod.backward()
+        g = mod.get_input_grads()[0].asnumpy()
+        p = np.exp(scores.asnumpy())
+        p /= p.sum(1, keepdims=True)
+        want = p.copy()
+        want[0, 0] -= 1
+        want[1, 2] -= 1
+        assert np.allclose(g, want, atol=1e-5)
+        # terminal loss refuses incoming gradients
+        with pytest.raises(ValueError):
+            mod.backward(out_grads=[mx.nd.ones((2, 3))])
+
+    def test_python_module_shapes_and_metric(self):
+        from mxnet_tpu.module import PythonLossModule
+        mod = PythonLossModule(name='l')
+        mod.bind(data_shapes=[('data', (8, 5))])
+        assert mod.output_shapes == [('l_output', (8, 5))]
+        assert mod.data_names == ['data']
+        mod.init_params()
+        assert mod.params_initialized
+        assert mod.get_params() == ({}, {})

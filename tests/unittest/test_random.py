@@ -1,0 +1,109 @@
+"""Random samplers: determinism under seed, distribution moments.
+
+Reference: tests/python/unittest/test_random.py (seeded reproducibility
++ moment checks per sampler) over src/operator/random/.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+N = (50, 50)  # 2500 samples: loose moment checks
+
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = nd.random.uniform(0, 1, shape=(4, 4)).asnumpy()
+    b = nd.random.uniform(0, 1, shape=(4, 4)).asnumpy()
+    assert not np.allclose(a, b)          # stream advances
+    mx.random.seed(42)
+    a2 = nd.random.uniform(0, 1, shape=(4, 4)).asnumpy()
+    b2 = nd.random.uniform(0, 1, shape=(4, 4)).asnumpy()
+    np.testing.assert_allclose(a, a2)
+    np.testing.assert_allclose(b, b2)
+    mx.random.seed(43)
+    c = nd.random.uniform(0, 1, shape=(4, 4)).asnumpy()
+    assert not np.allclose(a, c)
+
+
+def test_uniform_moments_and_range():
+    mx.random.seed(0)
+    x = nd.random.uniform(-2, 3, shape=N).asnumpy()
+    assert x.min() >= -2 and x.max() <= 3
+    assert abs(x.mean() - 0.5) < 0.15
+    assert abs(x.std() - np.sqrt(25 / 12.0)) < 0.15
+
+
+def test_normal_moments():
+    mx.random.seed(0)
+    x = nd.random.normal(1.5, 2.0, shape=N).asnumpy()
+    assert abs(x.mean() - 1.5) < 0.2
+    assert abs(x.std() - 2.0) < 0.2
+
+
+def test_gamma_moments():
+    mx.random.seed(0)
+    x = nd.random.gamma(3.0, 2.0, shape=N).asnumpy()
+    # mean = alpha*beta, var = alpha*beta^2
+    assert abs(x.mean() - 6.0) < 0.5
+    assert abs(x.var() - 12.0) < 2.5
+    assert (x > 0).all()
+
+
+def test_exponential_moments():
+    mx.random.seed(0)
+    x = nd.random.exponential(0.5, shape=N).asnumpy()
+    assert abs(x.mean() - 0.5) < 0.1
+    assert (x >= 0).all()
+
+
+def test_poisson_moments():
+    mx.random.seed(0)
+    x = nd.random.poisson(4.0, shape=N).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.3
+    assert abs(x.var() - 4.0) < 0.8
+    assert np.allclose(x, np.round(x))
+
+
+def test_negative_binomial():
+    mx.random.seed(0)
+    x = nd.random.negative_binomial(5, 0.5, shape=N).asnumpy()
+    # mean = k(1-p)/p = 5
+    assert abs(x.mean() - 5.0) < 0.6
+    assert (x >= 0).all()
+
+
+def test_multinomial():
+    mx.random.seed(0)
+    probs = nd.array(np.array([[0.0, 0.1, 0.9]] * 4, np.float32))
+    s = nd.random.multinomial(probs, shape=(100,)).asnumpy()
+    assert s.shape == (4, 100)
+    assert (s >= 1).all() and (s <= 2).all()
+    assert (s == 2).mean() > 0.75
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(0)
+    x = nd.array(np.arange(20, dtype=np.float32))
+    y = nd.random.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(20))
+
+
+def test_nd_level_samplers():
+    mx.random.seed(0)
+    u = nd.random_uniform(low=0, high=1, shape=(3, 3))
+    n = nd.random_normal(loc=0, scale=1, shape=(3, 3))
+    assert u.shape == (3, 3) and n.shape == (3, 3)
+
+
+def test_symbol_random_ops_in_graph():
+    """Samplers compose into symbolic graphs (reference random ops are
+    normal NNVM ops with a resource request)."""
+    s = mx.sym.random_uniform(low=0, high=1, shape=(2, 2))
+    out = s * 2
+    ex = out.bind(mx.cpu(), {})
+    mx.random.seed(7)
+    a = ex.forward()[0].asnumpy()
+    assert a.shape == (2, 2)
+    assert (a >= 0).all() and (a <= 2).all()

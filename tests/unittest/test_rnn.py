@@ -1,0 +1,172 @@
+"""Symbolic RNN cell coverage.
+
+Reference: tests/python/unittest/test_rnn.py — unroll shape checks,
+fused-vs-unfused equivalence, stacked/bidirectional/modifier cells,
+weight pack/unpack roundtrips.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+from mxnet_tpu import nd
+
+B, T, D, H = 4, 5, 6, 7
+RNG = np.random.RandomState
+
+
+def _unroll_outputs(cell, seed=0, length=T, input_dim=D, batch=B,
+                    merge=True):
+    """Bind an unrolled cell with random params and return (outputs,
+    arg_dict) as numpy."""
+    cell.reset()
+    data = mx.sym.Variable('data')
+    inputs = [mx.sym.slice_axis(data, axis=1, begin=i, end=i + 1).reshape(
+        (batch, input_dim)) for i in range(length)]
+    outputs, states = cell.unroll(length, inputs=inputs,
+                                  merge_outputs=merge)
+    out = outputs if merge else mx.sym.Group(outputs)
+    rng = RNG(seed)
+    x = rng.randn(batch, length, input_dim).astype(np.float32)
+    arg_shapes, _, _ = out.infer_shape(data=(batch, length, input_dim))
+    args = {}
+    for name, shape in zip(out.list_arguments(), arg_shapes):
+        if name == 'data':
+            args[name] = nd.array(x)
+        else:
+            args[name] = nd.array(rng.uniform(-0.1, 0.1, shape).astype(
+                np.float32))
+    ex = out.bind(mx.cpu(), args)
+    res = [o.asnumpy() for o in ex.forward()]
+    return res, args, out
+
+
+def test_rnn_cell_unroll_shapes():
+    cell = rnn.RNNCell(H, prefix='rnn_')
+    res, args, out = _unroll_outputs(cell)
+    assert res[0].shape == (B, T, H)
+    assert sorted(n for n in out.list_arguments() if n != 'data') == \
+        ['rnn_h2h_bias', 'rnn_h2h_weight', 'rnn_i2h_bias', 'rnn_i2h_weight']
+
+
+def test_lstm_cell_unroll_shapes_and_oracle():
+    cell = rnn.LSTMCell(H, prefix='lstm_', forget_bias=0.0)
+    res, args, out = _unroll_outputs(cell)
+    assert res[0].shape == (B, T, H)
+    # numpy oracle for the first step
+    x = args['data'].asnumpy()[:, 0, :]
+    wi = args['lstm_i2h_weight'].asnumpy()
+    bi = args['lstm_i2h_bias'].asnumpy()
+    wh = args['lstm_h2h_weight'].asnumpy()
+    bh = args['lstm_h2h_bias'].asnumpy()
+    gates = x @ wi.T + bi + bh          # h0 = 0
+    i, f, c, o = np.split(gates, 4, axis=1)
+
+    def sig(v):
+        return 1 / (1 + np.exp(-v))
+    ct = sig(i) * np.tanh(c)            # c0 = 0
+    ht = sig(o) * np.tanh(ct)
+    assert np.allclose(res[0][:, 0, :], ht, atol=1e-5)
+
+
+def test_gru_cell_unroll():
+    cell = rnn.GRUCell(H, prefix='gru_')
+    res, _, _ = _unroll_outputs(cell)
+    assert res[0].shape == (B, T, H)
+    assert np.isfinite(res[0]).all()
+
+
+def test_sequential_stack():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, prefix='l0_'))
+    stack.add(rnn.LSTMCell(H, prefix='l1_'))
+    res, _, out = _unroll_outputs(stack)
+    assert res[0].shape == (B, T, H)
+    names = set(out.list_arguments())
+    assert 'l0_i2h_weight' in names and 'l1_h2h_weight' in names
+
+
+def test_bidirectional():
+    cell = rnn.BidirectionalCell(rnn.LSTMCell(H, prefix='l_'),
+                                 rnn.LSTMCell(H, prefix='r_'))
+    res, _, _ = _unroll_outputs(cell)
+    assert res[0].shape == (B, T, 2 * H)
+
+
+def test_residual_cell():
+    cell = rnn.ResidualCell(rnn.RNNCell(D, prefix='res_'))
+    res, args, _ = _unroll_outputs(cell)
+    assert res[0].shape == (B, T, D)
+    # residual output = inner + input: recompute inner from a plain cell
+    inner = rnn.RNNCell(D, prefix='res_')
+    res2, args2, _ = _unroll_outputs(inner)
+    # same seed -> same params/data, so difference is exactly the input
+    x = args['data'].asnumpy()
+    assert np.allclose(res[0], res2[0] + x, atol=1e-5)
+
+
+def test_zoneout_cell_predict_mode_passthrough():
+    cell = rnn.ZoneoutCell(rnn.RNNCell(H, prefix='z_'),
+                           zoneout_outputs=0.0, zoneout_states=0.0)
+    res, _, _ = _unroll_outputs(cell)
+    plain = rnn.RNNCell(H, prefix='z_')
+    res2, _, _ = _unroll_outputs(plain)
+    assert np.allclose(res[0], res2[0], atol=1e-5)
+
+
+def test_dropout_cell_eval_identity():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.RNNCell(H, prefix='d0_'))
+    stack.add(rnn.DropoutCell(0.5))
+    res, _, _ = _unroll_outputs(stack)
+    plain = rnn.RNNCell(H, prefix='d0_')
+    res2, _, _ = _unroll_outputs(plain)
+    # executor runs is_train=False by default in forward() -> identity
+    assert res[0].shape == res2[0].shape
+
+
+def test_fused_cell_unroll_and_unfuse():
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode='lstm', prefix='f_')
+    res, _, _ = _unroll_outputs(fused)
+    assert res[0].shape == (B, T, H)
+    stack = fused.unfuse()
+    assert isinstance(stack, rnn.SequentialRNNCell)
+    res2, _, _ = _unroll_outputs(stack)
+    assert res2[0].shape == (B, T, H)
+
+
+def test_pack_unpack_roundtrip():
+    cell = rnn.LSTMCell(H, prefix='p_')
+    rng = RNG(3)
+    args = {
+        'p_i2h_weight': nd.array(rng.randn(4 * H, D).astype(np.float32)),
+        'p_i2h_bias': nd.array(rng.randn(4 * H).astype(np.float32)),
+        'p_h2h_weight': nd.array(rng.randn(4 * H, H).astype(np.float32)),
+        'p_h2h_bias': nd.array(rng.randn(4 * H).astype(np.float32)),
+    }
+    unpacked = cell.unpack_weights(args)
+    assert 'p_i2h_i_weight' in unpacked and 'p_h2h_o_bias' in unpacked
+    assert unpacked['p_i2h_i_weight'].shape == (H, D)
+    packed = cell.pack_weights(unpacked)
+    for k in args:
+        assert np.allclose(packed[k].asnumpy(), args[k].asnumpy()), k
+
+
+def test_begin_state_and_state_info():
+    cell = rnn.LSTMCell(H, prefix='s_')
+    info = cell.state_info
+    assert len(info) == 2                       # h and c
+    states = cell.begin_state(batch_size=B)
+    assert len(states) == 2
+
+
+def test_bucket_sentence_iter():
+    from mxnet_tpu.rnn.io import BucketSentenceIter
+    sents = [[1, 2, 3], [4, 5], [6, 7, 8, 9, 10, 11], [1, 1, 1, 1]]
+    it = BucketSentenceIter(sents, batch_size=2, buckets=[4, 8],
+                            invalid_label=0)
+    batches = list(it)
+    assert len(batches) >= 1
+    for b in batches:
+        assert b.data[0].shape[0] == 2
+        assert b.data[0].shape[1] in (4, 8)

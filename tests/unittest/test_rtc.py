@@ -1,0 +1,68 @@
+"""Runtime kernel compilation (mx.rtc TPU analog).
+
+Reference: python/mxnet/rtc.py usage pattern — write a kernel body as a
+string, compile at runtime, push NDArrays through it.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_rtc_jnp_elementwise():
+    x = nd.array(np.arange(10, dtype=np.float32))
+    y = nd.zeros((10,))
+    rtc = mx.rtc.Rtc('saxpy', [('x', x)], [('y', y)],
+                     'y = 2.0 * x + 1.0')
+    rtc.push([x], [y])
+    np.testing.assert_allclose(y.asnumpy(), 2 * np.arange(10) + 1)
+
+
+def test_rtc_jnp_two_inputs_two_outputs():
+    a = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    b = nd.array(np.array([10.0, 20.0, 30.0], np.float32))
+    s = nd.zeros((3,))
+    d = nd.zeros((3,))
+    rtc = mx.rtc.Rtc('sumdiff', [('a', a), ('b', b)],
+                     [('s', s), ('d', d)],
+                     's = a + b\nd = b - a')
+    rtc.push([a, b], [s, d])
+    np.testing.assert_allclose(s.asnumpy(), [11, 22, 33])
+    np.testing.assert_allclose(d.asnumpy(), [9, 18, 27])
+
+
+def test_rtc_jnp_uses_jnp_functions():
+    x = nd.array(np.array([0.0, 1.0, 4.0], np.float32))
+    y = nd.zeros((3,))
+    rtc = mx.rtc.Rtc('k', [('x', x)], [('y', y)],
+                     'y = jnp.sqrt(x) + jnp.sin(x) * 0.0')
+    rtc.push([x], [y])
+    np.testing.assert_allclose(y.asnumpy(), np.sqrt([0.0, 1.0, 4.0]),
+                               rtol=1e-6)
+
+
+def test_rtc_pallas_kernel():
+    x = nd.array(np.arange(8, dtype=np.float32))
+    y = nd.zeros((8,))
+    src = '''
+def kernel(x_ref, y_ref):
+    y_ref[...] = x_ref[...] * 3.0
+'''
+    rtc = mx.rtc.Rtc('triple', [('x', x)], [('y', y)], src,
+                     mode='pallas')
+    rtc.push([x], [y])
+    np.testing.assert_allclose(y.asnumpy(), 3 * np.arange(8))
+
+
+def test_rtc_arg_validation():
+    x = nd.zeros((2,))
+    y = nd.zeros((2,))
+    rtc = mx.rtc.Rtc('id', [('x', x)], [('y', y)], 'y = x')
+    with pytest.raises(ValueError):
+        rtc.push([x, x], [y])
+    with pytest.raises(ValueError):
+        mx.rtc.Rtc('bad', [('x', x)], [('y', y)], 'y = x', mode='cuda')
+    with pytest.raises(ValueError):
+        mx.rtc.Rtc('nokern', [('x', x)], [('y', y)], 'z = 1',
+                   mode='pallas')

@@ -1,0 +1,181 @@
+"""Symbol graph API coverage.
+
+Reference: tests/python/unittest/test_symbol.py (compose, list_*,
+internals, json roundtrip, infer shape/type) and test_attr.py
+(AttrScope, attribute inheritance), test_infer_shape.py.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _mlp():
+    data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(data, name='fc1', num_hidden=10)
+    net = mx.sym.Activation(net, name='relu1', act_type='relu')
+    net = mx.sym.FullyConnected(net, name='fc2', num_hidden=3)
+    return mx.sym.SoftmaxOutput(net, name='softmax')
+
+
+def test_compose_and_lists():
+    net = _mlp()
+    assert net.list_arguments() == [
+        'data', 'fc1_weight', 'fc1_bias', 'fc2_weight', 'fc2_bias',
+        'softmax_label']
+    assert net.list_outputs() == ['softmax_output']
+    assert net.name == 'softmax'
+
+
+def test_call_compose():
+    lhs = mx.sym.Variable('lhs')
+    rhs = mx.sym.Variable('rhs')
+    net = mx.sym.FullyConnected(lhs, name='fc', num_hidden=4)
+    composed = net(lhs=rhs)
+    assert 'rhs' in composed.list_arguments()
+    assert 'lhs' not in composed.list_arguments()
+
+
+def test_get_internals_and_children():
+    net = _mlp()
+    internals = net.get_internals()
+    outs = internals.list_outputs()
+    assert 'fc1_output' in outs and 'relu1_output' in outs
+    fc1 = internals['fc1_output']
+    assert fc1.list_arguments() == ['data', 'fc1_weight', 'fc1_bias']
+    ch = net.get_children()
+    assert ch is not None
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 100))
+    d = dict(zip(net.list_arguments(), arg_shapes))
+    assert d['fc1_weight'] == (10, 100)
+    assert d['fc1_bias'] == (10,)
+    assert d['fc2_weight'] == (3, 10)
+    assert out_shapes[0] == (8, 3)
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = mx.sym.Variable('data')
+    prev = mx.sym.Variable('prev')
+    net = mx.sym.FullyConnected(data=data, name='fc1', num_hidden=10)
+    net2 = mx.sym.FullyConnected(data=prev, name='fc2', num_hidden=10)
+    out = net + net2
+    # full inference fails (prev unknown), partial succeeds
+    arg_shapes, out_shapes, _ = out.infer_shape_partial(data=(2, 5))
+    d = dict(zip(out.list_arguments(), arg_shapes))
+    assert d['fc1_weight'] == (10, 5)
+
+
+def test_infer_type():
+    net = _mlp()
+    arg_types, out_types, _ = net.infer_type(data='float32')
+    assert all(t == np.float32 for t in arg_types)
+    assert out_types[0] == np.float32
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    # same compute after reload
+    rng = np.random.RandomState(0)
+    args = {}
+    arg_shapes, _, _ = net.infer_shape(data=(2, 4))
+    for name, shape in zip(net.list_arguments(), arg_shapes):
+        args[name] = nd.array(rng.randn(*shape).astype(np.float32))
+    ex1 = net.bind(mx.cpu(), dict(args))
+    ex2 = net2.bind(mx.cpu(), dict(args))
+    np.testing.assert_allclose(ex1.forward()[0].asnumpy(),
+                               ex2.forward()[0].asnumpy(), rtol=1e-5)
+
+
+def test_save_load_file():
+    net = _mlp()
+    with tempfile.TemporaryDirectory() as d:
+        fname = os.path.join(d, 'net-symbol.json')
+        net.save(fname)
+        net2 = mx.sym.load(fname)
+        assert net2.tojson() == net.tojson()
+
+
+def test_group_and_slicing():
+    a = mx.sym.Variable('a')
+    b = mx.sym.Variable('b')
+    s = mx.sym.Group([a * 2, b + 1])
+    assert len(s.list_outputs()) == 2
+    first = s[0]
+    assert first.list_arguments() == ['a']
+    for out in s:
+        assert isinstance(out, mx.sym.Symbol)
+
+
+def test_attr_and_attr_scope():
+    with mx.AttrScope(ctx_group='dev1'):
+        a = mx.sym.Variable('a')
+        fc = mx.sym.FullyConnected(a, name='fc', num_hidden=2)
+    assert a.attr('ctx_group') == 'dev1'
+    d = fc.attr_dict()
+    assert d.get('fc', {}).get('ctx_group') == 'dev1'
+    v = mx.sym.Variable('v', lr_mult=2.0)
+    assert float(v.attr('__lr_mult__')) == 2.0
+
+
+def test_variable_shape_attr_used_in_inference():
+    v = mx.sym.Variable('v', shape=(3, 4))
+    out = mx.sym.sum(v)
+    arg_shapes, out_shapes, _ = out.infer_shape()
+    assert arg_shapes[0] == (3, 4)
+    assert out_shapes[0] == ()or out_shapes[0] == (1,)
+
+
+def test_arithmetic_operators_on_symbols():
+    a = mx.sym.Variable('a')
+    b = mx.sym.Variable('b')
+    expr = (a + b) * (a - b) / (b + 1.0) ** 2 - (-a)
+    av = np.array([[2.0, 3.0]], np.float32)
+    bv = np.array([[1.0, 1.0]], np.float32)
+    ex = expr.bind(mx.cpu(), {'a': nd.array(av), 'b': nd.array(bv)})
+    want = (av + bv) * (av - bv) / (bv + 1.0) ** 2 + av
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), want, rtol=1e-6)
+
+
+def test_gradient_symbolic():
+    """simple_bind + backward computes d(sum(x*w))/dw."""
+    x = mx.sym.Variable('x')
+    w = mx.sym.Variable('w')
+    y = mx.sym.sum(x * w)
+    ex = y.simple_bind(mx.cpu(), x=(2, 2), w=(2, 2))
+    xv = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    wv = np.ones((2, 2), np.float32)
+    ex.arg_dict['x'][:] = xv
+    ex.arg_dict['w'][:] = wv
+    ex.forward(is_train=True)
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict['w'].asnumpy(), xv)
+    np.testing.assert_allclose(ex.grad_dict['x'].asnumpy(), wv)
+
+
+def test_softmax_output_label_inference_variants():
+    data = mx.sym.Variable('data')
+    # default: (N,)
+    s = mx.sym.SoftmaxOutput(data, name='sm')
+    args, _, _ = s.infer_shape(data=(4, 7))
+    assert dict(zip(s.list_arguments(), args))['sm_label'] == (4,)
+    # preserve_shape: data shape minus the class axis
+    s2 = mx.sym.SoftmaxOutput(data, name='sm', preserve_shape=True)
+    args2, _, _ = s2.infer_shape(data=(4, 7, 3))
+    assert dict(zip(s2.list_arguments(), args2))['sm_label'] == (4, 7)
+    # multi_output: class axis 1 removed
+    s3 = mx.sym.SoftmaxOutput(data, name='sm', multi_output=True)
+    args3, _, _ = s3.infer_shape(data=(4, 3, 5, 5))
+    assert dict(zip(s3.list_arguments(), args3))['sm_label'] == (4, 5, 5)
