@@ -52,6 +52,7 @@ from .executor_manager import DataParallelExecutorManager  # noqa: F401
 from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import rtc
+from . import plugin
 from . import parallel
 
 # Server/scheduler processes block in their role loop here and exit with the

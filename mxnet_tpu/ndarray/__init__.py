@@ -23,6 +23,14 @@ def cast_storage(data, stype='default'):
     return sparse.cast_storage(data, stype)
 
 
+def Custom(*args, **kwargs):
+    """Eager Custom op: host-python execution + autograd recording
+    (reference custom.cc ExecType::kLocal). The registry 'Custom' op
+    remains the symbolic-executor form."""
+    from ..operator import custom_eager
+    return custom_eager(*args, **kwargs)
+
+
 def sparse_retain(data, indices):
     """Eager sparse_retain: row_sparse in → row_sparse out
     (reference sparse_retain-inl.h); dense input uses the registry op's

@@ -82,6 +82,61 @@ def get_all_registered_operators():
     return list(_CUSTOM_OPS)
 
 
+def invoke_custom(op, inputs, out_shapes, out_dtypes=None):
+    """Run a CustomOp instance eagerly on NDArrays, recording it on the
+    imperative tape when autograd is active (reference custom.cc runs
+    the python callbacks outside the graph with ExecType::kLocal and
+    registers a backward entry; here the backward entry is a TapeNode
+    whose vjp calls op.backward). ``is_train`` follows the training
+    mode flag (reference contract), not the recording flag."""
+    from . import autograd as _ag
+    from .ndarray.ndarray import _parent_entry
+
+    if out_dtypes is None:
+        out_dtypes = ['float32'] * len(out_shapes)
+    out_nd = [zeros(tuple(s), dtype=t)
+              for s, t in zip(out_shapes, out_dtypes)]
+    recording = _ag.is_recording() and any(
+        i._node is not None or i._leaf is not None for i in inputs)
+    op.forward(is_train=_ag.is_training(),
+               req=['write'] * len(out_nd), in_data=list(inputs),
+               out_data=out_nd, aux=[])
+    if recording:
+        def vjp_fn(cots):
+            if len(out_nd) == 1:
+                cots = (cots,)
+            in_grads = [zeros(i.shape, dtype=i.dtype) for i in inputs]
+            op.backward(req=['write'] * len(inputs),
+                        out_grad=[NDArray(c, None) for c in cots],
+                        in_data=list(inputs), out_data=out_nd,
+                        in_grad=in_grads, aux=[])
+            return tuple(g._data for g in in_grads)
+
+        from . import autograd as ag
+        node = ag.record_op(vjp_fn, [_parent_entry(i) for i in inputs],
+                            len(out_nd), len(inputs))
+        node.head_ids = [(tuple(o.shape), o._data.dtype) for o in out_nd]
+        for i, o in enumerate(out_nd):
+            o._node = node
+            o._out_idx = i
+    return out_nd[0] if len(out_nd) == 1 else out_nd
+
+
+def custom_eager(*args, **kwargs):
+    """Eager nd.Custom: host execution + tape recording (installed over
+    the registry-generated wrapper in ndarray/__init__.py)."""
+    op_type = kwargs.pop('op_type')
+    kwargs.pop('name', None)
+    inputs = [a for a in args if isinstance(a, NDArray)]
+    prop = _CUSTOM_OPS[op_type](**kwargs)
+    shapes = [list(a.shape) for a in inputs]
+    _, out_shapes, _ = prop.infer_shape(shapes)
+    in_types = [a.dtype for a in inputs]
+    _, out_types, _ = prop.infer_type(in_types)
+    op = prop.create_operator(None, [tuple(s) for s in shapes], in_types)
+    return invoke_custom(op, inputs, out_shapes, out_dtypes=out_types)
+
+
 @_reg.register('Custom', variadic=True, key_var_num_args='num_args',
                differentiable=False)
 def _custom_fn(attrs, *arrays):

@@ -1,0 +1,10 @@
+"""Optional plugins (reference plugin/ — caffe/torch/warpctc bridges).
+
+The reference compiles these in behind build flags; here each plugin is
+an import-gated python module. Only bridges whose host library exists in
+the environment load; everything degrades to an ImportError with a
+clear message, never a crash at package import.
+"""
+from . import torch_bridge  # noqa: F401  (guards its own torch import)
+
+__all__ = ['torch_bridge']

@@ -1,0 +1,135 @@
+"""Torch plugin — run pytorch modules/criteria inside mxnet_tpu graphs.
+
+Reference: plugin/torch (torch_module-inl.h TorchModuleOp wraps a Lua
+torch module built from ``lua_string``; torch_criterion-inl.h wraps a
+criterion as a loss op). The modern analog wraps a **pytorch**
+``nn.Module`` as a CustomOp: forward/backward run on host CPU through
+torch autograd, the rest of the graph stays on TPU — the same
+host-callback execution contract as the reference plugin
+(ExecType::kLocal) and our CustomOp bridge.
+
+Usage::
+
+    bridge = TorchModule(torch.nn.Linear(4, 2))
+    y = bridge(mx.nd.ones((3, 4)))            # imperative
+    loss = TorchCriterion(torch.nn.MSELoss())
+    l = loss(pred, target)
+
+Both are differentiable under ``mx.autograd.record()`` — gradients
+flow back into the mxnet_tpu graph (and into the torch parameters via
+torch autograd, mirroring the reference's lua-held parameter update).
+"""
+import numpy as np
+
+from ..ndarray.ndarray import array as nd_array
+from ..operator import CustomOp, invoke_custom
+
+try:
+    import torch as _torch
+except ImportError:  # pragma: no cover - torch is baked into this image
+    _torch = None
+
+
+def _require_torch():
+    if _torch is None:
+        raise ImportError('the torch plugin needs pytorch installed')
+
+
+def _to_torch(x):
+    """NDArray → torch tensor. Copies: jax buffers are read-only and
+    torch assumes writable memory."""
+    return _torch.from_numpy(np.array(x.asnumpy()))
+
+
+class _TorchOp(CustomOp):
+    """CustomOp running a pytorch callable on host CPU."""
+
+    def __init__(self, fn, grad_input_mask=None):
+        self._fn = fn
+        self._mask = grad_input_mask  # None = grads for all inputs
+        self._saved = None
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        tins = [_to_torch(x) for x in in_data]
+        if is_train:
+            for i, t in enumerate(tins):
+                if self._mask is None or self._mask[i]:
+                    t.requires_grad_(True)
+            out = self._fn(*tins)
+            self._saved = (tins, out)
+            self.assign(out_data[0], req[0], nd_array(out.detach().numpy()))
+        else:
+            with _torch.no_grad():
+                out = self._fn(*tins)
+            self.assign(out_data[0], req[0], nd_array(out.numpy()))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        tins, out = self._saved
+        gout = _to_torch(out_grad[0]).reshape(out.shape)
+        # .backward (not autograd.grad) so module parameters accumulate
+        # their .grad too — the torch side stays trainable, like the
+        # reference's lua-held parameters
+        out.backward(gout)
+        for i, t in enumerate(tins):
+            g = t.grad
+            z = np.zeros(in_data[i].shape, np.float32) if g is None \
+                else g.numpy()
+            self.assign(in_grad[i], req[i], nd_array(z))
+
+
+class TorchModule:
+    """Wrap a pytorch ``nn.Module`` (or a source string evaluating to
+    one, mirroring the reference's ``lua_string``) as a differentiable
+    mxnet_tpu operator."""
+
+    def __init__(self, module):
+        _require_torch()
+        if isinstance(module, str):
+            # the reference's lua_string contract: source evaluating to
+            # a module, e.g. "nn.Linear(4, 2)"
+            module = eval(module, {'torch': _torch, 'nn': _torch.nn})  # noqa: S307
+        self.module = module.to('cpu')
+        self._shape_cache = {}
+
+    def _out_shape(self, inputs):
+        """Output shape for these input shapes, memoized. The one probe
+        run per new shape happens in eval() mode so stateful modules
+        (BatchNorm running stats) are not double-updated."""
+        key = tuple(tuple(x.shape) for x in inputs)
+        if key not in self._shape_cache:
+            was_training = self.module.training
+            self.module.eval()
+            try:
+                with _torch.no_grad():
+                    probe = self.module(*[_to_torch(x) for x in inputs])
+            finally:
+                if was_training:
+                    self.module.train()
+            self._shape_cache[key] = tuple(probe.shape)
+        return self._shape_cache[key]
+
+    def __call__(self, *inputs):
+        op = _TorchOp(lambda *t: self.module(*t))
+        return invoke_custom(op, list(inputs),
+                             [self._out_shape(inputs)])
+
+    def parameters(self):
+        """Snapshot of the torch-held parameters as NDArrays (the torch
+        side owns them, like the reference's lua-held params)."""
+        return [nd_array(p.detach().numpy())
+                for p in self.module.parameters()]
+
+    def torch_parameters(self):
+        return list(self.module.parameters())
+
+
+class TorchCriterion(TorchModule):
+    """Wrap a pytorch loss (criterion): ``crit(pred, target)`` →
+    loss NDArray; grads flow to ``pred`` only (the reference
+    TorchCriterionOp contract)."""
+
+    def __call__(self, pred, target):
+        op = _TorchOp(lambda p, t: self.module(p, t),
+                      grad_input_mask=[True, False])
+        return invoke_custom(op, [pred, target],
+                             [self._out_shape([pred, target])])

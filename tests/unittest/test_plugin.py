@@ -1,0 +1,131 @@
+"""Plugin tier: torch bridge (reference plugin/torch as TorchModule/
+TorchCriterion ops) and the differentiable eager Custom path it rides.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd as ag
+from mxnet_tpu import nd
+
+torch = pytest.importorskip('torch')
+from mxnet_tpu.plugin.torch_bridge import TorchModule, TorchCriterion  # noqa: E402
+
+
+def test_torch_module_forward_matches_torch():
+    lin = torch.nn.Linear(4, 2)
+    bridge = TorchModule(lin)
+    x = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    got = bridge(nd.array(x)).asnumpy()
+    want = lin(torch.from_numpy(x)).detach().numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_torch_module_from_source_string():
+    bridge = TorchModule('nn.ReLU()')
+    x = np.array([[-1.0, 2.0]], np.float32)
+    np.testing.assert_allclose(bridge(nd.array(x)).asnumpy(), [[0.0, 2.0]])
+
+
+def test_torch_module_backward_into_mx_graph():
+    lin = torch.nn.Linear(3, 1, bias=False)
+    with torch.no_grad():
+        lin.weight[:] = torch.tensor([[1.0, 2.0, 3.0]])
+    bridge = TorchModule(lin)
+    x = nd.array(np.array([[1.0, 1.0, 1.0], [2.0, 0.0, 1.0]], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = bridge(x * 2.0)          # mx op before the torch op
+        loss = nd.sum(y)
+    loss.backward()
+    # dloss/dx = 2 * W summed over output rows
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               np.tile([[2.0, 4.0, 6.0]], (2, 1)),
+                               rtol=1e-5)
+    # torch side accumulated its own param grads too
+    assert lin.weight.grad is not None
+
+
+def test_torch_criterion():
+    crit = TorchCriterion(torch.nn.MSELoss())
+    pred = nd.array(np.array([1.0, 2.0], np.float32))
+    target = nd.array(np.array([0.0, 0.0], np.float32))
+    pred.attach_grad()
+    with ag.record():
+        l = crit(pred, target)
+    l.backward()
+    np.testing.assert_allclose(float(l.asnumpy()), 2.5, rtol=1e-6)
+    # d/dpred mean((p-t)^2) = 2(p-t)/n
+    np.testing.assert_allclose(pred.grad.asnumpy(), [1.0, 2.0], rtol=1e-6)
+
+
+def test_custom_op_backward_eager():
+    """The upgraded nd.Custom records on the tape (reference custom op
+    autograd support)."""
+    import mxnet_tpu.operator as op_mod
+
+    class Square(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0] * in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        out_grad[0] * in_data[0] * 2.0)
+
+    @op_mod.register('square_plugin_test')
+    class SquareProp(op_mod.CustomOpProp):
+        def create_operator(self, ctx, shapes, dtypes):
+            return Square()
+
+    x = nd.array(np.array([1.0, 3.0], np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = nd.Custom(x, op_type='square_plugin_test')
+        loss = nd.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), [1.0, 9.0])
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 6.0])
+
+
+def test_torch_bn_stats_single_update_per_call():
+    """Regression: the shape probe must not double-run stateful modules."""
+    bn = torch.nn.BatchNorm1d(2)
+    bridge = TorchModule(bn)
+    x = nd.array(np.random.RandomState(0).randn(8, 2).astype(np.float32))
+    with ag.record():
+        bridge(x)
+    assert int(bn.num_batches_tracked) == 1
+    with ag.record():
+        bridge(x)
+    assert int(bn.num_batches_tracked) == 2
+    assert hasattr(mx.plugin, 'torch_bridge')
+
+
+def test_custom_op_dtype_follows_infer_type():
+    import mxnet_tpu.operator as op_mod
+
+    class ArgMax(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0],
+                        nd.array(in_data[0].asnumpy().argmax(1)))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0],
+                        nd.zeros(in_data[0].shape))
+
+    @op_mod.register('argmax_dtype_test')
+    class ArgMaxProp(op_mod.CustomOpProp):
+        def infer_shape(self, in_shape):
+            return in_shape, [[in_shape[0][0]]], []
+
+        def infer_type(self, in_type):
+            # int32: jax without x64 keeps integer arrays at 32 bits
+            return in_type, [np.int32], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return ArgMax()
+
+    x = nd.array(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    out = nd.Custom(x, op_type='argmax_dtype_test')
+    assert out.asnumpy().dtype == np.int32
+    np.testing.assert_array_equal(out.asnumpy(), [1, 0])
