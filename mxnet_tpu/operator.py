@@ -82,7 +82,7 @@ def get_all_registered_operators():
     return list(_CUSTOM_OPS)
 
 
-def invoke_custom(op, inputs, out_shapes, out_dtypes=None):
+def invoke_custom(op, inputs, out_shapes, out_dtypes=None, aux=None):
     """Run a CustomOp instance eagerly on NDArrays, recording it on the
     imperative tape when autograd is active (reference custom.cc runs
     the python callbacks outside the graph with ExecType::kLocal and
@@ -94,13 +94,15 @@ def invoke_custom(op, inputs, out_shapes, out_dtypes=None):
 
     if out_dtypes is None:
         out_dtypes = ['float32'] * len(out_shapes)
+    if aux is None:
+        aux = []
     out_nd = [zeros(tuple(s), dtype=t)
               for s, t in zip(out_shapes, out_dtypes)]
     recording = _ag.is_recording() and any(
         i._node is not None or i._leaf is not None for i in inputs)
     op.forward(is_train=_ag.is_training(),
                req=['write'] * len(out_nd), in_data=list(inputs),
-               out_data=out_nd, aux=[])
+               out_data=out_nd, aux=aux)
     if recording:
         def vjp_fn(cots):
             if len(out_nd) == 1:
@@ -109,12 +111,11 @@ def invoke_custom(op, inputs, out_shapes, out_dtypes=None):
             op.backward(req=['write'] * len(inputs),
                         out_grad=[NDArray(c, None) for c in cots],
                         in_data=list(inputs), out_data=out_nd,
-                        in_grad=in_grads, aux=[])
+                        in_grad=in_grads, aux=aux)
             return tuple(g._data for g in in_grads)
 
-        from . import autograd as ag
-        node = ag.record_op(vjp_fn, [_parent_entry(i) for i in inputs],
-                            len(out_nd), len(inputs))
+        node = _ag.record_op(vjp_fn, [_parent_entry(i) for i in inputs],
+                             len(out_nd), len(inputs))
         node.head_ids = [(tuple(o.shape), o._data.dtype) for o in out_nd]
         for i, o in enumerate(out_nd):
             o._node = node
@@ -130,11 +131,14 @@ def custom_eager(*args, **kwargs):
     inputs = [a for a in args if isinstance(a, NDArray)]
     prop = _CUSTOM_OPS[op_type](**kwargs)
     shapes = [list(a.shape) for a in inputs]
-    _, out_shapes, _ = prop.infer_shape(shapes)
+    _, out_shapes, aux_shapes = prop.infer_shape(shapes)
     in_types = [a.dtype for a in inputs]
-    _, out_types, _ = prop.infer_type(in_types)
+    _, out_types, aux_types = prop.infer_type(in_types)
+    aux = [zeros(tuple(s), dtype=t)
+           for s, t in zip(aux_shapes or [], aux_types or [])]
     op = prop.create_operator(None, [tuple(s) for s in shapes], in_types)
-    return invoke_custom(op, inputs, out_shapes, out_dtypes=out_types)
+    return invoke_custom(op, inputs, out_shapes, out_dtypes=out_types,
+                         aux=aux)
 
 
 @_reg.register('Custom', variadic=True, key_var_num_args='num_args',

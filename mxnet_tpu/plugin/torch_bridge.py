@@ -44,24 +44,25 @@ def _to_torch(x):
 class _TorchOp(CustomOp):
     """CustomOp running a pytorch callable on host CPU."""
 
-    def __init__(self, fn, grad_input_mask=None):
+    def __init__(self, fn, module=None, grad_input_mask=None):
         self._fn = fn
+        self._module = module  # for train/eval mode switching
         self._mask = grad_input_mask  # None = grads for all inputs
         self._saved = None
 
     def forward(self, is_train, req, in_data, out_data, aux):
         tins = [_to_torch(x) for x in in_data]
-        if is_train:
-            for i, t in enumerate(tins):
-                if self._mask is None or self._mask[i]:
-                    t.requires_grad_(True)
-            out = self._fn(*tins)
-            self._saved = (tins, out)
-            self.assign(out_data[0], req[0], nd_array(out.detach().numpy()))
-        else:
-            with _torch.no_grad():
-                out = self._fn(*tins)
-            self.assign(out_data[0], req[0], nd_array(out.numpy()))
+        # the torch graph is built regardless of is_train: the tape may
+        # record in predict mode too (record(train_mode=False) — e.g.
+        # saliency maps), and backward needs the saved graph either way
+        for i, t in enumerate(tins):
+            if self._mask is None or self._mask[i]:
+                t.requires_grad_(True)
+        if self._module is not None:
+            self._module.train(bool(is_train))
+        out = self._fn(*tins)
+        self._saved = (tins, out)
+        self.assign(out_data[0], req[0], nd_array(out.detach().numpy()))
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
         tins, out = self._saved
@@ -91,10 +92,11 @@ class TorchModule:
         self.module = module.to('cpu')
         self._shape_cache = {}
 
-    def _out_shape(self, inputs):
-        """Output shape for these input shapes, memoized. The one probe
-        run per new shape happens in eval() mode so stateful modules
-        (BatchNorm running stats) are not double-updated."""
+    def _out_spec(self, inputs):
+        """(shape, dtype) of the output for these input shapes,
+        memoized. The one probe run per new shape happens in eval()
+        mode so stateful modules (BatchNorm running stats) are not
+        double-updated."""
         key = tuple(tuple(x.shape) for x in inputs)
         if key not in self._shape_cache:
             was_training = self.module.training
@@ -105,13 +107,15 @@ class TorchModule:
             finally:
                 if was_training:
                     self.module.train()
-            self._shape_cache[key] = tuple(probe.shape)
+            self._shape_cache[key] = (tuple(probe.shape),
+                                      str(probe.numpy().dtype))
         return self._shape_cache[key]
 
     def __call__(self, *inputs):
-        op = _TorchOp(lambda *t: self.module(*t))
-        return invoke_custom(op, list(inputs),
-                             [self._out_shape(inputs)])
+        op = _TorchOp(lambda *t: self.module(*t), module=self.module)
+        shape, dtype = self._out_spec(inputs)
+        return invoke_custom(op, list(inputs), [shape],
+                             out_dtypes=[dtype])
 
     def parameters(self):
         """Snapshot of the torch-held parameters as NDArrays (the torch
@@ -129,7 +133,8 @@ class TorchCriterion(TorchModule):
     TorchCriterionOp contract)."""
 
     def __call__(self, pred, target):
-        op = _TorchOp(lambda p, t: self.module(p, t),
+        op = _TorchOp(lambda p, t: self.module(p, t), module=self.module,
                       grad_input_mask=[True, False])
-        return invoke_custom(op, [pred, target],
-                             [self._out_shape([pred, target])])
+        shape, dtype = self._out_spec([pred, target])
+        return invoke_custom(op, [pred, target], [shape],
+                             out_dtypes=[dtype])

@@ -1,0 +1,151 @@
+"""Data iterator behaviors.
+
+Reference: tests/python/unittest/test_io.py (NDArrayIter padding/
+discard/roll_over, shuffle determinism, CSVIter roundtrip, MNISTIter,
+PrefetchingIter equivalence, ResizeIter).
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import nd
+
+
+def _collect(it):
+    it.reset()
+    batches = []
+    for b in it:
+        batches.append((b.data[0].asnumpy().copy(),
+                        None if not b.label else b.label[0].asnumpy().copy(),
+                        b.pad))
+    return batches
+
+
+def test_ndarrayiter_exact_batches():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    y = np.arange(12, dtype=np.float32)
+    it = mio.NDArrayIter(X, y, batch_size=4)
+    bs = _collect(it)
+    assert len(bs) == 3
+    got = np.concatenate([b[0] for b in bs])
+    np.testing.assert_allclose(got, X)
+    assert all(b[2] == 0 for b in bs)
+
+
+def test_ndarrayiter_pad_last_batch():
+    X = np.arange(10, dtype=np.float32).reshape(5, 2)
+    it = mio.NDArrayIter(X, batch_size=4, last_batch_handle='pad')
+    bs = _collect(it)
+    assert len(bs) == 2
+    assert bs[0][2] == 0 and bs[1][2] == 3      # 3 padded samples
+    # padded region wraps to the start (reference pad semantics)
+    np.testing.assert_allclose(bs[1][0][1:], X[:3])
+
+
+def test_ndarrayiter_discard_last_batch():
+    X = np.arange(10, dtype=np.float32).reshape(5, 2)
+    it = mio.NDArrayIter(X, batch_size=4, last_batch_handle='discard')
+    bs = _collect(it)
+    assert len(bs) == 1
+    np.testing.assert_allclose(bs[0][0], X[:4])
+
+
+def test_ndarrayiter_roll_over():
+    """Reference io.py:673 — roll_over yields the same epoch-1 batches
+    as pad, but the next reset rolls the leftover into epoch 2 (which
+    then has fewer batches)."""
+    X = np.arange(10, dtype=np.float32).reshape(5, 2)
+    it = mio.NDArrayIter(X, batch_size=4, last_batch_handle='roll_over')
+    b1 = _collect(it)       # _collect resets first: epoch 1
+    assert len(b1) == 2
+    it.reset()              # cursor rolled: epoch 2 has one batch
+    b2 = [b for b in it]
+    assert len(b2) == 1
+    assert b2[0].data[0].shape == (4, 2)
+    it.hard_reset()         # hard_reset ignores roll-over state
+    assert len([b for b in it]) == 2
+
+
+def test_ndarrayiter_shuffle_is_permutation_and_seeded():
+    X = np.arange(16, dtype=np.float32).reshape(8, 2)
+    y = np.arange(8, dtype=np.float32)
+    mx.random.seed(5)
+    it = mio.NDArrayIter(X, y, batch_size=4, shuffle=True)
+    bs = _collect(it)
+    data = np.concatenate([b[0] for b in bs])
+    labels = np.concatenate([b[1] for b in bs])
+    # permutation of rows, with labels moved consistently
+    assert sorted(data[:, 0].tolist()) == sorted(X[:, 0].tolist())
+    for row, lab in zip(data, labels):
+        np.testing.assert_allclose(row, X[int(lab)])
+
+
+def test_ndarrayiter_dict_input_and_provide_data():
+    X = {'a': np.zeros((6, 2), np.float32), 'b': np.ones((6, 3), np.float32)}
+    it = mio.NDArrayIter(X, batch_size=3)
+    names = sorted(d.name for d in it.provide_data)
+    assert names == ['a', 'b']
+    it.reset()
+    b = next(iter(it))
+    assert len(b.data) == 2
+
+
+def test_csviter_roundtrip():
+    X = np.arange(30, dtype=np.float32).reshape(10, 3)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'x.csv')
+        np.savetxt(path, X, delimiter=',')
+        it = mio.CSVIter(data_csv=path, data_shape=(3,), batch_size=5)
+        bs = _collect(it)
+        got = np.concatenate([b[0] for b in bs])
+        np.testing.assert_allclose(got, X, rtol=1e-6)
+
+
+def test_resizeiter():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    base = mio.NDArrayIter(X, batch_size=4)
+    it = mio.ResizeIter(base, 2)
+    bs = _collect(it)
+    assert len(bs) == 2
+    it.reset()
+    assert len([b for b in it]) == 2
+
+
+def test_prefetching_iter_equivalence():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    y = np.arange(12, dtype=np.float32)
+    plain = _collect(mio.NDArrayIter(X, y, batch_size=4))
+    pre = mio.PrefetchingIter(mio.NDArrayIter(X, y, batch_size=4))
+    fetched = _collect(pre)
+    assert len(plain) == len(fetched)
+    for p, f in zip(plain, fetched):
+        np.testing.assert_allclose(p[0], f[0])
+        np.testing.assert_allclose(p[1], f[1])
+
+
+def test_mnist_iter_synthetic_fallback():
+    """Absent idx files → hermetic synthetic digits (class-separable)."""
+    it = mio.MNISTIter(image='/nonexistent/train-images-idx3-ubyte',
+                       label='/nonexistent/train-labels-idx1-ubyte',
+                       batch_size=8, shuffle=False)
+    it.reset()
+    b = next(iter(it))
+    assert b.data[0].shape == (8, 1, 28, 28)
+    assert b.label[0].shape == (8,)
+    flat = mio.MNISTIter(image='/nonexistent/t10k-images-idx3-ubyte',
+                         label='/nonexistent/t10k-labels-idx1-ubyte',
+                         batch_size=8, flat=True, shuffle=False)
+    flat.reset()
+    b2 = next(iter(flat))
+    assert b2.data[0].shape == (8, 784)
+
+
+def test_databatch_and_desc():
+    d = mio.DataDesc('data', (4, 3))
+    assert d.name == 'data' and d.shape == (4, 3)
+    b = mio.DataBatch(data=[nd.zeros((4, 3))], label=None, pad=1)
+    assert b.pad == 1

@@ -1,0 +1,112 @@
+"""Gluon losses vs numpy oracles (reference tests/python/unittest/
+test_loss.py), including weighting and convergence-through-gradient.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd as ag
+from mxnet_tpu import gluon, nd
+
+RNG = np.random.RandomState
+
+
+def test_l2_loss_oracle():
+    rng = RNG(0)
+    p = rng.randn(4, 3).astype(np.float32)
+    t = rng.randn(4, 3).astype(np.float32)
+    l = gluon.loss.L2Loss()(nd.array(p), nd.array(t)).asnumpy()
+    want = ((p - t) ** 2).mean(1) / 2
+    np.testing.assert_allclose(l, want, rtol=1e-5)
+
+
+def test_l1_loss_oracle():
+    rng = RNG(1)
+    p = rng.randn(4, 3).astype(np.float32)
+    t = rng.randn(4, 3).astype(np.float32)
+    l = gluon.loss.L1Loss()(nd.array(p), nd.array(t)).asnumpy()
+    np.testing.assert_allclose(l, np.abs(p - t).mean(1), rtol=1e-5)
+
+
+def test_sigmoid_bce_from_logits_and_probs():
+    rng = RNG(2)
+    logits = rng.randn(5, 2).astype(np.float32)
+    label = (rng.rand(5, 2) > 0.5).astype(np.float32)
+    got = gluon.loss.SigmoidBinaryCrossEntropyLoss()(
+        nd.array(logits), nd.array(label)).asnumpy()
+    p = 1 / (1 + np.exp(-logits))
+    want = -(label * np.log(p) + (1 - label) * np.log(1 - p)).mean(1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    got2 = gluon.loss.SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)(
+        nd.array(p), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(got2, want, rtol=1e-4, atol=1e-6)
+
+
+def test_softmax_ce_sparse_and_dense_label():
+    rng = RNG(3)
+    logits = rng.randn(6, 4).astype(np.float32)
+    label = rng.randint(0, 4, 6)
+    lsm = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+    want = -lsm[np.arange(6), label]
+    got = gluon.loss.SoftmaxCrossEntropyLoss()(
+        nd.array(logits), nd.array(label.astype(np.float32))).asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+    onehot = np.eye(4, dtype=np.float32)[label]
+    got2 = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd.array(logits), nd.array(onehot)).asnumpy()
+    np.testing.assert_allclose(got2, want, rtol=1e-4, atol=1e-6)
+
+
+def test_kl_div_loss():
+    rng = RNG(4)
+    logits = rng.randn(3, 5).astype(np.float32)
+    target = np.exp(rng.randn(3, 5).astype(np.float32))
+    target /= target.sum(1, keepdims=True)
+    lsm = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+    got = gluon.loss.KLDivLoss()(nd.array(lsm),
+                                 nd.array(target)).asnumpy()
+    want = (target * (np.log(target) - lsm)).mean(1)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_sample_weight():
+    rng = RNG(5)
+    p = rng.randn(4, 3).astype(np.float32)
+    t = rng.randn(4, 3).astype(np.float32)
+    w = np.array([[1.0], [0.0], [2.0], [1.0]], np.float32)
+    got = gluon.loss.L2Loss()(nd.array(p), nd.array(t),
+                              nd.array(w)).asnumpy()
+    want = (((p - t) ** 2) * w).mean(1) / 2
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    assert got[1] == 0.0
+
+
+def test_loss_weight_scalar():
+    p = nd.array(np.ones((2, 2), np.float32))
+    t = nd.zeros((2, 2))
+    l1 = gluon.loss.L2Loss(weight=1.0)(p, t).asnumpy()
+    l3 = gluon.loss.L2Loss(weight=3.0)(p, t).asnumpy()
+    np.testing.assert_allclose(l3, 3 * l1, rtol=1e-6)
+
+
+def test_loss_gradient_trains():
+    """A linear model under each loss must reduce it (gradient sanity,
+    reference test_loss convergence checks)."""
+    rng = RNG(6)
+    X = rng.randn(32, 4).astype(np.float32)
+    Y = (X @ rng.randn(4).astype(np.float32))[:, None]
+    for loss_fn in [gluon.loss.L2Loss(), gluon.loss.L1Loss()]:
+        w = nd.array(rng.randn(1, 4).astype(np.float32) * 0.1)
+        w.attach_grad()
+        hist = []
+        for _ in range(40):
+            with ag.record():
+                pred = nd.FullyConnected(nd.array(X), w, no_bias=True,
+                                         num_hidden=1)
+                l = loss_fn(pred, nd.array(Y))
+                s = nd.sum(l)
+            s.backward()
+            hist.append(float(s.asnumpy()))
+            w -= 0.02 * w.grad
+            w.grad[:] = 0
+        assert hist[-1] < hist[0] * 0.5, type(loss_fn).__name__

@@ -129,3 +129,71 @@ def test_custom_op_dtype_follows_infer_type():
     out = nd.Custom(x, op_type='argmax_dtype_test')
     assert out.asnumpy().dtype == np.int32
     np.testing.assert_array_equal(out.asnumpy(), [1, 0])
+
+
+def test_torch_bridge_predict_mode_gradients():
+    """Regression: record(train_mode=False) must still backprop, with
+    the module in eval mode (running stats untouched)."""
+    bn = torch.nn.BatchNorm1d(3)
+    seq = torch.nn.Sequential(torch.nn.Linear(3, 3), bn)
+    bridge = TorchModule(seq)
+    x = nd.array(np.random.RandomState(1).randn(4, 3).astype(np.float32))
+    x.attach_grad()
+    before = int(bn.num_batches_tracked)
+    with ag.record(train_mode=False):
+        y = bridge(x)
+        s = nd.sum(y * y)
+    s.backward()
+    assert int(bn.num_batches_tracked) == before   # eval mode: no update
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_torch_bridge_inference_eval_mode():
+    """Plain inference runs the module in eval mode (deterministic
+    dropout, running-stat BN)."""
+    drop = torch.nn.Dropout(0.9)
+    bridge = TorchModule(drop)
+    x = nd.array(np.ones((4, 8), np.float32))
+    a = bridge(x).asnumpy()
+    b = bridge(x).asnumpy()
+    np.testing.assert_allclose(a, np.ones((4, 8)))   # identity in eval
+    np.testing.assert_allclose(a, b)
+
+
+def test_torch_bridge_int_output_dtype():
+    class ArgMaxMod(torch.nn.Module):
+        def forward(self, x):
+            return x.argmax(1)
+
+    bridge = TorchModule(ArgMaxMod())
+    x = nd.array(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+    out = bridge(x)
+    assert np.issubdtype(out.asnumpy().dtype, np.integer)
+    np.testing.assert_array_equal(out.asnumpy(), [1, 0])
+
+
+def test_custom_op_aux_states():
+    import mxnet_tpu.operator as op_mod
+
+    class Counter(op_mod.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            aux[0][:] = aux[0] + 1.0
+            self.assign(out_data[0], req[0], in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], out_grad[0])
+
+    @op_mod.register('aux_counter_test')
+    class CounterProp(op_mod.CustomOpProp):
+        def list_auxiliary_states(self):
+            return ['count']
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], [[1]]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return Counter()
+
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    out = nd.Custom(x, op_type='aux_counter_test')
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
