@@ -41,8 +41,11 @@ class CustomOpProp:
         self.need_top_grad_ = need_top_grad
 
     def infer_shape(self, in_shape):
-        return in_shape, [in_shape[0]] * len(self.list_outputs()), \
-            [] if not self.list_auxiliary_states() else []
+        """Default: every output shaped like input 0, no aux shapes —
+        a prop declaring auxiliary states must override this (the
+        reference's default also cannot derive aux shapes,
+        operator.py:108)."""
+        return in_shape, [in_shape[0]] * len(self.list_outputs()), []
 
     def infer_type(self, in_type):
         return in_type, [in_type[0]] * len(self.list_outputs()), \
@@ -151,13 +154,18 @@ def _custom_fn(attrs, *arrays):
     op_type = attrs['op_type']
     prop = _CUSTOM_OPS[op_type]()
     in_nd = [NDArray(a, None) for a in arrays]
-    out_shapes = prop.infer_shape([list(a.shape) for a in arrays])[1]
-    out_nd = [zeros(tuple(s)) for s in out_shapes]
-    op = prop.create_operator(None, [a.shape for a in arrays],
-                              [a.dtype for a in arrays])
+    _, out_shapes, aux_shapes = prop.infer_shape(
+        [list(a.shape) for a in arrays])
+    in_types = [a.dtype for a in arrays]
+    _, out_types, aux_types = prop.infer_type(in_types)
+    out_nd = [zeros(tuple(s), dtype=t)
+              for s, t in zip(out_shapes, out_types)]
+    aux = [zeros(tuple(s), dtype=t)
+           for s, t in zip(aux_shapes or [], aux_types or [])]
+    op = prop.create_operator(None, [a.shape for a in arrays], in_types)
     op.forward(is_train=attrs.get('__is_train__', False),
                req=['write'] * len(out_nd), in_data=in_nd, out_data=out_nd,
-               aux=[])
+               aux=aux)
     if len(out_nd) == 1:
         return out_nd[0]._data
     return tuple(o._data for o in out_nd)

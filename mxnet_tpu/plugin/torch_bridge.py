@@ -51,17 +51,24 @@ class _TorchOp(CustomOp):
         self._saved = None
 
     def forward(self, is_train, req, in_data, out_data, aux):
+        from .. import autograd as _ag
+
         tins = [_to_torch(x) for x in in_data]
-        # the torch graph is built regardless of is_train: the tape may
-        # record in predict mode too (record(train_mode=False) — e.g.
-        # saliency maps), and backward needs the saved graph either way
-        for i, t in enumerate(tins):
-            if self._mask is None or self._mask[i]:
-                t.requires_grad_(True)
         if self._module is not None:
             self._module.train(bool(is_train))
-        out = self._fn(*tins)
-        self._saved = (tins, out)
+        # build the torch graph iff the mxnet tape is recording (covers
+        # record(train_mode=False) saliency-style gradients too); plain
+        # inference takes the cheap no_grad path
+        if _ag.is_recording():
+            for i, t in enumerate(tins):
+                if (self._mask is None or self._mask[i]) \
+                        and t.is_floating_point():
+                    t.requires_grad_(True)
+            out = self._fn(*tins)
+            self._saved = (tins, out)
+        else:
+            with _torch.no_grad():
+                out = self._fn(*tins)
         self.assign(out_data[0], req[0], nd_array(out.detach().numpy()))
 
     def backward(self, req, out_grad, in_data, out_data, in_grad, aux):

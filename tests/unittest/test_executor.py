@@ -1,0 +1,117 @@
+"""Executor API coverage.
+
+Reference: tests/python/unittest/test_executor.py (bind/simple_bind,
+reshape, grad_req modes, shared outputs) and test_multi_device_exec.py
+patterns.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RNG = np.random.RandomState
+
+
+def _net():
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, name='fc', num_hidden=4)
+    return mx.sym.Activation(fc, act_type='tanh', name='act')
+
+
+def test_simple_bind_and_dicts():
+    ex = _net().simple_bind(mx.cpu(), data=(2, 3))
+    assert set(ex.arg_dict) == {'data', 'fc_weight', 'fc_bias'}
+    assert ex.arg_dict['fc_weight'].shape == (4, 3)
+    assert set(ex.grad_dict) == set(ex.arg_dict)
+    ex.arg_dict['data'][:] = 1.0
+    out = ex.forward()[0]
+    assert out.shape == (2, 4)
+    assert 'act_output' in ex.output_dict
+
+
+def test_forward_with_kwargs_updates_inputs():
+    ex = _net().simple_bind(mx.cpu(), data=(2, 3))
+    rng = RNG(0)
+    ex.arg_dict['fc_weight'][:] = rng.randn(4, 3).astype(np.float32)
+    a = rng.randn(2, 3).astype(np.float32)
+    out1 = ex.forward(data=nd.array(a))[0].asnumpy()
+    out2 = ex.forward(data=nd.array(2 * a))[0].asnumpy()
+    assert not np.allclose(out1, out2)
+
+
+def test_grad_req_null_and_add():
+    x = mx.sym.Variable('x')
+    y = mx.sym.sum(x * x)
+    # null: no gradient computed
+    exn = y.simple_bind(mx.cpu(), x=(2,), grad_req='null')
+    exn.forward(is_train=True)
+    exn.backward()
+    # add: accumulates across backwards
+    exa = y.simple_bind(mx.cpu(), x=(2,), grad_req='add')
+    exa.arg_dict['x'][:] = np.array([1.0, 2.0], np.float32)
+    for _ in range(2):
+        exa.forward(is_train=True)
+        exa.backward()
+    np.testing.assert_allclose(exa.grad_dict['x'].asnumpy(),
+                               2 * 2 * np.array([1.0, 2.0]), rtol=1e-5)
+
+
+def test_reshape_preserves_params():
+    ex = _net().simple_bind(mx.cpu(), data=(2, 3))
+    rng = RNG(1)
+    w = rng.randn(4, 3).astype(np.float32)
+    ex.arg_dict['fc_weight'][:] = w
+    ex2 = ex.reshape(data=(5, 3))
+    assert ex2.arg_dict['data'].shape == (5, 3)
+    np.testing.assert_allclose(ex2.arg_dict['fc_weight'].asnumpy(), w)
+    out = ex2.forward(data=nd.array(rng.randn(5, 3).astype(np.float32)))[0]
+    assert out.shape == (5, 4)
+
+
+def test_copy_params_from():
+    ex = _net().simple_bind(mx.cpu(), data=(2, 3))
+    rng = RNG(2)
+    w = nd.array(rng.randn(4, 3).astype(np.float32))
+    b = nd.array(rng.randn(4).astype(np.float32))
+    ex.copy_params_from({'fc_weight': w, 'fc_bias': b})
+    np.testing.assert_allclose(ex.arg_dict['fc_weight'].asnumpy(),
+                               w.asnumpy())
+    with pytest.raises(ValueError):
+        ex.copy_params_from({'not_a_param': w})
+    ex.copy_params_from({'not_a_param': w}, allow_extra_params=True)
+
+
+def test_backward_matches_numeric():
+    ex = _net().simple_bind(mx.cpu(), data=(3, 3))
+    rng = RNG(3)
+    for name in ex.arg_dict:
+        ex.arg_dict[name][:] = rng.randn(
+            *ex.arg_dict[name].shape).astype(np.float32) * 0.5
+    ex.forward(is_train=True)
+    ex.backward(out_grads=nd.ones((3, 4)))
+    # numeric check on the bias
+    eps = 1e-3
+    b = ex.arg_dict['fc_bias'].asnumpy().copy()
+    grads = []
+    for i in range(4):
+        for sgn in (+1, -1):
+            bb = b.copy()
+            bb[i] += sgn * eps
+            ex.arg_dict['fc_bias'][:] = bb
+            out = ex.forward(is_train=False)[0].asnumpy().sum()
+            grads.append(out)
+    num = [(grads[2 * i] - grads[2 * i + 1]) / (2 * eps) for i in range(4)]
+    np.testing.assert_allclose(ex.grad_dict['fc_bias'].asnumpy(), num,
+                               rtol=0.05, atol=1e-3)
+
+
+def test_multi_output_executor():
+    x = mx.sym.Variable('x')
+    g = mx.sym.Group([x * 2, x + 1, mx.sym.sum(x)])
+    ex = g.bind(mx.cpu(), {'x': nd.array(np.array([1.0, 2.0], np.float32))})
+    outs = ex.forward()
+    assert len(outs) == 3
+    np.testing.assert_allclose(outs[0].asnumpy(), [2.0, 4.0])
+    np.testing.assert_allclose(outs[1].asnumpy(), [2.0, 3.0])
+    np.testing.assert_allclose(float(outs[2].asnumpy()), 3.0)

@@ -1,0 +1,96 @@
+"""Gluon data pipeline (reference tests/python/unittest/
+test_gluon_data.py): datasets, samplers, DataLoader batching,
+transforms, RecordFileDataset.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, recordio
+
+
+def test_array_dataset_and_len():
+    X = np.arange(20, dtype=np.float32).reshape(10, 2)
+    y = np.arange(10, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(nd.array(X), nd.array(y))
+    assert len(ds) == 10
+    xi, yi = ds[3]
+    np.testing.assert_allclose(xi.asnumpy(), X[3])
+    # 1-D label arrays index to host scalars (reference dataset.py:63)
+    assert float(yi) == 3.0
+
+
+def test_simple_dataset_transform():
+    ds = gluon.data.SimpleDataset(list(range(6)))
+    doubled = ds.transform(lambda x: 2 * x)
+    assert [doubled[i] for i in range(6)] == [0, 2, 4, 6, 8, 10]
+    first = ds.transform_first(lambda x: x + 100)
+    assert first[2] == 102
+
+
+def test_samplers():
+    seq = list(gluon.data.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(gluon.data.RandomSampler(5))
+    assert sorted(rnd) == [0, 1, 2, 3, 4]
+    bs = list(gluon.data.BatchSampler(gluon.data.SequentialSampler(5), 2,
+                                      last_batch='keep'))
+    assert bs == [[0, 1], [2, 3], [4]]
+    bd = list(gluon.data.BatchSampler(gluon.data.SequentialSampler(5), 2,
+                                      last_batch='discard'))
+    assert bd == [[0, 1], [2, 3]]
+    br = list(gluon.data.BatchSampler(gluon.data.SequentialSampler(5), 2,
+                                      last_batch='rollover'))
+    assert br == [[0, 1], [2, 3]]
+
+
+def test_dataloader_batches():
+    X = np.arange(24, dtype=np.float32).reshape(12, 2)
+    y = np.arange(12, dtype=np.float32)
+    ds = gluon.data.ArrayDataset(nd.array(X), nd.array(y))
+    loader = gluon.data.DataLoader(ds, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 3
+    bx, by = batches[0]
+    assert bx.shape == (4, 2) and by.shape == (4,)
+    got = np.concatenate([b[0].asnumpy() for b in batches])
+    np.testing.assert_allclose(got, X)
+
+
+def test_dataloader_shuffle_covers_all():
+    X = np.arange(16, dtype=np.float32)
+    ds = gluon.data.SimpleDataset([nd.array(np.array([v])) for v in X])
+    loader = gluon.data.DataLoader(ds, batch_size=4, shuffle=True)
+    got = np.sort(np.concatenate([b.asnumpy().ravel() for b in loader]))
+    np.testing.assert_allclose(got, X)
+
+
+def test_record_file_dataset():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, 'data.rec')
+        idx = os.path.join(d, 'data.idx')
+        w = recordio.MXIndexedRecordIO(idx, path, 'w')
+        payloads = [b'alpha', b'beta', b'gamma']
+        for i, p in enumerate(payloads):
+            w.write_idx(i, p)
+        w.close()
+        ds = gluon.data.RecordFileDataset(path)
+        assert len(ds) == 3
+        assert ds[1] == b'beta' or bytes(ds[1]) == b'beta'
+
+
+def test_vision_mnist_synthetic():
+    """Vision datasets fall back to deterministic synthetic data when
+    offline (this image has zero egress)."""
+    with tempfile.TemporaryDirectory() as d:
+        ds = gluon.data.vision.MNIST(root=d, train=False)
+        img, label = ds[0]
+        assert tuple(img.shape) == (28, 28, 1)
+        assert 0 <= int(label) <= 9
+        loader = gluon.data.DataLoader(ds.transform_first(
+            lambda x: x.astype('float32') / 255.0), batch_size=16)
+        b, l = next(iter(loader))
+        assert b.shape == (16, 28, 28, 1)

@@ -197,3 +197,32 @@ def test_custom_op_aux_states():
     x = nd.array(np.array([1.0, 2.0], np.float32))
     out = nd.Custom(x, op_type='aux_counter_test')
     np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+
+
+def test_torch_bridge_integer_input_inference():
+    """Regression: integer inputs (Embedding indices) must work at
+    inference — requires_grad only applies to recording float tensors."""
+    emb = torch.nn.Embedding(10, 4)
+    bridge = TorchModule(emb)
+    idx = nd.array(np.array([1, 5, 7], np.float32)).astype('int32')
+    out = bridge(idx)
+    assert out.shape == (3, 4)
+    want = emb(torch.tensor([1, 5, 7])).detach().numpy()
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+    # and under record(): grads flow to the float path / torch params
+    x = nd.array(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        y = bridge(idx) * x
+        s = nd.sum(y)
+    s.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), want, rtol=1e-5)
+
+
+def test_custom_symbolic_aux_states():
+    """Aux plumbing works in the symbolic executor too."""
+    s = mx.sym.Custom(mx.sym.Variable('x'), op_type='aux_counter_test',
+                      num_args=1)
+    ex = s.bind(mx.cpu(), {'x': nd.array(np.array([3.0], np.float32))})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, [3.0])
