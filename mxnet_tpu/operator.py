@@ -126,46 +126,75 @@ def invoke_custom(op, inputs, out_shapes, out_dtypes=None, aux=None):
     return out_nd[0] if len(out_nd) == 1 else out_nd
 
 
-def custom_eager(*args, **kwargs):
-    """Eager nd.Custom: host execution + tape recording (installed over
-    the registry-generated wrapper in ndarray/__init__.py)."""
-    op_type = kwargs.pop('op_type')
-    kwargs.pop('name', None)
-    inputs = [a for a in args if isinstance(a, NDArray)]
-    prop = _CUSTOM_OPS[op_type](**kwargs)
+_CUSTOM_RESERVED = ('op_type', 'num_args', '__is_train__', 'name')
+
+
+def _split_aux(prop, arrays):
+    """Reference custom.cc appends aux states after the regular inputs;
+    when the caller passed them, split them off so they persist (the
+    caller owns the buffers and sees the mutations)."""
+    n_aux = len(prop.list_auxiliary_states())
+    n_args = len(prop.list_arguments())
+    if n_aux and len(arrays) == n_args + n_aux:
+        return list(arrays[:n_args]), list(arrays[n_args:])
+    return list(arrays), None
+
+
+def _infer_and_alloc(prop, inputs, aux_nd):
+    """Shared shape/type inference + buffer allocation for the eager
+    and symbolic Custom paths. Returns (out_shapes, out_types, aux)."""
     shapes = [list(a.shape) for a in inputs]
     _, out_shapes, aux_shapes = prop.infer_shape(shapes)
     in_types = [a.dtype for a in inputs]
     _, out_types, aux_types = prop.infer_type(in_types)
-    aux = [zeros(tuple(s), dtype=t)
-           for s, t in zip(aux_shapes or [], aux_types or [])]
+    if aux_nd is None:
+        # no caller-provided aux: allocate fresh (stateless per call)
+        aux_nd = [zeros(tuple(s), dtype=t)
+                  for s, t in zip(aux_shapes or [], aux_types or [])]
+    return out_shapes, out_types, aux_nd, in_types, shapes
+
+
+def custom_eager(*args, **kwargs):
+    """Eager nd.Custom: host execution + tape recording (installed over
+    the registry-generated wrapper in ndarray/__init__.py). Trailing
+    positional NDArrays beyond list_arguments() are auxiliary states
+    (reference custom.cc input layout) — caller-owned, mutated in
+    place, persistent across calls."""
+    op_type = kwargs.pop('op_type')
+    kwargs.pop('name', None)
+    arrays = [a for a in args if isinstance(a, NDArray)]
+    prop = _CUSTOM_OPS[op_type](**kwargs)
+    inputs, aux_nd = _split_aux(prop, arrays)
+    out_shapes, out_types, aux_nd, in_types, shapes = \
+        _infer_and_alloc(prop, inputs, aux_nd)
     op = prop.create_operator(None, [tuple(s) for s in shapes], in_types)
     return invoke_custom(op, inputs, out_shapes, out_dtypes=out_types,
-                         aux=aux)
+                         aux=aux_nd)
 
 
 @_reg.register('Custom', variadic=True, key_var_num_args='num_args',
-               differentiable=False)
+               differentiable=False, train_aware=True)
 def _custom_fn(attrs, *arrays):
     """Host-python bridge: executes the CustomOp eagerly via pure_callback
     is NOT used — Custom ops run outside jit in the imperative path and in
     the executor's staged mode (reference runs them on a dedicated thread,
-    custom.cc:380-405, ExecType::kLocal)."""
+    custom.cc:380-405, ExecType::kLocal). Aux states here are per-call
+    buffers (trailing inputs persist only as executor-bound arrays; true
+    in-place aux mutation needs the eager path)."""
     op_type = attrs['op_type']
-    prop = _CUSTOM_OPS[op_type]()
-    in_nd = [NDArray(a, None) for a in arrays]
-    _, out_shapes, aux_shapes = prop.infer_shape(
-        [list(a.shape) for a in arrays])
-    in_types = [a.dtype for a in arrays]
-    _, out_types, aux_types = prop.infer_type(in_types)
+    prop_kwargs = {k: v for k, v in attrs.items()
+                   if k not in _CUSTOM_RESERVED}
+    prop = _CUSTOM_OPS[op_type](**prop_kwargs)
+    in_all = [NDArray(a, None) for a in arrays]
+    inputs, aux_nd = _split_aux(prop, in_all)
+    out_shapes, out_types, aux_nd, in_types, shapes = \
+        _infer_and_alloc(prop, inputs, aux_nd)
     out_nd = [zeros(tuple(s), dtype=t)
               for s, t in zip(out_shapes, out_types)]
-    aux = [zeros(tuple(s), dtype=t)
-           for s, t in zip(aux_shapes or [], aux_types or [])]
-    op = prop.create_operator(None, [a.shape for a in arrays], in_types)
+    op = prop.create_operator(None, [tuple(s) for s in shapes], in_types)
     op.forward(is_train=attrs.get('__is_train__', False),
-               req=['write'] * len(out_nd), in_data=in_nd, out_data=out_nd,
-               aux=aux)
+               req=['write'] * len(out_nd), in_data=inputs, out_data=out_nd,
+               aux=aux_nd)
     if len(out_nd) == 1:
         return out_nd[0]._data
     return tuple(o._data for o in out_nd)

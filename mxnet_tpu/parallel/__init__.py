@@ -25,6 +25,8 @@ from .ring_attention import (ring_attention, blockwise_attention,
                              ulysses_attention, make_ring_attention,
                              attention_reference)
 from .pipeline import PipelineStage, pipeline_apply, stack_stage_params
+from .multihost import (init_multihost, global_mesh, process_index,
+                        process_count, is_multihost)
 from .five_d import (TransformerConfig, full_mesh, make_5d_train_step,
                      make_loss_fn as make_5d_loss_fn)
 
@@ -40,4 +42,6 @@ __all__ = [
     'PipelineStage', 'pipeline_apply', 'stack_stage_params',
     'TransformerConfig', 'full_mesh', 'make_5d_train_step',
     'make_5d_loss_fn',
+    'init_multihost', 'global_mesh', 'process_index', 'process_count',
+    'is_multihost',
 ]

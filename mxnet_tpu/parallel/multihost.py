@@ -1,0 +1,109 @@
+"""Multi-host SPMD initialization — XLA collectives over ICI/DCN.
+
+Reference analog: the ps-lite bootstrap (`ps::Postoffice` role/rank
+wiring, kvstore.h:257-301) that connects MXNet workers across machines.
+The TPU-native transport is NOT a parameter server: every host joins
+one jax.distributed job, `jax.devices()` becomes the GLOBAL device
+list, and a `Mesh` laid out over it makes pjit/shard_map insert DCN/ICI
+collectives automatically (psum replaces push/pull — SURVEY §5.8).
+
+The dist kvstore tier (kvstore_dist.py) remains for reference-API
+compatibility; this module is the idiomatic path for new code:
+
+    mx.parallel.init_multihost()              # env-driven, launcher-set
+    mesh = mx.parallel.global_mesh({'dp': -1})
+    ... pjit/shard_map over mesh ...
+
+`tools/launch.py` exports MXTPU_COORDINATOR / MXTPU_NUM_HOSTS /
+MXTPU_HOST_ID for its workers, so the same launcher drives both the PS
+tier and this one.
+"""
+import os
+
+import numpy as np
+
+__all__ = ['init_multihost', 'global_mesh', 'process_index',
+           'process_count', 'local_devices', 'is_multihost']
+
+_initialized = False
+
+
+def init_multihost(coordinator_address=None, num_processes=None,
+                   process_id=None):
+    """Join (or create) a jax.distributed job.
+
+    Arguments default from the launcher env protocol:
+    ``MXTPU_COORDINATOR`` (host:port), ``MXTPU_NUM_HOSTS``,
+    ``MXTPU_HOST_ID``. With one process (or no env), this is a no-op —
+    single-host programs need no coordinator. Safe to call twice.
+    """
+    global _initialized
+    if _initialized:
+        return False
+    coordinator_address = coordinator_address or \
+        os.environ.get('MXTPU_COORDINATOR')
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get('MXTPU_NUM_HOSTS', '1'))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get('MXTPU_HOST_ID', '0'))
+    if num_processes <= 1 or not coordinator_address:
+        return False
+    import jax
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def process_index():
+    import jax
+    return jax.process_index()
+
+
+def process_count():
+    import jax
+    return jax.process_count()
+
+
+def local_devices():
+    import jax
+    return jax.local_devices()
+
+
+def is_multihost():
+    import jax
+    return jax.process_count() > 1
+
+
+def global_mesh(axes):
+    """Build a Mesh over the GLOBAL device list.
+
+    ``axes``: ordered dict/list of (name, size); one size may be -1
+    (inferred). Axis order should put the fastest-varying (ICI-local)
+    axis last so DCN only carries the leading axes — the
+    how-to-scale-your-model layout rule.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if isinstance(axes, dict):
+        items = list(axes.items())
+    else:
+        items = list(axes)
+    names = [k for k, _ in items]
+    sizes = [v for _, v in items]
+    devs = jax.devices()
+    n = len(devs)
+    if sizes.count(-1) > 1:
+        raise ValueError('at most one axis size may be -1')
+    known = int(np.prod([s for s in sizes if s != -1])) if sizes else 1
+    if -1 in sizes:
+        if n % known:
+            raise ValueError('device count %d not divisible by %d'
+                             % (n, known))
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError('mesh %r does not cover %d global devices'
+                         % (dict(zip(names, sizes)), n))
+    return Mesh(np.array(devs).reshape(sizes), tuple(names))

@@ -1,0 +1,78 @@
+"""Worker script: multi-host SPMD data parallelism over jax.distributed.
+
+Launched by tests/unittest/test_multihost.py as N local processes (the
+SURVEY §4 'real multi-process distributed runs on one machine' tier).
+Each process owns one CPU device; a global dp mesh spans processes, so
+the psum rides the gloo DCN transport — the same program shape scales
+to real multi-host TPU pods.
+
+Asserts: the globally-psummed gradient equals the analytic sum over all
+hosts' shards, and every host sees identical updated weights.
+"""
+import os
+import sys
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+from mxnet_tpu import parallel as par  # noqa: E402
+
+
+def main():
+    joined = par.init_multihost()
+    assert joined, 'env protocol missing (run under tools/launch.py)'
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
+
+    n = par.process_count()
+    rank = par.process_index()
+    mesh = par.global_mesh({'dp': -1})
+    assert mesh.devices.size == n
+
+    # per-host shard: x_i = rank+1; loss = mean over global batch of w*x
+    w = jnp.ones((4,), jnp.float32)
+    local_x = np.full((2, 4), rank + 1, np.float32)
+    gx = multihost_utils.host_local_array_to_global_array(
+        local_x, mesh, P('dp', None))
+
+    @jax.jit
+    def step(w, x):
+        def loss_fn(w):
+            return jnp.mean(jnp.sum(x * w, axis=-1))
+        l, g = jax.value_and_grad(loss_fn)(w)
+        return l, g, w - 0.1 * g
+
+    with mesh:
+        loss, grad, new_w = jax.jit(
+            step,
+            in_shardings=(NamedSharding(mesh, P()),
+                          NamedSharding(mesh, P('dp', None))),
+            out_shardings=NamedSharding(mesh, P()))(w, gx)
+
+    # replicated (P()) outputs are addressable on every host; the mean
+    # over the GLOBAL batch proves the psum crossed processes
+    want_loss = 4.0 * np.mean([r + 1 for r in range(n)])
+    got_loss = float(np.asarray(loss))
+    assert abs(got_loss - want_loss) < 1e-5, (got_loss, want_loss)
+
+    want_grad = np.full((4,), np.mean([r + 1 for r in range(n)]))
+    np.testing.assert_allclose(np.asarray(grad), want_grad, rtol=1e-6)
+
+    # every host holds the same replicated weights after the update;
+    # cross-check by allgathering a host-side digest
+    local_digest = np.asarray(new_w).sum(keepdims=True)
+    digests = np.asarray(multihost_utils.process_allgather(
+        local_digest, tiled=True)).ravel()
+    np.testing.assert_allclose(digests, np.full(n, digests[0]), rtol=1e-6)
+    print('MULTIHOST_OK rank=%d n=%d loss=%.3f' % (rank, n, got_loss),
+          flush=True)
+
+
+if __name__ == '__main__':
+    main()

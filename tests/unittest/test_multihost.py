@@ -1,0 +1,56 @@
+"""Multi-host SPMD tier: N local processes, one global mesh, DCN psum.
+
+Reference analog: tests/nightly/dist_sync_kvstore.py launched via
+tools/launch.py — here the same launcher drives the jax.distributed
+bridge (parallel/multihost.py) instead of the PS tier.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_global_mesh_single_process():
+    """Mesh inference over the local (virtual 8-device) topology."""
+    from mxnet_tpu import parallel as par
+    mesh = par.global_mesh({'dp': -1})
+    assert mesh.devices.size >= 1
+    mesh2 = par.global_mesh({'dp': 2, 'tp': -1})
+    assert mesh2.shape['dp'] == 2
+    with pytest.raises(ValueError):
+        par.global_mesh({'dp': -1, 'tp': -1})
+    with pytest.raises(ValueError):
+        par.global_mesh({'dp': 3})  # 8 % 3 != 0
+
+
+def test_init_multihost_noop_without_env():
+    from mxnet_tpu import parallel as par
+    env = {k: os.environ.pop(k, None)
+           for k in ('MXTPU_COORDINATOR', 'MXTPU_NUM_HOSTS',
+                     'MXTPU_HOST_ID')}
+    try:
+        assert par.init_multihost() is False
+    finally:
+        for k, v in env.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def test_two_process_psum_via_launcher():
+    """Real 2-process SPMD run through tools/launch.py (gloo DCN)."""
+    env = dict(os.environ)
+    env.pop('JAX_PLATFORMS', None)       # worker script forces cpu itself
+    env.pop('XLA_FLAGS', None)           # one device per process
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools', 'launch.py'),
+         '-n', '2', '--num-servers', '0', '--',
+         sys.executable, os.path.join(REPO, 'tests', 'dist',
+                                      'multihost_psum.py')],
+        capture_output=True, text=True, timeout=300, env=env)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert out.count('MULTIHOST_OK') == 2, out[-3000:]

@@ -226,3 +226,14 @@ def test_custom_symbolic_aux_states():
     ex = s.bind(mx.cpu(), {'x': nd.array(np.array([3.0], np.float32))})
     out = ex.forward()[0].asnumpy()
     np.testing.assert_allclose(out, [3.0])
+
+
+def test_custom_op_persistent_aux_states():
+    """Reference custom.cc input layout: trailing NDArrays are aux —
+    caller-owned and persistent across calls."""
+    count = nd.zeros((1,))
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    for i in range(3):
+        out = nd.Custom(x, count, op_type='aux_counter_test')
+        np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+    np.testing.assert_allclose(count.asnumpy(), [3.0])

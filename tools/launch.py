@@ -37,6 +37,10 @@ def main():
                     help='accepted for reference CLI compat; unused locally')
     ap.add_argument('command', nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    # REMAINDER keeps a leading '--' separator; drop it (reference
+    # launch.py accepts both `launch.py -n 2 cmd` and `-n 2 -- cmd`)
+    if args.command and args.command[0] == '--':
+        args.command = args.command[1:]
     if not args.command:
         ap.error('no command given')
     num_servers = (args.num_servers if args.num_servers is not None
@@ -48,6 +52,11 @@ def main():
         'DMLC_PS_ROOT_PORT': str(free_port()),
         'DMLC_NUM_WORKER': str(args.num_workers),
         'DMLC_NUM_SERVER': str(num_servers),
+        # jax.distributed bridge (parallel/multihost.py): workers can
+        # join one SPMD job with XLA collectives instead of (or beside)
+        # the PS tier
+        'MXTPU_COORDINATOR': '127.0.0.1:%d' % free_port(),
+        'MXTPU_NUM_HOSTS': str(args.num_workers),
     })
     # role processes must be able to import mxnet_tpu from any cwd
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -56,13 +65,18 @@ def main():
     role_cmd = [sys.executable, '-c', 'import mxnet_tpu']
 
     procs, workers = [], []
+    # no PS tier requested (e.g. pure jax.distributed jobs): skip the
+    # scheduler too, or workers would leave it blocking 20 s at exit
+    scheduler_count = 1 if num_servers > 0 else 0
     try:
-        for role, count, cmd in [('scheduler', 1, role_cmd),
+        for role, count, cmd in [('scheduler', scheduler_count, role_cmd),
                                  ('server', num_servers, role_cmd),
                                  ('worker', args.num_workers, args.command)]:
             for i in range(count):
                 env = dict(base_env)
                 env['DMLC_ROLE'] = role
+                if role == 'worker':
+                    env['MXTPU_HOST_ID'] = str(i)
                 p = subprocess.Popen(cmd, env=env)
                 procs.append(p)
                 if role == 'worker':
