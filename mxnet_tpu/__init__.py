@@ -42,6 +42,7 @@ from . import module as mod
 from . import rnn
 from . import gluon
 from . import monitor
+from . import monitor as mon  # reference __init__.py:62 alias
 from .monitor import Monitor
 from . import profiler
 from . import visualization

@@ -149,6 +149,16 @@ flags.declare('MXTPU_PROFILER_AUTOSTART', bool, False,
               'Start the profiler at init (reference '
               'MXNET_PROFILER_AUTOSTART)',
               aliases=('MXNET_PROFILER_AUTOSTART',))
+flags.declare('MXTPU_COORDINATOR', str, '',
+              'host:port of the jax.distributed coordinator for the '
+              'multi-host SPMD tier (set by tools/launch.py; the DCN '
+              'analog of DMLC_PS_ROOT_URI/PORT)')
+flags.declare('MXTPU_NUM_HOSTS', int, 1,
+              'Process count of the multi-host SPMD job '
+              '(DMLC_NUM_WORKER analog)', min_value=1)
+flags.declare('MXTPU_HOST_ID', int, 0,
+              'This process\'s rank in the multi-host SPMD job',
+              min_value=0)
 
 
 # ---- dmlc::Parameter analog ----------------------------------------------

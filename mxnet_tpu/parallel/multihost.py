@@ -18,8 +18,6 @@ compatibility; this module is the idiomatic path for new code:
 MXTPU_HOST_ID for its workers, so the same launcher drives both the PS
 tier and this one.
 """
-import os
-
 import numpy as np
 
 __all__ = ['init_multihost', 'global_mesh', 'process_index',
@@ -40,12 +38,16 @@ def init_multihost(coordinator_address=None, num_processes=None,
     global _initialized
     if _initialized:
         return False
+    from ..config import flags
+    flags.reload('MXTPU_COORDINATOR')
+    flags.reload('MXTPU_NUM_HOSTS')
+    flags.reload('MXTPU_HOST_ID')
     coordinator_address = coordinator_address or \
-        os.environ.get('MXTPU_COORDINATOR')
+        flags.get('MXTPU_COORDINATOR')
     num_processes = num_processes if num_processes is not None else \
-        int(os.environ.get('MXTPU_NUM_HOSTS', '1'))
+        flags.get('MXTPU_NUM_HOSTS')
     process_id = process_id if process_id is not None else \
-        int(os.environ.get('MXTPU_HOST_ID', '0'))
+        flags.get('MXTPU_HOST_ID')
     if num_processes <= 1 or not coordinator_address:
         return False
     import jax

@@ -41,7 +41,6 @@ def main():
     gx = multihost_utils.host_local_array_to_global_array(
         local_x, mesh, P('dp', None))
 
-    @jax.jit
     def step(w, x):
         def loss_fn(w):
             return jnp.mean(jnp.sum(x * w, axis=-1))

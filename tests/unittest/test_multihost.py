@@ -40,6 +40,7 @@ def test_init_multihost_noop_without_env():
                 os.environ[k] = v
 
 
+@pytest.mark.slow
 def test_two_process_psum_via_launcher():
     """Real 2-process SPMD run through tools/launch.py (gloo DCN)."""
     env = dict(os.environ)

@@ -172,28 +172,31 @@ def test_torch_bridge_int_output_dtype():
     np.testing.assert_array_equal(out.asnumpy(), [1, 0])
 
 
+import mxnet_tpu.operator as op_mod
+
+
+class _Counter(op_mod.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        aux[0][:] = aux[0] + 1.0
+        self.assign(out_data[0], req[0], in_data[0])
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        self.assign(in_grad[0], req[0], out_grad[0])
+
+
+@op_mod.register('aux_counter_test')
+class _CounterProp(op_mod.CustomOpProp):
+    def list_auxiliary_states(self):
+        return ['count']
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], [[1]]
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return _Counter()
+
+
 def test_custom_op_aux_states():
-    import mxnet_tpu.operator as op_mod
-
-    class Counter(op_mod.CustomOp):
-        def forward(self, is_train, req, in_data, out_data, aux):
-            aux[0][:] = aux[0] + 1.0
-            self.assign(out_data[0], req[0], in_data[0])
-
-        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
-            self.assign(in_grad[0], req[0], out_grad[0])
-
-    @op_mod.register('aux_counter_test')
-    class CounterProp(op_mod.CustomOpProp):
-        def list_auxiliary_states(self):
-            return ['count']
-
-        def infer_shape(self, in_shape):
-            return in_shape, [in_shape[0]], [[1]]
-
-        def create_operator(self, ctx, shapes, dtypes):
-            return Counter()
-
     x = nd.array(np.array([1.0, 2.0], np.float32))
     out = nd.Custom(x, op_type='aux_counter_test')
     np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
