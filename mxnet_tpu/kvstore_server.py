@@ -65,6 +65,7 @@ class Scheduler:
         self._finalized = 0
         self._threads = []
         self._beats = {}    # (role, rank) -> last heartbeat time
+        self._start_time = time.time()
         self._done = threading.Event()
 
     def run(self):
@@ -126,8 +127,11 @@ class Scheduler:
     def _num_dead(self, node_id, timeout):
         """Count nodes in the masked groups whose heartbeat is stale.
 
-        A registered node that never opened its aux channel counts as
-        dead once the query arrives (it should have connected at init)."""
+        Heartbeats are seeded at node registration; a node that never
+        arrived at all is measured from scheduler start. Either way a
+        node becomes dead after ``timeout`` seconds of silence, never
+        instantly (a query racing cluster startup must not report
+        phantom dead nodes)."""
         now = time.time()
         dead = 0
         with self._lock:
@@ -138,8 +142,8 @@ class Scheduler:
                 groups.append(('server', self.num_servers))
             for role, count in groups:
                 for rank in range(count):
-                    beat = self._beats.get((role, rank))
-                    if beat is None or now - beat > timeout:
+                    beat = self._beats.get((role, rank), self._start_time)
+                    if now - beat > timeout:
                         dead += 1
         return dead
 
@@ -153,6 +157,10 @@ class Scheduler:
         with self._lock:
             rank = len(self._registered[role])
             self._registered[role].append((conn, addr))
+            # registration seeds the heartbeat: the grace period for
+            # failure detection starts per node when it arrives, so a
+            # slow rendezvous never yields phantom dead nodes
+            self._beats[(role, rank)] = time.time()
             done = (len(self._registered['worker']) == self.num_workers and
                     len(self._registered['server']) == self.num_servers)
         if done:

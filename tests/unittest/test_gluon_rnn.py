@@ -1,0 +1,107 @@
+"""Gluon recurrent API depth (reference tests/python/unittest/
+test_gluon_rnn.py): cell-vs-layer equivalence, unroll, hybridize,
+bidirectional, stacking.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+import mxnet_tpu.autograd as ag
+from mxnet_tpu import gluon, nd
+
+B, T, D, H = 3, 4, 5, 6
+RNG = np.random.RandomState
+
+
+def test_lstm_cell_unroll_shapes_and_grad():
+    cell = gluon.rnn.LSTMCell(H, input_size=D)
+    cell.initialize()
+    x = nd.array(RNG(0).randn(B, T, D).astype(np.float32))
+    x.attach_grad()
+    with ag.record():
+        outputs, states = cell.unroll(T, x, layout='NTC',
+                                      merge_outputs=True)
+        loss = nd.sum(outputs)
+    loss.backward()
+    assert outputs.shape == (B, T, H)
+    assert len(states) == 2
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+
+
+def test_cell_layer_equivalence_lstm():
+    """An LSTM layer must equal its cell unrolled, given shared
+    weights (reference test_gluon_rnn.py check_rnn_layer pattern)."""
+    layer = gluon.rnn.LSTM(H, num_layers=1, layout='NTC', input_size=D)
+    layer.initialize()
+    x = nd.array(RNG(1).randn(B, T, D).astype(np.float32))
+    out_layer = layer(x).asnumpy()
+
+    cell = gluon.rnn.LSTMCell(H, input_size=D)
+    cell.initialize()
+    # pack the cell's split matrices into the layer's fused flat vector
+    # (cuDNN canonical order, ops/rnn_ops.py: all W/R first, then all
+    # biases; gate order [i, f, g, o] matches the cell's)
+    cp = {k.split('_', 1)[1]: v.data().asnumpy()
+          for k, v in cell.collect_params().items()}
+    flat = np.concatenate([cp['i2h_weight'].ravel(),
+                           cp['h2h_weight'].ravel(),
+                           cp['i2h_bias'], cp['h2h_bias']])
+    lname = list(layer.collect_params())[0]
+    layer.collect_params()[lname].set_data(nd.array(flat))
+    out_layer = layer(x).asnumpy()
+    out_cell, _ = cell.unroll(T, x, layout='NTC', merge_outputs=True)
+    np.testing.assert_allclose(out_layer, out_cell.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bidirectional_layer_shape():
+    layer = gluon.rnn.GRU(H, num_layers=2, bidirectional=True,
+                          layout='NTC', input_size=D)
+    layer.initialize()
+    x = nd.array(RNG(2).randn(B, T, D).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (B, T, 2 * H)
+
+
+def test_layer_with_explicit_states():
+    layer = gluon.rnn.LSTM(H, num_layers=1, layout='NTC', input_size=D)
+    layer.initialize()
+    x = nd.array(RNG(3).randn(B, T, D).astype(np.float32))
+    begin = layer.begin_state(batch_size=B)
+    out, states = layer(x, begin)
+    assert out.shape == (B, T, H)
+    assert states[0].shape == (1, B, H)
+    # feeding states back continues the sequence
+    out2, _ = layer(x, states)
+    assert not np.allclose(out.asnumpy(), out2.asnumpy())
+
+
+def test_sequential_stack_and_dropout_cell():
+    stack = gluon.rnn.SequentialRNNCell()
+    stack.add(gluon.rnn.LSTMCell(H, input_size=D))
+    stack.add(gluon.rnn.DropoutCell(0.0))
+    stack.add(gluon.rnn.GRUCell(H, input_size=H))
+    stack.initialize()
+    x = nd.array(RNG(4).randn(B, T, D).astype(np.float32))
+    out, states = stack.unroll(T, x, layout='NTC', merge_outputs=True)
+    assert out.shape == (B, T, H)
+
+
+def test_hybridized_cell_matches_eager():
+    cell = gluon.rnn.GRUCell(H, input_size=D)
+    cell.initialize()
+    x = nd.array(RNG(5).randn(B, D).astype(np.float32))
+    states = cell.begin_state(batch_size=B)
+    out_eager, _ = cell(x, states)
+    cell.hybridize()
+    out_hyb, _ = cell(x, states)
+    np.testing.assert_allclose(out_eager.asnumpy(), out_hyb.asnumpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tnc_layout():
+    layer = gluon.rnn.RNN(H, num_layers=1, layout='TNC', input_size=D)
+    layer.initialize()
+    x = nd.array(RNG(6).randn(T, B, D).astype(np.float32))
+    out = layer(x)
+    assert out.shape == (T, B, H)
