@@ -6,9 +6,12 @@ NDArray ops dispatch through cached jit closures, Symbol.bind compiles whole
 graphs into single XLA computations, KVStore lowers to mesh collectives.
 See SURVEY.md for the layer map this follows.
 """
-__version__ = '0.1.0'
+from .libinfo import __version__  # noqa: F401  (single version source)
 
 from . import base
+from . import libinfo
+from . import log
+from . import name
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, num_gpus
 from . import ndarray

@@ -1,0 +1,32 @@
+"""Library locator + version (reference python/mxnet/libinfo.py —
+the single source of the version, imported by __init__).
+
+find_lib_path() resolves the native runtime libraries this framework
+builds: the C ABI `libmxnet_tpu.so` (lib/) and the runtime
+`libmxtpu.so` (built on demand by _native.py next to the package).
+"""
+import os
+
+__all__ = ['find_lib_path', '__version__']
+
+__version__ = '0.1.0'
+
+
+def find_lib_path():
+    """Paths of the native libraries that exist on disk, C ABI first
+    (reference returns the mxnet shared library path list; raises if
+    nothing is found and MXTPU_LIBRARY_PATH doesn't point anywhere)."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    candidates = [
+        os.environ.get('MXTPU_LIBRARY_PATH', ''),
+        os.path.join(repo, 'lib', 'libmxnet_tpu.so'),
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     'libmxtpu.so'),  # _native.py's build target (_SO)
+    ]
+    found = [p for p in candidates if p and os.path.isfile(p)]
+    if not found:
+        raise RuntimeError(
+            'no native library found; mxnet_tpu._native.get_lib() '
+            'builds the runtime on demand, or set MXTPU_LIBRARY_PATH '
+            '(searched: %s)' % [p for p in candidates if p])
+    return found

@@ -1,0 +1,34 @@
+"""Logging helper (reference python/mxnet/log.py get_logger: a logger
+with the reference's level-letter/timestamp format, to stderr or file).
+"""
+import logging
+import sys
+
+__all__ = ['get_logger']
+
+_FORMAT = '%(asctime)s [%(levelname).1s] %(name)s: %(message)s'
+_DATEFMT = '%m%d %H:%M:%S'
+
+
+class _Formatter(logging.Formatter):
+    def __init__(self):
+        super().__init__(_FORMAT, _DATEFMT)
+
+
+def get_logger(name=None, filename=None, filemode=None, level=logging.WARNING):
+    """Get a configured logger (reference log.py:48). ``filename``
+    routes to a file (mode ``filemode``, default 'a'); otherwise
+    stderr. Repeated calls reconfigure the level only."""
+    logger = logging.getLogger(name)
+    if getattr(logger, '_mxtpu_init', False):
+        logger.setLevel(level)
+        return logger
+    if filename:
+        handler = logging.FileHandler(filename, filemode or 'a')
+    else:
+        handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(_Formatter())
+    logger.addHandler(handler)
+    logger.setLevel(level)
+    logger._mxtpu_init = True
+    return logger

@@ -1,0 +1,9 @@
+"""Automatic naming for the symbolic API — module-path parity shim.
+
+Reference: python/mxnet/name.py (NameManager/Prefix). The
+implementations live in attribute.py beside AttrScope (one scope
+stack); this module keeps the reference's import path working.
+"""
+from .attribute import NameManager, Prefix
+
+__all__ = ['NameManager', 'Prefix']

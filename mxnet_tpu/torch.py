@@ -1,0 +1,10 @@
+"""Torch interop — module-path parity for the reference's mx.torch.
+
+Reference: python/mxnet/torch.py exposed the Lua-torch op bridge
+(plugin/torch). The modern equivalent wraps **pytorch** modules and
+criteria as differentiable operators; see
+:mod:`mxnet_tpu.plugin.torch_bridge` for the implementation.
+"""
+from .plugin.torch_bridge import TorchModule, TorchCriterion
+
+__all__ = ['TorchModule', 'TorchCriterion']

@@ -118,3 +118,27 @@ class TestParameter:
 
         c = Child(a=5)
         assert c.a == 5 and c.b == 2
+
+
+def test_libinfo_log_name_modules():
+    """Module-path parity: libinfo/log/name (reference python/mxnet/)."""
+    import logging
+    import mxnet_tpu.libinfo as libinfo
+    import mxnet_tpu.log as log
+    import mxnet_tpu.name as name_mod
+    import mxnet_tpu as mx
+
+    paths = libinfo.find_lib_path()
+    assert paths and all(p.endswith('.so') for p in paths)
+    assert libinfo.__version__ == mx.__version__
+
+    logger = log.get_logger('mxtpu_test_logger', level=logging.INFO)
+    assert logger.level == logging.INFO
+    logger2 = log.get_logger('mxtpu_test_logger', level=logging.DEBUG)
+    assert logger2 is logger and logger.level == logging.DEBUG
+    assert len(logger.handlers) == 1          # no handler duplication
+
+    assert name_mod.NameManager is mx.attribute.NameManager
+    with name_mod.Prefix('pfx_'):
+        s = mx.sym.FullyConnected(mx.sym.Variable('d'), num_hidden=2)
+        assert s.name.startswith('pfx_')
