@@ -11,6 +11,9 @@ from . import register as _register
 from .utils import save, load
 from . import random  # noqa: F401
 from . import sparse  # noqa: F401
+from . import linalg  # noqa: F401
+from . import op  # noqa: F401  (generated-op module path)
+from . import _internal  # noqa: F401
 from .sparse import csr_matrix, row_sparse_array
 
 _register.install_ops(globals())

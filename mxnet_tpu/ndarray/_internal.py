@@ -1,0 +1,8 @@
+"""mx.nd._internal — underscore-prefixed operator namespace
+(reference python/mxnet/ndarray/_internal.py). Lazily generated.
+"""
+from ..ops.registry import lazy_op_module
+from .register import make_nd_function
+
+__getattr__, __dir__ = lazy_op_module(globals(), make_nd_function,
+                                      underscore_only=True)

@@ -1,0 +1,21 @@
+"""mx.nd.linalg — advanced linear algebra namespace.
+
+Reference: python/mxnet/ndarray/linalg.py (generated from the
+``linalg_*`` operator family, src/operator/tensor/la_op.cc); the short
+names are the registry ops' generated wrappers, so positional scalar
+params and ``out=`` behave like every other nd function.
+"""
+from . import register as _register
+
+__all__ = ['gemm', 'gemm2', 'potrf', 'potri', 'trmm', 'trsm', 'syrk',
+           'gelqf', 'sumlogdiag']
+
+gemm = _register.make_nd_function('linalg_gemm')
+gemm2 = _register.make_nd_function('linalg_gemm2')
+potrf = _register.make_nd_function('linalg_potrf')
+potri = _register.make_nd_function('linalg_potri')
+trmm = _register.make_nd_function('linalg_trmm')
+trsm = _register.make_nd_function('linalg_trsm')
+syrk = _register.make_nd_function('linalg_syrk')
+gelqf = _register.make_nd_function('linalg_gelqf')
+sumlogdiag = _register.make_nd_function('linalg_sumlogdiag')

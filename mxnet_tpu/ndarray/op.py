@@ -1,0 +1,10 @@
+"""mx.nd.op — the generated-operator module path.
+
+Reference: python/mxnet/ndarray/op.py (where _make_ndarray_function
+installs the generated wrappers; the public names are re-exported into
+mx.nd). Any registered op resolves lazily.
+"""
+from ..ops.registry import lazy_op_module
+from .register import make_nd_function
+
+__getattr__, __dir__ = lazy_op_module(globals(), make_nd_function)

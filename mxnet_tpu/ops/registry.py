@@ -112,6 +112,24 @@ def _jitted_impl(name, akey):
     return jax.jit(f)
 
 
+def lazy_op_module(module_globals, make_fn, underscore_only=False):
+    """Build (__getattr__, __dir__) for a generated-op module path
+    (nd/sym ``op`` and ``_internal`` — reference ndarray/op.py etc.).
+    Resolved functions are cached into the module's globals."""
+    def __getattr__(name):
+        if exists(name):
+            fn = make_fn(name)
+            module_globals[name] = fn
+            return fn
+        raise AttributeError('operator %r is not registered' % (name,))
+
+    def __dir__():
+        ops = list_ops()
+        return [n for n in ops if n.startswith('_')] \
+            if underscore_only else ops
+    return __getattr__, __dir__
+
+
 def jitted(name, attrs):
     """Cached jit-compiled closure for (op, attrs). jax.jit adds the
     shape/dtype-keyed cache on top — together these are the CachedOp

@@ -18,6 +18,11 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype='float32', **kwargs):
                                                   repeat=repeat, dtype=dtype, **kwargs)
 
 from . import contrib  # noqa: E402,F401  (mx.sym.contrib.*)
+from . import linalg    # noqa: E402,F401  (mx.sym.linalg.*)
+from . import random    # noqa: E402,F401  (mx.sym.random.*)
+from . import sparse    # noqa: E402,F401  (mx.sym.sparse.*)
+from . import op        # noqa: E402,F401  (generated-op module path)
+from . import _internal  # noqa: E402,F401
 
 
 def __getattr__(name):

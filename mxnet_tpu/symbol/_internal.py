@@ -1,0 +1,8 @@
+"""mx.sym._internal — underscore-prefixed symbolic operator namespace
+(reference python/mxnet/symbol/_internal.py). Lazily generated.
+"""
+from ..ops.registry import lazy_op_module
+from .register import make_sym_function
+
+__getattr__, __dir__ = lazy_op_module(globals(), make_sym_function,
+                                      underscore_only=True)

@@ -1,0 +1,29 @@
+"""mx.sym.linalg — symbolic linear-algebra namespace (reference
+python/mxnet/symbol/linalg.py over the ``linalg_*`` family).
+"""
+from . import register as _register
+
+__all__ = ['gemm', 'gemm2', 'potrf', 'potri', 'trmm', 'trsm', 'syrk',
+           'gelqf', 'sumlogdiag']
+
+
+def _op(name):
+    base = _register.make_sym_function('linalg_' + name)
+
+    def fn(*args, **kwargs):
+        return base(*args, **kwargs)
+    fn.__name__ = name
+    fn.__doc__ = 'mx.sym.linalg.%s — see the linalg_%s operator.' % (
+        name, name)
+    return fn
+
+
+gemm = _op('gemm')
+gemm2 = _op('gemm2')
+potrf = _op('potrf')
+potri = _op('potri')
+trmm = _op('trmm')
+trsm = _op('trsm')
+syrk = _op('syrk')
+gelqf = _op('gelqf')
+sumlogdiag = _op('sumlogdiag')

@@ -1,0 +1,7 @@
+"""mx.sym.op — the generated symbolic-operator module path
+(reference python/mxnet/symbol/op.py). Lazily generated.
+"""
+from ..ops.registry import lazy_op_module
+from .register import make_sym_function
+
+__getattr__, __dir__ = lazy_op_module(globals(), make_sym_function)

@@ -1,0 +1,14 @@
+"""mx.sym.sparse — symbolic sparse-op namespace (reference
+python/mxnet/symbol/sparse.py). In the symbol world storage types are
+annotations over dense XLA buffers (see ops/sparse_ops.py); the names
+here keep ported code importing.
+"""
+from . import register as _register
+
+__all__ = ['cast_storage', 'retain', 'dot', 'square_sum', 'zeros_like']
+
+cast_storage = _register.make_sym_function('cast_storage')
+retain = _register.make_sym_function('_sparse_retain')
+dot = _register.make_sym_function('dot')
+square_sum = _register.make_sym_function('_square_sum')
+zeros_like = _register.make_sym_function('zeros_like')

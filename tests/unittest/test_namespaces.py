@@ -1,0 +1,83 @@
+"""Generated-namespace parity: nd/sym linalg, random, sparse, op,
+_internal module paths (reference python/mxnet/{ndarray,symbol}/*.py).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_nd_linalg_namespace():
+    rng = np.random.RandomState(0)
+    a = nd.array(rng.randn(3, 3).astype(np.float32))
+    spd = nd.linalg.gemm2(a, a, transpose_b=True) + \
+        3 * nd.array(np.eye(3, dtype=np.float32))
+    L = nd.linalg.potrf(spd)
+    np.testing.assert_allclose(L.asnumpy() @ L.asnumpy().T,
+                               spd.asnumpy(), rtol=1e-4, atol=1e-4)
+    s = nd.linalg.sumlogdiag(nd.array(np.diag([1.0, np.e])
+                                      .astype(np.float32)))
+    assert abs(float(s.asnumpy()) - 1.0) < 1e-5
+
+
+def test_nd_internal_and_op_paths():
+    x = nd._internal._plus_scalar(nd.ones((3,)), scalar=2.0)
+    np.testing.assert_allclose(x.asnumpy(), 3.0)
+    y = nd.op.relu(nd.array(np.array([-1.0, 2.0], np.float32)))
+    np.testing.assert_allclose(y.asnumpy(), [0.0, 2.0])
+    with pytest.raises(AttributeError):
+        nd._internal._no_such_op_xyz
+    assert '_plus_scalar' in dir(nd._internal)
+
+
+def test_sym_random_scalar_and_symbol_params():
+    s = mx.sym.random.uniform(low=0.0, high=1.0, shape=(2, 2))
+    ex = s.bind(mx.cpu(), {})
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (2, 2) and (out >= 0).all() and (out <= 1).all()
+    mu = mx.sym.Variable('mu')
+    sd = mx.sym.Variable('sd')
+    s2 = mx.sym.random.normal(mu, sd)
+    ex2 = s2.bind(mx.cpu(), {'mu': nd.zeros((4,)),
+                             'sd': nd.array(np.full((4,), 1e-9,
+                                                    np.float32))})
+    assert np.allclose(ex2.forward()[0].asnumpy(), 0, atol=1e-6)
+    with pytest.raises(TypeError):
+        mx.sym.random.negative_binomial(mx.sym.Variable('k'), 0.5)
+
+
+def test_sym_linalg_sparse_op_internal():
+    g = mx.sym.linalg.sumlogdiag(mx.sym.Variable('m'))
+    ex = g.bind(mx.cpu(), {'m': nd.array(np.diag([1.0, np.e])
+                                         .astype(np.float32))})
+    assert abs(float(ex.forward()[0].asnumpy()) - 1.0) < 1e-5
+    cs = mx.sym.sparse.cast_storage(mx.sym.Variable('x'),
+                                    stype='row_sparse')
+    ex2 = cs.bind(mx.cpu(), {'x': nd.ones((2, 2))})
+    np.testing.assert_allclose(ex2.forward()[0].asnumpy(), 1.0)
+    assert mx.sym.op.relu is not None
+    assert mx.sym._internal._mul_scalar is not None
+
+
+def test_sym_random_positional_shape_and_mixed_params():
+    # positional shape (reference generated signature: low, high, shape)
+    s = mx.sym.random.uniform(0.0, 1.0, (3, 2))
+    ex = s.bind(mx.cpu(), {})
+    assert ex.forward()[0].shape == (3, 2)
+    # mixed Symbol/scalar params raise the reference's clear error
+    with pytest.raises(ValueError):
+        mx.sym.random.normal(mx.sym.Variable('mu'), 2.0)
+
+
+def test_nd_linalg_positional_scalar_and_out():
+    rng = np.random.RandomState(1)
+    a = nd.array(rng.randn(2, 3).astype(np.float32))
+    b = nd.array(rng.randn(3, 2).astype(np.float32))
+    # generated signature order: (A, B, transpose_a, transpose_b, alpha)
+    got = nd.linalg.gemm2(a, b, False, False, 2.0).asnumpy()
+    np.testing.assert_allclose(got, 2.0 * a.asnumpy() @ b.asnumpy(),
+                               rtol=1e-5)
+    got2 = nd.linalg.gemm2(a, b, alpha=3.0).asnumpy()
+    np.testing.assert_allclose(got2, 3.0 * a.asnumpy() @ b.asnumpy(),
+                               rtol=1e-5)
