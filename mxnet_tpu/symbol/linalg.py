@@ -1,29 +1,19 @@
 """mx.sym.linalg — symbolic linear-algebra namespace (reference
-python/mxnet/symbol/linalg.py over the ``linalg_*`` family).
+python/mxnet/symbol/linalg.py over the ``linalg_*`` family). Short
+names are the generated wrappers, so positional scalars behave like
+the nd counterparts.
 """
 from . import register as _register
 
 __all__ = ['gemm', 'gemm2', 'potrf', 'potri', 'trmm', 'trsm', 'syrk',
            'gelqf', 'sumlogdiag']
 
-
-def _op(name):
-    base = _register.make_sym_function('linalg_' + name)
-
-    def fn(*args, **kwargs):
-        return base(*args, **kwargs)
-    fn.__name__ = name
-    fn.__doc__ = 'mx.sym.linalg.%s — see the linalg_%s operator.' % (
-        name, name)
-    return fn
-
-
-gemm = _op('gemm')
-gemm2 = _op('gemm2')
-potrf = _op('potrf')
-potri = _op('potri')
-trmm = _op('trmm')
-trsm = _op('trsm')
-syrk = _op('syrk')
-gelqf = _op('gelqf')
-sumlogdiag = _op('sumlogdiag')
+gemm = _register.make_sym_function('linalg_gemm')
+gemm2 = _register.make_sym_function('linalg_gemm2')
+potrf = _register.make_sym_function('linalg_potrf')
+potri = _register.make_sym_function('linalg_potri')
+trmm = _register.make_sym_function('linalg_trmm')
+trsm = _register.make_sym_function('linalg_trsm')
+syrk = _register.make_sym_function('linalg_syrk')
+gelqf = _register.make_sym_function('linalg_gelqf')
+sumlogdiag = _register.make_sym_function('linalg_sumlogdiag')

@@ -24,16 +24,22 @@ def _sampler(scalar_op, sample_op, pnames):
         # reference's generated signature: shape, then dtype
         for extra_name, extra in zip(('shape', 'dtype'),
                                      args[len(pnames):]):
-            kwargs.setdefault(extra_name, extra)
+            if extra_name in kwargs:
+                raise TypeError('%s() got multiple values for argument '
+                                '%r' % (fn.__name__, extra_name))
+            kwargs[extra_name] = extra
         for n in pnames:
             if n in kwargs:
+                if n in vals:
+                    raise TypeError('%s() got multiple values for '
+                                    'argument %r' % (fn.__name__, n))
                 vals[n] = kwargs.pop(n)
         n_sym = sum(isinstance(v, Symbol) for v in vals.values())
         if n_sym:
             if sample_fn is None:
                 raise TypeError('%s does not take Symbol parameters'
                                 % scalar_op)
-            if n_sym != len(vals):
+            if n_sym != len(pnames) or len(vals) != len(pnames):
                 # reference symbol/random.py _random_helper contract
                 raise ValueError('Distribution parameters must all '
                                  'have the same type (all Symbol or '

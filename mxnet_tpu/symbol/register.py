@@ -15,6 +15,16 @@ def make_sym_function(op_name):
 
     def fn(*args, **kwargs):
         inputs = [a for a in args if isinstance(a, Symbol)]
+        # positional scalars map onto declared params in order, the
+        # generated-signature convention shared with make_nd_function
+        pos_attrs = [a for a in args
+                     if not isinstance(a, Symbol) and a is not None]
+        if pos_attrs:
+            for pname in op.param_defaults:
+                if not pos_attrs:
+                    break
+                if pname not in kwargs:
+                    kwargs[pname] = pos_attrs.pop(0)
         return _invoke_sym(op_name, inputs, kwargs)
 
     fn.__name__ = op_name

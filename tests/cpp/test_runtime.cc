@@ -1,0 +1,216 @@
+/*
+ * Native runtime unit tests (reference tests/cpp: threaded_engine_test.cc
+ * randomized dependency workloads, storage_test.cc alloc/free reuse) —
+ * a standalone binary over the MXT C ABI, no gtest dependency.
+ *
+ * Build + run: make -C tests/cpp test   (or via tests/unittest/test_native.py)
+ */
+#include <atomic>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../../src/mxtpu.h"
+
+static int g_failures = 0;
+#define CHECK_MSG(cond, msg)                                              \
+  do {                                                                    \
+    if (!(cond)) {                                                        \
+      std::fprintf(stderr, "FAIL %s:%d: %s\n", __FILE__, __LINE__, msg);  \
+      ++g_failures;                                                       \
+    }                                                                     \
+  } while (0)
+#define CHECK_OK(call) CHECK_MSG((call) == 0, MXTGetLastError())
+
+/* -- engine: randomized workload vs serial oracle ---------------------- */
+struct Cell {
+  double value = 0.0;
+};
+struct Task {
+  Cell *reads[4];
+  int n_reads;
+  Cell *write;
+  double coeff;
+};
+
+static void apply_task(void *param) {
+  Task *t = static_cast<Task *>(param);
+  double acc = 0.0;
+  for (int i = 0; i < t->n_reads; ++i) acc += t->reads[i]->value;
+  t->write->value = acc * t->coeff + 1.0;
+}
+
+static void test_engine_randomized() {
+  for (int workers : {0, 1, 4}) {
+    EngineHandle eng;
+    CHECK_OK(MXTEngineCreate(workers, &eng));
+    const int kVars = 8, kOps = 400;
+    std::vector<Cell> cells(kVars), oracle(kVars);
+    std::vector<VarHandle> vars(kVars);
+    for (auto &v : vars) CHECK_OK(MXTEngineNewVar(eng, &v));
+
+    std::mt19937 rng(workers * 7919 + 13);
+    std::vector<Task> tasks(kOps);
+    std::vector<Task> otasks(kOps);
+    for (int i = 0; i < kOps; ++i) {
+      int n_reads = 1 + static_cast<int>(rng() % 3);
+      int widx = static_cast<int>(rng() % kVars);
+      Task &t = tasks[i];
+      t.n_reads = 0;
+      VarHandle rvars[4];
+      for (int r = 0; r < n_reads; ++r) {
+        int ridx = static_cast<int>(rng() % kVars);
+        if (ridx == widx) continue;
+        rvars[t.n_reads] = vars[ridx];
+        t.reads[t.n_reads++] = &cells[ridx];
+      }
+      t.write = &cells[widx];
+      t.coeff = 0.5 + 0.001 * static_cast<double>(i % 7);
+      otasks[i] = t;
+      for (int r = 0; r < t.n_reads; ++r)
+        otasks[i].reads[r] = &oracle[t.reads[r] - &cells[0]];
+      otasks[i].write = &oracle[widx];
+      VarHandle wv = vars[widx];
+      CHECK_OK(MXTEnginePushSync(eng, apply_task, &t, rvars, t.n_reads,
+                                 &wv, 1, 0, "task"));
+    }
+    CHECK_OK(MXTEngineWaitForAll(eng));
+    for (auto &t : otasks) apply_task(&t);  /* serial oracle */
+    for (int i = 0; i < kVars; ++i)
+      CHECK_MSG(cells[i].value == oracle[i].value,
+                "engine result diverged from serial oracle");
+    int64_t pending = -1;
+    CHECK_OK(MXTEnginePendingOps(eng, &pending));
+    CHECK_MSG(pending == 0, "pending ops after WaitForAll");
+    for (auto &v : vars) CHECK_OK(MXTEngineDeleteVar(eng, v));
+    CHECK_OK(MXTEngineFree(eng));
+  }
+  std::puts("engine_randomized OK");
+}
+
+/* crossing read/write sets pushed from two threads must not deadlock
+ * (the grant-ordering hazard: op1 r:A w:B vs op2 r:B w:A) */
+static void test_engine_crossing_sets() {
+  EngineHandle eng;
+  CHECK_OK(MXTEngineCreate(2, &eng));
+  VarHandle a, b;
+  CHECK_OK(MXTEngineNewVar(eng, &a));
+  CHECK_OK(MXTEngineNewVar(eng, &b));
+  static std::atomic<int> counter{0};
+  auto bump = [](void *) { counter.fetch_add(1); };
+  const int kRounds = 200;
+  std::thread t1([&] {
+    for (int i = 0; i < kRounds; ++i)
+      MXTEnginePushSync(eng, bump, nullptr, &a, 1, &b, 1, 0, "x");
+  });
+  std::thread t2([&] {
+    for (int i = 0; i < kRounds; ++i)
+      MXTEnginePushSync(eng, bump, nullptr, &b, 1, &a, 1, 0, "y");
+  });
+  t1.join();
+  t2.join();
+  CHECK_OK(MXTEngineWaitForAll(eng));
+  CHECK_MSG(counter.load() == 2 * kRounds, "lost ops under crossing sets");
+  CHECK_OK(MXTEngineFree(eng));
+  std::puts("engine_crossing_sets OK");
+}
+
+/* -- storage: pooled reuse --------------------------------------------- */
+static void test_storage_pool() {
+  CHECK_OK(MXTStorageReleaseAll());
+  int64_t s0[4], s1[4];
+  CHECK_OK(MXTStorageStats(s0));
+  void *p = nullptr;
+  CHECK_OK(MXTStorageAlloc(1 << 16, &p));
+  CHECK_MSG(p != nullptr, "null alloc");
+  std::memset(p, 0xAB, 1 << 16);
+  CHECK_OK(MXTStorageFree(p));
+  void *q = nullptr;
+  CHECK_OK(MXTStorageAlloc(1 << 16, &q));  /* same bucket -> pool hit */
+  CHECK_OK(MXTStorageStats(s1));
+  CHECK_MSG(s1[3] > s0[3], "free+alloc of same bucket missed the pool");
+  CHECK_OK(MXTStorageDirectFree(q));
+  CHECK_OK(MXTStorageReleaseAll());
+  std::puts("storage_pool OK");
+}
+
+/* -- recordio: roundtrip incl. magic-collision + multipart ------------- */
+static void test_recordio() {
+  const char *path = "/tmp/mxtpu_test_cc.rec";
+  RecordIOHandle w;
+  CHECK_OK(MXTRecordIOWriterCreate(path, &w));
+  /* payload containing the magic bytes forces escaping */
+  uint32_t magic = 0xced7230a;
+  std::string rec1(reinterpret_cast<char *>(&magic), 4);
+  rec1 += "hello";
+  std::string rec2(1 << 20, 'z');          /* 1 MB */
+  for (size_t i = 0; i < rec2.size(); i += 4096)
+    rec2[i] = static_cast<char>(i & 0xff);
+  std::string rec3 = "";                   /* empty record */
+  CHECK_OK(MXTRecordIOWriterWrite(w, rec1.data(), rec1.size()));
+  CHECK_OK(MXTRecordIOWriterWrite(w, rec2.data(), rec2.size()));
+  CHECK_OK(MXTRecordIOWriterWrite(w, rec3.data(), rec3.size()));
+  CHECK_OK(MXTRecordIOWriterFree(w));
+
+  RecordIOHandle r;
+  CHECK_OK(MXTRecordIOReaderCreate(path, &r));
+  const char *buf;
+  size_t len;
+  CHECK_OK(MXTRecordIOReaderNext(r, &buf, &len));
+  CHECK_MSG(len == rec1.size() && std::memcmp(buf, rec1.data(), len) == 0,
+            "rec1 mismatch (magic escaping)");
+  CHECK_OK(MXTRecordIOReaderNext(r, &buf, &len));
+  CHECK_MSG(len == rec2.size() && std::memcmp(buf, rec2.data(), len) == 0,
+            "rec2 mismatch (1MB)");
+  CHECK_OK(MXTRecordIOReaderNext(r, &buf, &len));
+  CHECK_MSG(len == 0, "rec3 should be empty");
+  CHECK_OK(MXTRecordIOReaderNext(r, &buf, &len));
+  CHECK_MSG(len == (size_t)-1, "expected end of stream");
+  CHECK_OK(MXTRecordIOReaderFree(r));
+  std::remove(path);
+  std::puts("recordio OK");
+}
+
+/* -- profiler: explicit events -> chrome trace JSON -------------------- */
+static void test_profiler() {
+  const char *path = "/tmp/mxtpu_test_cc_trace.json";
+  CHECK_OK(MXTProfilerSetState(1));
+  int64_t t0 = MXTNowUS();
+  CHECK_OK(MXTProfilerAddEvent("unit_event", "test", t0, t0 + 42));
+  CHECK_OK(MXTProfilerSetState(0));
+  CHECK_OK(MXTProfilerDump(path));
+  FILE *f = std::fopen(path, "rb");
+  CHECK_MSG(f != nullptr, "trace file missing");
+  if (f) {
+    std::string content;
+    char chunk[4096];
+    size_t n;
+    while ((n = std::fread(chunk, 1, sizeof chunk, f)) > 0)
+      content.append(chunk, n);
+    std::fclose(f);
+    CHECK_MSG(content.find("unit_event") != std::string::npos,
+              "event name absent from trace");
+    CHECK_MSG(content.find("traceEvents") != std::string::npos,
+              "not chrome trace format");
+  }
+  std::remove(path);
+  std::puts("profiler OK");
+}
+
+int main() {
+  test_engine_randomized();
+  test_engine_crossing_sets();
+  test_storage_pool();
+  test_recordio();
+  test_profiler();
+  if (g_failures) {
+    std::fprintf(stderr, "%d FAILURES\n", g_failures);
+    return 1;
+  }
+  std::puts("ALL CPP TESTS PASSED");
+  return 0;
+}

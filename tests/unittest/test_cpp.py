@@ -1,0 +1,23 @@
+"""Native C++ unit-test tier (reference tests/cpp) — built from source,
+independent of the prebuilt ctypes runtime library.
+"""
+import os
+
+import pytest
+
+
+def test_cpp_unit_suite():
+    """Build + run the native C++ test binary (reference tests/cpp
+    tier: engine/storage/recordio/profiler without python)."""
+    import shutil
+    import subprocess
+    if shutil.which('g++') is None or shutil.which('make') is None:
+        pytest.skip('no native toolchain')
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    proc = subprocess.run(['make', '-s', '-C',
+                           os.path.join(repo, 'tests', 'cpp'), 'test'],
+                          capture_output=True, text=True, timeout=300)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-2000:]
+    assert 'ALL CPP TESTS PASSED' in out

@@ -81,3 +81,23 @@ def test_nd_linalg_positional_scalar_and_out():
     got2 = nd.linalg.gemm2(a, b, alpha=3.0).asnumpy()
     np.testing.assert_allclose(got2, 3.0 * a.asnumpy() @ b.asnumpy(),
                                rtol=1e-5)
+
+
+def test_sym_linalg_positional_scalars():
+    a = mx.sym.Variable('a')
+    b = mx.sym.Variable('b')
+    s = mx.sym.linalg.gemm2(a, b, False, False, 2.0)
+    av = np.random.RandomState(2).randn(2, 3).astype(np.float32)
+    bv = np.random.RandomState(3).randn(3, 2).astype(np.float32)
+    ex = s.bind(mx.cpu(), {'a': nd.array(av), 'b': nd.array(bv)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), 2.0 * av @ bv,
+                               rtol=1e-5)
+
+
+def test_sym_random_arg_errors():
+    with pytest.raises(TypeError):
+        mx.sym.random.uniform(0.0, 1.0, low=5.0)     # duplicate param
+    with pytest.raises(ValueError):
+        mx.sym.random.normal(mx.sym.Variable('mu'))  # partial Symbol
+    with pytest.raises(TypeError):
+        mx.sym.random.uniform(0.0, 1.0, (2,), shape=(3,))  # dup shape

@@ -254,3 +254,4 @@ def test_profiler_dump(tmp_path):
         trace = json.load(f)
     names = [e.get('name') for e in trace['traceEvents']]
     assert names.count('profiled_op') == 5
+
