@@ -56,6 +56,7 @@ from .executor_manager import DataParallelExecutorManager  # noqa: F401
 from . import operator
 from .operator import CustomOp, CustomOpProp
 from . import rtc
+from . import contrib
 from . import plugin
 from . import parallel
 

@@ -1,0 +1,9 @@
+"""Experimental contrib namespace (reference python/mxnet/contrib/).
+
+Submodules: autograd (the older experimental autograd API surface),
+ndarray/symbol (contrib-op namespaces), tensorboard (metric logging).
+"""
+from . import autograd  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401
+from . import tensorboard  # noqa: F401

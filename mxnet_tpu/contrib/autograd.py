@@ -1,0 +1,49 @@
+"""Experimental autograd API (reference contrib/autograd.py) — thin
+adapter over mxnet_tpu.autograd, kept for ported code. The modern API
+is mx.autograd.
+"""
+from .. import autograd as _ag
+
+__all__ = ['set_is_training', 'train_section', 'test_section',
+           'backward', 'grad_and_loss', 'grad', 'mark_variables']
+
+
+def set_is_training(is_train):
+    """Returns the previous state (reference contrib/autograd.py:31)."""
+    prev = _ag.is_training()
+    _ag.set_training(is_train)
+    _ag.set_recording(is_train)
+    return prev
+
+
+class _Section:
+    def __init__(self, train):
+        self._train = train
+
+    def __enter__(self):
+        self._prev_t = _ag.is_training()
+        self._prev_r = _ag.is_recording()
+        _ag.set_training(self._train)
+        _ag.set_recording(self._train)
+
+    def __exit__(self, *args):
+        _ag.set_training(self._prev_t)
+        _ag.set_recording(self._prev_r)
+
+
+def train_section():
+    """``with train_section():`` — record + train mode (reference :56)."""
+    return _Section(True)
+
+
+def test_section():
+    return _Section(False)
+
+
+def backward(outputs, out_grads=None, retain_graph=False):
+    return _ag.backward(outputs, out_grads, retain_graph=retain_graph)
+
+
+grad_and_loss = _ag.grad_and_loss
+grad = _ag.grad
+mark_variables = _ag.mark_variables

@@ -1,0 +1,10 @@
+"""Contrib NDArray ops (reference contrib/ndarray.py) — the same
+namespace as mx.nd.contrib."""
+from ..ndarray.contrib import *  # noqa: F401,F403
+from ..ndarray import contrib as _c
+
+__all__ = getattr(_c, '__all__', [])
+
+
+def __getattr__(name):
+    return getattr(_c, name)

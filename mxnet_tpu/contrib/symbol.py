@@ -1,0 +1,10 @@
+"""Contrib Symbol ops (reference contrib/symbol.py) — the same
+namespace as mx.sym.contrib."""
+from ..symbol.contrib import *  # noqa: F401,F403
+from ..symbol import contrib as _c
+
+__all__ = getattr(_c, '__all__', [])
+
+
+def __getattr__(name):
+    return getattr(_c, name)

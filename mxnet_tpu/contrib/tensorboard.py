@@ -1,0 +1,43 @@
+"""TensorBoard logging callback (reference contrib/tensorboard.py
+LogMetricsCallback over the optional tensorboard SummaryWriter).
+"""
+__all__ = ['LogMetricsCallback']
+
+
+class LogMetricsCallback:
+    """Log metric values as tensorboard scalars each batch.
+
+    Needs a SummaryWriter provider (`tensorboardX` or `torch.utils.
+    tensorboard`); raises a clear ImportError otherwise (the reference
+    requires the standalone `tensorboard` python package the same way).
+    """
+
+    def __init__(self, logging_dir, prefix=None):
+        self.prefix = prefix
+        self.step = 0
+        try:
+            from tensorboardX import SummaryWriter
+        except ImportError:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+            except ImportError:
+                raise ImportError(
+                    'LogMetricsCallback needs tensorboardX or torch '
+                    'with tensorboard support installed')
+        self.summary_writer = SummaryWriter(logging_dir)
+
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        self.step += 1
+        for name, value in zip(*_as_lists(param.eval_metric.get())):
+            if self.prefix is not None:
+                name = '%s-%s' % (self.prefix, name)
+            self.summary_writer.add_scalar(name, value, self.step)
+
+
+def _as_lists(name_value):
+    name, value = name_value
+    if isinstance(name, str):
+        return [name], [value]
+    return name, value

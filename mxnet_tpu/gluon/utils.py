@@ -10,7 +10,8 @@ import numpy as np
 from .. import ndarray as nd
 from ..ndarray import NDArray
 
-__all__ = ['split_data', 'split_and_load', 'clip_global_norm']
+__all__ = ['split_data', 'split_and_load', 'clip_global_norm',
+           'download', 'check_sha1']
 
 
 def split_data(data, num_slice, batch_axis=0, even_split=True):
@@ -67,3 +68,63 @@ def clip_global_norm(arrays, max_norm):
         for arr in arrays:
             arr *= ratio
     return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    """Whether the file's sha1 matches (reference utils.py:139)."""
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, 'rb') as f:
+        while True:
+            data = f.read(1 << 20)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None):
+    """Download a file (reference utils.py:166). Zero-egress
+    environments raise a clear error instead of hanging; a file:// url
+    or an already-present verified file short-circuits."""
+    import os
+    import shutil
+    import urllib.request
+
+    fname = url.split('/')[-1]
+    assert fname, ('cannot derive a file name from %r; provide path= '
+                   'with a file name' % url)
+    if path is None:
+        path = fname
+    elif os.path.isdir(path):
+        path = os.path.join(path, fname)
+    if os.path.exists(path) and not overwrite and \
+            (sha1_hash is None or check_sha1(path, sha1_hash)):
+        return path
+    dirname = os.path.dirname(os.path.abspath(path))
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    # write to a temp name and move into place only on success, so a
+    # dropped connection never leaves a truncated file that later calls
+    # would return as a valid cached download
+    tmp = path + '.part'
+    if url.startswith('file://'):
+        shutil.copyfile(url[len('file://'):], tmp)
+    else:
+        try:
+            r = urllib.request.urlopen(url, timeout=30)
+        except OSError as e:
+            raise OSError('download of %s failed (offline environment?): '
+                          '%s' % (url, e))
+        try:
+            with r, open(tmp, 'wb') as f:
+                shutil.copyfileobj(r, f)
+        except OSError:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+    if sha1_hash and not check_sha1(tmp, sha1_hash):
+        os.remove(tmp)
+        raise OSError('downloaded file %s sha1 mismatch' % path)
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,68 @@
+"""mx.contrib package (reference python/mxnet/contrib/): the
+experimental autograd surface, contrib op namespaces, tensorboard
+callback.
+"""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import autograd as cag
+
+
+def test_contrib_autograd_train_section_and_backward():
+    x = nd.array(np.array([3.0], np.float32))
+    gx = nd.zeros((1,))
+    cag.mark_variables([x], [gx])
+    with cag.train_section():
+        y = x * x + x
+    cag.backward([y])
+    np.testing.assert_allclose(gx.asnumpy(), [7.0])
+
+
+def test_contrib_autograd_set_is_training():
+    prev = cag.set_is_training(True)
+    assert mx.autograd.is_training()
+    cag.set_is_training(prev)
+    assert not mx.autograd.is_training()
+    with cag.test_section():
+        assert not mx.autograd.is_recording()
+
+
+def test_contrib_autograd_grad_and_loss():
+    ga = cag.grad_and_loss(lambda a: a * a)
+    grads, loss = ga(nd.array(np.array([4.0], np.float32)))
+    np.testing.assert_allclose(grads[0].asnumpy(), [8.0])
+    np.testing.assert_allclose(loss.asnumpy(), [16.0])
+
+
+def test_contrib_op_namespaces():
+    assert mx.contrib.ndarray.MultiBoxPrior is not None
+    assert mx.contrib.symbol.MultiBoxPrior is not None
+    # same underlying registry op as nd.contrib
+    x = nd.array(np.zeros((1, 3, 4, 4), np.float32))
+    a = mx.contrib.ndarray.MultiBoxPrior(x, sizes=[0.5], ratios=[1.0])
+    b = nd.contrib.MultiBoxPrior(x, sizes=[0.5], ratios=[1.0])
+    np.testing.assert_allclose(a.asnumpy(), b.asnumpy())
+
+
+def test_tensorboard_callback():
+    tb = pytest.importorskip('torch.utils.tensorboard')
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from mxnet_tpu.metric import create as create_metric
+
+    with tempfile.TemporaryDirectory() as d:
+        cb = LogMetricsCallback(d, prefix='train')
+
+        class P:
+            eval_metric = create_metric('acc')
+        P.eval_metric.update(
+            [nd.array(np.array([0.0], np.float32))],
+            [nd.array(np.array([[0.9, 0.1]], np.float32))])
+        cb(P)
+        cb.summary_writer.flush()
+        files = os.listdir(d)
+        assert any('tfevents' in f for f in files)

@@ -94,3 +94,32 @@ def test_vision_mnist_synthetic():
             lambda x: x.astype('float32') / 255.0), batch_size=16)
         b, l = next(iter(loader))
         assert b.shape == (16, 28, 28, 1)
+
+
+def test_gluon_utils_download_file_url_and_sha1(tmp_path):
+    import hashlib
+    from mxnet_tpu.gluon.utils import download, check_sha1
+    src = tmp_path / 'payload.bin'
+    src.write_bytes(b'mxnet-tpu-data')
+    sha = hashlib.sha1(b'mxnet-tpu-data').hexdigest()
+    dst = download('file://%s' % src, path=str(tmp_path / 'out.bin'),
+                   sha1_hash=sha)
+    assert check_sha1(dst, sha)
+    # cached: second call with matching hash is a no-op
+    assert download('file://%s' % src, path=dst, sha1_hash=sha) == dst
+    with pytest.raises(OSError):
+        download('file://%s' % src, path=str(tmp_path / 'bad.bin'),
+                 sha1_hash='0' * 40)
+
+
+def test_download_no_partial_file_on_mismatch(tmp_path):
+    from mxnet_tpu.gluon.utils import download
+    src = tmp_path / 'src.bin'
+    src.write_bytes(b'payload')
+    dst = tmp_path / 'sub' / 'dir' / 'dst.bin'   # dirs auto-created
+    with pytest.raises(OSError):
+        download('file://%s' % src, path=str(dst), sha1_hash='0' * 40)
+    assert not dst.exists()                      # nothing truncated left
+    assert not (tmp_path / 'sub' / 'dir' / 'dst.bin.part').exists()
+    ok = download('file://%s' % src, path=str(dst))
+    assert open(ok, 'rb').read() == b'payload'
