@@ -10,6 +10,7 @@ from .. import autograd
 from .. import ndarray as nd
 from ..base import MXNetError
 from ..context import Context, cpu, current_context
+from .. import initializer as initializer_mod
 from ..initializer import InitDesc, Initializer, Uniform
 from ..ndarray import NDArray
 
@@ -128,8 +129,9 @@ class Parameter:
             % (self.name, str(self.shape))
         with autograd.pause():
             data = nd.zeros(self.shape, dtype=self.dtype, ctx=cpu())
-            (init if init is not None else default_init)(
-                InitDesc(self.name, {'__init__': ''}), data)
+            initializer = initializer_mod.create(
+                init if init is not None else default_init)
+            initializer(InitDesc(self.name, {'__init__': ''}), data)
             self._init_impl(data, ctx)
 
     def _init_impl(self, data, ctx_list):

@@ -1,8 +1,3 @@
-"""String → Initializer resolution shared by gluon layers."""
-from ...initializer import Zero, One
-
-
-def init_by_name(init):
-    if init is None or not isinstance(init, str):
-        return init
-    return {'zeros': Zero(), 'ones': One()}.get(init, init)
+"""String → Initializer resolution shared by gluon layers — delegates
+to the single registry-backed resolver (initializer.create)."""
+from ...initializer import create as init_by_name  # noqa: F401

@@ -319,3 +319,21 @@ class FusedRNN(Initializer):
 
 def init(name):
     return _INIT_REGISTRY[name.lower()]
+
+
+_STRING_ALIASES = {'zeros': 'zero', 'ones': 'one'}
+
+
+def create(spec):
+    """Resolve an initializer spec: an Initializer passes through; a
+    string ('normal', 'xavier', 'zeros', ...) resolves via the registry
+    with the common plural aliases (the single resolution point used by
+    gluon Parameters and layers)."""
+    if spec is None or not isinstance(spec, str):
+        return spec
+    key = _STRING_ALIASES.get(spec.lower(), spec.lower())
+    try:
+        return _INIT_REGISTRY[key]()
+    except KeyError:
+        raise ValueError('unknown initializer %r (known: %s)'
+                         % (spec, ', '.join(sorted(_INIT_REGISTRY))))

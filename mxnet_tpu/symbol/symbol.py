@@ -424,7 +424,13 @@ def Variable(name, attr=None, shape=None, lr_mult=None, wd_mult=None,
     if wd_mult is not None:
         attr_dict['__wd_mult__'] = str(wd_mult)
     if init is not None:
-        attr_dict['__init__'] = init.dumps() if hasattr(init, 'dumps') else str(init)
+        if isinstance(init, str):
+            # resolve string specs so '__init__' always holds the json
+            # form Initializer.__call__ expects
+            from ..initializer import create as _create_init
+            init = _create_init(init)
+        attr_dict['__init__'] = init.dumps() if hasattr(init, 'dumps') \
+            else str(init)
     node = Node(None, {}, [], name, attr_dict)
     return Symbol([(node, 0)])
 
