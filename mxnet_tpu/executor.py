@@ -427,7 +427,8 @@ class Executor:
                 nd_zeros(sh, ctx=self._ctx, dtype=str(cur._data.dtype))
         grads = None
         if any(g is not None for g in self.grad_arrays):
-            grads = {n: nd_zeros(new_args[n].shape, ctx=self._ctx)
+            grads = {n: nd_zeros(new_args[n].shape, ctx=self._ctx,
+                                 dtype=str(new_args[n]._data.dtype))
                      for n in self._grad_names}
         return Executor(self._symbol, self._ctx, new_args, grads,
                         self._grad_req, new_aux, group2ctx=self._group2ctx)
@@ -462,13 +463,16 @@ def simple_bind(symbol, ctx, grad_req='write', type_dict=None, group2ctx=None,
     type_dict = type_dict or {}
     arg_names = symbol.list_arguments()
     aux_names = symbol.list_auxiliary_states()
+    # dtypes via type inference (reference simple_bind runs InferType):
+    # a Cast to bf16 makes downstream parameters bf16 automatically
+    arg_types, _, aux_types = symbol.infer_type(**type_dict)
     args = {}
-    for name, sh in zip(arg_names, arg_shapes):
-        dt = str(np_dtype(type_dict.get(name, 'float32')))
+    for name, sh, it in zip(arg_names, arg_shapes, arg_types):
+        dt = str(np_dtype(type_dict.get(name, it)))
         args[name] = nd_zeros(sh, ctx=ctx, dtype=dt)
     aux = {}
-    for name, sh in zip(aux_names, aux_shapes):
-        aux[name] = nd_zeros(sh, ctx=ctx)
+    for name, sh, it in zip(aux_names, aux_shapes, aux_types):
+        aux[name] = nd_zeros(sh, ctx=ctx, dtype=str(np_dtype(it)))
     grads = None
     req_of = (lambda n: grad_req) if isinstance(grad_req, str) else \
         (lambda n: grad_req[arg_names.index(n)] if isinstance(grad_req, (list, tuple))
@@ -477,6 +481,7 @@ def simple_bind(symbol, ctx, grad_req='write', type_dict=None, group2ctx=None,
         grads = {}
         for name, sh in zip(arg_names, arg_shapes):
             if req_of(name) != 'null':
-                grads[name] = nd_zeros(sh, ctx=ctx)
+                grads[name] = nd_zeros(sh, ctx=ctx,
+                                       dtype=str(args[name]._data.dtype))
     return Executor(symbol, ctx, args, grads, grad_req, aux,
                     group2ctx=group2ctx)

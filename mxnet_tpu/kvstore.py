@@ -71,7 +71,12 @@ class KVStore:
                 olist = [olist]
             src = self._store[k]
             for o in olist:
-                o._data = jax.device_put(src._data, o.context.jax_device())
+                # cast to the destination's dtype (reference CopyFromTo):
+                # with multi-precision optimizers the store/updater holds
+                # fp32 masters while executors stay bound in bf16
+                o._data = jax.device_put(
+                    src._data.astype(o._data.dtype),
+                    o.context.jax_device())
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Reference kvstore_local.h:203 PullRowSparseImpl."""

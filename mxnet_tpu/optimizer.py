@@ -19,6 +19,14 @@ from . import ndarray as nd
 from .ndarray import NDArray, zeros
 from .base import normalize_value
 
+
+def _is_half(dtype):
+    """True for the half-precision dtypes multi_precision applies to —
+    float16 (reference optimizer.py:338) and bfloat16, the TPU half
+    type the bench's mp path trains in."""
+    return str(dtype) in ('float16', 'bfloat16')
+
+
 __all__ = ['Optimizer', 'SGD', 'NAG', 'SGLD', 'DCASGD', 'ccSGD', 'Adam',
            'AdaGrad', 'RMSProp', 'AdaDelta', 'Ftrl', 'Adamax', 'Nadam',
            'Test', 'Updater', 'get_updater', 'register', 'create']
@@ -72,7 +80,7 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_half(weight.dtype):
             weight_master_copy = weight.astype('float32')
             return (weight_master_copy, self.create_state(index, weight_master_copy))
         return self.create_state(index, weight)
@@ -81,7 +89,7 @@ class Optimizer:
         raise NotImplementedError()
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_half(weight.dtype):
             weight_master, orig_state = state
             grad32 = grad.astype('float32')
             self.update(index, weight_master, grad32, orig_state)
@@ -190,7 +198,7 @@ class SGD(Optimizer):
             nd.sgd_update(weight, grad, out=weight, **kwargs)
 
     def update_multi_precision(self, index, weight, grad, state):
-        if self.multi_precision and weight.dtype == np.float16:
+        if self.multi_precision and _is_half(weight.dtype):
             self._update_count(index)
             lr = self._get_lr(index)
             wd = self._get_wd(index)

@@ -254,6 +254,15 @@ def _eval_node_shape(n, in_shapes, known_types):
     return [tuple(o.shape) for o in out]
 
 
+def _is_floating(t):
+    """Floating check covering bfloat16 (outside numpy's hierarchy;
+    ``t`` may be a np.dtype, a numpy scalar type, or the jnp.bfloat16
+    class)."""
+    dt = np.dtype(t)
+    return dt.name in ('bfloat16', 'float16') or \
+        np.issubdtype(dt, np.floating)
+
+
 def infer_types(symbol, known):
     dtypes = {}
     var_dtype = {}
@@ -262,11 +271,24 @@ def infer_types(symbol, known):
             t = known.get(n.name)
             if t is None and '__dtype__' in n.attr_dict:
                 t = n.attr_dict['__dtype__']
-            var_dtype[n.name] = np_dtype(t) if t is not None else np.dtype('float32')
+            # None = not yet known; resolved from the first consumer
+            # below (the practical direction of the reference's
+            # bidirectional InferType fixpoint — parameters of a bf16
+            # node become bf16)
+            var_dtype[n.name] = np_dtype(t) if t is not None else None
             dtypes[id(n)] = [var_dtype[n.name]]
             continue
         in_dtypes = [dtypes[id(p)][i] for (p, i) in n.inputs]
-        # forward propagate: result dtype = first floating input (simplified)
+        # seed from the first FLOATING known input: integer inputs
+        # (Embedding/take indices) must not type float parameters
+        seed = next((t for t in in_dtypes if t is not None
+                     and _is_floating(t)), np.dtype('float32'))
+        for (p, i), t in zip(n.inputs, in_dtypes):
+            if t is None and p.is_variable():
+                var_dtype[p.name] = seed
+                dtypes[id(p)] = [seed]
+        in_dtypes = [dtypes[id(p)][i] for (p, i) in n.inputs]
+        # forward propagate: result dtype = first input (simplified)
         out_t = in_dtypes[0] if in_dtypes else np.dtype('float32')
         if n.op == 'Cast':
             out_t = np_dtype(n.attrs['dtype'])
@@ -274,6 +296,7 @@ def infer_types(symbol, known):
         dtypes[id(n)] = [out_t] * op.n_outputs(n.attrs)
     args = symbol.list_arguments()
     auxs = symbol.list_auxiliary_states()
-    outs = [dtypes[id(node)][idx] for node, idx in symbol._outputs]
-    return ([var_dtype.get(a, np.dtype('float32')) for a in args], outs,
-            [var_dtype.get(a, np.dtype('float32')) for a in auxs])
+    f32 = np.dtype('float32')
+    outs = [dtypes[id(node)][idx] or f32 for node, idx in symbol._outputs]
+    return ([var_dtype.get(a) or f32 for a in args], outs,
+            [var_dtype.get(a) or f32 for a in auxs])

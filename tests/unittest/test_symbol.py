@@ -179,3 +179,22 @@ def test_softmax_output_label_inference_variants():
     s3 = mx.sym.SoftmaxOutput(data, name='sm', multi_output=True)
     args3, _, _ = s3.infer_shape(data=(4, 3, 5, 5))
     assert dict(zip(s3.list_arguments(), args3))['sm_label'] == (4, 5, 5)
+
+
+def test_infer_type_bf16_flows_and_int_does_not():
+    # Cast to bf16 types downstream parameters
+    d = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(mx.sym.Cast(d, dtype='bfloat16'),
+                                num_hidden=4, name='fc')
+    at, _, _ = net.infer_type(data='float32')
+    types = dict(zip(net.list_arguments(), at))
+    assert np.dtype(types['fc_weight']).name == 'bfloat16'
+    # integer indices do NOT type the embedding weight
+    idx = mx.sym.Variable('idx')
+    emb = mx.sym.Embedding(idx, input_dim=10, output_dim=4, name='emb')
+    at2, _, _ = emb.infer_type(idx='int32')
+    types2 = dict(zip(emb.list_arguments(), at2))
+    assert np.dtype(types2['emb_weight']) == np.float32
+    # and simple_bind allocates grads in the arg dtype
+    ex = net.simple_bind(mx.cpu(), data=(2, 6))
+    assert str(ex.grad_dict['fc_weight'].dtype) == 'bfloat16'
