@@ -142,3 +142,30 @@ def test_libinfo_log_name_modules():
     with name_mod.Prefix('pfx_'):
         s = mx.sym.FullyConnected(mx.sym.Variable('d'), num_hidden=2)
         assert s.name.startswith('pfx_')
+
+
+def test_parse_log_tool(tmp_path):
+    """tools/parse_log.py over real fit() log lines (reference
+    tools/parse_log.py)."""
+    import os
+    import subprocess
+    import sys as _sys
+    log = tmp_path / 'train.log'
+    log.write_text(
+        'INFO Epoch[0] Train-accuracy=0.610000\n'
+        'INFO Epoch[0] Time cost=12.500\n'
+        'INFO Epoch[0] Validation-accuracy=0.580000\n'
+        'INFO Epoch[1] Train-accuracy=0.820000\n'
+        'INFO Epoch[1] Time cost=11.900\n'
+        'INFO Epoch[1] Validation-accuracy=0.790000\n')
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    out = subprocess.run(
+        [_sys.executable, os.path.join(repo, 'tools', 'parse_log.py'),
+         str(log), '--format', 'csv'],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    lines = out.stdout.strip().splitlines()
+    assert lines[0] == 'epoch,train-accuracy,time,val-accuracy'
+    assert lines[1].startswith('0,0.61,12.5,0.58')
+    assert lines[2].startswith('1,0.82,11.9,0.79')

@@ -1,0 +1,78 @@
+#!/usr/bin/env python3
+"""Parse training logs into a per-epoch table (reference
+tools/parse_log.py — same job over this framework's fit() log lines:
+``Epoch[N] Train-<metric>=V``, ``Epoch[N] Validation-<metric>=V``,
+``Epoch[N] Time cost=T``).
+
+    python tools/parse_log.py train.log [--format markdown|csv]
+"""
+import argparse
+import re
+import sys
+
+_TRAIN = re.compile(r'Epoch\[(\d+)\] Train-([^=\s]+)=([\d.eE+-]+)')
+_VAL = re.compile(r'Epoch\[(\d+)\] Validation-([^=\s]+)=([\d.eE+-]+)')
+_TIME = re.compile(r'Epoch\[(\d+)\] Time cost=([\d.eE+-]+)')
+
+
+def parse(lines):
+    """Returns (rows, metric_names): one row dict per epoch."""
+    epochs = {}
+
+    def row(i):
+        return epochs.setdefault(int(i), {'epoch': int(i)})
+
+    metrics = []
+    for line in lines:
+        m = _TRAIN.search(line)
+        if m:
+            key = 'train-' + m.group(2)
+            row(m.group(1))[key] = float(m.group(3))
+            if key not in metrics:
+                metrics.append(key)
+            continue
+        m = _VAL.search(line)
+        if m:
+            key = 'val-' + m.group(2)
+            row(m.group(1))[key] = float(m.group(3))
+            if key not in metrics:
+                metrics.append(key)
+            continue
+        m = _TIME.search(line)
+        if m:
+            row(m.group(1))['time'] = float(m.group(2))
+            if 'time' not in metrics:
+                metrics.append('time')
+    return [epochs[k] for k in sorted(epochs)], metrics
+
+
+def render(rows, metrics, fmt='markdown'):
+    cols = ['epoch'] + metrics
+    out = []
+    if fmt == 'markdown':
+        out.append('| ' + ' | '.join(cols) + ' |')
+        out.append('|' + '---|' * len(cols))
+        for r in rows:
+            out.append('| ' + ' | '.join(
+                ('%g' % r[c]) if c in r else '-' for c in cols) + ' |')
+    else:
+        out.append(','.join(cols))
+        for r in rows:
+            out.append(','.join(('%g' % r[c]) if c in r else '' for c in cols))
+    return '\n'.join(out)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument('logfile')
+    ap.add_argument('--format', choices=['markdown', 'csv'],
+                    default='markdown')
+    args = ap.parse_args(argv)
+    with open(args.logfile) as f:
+        rows, metrics = parse(f)
+    print(render(rows, metrics, args.format))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
