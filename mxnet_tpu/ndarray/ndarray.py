@@ -28,26 +28,23 @@ __all__ = ['NDArray', 'array', 'zeros', 'ones', 'empty', 'full', 'arange',
            'invoke', 'waitall', 'concatenate', 'moveaxis', 'onehot_encode',
            'imperative_invoke', 'from_jax', 'stack']
 
-_recent = []  # small ring of recently-dispatched arrays, for waitall()
-_RECENT_MAX = 64
-
-
-def _track(data):
-    _recent.append(data)
-    if len(_recent) > _RECENT_MAX:
-        del _recent[:_RECENT_MAX // 2]
-
 
 def waitall():
-    """Block until all dispatched computation is done.
+    """Block until all dispatched computation is done — a real barrier.
 
-    Reference: MXNDArrayWaitAll / Engine::WaitForAll (engine.h:180)."""
-    for d in _recent:
+    Reference: MXNDArrayWaitAll / Engine::WaitForAll (engine.h:180).
+    XLA devices execute programs in submission order, so dispatching a
+    trivial program on each local device and fetching its result to
+    the host drains everything queued before it (a host fetch, not
+    block_until_ready: through tunneled runtimes only the device→host
+    copy is a reliable fence)."""
+    import numpy as _np
+    for dev in jax.local_devices():
         try:
-            jax.block_until_ready(d)
-        except Exception:  # deleted buffers are fine to skip
+            fence = jax.device_put(_np.zeros((), _np.float32), dev)
+            _np.asarray(fence + 1)
+        except Exception:  # device gone/unreachable: nothing to drain
             pass
-    del _recent[:]
 
 
 class NDArray:
@@ -224,7 +221,6 @@ class NDArray:
         self._data = new_data
         self._node = node
         self._out_idx = out_idx
-        _track(new_data)
 
     def __setitem__(self, key, value):
         if isinstance(value, NDArray):
@@ -545,7 +541,6 @@ def invoke(op_name, inputs, attrs=None, out=None):
         r._node = node
         r._out_idx = i
         results.append(r)
-        _track(outs_t[i])
 
     if out is not None:
         outs_list = out if isinstance(out, (list, tuple)) else [out]

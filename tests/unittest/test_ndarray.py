@@ -232,3 +232,15 @@ def test_random():
     assert abs(float(n.asnumpy().mean())) < 0.2
     g = nd.random.gamma(2.0, 2.0, shape=(500,))
     assert g.asnumpy().min() >= 0
+
+
+def test_waitall_is_a_barrier():
+    """waitall must drain every queued computation on every used device
+    (the old implementation tracked only the last 64 arrays)."""
+    arrays = [mx.nd.ones((8, 8)) * i for i in range(200)]
+    mx.nd.waitall()
+    for i, a in enumerate(arrays):
+        assert float(a.asnumpy()[0, 0]) == float(i)
+    # repeated calls are cheap no-ops
+    mx.nd.waitall()
+    mx.nd.waitall()
