@@ -104,6 +104,8 @@ def init_backend():
                  % (time.perf_counter() - t0))
             devs = jax.devices()
             _log('backend up: %s' % devs)
+            if devs[0].platform == 'cpu':
+                _shrink_for_cpu()
             return devs, devs[0].platform
         _log('  probe result: %s' % status)
         if attempt < INIT_ATTEMPTS:
@@ -121,7 +123,18 @@ def init_backend():
         _log('FATAL: cpu fallback failed: %s' % e)
         sys.exit(1)
     _log('cpu backend up: %s' % devs)
+    _shrink_for_cpu()
     return devs, 'cpu(fallback)'
+
+
+def _shrink_for_cpu():
+    """Shrink the workload so a CPU run (fallback or cpu-only host)
+    yields a number quickly instead of risking the harness timeout on a
+    CPU-compiled ResNet."""
+    global BATCH, WARMUP_STEPS
+    if 'MXTPU_BENCH_BATCH' not in os.environ:
+        BATCH = 8
+    WARMUP_STEPS = 1
 
 
 def build_train_step():
@@ -261,6 +274,8 @@ def main():
     # Scale the measured run to ~10-30s of wall clock.
     per_step = max(1e-4, warmup_dt / WARMUP_STEPS)
     bench_steps = int(min(200, max(10, 15.0 / per_step)))
+    if platform.startswith('cpu'):
+        bench_steps = min(bench_steps, 5)
     _log('measuring %d steps...' % bench_steps)
     t0 = time.perf_counter()
     for _ in range(bench_steps):
