@@ -14,6 +14,7 @@ import pytest
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
 
 CASES = [
+    ('parallel/train_multihost.py', ['--steps', '20']),
     ('image-classification/train_mnist.py',
      ['--num-epochs', '1', '--network', 'mlp']),
     ('image-classification/train_imagenet.py',
