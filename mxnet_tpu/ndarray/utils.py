@@ -1,13 +1,22 @@
 """NDArray serialization.
 
-Reference: include/mxnet/ndarray.h:361-373 NDArray::Save/Load (versioned
-binary) + python/mxnet/ndarray/utils.py save/load (dict/list of arrays).
+Reference: include/mxnet/ndarray.h:361-373 + src/ndarray/ndarray.cc:814
+(NDArray::Save/Load, dmlc::Stream binary) + python/mxnet/ndarray/
+utils.py save/load (dict/list of arrays).
 
-Format here: a single .npz container with a manifest — functionally
-equivalent (dict/list round-trip, dtype/shape preserved); the on-disk bytes
-differ from the reference's dmlc::Stream format by design (no CUDA/mshadow
-layout baggage).
+Two on-disk formats:
+
+- the native container (single .npz with a manifest) — default for
+  ``save``;
+- the REFERENCE binary format (list magic 0x112, per-array V2 magic
+  0xF993fac9, little-endian dmlc streams, ndarray.cc:809-1040) —
+  ``load`` auto-detects it, so ``.params``/``.ndarray`` files written
+  by the reference load directly (the checkpoint-migration path), and
+  ``save(..., fmt='mxnet')`` writes it for the reverse direction.
 """
+import struct
+import warnings
+
 import numpy as np
 
 from .ndarray import NDArray, array
@@ -16,29 +25,238 @@ __all__ = ['save', 'load']
 
 _LIST_KEY = '__mxtpu_list__%d'
 
+_LIST_MAGIC = 0x112
+_V2_MAGIC = 0xF993FAC9
+_V1_MAGIC = 0xF993FAC8
+# mshadow type flags (mshadow/base.h)
+_TYPE_FLAGS = {0: np.float32, 1: np.float64, 2: np.float16,
+               3: np.uint8, 4: np.int32, 5: np.int8, 6: np.int64}
+_FLAG_OF = {np.dtype(v): k for k, v in _TYPE_FLAGS.items()}
 
-def save(fname, data):
+
+def save(fname, data, fmt='npz'):
+    """Save NDArrays. ``fmt='npz'`` (native container) or ``'mxnet'``
+    (the reference's binary list format, loadable by the reference)."""
     if isinstance(data, NDArray):
         data = [data]
+    if fmt == 'mxnet':
+        return _save_mxnet(fname, data)
+    if fmt != 'npz':
+        raise ValueError("fmt must be 'npz' or 'mxnet'")
     if isinstance(data, dict):
         arrays = {k: v.asnumpy() for k, v in data.items()}
-        fmt = 'dict'
+        container = 'dict'
     elif isinstance(data, (list, tuple)):
         arrays = {_LIST_KEY % i: v.asnumpy() for i, v in enumerate(data)}
-        fmt = 'list'
+        container = 'list'
     else:
         raise ValueError('data must be NDArray, list or dict')
     with open(fname, 'wb') as f:  # savez would append .npz to a str path
-        np.savez(f, __format__=fmt, **arrays)
+        np.savez(f, __format__=container, **arrays)
 
 
 def load(fname):
+    """Load NDArrays; the reference's binary format is auto-detected by
+    its list magic, anything else parses as the native npz."""
+    with open(fname, 'rb') as f:
+        head = f.read(8)
+    if len(head) == 8 and struct.unpack('<Q', head)[0] == _LIST_MAGIC:
+        return _load_mxnet(fname)
     with np.load(fname, allow_pickle=False) as f:
-        fmt = str(f['__format__'])
+        container = str(f['__format__'])
         keys = [k for k in f.files if k != '__format__']
-        if fmt == 'list':
+        if container == 'list':
             out = []
             for i in range(len(keys)):
                 out.append(array(f[_LIST_KEY % i]))
             return out
         return {k: array(f[k]) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# Reference binary format (src/ndarray/ndarray.cc:814-1040)
+# ---------------------------------------------------------------------------
+
+class _Reader:
+    def __init__(self, buf):
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n):
+        b = self.buf[self.pos:self.pos + n]
+        if len(b) != n:
+            raise ValueError('truncated reference NDArray file')
+        self.pos += n
+        return b
+
+    def u32(self):
+        return struct.unpack('<I', self.take(4))[0]
+
+    def i32(self):
+        return struct.unpack('<i', self.take(4))[0]
+
+    def u64(self):
+        return struct.unpack('<Q', self.take(8))[0]
+
+    def shape(self):
+        ndim = self.u32()
+        return tuple(struct.unpack('<%dI' % ndim, self.take(4 * ndim)))
+
+
+def _read_one(r):
+    """One NDArray (ndarray.cc NDArray::Load / LegacyLoad)."""
+    magic = r.u32()
+    stype, sshape, nad = 0, None, 0
+    if magic == _V2_MAGIC:
+        stype = r.i32()
+        nad = {1: 1, 2: 2}.get(stype, 0)   # row_sparse / csr aux counts
+        if nad > 0:
+            sshape = r.shape()
+        shape = r.shape()
+    elif magic == _V1_MAGIC:
+        shape = r.shape()
+    else:
+        ndim = magic                       # legacy: the magic IS ndim
+        shape = tuple(struct.unpack('<%dI' % ndim, r.take(4 * ndim)))
+    if len(shape) == 0:
+        return array(np.zeros((0,), np.float32))
+    r.i32()  # dev_type (placement is ours to choose)
+    r.i32()  # dev_id
+    type_flag = r.i32()
+    dtype = _TYPE_FLAGS.get(type_flag)
+    if dtype is None:
+        raise ValueError('unknown reference dtype flag %d' % type_flag)
+    aux = []
+    for _ in range(nad):
+        at = r.i32()
+        ash = r.shape()
+        aux.append((_TYPE_FLAGS[at], ash))
+    data_shape = sshape if nad > 0 else shape
+    n = int(np.prod(data_shape)) if data_shape else 1
+    data = np.frombuffer(r.take(n * np.dtype(dtype).itemsize),
+                         dtype=dtype).reshape(data_shape)
+    aux_data = []
+    for at, ash in aux:
+        an = int(np.prod(ash)) if ash else 1
+        aux_data.append(np.frombuffer(
+            r.take(an * np.dtype(at).itemsize), dtype=at).reshape(ash))
+    if nad == 0:
+        return array(_guard_narrowing(data.copy()))
+    from . import sparse
+    if stype == 1:  # row_sparse: aux = [indices]
+        return sparse.RowSparseNDArray(
+            array(data.copy()), array(aux_data[0].astype(np.int64)),
+            shape)
+    # csr: aux = [indptr, indices] (ndarray.h:82-87 aux order)
+    return sparse.CSRNDArray(
+        array(data.copy()), array(aux_data[0].astype(np.int64)),
+        array(aux_data[1].astype(np.int64)), shape)
+
+
+def _guard_narrowing(npy):
+    """jax (x64 off) stores 64-bit payloads as 32-bit: raise on integer
+    overflow (silent wrap would corrupt saved indices), warn on float64
+    precision narrowing."""
+    if npy.dtype == np.int64:
+        if npy.size and (np.abs(npy) > np.iinfo(np.int32).max).any():
+            raise ValueError(
+                'reference file holds int64 values beyond int32 range; '
+                'this runtime (jax without x64) cannot represent them')
+        return npy
+    if npy.dtype == np.float64:
+        warnings.warn('float64 payload narrowed to float32 (jax x64 off)',
+                      stacklevel=3)
+    return npy
+
+
+def _load_mxnet(fname):
+    with open(fname, 'rb') as f:
+        r = _Reader(f.read())
+    assert r.u64() == _LIST_MAGIC
+    r.u64()  # reserved
+    n = r.u64()
+    arrays = [_read_one(r) for _ in range(n)]
+    n_names = r.u64()
+    names = []
+    for _ in range(n_names):
+        ln = r.u64()
+        names.append(r.take(ln).decode())
+    if not names:
+        return arrays
+    if len(names) != len(arrays):
+        raise ValueError('invalid reference NDArray file (name count)')
+    return dict(zip(names, arrays))
+
+
+def _shape_bytes(shape):
+    return struct.pack('<I', len(shape)) + \
+        struct.pack('<%dI' % len(shape), *shape)
+
+
+def _body_bytes(npy):
+    """context + type_flag + raw data (shared by dense and sparse)."""
+    return (struct.pack('<ii', 1, 0) +                   # cpu(0)
+            struct.pack('<i', _FLAG_OF[np.dtype(npy.dtype)]))
+
+
+def _write_one(f, arr):
+    from . import sparse as _sp
+    if isinstance(arr, _sp.BaseSparseNDArray):
+        return _write_sparse(f, arr)
+    npy = arr.asnumpy()
+    if np.dtype(npy.dtype) not in _FLAG_OF:
+        npy = npy.astype(np.float32)   # bf16 etc.: widen for the reference
+    if npy.ndim == 0:
+        # the reference has no 0-d arrays; its scalar convention is (1,)
+        npy = npy.reshape(1)
+    f.write(struct.pack('<I', _V2_MAGIC))
+    f.write(struct.pack('<i', 0))                        # kDefaultStorage
+    f.write(_shape_bytes(npy.shape))
+    f.write(_body_bytes(npy))
+    f.write(np.ascontiguousarray(npy).tobytes())
+
+
+def _write_sparse(f, arr):
+    """RowSparse (stype 1, aux [indices]) / CSR (stype 2, aux
+    [indptr, indices]) in the reference layout (ndarray.h:82-87)."""
+    from . import sparse as _sp
+    data = arr.data.asnumpy()
+    if np.dtype(data.dtype) not in _FLAG_OF:
+        data = data.astype(np.float32)
+    if isinstance(arr, _sp.RowSparseNDArray):
+        stype, auxes = 1, [arr.indices.asnumpy().astype(np.int64)]
+    else:
+        stype = 2
+        auxes = [arr.indptr.asnumpy().astype(np.int64),
+                 arr.indices.asnumpy().astype(np.int64)]
+    f.write(struct.pack('<I', _V2_MAGIC))
+    f.write(struct.pack('<i', stype))
+    f.write(_shape_bytes(data.shape))                    # storage shape
+    f.write(_shape_bytes(arr.shape))
+    f.write(_body_bytes(data))
+    for a in auxes:
+        f.write(struct.pack('<i', _FLAG_OF[np.dtype(a.dtype)]))
+        f.write(_shape_bytes(a.shape))
+    f.write(np.ascontiguousarray(data).tobytes())
+    for a in auxes:
+        f.write(np.ascontiguousarray(a).tobytes())
+
+
+def _save_mxnet(fname, data):
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    elif isinstance(data, (list, tuple)):
+        names, arrays = [], list(data)
+    else:
+        raise ValueError('data must be NDArray, list or dict')
+    with open(fname, 'wb') as f:
+        f.write(struct.pack('<QQ', _LIST_MAGIC, 0))
+        f.write(struct.pack('<Q', len(arrays)))
+        for a in arrays:
+            _write_one(f, a)
+        f.write(struct.pack('<Q', len(names)))
+        for nm in names:
+            b = nm.encode()
+            f.write(struct.pack('<Q', len(b)))
+            f.write(b)

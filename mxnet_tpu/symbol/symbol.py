@@ -515,31 +515,53 @@ def _sym_scalar(lhs, scalar, op_name):
 # load
 # ---------------------------------------------------------------------------
 
+def _entry(nodes, e):
+    """Graph entry [node, out_idx(, version)] — the reference wrote
+    2-element entries pre-0.9 and 3-element after."""
+    return (nodes[e[0]], e[1] if len(e) > 1 else 0)
+
+
 def load_json(json_str):
+    """Parse graph JSON — ours and the reference's (both the 0.11 form
+    with 'attrs' and the legacy form with 'param' op-attrs + 'attr'
+    user-attrs, e.g. tests/python/unittest/save_000800.json)."""
     g = json.loads(json_str)
     nodes = []
     for jn in g['nodes']:
         if jn['op'] == 'null':
             attr_dict = {}
-            for k, v in jn.get('attrs', {}).items():
-                if k.startswith('__user__'):
-                    attr_dict[k[len('__user__'):]] = v
-                else:
-                    attr_dict[k] = v
+            for src in (jn.get('attrs', {}), jn.get('attr', {})):
+                for k, v in src.items():
+                    if k.startswith('__user__'):
+                        attr_dict[k[len('__user__'):]] = v
+                    else:
+                        attr_dict[k] = v
             nodes.append(Node(None, {}, [], jn['name'], attr_dict))
         else:
             attrs = {}
-            attr_dict = {}
+            attr_dict = dict(jn.get('attr', {}))  # legacy user attrs
             for k, v in jn.get('attrs', jn.get('param', {})).items():
                 if k.startswith('__user__'):
                     attr_dict[k[len('__user__'):]] = v
                 else:
                     attrs[k] = _parse_attr(v)
-            inputs = [(nodes[i], idx) for i, idx, _ in jn['inputs']]
+            inputs = [_entry(nodes, e) for e in jn['inputs']]
+            # legacy graphs omit auxiliary-state inputs (BatchNorm
+            # moving_mean/var were implicit pre-0.9): synthesize ONLY
+            # the missing trailing aux variables, compose-named
+            if _reg.exists(jn['op']):
+                op = _reg.get(jn['op'])
+                names = op.input_names
+                n_aux = len(op.aux_inputs)
+                if n_aux and len(inputs) == len(names) - n_aux:
+                    for miss in names[len(inputs):]:
+                        inputs.append((Node(None, {}, [],
+                                            '%s_%s' % (jn['name'], miss),
+                                            {}), 0))
             nodes.append(Node(jn['op'], normalize_attrs(attrs), inputs,
                               jn['name'], attr_dict,
                               num_args=len(inputs)))
-    outputs = [(nodes[i], idx) for i, idx, _ in g['heads']]
+    outputs = [_entry(nodes, e) for e in g['heads']]
     return Symbol(outputs)
 
 

@@ -244,3 +244,89 @@ def test_waitall_is_a_barrier():
     # repeated calls are cheap no-ops
     mx.nd.waitall()
     mx.nd.waitall()
+
+
+def test_reference_format_roundtrip_and_handcrafted():
+    """The reference's binary .params format loads (auto-detected) and
+    saves (fmt='mxnet'). A hand-built byte stream locks the wire format
+    (ndarray.cc:809-1040) independently of our writer."""
+    import struct
+    import tempfile, os
+    from mxnet_tpu.ndarray import save, load
+
+    rng = np.random.RandomState(0)
+    d = {'arg:fc_weight': mx.nd.array(rng.randn(3, 4).astype(np.float32)),
+         'aux:bn_mean': mx.nd.array(rng.randn(5).astype(np.float32))}
+    with tempfile.TemporaryDirectory() as tmp:
+        path = os.path.join(tmp, 'model.params')
+        save(path, d, fmt='mxnet')
+        back = load(path)                      # auto-detects by magic
+        assert set(back) == set(d)
+        for k in d:
+            np.testing.assert_allclose(back[k].asnumpy(), d[k].asnumpy())
+
+        # hand-built stream: one float32 (2,3) array named 'w'
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        raw = struct.pack('<QQ', 0x112, 0)          # list magic, reserved
+        raw += struct.pack('<Q', 1)                 # 1 ndarray
+        raw += struct.pack('<I', 0xF993FAC9)        # V2 magic
+        raw += struct.pack('<i', 0)                 # kDefaultStorage
+        raw += struct.pack('<I', 2) + struct.pack('<2I', 2, 3)  # shape
+        raw += struct.pack('<ii', 2, 0)             # gpu(0) context
+        raw += struct.pack('<i', 0)                 # kFloat32
+        raw += arr.tobytes()
+        raw += struct.pack('<Q', 1)                 # 1 name
+        raw += struct.pack('<Q', 1) + b'w'
+        path2 = os.path.join(tmp, 'hand.params')
+        open(path2, 'wb').write(raw)
+        got = load(path2)
+        assert list(got) == ['w']
+        np.testing.assert_allclose(got['w'].asnumpy(), arr)
+
+        # list container (no names) + legacy V1 array
+        raw2 = struct.pack('<QQ', 0x112, 0) + struct.pack('<Q', 1)
+        raw2 += struct.pack('<I', 0xF993FAC8)       # V1 magic
+        raw2 += struct.pack('<I', 1) + struct.pack('<I', 4)
+        raw2 += struct.pack('<ii', 1, 0) + struct.pack('<i', 4)  # int32
+        raw2 += np.array([9, 8, 7, 6], np.int32).tobytes()
+        raw2 += struct.pack('<Q', 0)                # no names
+        path3 = os.path.join(tmp, 'legacy.ndarray')
+        open(path3, 'wb').write(raw2)
+        got2 = load(path3)
+        assert isinstance(got2, list) and len(got2) == 1
+        np.testing.assert_array_equal(got2[0].asnumpy(), [9, 8, 7, 6])
+
+        # npz path still the default
+        path4 = os.path.join(tmp, 'native.params')
+        save(path4, d)
+        back2 = load(path4)
+        np.testing.assert_allclose(back2['arg:fc_weight'].asnumpy(),
+                                   d['arg:fc_weight'].asnumpy())
+
+
+def test_reference_format_sparse_and_scalar():
+    import tempfile, os
+    from mxnet_tpu.ndarray import save, load
+    from mxnet_tpu.ndarray import sparse
+    dense = np.zeros((6, 3), np.float32)
+    dense[[1, 4]] = np.random.RandomState(0).randn(2, 3)
+    rsp = mx.nd.array(dense).tostype('row_sparse')
+    csr_dense = np.zeros((3, 5), np.float32)
+    csr_dense[0, 1] = 2.0
+    csr_dense[2, 4] = 3.0
+    csr = mx.nd.array(csr_dense).tostype('csr')
+    scalar = mx.nd.array(np.float32(7.5))
+    with tempfile.TemporaryDirectory() as tmp:
+        p = os.path.join(tmp, 'mixed.params')
+        save(p, {'rsp': rsp, 'csr': csr, 's': scalar, 'd': mx.nd.ones((2,))},
+             fmt='mxnet')
+        back = load(p)
+        assert back['rsp'].stype == 'row_sparse'
+        np.testing.assert_allclose(
+            back['rsp'].tostype('default').asnumpy(), dense)
+        assert back['csr'].stype == 'csr'
+        np.testing.assert_allclose(
+            back['csr'].tostype('default').asnumpy(), csr_dense)
+        # scalars persist via the reference's (1,) convention
+        np.testing.assert_allclose(back['s'].asnumpy(), [7.5])
+        np.testing.assert_allclose(back['d'].asnumpy(), [1.0, 1.0])

@@ -198,3 +198,70 @@ def test_infer_type_bf16_flows_and_int_does_not():
     # and simple_bind allocates grads in the arg dtype
     ex = net.simple_bind(mx.cpu(), data=(2, 6))
     assert str(ex.grad_dict['fc_weight'].dtype) == 'bfloat16'
+
+
+def test_load_legacy_reference_json():
+    """The reference's pre-0.9 graph JSON schema ('param' op attrs,
+    'attr' user attrs, 2-element graph entries) loads and executes
+    (schema of tests/python/unittest/save_000800.json)."""
+    import json
+    legacy = {
+        'nodes': [
+            {'op': 'null', 'param': {}, 'name': 'data', 'inputs': [],
+             'backward_source_id': -1,
+             'attr': {'ctx_group': 'stage1', 'lr_mult': '0.2'}},
+            {'op': 'null', 'param': {}, 'name': 'fc1_weight',
+             'inputs': [], 'backward_source_id': -1},
+            {'op': 'null', 'param': {}, 'name': 'fc1_bias',
+             'inputs': [], 'backward_source_id': -1},
+            {'op': 'FullyConnected',
+             'param': {'no_bias': 'False', 'num_hidden': '4'},
+             'name': 'fc1', 'inputs': [[0, 0], [1, 0], [2, 0]],
+             'backward_source_id': -1},
+            {'op': 'Activation', 'param': {'act_type': 'relu'},
+             'name': 'relu1', 'inputs': [[3, 0]],
+             'backward_source_id': -1},
+            {'op': 'null', 'param': {}, 'name': 'softmax_label',
+             'inputs': [], 'backward_source_id': -1},
+            {'op': 'SoftmaxOutput',
+             'param': {'grad_scale': '1', 'ignore_label': '-1',
+                       'multi_output': 'False', 'normalization': 'null',
+                       'preserve_shape': 'False', 'use_ignore': 'False'},
+             'name': 'softmax', 'inputs': [[4, 0], [5, 0]],
+             'backward_source_id': -1,
+             'attr': {'ctx_group': 'stage2'}},
+        ],
+        'arg_nodes': [0, 1, 2, 5],
+        'heads': [[6, 0]],
+    }
+    s = mx.sym.load_json(json.dumps(legacy))
+    assert s.list_arguments() == ['data', 'fc1_weight', 'fc1_bias',
+                                  'softmax_label']
+    assert s.attr_dict().get('data', {}).get('ctx_group') == 'stage1'
+    rng = np.random.RandomState(0)
+    args = {'data': nd.array(rng.randn(2, 5).astype(np.float32)),
+            'fc1_weight': nd.array(rng.randn(4, 5).astype(np.float32)),
+            'fc1_bias': nd.zeros((4,)),
+            'softmax_label': nd.array(np.array([0, 1], np.float32))}
+    out = s.bind(mx.cpu(), args).forward()[0].asnumpy()
+    assert out.shape == (2, 4)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
+
+
+def test_load_actual_reference_checkpoint_json():
+    """End-to-end: the reference repo's own saved graph (BatchNorm aux
+    synthesis included) binds and runs. Skipped when the reference
+    checkout is absent."""
+    path = '/root/reference/tests/python/unittest/save_000800.json'
+    if not os.path.exists(path):
+        pytest.skip('reference checkout not present')
+    s = mx.sym.load(path)
+    assert s.list_auxiliary_states() == [
+        'batchnorm0_moving_mean', 'batchnorm0_moving_var']
+    ex = s.simple_bind(mx.cpu(), data=(2, 10))
+    rng = np.random.RandomState(0)
+    for k in ex.arg_dict:
+        ex.arg_dict[k][:] = rng.randn(*ex.arg_dict[k].shape) * 0.1
+    out = ex.forward()[0].asnumpy()
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(1), 1.0, rtol=1e-5)
