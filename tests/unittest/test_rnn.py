@@ -170,3 +170,29 @@ def test_bucket_sentence_iter():
     for b in batches:
         assert b.data[0].shape[0] == 2
         assert b.data[0].shape[1] in (4, 8)
+
+
+def test_rnn_checkpoint_roundtrip(tmp_path):
+    """save/load_rnn_checkpoint pack cell weights into fused form and
+    back (reference rnn/rnn.py:32-95)."""
+    from mxnet_tpu.rnn.rnn import save_rnn_checkpoint, load_rnn_checkpoint
+    cell = rnn.LSTMCell(H, prefix='ck_')
+    rng = RNG(5)
+    arg_params = {
+        'ck_i2h_weight': nd.array(rng.randn(4 * H, D).astype(np.float32)),
+        'ck_i2h_bias': nd.array(rng.randn(4 * H).astype(np.float32)),
+        'ck_h2h_weight': nd.array(rng.randn(4 * H, H).astype(np.float32)),
+        'ck_h2h_bias': nd.array(rng.randn(4 * H).astype(np.float32)),
+    }
+    data = mx.sym.Variable('data')
+    inputs = [mx.sym.slice_axis(data, axis=1, begin=i, end=i + 1)
+              .reshape((B, D)) for i in range(T)]
+    outputs, _ = cell.unroll(T, inputs=inputs, merge_outputs=True)
+    prefix = str(tmp_path / 'rnnmodel')
+    save_rnn_checkpoint([cell], prefix, 3, outputs, arg_params, {})
+    sym2, args2, aux2 = load_rnn_checkpoint([cell], prefix, 3)
+    assert sorted(args2) == sorted(arg_params)
+    for k in arg_params:
+        np.testing.assert_allclose(args2[k].asnumpy(),
+                                   arg_params[k].asnumpy(), rtol=1e-6)
+    assert sym2.list_outputs() == outputs.list_outputs()
