@@ -99,8 +99,12 @@ class _Reader:
         return struct.unpack('<Q', self.take(8))[0]
 
     def shape(self):
+        # TShape::Save (nnvm Tuple): uint32 ndim + int64 per-dim —
+        # NDARRAY_V1_MAGIC marks exactly the int64_t TShape change
+        # (reference src/ndarray/ndarray.cc:806-812); uint32 dims exist
+        # only in the pre-V1 magic-as-ndim legacy branch.
         ndim = self.u32()
-        return tuple(struct.unpack('<%dI' % ndim, self.take(4 * ndim)))
+        return tuple(struct.unpack('<%dq' % ndim, self.take(8 * ndim)))
 
 
 def _read_one(r):
@@ -143,14 +147,14 @@ def _read_one(r):
     if nad == 0:
         return array(_guard_narrowing(data.copy()))
     from . import sparse
+    data = _guard_narrowing(data.copy())
+    aux_data = [_guard_narrowing(a.astype(np.int64)) for a in aux_data]
     if stype == 1:  # row_sparse: aux = [indices]
         return sparse.RowSparseNDArray(
-            array(data.copy()), array(aux_data[0].astype(np.int64)),
-            shape)
+            array(data), array(aux_data[0]), shape)
     # csr: aux = [indptr, indices] (ndarray.h:82-87 aux order)
     return sparse.CSRNDArray(
-        array(data.copy()), array(aux_data[0].astype(np.int64)),
-        array(aux_data[1].astype(np.int64)), shape)
+        array(data), array(aux_data[0]), array(aux_data[1]), shape)
 
 
 def _guard_narrowing(npy):
@@ -189,8 +193,10 @@ def _load_mxnet(fname):
 
 
 def _shape_bytes(shape):
+    # uint32 ndim + int64 dims, matching TShape::Save under V1/V2 magics
+    # (reference src/ndarray/ndarray.cc:806-812).
     return struct.pack('<I', len(shape)) + \
-        struct.pack('<%dI' % len(shape), *shape)
+        struct.pack('<%dq' % len(shape), *shape)
 
 
 def _body_bytes(npy):

@@ -271,7 +271,8 @@ def test_reference_format_roundtrip_and_handcrafted():
         raw += struct.pack('<Q', 1)                 # 1 ndarray
         raw += struct.pack('<I', 0xF993FAC9)        # V2 magic
         raw += struct.pack('<i', 0)                 # kDefaultStorage
-        raw += struct.pack('<I', 2) + struct.pack('<2I', 2, 3)  # shape
+        # TShape under V2: uint32 ndim + int64 dims (ndarray.cc:806-812)
+        raw += struct.pack('<I', 2) + struct.pack('<2q', 2, 3)  # shape
         raw += struct.pack('<ii', 2, 0)             # gpu(0) context
         raw += struct.pack('<i', 0)                 # kFloat32
         raw += arr.tobytes()
@@ -286,7 +287,7 @@ def test_reference_format_roundtrip_and_handcrafted():
         # list container (no names) + legacy V1 array
         raw2 = struct.pack('<QQ', 0x112, 0) + struct.pack('<Q', 1)
         raw2 += struct.pack('<I', 0xF993FAC8)       # V1 magic
-        raw2 += struct.pack('<I', 1) + struct.pack('<I', 4)
+        raw2 += struct.pack('<I', 1) + struct.pack('<q', 4)  # int64 dims
         raw2 += struct.pack('<ii', 1, 0) + struct.pack('<i', 4)  # int32
         raw2 += np.array([9, 8, 7, 6], np.int32).tobytes()
         raw2 += struct.pack('<Q', 0)                # no names
@@ -295,6 +296,18 @@ def test_reference_format_roundtrip_and_handcrafted():
         got2 = load(path3)
         assert isinstance(got2, list) and len(got2) == 1
         np.testing.assert_array_equal(got2[0].asnumpy(), [9, 8, 7, 6])
+
+        # pre-V1 legacy: the magic IS ndim and dims are uint32
+        # (ndarray.cc LegacyTShapeLoad default branch)
+        raw3 = struct.pack('<QQ', 0x112, 0) + struct.pack('<Q', 1)
+        raw3 += struct.pack('<I', 2) + struct.pack('<2I', 1, 3)
+        raw3 += struct.pack('<ii', 1, 0) + struct.pack('<i', 0)
+        raw3 += np.array([[1, 2, 3]], np.float32).tobytes()
+        raw3 += struct.pack('<Q', 0)
+        path5 = os.path.join(tmp, 'prev1.ndarray')
+        open(path5, 'wb').write(raw3)
+        got3 = load(path5)
+        np.testing.assert_array_equal(got3[0].asnumpy(), [[1, 2, 3]])
 
         # npz path still the default
         path4 = os.path.join(tmp, 'native.params')
