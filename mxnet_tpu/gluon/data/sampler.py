@@ -1,6 +1,8 @@
 """Gluon samplers (reference python/mxnet/gluon/data/sampler.py)."""
 import numpy as np
 
+from ... import random as _random
+
 __all__ = ['Sampler', 'SequentialSampler', 'RandomSampler', 'BatchSampler']
 
 
@@ -29,7 +31,7 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         indices = np.arange(self._length)
-        np.random.shuffle(indices)
+        _random.host_rng().shuffle(indices)
         return iter(indices)
 
     def __len__(self):

@@ -18,6 +18,7 @@ import random
 import numpy as np
 
 from ..io import DataIter, DataBatch, DataDesc
+from .. import random as _random
 from ..ndarray.ndarray import NDArray, array as nd_array
 from .. import recordio
 
@@ -308,7 +309,7 @@ class LightingAug(Augmenter):
         self.eigvec = np.asarray(eigvec, np.float32)
 
     def __call__(self, src):
-        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        alpha = _random.host_rng().normal(0, self.alphastd, size=(3,))
         rgb = np.dot(self.eigvec * alpha, self.eigval)
         return np.asarray(src, np.float32) + rgb
 

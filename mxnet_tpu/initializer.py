@@ -144,7 +144,7 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        arr[:] = np.random.uniform(-self.scale, self.scale, arr.shape)
+        arr[:] = _random.host_rng().uniform(-self.scale, self.scale, arr.shape)
 
 
 @register
@@ -154,7 +154,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        arr[:] = np.random.normal(0, self.sigma, arr.shape)
+        arr[:] = _random.host_rng().normal(0, self.sigma, arr.shape)
 
 
 @register
@@ -168,9 +168,9 @@ class Orthogonal(Initializer):
         nout = arr.shape[0]
         nin = int(np.prod(arr.shape[1:]))
         if self.rand_type == 'uniform':
-            tmp = np.random.uniform(-1.0, 1.0, (nout, nin))
+            tmp = _random.host_rng().uniform(-1.0, 1.0, (nout, nin))
         else:
-            tmp = np.random.normal(0.0, 1.0, (nout, nin))
+            tmp = _random.host_rng().normal(0.0, 1.0, (nout, nin))
         u, _, v = np.linalg.svd(tmp, full_matrices=False)
         q = u if u.shape == tmp.shape else v
         arr[:] = self.scale * q.reshape(arr.shape)
@@ -203,9 +203,9 @@ class Xavier(Initializer):
             factor = fan_out
         scale = np.sqrt(self.magnitude / factor)
         if self.rnd_type == 'uniform':
-            arr[:] = np.random.uniform(-scale, scale, arr.shape)
+            arr[:] = _random.host_rng().uniform(-scale, scale, arr.shape)
         else:
-            arr[:] = np.random.normal(0, scale, arr.shape)
+            arr[:] = _random.host_rng().normal(0, scale, arr.shape)
 
 
 @register
@@ -294,7 +294,7 @@ class FusedRNN(Initializer):
         self._forget_bias = forget_bias
 
     def _init_weight(self, desc, arr):
-        arr[:] = np.random.uniform(-0.07, 0.07, arr.shape) \
+        arr[:] = _random.host_rng().uniform(-0.07, 0.07, arr.shape) \
             if self._init is None else arr.asnumpy()
         if self._init is not None:
             a = np.zeros(arr.shape, dtype='float32')

@@ -18,6 +18,8 @@ import threading
 
 import numpy as np
 
+from .. import random as _random
+
 from ..ndarray import NDArray, array
 from ..base import MXNetError
 
@@ -135,7 +137,7 @@ class NDArrayIter(DataIter):
 
         self.idx = np.arange(self.data[0][1].shape[0])
         if shuffle:
-            np.random.shuffle(self.idx)
+            _random.host_rng().shuffle(self.idx)
         self._shuffle = shuffle
 
         if last_batch_handle == 'discard':
@@ -169,7 +171,7 @@ class NDArrayIter(DataIter):
 
     def reset(self):
         if self._shuffle:
-            np.random.shuffle(self.idx)
+            _random.host_rng().shuffle(self.idx)
         if self.last_batch_handle == 'roll_over' and \
                 self.cursor > self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % self.batch_size
@@ -602,7 +604,7 @@ class ImageRecordIter(DataIter):
 
     def next(self):
         batch = self._inner.next()
-        if self._rand_mirror and np.random.rand() < 0.5:
+        if self._rand_mirror and _random.host_rng().rand() < 0.5:
             batch = DataBatch([d.flip(axis=3) if d.ndim == 4 else d
                                for d in batch.data],
                               batch.label, batch.pad, batch.index,
@@ -733,7 +735,7 @@ class ImageDetRecordIter(DataIter):
 
     def next(self):
         batch = self._inner.next()
-        if self._rand_mirror and np.random.rand() < 0.5:
+        if self._rand_mirror and _random.host_rng().rand() < 0.5:
             batch = self._mirror_batch(batch)
         return batch
 

@@ -10,19 +10,34 @@ import threading
 import jax
 import numpy as _np
 
-__all__ = ['seed', 'next_key']
+__all__ = ['seed', 'next_key', 'host_rng']
 
 _lock = threading.Lock()
 # lazy: creating a key initializes the jax backend, which must not happen
 # at import time (slow/fragile through the TPU tunnel)
 _key = None
+# framework-private host-side stream for initializers / iterator shuffles.
+# Private so mx.random.seed is hermetic WITHOUT clobbering the user's
+# process-global numpy state (the reference's mx.random.seed doesn't
+# touch numpy either).
+_host_rng = _np.random.RandomState()
+
+
+def host_rng():
+    """The framework's host-side numpy stream (initializers, shuffles)."""
+    return _host_rng
 
 
 def seed(seed_state):
-    """Seed all device RNG streams (reference random.py:30 mx.random.seed)."""
+    """Seed all framework RNG streams (reference random.py:30
+    mx.random.seed): the device key stream AND the framework's host-side
+    stream that initializers / iterator shuffles draw from — without
+    the latter, suite ordering leaks into init and `seed` is not
+    hermetic."""
     global _key
     with _lock:
         _key = jax.random.PRNGKey(int(seed_state))
+        _host_rng.seed(int(seed_state) % (2 ** 32))
 
 
 def next_key():
@@ -30,6 +45,6 @@ def next_key():
     global _key
     with _lock:
         if _key is None:
-            _key = jax.random.PRNGKey(_np.random.randint(0, 2**31 - 1))
+            _key = jax.random.PRNGKey(_host_rng.randint(0, 2**31 - 1))
         _key, sub = jax.random.split(_key)
         return sub

@@ -9,6 +9,7 @@ import random
 import numpy as np
 
 from ..io import DataIter, DataBatch, DataDesc
+from .. import random as _random
 from ..ndarray import array
 
 __all__ = ['encode_sentences', 'BucketSentenceIter']
@@ -103,7 +104,7 @@ class BucketSentenceIter(DataIter):
         self.curr_idx = 0
         random.shuffle(self.idx)
         for buck in self.data:
-            np.random.shuffle(buck)
+            _random.host_rng().shuffle(buck)
 
         self.nddata = []
         self.ndlabel = []

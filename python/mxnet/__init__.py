@@ -1,0 +1,53 @@
+"""`mxnet` compatibility alias — makes reference scripts run unmodified.
+
+Importing this package replaces the ``mxnet`` entry in ``sys.modules``
+with :mod:`mxnet_tpu` and installs a meta-path finder so that every
+``mxnet.X`` submodule import resolves to the already-loaded
+``mxnet_tpu.X`` module object (never a second copy — a re-executed
+module would duplicate registry state).
+
+Usage: put this directory's parent on ``PYTHONPATH`` (it mirrors the
+reference's ``python/mxnet`` layout) and run any reference script:
+
+    PYTHONPATH=/root/repo/python:/root/repo python train_mnist.py ...
+
+Reference: python/mxnet/__init__.py (the public namespace this forwards
+to, re-exported by mxnet_tpu/__init__.py).
+"""
+import importlib
+import importlib.abc
+import importlib.util
+import sys
+
+_PKG = 'mxnet_tpu'
+
+
+class _AliasFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
+    """Route ``mxnet[.sub]`` imports to the ``mxnet_tpu`` module objects."""
+
+    def find_spec(self, fullname, path=None, target=None):
+        if fullname == 'mxnet' or fullname.startswith('mxnet.'):
+            return importlib.util.spec_from_loader(fullname, self)
+        return None
+
+    def create_module(self, spec):
+        real_name = _PKG + spec.name[len('mxnet'):]
+        return importlib.import_module(real_name)
+
+    def exec_module(self, module):
+        pass  # the real module is already executed
+
+
+def _install():
+    real = importlib.import_module(_PKG)
+    # alias already-imported submodules so `from mxnet.gluon import nn`
+    # style imports hit the same objects
+    for name, mod in list(sys.modules.items()):
+        if name == _PKG or name.startswith(_PKG + '.'):
+            sys.modules['mxnet' + name[len(_PKG):]] = mod
+    if not any(isinstance(f, _AliasFinder) for f in sys.meta_path):
+        sys.meta_path.insert(0, _AliasFinder())
+    return real
+
+
+_install()

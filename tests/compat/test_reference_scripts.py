@@ -1,0 +1,103 @@
+"""Reference example scripts run UNMODIFIED against this framework.
+
+The north-star compatibility claim (SURVEY.md §6): a reference user
+points ``PYTHONPATH`` at ``python/`` (the ``mxnet`` alias package) and
+their training scripts work as-is. These tests execute the actual
+script files from ``/root/reference/example/`` — zero edits — in a
+subprocess whose only framework-visible difference is the alias on
+``PYTHONPATH``.
+
+Data: the scripts download MNIST when ``data/`` is missing (zero egress
+here), so we pre-generate idx-format files from the same synthetic
+class-separable distribution the hermetic tests use — the scripts'
+``download_file``/``GetMNIST_ubyte`` helpers skip existing files
+(reference example/image-classification/common/util.py:27,
+tests/python/common/get_data.py:34).
+"""
+import gzip
+import os
+import re
+import struct
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
+REF_EXAMPLE = '/root/reference/example'
+
+
+def _synthetic_mnist(n, seed):
+    from mxnet_tpu.io import synthetic_mnist
+    images, labels = synthetic_mnist(n, seed=seed)
+    return (images * 255).astype(np.uint8), labels.astype(np.uint8)
+
+
+def _write_idx(dirpath, train_n=4096, test_n=1024, gz=True):
+    """MNIST idx files (big-endian magics 2051/2049, yann.lecun layout)."""
+    os.makedirs(dirpath, exist_ok=True)
+    opener = (lambda p: gzip.open(p + '.gz', 'wb')) if gz else \
+        (lambda p: open(p, 'wb'))
+    for tag, n, seed in (('train', train_n, 3), ('t10k', test_n, 9)):
+        images, labels = _synthetic_mnist(n, seed)
+        with opener(os.path.join(dirpath, '%s-images-idx3-ubyte' % tag)) as f:
+            f.write(struct.pack('>IIII', 2051, n, 28, 28))
+            f.write(images.tobytes())
+        with opener(os.path.join(dirpath, '%s-labels-idx1-ubyte' % tag)) as f:
+            f.write(struct.pack('>II', 2049, n))
+            f.write(labels.tobytes())
+
+
+def _run_reference_script(script_path, argv, cwd, timeout=540):
+    """Execute an unmodified reference script with the mxnet alias on
+    PYTHONPATH. The -c shim only pins the platform to CPU (sitecustomize
+    pre-pins a TPU platform) and sets argv — the script file is run
+    verbatim via runpy."""
+    env = dict(os.environ)
+    env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
+    env['JAX_PLATFORMS'] = 'cpu'
+    env['PYTHONPATH'] = os.path.join(ROOT, 'python') + os.pathsep + ROOT
+    script_dir = os.path.dirname(script_path)
+    code = (
+        "import jax; jax.config.update('jax_platforms','cpu');"
+        "import sys, runpy; sys.path.insert(0, %r); sys.argv=[%r]+%r;"
+        "runpy.run_path(%r, run_name='__main__')"
+        % (script_dir, os.path.basename(script_path), argv, script_path))
+    return subprocess.run([sys.executable, '-c', code], env=env,
+                          capture_output=True, text=True, timeout=timeout,
+                          cwd=cwd)
+
+
+def test_train_mnist_unmodified(tmp_path):
+    """example/image-classification/train_mnist.py:1-96 (mlp network,
+    common/fit.py fit loop) converges on synthetic MNIST."""
+    _write_idx(str(tmp_path / 'data'), gz=True)
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'image-classification', 'train_mnist.py'),
+        ['--network', 'mlp', '--num-epochs', '2', '--disp-batches', '25'],
+        cwd=str(tmp_path))
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert float(accs[-1]) > 0.9, out[-4000:]
+
+
+def test_gluon_image_classification_unmodified(tmp_path):
+    """example/gluon/image_classification.py (hybridized resnet18_v1
+    thumbnail on MNIST via MNISTIter) trains and validates."""
+    _write_idx(str(tmp_path / 'data'), train_n=1024, test_n=256, gz=False)
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'gluon', 'image_classification.py'),
+        ['--model', 'resnet18_v1', '--use_thumbnail', '--mode', 'hybrid',
+         '--dataset', 'mnist', '--epochs', '1', '--batch-size', '64',
+         '--log-interval', '10'],
+        cwd=str(tmp_path))
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = re.findall(r'validation: accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert float(accs[-1]) > 0.5, out[-4000:]
+    # the script's own save_params output exists
+    assert os.path.exists(str(tmp_path / 'image-classifier-resnet18_v1-1.params'))
