@@ -4,14 +4,18 @@ detection.py: DetAugmenter classes and ImageDetIter).
 Labels are [N, 5]: (cls, xmin, ymin, xmax, ymax) normalized to [0, 1],
 -1 rows are padding — the MultiBoxTarget convention
 (ops/contrib_ops.py)."""
-import random
-
 import numpy as np
+
+from .. import random as _random
 
 from ..io import DataIter, DataBatch, DataDesc
 from ..ndarray.ndarray import array as nd_array
 from .image import (Augmenter, imresize, ImageIter, resize_short,
                     HorizontalFlipAug)
+
+# framework-private stdlib-style stream: mx.random.seed controls it,
+# user-global `random` state is untouched
+random = _random.host_pyrng()
 
 __all__ = ['DetAugmenter', 'DetBorrowAug', 'DetRandomSelectAug',
            'DetHorizontalFlipAug', 'DetRandomCropAug', 'DetRandomPadAug',

@@ -13,7 +13,6 @@ convention), transposed to CHW at batching.
 """
 import logging
 import os
-import random
 
 import numpy as np
 
@@ -21,6 +20,10 @@ from ..io import DataIter, DataBatch, DataDesc
 from .. import random as _random
 from ..ndarray.ndarray import NDArray, array as nd_array
 from .. import recordio
+
+# framework-private stdlib-style stream: mx.random.seed controls it,
+# user-global `random` state is untouched
+random = _random.host_pyrng()
 
 __all__ = ['ImageIter', 'Augmenter', 'CreateAugmenter']
 

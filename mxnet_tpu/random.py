@@ -5,12 +5,13 @@ Reference: python/mxnet/random.py (mx.random.seed) + src/resource.cc:84
 counter-split key; every random op consumes one fresh subkey, passed to the
 op as a trailing array argument so the op itself stays pure/jittable.
 """
+import random as _pyrandom
 import threading
 
 import jax
 import numpy as _np
 
-__all__ = ['seed', 'next_key', 'host_rng']
+__all__ = ['seed', 'next_key', 'host_rng', 'host_pyrng']
 
 _lock = threading.Lock()
 # lazy: creating a key initializes the jax backend, which must not happen
@@ -21,11 +22,17 @@ _key = None
 # process-global numpy state (the reference's mx.random.seed doesn't
 # touch numpy either).
 _host_rng = _np.random.RandomState()
+_host_pyrng = _pyrandom.Random()
 
 
 def host_rng():
     """The framework's host-side numpy stream (initializers, shuffles)."""
     return _host_rng
+
+
+def host_pyrng():
+    """The framework's host-side stdlib stream (augmenter gates etc.)."""
+    return _host_pyrng
 
 
 def seed(seed_state):
@@ -38,6 +45,7 @@ def seed(seed_state):
     with _lock:
         _key = jax.random.PRNGKey(int(seed_state))
         _host_rng.seed(int(seed_state) % (2 ** 32))
+        _host_pyrng.seed(int(seed_state))
 
 
 def next_key():

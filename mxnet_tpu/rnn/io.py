@@ -4,13 +4,16 @@ Reference: python/mxnet/rnn/io.py — encode_sentences + BucketSentenceIter
 (assigns sentences to length buckets; feeds BucketingModule).
 """
 import bisect
-import random
 
 import numpy as np
 
 from ..io import DataIter, DataBatch, DataDesc
 from .. import random as _random
 from ..ndarray import array
+
+# framework-private stdlib-style stream: mx.random.seed controls it,
+# user-global `random` state is untouched
+random = _random.host_pyrng()
 
 __all__ = ['encode_sentences', 'BucketSentenceIter']
 

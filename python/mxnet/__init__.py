@@ -27,6 +27,15 @@ class _AliasFinder(importlib.abc.MetaPathFinder, importlib.abc.Loader):
 
     def find_spec(self, fullname, path=None, target=None):
         if fullname == 'mxnet' or fullname.startswith('mxnet.'):
+            # only claim names whose mxnet_tpu counterpart exists, so
+            # find_spec-based feature probes stay truthful and missing
+            # imports raise under the name the user asked for
+            real_name = _PKG + fullname[len('mxnet'):]
+            try:
+                if importlib.util.find_spec(real_name) is None:
+                    return None
+            except (ImportError, ValueError):
+                return None
             return importlib.util.spec_from_loader(fullname, self)
         return None
 
