@@ -72,6 +72,10 @@ class _GraphProgram:
         self.aux_names = symbol.list_auxiliary_states()
         self.outputs = list(symbol._outputs)
         self._aux_set = set(self.aux_names)
+        # host ops (image codecs, legacy callback bridges) cannot be
+        # traced; their presence forces the staged per-op path
+        self.has_host_ops = any(not n.is_variable() and n.opdef().host
+                                for n in self.topo)
 
     def make_runner(self):
         """Build run(arg_arrays, aux_arrays, key, is_train) ->
@@ -98,7 +102,12 @@ class _GraphProgram:
                 ins = [env[_entry_key(p, i)] for p, i in node.inputs]
                 if op.needs_rng:
                     ins.append(jax.random.fold_in(key, ni))
-                outs = op.fn(attrs, *ins)
+                if op.host:
+                    # pure_callback bridge: host python at execution time,
+                    # traceable (and differentiable via legacy backward)
+                    outs = _reg.host_bridge(op, attrs)(*ins)
+                else:
+                    outs = op.fn(attrs, *ins)
                 if not isinstance(outs, (tuple, list)):
                     outs = (outs,)
                 for i, o in enumerate(outs):
@@ -305,7 +314,8 @@ class Executor:
 
     # -- staged (group2ctx / monitor) mode --------------------------------
     def _use_staged(self):
-        return self._group2ctx is not None or self._monitor is not None
+        return (self._group2ctx is not None or self._monitor is not None
+                or self._prog.has_host_ops)
 
     def _node_device(self, node):
         if self._group2ctx:

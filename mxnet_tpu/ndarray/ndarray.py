@@ -13,6 +13,8 @@ hazards cannot exist (buffers are immutable), which deletes the entire
 ThreadedVar dependency-queue machinery (threaded_engine.h:111-213) with no
 loss of semantics.
 """
+import functools
+
 import numpy as np
 
 import jax
@@ -512,7 +514,15 @@ def invoke(op_name, inputs, attrs=None, out=None):
     recording = _ag.is_recording() and op.differentiable and any(
         i._node is not None or i._leaf is not None for i in inputs)
 
-    f = _reg.jitted(op_name, attrs)
+    if op.host:
+        # host ops (image codecs, legacy callback bridges) run python on
+        # concrete arrays. When the tape needs a vjp they go through the
+        # pure_callback bridge (traceable, legacy-backward-aware);
+        # otherwise they are applied directly.
+        f = (_reg.host_bridge(op, attrs) if recording
+             else functools.partial(op.fn, attrs))
+    else:
+        f = _reg.jitted(op_name, attrs)
     node = None
     if recording:
         outs, vjp_fn = jax.vjp(f, *arrays)

@@ -198,3 +198,72 @@ def _custom_fn(attrs, *arrays):
     if len(out_nd) == 1:
         return out_nd[0]._data
     return tuple(o._data for o in out_nd)
+
+
+# ---------------------------------------------------------------------------
+# Legacy pre-CustomOp python operator API (reference operator.py:36-242
+# PythonOp/NumpyOp and :243-380 NDArrayOp, bridged by src/operator/
+# native_op.cc and ndarray_op.cc). get_symbol() builds a `_Native` /
+# `_NDArray` symbol whose `info` attr keys the live instance (the
+# reference passes a callback-struct pointer the same way).
+# ---------------------------------------------------------------------------
+
+class PythonOp:
+    """Base class for legacy python operators (reference operator.py:36)."""
+
+    _op_name = '_Native'
+    _ref_holder = []  # keep instances alive, like the reference
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def __call__(self, *args, **kwargs):
+        return self.get_symbol(*args, **kwargs)
+
+    def get_symbol(self, *args, **kwargs):
+        from .ops.legacy_ops import register_legacy_callback
+        # the callback table holds the only (permanent) strong reference
+        kwargs['info'] = register_legacy_callback(self)
+        from . import symbol as _sym_mod
+        make = getattr(_sym_mod._internal, self._op_name)
+        return make(*args, **kwargs)
+
+    def forward(self, in_data, out_data):
+        out_data[0][:] = in_data[0]
+
+    def backward(self, out_grad, in_data, out_data, in_grad):
+        in_grad[0][:] = 1.0
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]]
+
+    def list_outputs(self):
+        return ['output']
+
+    def list_arguments(self):
+        return ['data']
+
+    def need_top_grad(self):
+        return self.need_top_grad_
+
+
+class NumpyOp(PythonOp):
+    """Legacy numpy operator (reference operator.py:158 NumpyOp)."""
+    _op_name = '_Native'
+
+
+class NDArrayOp(PythonOp):
+    """Legacy NDArray operator (reference operator.py:243): callbacks
+    receive NDArrays rather than numpy buffers."""
+    _op_name = '_NDArray'
+
+    def declare_backward_dependency(self, out_grad, in_data, out_data):
+        deps = []
+        if self.need_top_grad_:
+            deps.extend(out_grad)
+        deps.extend(in_data)
+        deps.extend(out_data)
+        return deps
+
+
+__all__ += ['PythonOp', 'NumpyOp', 'NDArrayOp']

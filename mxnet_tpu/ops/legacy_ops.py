@@ -1,0 +1,285 @@
+"""Legacy registration names — completes the registry superset.
+
+The reference registers ~110 legacy/alias names beyond the modern op
+set: capitalized NDArray-function forms (src/operator/tensor/
+elemwise_binary_op.cc `.add_alias("_Plus")` etc.), `_sample_*` alias
+names (src/operator/random/sample_op.cc:50-148), `_sparse_*` alias
+names, opencv host codecs (src/io/image_io.cc), legacy plugin bridges
+(plugin/, src/operator/native_op.cc, ndarray_op.cc), Convolution_v1
+(src/operator/convolution_v1.cc) and CuDNNBatchNorm
+(src/operator/cudnn_batch_norm.cc). Here every one of those names
+resolves: aliases point at the same OpDef; the rest are real
+implementations (host ops for the codecs/bridges).
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from . import registry as _reg
+from ..base import MXNetError
+
+# ---------------------------------------------------------------------------
+# pure aliases: legacy name -> modern registration (same OpDef object)
+# ---------------------------------------------------------------------------
+
+_ALIASES = {
+    # capitalized NDArray-function binary forms (elemwise_binary_op.cc)
+    '_Plus': '_plus', '_Minus': '_minus', '_Mul': '_mul', '_Div': '_div',
+    '_Mod': '_mod', '_Power': '_power', '_Maximum': '_maximum',
+    '_Minimum': '_minimum', '_Hypot': '_hypot', '_Equal': '_equal',
+    '_Not_Equal': '_not_equal', '_Greater': '_greater',
+    '_Greater_Equal': '_greater_equal', '_Lesser': '_lesser',
+    '_Lesser_Equal': '_lesser_equal',
+    # ...and their scalar forms (elemwise_binary_scalar_op_*.cc)
+    '_PlusScalar': '_plus_scalar', '_MinusScalar': '_minus_scalar',
+    '_RMinusScalar': '_rminus_scalar', '_MulScalar': '_mul_scalar',
+    '_DivScalar': '_div_scalar', '_RDivScalar': '_rdiv_scalar',
+    '_ModScalar': '_mod_scalar', '_RModScalar': '_rmod_scalar',
+    '_PowerScalar': '_power_scalar', '_RPowerScalar': '_rpower_scalar',
+    '_MaximumScalar': '_maximum_scalar', '_MinimumScalar': '_minimum_scalar',
+    '_HypotScalar': '_hypot_scalar', '_EqualScalar': '_equal_scalar',
+    '_NotEqualScalar': '_not_equal_scalar', '_GreaterScalar': '_greater_scalar',
+    '_GreaterEqualScalar': '_greater_equal_scalar',
+    '_LesserScalar': '_lesser_scalar',
+    '_LesserEqualScalar': '_lesser_equal_scalar',
+    # broadcast arithmetic aliases (elemwise_binary_broadcast_op_basic.cc)
+    'broadcast_plus': 'broadcast_add', 'broadcast_minus': 'broadcast_sub',
+    # sampler alias names (sample_op.cc:50-148)
+    '_sample_negbinomial': '_random_negative_binomial',
+    '_sample_gennegbinomial': '_random_generalized_negative_binomial',
+    # sparse alias names (storage-variant registrations; compute here is
+    # the dense lowering per the sparse ADR)
+    '_sparse_ElementWiseSum': 'add_n', '_sparse_add_n': 'add_n',
+    '_sparse_elemwise_add': 'elemwise_add',
+    '_sparse_cast_storage': 'cast_storage', '_sparse_dot': 'dot',
+    '_sparse_slice': 'slice', '_sparse_zeros_like': 'zeros_like',
+    # ctc loss contrib alias (contrib/ctc_loss.cc)
+    '_contrib_ctc_loss': 'ctc_loss',
+    # cudnn batch norm: same math, cudnn is a GPU implementation detail
+    # (cudnn_batch_norm.cc) — XLA owns the kernel choice here
+    'CuDNNBatchNorm': 'BatchNorm',
+    # backward of broadcast_to = sum over the broadcast axes with
+    # ReduceAxesParam, identical to `sum` (broadcast_reduce_op_value.cc:217)
+    '_broadcast_backward': 'sum',
+}
+
+for _alias, _target in _ALIASES.items():
+    _reg.register_alias(_alias, _target)
+
+
+# ---------------------------------------------------------------------------
+# real legacy ops
+# ---------------------------------------------------------------------------
+
+@_reg.register('Convolution_v1', input_names=['data', 'weight', 'bias'],
+               param_defaults={'kernel': None, 'stride': None, 'dilate': None,
+                               'pad': None, 'num_filter': 0, 'num_group': 1,
+                               'workspace': 1024, 'no_bias': False,
+                               'cudnn_tune': None, 'cudnn_off': False,
+                               'layout': None})
+def _convolution_v1(attrs, *arrays):
+    """Legacy convolution (src/operator/convolution_v1.cc) — identical
+    math to Convolution; v1 differed only in GPU workspace strategy."""
+    return _reg.apply_op('Convolution', attrs, *arrays)
+
+
+@_reg.register('_CrossDeviceCopy')
+def _cross_device_copy(attrs, x):
+    """Cross-device copy (src/operator/cross_device_copy.cc). Placement
+    is expressed through shardings here; inside one program this is
+    identity (XLA inserts the transfer)."""
+    return x
+
+
+@_reg.register('_NoGradient', differentiable=False)
+def _no_gradient(attrs, x):
+    """Gradient blocker (the reference's kNullOp grad convention)."""
+    return jax.lax.stop_gradient(x)
+
+
+# -- opencv host codecs (src/io/image_io.cc) --------------------------------
+
+@_reg.register('_cvimdecode', host=True, differentiable=False,
+               param_defaults={'flag': 1, 'to_rgb': True})
+def _cvimdecode(attrs, buf):
+    """Decode JPEG/PNG bytes to a uint8 HWC image (image_io.cc Imdecode;
+    PIL replaces opencv)."""
+    from ..image.image import imdecode
+    raw = np.asarray(buf).astype(np.uint8).tobytes()
+    img = imdecode(raw, to_rgb=bool(attrs.get('to_rgb', True)),
+                   flag=int(attrs.get('flag', 1)))
+    return jnp.asarray(np.asarray(img, np.uint8))
+
+
+@_reg.register('_cvimread', host=True, differentiable=False, input_names=[],
+               param_defaults={'filename': '', 'flag': 1, 'to_rgb': True})
+def _cvimread(attrs, *_):
+    """Read + decode an image file (image_io.cc Imread)."""
+    filename = attrs.get('filename', '')
+    with open(filename, 'rb') as f:
+        raw = f.read()
+    from ..image.image import imdecode
+    img = imdecode(raw, to_rgb=bool(attrs.get('to_rgb', True)),
+                   flag=int(attrs.get('flag', 1)))
+    return jnp.asarray(np.asarray(img, np.uint8))
+
+
+def _cvimresize_shape(attrs, in_shapes):
+    s = in_shapes[0]
+    return [(int(attrs['h']), int(attrs['w'])) + tuple(s[2:])], [None]
+
+
+@_reg.register('_cvimresize', host=True, differentiable=False,
+               shape_fn=_cvimresize_shape,
+               param_defaults={'w': 0, 'h': 0, 'interp': 1})
+def _cvimresize(attrs, src):
+    """Resize an HWC image (image_io.cc Imresize; bilinear numpy)."""
+    from ..image.image import imresize
+    img = np.asarray(src)
+    out = imresize(img.astype(np.float32), int(attrs['w']), int(attrs['h']),
+                   interp=int(attrs.get('interp', 1)))
+    if np.issubdtype(img.dtype, np.integer):
+        info = np.iinfo(img.dtype)
+        out = np.clip(np.rint(out), info.min, info.max)
+    return jnp.asarray(out.astype(img.dtype))
+
+
+def _cvborder_shape(attrs, in_shapes):
+    s = in_shapes[0]
+    out = (s[0] + int(attrs.get('top', 0)) + int(attrs.get('bot', 0)),
+           s[1] + int(attrs.get('left', 0)) + int(attrs.get('right', 0)))
+    return [out + tuple(s[2:])], [None]
+
+
+@_reg.register('_cvcopyMakeBorder', host=True, differentiable=False,
+               shape_fn=_cvborder_shape,
+               param_defaults={'top': 0, 'bot': 0, 'left': 0, 'right': 0,
+                               'type': 0, 'value': 0.0})
+def _cvcopy_make_border(attrs, src):
+    """Pad an HWC image with a constant border (image_io.cc
+    copyMakeBorder; type 0 = cv2.BORDER_CONSTANT is the only mode the
+    reference's io path uses)."""
+    img = np.asarray(src)
+    pad = ((int(attrs['top']), int(attrs['bot'])),
+           (int(attrs['left']), int(attrs['right'])))
+    if img.ndim == 3:
+        pad = pad + ((0, 0),)
+    out = np.pad(img, pad, mode='constant',
+                 constant_values=float(attrs.get('value', 0.0)))
+    return jnp.asarray(out)
+
+
+# -- legacy python-callback bridges -----------------------------------------
+# The reference passes C callback-struct pointers through the `info` attr
+# (native_op.cc / ndarray_op.cc / custom_function.cc); here `info` keys a
+# process-local table of live python objects (operator.py registers them).
+
+_LEGACY_CALLBACKS = {}
+
+
+def register_legacy_callback(obj):
+    key = str(id(obj))
+    _LEGACY_CALLBACKS[key] = obj
+    return key
+
+
+def _lookup_info(attrs, opname):
+    key = str(attrs.get('info', ''))
+    obj = _LEGACY_CALLBACKS.get(key)
+    if obj is None:
+        raise MXNetError(
+            '%s: no live python operator for info=%r — construct the '
+            'symbol through mx.operator.PythonOp/NDArrayOp.get_symbol() '
+            'in this process' % (opname, key))
+    return obj
+
+
+def _legacy_forward(inst, arrays):
+    np_in = [np.asarray(a, np.float32) for a in arrays]
+    _, out_shapes = inst.infer_shape([list(a.shape) for a in np_in])
+    out = [np.zeros(tuple(s), np.float32) for s in out_shapes]
+    inst.forward(in_data=np_in, out_data=out)
+    if len(out) == 1:
+        return jnp.asarray(out[0])
+    return tuple(jnp.asarray(o) for o in out)
+
+
+def _legacy_shape(attrs, in_shapes):
+    """shape_fn: delegate to the instance's infer_shape (the reference
+    routes NativeOpProp::InferShape to the same python callback)."""
+    inst = _lookup_info(attrs, 'legacy python op')
+    _, out_shapes = inst.infer_shape([list(s) for s in in_shapes])
+    return [tuple(s) for s in out_shapes], [np.float32] * len(out_shapes)
+
+
+@_reg.register('_Native', host=True, variadic=True, shape_fn=_legacy_shape,
+               train_aware=True, param_defaults={'info': ''})
+def _native(attrs, *arrays):
+    """Legacy numpy-callback op (src/operator/native_op.cc + the
+    plugin's NativeOpInfo protocol): forward runs the registered
+    PythonOp on host numpy buffers."""
+    return _legacy_forward(_lookup_info(attrs, '_Native'), arrays)
+
+
+def _native_backward(attrs, gouts, ins, outs):
+    """legacy_backward hook (host_bridge): the user's python backward
+    (reference NativeOpInfo.backward protocol)."""
+    inst = _lookup_info(attrs, '_Native')
+    np_in = [np.asarray(a, np.float32) for a in ins]
+    np_out = [np.asarray(o, np.float32) for o in outs]
+    np_gout = [np.asarray(g, np.float32) for g in gouts]
+    in_grad = [np.zeros_like(a) for a in np_in]
+    inst.backward(out_grad=np_gout, in_data=np_in, out_data=np_out,
+                  in_grad=in_grad)
+    return tuple(in_grad)
+
+
+_reg.get('_Native').legacy_backward = _native_backward
+
+
+@_reg.register('_NDArray', host=True, variadic=True, shape_fn=_legacy_shape,
+               train_aware=True, param_defaults={'info': ''})
+def _ndarray_op(attrs, *arrays):
+    """Legacy NDArray-callback op (src/operator/ndarray_op.cc): like
+    _Native but the callback sees NDArrays instead of numpy."""
+    from ..ndarray.ndarray import NDArray
+    inst = _lookup_info(attrs, '_NDArray')
+    nd_in = [NDArray(jnp.asarray(a)) for a in arrays]
+    _, out_shapes = inst.infer_shape([list(a.shape) for a in nd_in])
+    from ..ndarray import zeros
+    out = [zeros(tuple(s)) for s in out_shapes]
+    inst.forward(in_data=nd_in, out_data=out)
+    if len(out) == 1:
+        return out[0]._data
+    return tuple(o._data for o in out)
+
+
+def _ndarray_backward(attrs, gouts, ins, outs):
+    from ..ndarray.ndarray import NDArray
+    from ..ndarray import zeros
+    inst = _lookup_info(attrs, '_NDArray')
+    nd_in = [NDArray(jnp.asarray(a)) for a in ins]
+    nd_out = [NDArray(jnp.asarray(o)) for o in outs]
+    nd_gout = [NDArray(jnp.asarray(g)) for g in gouts]
+    in_grad = [zeros(tuple(a.shape)) for a in ins]
+    inst.backward(out_grad=nd_gout, in_data=nd_in, out_data=nd_out,
+                  in_grad=in_grad)
+    return tuple(np.asarray(g._data, np.float32) for g in in_grad)
+
+
+_reg.get('_NDArray').legacy_backward = _ndarray_backward
+
+
+@_reg.register('_CustomFunction', host=True, differentiable=False,
+               variadic=True, param_defaults={'info': ''})
+def _custom_function(attrs, *arrays):
+    """Imperative autograd Function bridge (src/operator/
+    custom_function.cc): applies the registered Function's forward."""
+    from ..ndarray.ndarray import NDArray
+    inst = _lookup_info(attrs, '_CustomFunction')
+    nd_in = [NDArray(jnp.asarray(a)) for a in arrays]
+    out = inst.forward(*nd_in)
+    if isinstance(out, (tuple, list)):
+        return tuple(o._data for o in out)
+    return out._data

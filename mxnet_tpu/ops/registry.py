@@ -23,8 +23,9 @@ Conventions:
 import functools
 
 import jax
+import jax.numpy as jnp
 
-from ..base import normalize_attrs, attr_key
+from ..base import MXNetError, normalize_attrs, attr_key
 
 __all__ = ['OpDef', 'register', 'get', 'list_ops', 'jitted']
 
@@ -36,7 +37,7 @@ class OpDef:
                  param_defaults=None, differentiable=True, variadic=False,
                  mutate_inputs=None, needs_rng=False, num_visible_outputs=None,
                  train_aware=False, aux_inputs=(), key_var_num_args=None,
-                 doc=None):
+                 host=False, shape_fn=None, doc=None):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -53,6 +54,12 @@ class OpDef:
         self.train_aware = train_aware
         self.aux_inputs = tuple(aux_inputs)  # names of inputs that are aux states
         self.key_var_num_args = key_var_num_args  # attr naming the input count
+        # host ops run python/numpy on concrete arrays (image codecs,
+        # legacy callback bridges). Inside traced programs they ride
+        # jax.pure_callback, which needs shape_fn(attrs, in_shapes) ->
+        # (out_shapes, out_dtypes); without one the op is imperative-only.
+        self.host = host
+        self.shape_fn = shape_fn
         self.doc = doc or (fn.__doc__ or '')
 
     def n_outputs(self, attrs):
@@ -141,3 +148,62 @@ def apply_op(name, attrs, *arrays):
     """Uncached direct application (used inside symbol executors where the
     surrounding graph is already being traced under one jit)."""
     return _OPS[name].fn(attrs, *arrays)
+
+
+def host_bridge(op, attrs):
+    """Traceable wrapper for a host op: jax.pure_callback (so the python
+    runs host-side at execution time, the reference's ExecType::kLocal)
+    plus a custom_vjp that calls the op's registered python `backward`
+    when one exists (legacy PythonOp/NDArrayOp protocol) and returns
+    zero cotangents otherwise (codecs are non-differentiable).
+
+    Requires op.shape_fn; host ops without one (data-dependent output
+    shapes, e.g. _cvimdecode) cannot enter traced programs."""
+    import numpy as np
+    if op.shape_fn is None:
+        raise MXNetError(
+            'host op %r has a data-dependent output shape and can only '
+            'be used imperatively (nd.*), not inside a traced graph'
+            % op.name)
+
+    def specs_for(arrays):
+        in_shapes = [tuple(a.shape) for a in arrays]
+        out_shapes, out_dtypes = op.shape_fn(attrs, in_shapes)
+        # a None dtype means "same as input 0"
+        fallback = arrays[0].dtype if arrays else np.float32
+        specs = tuple(jax.ShapeDtypeStruct(tuple(s),
+                                           np.dtype(fallback if d is None else d))
+                      for s, d in zip(out_shapes, out_dtypes))
+        # single-output ops return a bare array (the op-fn convention)
+        return specs[0] if len(specs) == 1 else specs
+
+    def run_host(*arrays):
+        outs = op.fn(attrs, *arrays)
+        if isinstance(outs, (tuple, list)):
+            return tuple(np.asarray(o) for o in outs)
+        return np.asarray(outs)
+
+    @jax.custom_vjp
+    def call(*arrays):
+        return jax.pure_callback(run_host, specs_for(arrays), *arrays)
+
+    def fwd(*arrays):
+        outs = jax.pure_callback(run_host, specs_for(arrays), *arrays)
+        return outs, (arrays, outs)
+
+    def bwd(res, gouts):
+        arrays, outs = res
+        backward = getattr(op, 'legacy_backward', None)
+        if backward is None:
+            return tuple(jnp.zeros(a.shape, a.dtype) for a in arrays)
+        in_specs = tuple(jax.ShapeDtypeStruct(tuple(a.shape), np.dtype(a.dtype))
+                         for a in arrays)
+        gouts_t = gouts if isinstance(gouts, (tuple, list)) else (gouts,)
+        outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+
+        def run_bwd(gouts_, ins_, outs_):
+            return backward(attrs, gouts_, ins_, outs_)
+        return jax.pure_callback(run_bwd, in_specs, gouts_t, arrays, outs_t)
+
+    call.defvjp(fwd, bwd)
+    return call

@@ -242,6 +242,16 @@ def _eval_node_shape(n, in_shapes, known_types):
     if op.needs_rng:
         specs.append(jax.ShapeDtypeStruct((2,), np.uint32))
 
+    if op.host:
+        # host ops cannot be traced; their shape contract comes from
+        # shape_fn (legacy infer_shape callbacks, codec geometry)
+        if op.shape_fn is None:
+            raise MXNetError(
+                'host op %s(%s) has a data-dependent output shape; it can '
+                'only be used imperatively' % (n.name, n.op))
+        out_shapes, _ = op.shape_fn(attrs, [tuple(s) for s in in_shapes])
+        return [tuple(s) for s in out_shapes]
+
     def f(*arrays):
         return op.fn(attrs, *arrays)
     try:

@@ -1,0 +1,202 @@
+"""Registry superset vs the reference + legacy op behavior.
+
+The reference registers ops via MXNET_REGISTER_OP_PROPERTY /
+NNVM_REGISTER_OP / .add_alias across src/operator (see
+ops/legacy_ops.py for the per-family citations). The sweep here
+re-derives the reference name list from those sources and fails on any
+missing registration (modulo `_backward_*`, subsumed by jax.vjp).
+"""
+import os
+import re
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import registry
+
+REF_SRC = '/root/reference/src'
+
+
+def _reference_op_names():
+    names = set()
+    reg = re.compile(r'(?:MXNET_REGISTER_OP_PROPERTY|NNVM_REGISTER_OP|'
+                     r'MXNET_REGISTER_SIMPLE_OP)\(\s*"?([A-Za-z0-9_.]+)"?\s*[,)]')
+    alias = re.compile(r'\.add_alias\(\s*"([A-Za-z0-9_.]+)"\s*\)')
+    for root, _, files in os.walk(REF_SRC):
+        for f in files:
+            if not f.endswith(('.cc', '.cu', '.h')):
+                continue
+            try:
+                s = open(os.path.join(root, f), errors='ignore').read()
+            except OSError:
+                continue
+            for m in reg.finditer(s):
+                names.add(m.group(1))
+            for m in alias.finditer(s):
+                names.add(m.group(1))
+    names.discard('name')  # macro parameter, not a registration
+    return names
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_SRC),
+                    reason='reference tree not present')
+def test_registry_is_a_superset_of_reference():
+    ours = set(registry.list_ops())
+    missing = sorted(n for n in _reference_op_names() - ours
+                     if not n.startswith('_backward'))
+    assert not missing, 'missing registrations: %s' % missing
+
+
+def test_capitalized_aliases_compute():
+    a = mx.nd.array([[1., 2.], [3., 4.]])
+    b = mx.nd.array([[10., 20.], [30., 40.]])
+    np.testing.assert_array_equal(
+        mx.nd._internal._Plus(a, b).asnumpy(), [[11, 22], [33, 44]])
+    np.testing.assert_array_equal(
+        mx.nd._internal._Mul(a, b).asnumpy(), [[10, 40], [90, 160]])
+    np.testing.assert_array_equal(
+        mx.nd._internal._MaximumScalar(a, scalar=2.5).asnumpy(),
+        [[2.5, 2.5], [3, 4]])
+    np.testing.assert_array_equal(
+        mx.nd._internal._Greater(a, b).asnumpy(), np.zeros((2, 2)))
+    np.testing.assert_array_equal(
+        mx.nd.broadcast_plus(a, mx.nd.array([[1.], [2.]])).asnumpy(),
+        [[2, 3], [5, 6]])
+
+
+def test_negbinomial_sampler_aliases():
+    mx.random.seed(0)
+    s = mx.nd._internal._sample_negbinomial(k=5, p=0.5, shape=(500,))
+    assert s.shape == (500,)
+    assert float(s.asnumpy().min()) >= 0
+    # negbinomial(k, p) mean = k(1-p)/p = 5
+    assert abs(float(s.asnumpy().mean()) - 5.0) < 1.0
+    g = mx.nd._internal._sample_gennegbinomial(mu=2.0, alpha=0.5, shape=(300,))
+    assert g.shape == (300,)
+
+
+def test_convolution_v1_matches_convolution():
+    mx.random.seed(1)
+    x = mx.nd.random.uniform(shape=(1, 3, 8, 8))
+    w = mx.nd.random.uniform(shape=(4, 3, 3, 3))
+    bz = mx.nd.zeros((4,))
+    y1 = mx.nd.Convolution(x, w, bz, kernel=(3, 3), num_filter=4)
+    y2 = mx.nd.Convolution_v1(x, w, bz, kernel=(3, 3), num_filter=4)
+    np.testing.assert_allclose(y1.asnumpy(), y2.asnumpy(), rtol=1e-5)
+
+
+def test_cv_host_ops(tmp_path):
+    img = (np.random.RandomState(0).rand(10, 12, 3) * 255).astype(np.uint8)
+    r = mx.nd._internal._cvimresize(mx.nd.array(img.astype(np.float32)),
+                                    w=6, h=5)
+    assert r.shape == (5, 6, 3)
+    p = mx.nd._internal._cvcopyMakeBorder(
+        mx.nd.array(img.astype(np.float32)), top=1, bot=2, left=3, right=4)
+    assert p.shape == (13, 19, 3)
+    np.testing.assert_array_equal(p.asnumpy()[0], np.zeros((19, 3)))
+    PIL = pytest.importorskip('PIL.Image')
+    fn = str(tmp_path / 'im.png')
+    PIL.fromarray(img).save(fn)
+    rd = mx.nd._internal._cvimread(filename=fn)
+    assert rd.shape == (10, 12, 3)
+    np.testing.assert_array_equal(rd.asnumpy(), img)
+    raw = open(fn, 'rb').read()
+    dec = mx.nd._internal._cvimdecode(
+        mx.nd.array(np.frombuffer(raw, np.uint8).astype(np.float32)))
+    assert dec.shape == (10, 12, 3)
+
+
+def test_legacy_numpy_and_ndarray_ops():
+    class Scale2(mx.operator.NumpyOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 2
+
+    sym = Scale2()(mx.sym.Variable('data'))
+    ex = sym.bind(mx.cpu(), {'data': mx.nd.array([[1., 2.]])})
+    np.testing.assert_array_equal(ex.forward()[0].asnumpy(), [[2., 4.]])
+
+    class AddOne(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] + 1
+
+    sym2 = AddOne()(mx.sym.Variable('data'))
+    ex2 = sym2.bind(mx.cpu(), {'data': mx.nd.array([3., 4.])})
+    np.testing.assert_array_equal(ex2.forward()[0].asnumpy(), [4., 5.])
+
+
+def test_legacy_op_simple_bind_and_backward():
+    """Host ops work through shape inference (simple_bind) and the
+    traced backward via the pure_callback bridge, with the user's
+    python backward supplying the VJP."""
+    class Scale3(mx.operator.NumpyOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 3
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 3
+
+    sym = Scale3()(mx.sym.Variable('data'))
+    ex = sym.simple_bind(mx.cpu(), data=(2, 3))
+    ex.arg_dict['data'][:] = mx.nd.ones((2, 3))
+    out = ex.forward(is_train=True)[0]
+    np.testing.assert_array_equal(out.asnumpy(), 3 * np.ones((2, 3)))
+    ex.backward(mx.nd.ones((2, 3)))
+    np.testing.assert_array_equal(ex.grad_dict['data'].asnumpy(),
+                                  3 * np.ones((2, 3)))
+
+
+def test_legacy_ndarray_op_imperative_autograd():
+    from mxnet_tpu.ops.legacy_ops import register_legacy_callback
+
+    class Sq(mx.operator.NDArrayOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0].asnumpy() ** 2
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = 2 * in_data[0].asnumpy() * out_grad[0].asnumpy()
+
+    op = Sq()
+    x = mx.nd.array([1., 2., 3.])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = mx.nd._internal._NDArray(x, info=register_legacy_callback(op))
+        loss = y.sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2., 4., 6.])
+
+
+def test_legacy_op_module_fit_converges():
+    class Scale3(mx.operator.NumpyOp):
+        def forward(self, in_data, out_data):
+            out_data[0][:] = in_data[0] * 3
+
+        def backward(self, out_grad, in_data, out_data, in_grad):
+            in_grad[0][:] = out_grad[0] * 3
+
+    mx.random.seed(0)
+    data = mx.sym.Variable('data')
+    h = mx.sym.Activation(mx.sym.FullyConnected(data, num_hidden=8,
+                                                name='fc1'), act_type='relu')
+    h = Scale3()(h)
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(h, num_hidden=2, name='fc2'), name='softmax')
+    X = np.random.RandomState(0).randn(256, 4).astype(np.float32)
+    Y = (X[:, 0] > 0).astype(np.float32)
+    it = mx.io.NDArrayIter(X, Y, batch_size=32, label_name='softmax_label')
+    mod = mx.mod.Module(net, data_names=['data'],
+                        label_names=['softmax_label'])
+    mod.fit(it, num_epoch=15, optimizer='sgd',
+            optimizer_params={'learning_rate': 0.05})
+    score = dict(mod.score(it, 'acc'))
+    assert score['accuracy'] > 0.9, score
+
+
+def test_no_gradient_and_cross_device_copy():
+    x = mx.nd.array([1., 2.])
+    np.testing.assert_array_equal(
+        mx.nd._internal._NoGradient(x).asnumpy(), [1., 2.])
+    np.testing.assert_array_equal(
+        mx.nd._internal._CrossDeviceCopy(x).asnumpy(), [1., 2.])
+    s = mx.nd._internal._broadcast_backward(mx.nd.ones((2, 3)), axis=0)
+    np.testing.assert_array_equal(s.asnumpy(), [2., 2., 2.])
