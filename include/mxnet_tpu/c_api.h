@@ -37,6 +37,69 @@ typedef void *DataIterHandle;
 typedef void *KVStoreHandle;
 typedef void *RecordIOHandle;
 typedef void *CachedOpHandle;
+typedef void *RtcHandle;
+
+/* -- C callback protocol (reference c_api.h:122-177) -- */
+typedef int (*MXGenericCallback)(void);
+
+struct MXCallbackList {
+  int num_callbacks;
+  int (**callbacks)(void);
+  void **contexts;
+};
+
+enum CustomOpCallbacks {
+  kCustomOpDelete,
+  kCustomOpForward,
+  kCustomOpBackward
+};
+
+enum CustomOpPropCallbacks {
+  kCustomOpPropDelete,
+  kCustomOpPropListArguments,
+  kCustomOpPropListOutputs,
+  kCustomOpPropListAuxiliaryStates,
+  kCustomOpPropInferShape,
+  kCustomOpPropDeclareBackwardDependency,
+  kCustomOpPropCreateOperator,
+  kCustomOpPropInferType
+};
+
+typedef int (*CustomOpFBFunc)(int size, void **ptrs, int *tags,
+                              const int *reqs, const int is_train,
+                              void *state);
+typedef int (*CustomOpDelFunc)(void *state);
+typedef int (*CustomOpListFunc)(char ***args, void *state);
+typedef int (*CustomOpInferShapeFunc)(int num_input, int *ndims,
+                                      unsigned **shapes, void *state);
+typedef int (*CustomOpInferTypeFunc)(int num_input, int *types, void *state);
+typedef int (*CustomOpBwdDepFunc)(const int *out_grad, const int *in_data,
+                                  const int *out_data, int *num_deps,
+                                  int **rdeps, void *state);
+typedef int (*CustomOpCreateFunc)(const char *ctx, int num_inputs,
+                                  unsigned **shapes, const int *ndims,
+                                  const int *dtypes,
+                                  struct MXCallbackList *ret, void *state);
+typedef int (*CustomOpPropCreator)(const char *op_type, const int num_kwargs,
+                                   const char **keys, const char **values,
+                                   struct MXCallbackList *ret);
+
+enum CustomFunctionCallbacks {
+  kCustomFunctionBackward,
+  kCustomFunctionDelete
+};
+
+typedef int (*CustomFunctionBwdFunc)(int num_ograds, int num_igrads,
+                                     void **ptrs, const int *reqs,
+                                     const int is_train, void *state);
+typedef int (*CustomFunctionDelFunc)(void *state);
+
+typedef void (*ExecutorMonitorCallback)(const char *name, NDArrayHandle arr,
+                                        void *callback_handle);
+typedef void (*MXKVStoreUpdater)(int key, NDArrayHandle recv,
+                                 NDArrayHandle local, void *handle);
+typedef void (*MXKVStoreStrUpdater)(const char *key, NDArrayHandle recv,
+                                    NDArrayHandle local, void *handle);
 
 /*! Return the last error message on this thread (empty string if none). */
 const char *MXGetLastError();
@@ -89,6 +152,20 @@ int MXNDArraySaveRawBytes(NDArrayHandle handle, size_t *out_size,
                           const char **out_buf);
 int MXNDArrayLoadFromRawBytes(const void *buf, size_t size,
                               NDArrayHandle *out);
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int *aux_type, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out);
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type);
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out);
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out);
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out);
+int MXNDArraySetGradState(NDArrayHandle handle, int state);
+/*! Copy src (or its aux array i; i < 0 means the data array) into dst. */
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, const int i);
 
 /* -------------------------------------------------------- operators -- */
 int MXListAllOpNames(mx_uint *out_size, const char ***out_array);
@@ -98,6 +175,28 @@ int MXImperativeInvoke(AtomicSymbolCreator creator, int num_inputs,
                        NDArrayHandle *inputs, int *num_outputs,
                        NDArrayHandle **outputs, int num_params,
                        const char **param_keys, const char **param_vals);
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes);
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator);
+
+/* -- legacy NDArray-function registry (reference c_api.h:407-500) -- */
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array);
+int MXGetFunction(const char *name, FunctionHandle *out);
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions, const char **return_type);
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask);
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars);
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals);
 
 /* --------------------------------------------------------- autograd -- */
 int MXAutogradSetIsRecording(int is_recording, int *prev);
@@ -112,6 +211,13 @@ int MXAutogradBackward(mx_uint num_output, NDArrayHandle *output_handles,
 int MXAutogradBackwardEx(mx_uint num_output, NDArrayHandle *output_handles,
                          NDArrayHandle *ograd_handles, int retain_graph,
                          int train_mode);
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles);
+/*! Export the recorded imperative history of `handle` as a Symbol. */
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out);
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           struct MXCallbackList *callbacks);
 
 /* --------------------------------------------------------- cachedop -- */
 int MXCreateCachedOp(SymbolHandle handle, CachedOpHandle *out);
@@ -119,6 +225,9 @@ int MXFreeCachedOp(CachedOpHandle handle);
 int MXInvokeCachedOp(CachedOpHandle handle, int num_inputs,
                      NDArrayHandle *inputs, int *num_outputs,
                      NDArrayHandle **outputs);
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes);
 
 /* ----------------------------------------------------------- symbol -- */
 int MXSymbolListAtomicSymbolCreators(mx_uint *out_size,
@@ -152,6 +261,8 @@ int MXSymbolGetAttr(SymbolHandle symbol, const char *key, const char **out,
 int MXSymbolSetAttr(SymbolHandle symbol, const char *key, const char *value);
 int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
                      const char ***out);
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out);
 int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
                           const char ***out_str_array);
 int MXSymbolListOutputs(SymbolHandle symbol, mx_uint *out_size,
@@ -204,6 +315,51 @@ int MXExecutorBind(SymbolHandle symbol_handle, int dev_type, int dev_id,
                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
                    mx_uint aux_states_len, NDArrayHandle *aux_states,
                    ExecutorHandle *out);
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train);
+/*! Bind with per-group device placement (group2ctx). */
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out);
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out);
+/*! simple_bind: the library allocates arg/grad/aux arrays from shape,
+ *  dtype and stype hints (reference c_api_executor.cc:167). */
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list,
+    mx_uint *num_in_args, NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out);
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle);
 
 /* ---------------------------------------------------------- data io -- */
 int MXListDataIters(mx_uint *out_size, DataIterHandle **out_array);
@@ -221,6 +377,8 @@ int MXDataIterBeforeFirst(DataIterHandle handle);
 int MXDataIterGetData(DataIterHandle handle, NDArrayHandle *out);
 int MXDataIterGetLabel(DataIterHandle handle, NDArrayHandle *out);
 int MXDataIterGetPadNum(DataIterHandle handle, int *pad);
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size);
 
 /* ---------------------------------------------------------- kvstore -- */
 int MXKVStoreCreate(const char *type, KVStoreHandle *out);
@@ -243,6 +401,26 @@ int MXKVStoreGetNumDeadNode(KVStoreHandle handle, int node_id,
 int MXKVStoreRunServer(KVStoreHandle handle);
 int MXKVStoreSendCommmandToServers(KVStoreHandle handle, int cmd_id,
                                    const char *cmd_body);
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals);
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals);
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority);
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority);
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority);
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit);
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle);
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle);
 
 /* --------------------------------------------------------- recordio -- */
 /* Native framed stream (src/recordio.cc) — no interpreter involved. */
@@ -257,6 +435,21 @@ int MXRecordIOReaderFree(RecordIOHandle handle);
 int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
                                size_t *size);
 int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos);
+
+/* -------------------------------------------------------------- rtc -- */
+/* Runtime kernel compilation (reference c_api.h:1666: CUDA C there;
+ * jnp/pallas python source here — mx.rtc semantics). Grid/block dims in
+ * MXRtcPush are accepted for signature parity and ignored (XLA owns
+ * scheduling). */
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out);
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ);
+int MXRtcFree(RtcHandle handle);
 
 #ifdef __cplusplus
 }

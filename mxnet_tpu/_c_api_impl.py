@@ -773,3 +773,601 @@ def nd_list_get(keys, arrays, index):
     arr = arrays[i]
     npy = arr.asnumpy().astype(np.float32)
     return keys[i], npy.tobytes(), tuple(int(d) for d in npy.shape)
+
+
+# ---------------------------------------------------------------------------
+# Round-3 additions: the 38 remaining reference entry points
+# (reference include/mxnet/c_api.h; closes the C ABI to 146/146).
+# ---------------------------------------------------------------------------
+
+def _ctypes():
+    import ctypes
+    return ctypes
+
+
+def _handle_ptr(obj):
+    """The PyObject* of obj as an integer — what the C caller sees as an
+    NDArrayHandle. The caller of the C callback must keep obj alive for
+    the duration of the call (we do, via locals)."""
+    return id(obj)
+
+
+# -- imperative/cachedop Ex variants (storage types out) --
+
+def nd_stype_code(arr):
+    from .ndarray import sparse as _sp
+    if isinstance(arr, _sp.RowSparseNDArray):
+        return 1
+    if isinstance(arr, _sp.CSRNDArray):
+        return 2
+    return 0
+
+
+def imperative_invoke_ex(name, inputs, keys, vals, num_out_provided, outputs):
+    outs = imperative_invoke(name, inputs, keys, vals, num_out_provided,
+                             outputs)
+    return outs, [nd_stype_code(o) for o in outs]
+
+
+def cached_op_invoke_ex(handle, inputs):
+    outs = handle(list(inputs))
+    return outs, [nd_stype_code(o) for o in outs]
+
+
+# -- sparse creation + accessors --
+
+def nd_create_sparse(storage_type, shape, dev_type, dev_id, dtype_code,
+                     aux_types, aux_shapes):
+    from .ndarray import sparse as _sp
+    from .ndarray import zeros as _zeros
+    ctx = _ctx(dev_type, dev_id)
+    dtype = _CODE_TO_DTYPE.get(int(dtype_code), 'float32')
+    shape = tuple(int(d) for d in shape)
+    if int(storage_type) == 1:      # row_sparse: aux [indices]
+        nrows = int(aux_shapes[0][0]) if aux_shapes and aux_shapes[0] else 0
+        return _sp.RowSparseNDArray(
+            _zeros((nrows,) + shape[1:], dtype=dtype),
+            _zeros((nrows,), dtype='int64'), shape, ctx=ctx)
+    if int(storage_type) == 2:      # csr: aux [indptr, indices]
+        nnz = int(aux_shapes[1][0]) if len(aux_shapes) > 1 and aux_shapes[1] else 0
+        return _sp.CSRNDArray(
+            _zeros((nnz,), dtype=dtype),
+            _zeros((shape[0] + 1,), dtype='int64'),
+            _zeros((nnz,), dtype='int64'), shape, ctx=ctx)
+    return _zeros(shape, dtype=dtype, ctx=ctx)
+
+
+def _aux_arrays(arr):
+    from .ndarray import sparse as _sp
+    if isinstance(arr, _sp.RowSparseNDArray):
+        return [arr.indices]
+    if isinstance(arr, _sp.CSRNDArray):
+        return [arr.indptr, arr.indices]
+    raise TypeError('dense NDArray has no aux arrays')
+
+
+def nd_aux_type(handle, i):
+    aux = _aux_arrays(handle)[int(i)]
+    return _DTYPE_TO_CODE.get(str(aux.dtype), 6)
+
+
+def nd_get_aux(handle, i):
+    return _aux_arrays(handle)[int(i)]
+
+
+def nd_get_data(handle):
+    return handle.data
+
+
+def nd_grad_state(handle):
+    return 1 if getattr(handle, '_fresh_grad', False) else 0
+
+
+def nd_set_grad_state(handle, state):
+    handle._fresh_grad = bool(state)
+    return 0
+
+
+def nd_sync_copy_from_ndarray(dst, src, i):
+    from .ndarray import sparse as _sp
+    if int(i) >= 0:
+        src = _aux_arrays(src)[int(i)]
+    elif isinstance(src, _sp.BaseSparseNDArray):
+        src = src.data
+    dst[:] = src.astype(dst.dtype) if str(src.dtype) != str(dst.dtype) else src
+    return 0
+
+
+# -- autograd extras --
+
+def autograd_get_symbol(handle):
+    """Export the recorded imperative history of `handle` as a Symbol
+    (reference MXAutogradGetSymbol / nnvm graph behind the tape).
+    Leaves and unrecorded inputs become Variables."""
+    from .symbol import Variable
+    node = handle._node
+    if node is None:
+        name = 'var0'
+        return Variable(name)
+    memo = {}
+    counter = [0]
+
+    def build(entry):
+        src, idx = entry
+        if src is None or not hasattr(src, 'op_info') or \
+                getattr(src, 'op_info', None) is None:
+            key = id(src) if src is not None else ('anon', counter[0])
+            if key not in memo:
+                memo[key] = Variable('var%d' % counter[0])
+                counter[0] += 1
+            return memo[key]
+        if id(src) in memo:
+            sym = memo[id(src)]
+        else:
+            op_name, attrs = src.op_info
+            parents = [build(p) for p in src.parents[:src.n_grad_inputs]]
+            attrs = {k: v for k, v in attrs.items()
+                     if not k.startswith('__')}
+            sym = _invoke_sym(op_name, parents, attrs)
+            memo[id(src)] = sym
+        if src.n_outputs > 1:
+            return sym[idx]
+        return sym
+    return build((node, handle._out_idx))
+
+
+class _CCustomFunction:
+    """MXCustomFunctionRecord: a python-side Function whose backward calls
+    the C callback list (kCustomFunctionBackward)."""
+
+    def __init__(self, callbacks_ptr, n_in, n_out):
+        ct = _ctypes()
+        self._cb = callbacks_ptr      # (fnptr_int, ctx_int) list
+        self.n_in, self.n_out = int(n_in), int(n_out)
+        fnptr, ctx = callbacks_ptr[0]
+        proto = ct.CFUNCTYPE(ct.c_int, ct.c_int, ct.c_int,
+                             ct.POINTER(ct.c_void_p), ct.POINTER(ct.c_int),
+                             ct.c_int, ct.c_void_p)
+        self._bwd = proto(fnptr) if fnptr else None
+        self._bwd_ctx = ctx
+
+    def backward_arrays(self, ograds):
+        """Run the C backward: ograds (NDArrays) -> igrads (NDArrays)."""
+        ct = _ctypes()
+        from .ndarray import zeros as _zeros
+        igrads = [_zeros(s) for s in self._igrad_shapes]
+        all_arrays = list(ograds) + igrads
+        n = len(all_arrays)
+        ptrs = (ct.c_void_p * n)(*[_handle_ptr(a) for a in all_arrays])
+        reqs = (ct.c_int * len(igrads))(*([1] * len(igrads)))
+        rc = self._bwd(len(ograds), len(igrads), ptrs, reqs, 1,
+                       ct.c_void_p(self._bwd_ctx))
+        if rc == 0:
+            raise RuntimeError('CustomFunction backward callback failed')
+        return igrads
+
+
+def custom_function_record(inputs, outputs, callbacks):
+    """Attach a C-callback backward to the tape edge inputs->outputs."""
+    from . import autograd as _ag
+    import jax.numpy as jnp
+    fn = _CCustomFunction(callbacks, len(inputs), len(outputs))
+    fn._igrad_shapes = [tuple(a.shape) for a in inputs]
+
+    def vjp_fn(cotangents):
+        if not isinstance(cotangents, (tuple, list)):
+            cotangents = (cotangents,)
+        ograds = [NDArray(jnp.asarray(g)) for g in cotangents]
+        igrads = fn.backward_arrays(ograds)
+        return tuple(g._data for g in igrads)
+
+    parents = []
+    for a in inputs:
+        if a._node is not None:
+            parents.append((a._node, a._out_idx))
+        elif a._leaf is not None:
+            parents.append((a._leaf, 0))
+        else:
+            parents.append((None, 0))
+    node = _ag.record_op(vjp_fn, parents, len(outputs), len(inputs),
+                         op_info=('_CustomFunction', {}))
+    node.head_ids = [(tuple(o.shape), o.dtype) for o in outputs]
+    for i, o in enumerate(outputs):
+        o._node = node
+        o._out_idx = i
+    return 0
+
+
+# -- legacy NDArray-function registry (MXFunc*) --
+
+class _LegacyFunction:
+    __slots__ = ('name', 'op')
+
+    def __init__(self, name):
+        self.name = name
+        self.op = _op_reg.get(name)
+
+
+_FUNC_CACHE = {}
+
+
+def list_functions():
+    return [get_function(n) for n in _op_reg.list_ops()]
+
+
+def get_function(name):
+    f = _FUNC_CACHE.get(name)
+    if f is None:
+        f = _FUNC_CACHE[name] = _LegacyFunction(name)
+    return f
+
+
+def func_describe(fun):
+    n_in = 0 if fun.op.variadic else len(fun.op.input_names)
+    n_out = fun.op.num_outputs if isinstance(fun.op.num_outputs, int) else 1
+    return n_in, 0, n_out, 0
+
+
+def func_get_info(fun):
+    op = fun.op
+    args = list(op.param_defaults)
+    return (fun.name, op.doc or '', args, ['string'] * len(args),
+            [''] * len(args), 'NDArray')
+
+
+def func_invoke(fun, use_vars, scalars, mutate_vars, keys, vals):
+    attrs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
+    outs = mutate_vars if mutate_vars else None
+    out = outs if outs and len(outs) > 1 else (outs[0] if outs else None)
+    res = _nd_invoke(fun.name, list(use_vars), attrs, out)
+    return 0
+
+
+# -- kvstore Ex / row_sparse / updater --
+
+def kv_init_ex(handle, keys, values):
+    handle.init(list(keys), list(values))
+    return 0
+
+
+def kv_push_ex(handle, keys, values, priority):
+    handle.push(list(keys), list(values), priority=int(priority))
+    return 0
+
+
+def kv_pull_ex(handle, keys, outs, priority):
+    handle.pull(list(keys), out=list(outs), priority=int(priority))
+    return 0
+
+
+def kv_pull_row_sparse(handle, keys, outs, row_ids, priority):
+    handle.row_sparse_pull(list(keys), out=list(outs),
+                           priority=int(priority), row_ids=list(row_ids))
+    return 0
+
+
+def kv_set_barrier_before_exit(handle, flag):
+    if hasattr(handle, 'set_barrier_before_exit'):
+        handle.set_barrier_before_exit(bool(flag))
+    return 0
+
+
+def kv_set_updater(handle, fnptr, str_fnptr, ctx_ptr):
+    """MXKVStoreSetUpdater(Ex): wrap the C function pointer in a python
+    updater. NDArray handles passed to C are live PyObject pointers kept
+    alive for the call duration."""
+    ct = _ctypes()
+    int_proto = ct.CFUNCTYPE(None, ct.c_int, ct.c_void_p, ct.c_void_p,
+                             ct.c_void_p)
+    str_proto = ct.CFUNCTYPE(None, ct.c_char_p, ct.c_void_p, ct.c_void_p,
+                             ct.c_void_p)
+    c_int_fn = int_proto(fnptr) if fnptr else None
+    c_str_fn = str_proto(str_fnptr) if str_fnptr else None
+
+    def updater(key, recv, local):
+        if isinstance(key, str) and not key.isdigit():
+            if c_str_fn is None:
+                raise RuntimeError(
+                    'string key %r needs MXKVStoreSetUpdaterEx with a '
+                    'str_updater (reference kvstore.cc semantics)' % key)
+            c_str_fn(key.encode(), _handle_ptr(recv), _handle_ptr(local),
+                     ct.c_void_p(ctx_ptr))
+        elif c_int_fn is not None:
+            c_int_fn(int(key), _handle_ptr(recv), _handle_ptr(local),
+                     ct.c_void_p(ctx_ptr))
+        elif c_str_fn is not None:
+            c_str_fn(str(key).encode(), _handle_ptr(recv),
+                     _handle_ptr(local), ct.c_void_p(ctx_ptr))
+    handle.set_updater(updater)
+    return 0
+
+
+def init_ps_env(keys, vals):
+    import os as _os
+    for k, v in zip(keys, vals):
+        _os.environ[str(k)] = str(v)
+    return 0
+
+
+# -- executor extras --
+
+def executor_backward_ex(handle, out_grads, is_train):
+    handle.backward(out_grads=list(out_grads) if out_grads else None)
+    return 0
+
+
+def executor_bind_x(sym_handle, dev_type, dev_id, map_keys, map_dev_types,
+                    map_dev_ids, args, arg_grads, grad_reqs, aux_states):
+    sym = _as_symbol(sym_handle)
+    ctx = _ctx(dev_type, dev_id)
+    g2c = {k: _ctx(t, i) for k, t, i in
+           zip(map_keys, map_dev_types, map_dev_ids)}
+    req_names = {0: 'null', 1: 'write', 3: 'add'}
+    arg_names = sym.list_arguments()
+    args_map = dict(zip(arg_names, args))
+    grads_map = {n: g for n, g in zip(arg_names, arg_grads or [])
+                 if g is not None}
+    reqs = {n: req_names.get(int(r), 'write')
+            for n, r in zip(arg_names, grad_reqs or [])} or 'write'
+    aux_map = dict(zip(sym.list_auxiliary_states(), aux_states or []))
+    from .executor import Executor
+    return Executor(sym, ctx, args_map, args_grad=grads_map or None,
+                    grad_req=reqs, aux_states=aux_map or None,
+                    group2ctx=g2c or None)
+
+
+def executor_simple_bind(sym_handle, dev_type, dev_id, g2c_keys,
+                         g2c_dev_types, g2c_dev_ids, grad_req_names,
+                         grad_req_types, shape_names, shapes, dtype_names,
+                         dtypes, stype_names, stypes,
+                         shared_buffer_names, shared_buffer_arrays):
+    """MXExecutorSimpleBind: allocate arg/grad/aux arrays from hints.
+    Returns (executor, arg_names, in_args, arg_grads(list w/ None),
+    aux_names, aux_states, updated_buffer_names, updated_buffer_arrays)."""
+    sym = _as_symbol(sym_handle)
+    ctx = _ctx(dev_type, dev_id)
+    kwargs = {}
+    for n, s in zip(shape_names, shapes):
+        kwargs[n] = tuple(int(d) for d in s)
+    grad_req = 'write'
+    named = [(n, t) for n, t in zip(grad_req_names, grad_req_types) if n]
+    if named:
+        grad_req = dict(named)
+    elif grad_req_types:
+        grad_req = grad_req_types[0]
+    type_dict = {n: _CODE_TO_DTYPE.get(int(t), 'float32')
+                 for n, t in zip(dtype_names, dtypes)} or None
+    g2c = {k: _ctx(t, i) for k, t, i in
+           zip(g2c_keys, g2c_dev_types, g2c_dev_ids)}
+    shared = dict(zip(shared_buffer_names or [],
+                      shared_buffer_arrays or []))
+    ex = sym.simple_bind(ctx, grad_req=grad_req, type_dict=type_dict,
+                         group2ctx=g2c or None, **kwargs)
+    arg_names = sym.list_arguments()
+    aux_names = sym.list_auxiliary_states()
+    in_args = [ex.arg_dict[n] for n in arg_names]
+    arg_grads = [ex.grad_dict.get(n) for n in arg_names]
+    aux_states = [ex.aux_dict[n] for n in aux_names]
+    # updated shared buffer: existing entries plus this bind's args
+    # (memory identity is an XLA concern here; values are what matter)
+    for n in arg_names:
+        shared.setdefault(n, ex.arg_dict[n])
+    upd_names = list(shared.keys())
+    upd_arrays = [shared[n] for n in upd_names]
+    return (ex, arg_names, in_args, arg_grads, aux_names, aux_states,
+            upd_names, upd_arrays)
+
+
+def executor_set_monitor_callback(handle, fnptr, ctx_ptr):
+    ct = _ctypes()
+    proto = ct.CFUNCTYPE(None, ct.c_char_p, ct.c_void_p, ct.c_void_p)
+    c_fn = proto(fnptr)
+
+    def monitor(name, arr):
+        c_fn(str(name).encode(), _handle_ptr(arr), ct.c_void_p(ctx_ptr))
+    handle.set_monitor_callback(monitor)
+    return 0
+
+
+# -- data iter index --
+
+def data_iter_get_index(handle):
+    batch = handle.batch
+    idx = getattr(batch, 'index', None)
+    if idx is None:
+        n = int(batch.data[0].shape[0]) if batch.data else 0
+        idx = np.arange(n, dtype=np.uint64)
+    return np.asarray(idx, dtype=np.uint64).tobytes()
+
+
+# -- custom op registration from C (MXCustomOpRegister) --
+
+_C_CUSTOM_CREATORS = {}
+
+
+def custom_op_register(op_type, creator_ptr):
+    """Register a C CustomOpPropCreator under op_type. A python
+    CustomOpProp proxy calls the C callback list for list_arguments/
+    list_outputs/infer_shape/create_operator (+forward/backward),
+    mirroring the reference's CustomOpProp-over-MXCallbackList protocol
+    (src/operator/custom/custom.cc)."""
+    ct = _ctypes()
+    from . import operator as _op_mod
+
+    creator_proto = ct.CFUNCTYPE(
+        ct.c_int, ct.c_char_p, ct.c_int, ct.POINTER(ct.c_char_p),
+        ct.POINTER(ct.c_char_p), ct.c_void_p)
+    creator = creator_proto(creator_ptr)
+    _C_CUSTOM_CREATORS[op_type] = creator
+
+    class _CallbackList(ct.Structure):
+        _fields_ = [('num_callbacks', ct.c_int),
+                    ('callbacks', ct.POINTER(ct.CFUNCTYPE(ct.c_int))),
+                    ('contexts', ct.POINTER(ct.c_void_p))]
+
+    list_proto = ct.CFUNCTYPE(ct.c_int, ct.POINTER(ct.POINTER(ct.c_char_p)),
+                              ct.c_void_p)
+    shape_proto = ct.CFUNCTYPE(ct.c_int, ct.c_int, ct.POINTER(ct.c_int),
+                               ct.POINTER(ct.POINTER(ct.c_uint)), ct.c_void_p)
+    create_proto = ct.CFUNCTYPE(ct.c_int, ct.c_char_p, ct.c_int,
+                                ct.POINTER(ct.POINTER(ct.c_uint)),
+                                ct.POINTER(ct.c_int), ct.POINTER(ct.c_int),
+                                ct.c_void_p, ct.c_void_p)
+    fb_proto = ct.CFUNCTYPE(ct.c_int, ct.c_int, ct.POINTER(ct.c_void_p),
+                            ct.POINTER(ct.c_int), ct.POINTER(ct.c_int),
+                            ct.c_int, ct.c_void_p)
+
+    def _read_strlist(fn_addr, context):
+        fn = list_proto(fn_addr)
+        arr = ct.POINTER(ct.c_char_p)()
+        if not fn(ct.byref(arr), context):
+            raise RuntimeError('%s: C list callback failed' % op_type)
+        out, i = [], 0
+        while arr[i]:
+            out.append(arr[i].decode())
+            i += 1
+        return out
+
+    class CProp(_op_mod.CustomOpProp):
+        def __init__(self, **kwargs):
+            super().__init__(need_top_grad=True)
+            keys = [k.encode() for k in kwargs]
+            vals = [str(v).encode() for v in kwargs.values()]
+            karr = (ct.c_char_p * len(keys))(*keys)
+            varr = (ct.c_char_p * len(vals))(*vals)
+            cblist = _CallbackList()
+            if not creator(op_type.encode(), len(keys), karr, varr,
+                           ct.cast(ct.byref(cblist), ct.c_void_p)):
+                raise RuntimeError('CustomOpPropCreator for %r failed'
+                                   % op_type)
+            # order: CustomOpPropCallbacks enum (c_api.h:137-146)
+            self._cbs = [(ct.cast(cblist.callbacks[i], ct.c_void_p).value,
+                          cblist.contexts[i])
+                         for i in range(cblist.num_callbacks)]
+
+        def _cb(self, idx):
+            fnptr, context = self._cbs[idx]
+            return fnptr, context
+
+        def list_arguments(self):
+            fnptr, context = self._cb(1)
+            return _read_strlist(fnptr, context)
+
+        def list_outputs(self):
+            fnptr, context = self._cb(2)
+            return _read_strlist(fnptr, context)
+
+        def list_auxiliary_states(self):
+            if len(self._cbs) > 3 and self._cbs[3][0]:
+                return _read_strlist(*self._cb(3))
+            return []
+
+        def infer_shape(self, in_shape):
+            fnptr, context = self._cb(4)
+            fn = shape_proto(fnptr)
+            n_in = len(self.list_arguments())
+            n_out = len(self.list_outputs())
+            n_aux = len(self.list_auxiliary_states())
+            # total includes aux states (reference custom.cc:109)
+            n = n_in + n_out + n_aux
+            # protocol: in entries filled by caller, callback fills rest
+            ndims = (ct.c_int * n)()
+            shape_ptrs = (ct.POINTER(ct.c_uint) * n)()
+            keep = []
+            for i, s in enumerate(in_shape):
+                ndims[i] = len(s)
+                buf = (ct.c_uint * max(1, len(s)))(*[int(d) for d in s])
+                keep.append(buf)
+                shape_ptrs[i] = ct.cast(buf, ct.POINTER(ct.c_uint))
+            if not fn(n, ndims, shape_ptrs, context):
+                raise RuntimeError('%s: infer_shape callback failed'
+                                   % op_type)
+            shapes = []
+            for i in range(n):
+                shapes.append(tuple(int(shape_ptrs[i][j])
+                                    for j in range(ndims[i])))
+            return (shapes[:n_in], shapes[n_in:n_in + n_out],
+                    shapes[n_in + n_out:])
+
+        def create_operator(self, ctx_str, in_shapes, in_dtypes):
+            fnptr, context = self._cb(6)
+            fn = create_proto(fnptr)
+            n = len(in_shapes)
+            ndims = (ct.c_int * n)(*[len(s) for s in in_shapes])
+            keep = []
+            shape_ptrs = (ct.POINTER(ct.c_uint) * n)()
+            for i, s in enumerate(in_shapes):
+                buf = (ct.c_uint * max(1, len(s)))(*[int(d) for d in s])
+                keep.append(buf)
+                shape_ptrs[i] = ct.cast(buf, ct.POINTER(ct.c_uint))
+            dts = (ct.c_int * n)(*[_DTYPE_TO_CODE.get(str(t), 0)
+                                   for t in in_dtypes])
+            op_cblist = _CallbackList()
+            if not fn(b'cpu', n, shape_ptrs, ndims, dts,
+                      ct.cast(ct.byref(op_cblist), ct.c_void_p), context):
+                raise RuntimeError('%s: create_operator callback failed'
+                                   % op_type)
+            op_cbs = [(ct.cast(op_cblist.callbacks[i], ct.c_void_p).value,
+                       op_cblist.contexts[i])
+                      for i in range(op_cblist.num_callbacks)]
+            prop = self
+
+            class COp(_op_mod.CustomOp):
+                def _run_fb(self, idx, arrays_tagged, is_train):
+                    fnptr2, context2 = op_cbs[idx]
+                    fn2 = fb_proto(fnptr2)
+                    n2 = len(arrays_tagged)
+                    ptrs = (ct.c_void_p * n2)(
+                        *[_handle_ptr(a) for a, _ in arrays_tagged])
+                    tags = (ct.c_int * n2)(*[t for _, t in arrays_tagged])
+                    reqs = (ct.c_int * n2)(*([1] * n2))
+                    if not fn2(n2, ptrs, tags, reqs, int(is_train),
+                               context2):
+                        raise RuntimeError('%s: forward/backward callback '
+                                           'failed' % op_type)
+
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    tagged = [(a, 0) for a in in_data] + \
+                             [(a, 1) for a in out_data] + \
+                             [(a, 4) for a in aux]
+                    self._run_fb(1, tagged, is_train)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    tagged = [(a, 3) for a in out_grad] + \
+                             [(a, 0) for a in in_data] + \
+                             [(a, 1) for a in out_data] + \
+                             [(a, 2) for a in in_grad] + \
+                             [(a, 4) for a in aux]
+                    self._run_fb(2, tagged, is_train=True)
+            return COp()
+
+    CProp.__name__ = 'CProp_%s' % op_type
+    _op_mod.register(op_type)(CProp)
+    return 0
+
+
+# -- rtc --
+
+def rtc_create(name, input_names, output_names, inputs, outputs, kernel):
+    from . import rtc as _rtc
+    ins = list(zip(input_names, inputs))
+    outs = list(zip(output_names, outputs))
+    return _rtc.Rtc(name, ins, outs, kernel)
+
+
+def rtc_push(handle, inputs, outputs):
+    handle.push(list(inputs), list(outputs))
+    return 0
+
+
+# -- symbol shallow attrs --
+
+def symbol_list_attr_shallow(handle):
+    sym = _as_symbol(handle)
+    flat = []
+    for node, _idx in sym._outputs:
+        for k, v in node.attr_dict.items():
+            flat.append(str(k))
+            flat.append(str(v))
+    return flat

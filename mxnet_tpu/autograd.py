@@ -95,14 +95,18 @@ class TapeNode:
     Parallels AGNodeEntry/AGNode in src/ndarray/autograd.h:40-70.
     """
     __slots__ = ('vjp_fn', 'parents', 'n_outputs', 'out_grads', 'n_grad_inputs',
-                 'head_ids')
+                 'head_ids', 'op_info')
 
-    def __init__(self, vjp_fn, parents, n_outputs, n_grad_inputs):
+    def __init__(self, vjp_fn, parents, n_outputs, n_grad_inputs,
+                 op_info=None):
         self.vjp_fn = vjp_fn
         self.parents = parents          # list[TapeNode|None] aligned with grad inputs
         self.n_outputs = n_outputs
         self.n_grad_inputs = n_grad_inputs
         self.out_grads = None           # list of cotangents, filled during backward
+        # (op_name, attrs) — lets MXAutogradGetSymbol export the recorded
+        # history as a Symbol (reference nnvm graph behind the tape)
+        self.op_info = op_info
 
 
 class LeafNode:
@@ -114,8 +118,10 @@ class LeafNode:
         self.grad_req = grad_req
 
 
-def record_op(vjp_fn, parent_entries, n_outputs, n_grad_inputs):
-    return TapeNode(vjp_fn, parent_entries, n_outputs, n_grad_inputs)
+def record_op(vjp_fn, parent_entries, n_outputs, n_grad_inputs,
+              op_info=None):
+    return TapeNode(vjp_fn, parent_entries, n_outputs, n_grad_inputs,
+                    op_info=op_info)
 
 
 def mark_variables(variables, gradients, grad_reqs='write'):

@@ -531,7 +531,8 @@ def invoke(op_name, inputs, attrs=None, out=None):
         parents = [_parent_entry(i) for i in inputs]
         if op.needs_rng:
             parents.append((None, 0))
-        node = _ag.record_op(vjp_fn, parents, len(outs_t), n_real)
+        node = _ag.record_op(vjp_fn, parents, len(outs_t), n_real,
+                             op_info=(op_name, dict(attrs)))
         node.head_ids = [(o.shape, o.dtype) for o in outs_t]
     else:
         outs = f(*arrays)

@@ -837,7 +837,11 @@ static int StrListGetter(const char *fn, void *handle, mx_uint *out_size,
 int MXSymbolListAttr(SymbolHandle symbol, mx_uint *out_size,
                      const char ***out) {
   API_BEGIN();
-  return StrListGetter("symbol_list_attr", symbol, out_size, out);
+  int rc = StrListGetter("symbol_list_attr", symbol, out_size, out);
+  /* reference ABI: out_size counts key/value PAIRS; out holds 2*out_size
+     strings (c_api_symbolic.cc:297) */
+  if (rc == 0) *out_size /= 2;
+  return rc;
 }
 
 int MXSymbolListArguments(SymbolHandle symbol, mx_uint *out_size,
@@ -1313,6 +1317,650 @@ int MXRecordIOReaderReadRecord(RecordIOHandle handle, const char **buf,
 
 int MXRecordIOReaderSeek(RecordIOHandle handle, size_t pos) {
   return MXTRecordIOReaderSeek(handle, pos);
+}
+
+
+/* ------------------------------------------------------------------------
+ * Round-3 additions: remaining reference entry points (146/146 parity).
+ * Reference: include/mxnet/c_api.h; bridge helpers in _c_api_impl.py.
+ * ---------------------------------------------------------------------- */
+
+int MXImperativeInvokeEx(AtomicSymbolCreator creator, int num_inputs,
+                         NDArrayHandle *inputs, int *num_outputs,
+                         NDArrayHandle **outputs, int num_params,
+                         const char **param_keys, const char **param_vals,
+                         const int **out_stypes) {
+  API_BEGIN();
+  PyObject *ins = HandleList(num_inputs, inputs);
+  PyObject *keys = StrList(num_params, param_keys);
+  PyObject *vals = StrList(num_params, param_vals);
+  int n_provided = (*num_outputs > 0 && *outputs != nullptr) ? *num_outputs : 0;
+  PyObject *outs = HandleList(n_provided, (void **)(n_provided ? *outputs : nullptr));
+  PyObject *r = CallV("imperative_invoke_ex",
+                      Py_BuildValue("(sNNNiN)", CreatorName(creator), ins,
+                                    keys, vals, n_provided, outs));
+  CHECK_PY(r);
+  PyObject *arrs = PyTuple_GetItem(r, 0);
+  PyObject *stypes = PyTuple_GetItem(r, 1);
+  mx_uint n = 0;
+  if (n_provided == 0) {
+    *outputs = (NDArrayHandle *)StoreHandleList(arrs, &n);
+    *num_outputs = (int)n;
+  } else {
+    *num_outputs = (int)PySequence_Size(arrs);
+  }
+  ret.ints.clear();
+  for (Py_ssize_t i = 0; i < PySequence_Size(stypes); ++i) {
+    PyObject *it = PySequence_GetItem(stypes, i);
+    ret.ints.push_back((int)PyLong_AsLong(it));
+    Py_DECREF(it);
+  }
+  *out_stypes = ret.ints.data();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXInvokeCachedOpEx(CachedOpHandle handle, int num_inputs,
+                       NDArrayHandle *inputs, int *num_outputs,
+                       NDArrayHandle **outputs, const int **out_stypes) {
+  API_BEGIN();
+  PyObject *ins = HandleList(num_inputs, inputs);
+  PyObject *r = CallV("cached_op_invoke_ex",
+                      Py_BuildValue("(ON)", (PyObject *)handle, ins));
+  CHECK_PY(r);
+  PyObject *arrs = PyTuple_GetItem(r, 0);
+  PyObject *stypes = PyTuple_GetItem(r, 1);
+  mx_uint n = 0;
+  *outputs = (NDArrayHandle *)StoreHandleList(arrs, &n);
+  *num_outputs = (int)n;
+  ret.ints.clear();
+  for (Py_ssize_t i = 0; i < PySequence_Size(stypes); ++i) {
+    PyObject *it = PySequence_GetItem(stypes, i);
+    ret.ints.push_back((int)PyLong_AsLong(it));
+    Py_DECREF(it);
+  }
+  *out_stypes = ret.ints.data();
+  Py_DECREF(r);
+  return 0;
+}
+
+/* -- sparse containers -- */
+
+int MXNDArrayCreateSparseEx(int storage_type, const mx_uint *shape,
+                            mx_uint ndim, int dev_type, int dev_id,
+                            int delay_alloc, int dtype, mx_uint num_aux,
+                            int *aux_type, mx_uint *aux_ndims,
+                            const mx_uint *aux_shape, NDArrayHandle *out) {
+  (void)delay_alloc;
+  API_BEGIN();
+  PyObject *shp = UIntList((int)ndim, shape);
+  PyObject *atypes = IntList((int)num_aux, aux_type);
+  PyObject *ashapes = PyList_New(num_aux);
+  mx_uint off = 0;
+  for (mx_uint i = 0; i < num_aux; ++i) {
+    PyObject *one = UIntList((int)aux_ndims[i], aux_shape + off);
+    off += aux_ndims[i];
+    PyList_SET_ITEM(ashapes, i, one);
+  }
+  PyObject *r = CallV("nd_create_sparse",
+                      Py_BuildValue("(iNiiiNN)", storage_type, shp, dev_type,
+                                    dev_id, dtype, atypes, ashapes));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArrayGetAuxType(NDArrayHandle handle, mx_uint i, int *out_type) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_aux_type",
+                      Py_BuildValue("(Oi)", (PyObject *)handle, (int)i));
+  CHECK_PY(r);
+  *out_type = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArrayGetAuxNDArray(NDArrayHandle handle, mx_uint i,
+                           NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_get_aux",
+                      Py_BuildValue("(Oi)", (PyObject *)handle, (int)i));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArrayGetDataNDArray(NDArrayHandle handle, NDArrayHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_get_data", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out = (NDArrayHandle)r;
+  return 0;
+}
+
+int MXNDArrayGetGradState(NDArrayHandle handle, int *out) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_grad_state", Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySetGradState(NDArrayHandle handle, int state) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_set_grad_state",
+                      Py_BuildValue("(Oi)", (PyObject *)handle, state));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXNDArraySyncCopyFromNDArray(NDArrayHandle handle_dst,
+                                 const NDArrayHandle handle_src, const int i) {
+  API_BEGIN();
+  PyObject *r = CallV("nd_sync_copy_from_ndarray",
+                      Py_BuildValue("(OOi)", (PyObject *)handle_dst,
+                                    (PyObject *)handle_src, i));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+/* -- autograd extras -- */
+
+int MXAutogradComputeGradient(mx_uint num_output,
+                              NDArrayHandle *output_handles) {
+  return MXAutogradBackward(num_output, output_handles, nullptr, 0);
+}
+
+int MXAutogradGetSymbol(NDArrayHandle handle, SymbolHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("autograd_get_symbol",
+                      Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  *out = (SymbolHandle)r;
+  return 0;
+}
+
+int MXCustomFunctionRecord(int num_inputs, NDArrayHandle *inputs,
+                           int num_outputs, NDArrayHandle *outputs,
+                           struct MXCallbackList *callbacks) {
+  API_BEGIN();
+  PyObject *ins = HandleList(num_inputs, inputs);
+  PyObject *outs = HandleList(num_outputs, outputs);
+  PyObject *cbs = PyList_New(callbacks->num_callbacks);
+  for (int i = 0; i < callbacks->num_callbacks; ++i) {
+    PyObject *pair = Py_BuildValue("(KK)",
+        (unsigned long long)(uintptr_t)callbacks->callbacks[i],
+        (unsigned long long)(uintptr_t)callbacks->contexts[i]);
+    PyList_SET_ITEM(cbs, i, pair);
+  }
+  PyObject *r = CallV("custom_function_record",
+                      Py_BuildValue("(NNN)", ins, outs, cbs));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXCustomOpRegister(const char *op_type, CustomOpPropCreator creator) {
+  API_BEGIN();
+  PyObject *r = CallV("custom_op_register",
+                      Py_BuildValue("(sK)", op_type,
+                                    (unsigned long long)(uintptr_t)creator));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+/* -- legacy NDArray-function registry -- */
+
+int MXListFunctions(mx_uint *out_size, FunctionHandle **out_array) {
+  API_BEGIN();
+  PyObject *r = CallV("list_functions", PyTuple_New(0));
+  CHECK_PY(r);
+  *out_array = (FunctionHandle *)StoreHandleList(r, out_size);
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXGetFunction(const char *name, FunctionHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV("get_function", Py_BuildValue("(s)", name));
+  CHECK_PY(r);
+  *out = (FunctionHandle)r;
+  return 0;
+}
+
+int MXFuncDescribe(FunctionHandle fun, mx_uint *num_use_vars,
+                   mx_uint *num_scalars, mx_uint *num_mutate_vars,
+                   int *type_mask) {
+  API_BEGIN();
+  PyObject *r = CallV("func_describe", Py_BuildValue("(O)", (PyObject *)fun));
+  CHECK_PY(r);
+  *num_use_vars = (mx_uint)PyLong_AsLong(PyTuple_GetItem(r, 0));
+  *num_scalars = (mx_uint)PyLong_AsLong(PyTuple_GetItem(r, 1));
+  *num_mutate_vars = (mx_uint)PyLong_AsLong(PyTuple_GetItem(r, 2));
+  *type_mask = (int)PyLong_AsLong(PyTuple_GetItem(r, 3));
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncGetInfo(FunctionHandle fun, const char **name,
+                  const char **description, mx_uint *num_args,
+                  const char ***arg_names, const char ***arg_type_infos,
+                  const char ***arg_descriptions, const char **return_type) {
+  API_BEGIN();
+  PyObject *r = CallV("func_get_info", Py_BuildValue("(O)", (PyObject *)fun));
+  CHECK_PY(r);
+  /* storage layout mirrors MXSymbolGetAtomicSymbolInfo: strings go into
+     thread-local ret. */
+  ret.strings.clear();
+  auto keep = [&](PyObject *o) {
+    ret.strings.emplace_back(PyUnicode_Check(o) ? PyUnicode_AsUTF8(o) : "");
+  };
+  keep(PyTuple_GetItem(r, 0));
+  keep(PyTuple_GetItem(r, 1));
+  PyObject *args = PyTuple_GetItem(r, 2);
+  PyObject *tinfos = PyTuple_GetItem(r, 3);
+  PyObject *descs = PyTuple_GetItem(r, 4);
+  keep(PyTuple_GetItem(r, 5));
+  Py_ssize_t n = PySequence_Size(args);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject *a = PySequence_GetItem(args, i); keep(a); Py_DECREF(a);
+    PyObject *t = PySequence_GetItem(tinfos, i); keep(t); Py_DECREF(t);
+    PyObject *d = PySequence_GetItem(descs, i); keep(d); Py_DECREF(d);
+  }
+  ret.cptrs.clear();
+  for (auto &s : ret.strings) ret.cptrs.push_back(s.c_str());
+  *name = ret.cptrs[0];
+  *description = ret.cptrs[1];
+  if (return_type) *return_type = ret.cptrs[2];
+  *num_args = (mx_uint)n;
+  /* triples start at index 3: name,i type,i desc,i interleaved */
+  ret.handles.clear();  /* reuse as scratch for pointer arrays */
+  static thread_local std::vector<const char *> anames, atypes, adescs;
+  anames.clear(); atypes.clear(); adescs.clear();
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    anames.push_back(ret.cptrs[3 + 3 * i]);
+    atypes.push_back(ret.cptrs[3 + 3 * i + 1]);
+    adescs.push_back(ret.cptrs[3 + 3 * i + 2]);
+  }
+  *arg_names = anames.data();
+  *arg_type_infos = atypes.data();
+  *arg_descriptions = adescs.data();
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncInvokeEx(FunctionHandle fun, NDArrayHandle *use_vars,
+                   mx_float *scalar_args, NDArrayHandle *mutate_vars,
+                   int num_params, char **param_keys, char **param_vals) {
+  API_BEGIN();
+  mx_uint n_use = 0, n_scalar = 0, n_mut = 0; int mask = 0;
+  {
+    PyObject *d = CallV("func_describe", Py_BuildValue("(O)", (PyObject *)fun));
+    CHECK_PY(d);
+    n_use = (mx_uint)PyLong_AsLong(PyTuple_GetItem(d, 0));
+    n_scalar = (mx_uint)PyLong_AsLong(PyTuple_GetItem(d, 1));
+    n_mut = (mx_uint)PyLong_AsLong(PyTuple_GetItem(d, 2));
+    mask = (int)PyLong_AsLong(PyTuple_GetItem(d, 3));
+    (void)mask;
+    Py_DECREF(d);
+  }
+  PyObject *uses = HandleList((int)n_use, use_vars);
+  PyObject *scalars = PyList_New(n_scalar);
+  for (mx_uint i = 0; i < n_scalar; ++i)
+    PyList_SET_ITEM(scalars, i, PyFloat_FromDouble(scalar_args ? scalar_args[i] : 0));
+  PyObject *muts = HandleList((int)n_mut, mutate_vars);
+  PyObject *keys = StrList(num_params, (const char *const *)param_keys);
+  PyObject *vals = StrList(num_params, (const char *const *)param_vals);
+  PyObject *r = CallV("func_invoke",
+                      Py_BuildValue("(ONNNNN)", (PyObject *)fun, uses, scalars,
+                                    muts, keys, vals));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXFuncInvoke(FunctionHandle fun, NDArrayHandle *use_vars,
+                 mx_float *scalar_args, NDArrayHandle *mutate_vars) {
+  return MXFuncInvokeEx(fun, use_vars, scalar_args, mutate_vars, 0, nullptr,
+                        nullptr);
+}
+
+/* -- kvstore extras -- */
+
+int MXInitPSEnv(mx_uint num_vars, const char **keys, const char **vals) {
+  API_BEGIN();
+  PyObject *r = CallV("init_ps_env",
+                      Py_BuildValue("(NN)", StrList((int)num_vars, keys),
+                                    StrList((int)num_vars, vals)));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreInitEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_init_ex",
+                      Py_BuildValue("(ONN)", (PyObject *)handle,
+                                    StrList((int)num, keys),
+                                    HandleList((int)num, vals)));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePushEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_push_ex",
+                      Py_BuildValue("(ONNi)", (PyObject *)handle,
+                                    StrList((int)num, keys),
+                                    HandleList((int)num, vals), priority));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePullEx(KVStoreHandle handle, mx_uint num, const char **keys,
+                    NDArrayHandle *vals, int priority) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_pull_ex",
+                      Py_BuildValue("(ONNi)", (PyObject *)handle,
+                                    StrList((int)num, keys),
+                                    HandleList((int)num, vals), priority));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePullRowSparse(KVStoreHandle handle, mx_uint num,
+                           const int *keys, NDArrayHandle *vals,
+                           const NDArrayHandle *row_ids, int priority) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_pull_row_sparse",
+                      Py_BuildValue("(ONNNi)", (PyObject *)handle,
+                                    IntList((int)num, keys),
+                                    HandleList((int)num, vals),
+                                    HandleList((int)num, (void *const *)row_ids),
+                                    priority));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStorePullRowSparseEx(KVStoreHandle handle, mx_uint num,
+                             const char **keys, NDArrayHandle *vals,
+                             const NDArrayHandle *row_ids, int priority) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_pull_row_sparse",
+                      Py_BuildValue("(ONNNi)", (PyObject *)handle,
+                                    StrList((int)num, keys),
+                                    HandleList((int)num, vals),
+                                    HandleList((int)num, (void *const *)row_ids),
+                                    priority));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetBarrierBeforeExit(KVStoreHandle handle,
+                                  const int barrier_before_exit) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_set_barrier_before_exit",
+                      Py_BuildValue("(Oi)", (PyObject *)handle,
+                                    barrier_before_exit));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetUpdaterEx(KVStoreHandle handle, MXKVStoreUpdater updater,
+                          MXKVStoreStrUpdater str_updater,
+                          void *updater_handle) {
+  API_BEGIN();
+  PyObject *r = CallV("kv_set_updater",
+                      Py_BuildValue("(OKKK)", (PyObject *)handle,
+                                    (unsigned long long)(uintptr_t)updater,
+                                    (unsigned long long)(uintptr_t)str_updater,
+                                    (unsigned long long)(uintptr_t)updater_handle));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXKVStoreSetUpdater(KVStoreHandle handle, MXKVStoreUpdater updater,
+                        void *updater_handle) {
+  return MXKVStoreSetUpdaterEx(handle, updater, nullptr, updater_handle);
+}
+
+/* -- executor extras -- */
+
+int MXExecutorBackwardEx(ExecutorHandle handle, mx_uint len,
+                         NDArrayHandle *head_grads, int is_train) {
+  API_BEGIN();
+  PyObject *grads = HandleList((int)len, head_grads);
+  PyObject *r = CallV("executor_backward_ex",
+                      Py_BuildValue("(ONi)", (PyObject *)handle, grads,
+                                    is_train));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+static int BindXImpl(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle *out) {
+  API_BEGIN();
+  PyObject *reqs = PyList_New(len);
+  for (mx_uint i = 0; i < len; ++i)
+    PyList_SET_ITEM(reqs, i,
+                    PyLong_FromUnsignedLong(grad_req_type ? grad_req_type[i] : 1));
+  PyObject *r = CallV(
+      "executor_bind_x",
+      Py_BuildValue("(OiiNNNNNNN)", (PyObject *)symbol_handle, dev_type,
+                    dev_id, StrList((int)num_map_keys, map_keys),
+                    IntList((int)num_map_keys, map_dev_types),
+                    IntList((int)num_map_keys, map_dev_ids),
+                    HandleList((int)len, in_args),
+                    HandleList((int)len, arg_grad_store), reqs,
+                    HandleList((int)aux_states_len, aux_states)));
+  CHECK_PY(r);
+  *out = (ExecutorHandle)r;
+  return 0;
+}
+
+int MXExecutorBindX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                    mx_uint num_map_keys, const char **map_keys,
+                    const int *map_dev_types, const int *map_dev_ids,
+                    mx_uint len, NDArrayHandle *in_args,
+                    NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                    mx_uint aux_states_len, NDArrayHandle *aux_states,
+                    ExecutorHandle *out) {
+  return BindXImpl(symbol_handle, dev_type, dev_id, num_map_keys, map_keys,
+                   map_dev_types, map_dev_ids, len, in_args, arg_grad_store,
+                   grad_req_type, aux_states_len, aux_states, out);
+}
+
+int MXExecutorBindEX(SymbolHandle symbol_handle, int dev_type, int dev_id,
+                     mx_uint num_map_keys, const char **map_keys,
+                     const int *map_dev_types, const int *map_dev_ids,
+                     mx_uint len, NDArrayHandle *in_args,
+                     NDArrayHandle *arg_grad_store, mx_uint *grad_req_type,
+                     mx_uint aux_states_len, NDArrayHandle *aux_states,
+                     ExecutorHandle shared_exec, ExecutorHandle *out) {
+  (void)shared_exec;  /* memory sharing is XLA's concern here */
+  return BindXImpl(symbol_handle, dev_type, dev_id, num_map_keys, map_keys,
+                   map_dev_types, map_dev_ids, len, in_args, arg_grad_store,
+                   grad_req_type, aux_states_len, aux_states, out);
+}
+
+int MXExecutorSimpleBind(
+    SymbolHandle symbol_handle, int dev_type, int dev_id,
+    const mx_uint num_g2c_keys, const char **g2c_keys,
+    const int *g2c_dev_types, const int *g2c_dev_ids,
+    const mx_uint provided_grad_req_list_len,
+    const char **provided_grad_req_names,
+    const char **provided_grad_req_types,
+    const mx_uint num_provided_arg_shapes,
+    const char **provided_arg_shape_names,
+    const mx_uint *provided_arg_shape_data,
+    const mx_uint *provided_arg_shape_idx,
+    const mx_uint num_provided_arg_dtypes,
+    const char **provided_arg_dtype_names, const int *provided_arg_dtypes,
+    const mx_uint num_provided_arg_stypes,
+    const char **provided_arg_stype_names, const int *provided_arg_stypes,
+    const mx_uint num_shared_arg_names, const char **shared_arg_name_list,
+    int *shared_buffer_len, const char **shared_buffer_name_list,
+    NDArrayHandle *shared_buffer_handle_list,
+    const char ***updated_shared_buffer_name_list,
+    NDArrayHandle **updated_shared_buffer_handle_list,
+    mx_uint *num_in_args, NDArrayHandle **in_args, NDArrayHandle **arg_grads,
+    mx_uint *num_aux_states, NDArrayHandle **aux_states,
+    ExecutorHandle shared_exec_handle, ExecutorHandle *out) {
+  (void)num_shared_arg_names; (void)shared_arg_name_list;
+  (void)shared_exec_handle;
+  API_BEGIN();
+  /* shapes arrive as a CSR pair (idx/data) keyed by name */
+  PyObject *shapes = PyList_New(num_provided_arg_shapes);
+  for (mx_uint i = 0; i < num_provided_arg_shapes; ++i) {
+    mx_uint b = provided_arg_shape_idx[i], e = provided_arg_shape_idx[i + 1];
+    PyObject *one = UIntList((int)(e - b), provided_arg_shape_data + b);
+    PyList_SET_ITEM(shapes, i, one);
+  }
+  int n_buf = shared_buffer_len ? *shared_buffer_len : -1;
+  if (n_buf < 0) n_buf = 0;
+  PyObject *r = CallV(
+      "executor_simple_bind",
+      Py_BuildValue(
+          "(OiiNNNNNNNNNNNNN)", (PyObject *)symbol_handle, dev_type, dev_id,
+          StrList((int)num_g2c_keys, g2c_keys),
+          IntList((int)num_g2c_keys, g2c_dev_types),
+          IntList((int)num_g2c_keys, g2c_dev_ids),
+          StrList((int)provided_grad_req_list_len, provided_grad_req_names),
+          StrList((int)provided_grad_req_list_len, provided_grad_req_types),
+          StrList((int)num_provided_arg_shapes, provided_arg_shape_names),
+          shapes,
+          StrList((int)num_provided_arg_dtypes, provided_arg_dtype_names),
+          IntList((int)num_provided_arg_dtypes, provided_arg_dtypes),
+          StrList((int)num_provided_arg_stypes, provided_arg_stype_names),
+          IntList((int)num_provided_arg_stypes, provided_arg_stypes),
+          StrList(n_buf, shared_buffer_name_list),
+          HandleList(n_buf, shared_buffer_handle_list)));
+  CHECK_PY(r);
+  /* (ex, arg_names, in_args, arg_grads, aux_names, aux_states,
+     upd_names, upd_arrays) */
+  PyObject *ex = PyTuple_GetItem(r, 0);
+  Py_INCREF(ex);
+  *out = (ExecutorHandle)ex;
+  mx_uint n = 0;
+  *in_args = (NDArrayHandle *)StoreHandleList(PyTuple_GetItem(r, 2), &n);
+  *num_in_args = n;
+  /* arg grads share the handles vector; stash after in_args */
+  static thread_local std::vector<void *> grad_handles, aux_handles,
+      upd_handles;
+  grad_handles.clear();
+  PyObject *gl = PyTuple_GetItem(r, 3);
+  for (Py_ssize_t i = 0; i < PySequence_Size(gl); ++i) {
+    PyObject *it = PySequence_GetItem(gl, i);
+    if (it == Py_None) { grad_handles.push_back(nullptr); Py_DECREF(it); }
+    else grad_handles.push_back((void *)it);  /* keep ref */
+  }
+  *arg_grads = grad_handles.data();
+  aux_handles.clear();
+  PyObject *al = PyTuple_GetItem(r, 5);
+  for (Py_ssize_t i = 0; i < PySequence_Size(al); ++i)
+    aux_handles.push_back((void *)PySequence_GetItem(al, i));
+  *aux_states = aux_handles.data();
+  *num_aux_states = (mx_uint)aux_handles.size();
+  if (updated_shared_buffer_name_list && shared_buffer_len) {
+    mx_uint nu = 0;
+    *updated_shared_buffer_name_list =
+        StoreStrList(PyTuple_GetItem(r, 6), &nu);
+    upd_handles.clear();
+    PyObject *ul = PyTuple_GetItem(r, 7);
+    for (Py_ssize_t i = 0; i < PySequence_Size(ul); ++i)
+      upd_handles.push_back((void *)PySequence_GetItem(ul, i));
+    *updated_shared_buffer_handle_list = upd_handles.data();
+    *shared_buffer_len = (int)nu;
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+int MXExecutorSetMonitorCallback(ExecutorHandle handle,
+                                 ExecutorMonitorCallback callback,
+                                 void *callback_handle) {
+  API_BEGIN();
+  PyObject *r = CallV("executor_set_monitor_callback",
+                      Py_BuildValue("(OKK)", (PyObject *)handle,
+                                    (unsigned long long)(uintptr_t)callback,
+                                    (unsigned long long)(uintptr_t)callback_handle));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+/* -- data iter index -- */
+
+int MXDataIterGetIndex(DataIterHandle handle, uint64_t **out_index,
+                       uint64_t *out_size) {
+  API_BEGIN();
+  PyObject *r = CallV("data_iter_get_index",
+                      Py_BuildValue("(O)", (PyObject *)handle));
+  CHECK_PY(r);
+  char *buf = nullptr; Py_ssize_t blen = 0;
+  PyBytes_AsStringAndSize(r, &buf, &blen);
+  ret.blob.assign(buf, (size_t)blen);
+  *out_index = (uint64_t *)ret.blob.data();
+  *out_size = (uint64_t)(blen / sizeof(uint64_t));
+  Py_DECREF(r);
+  return 0;
+}
+
+/* -- symbol shallow attr -- */
+
+int MXSymbolListAttrShallow(SymbolHandle symbol, mx_uint *out_size,
+                            const char ***out) {
+  API_BEGIN();
+  PyObject *r = CallV("symbol_list_attr_shallow",
+                      Py_BuildValue("(O)", (PyObject *)symbol));
+  CHECK_PY(r);
+  *out = StoreStrList(r, out_size);
+  *out_size /= 2;  /* pairs, not flat strings (reference ABI) */
+  Py_DECREF(r);
+  return 0;
+}
+
+/* -- rtc -- */
+
+int MXRtcCreate(char *name, mx_uint num_input, mx_uint num_output,
+                char **input_names, char **output_names,
+                NDArrayHandle *inputs, NDArrayHandle *outputs, char *kernel,
+                RtcHandle *out) {
+  API_BEGIN();
+  PyObject *r = CallV(
+      "rtc_create",
+      Py_BuildValue("(sNNNNs)", name,
+                    StrList((int)num_input, (const char *const *)input_names),
+                    StrList((int)num_output, (const char *const *)output_names),
+                    HandleList((int)num_input, inputs),
+                    HandleList((int)num_output, outputs), kernel));
+  CHECK_PY(r);
+  *out = (RtcHandle)r;
+  return 0;
+}
+
+int MXRtcPush(RtcHandle handle, mx_uint num_input, mx_uint num_output,
+              NDArrayHandle *inputs, NDArrayHandle *outputs,
+              mx_uint gridDimX, mx_uint gridDimY, mx_uint gridDimZ,
+              mx_uint blockDimX, mx_uint blockDimY, mx_uint blockDimZ) {
+  (void)gridDimX; (void)gridDimY; (void)gridDimZ;
+  (void)blockDimX; (void)blockDimY; (void)blockDimZ;
+  API_BEGIN();
+  PyObject *r = CallV("rtc_push",
+                      Py_BuildValue("(ONN)", (PyObject *)handle,
+                                    HandleList((int)num_input, inputs),
+                                    HandleList((int)num_output, outputs)));
+  CHECK_PY(r); Py_DECREF(r);
+  return 0;
+}
+
+int MXRtcFree(RtcHandle handle) {
+  if (handle) { Gil g; Py_DECREF((PyObject *)handle); }
+  return 0;
 }
 
 }  /* extern "C" */

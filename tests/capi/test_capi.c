@@ -505,6 +505,528 @@ static void test_error_path(void) {
   printf("error path ok\n");
 }
 
+
+/* ---- round-3 additions: the 38 new entry points ---- */
+
+static AtomicSymbolCreator find_op(const char *want) {
+  mx_uint n = 0;
+  AtomicSymbolCreator *creators;
+  CHECK_OK(MXSymbolListAtomicSymbolCreators(&n, &creators));
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name;
+    CHECK_OK(MXSymbolGetAtomicSymbolName(creators[i], &name));
+    if (strcmp(name, want) == 0) return creators[i];
+  }
+  return NULL;
+}
+
+static void test_func_family(void) {
+  mx_uint n_funcs = 0;
+  FunctionHandle *funcs;
+  CHECK_OK(MXListFunctions(&n_funcs, &funcs));
+  CHECK(n_funcs > 200);
+
+  FunctionHandle plus;
+  CHECK_OK(MXGetFunction("_plus", &plus));
+  mx_uint nu, ns, nm;
+  int mask;
+  CHECK_OK(MXFuncDescribe(plus, &nu, &ns, &nm, &mask));
+  CHECK(nu == 2 && nm == 1);
+  const char *name, *desc, **anames, **atypes, **adescs, *rtype;
+  mx_uint nargs;
+  CHECK_OK(MXFuncGetInfo(plus, &name, &desc, &nargs, &anames, &atypes,
+                         &adescs, &rtype));
+  CHECK(strcmp(name, "_plus") == 0);
+
+  mx_uint shape[1] = {4};
+  NDArrayHandle a, b, out;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &a));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &b));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &out));
+  float xs[4] = {1, 2, 3, 4};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(a, xs, 4));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(b, xs, 4));
+  NDArrayHandle uses[2] = {a, b}, muts[1] = {out};
+  CHECK_OK(MXFuncInvoke(plus, uses, NULL, muts));
+  float res[4];
+  CHECK_OK(MXNDArraySyncCopyToCPU(out, res, 4));
+  for (int i = 0; i < 4; ++i) CHECK(fabsf(res[i] - 2 * xs[i]) < 1e-6f);
+  CHECK_OK(MXNDArrayFree(a));
+  CHECK_OK(MXNDArrayFree(b));
+  CHECK_OK(MXNDArrayFree(out));
+  printf("func family ok\n");
+}
+
+static void test_invoke_ex_and_sparse(void) {
+  AtomicSymbolCreator plus = find_op("_plus");
+  CHECK(plus != NULL);
+  mx_uint shape[1] = {3};
+  NDArrayHandle a;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &a));
+  float xs[3] = {1, 2, 3};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(a, xs, 3));
+  NDArrayHandle ins[2] = {a, a}, *outs = NULL;
+  int num_out = 0;
+  const int *stypes = NULL;
+  CHECK_OK(MXImperativeInvokeEx(plus, 2, ins, &num_out, &outs, 0, NULL, NULL,
+                                &stypes));
+  CHECK(num_out == 1 && stypes[0] == 0);
+
+  /* row_sparse container: shape (4,2), 2 stored rows */
+  mx_uint sshape[2] = {4, 2};
+  int aux_types[1] = {6};
+  mx_uint aux_ndims[1] = {1};
+  mx_uint aux_shapes[1] = {2};
+  NDArrayHandle rsp;
+  CHECK_OK(MXNDArrayCreateSparseEx(1, sshape, 2, 1, 0, 0, 0, 1, aux_types,
+                                   aux_ndims, aux_shapes, &rsp));
+  int stype;
+  CHECK_OK(MXNDArrayGetStorageType(rsp, &stype));
+  CHECK(stype == 1);
+  int aux_t;
+  CHECK_OK(MXNDArrayGetAuxType(rsp, 0, &aux_t));
+  CHECK(aux_t == 6 || aux_t == 4); /* int64 stored (int32 under x64-off) */
+  NDArrayHandle aux0, data;
+  CHECK_OK(MXNDArrayGetAuxNDArray(rsp, 0, &aux0));
+  CHECK_OK(MXNDArrayGetDataNDArray(rsp, &data));
+  mx_uint nd;
+  const mx_uint *dims;
+  CHECK_OK(MXNDArrayGetShape(data, &nd, &dims));
+  CHECK(nd == 2 && dims[0] == 2 && dims[1] == 2);
+
+  /* grad state flag */
+  int gs = -1;
+  CHECK_OK(MXNDArraySetGradState(a, 1));
+  CHECK_OK(MXNDArrayGetGradState(a, &gs));
+  CHECK(gs == 1);
+
+  /* copy data array of rsp into a dense of same shape */
+  mx_uint dshape[2] = {2, 2};
+  NDArrayHandle dst;
+  CHECK_OK(MXNDArrayCreate(dshape, 2, 1, 0, 0, &dst));
+  CHECK_OK(MXNDArraySyncCopyFromNDArray(dst, rsp, -1));
+
+  CHECK_OK(MXNDArrayFree(dst));
+  CHECK_OK(MXNDArrayFree(aux0));
+  CHECK_OK(MXNDArrayFree(data));
+  CHECK_OK(MXNDArrayFree(rsp));
+  CHECK_OK(MXNDArrayFree(outs[0]));
+  CHECK_OK(MXNDArrayFree(a));
+  printf("invoke_ex + sparse handles ok\n");
+}
+
+static void do_update(NDArrayHandle recv, NDArrayHandle local,
+                      void *handle) {
+  /* local += recv, through the C API itself */
+  *(int *)handle += 1;
+  AtomicSymbolCreator plus = find_op("_plus");
+  NDArrayHandle ins[2] = {local, recv};
+  NDArrayHandle outs_buf[1] = {local};
+  NDArrayHandle *outs = outs_buf;
+  int num_out = 1;
+  CHECK_OK(MXImperativeInvoke(plus, 2, ins, &num_out, &outs, 0, NULL, NULL));
+}
+
+static void updater_fn(int key, NDArrayHandle recv, NDArrayHandle local,
+                       void *handle) {
+  (void)key;
+  do_update(recv, local, handle);
+}
+
+static void str_updater_fn(const char *key, NDArrayHandle recv,
+                           NDArrayHandle local, void *handle) {
+  (void)key;
+  do_update(recv, local, handle);
+}
+
+static void test_kvstore_ex_and_updater(void) {
+  KVStoreHandle kv;
+  CHECK_OK(MXKVStoreCreate("local", &kv));
+  int calls = 0;
+  CHECK_OK(MXKVStoreSetUpdaterEx(kv, updater_fn, str_updater_fn, &calls));
+
+  mx_uint shape[1] = {2};
+  NDArrayHandle init_v, push_v, pull_v;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &init_v));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &push_v));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &pull_v));
+  float ones[2] = {1, 1}, twos[2] = {2, 2};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(init_v, ones, 2));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(push_v, twos, 2));
+
+  const char *keys[1] = {"w0"};
+  NDArrayHandle vals[1] = {init_v};
+  CHECK_OK(MXKVStoreInitEx(kv, 1, keys, vals));
+  vals[0] = push_v;
+  CHECK_OK(MXKVStorePushEx(kv, 1, keys, vals, 0));
+  vals[0] = pull_v;
+  CHECK_OK(MXKVStorePullEx(kv, 1, keys, vals, 0));
+  float got[2];
+  CHECK_OK(MXNDArraySyncCopyToCPU(pull_v, got, 2));
+  CHECK(calls == 1);
+  CHECK(fabsf(got[0] - 3.0f) < 1e-6f); /* 1 + 2 via C updater */
+
+  CHECK_OK(MXKVStoreSetBarrierBeforeExit(kv, 0));
+  CHECK_OK(MXInitPSEnv(0, NULL, NULL));
+  CHECK_OK(MXNDArrayFree(init_v));
+  CHECK_OK(MXNDArrayFree(push_v));
+  CHECK_OK(MXNDArrayFree(pull_v));
+  CHECK_OK(MXKVStoreFree(kv));
+  printf("kvstore ex + C updater ok\n");
+}
+
+static void test_simple_bind_and_backward_ex(void) {
+  /* y = FC(x; w, b) built through symbol compose, then SimpleBind */
+  AtomicSymbolCreator fc = find_op("FullyConnected");
+  CHECK(fc != NULL);
+  const char *pk[1] = {"num_hidden"};
+  const char *pv[1] = {"3"};
+  SymbolHandle fcs, x;
+  CHECK_OK(MXSymbolCreateAtomicSymbol(fc, 1, pk, pv, &fcs));
+  CHECK_OK(MXSymbolCreateVariable("x", &x));
+  const char *ckeys[1] = {"data"};
+  SymbolHandle args[1] = {x};
+  CHECK_OK(MXSymbolCompose(fcs, "fc1", 1, ckeys, args));
+
+  const char *shape_names[1] = {"x"};
+  mx_uint shape_data[2] = {4, 5};
+  mx_uint shape_idx[2] = {0, 2};
+  const char *req_types[1] = {"write"};
+  mx_uint num_in = 0, num_aux = 0;
+  NDArrayHandle *in_args, *arg_grads, *aux_states;
+  ExecutorHandle ex;
+  int buf_len = -1;
+  CHECK_OK(MXExecutorSimpleBind(
+      fcs, 1, 0, 0, NULL, NULL, NULL, 1, NULL, req_types, 1, shape_names,
+      shape_data, shape_idx, 0, NULL, NULL, 0, NULL, NULL, 0, NULL, &buf_len,
+      NULL, NULL, NULL, NULL, &num_in, &in_args, &arg_grads, &num_aux,
+      &aux_states, NULL, &ex));
+  CHECK(num_in == 3); /* x, fc1_weight, fc1_bias */
+  CHECK(arg_grads[0] != NULL);
+
+  CHECK_OK(MXExecutorForward(ex, 1));
+  mx_uint n_out = 0;
+  NDArrayHandle *outs;
+  CHECK_OK(MXExecutorOutputs(ex, &n_out, &outs));
+  CHECK(n_out == 1);
+  mx_uint nd;
+  const mx_uint *dims;
+  CHECK_OK(MXNDArrayGetShape(outs[0], &nd, &dims));
+  CHECK(nd == 2 && dims[0] == 4 && dims[1] == 3);
+
+  /* BackwardEx with explicit head grads */
+  mx_uint gshape[2] = {4, 3};
+  NDArrayHandle hg;
+  CHECK_OK(MXNDArrayCreate(gshape, 2, 1, 0, 0, &hg));
+  float gbuf[12];
+  for (int i = 0; i < 12; ++i) gbuf[i] = 1.0f;
+  CHECK_OK(MXNDArraySyncCopyFromCPU(hg, gbuf, 12));
+  CHECK_OK(MXExecutorForward(ex, 1));
+  NDArrayHandle hgs[1] = {hg};
+  CHECK_OK(MXExecutorBackwardEx(ex, 1, hgs, 1));
+
+  CHECK_OK(MXNDArrayFree(hg));
+  CHECK_OK(MXExecutorFree(ex));
+  CHECK_OK(MXSymbolFree(fcs));
+  printf("simple bind + backward_ex ok\n");
+}
+
+static void monitor_cb(const char *name, NDArrayHandle arr, void *handle) {
+  (void)name; (void)arr;
+  *(int *)handle += 1;
+}
+
+static void test_monitor_and_attr_shallow(void) {
+  AtomicSymbolCreator relu = find_op("Activation");
+  CHECK(relu != NULL);
+  const char *pk[1] = {"act_type"};
+  const char *pv[1] = {"relu"};
+  SymbolHandle act, x;
+  CHECK_OK(MXSymbolCreateAtomicSymbol(relu, 1, pk, pv, &act));
+  CHECK_OK(MXSymbolCreateVariable("x", &x));
+  const char *ckeys[1] = {"data"};
+  SymbolHandle args[1] = {x};
+  CHECK_OK(MXSymbolCompose(act, "a1", 1, ckeys, args));
+
+  mx_uint n_attr = 0;
+  const char **attrs;
+  CHECK_OK(MXSymbolListAttrShallow(act, &n_attr, &attrs));
+
+  mx_uint shape[1] = {4};
+  NDArrayHandle in;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &in));
+  float xs[4] = {-1, 2, -3, 4};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(in, xs, 4));
+  NDArrayHandle in_args[1] = {in};
+  mx_uint reqs[1] = {0};
+  ExecutorHandle ex;
+  CHECK_OK(MXExecutorBind(act, 1, 0, 1, in_args, NULL, reqs, 0, NULL, &ex));
+  int hits = 0;
+  CHECK_OK(MXExecutorSetMonitorCallback(ex, monitor_cb, &hits));
+  CHECK_OK(MXExecutorForward(ex, 0));
+  mx_uint n_out;
+  NDArrayHandle *outs;
+  CHECK_OK(MXExecutorOutputs(ex, &n_out, &outs));
+  float res[4];
+  CHECK_OK(MXNDArraySyncCopyToCPU(outs[0], res, 4));
+  CHECK(res[0] == 0.0f && res[1] == 2.0f);
+  CHECK(hits > 0); /* monitor saw intermediate outputs */
+  CHECK_OK(MXExecutorFree(ex));
+  CHECK_OK(MXNDArrayFree(in));
+  printf("monitor callback + attr shallow ok\n");
+}
+
+static void test_dataiter_index_and_rtc(void) {
+  mx_uint n = 0;
+  DataIterHandle *creators;
+  CHECK_OK(MXListDataIters(&n, &creators));
+  CHECK(n >= 1);
+  /* MNISTIter falls back to synthetic data when files are absent */
+  DataIterHandle mnist_creator = NULL;
+  for (mx_uint i = 0; i < n; ++i) {
+    const char *name, *desc, **anames, **atypes, **adescs;
+    mx_uint nargs;
+    CHECK_OK(MXDataIterGetIterInfo(creators[i], &name, &desc, &nargs, &anames,
+                                   &atypes, &adescs));
+    if (strcmp(name, "MNISTIter") == 0) mnist_creator = creators[i];
+  }
+  CHECK(mnist_creator != NULL);
+  const char *keys[2] = {"batch_size", "silent"};
+  const char *vals[2] = {"8", "1"};
+  DataIterHandle it;
+  CHECK_OK(MXDataIterCreateIter(mnist_creator, 2, keys, vals, &it));
+  int has_next = 0;
+  CHECK_OK(MXDataIterNext(it, &has_next));
+  CHECK(has_next == 1);
+  uint64_t *index;
+  uint64_t isize;
+  CHECK_OK(MXDataIterGetIndex(it, &index, &isize));
+  CHECK(isize == 8);
+  CHECK_OK(MXDataIterFree(it));
+
+  /* rtc: out = a * 2 + b via jnp source */
+  mx_uint shape[1] = {4};
+  NDArrayHandle a, b, out;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &a));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &b));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &out));
+  float xs[4] = {1, 2, 3, 4};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(a, xs, 4));
+  CHECK_OK(MXNDArraySyncCopyFromCPU(b, xs, 4));
+  char *in_names[2] = {(char *)"a", (char *)"b"};
+  char *out_names[1] = {(char *)"y"};
+  NDArrayHandle ins[2] = {a, b};
+  NDArrayHandle outs[1] = {out};
+  RtcHandle rtc;
+  CHECK_OK(MXRtcCreate((char *)"axpy", 2, 1, in_names, out_names, ins, outs,
+                       (char *)"y = a * 2 + b\n",
+                       &rtc));
+  CHECK_OK(MXRtcPush(rtc, 2, 1, ins, outs, 1, 1, 1, 1, 1, 1));
+  float res[4];
+  CHECK_OK(MXNDArraySyncCopyToCPU(out, res, 4));
+  for (int i = 0; i < 4; ++i) CHECK(fabsf(res[i] - 3 * xs[i]) < 1e-6f);
+  CHECK_OK(MXRtcFree(rtc));
+  CHECK_OK(MXNDArrayFree(a));
+  CHECK_OK(MXNDArrayFree(b));
+  CHECK_OK(MXNDArrayFree(out));
+  printf("dataiter index + rtc ok\n");
+}
+
+/* C-defined custom op: doubler (forward: out = 2*in) via the full
+   MXCustomOpRegister callback-list protocol. */
+static int cop_list_args(char ***args, void *state) {
+  static char *names[] = {(char *)"data", NULL};
+  (void)state;
+  *args = names;
+  return 1;
+}
+static int cop_list_outs(char ***args, void *state) {
+  static char *names[] = {(char *)"output", NULL};
+  (void)state;
+  *args = names;
+  return 1;
+}
+static int cop_infer_shape(int num_input, int *ndims, unsigned **shapes,
+                           void *state) {
+  (void)state;
+  /* one input, one output: same shape */
+  ndims[num_input - 1] = ndims[0];
+  shapes[num_input - 1] = shapes[0];
+  return 1;
+}
+static int cop_fwd(int size, void **ptrs, int *tags, const int *reqs,
+                   const int is_train, void *state) {
+  (void)reqs; (void)is_train; (void)state;
+  NDArrayHandle in = NULL, out = NULL;
+  for (int i = 0; i < size; ++i) {
+    if (tags[i] == 0) in = ptrs[i];
+    if (tags[i] == 1) out = ptrs[i];
+  }
+  if (!in || !out) return 0;
+  AtomicSymbolCreator plus = find_op("_plus");
+  NDArrayHandle ins[2] = {in, in};
+  NDArrayHandle outs_buf[1] = {out};
+  NDArrayHandle *outs = outs_buf;
+  int num_out = 1;
+  return MXImperativeInvoke(plus, 2, ins, &num_out, &outs, 0, NULL, NULL) == 0;
+}
+static int cop_del(void *state) { (void)state; return 1; }
+
+static int (*cop_callbacks[8])(void);
+static void *cop_contexts[8];
+static int (*op_callbacks[3])(void);
+static void *op_contexts[3];
+
+static int cop_create_op(const char *ctx, int num_inputs, unsigned **shapes,
+                         const int *ndims, const int *dtypes,
+                         struct MXCallbackList *ret, void *state) {
+  (void)ctx; (void)num_inputs; (void)shapes; (void)ndims; (void)dtypes;
+  (void)state;
+  op_callbacks[kCustomOpDelete] = (int (*)(void))cop_del;
+  op_callbacks[kCustomOpForward] = (int (*)(void))cop_fwd;
+  op_callbacks[kCustomOpBackward] = NULL;
+  ret->num_callbacks = 2; /* delete + forward */
+  ret->callbacks = op_callbacks;
+  ret->contexts = op_contexts;
+  return 1;
+}
+
+static int cop_creator(const char *op_type, const int num_kwargs,
+                       const char **keys, const char **values,
+                       struct MXCallbackList *ret) {
+  (void)op_type; (void)num_kwargs; (void)keys; (void)values;
+  cop_callbacks[kCustomOpPropDelete] = (int (*)(void))cop_del;
+  cop_callbacks[kCustomOpPropListArguments] = (int (*)(void))cop_list_args;
+  cop_callbacks[kCustomOpPropListOutputs] = (int (*)(void))cop_list_outs;
+  cop_callbacks[kCustomOpPropListAuxiliaryStates] = NULL;
+  cop_callbacks[kCustomOpPropInferShape] = (int (*)(void))cop_infer_shape;
+  cop_callbacks[kCustomOpPropDeclareBackwardDependency] = NULL;
+  cop_callbacks[kCustomOpPropCreateOperator] = (int (*)(void))cop_create_op;
+  ret->num_callbacks = 7;
+  ret->callbacks = cop_callbacks;
+  ret->contexts = cop_contexts;
+  return 1;
+}
+
+static void test_custom_op_register(void) {
+  CHECK_OK(MXCustomOpRegister("cdoubler", cop_creator));
+  /* invoke through the imperative Custom op */
+  AtomicSymbolCreator custom = find_op("Custom");
+  CHECK(custom != NULL);
+  mx_uint shape[1] = {3};
+  NDArrayHandle a;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &a));
+  float xs[3] = {1, 2, 3};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(a, xs, 3));
+  NDArrayHandle ins[1] = {a}, *outs = NULL;
+  int num_out = 0;
+  const char *pk[1] = {"op_type"};
+  const char *pv[1] = {"cdoubler"};
+  CHECK_OK(MXImperativeInvoke(custom, 1, ins, &num_out, &outs, 1, pk, pv));
+  CHECK(num_out == 1);
+  float res[3];
+  CHECK_OK(MXNDArraySyncCopyToCPU(outs[0], res, 3));
+  for (int i = 0; i < 3; ++i) CHECK(fabsf(res[i] - 2 * xs[i]) < 1e-6f);
+  CHECK_OK(MXNDArrayFree(outs[0]));
+  CHECK_OK(MXNDArrayFree(a));
+  printf("C custom op register ok\n");
+}
+
+static void test_autograd_get_symbol(void) {
+  mx_uint shape[1] = {2};
+  NDArrayHandle x, g;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &x));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &g));
+  float xs[2] = {1, 2};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(x, xs, 2));
+  NDArrayHandle vars[1] = {x}, grads[1] = {g};
+  mx_uint reqs[1] = {1};
+  CHECK_OK(MXAutogradMarkVariables(1, vars, reqs, grads));
+  int prev;
+  CHECK_OK(MXAutogradSetIsRecording(1, &prev));
+  AtomicSymbolCreator plus = find_op("_plus");
+  NDArrayHandle ins[2] = {x, x}, *outs = NULL;
+  int num_out = 0;
+  CHECK_OK(MXImperativeInvoke(plus, 2, ins, &num_out, &outs, 0, NULL, NULL));
+  CHECK_OK(MXAutogradSetIsRecording(0, &prev));
+  SymbolHandle sym;
+  CHECK_OK(MXAutogradGetSymbol(outs[0], &sym));
+  const char *json;
+  CHECK_OK(MXSymbolSaveToJSON(sym, &json));
+  CHECK(strstr(json, "_plus") != NULL || strstr(json, "elemwise") != NULL);
+  /* MXAutogradComputeGradient = backward with ones head */
+  CHECK_OK(MXAutogradComputeGradient(1, outs));
+  float gbuf[2];
+  CHECK_OK(MXNDArraySyncCopyToCPU(g, gbuf, 2));
+  CHECK(fabsf(gbuf[0] - 2.0f) < 1e-6f);
+  CHECK_OK(MXSymbolFree(sym));
+  CHECK_OK(MXNDArrayFree(outs[0]));
+  CHECK_OK(MXNDArrayFree(x));
+  CHECK_OK(MXNDArrayFree(g));
+  printf("autograd get-symbol + compute-gradient ok\n");
+}
+
+
+/* custom function: y = x (forward done by caller), backward callback
+   writes igrad = 3 * ograd through the C API */
+static int cfn_backward(int num_ograds, int num_igrads, void **ptrs,
+                        const int *reqs, const int is_train, void *state) {
+  (void)reqs; (void)is_train;
+  *(int *)state += 1;
+  if (num_ograds != 1 || num_igrads != 1) return 0;
+  NDArrayHandle og = ptrs[0], ig = ptrs[1];
+  AtomicSymbolCreator muls = find_op("_mul_scalar");
+  NDArrayHandle ins[1] = {og};
+  NDArrayHandle outs_buf[1] = {ig};
+  NDArrayHandle *outs = outs_buf;
+  int num_out = 1;
+  const char *pk[1] = {"scalar"};
+  const char *pv[1] = {"3"};
+  return MXImperativeInvoke(muls, 1, ins, &num_out, &outs, 1, pk, pv) == 0;
+}
+
+static void test_custom_function_record(void) {
+  mx_uint shape[1] = {2};
+  NDArrayHandle x, g, y;
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &x));
+  CHECK_OK(MXNDArrayCreate(shape, 1, 1, 0, 0, &g));
+  float xs[2] = {1, 2};
+  CHECK_OK(MXNDArraySyncCopyFromCPU(x, xs, 2));
+  NDArrayHandle vars[1] = {x}, grads[1] = {g};
+  mx_uint reqs[1] = {1};
+  CHECK_OK(MXAutogradMarkVariables(1, vars, reqs, grads));
+  int prev;
+  CHECK_OK(MXAutogradSetIsRecording(1, &prev));
+  /* forward outside the tape: y = x + x */
+  AtomicSymbolCreator plus = find_op("_plus");
+  NDArrayHandle ins[2] = {x, x}, *fouts = NULL;
+  int num_out = 0;
+  CHECK_OK(MXAutogradSetIsRecording(0, &prev));
+  CHECK_OK(MXImperativeInvoke(plus, 2, ins, &num_out, &fouts, 0, NULL, NULL));
+  y = fouts[0];
+  CHECK_OK(MXAutogradSetIsRecording(1, &prev));
+  int calls = 0;
+  static int (*cbs[2])(void);
+  static void *ctxs[2];
+  cbs[kCustomFunctionBackward] = (int (*)(void))cfn_backward;
+  cbs[kCustomFunctionDelete] = NULL;
+  ctxs[kCustomFunctionBackward] = &calls;
+  struct MXCallbackList cblist = {2, cbs, ctxs};
+  NDArrayHandle cf_in[1] = {x}, cf_out[1] = {y};
+  CHECK_OK(MXCustomFunctionRecord(1, cf_in, 1, cf_out, &cblist));
+  CHECK_OK(MXAutogradSetIsRecording(0, &prev));
+  NDArrayHandle heads[1] = {y};
+  CHECK_OK(MXAutogradBackward(1, heads, NULL, 0));
+  CHECK(calls == 1);
+  float gbuf[2];
+  CHECK_OK(MXNDArraySyncCopyToCPU(g, gbuf, 2));
+  CHECK(fabsf(gbuf[0] - 3.0f) < 1e-6f); /* igrad = 3 * ones */
+  CHECK_OK(MXNDArrayFree(y));
+  CHECK_OK(MXNDArrayFree(x));
+  CHECK_OK(MXNDArrayFree(g));
+  printf("custom function record ok\n");
+}
+
 int main(void) {
   int version;
   CHECK_OK(MXGetVersion(&version));
@@ -519,6 +1041,15 @@ int main(void) {
   test_typed_params_and_bf16();
   test_caller_grad_buffer();
   test_error_path();
+  test_func_family();
+  test_invoke_ex_and_sparse();
+  test_kvstore_ex_and_updater();
+  test_simple_bind_and_backward_ex();
+  test_monitor_and_attr_shallow();
+  test_dataiter_index_and_rtc();
+  test_custom_op_register();
+  test_autograd_get_symbol();
+  test_custom_function_record();
   CHECK_OK(MXRandomSeed(42));
   CHECK_OK(MXNotifyShutdown());
   printf("ALL C API TESTS PASSED\n");

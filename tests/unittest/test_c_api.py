@@ -82,3 +82,26 @@ def test_bridge_helpers_roundtrip():
     blob = impl.nd_save_raw_bytes(h)
     h2 = impl.nd_load_from_raw_bytes(blob)
     np.testing.assert_allclose(h2.asnumpy(), h.asnumpy())
+
+
+REF_HEADER = '/root/reference/include/mxnet/c_api.h'
+
+
+@pytest.mark.skipif(not os.path.exists(REF_HEADER),
+                    reason='reference tree not present')
+def test_c_api_name_parity():
+    """Every MX* function the reference header declares exists in ours
+    (146/146) and is exported by the built library."""
+    import re
+    ref = open(REF_HEADER).read()
+    ours = open(os.path.join(REPO, 'include', 'mxnet_tpu', 'c_api.h')).read()
+    ref_names = set(re.findall(r'MXNET_DLL\s+\w+\s+(MX\w+)\(', ref))
+    our_names = set(re.findall(r'\b(MX\w+)\(', ours))
+    missing = sorted(ref_names - our_names)
+    assert not missing, 'header missing: %s' % missing
+    _build_lib()
+    r = subprocess.run(['nm', '-D', LIB], capture_output=True, text=True)
+    exported = set(l.split()[-1] for l in r.stdout.splitlines()
+                   if ' T MX' in l)
+    unexported = sorted(n for n in ref_names if n not in exported)
+    assert not unexported, 'not exported: %s' % unexported
