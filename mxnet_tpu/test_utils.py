@@ -14,8 +14,9 @@ from .context import Context, cpu, current_context
 from .ndarray import NDArray, array
 
 __all__ = ['default_context', 'set_default_context', 'rand_shape_2d',
-           'rand_shape_3d', 'rand_ndarray', 'assert_almost_equal',
-           'almost_equal', 'same', 'check_numeric_gradient',
+           'rand_shape_3d', 'rand_ndarray', 'rand_sparse_ndarray',
+           'assert_almost_equal', 'almost_equal', 'same',
+           'get_rtol', 'get_atol', 'check_numeric_gradient',
            'check_symbolic_forward', 'check_symbolic_backward',
            'check_consistency', 'simple_forward', 'rand_np']
 
@@ -51,16 +52,72 @@ def rand_shape_3d(dim0=10, dim1=10, dim2=10):
 def rand_ndarray(shape, stype='default', density=None, dtype=None):
     if stype == 'default':
         return array(np.random.uniform(-1, 1, shape), dtype=dtype)
+    return rand_sparse_ndarray(shape, stype, density=density,
+                               dtype=dtype)[0]
+
+
+def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
+                        data_init=None, rsp_indices=None):
+    """(sparse NDArray, (data, idx...)) pair — reference
+    test_utils.py:rand_sparse_ndarray. Explicit ``rsp_indices`` pins the
+    stored rows of a row_sparse array; ``data_init`` fills values."""
     from .ndarray.sparse import row_sparse_array, csr_matrix
     density = 0.5 if density is None else density
-    dense = np.random.uniform(-1, 1, shape)
-    mask = np.random.uniform(0, 1, shape) < density
-    dense = dense * mask
+    dtype = np.float32 if dtype is None else np.dtype(dtype)
     if stype == 'row_sparse':
-        return row_sparse_array(dense.astype(dtype or np.float32))
+        if rsp_indices is not None:
+            idx = np.asarray(sorted(set(int(i) for i in rsp_indices)),
+                             np.int64)
+        else:
+            mask = np.random.uniform(0, 1, shape[0]) < density
+            idx = np.nonzero(mask)[0].astype(np.int64)
+        vals = np.random.uniform(-1, 1, (len(idx),) + tuple(shape[1:]))
+        if data_init is not None:
+            vals[:] = data_init
+        arr = row_sparse_array((vals.astype(dtype), idx), shape=shape,
+                               dtype=dtype)
+        return arr, (vals.astype(dtype), idx)
     if stype == 'csr':
-        return csr_matrix(dense.astype(dtype or np.float32))
+        dense = np.random.uniform(-1, 1, shape)
+        dense *= np.random.uniform(0, 1, shape) < density
+        if data_init is not None:
+            dense[dense != 0] = data_init
+        arr = csr_matrix(dense.astype(dtype), dtype=dtype)
+        return arr, (arr.data.asnumpy(), arr.indptr.asnumpy(),
+                     arr.indices.asnumpy())
     raise ValueError(stype)
+
+
+# per-dtype default tolerances (reference test_utils.py:62 default_rtols).
+# Only HALF types loosen the defaults; fp32/fp64/int keep the historical
+# 1e-5/1e-20 so existing call sites are unchanged.
+_DTYPE_RTOL = {np.dtype(np.float16): 1e-2, 'bfloat16': 1e-2}
+_DTYPE_ATOL = {np.dtype(np.float16): 1e-3, 'bfloat16': 1e-2}
+
+
+def _tol_key(x):
+    name = getattr(getattr(x, 'dtype', None), 'name', None)
+    if name == 'bfloat16':
+        return 'bfloat16'
+    try:
+        return np.dtype(getattr(x, 'dtype', np.float32))
+    except TypeError:
+        return np.dtype(np.float32)
+
+
+def get_rtol(a=None, b=None, rtol=None):
+    """Dtype-aware default rtol: the loosest of the operand dtypes."""
+    if rtol is not None:
+        return rtol
+    return max(_DTYPE_RTOL.get(_tol_key(a), 1e-5),
+               _DTYPE_RTOL.get(_tol_key(b), 1e-5))
+
+
+def get_atol(a=None, b=None, atol=None):
+    if atol is not None:
+        return atol
+    return max(_DTYPE_ATOL.get(_tol_key(a), 1e-20),
+               _DTYPE_ATOL.get(_tol_key(b), 1e-20))
 
 
 def same(a, b):
@@ -80,9 +137,9 @@ def _as_np(x):
 
 
 def assert_almost_equal(a, b, rtol=None, atol=None, names=('a', 'b')):
+    rtol = get_rtol(a, b, rtol)
+    atol = get_atol(a, b, atol)
     a, b = _as_np(a), _as_np(b)
-    rtol = 1e-5 if rtol is None else rtol
-    atol = 1e-20 if atol is None else atol
     if almost_equal(a, b, rtol, atol):
         return
     index = np.unravel_index(np.argmax(np.abs(a - b)), a.shape) \
