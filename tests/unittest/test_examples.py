@@ -20,8 +20,13 @@ CASES = [
     ('image-classification/train_imagenet.py',
      ['--num-layers', '18', '--image-shape', '3,32,32', '--num-classes', '5',
       '--samples', '32', '--batch-size', '16', '--benchmark', '1']),
-    ('ssd/train_ssd.py', ['--epochs', '1', '--samples', '32',
-                          '--batch-size', '16']),
+    ('ssd/train_ssd.py', ['--epochs', '40', '--samples', '32',
+                          '--batch-size', '16', '--min-recall', '0.15']),
+    ('rnn/model_parallel_lstm.py', ['--steps', '30', '--num-layers', '2',
+                                    '--num-hidden', '32', '--seq-len', '8',
+                                    '--lr', '0.02']),
+    ('image-classification/benchmark_score.py',
+     ['--model', 'resnet18_v1', '--batch-sizes', '2', '--image-size', '64']),
     ('rnn/lstm_bucketing.py',
      ['--num-epochs', '1', '--batch-size', '16', '--num-hidden', '32',
       '--num-embed', '16', '--num-layers', '1', '--vocab', '50']),
