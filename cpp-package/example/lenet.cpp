@@ -1,0 +1,134 @@
+/*
+ * cpp-package example: LeNet on MNIST, built ENTIRELY from the
+ * generated per-op factories (op.h), fed by MXDataIter(MNISTIter) and
+ * trained with OptimizerRegistry SGD — the reference's
+ * cpp-package/example/lenet.cpp workflow.
+ */
+#include <cmath>
+#include <cstdio>
+#include <random>
+#include <vector>
+
+#include "mxnet-cpp/MxNetCpp.h"
+#include "mxnet-cpp/MxDataIter.h"
+#include "mxnet-cpp/op.h"
+#include "mxnet-cpp/optimizer.h"
+
+using namespace mxnet::cpp;
+
+int main() {
+  const int batch = 64, n_class = 10;
+  Context ctx = Context::cpu();
+
+  Symbol data = Symbol::Variable("data");
+  Symbol label = Symbol::Variable("softmax_label");
+  Symbol c1w = Symbol::Variable("c1_weight"), c1b = Symbol::Variable("c1_bias");
+  Symbol c2w = Symbol::Variable("c2_weight"), c2b = Symbol::Variable("c2_bias");
+  Symbol f1w = Symbol::Variable("f1_weight"), f1b = Symbol::Variable("f1_bias");
+  Symbol f2w = Symbol::Variable("f2_weight"), f2b = Symbol::Variable("f2_bias");
+
+  Symbol conv1 = Convolution("c1", data, c1w, c1b, Shape{5, 5}, Shape(),
+                             Shape(), Shape(), 8);
+  Symbol tanh1 = Activation("t1", conv1, "tanh");
+  Symbol pool1 = Pooling("p1", tanh1, Shape{2, 2}, "max", Shape{2, 2});
+  Symbol conv2 = Convolution("c2", pool1, c2w, c2b, Shape{5, 5}, Shape(),
+                             Shape(), Shape(), 16);
+  Symbol tanh2 = Activation("t2", conv2, "tanh");
+  Symbol pool2 = Pooling("p2", tanh2, Shape{2, 2}, "max", Shape{2, 2});
+  Symbol flat = Flatten("flat", pool2);
+  Symbol fc1 = FullyConnected("f1", flat, f1w, f1b, 64);
+  Symbol tanh3 = Activation("t3", fc1, "tanh");
+  Symbol fc2 = FullyConnected("f2", tanh3, f2w, f2b, n_class);
+  Symbol net = SoftmaxOutput("softmax", fc2, label);
+
+  /* parameter arrays in list_arguments order */
+  std::vector<std::string> arg_names = net.ListArguments();
+  std::vector<Shape> shapes = {
+      {(mx_uint)batch, 1, 28, 28},                 /* data */
+      {8, 1, 5, 5}, {8},                           /* c1 */
+      {16, 8, 5, 5}, {16},                         /* c2 */
+      {64, 16 * 4 * 4}, {64},                      /* f1 (28->24->12->8->4) */
+      {(mx_uint)n_class, 64}, {(mx_uint)n_class},  /* f2 */
+      {(mx_uint)batch},                            /* label */
+  };
+  if (arg_names.size() != shapes.size()) {
+    std::fprintf(stderr, "unexpected arg count %zu\n", arg_names.size());
+    return 1;
+  }
+  std::mt19937 rng(7);
+  std::vector<NDArray> args, grads;
+  for (size_t i = 0; i < shapes.size(); ++i) {
+    args.emplace_back(shapes[i], ctx);      /* zero-initialized */
+    grads.emplace_back(shapes[i], ctx);
+    if (arg_names[i].find("weight") != std::string::npos) {
+      size_t n = args.back().Size();
+      float scale = std::sqrt(3.f / (float)(n / shapes[i][0]));
+      std::uniform_real_distribution<float> u(-scale, scale);
+      std::vector<float> init(n);
+      for (auto &v : init) v = u(rng);
+      args.back().SyncCopyFromCPU(init.data(), init.size());
+    }
+  }
+  /* grad only for parameters, not data/label */
+  std::vector<mx_uint> reqs(shapes.size(), 1);
+  reqs.front() = 0;
+  reqs.back() = 0;
+
+  Executor exec(net, ctx, &args, &grads, reqs);
+
+  std::unique_ptr<Optimizer> opt(OptimizerRegistry::Find("sgd"));
+  opt->SetParam("lr", 0.1f)->SetParam("momentum", 0.9f)
+     ->SetParam("wd", 1e-4f)
+     ->SetParam("rescale_grad", 1.0f / (float)batch);
+
+  MXDataIter iter("MNISTIter");
+  iter.SetParam("batch_size", batch).SetParam("silent", 1)
+      .CreateDataIter();
+
+  float first_acc = -1.f, acc = 0.f;
+  for (int epoch = 0; epoch < 3; ++epoch) {
+    int correct = 0, total = 0, batches = 0;
+    iter.BeforeFirst();
+    while (iter.Next() && batches < 40) {
+      NDArray x = iter.GetData();
+      NDArray y = iter.GetLabel();
+      std::vector<float> xv = x.AsVector(), yv = y.AsVector();
+      args[0].SyncCopyFromCPU(xv.data(), xv.size());
+      args.back().SyncCopyFromCPU(yv.data(), yv.size());
+      exec.Forward(true);
+      exec.Backward();
+#ifdef LENET_DEBUG
+      if (batches == 0) {
+        for (size_t i = 0; i < args.size(); ++i) {
+          double gn = 0;
+          for (float v : grads[i].AsVector()) gn += (double)v * v;
+          std::printf("arg %zu %s grad_norm %.6f\n", i,
+                      arg_names[i].c_str(), std::sqrt(gn));
+        }
+      }
+#endif
+      for (size_t i = 1; i + 1 < args.size(); ++i)
+        opt->Update((int)i, &args[i], grads[i]);
+      std::vector<NDArray> outs = exec.Outputs();
+      std::vector<float> probs = outs[0].AsVector();
+      for (int b = 0; b < batch; ++b) {
+        int best = 0;
+        for (int c = 1; c < n_class; ++c)
+          if (probs[b * n_class + c] > probs[b * n_class + best]) best = c;
+        correct += (best == (int)yv[b]);
+        ++total;
+      }
+      ++batches;
+    }
+    acc = (float)correct / (float)total;
+    if (first_acc < 0) first_acc = acc;
+    std::printf("epoch %d acc %.3f\n", epoch, acc);
+  }
+  if (!(acc > 0.8f && acc > first_acc)) {
+    std::fprintf(stderr, "did not learn: first %.3f last %.3f\n",
+                 first_acc, acc);
+    return 1;
+  }
+  std::printf("cpp-package lenet ok\n");
+  return 0;
+}

@@ -1,0 +1,99 @@
+/*
+ * MxDataIter.h — C++ data iterator wrapper over the C ABI.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/MxDataIter.h (MXDataIter:
+ * creator lookup by name + SetParam + Next/GetData/GetLabel). The
+ * registered iterator families are served by MXListDataIters /
+ * MXDataIterCreateIter.
+ */
+#ifndef MXNET_TPU_CPP_MXDATAITER_H_
+#define MXNET_TPU_CPP_MXDATAITER_H_
+
+#include <map>
+#include <string>
+#include <vector>
+
+#include "MxNetCpp.h"
+
+namespace mxnet {
+namespace cpp {
+
+class MXDataIter {
+ public:
+  explicit MXDataIter(const std::string &name) : name_(name) {}
+  MXDataIter(const MXDataIter &) = delete;
+  MXDataIter &operator=(const MXDataIter &) = delete;
+  ~MXDataIter() { if (handle_) MXDataIterFree(handle_); }
+
+  MXDataIter &SetParam(const std::string &k, const std::string &v) {
+    params_[k] = v;
+    return *this;
+  }
+  template <typename T>
+  MXDataIter &SetParam(const std::string &k, const T &v) {
+    return SetParam(k, std::to_string(v));
+  }
+
+  MXDataIter &CreateDataIter() {
+    mx_uint n;
+    DataIterHandle *creators;
+    Check(MXListDataIters(&n, &creators));
+    DataIterHandle creator = nullptr;
+    for (mx_uint i = 0; i < n; ++i) {
+      const char *cname, *desc, **anames, **atypes, **adescs;
+      mx_uint nargs;
+      Check(MXDataIterGetIterInfo(creators[i], &cname, &desc, &nargs,
+                                  &anames, &atypes, &adescs));
+      if (name_ == cname) creator = creators[i];
+    }
+    if (!creator)
+      throw std::runtime_error("unknown data iter " + name_);
+    std::vector<const char *> pk, pv;
+    for (auto &kv : params_) {
+      pk.push_back(kv.first.c_str());
+      pv.push_back(kv.second.c_str());
+    }
+    DataIterHandle h;
+    Check(MXDataIterCreateIter(creator, (mx_uint)pk.size(), pk.data(),
+                               pv.data(), &h));
+    handle_ = h;
+    return *this;
+  }
+
+  bool Next() {
+    int out;
+    Check(MXDataIterNext(handle_, &out));
+    return out != 0;
+  }
+  void BeforeFirst() { Check(MXDataIterBeforeFirst(handle_)); }
+  NDArray GetData() {
+    NDArrayHandle h;
+    Check(MXDataIterGetData(handle_, &h));
+    return NDArray(h);
+  }
+  NDArray GetLabel() {
+    NDArrayHandle h;
+    Check(MXDataIterGetLabel(handle_, &h));
+    return NDArray(h);
+  }
+  int GetPadNum() {
+    int pad;
+    Check(MXDataIterGetPadNum(handle_, &pad));
+    return pad;
+  }
+  std::vector<uint64_t> GetIndex() {
+    uint64_t *idx, n;
+    Check(MXDataIterGetIndex(handle_, &idx, &n));
+    return std::vector<uint64_t>(idx, idx + n);
+  }
+
+ private:
+  std::string name_;
+  std::map<std::string, std::string> params_;
+  DataIterHandle handle_ = nullptr;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  /* MXNET_TPU_CPP_MXDATAITER_H_ */

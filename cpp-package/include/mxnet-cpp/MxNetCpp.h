@@ -152,19 +152,38 @@ class Symbol {
     std::vector<const char *> pk, pv, ik;
     for (auto &s : param_keys) pk.push_back(s.c_str());
     for (auto &s : param_vals) pv.push_back(s.c_str());
-    for (auto &s : input_keys) ik.push_back(s.c_str());
+    bool positional = true;
+    for (auto &s : input_keys) {
+      ik.push_back(s.c_str());
+      if (!s.empty()) positional = false;
+    }
     std::vector<SymbolHandle> ih;
     for (auto *s : inputs) ih.push_back(s->GetHandle());
     SymbolHandle h;
     Check(MXSymbolCreateAtomicSymbol(OpMap::Get(op), (mx_uint)pk.size(),
                                      pk.data(), pv.data(), &h));
+    /* all-empty keys = positional compose (variadic ops) */
     Check(MXSymbolCompose(h, name.c_str(), (mx_uint)ih.size(),
-                          ik.empty() ? nullptr : ik.data(), ih.data()));
+                          positional ? nullptr : ik.data(), ih.data()));
     return Symbol(h);
   }
 
-  Symbol(const Symbol &) = delete;
-  Symbol &operator=(const Symbol &) = delete;
+  /* copyable via MXSymbolCopy (the reference's Symbol is a shared
+   * handle; deep copy preserves the same value semantics here) */
+  Symbol(const Symbol &o) : handle_(nullptr) {
+    if (o.handle_) {
+      SymbolHandle h;
+      Check(MXSymbolCopy(o.handle_, &h));
+      handle_ = h;
+    }
+  }
+  Symbol &operator=(const Symbol &o) {
+    if (this != &o) {
+      Symbol tmp(o);
+      std::swap(handle_, tmp.handle_);
+    }
+    return *this;
+  }
   Symbol(Symbol &&o) noexcept : handle_(o.handle_) { o.handle_ = nullptr; }
   Symbol &operator=(Symbol &&o) noexcept {
     if (this != &o) { Free(); handle_ = o.handle_; o.handle_ = nullptr; }

@@ -1,0 +1,139 @@
+/*
+ * optimizer.h — C++ optimizer wrappers over the fused update ops.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/optimizer.h (Optimizer base
+ * with per-index state + OptimizerRegistry::Find("sgd"|...)). Updates
+ * run through MXImperativeInvoke on the registered *_update ops — the
+ * same kernels the python Optimizer family uses.
+ */
+#ifndef MXNET_TPU_CPP_OPTIMIZER_H_
+#define MXNET_TPU_CPP_OPTIMIZER_H_
+
+#include <cmath>
+#include <cstdlib>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+#include "MxNetCpp.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Optimizer {
+ public:
+  virtual ~Optimizer() {}
+  Optimizer *SetParam(const std::string &k, const std::string &v) {
+    params_[k] = v;
+    return this;
+  }
+  template <typename T>
+  Optimizer *SetParam(const std::string &k, const T &v) {
+    return SetParam(k, std::to_string(v));
+  }
+  virtual void Update(int index, NDArray *weight, const NDArray &grad) = 0;
+
+ protected:
+  /* run op(weight, grad, states...) writing into weight in place;
+   * `overrides` take precedence over the stored params */
+  void Invoke(const std::string &op, std::vector<NDArrayHandle> ins,
+              NDArrayHandle out,
+              const std::map<std::string, std::string> &overrides = {}) {
+    std::map<std::string, std::string> merged = params_;
+    for (auto &kv : overrides) merged[kv.first] = kv.second;
+    std::vector<const char *> pk, pv;
+    for (auto &kv : merged) {
+      pk.push_back(kv.first.c_str());
+      pv.push_back(kv.second.c_str());
+    }
+    NDArrayHandle outs_buf[1] = {out};
+    NDArrayHandle *outs = outs_buf;
+    int num_out = 1;
+    Check(MXImperativeInvoke(OpMap::Get(op), (int)ins.size(), ins.data(),
+                             &num_out, &outs, (int)pk.size(), pk.data(),
+                             pv.data()));
+  }
+  NDArray *State(int index, const NDArray &like, int slot = 0) {
+    auto key = std::make_pair(index, slot);
+    auto it = states_.find(key);
+    if (it == states_.end()) {
+      /* NDArray(shape, ctx) is already zero-initialized */
+      it = states_.emplace(key, std::make_unique<NDArray>(
+                                    like.GetShape(), Context::cpu())).first;
+    }
+    return it->second.get();
+  }
+
+  float ParamOr(const std::string &k, float dflt) const {
+    auto it = params_.find(k);
+    return it == params_.end() ? dflt : std::strtof(it->second.c_str(),
+                                                    nullptr);
+  }
+
+  std::map<std::string, std::string> params_;
+  std::map<std::pair<int, int>, std::unique_ptr<NDArray>> states_;
+};
+
+class SGDOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray *weight, const NDArray &grad) override {
+    bool has_mom = ParamOr("momentum", 0.f) != 0.f;
+    if (has_mom) {
+      NDArray *mom = State(index, *weight);
+      Invoke("sgd_mom_update",
+             {weight->GetHandle(), grad.GetHandle(), mom->GetHandle()},
+             weight->GetHandle());
+    } else {
+      Invoke("sgd_update", {weight->GetHandle(), grad.GetHandle()},
+             weight->GetHandle());
+    }
+  }
+};
+
+class AdamOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray *weight, const NDArray &grad) override {
+    NDArray *m = State(index, *weight, 0);
+    NDArray *v = State(index, *weight, 1);
+    /* bias correction, matching the python Adam (optimizer.py): scale
+     * lr by sqrt(1-beta2^t)/(1-beta1^t) for this parameter's step t */
+    int t = ++step_[index];
+    float lr = ParamOr("lr", 0.001f);
+    float b1 = ParamOr("beta1", 0.9f), b2 = ParamOr("beta2", 0.999f);
+    lr *= std::sqrt(1.f - std::pow(b2, (float)t)) /
+          (1.f - std::pow(b1, (float)t));
+    Invoke("adam_update",
+           {weight->GetHandle(), grad.GetHandle(), m->GetHandle(),
+            v->GetHandle()},
+           weight->GetHandle(), {{"lr", std::to_string(lr)}});
+  }
+
+ private:
+  std::map<int, int> step_;
+};
+
+class RMSPropOptimizer : public Optimizer {
+ public:
+  void Update(int index, NDArray *weight, const NDArray &grad) override {
+    NDArray *n = State(index, *weight);
+    Invoke("rmsprop_update",
+           {weight->GetHandle(), grad.GetHandle(), n->GetHandle()},
+           weight->GetHandle());
+  }
+};
+
+class OptimizerRegistry {
+ public:
+  static Optimizer *Find(const std::string &name) {
+    if (name == "sgd") return new SGDOptimizer();
+    if (name == "adam") return new AdamOptimizer();
+    if (name == "rmsprop") return new RMSPropOptimizer();
+    throw std::runtime_error("unknown optimizer " + name);
+  }
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  /* MXNET_TPU_CPP_OPTIMIZER_H_ */
