@@ -10,11 +10,13 @@ to a single donated-buffer XLA computation.
 vs_baseline divides by the strongest single-GPU reference number:
 P100 batch-32 ResNet-50 training at 181.53 img/s (BASELINE.md).
 
-Robustness (round-2 hardening): prints a heartbeat before the first
+Robustness (round-3 hardening): prints a heartbeat before the first
 device touch, probes the backend in a throwaway subprocess (a hung TPU
-tunnel can never wedge this process's backend lock), retries with
-backoff on transient init errors, and falls back to CPU (marked in the
-output) rather than hanging silently.
+tunnel can never wedge this process's backend lock), and spreads
+retries over the WHOLE bench budget: if the first probes fail it banks
+a CPU fallback number immediately, then keeps reprobing the TPU until
+MXTPU_BENCH_BUDGET seconds (default 20 min) have elapsed — a tunnel
+that recovers mid-run still yields a real device number.
 
 Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
@@ -28,9 +30,13 @@ import numpy as np
 BASELINE_IMG_S = 181.53  # P100, batch 32, docs/how_to/perf.md:150-190
 BATCH = int(os.environ.get('MXTPU_BENCH_BATCH', '32'))
 WARMUP_STEPS = 3
-INIT_ATTEMPTS = int(os.environ.get('MXTPU_BENCH_INIT_ATTEMPTS', '3'))
-INIT_TIMEOUT_S = float(os.environ.get('MXTPU_BENCH_INIT_TIMEOUT', '240'))
+INIT_ATTEMPTS = int(os.environ.get('MXTPU_BENCH_INIT_ATTEMPTS', '2'))
+INIT_TIMEOUT_S = float(os.environ.get('MXTPU_BENCH_INIT_TIMEOUT', '180'))
 INIT_BACKOFF_S = 15.0
+BUDGET_S = float(os.environ.get('MXTPU_BENCH_BUDGET', '1200'))
+REPROBE_TIMEOUT_S = 120.0
+REPROBE_SLEEP_S = 45.0
+_START = time.perf_counter()
 
 # Peak dense bf16 FLOP/s per chip, by device_kind substring.
 _PEAK_FLOPS = [
@@ -237,10 +243,44 @@ def _peak_flops(device):
     return 0.0, kind
 
 
+def _late_tpu_attempt(remaining_s):
+    """The tunnel recovered after we banked a CPU number: run the real
+    bench in a fresh subprocess (this process's backend is already CPU)
+    and relay its JSON line. Returns the parsed dict or None."""
+    import subprocess
+    env = dict(os.environ)
+    env['MXTPU_BENCH_DIRECT'] = '1'
+    _log('reprobe healthy: running device bench in subprocess '
+         '(%.0fs left)' % remaining_s)
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, text=True,
+                              timeout=max(60.0, remaining_s))
+    except Exception as e:  # noqa: BLE001
+        _log('late device bench failed: %s' % e)
+        return None
+    sys.stderr.write(proc.stderr)
+    for line in reversed((proc.stdout or '').strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    _log('late device bench produced no JSON (rc=%d)' % proc.returncode)
+    return None
+
+
 def main():
     _log('python up, pid=%d — probing backend before any device work'
          % os.getpid())
-    devices, platform = init_backend()
+    if os.environ.get('MXTPU_BENCH_DIRECT'):
+        # child of a successful late reprobe: init the default backend
+        # straight away (the parent just verified it is healthy)
+        import jax
+        devices = jax.devices()
+        platform = devices[0].platform
+        _log('direct mode: backend %s' % devices)
+    else:
+        devices, platform = init_backend()
     if platform.startswith('cpu'):
         _shrink_for_cpu()   # single decision point for every CPU path
     import jax
@@ -303,6 +343,21 @@ def main():
     if platform.startswith('cpu'):
         out['note'] = ('cpu run at reduced batch; not config-comparable '
                        'to the batch-32 GPU baseline')
+        # the CPU number is banked, not final: keep reprobing the real
+        # device until the budget runs out (a wedged tunnel can recover)
+        if not os.environ.get('MXTPU_BENCH_DIRECT'):
+            while time.perf_counter() - _START < BUDGET_S - 90.0:
+                _log('reprobing device backend (%.0fs into %.0fs budget)'
+                     % (time.perf_counter() - _START, BUDGET_S))
+                if _probe_subprocess(REPROBE_TIMEOUT_S) == 'ok':
+                    late = _late_tpu_attempt(
+                        BUDGET_S - (time.perf_counter() - _START))
+                    if late is not None:
+                        print(json.dumps(late))
+                        return
+                    break
+                time.sleep(REPROBE_SLEEP_S)
+            _log('budget exhausted; reporting the banked CPU number')
     print(json.dumps(out))
 
 
