@@ -18,7 +18,9 @@ a CPU fallback number immediately, then keeps reprobing the TPU until
 MXTPU_BENCH_BUDGET seconds (default 20 min) have elapsed — a tunnel
 that recovers mid-run still yields a real device number.
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Prints JSON lines {"metric", "value", "unit", "vs_baseline", ...};
+the LAST line is authoritative (a banked CPU fallback line may precede
+a late real-device line).
 """
 import json
 import os

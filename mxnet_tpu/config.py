@@ -129,6 +129,10 @@ flags.declare('MXTPU_BACKWARD_DO_MIRROR', str, '0',
               "'dots' = keep matmul results (checkpoint_dots policy), "
               "'0'/''/'false' = off (legacy spellings honored)",
               aliases=('MXNET_BACKWARD_DO_MIRROR',))
+flags.declare('MXTPU_CONV_BWD_PATCHES', bool, False,
+              'compute conv2d weight gradients as an explicit im2col '
+              'patches-matmul instead of conv_backprop_filter '
+              '(groups=1 2D convs only; see docs/perf.md)')
 flags.declare('MXTPU_FORCE_PALLAS', bool, False,
               'Dispatch LayerNorm/softmax/attention to the Pallas kernels '
               'even off-TPU (interpret mode; exercises the kernel path on '

@@ -12,6 +12,8 @@ BatchNorm follows the aux-state protocol: it RETURNS updated moving stats as
 extra outputs and the invoke layer writes them back (op_attr_types.h
 FMutateInputs analog).
 """
+import functools
+
 import numpy as _np
 
 import jax
@@ -106,10 +108,69 @@ def _channels_last_conv(data, weight, w_layout, **conv_kwargs):
 
 
 def _conv_nd(data, weight, stride, dilate, pad, groups):
+    from ..config import flags as _flags
+    if (_flags.get('MXTPU_CONV_BWD_PATCHES') and groups == 1
+            and data.ndim == 4):
+        return _conv2d_patches_bwd(data, weight, stride, dilate, pad)
     return _channels_last_conv(
         data, weight, 'OI', window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         feature_group_count=groups)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _conv2d_patches_bwd(data, weight, stride, dilate, pad):
+    """Conv2d whose WEIGHT gradient is an explicit patches-matmul.
+
+    The measured MFU gap (docs/perf.md:34) is XLA's grad-weight conv at
+    small spatial sizes: conv_backprop_filter becomes a long skinny
+    contraction the MXU tiles poorly. im2col + dot_general instead
+    turns it into one large (C*kh*kw, N*H'*W') x (N*H'*W', O) matmul —
+    the shape the MXU is built for. Data gradient stays the standard
+    transposed conv (XLA is already good at it). Opt-in via
+    MXTPU_CONV_BWD_PATCHES=1; numerics parity-tested vs the plain path
+    (tests/unittest/test_conv_patches.py)."""
+    return _channels_last_conv(
+        data, weight, 'OI', window_strides=stride,
+        padding=[(p, p) for p in pad], rhs_dilation=dilate,
+        feature_group_count=1)
+
+
+def _conv2d_patches_fwd(data, weight, stride, dilate, pad):
+    out = _conv2d_patches_bwd(data, weight, stride, dilate, pad)
+    return out, (data, weight)
+
+
+def _conv2d_patches_rev(stride, dilate, pad, res, gout):
+    data, weight = res
+    padding = [(p, p) for p in pad]
+
+    # grad wrt data: transposed conv, same as the default rule
+    def fwd_data(d):
+        return _channels_last_conv(
+            d, weight, 'OI', window_strides=stride, padding=padding,
+            rhs_dilation=dilate, feature_group_count=1)
+    g_data = jax.vjp(fwd_data, data)[1](gout)[0]
+
+    # grad wrt weight: im2col patches, one big MXU matmul.
+    # patches: (N, C*kh*kw, H', W') with feature dim ordered (C, kh, kw)
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    patches = jax.lax.conv_general_dilated_patches(
+        data, filter_shape=(kh, kw), window_strides=stride,
+        padding=padding, rhs_dilation=dilate,
+        dimension_numbers=('NCHW', 'OIHW', 'NCHW'))
+    # contract batch+space of patches (N,CKK,H',W') with gout (N,O,H',W')
+    g_w = jax.lax.dot_general(
+        patches, gout,
+        dimension_numbers=(((0, 2, 3), (0, 2, 3)), ((), ())),
+        preferred_element_type=jnp.float32)          # (CKK, O)
+    c = int(weight.shape[1])
+    g_w = g_w.reshape(c, kh, kw, g_w.shape[-1])      # (C,kh,kw,O)
+    g_w = jnp.transpose(g_w, (3, 0, 1, 2)).astype(weight.dtype)
+    return g_data, g_w
+
+
+_conv2d_patches_bwd.defvjp(_conv2d_patches_fwd, _conv2d_patches_rev)
 
 
 @register('Deconvolution', input_names=['data', 'weight', 'bias'],
