@@ -1,0 +1,92 @@
+"""CNN text classification (Kim 2014) — reference
+example/cnn_text_classification/: parallel 1D convolutions of several
+filter widths over word embeddings, max-over-time pooling, dropout, FC.
+
+Hermetic synthetic task: sequences over a vocabulary where the class is
+determined by which "pattern" bigrams appear — exactly the structure
+width-2+ text filters exist to detect.
+
+    python train.py --epochs 3
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+
+def cnn_text_symbol(vocab, embed, seq_len, filters=(2, 3, 4),
+                    num_filter=16, num_classes=2, dropout=0.3):
+    data = mx.sym.Variable('data')                       # (B, seq)
+    emb = mx.sym.Embedding(data, input_dim=vocab, output_dim=embed,
+                           name='embed')                 # (B, seq, E)
+    x = mx.sym.Reshape(emb, shape=(0, 1, seq_len, embed))
+    pooled = []
+    for fw in filters:
+        c = mx.sym.Convolution(x, kernel=(fw, embed), num_filter=num_filter,
+                               name='conv%d' % fw)       # (B, F, seq-fw+1, 1)
+        a = mx.sym.Activation(c, act_type='relu')
+        p = mx.sym.Pooling(a, kernel=(seq_len - fw + 1, 1), pool_type='max')
+        pooled.append(p)                                 # (B, F, 1, 1)
+    h = mx.sym.Concat(*pooled, dim=1)
+    h = mx.sym.Flatten(h)
+    h = mx.sym.Dropout(h, p=dropout)
+    fc = mx.sym.FullyConnected(h, num_hidden=num_classes, name='fc')
+    return mx.sym.SoftmaxOutput(fc, name='softmax')
+
+
+def synthetic_text(n, vocab, seq_len, seed=0):
+    """Class 1 iff one of two signal bigrams occurs."""
+    rng = np.random.RandomState(seed)
+    bigrams = [(7, 3), (11, 5)]
+    X = rng.randint(12, vocab, size=(n, seq_len))
+    y = rng.randint(0, 2, size=n)
+    for i in range(n):
+        if y[i]:
+            pos = rng.randint(0, seq_len - 1)
+            X[i, pos:pos + 2] = bigrams[rng.randint(2)]
+    return X.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--epochs', type=int, default=3)
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--samples', type=int, default=512)
+    parser.add_argument('--vocab', type=int, default=64)
+    parser.add_argument('--embed', type=int, default=16)
+    parser.add_argument('--seq-len', type=int, default=24)
+    parser.add_argument('--lr', type=float, default=0.05)
+    parser.add_argument('--seed', type=int, default=0)
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+
+    X, y = synthetic_text(args.samples, args.vocab, args.seq_len,
+                          seed=args.seed)
+    Xv, yv = synthetic_text(128, args.vocab, args.seq_len,
+                            seed=args.seed + 1)
+    train = mx.io.NDArrayIter(X, y, batch_size=args.batch_size,
+                              shuffle=True, label_name='softmax_label')
+    val = mx.io.NDArrayIter(Xv, yv, batch_size=args.batch_size,
+                            label_name='softmax_label')
+
+    net = cnn_text_symbol(args.vocab, args.embed, args.seq_len)
+    mod = mx.mod.Module(net, label_names=['softmax_label'])
+    mod.fit(train, eval_data=val, num_epoch=args.epochs, optimizer='adam',
+            optimizer_params={'learning_rate': args.lr},
+            initializer=mx.init.Xavier(),
+            eval_metric='acc')
+    score = dict(mod.score(val, 'acc'))
+    logging.info('val accuracy %.3f', score['accuracy'])
+    assert score['accuracy'] > 0.85, score
+    print('cnn text classification ok: %.3f' % score['accuracy'])
+
+
+if __name__ == '__main__':
+    main()

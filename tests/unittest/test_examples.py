@@ -36,6 +36,8 @@ CASES = [
     ('gluon/image_classification.py',
      ['--model', 'resnet18_v1', '--epochs', '1', '--samples', '64',
       '--image-size', '16', '--batch-size', '16']),
+    ('gluon/dcgan.py', ['--epochs', '2', '--batches', '12']),
+    ('cnn_text_classification/train.py', ['--epochs', '3']),
 ]
 
 
