@@ -18,6 +18,14 @@ Standalone mode: without the DMLC_* cluster env (no launcher), a scheduler
 and one server are spun up as in-process threads so `mx.kv.create
 ('dist_sync')` works as a 1-worker cluster — handy for tests and parity with
 the reference's single-machine `dist` fallback.
+
+SECURITY — trusted clusters only: like the reference's ps-lite transport
+(and its pickled server-side optimizer, python/mxnet/kvstore.py:349-393),
+the wire protocol carries pickled python objects with no authentication or
+encryption. Anyone who can connect to the scheduler/server ports can execute
+arbitrary code in the job. Run only on private cluster networks; for
+untrusted environments use the SPMD tier (jax.distributed + XLA collectives)
+whose transport carries tensors, not code.
 """
 import atexit
 import os

@@ -101,6 +101,51 @@ def measure_kvstore(sizes, iters):
     return results
 
 
+def measure_dist(sizes, iters, n_servers=1):
+    """PS-tier bandwidth: spawn a real 1-worker/N-server TCP cluster via
+    tools/launch.py and time dist_sync push+pull (the reference
+    measure.py against its parameter servers)."""
+    import subprocess
+    env = dict(os.environ)
+    env.pop('DMLC_ROLE', None)
+    env['JAX_PLATFORMS'] = 'cpu'
+    env.pop('XLA_FLAGS', None)
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(here), 'launch.py'),
+         '-n', '1', '-s', str(n_servers), sys.executable, here,
+         '--dist-worker', '--sizes', ','.join(str(int(s)) for s in sizes),
+         '--iters', str(iters)],
+        env=env, capture_output=True, text=True, timeout=600)
+    sys.stdout.write(r.stdout)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr[-3000:])
+        raise SystemExit('dist bandwidth run failed')
+
+
+def measure_dist_worker(sizes, iters):
+    import numpy as np
+    import mxnet_tpu as mx
+    kv = mx.kv.create('dist_sync')
+    for size in sizes:
+        size = int(size)
+        arr = mx.nd.array(np.ones(size, np.float32))
+        out = mx.nd.zeros((size,))
+        kv.init(0, arr)
+        kv.push(0, arr)
+        kv.pull(0, out=out)
+        out.wait_to_read()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            kv.push(0, arr)
+            kv.pull(0, out=out)
+        out.wait_to_read()
+        dt = (time.perf_counter() - t0) / iters
+        gbps = size * 4 * 2 / dt / 1e9
+        print('%-15s %10d B  %8.3f ms  %8.2f GB/s' %
+              ('dist_push_pull', size * 4, dt * 1e3, gbps))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument('--sizes', default='1e6,1e7',
@@ -110,11 +155,21 @@ def main(argv=None):
                    choices=['float32', 'bfloat16'])
     p.add_argument('--kvstore', action='store_true',
                    help='also time kvstore push+pull (reference protocol)')
+    p.add_argument('--dist', action='store_true',
+                   help='also time the TCP parameter-server tier '
+                        '(spawns a local 1-worker/1-server cluster)')
+    p.add_argument('--dist-worker', action='store_true',
+                   help=argparse.SUPPRESS)
     p.add_argument('--cpu-devices', type=int, default=0,
                    help='force an N-device virtual CPU mesh (the container '
                         'pre-pins jax to the TPU backend; env vars alone '
                         'are too late)')
     args = p.parse_args(argv)
+    sizes_early = [float(s) for s in args.sizes.split(',')]
+    if args.dist_worker:
+        import jax
+        jax.config.update('jax_platforms', 'cpu')
+        return measure_dist_worker(sizes_early, args.iters)
     if args.cpu_devices:
         os.environ['XLA_FLAGS'] = (
             os.environ.get('XLA_FLAGS', '') +
@@ -128,6 +183,8 @@ def main(argv=None):
     results = measure_collectives(sizes, args.iters, args.dtype)
     if args.kvstore:
         results += measure_kvstore(sizes, args.iters)
+    if args.dist:
+        measure_dist(sizes, args.iters)
     return results
 
 
