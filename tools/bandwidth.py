@@ -72,12 +72,12 @@ def measure_collectives(sizes, iters, dtype='float32'):
     return results
 
 
-def measure_kvstore(sizes, iters):
+def measure_kvstore(sizes, iters, kv_type='device', label='kv_push_pull'):
     """Reference measure.py's actual protocol: init + timed push/pull."""
     import numpy as np
     import mxnet_tpu as mx
 
-    kv = mx.kv.create('device')
+    kv = mx.kv.create(kv_type)
     results = []
     for size in sizes:
         size = int(size)
@@ -94,56 +94,59 @@ def measure_kvstore(sizes, iters):
         out.wait_to_read()
         dt = (time.perf_counter() - t0) / iters
         gbps = size * 4 * 2 / dt / 1e9  # push + pull
-        results.append({'op': 'kv_push_pull', 'bytes': size * 4,
+        results.append({'op': label, 'bytes': size * 4,
                         'time_ms': dt * 1e3, 'GBps': gbps})
         print('%-15s %10d B  %8.3f ms  %8.2f GB/s' %
-              ('kv_push_pull', size * 4, dt * 1e3, gbps))
+              (label, size * 4, dt * 1e3, gbps))
     return results
 
 
-def measure_dist(sizes, iters, n_servers=1):
+def measure_dist(sizes, iters, n_servers=1, timeout_s=600):
     """PS-tier bandwidth: spawn a real 1-worker/N-server TCP cluster via
     tools/launch.py and time dist_sync push+pull (the reference
-    measure.py against its parameter servers)."""
+    measure.py against its parameter servers). The cluster runs in its
+    own process group so a wedged server can be killed wholesale; the
+    worker's printed rows are parsed back into result dicts."""
+    import signal
     import subprocess
     env = dict(os.environ)
     env.pop('DMLC_ROLE', None)
     env['JAX_PLATFORMS'] = 'cpu'
     env.pop('XLA_FLAGS', None)
     here = os.path.abspath(__file__)
-    r = subprocess.run(
+    proc = subprocess.Popen(
         [sys.executable, os.path.join(os.path.dirname(here), 'launch.py'),
          '-n', '1', '-s', str(n_servers), sys.executable, here,
          '--dist-worker', '--sizes', ','.join(str(int(s)) for s in sizes),
          '--iters', str(iters)],
-        env=env, capture_output=True, text=True, timeout=600)
-    sys.stdout.write(r.stdout)
-    if r.returncode != 0:
-        sys.stderr.write(r.stderr[-3000:])
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, start_new_session=True)
+    try:
+        out, err = proc.communicate(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        # kill the WHOLE group: orphaned scheduler/server processes hold
+        # the inherited pipes open and would hang a plain kill+communicate
+        os.killpg(proc.pid, signal.SIGKILL)
+        out, err = proc.communicate()
+        sys.stderr.write((err or '')[-3000:])
+        raise SystemExit('dist bandwidth run timed out')
+    sys.stdout.write(out)
+    if proc.returncode != 0:
+        sys.stderr.write((err or '')[-3000:])
         raise SystemExit('dist bandwidth run failed')
+    results = []
+    for line in out.splitlines():
+        parts = line.split()
+        if len(parts) == 7 and parts[0] == 'dist_push_pull':
+            results.append({'op': parts[0], 'bytes': int(parts[1]),
+                            'time_ms': float(parts[3]),
+                            'GBps': float(parts[5])})
+    return results
 
 
 def measure_dist_worker(sizes, iters):
-    import numpy as np
-    import mxnet_tpu as mx
-    kv = mx.kv.create('dist_sync')
-    for size in sizes:
-        size = int(size)
-        arr = mx.nd.array(np.ones(size, np.float32))
-        out = mx.nd.zeros((size,))
-        kv.init(0, arr)
-        kv.push(0, arr)
-        kv.pull(0, out=out)
-        out.wait_to_read()
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            kv.push(0, arr)
-            kv.pull(0, out=out)
-        out.wait_to_read()
-        dt = (time.perf_counter() - t0) / iters
-        gbps = size * 4 * 2 / dt / 1e9
-        print('%-15s %10d B  %8.3f ms  %8.2f GB/s' %
-              ('dist_push_pull', size * 4, dt * 1e3, gbps))
+    return measure_kvstore(sizes, iters, kv_type='dist_sync',
+                           label='dist_push_pull')
 
 
 def main(argv=None):
@@ -165,11 +168,11 @@ def main(argv=None):
                         'pre-pins jax to the TPU backend; env vars alone '
                         'are too late)')
     args = p.parse_args(argv)
-    sizes_early = [float(s) for s in args.sizes.split(',')]
+    sizes = [float(s) for s in args.sizes.split(',')]
     if args.dist_worker:
         import jax
         jax.config.update('jax_platforms', 'cpu')
-        return measure_dist_worker(sizes_early, args.iters)
+        return measure_dist_worker(sizes, args.iters)
     if args.cpu_devices:
         os.environ['XLA_FLAGS'] = (
             os.environ.get('XLA_FLAGS', '') +
@@ -179,12 +182,11 @@ def main(argv=None):
     import jax
     print('devices: %d x %s' % (len(jax.devices()),
                                 jax.devices()[0].device_kind))
-    sizes = [float(s) for s in args.sizes.split(',')]
     results = measure_collectives(sizes, args.iters, args.dtype)
     if args.kvstore:
         results += measure_kvstore(sizes, args.iters)
     if args.dist:
-        measure_dist(sizes, args.iters)
+        results += measure_dist(sizes, args.iters)
     return results
 
 
