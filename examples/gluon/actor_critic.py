@@ -1,0 +1,143 @@
+"""Actor-critic on CartPole — reference example/gluon/actor_critic.py.
+
+Same algorithm (shared trunk, policy + value heads, discounted-return
+advantage, policy-gradient + L1 value loss per episode); the gym
+dependency is replaced by an in-file CartPole implementation of the
+standard cart-pole dynamics so the run is hermetic. The episode loss is
+computed in ONE recorded batched forward over the episode's states
+(same math as the reference's per-step accumulation, XLA-friendly).
+
+    python actor_critic.py --episodes 120
+"""
+import argparse
+import logging
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+class CartPole:
+    """Classic cart-pole balancing dynamics (Barto/Sutton/Anderson '83)."""
+
+    GRAV, MCART, MPOLE, LEN, FORCE, TAU = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+    X_LIM, THETA_LIM = 2.4, 12 * math.pi / 180
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.state = None
+
+    def reset(self):
+        self.state = self.rng.uniform(-0.05, 0.05, 4)
+        return self.state.copy()
+
+    def step(self, action):
+        x, x_dot, th, th_dot = self.state
+        force = self.FORCE if action == 1 else -self.FORCE
+        mtot = self.MCART + self.MPOLE
+        pml = self.MPOLE * self.LEN
+        costh, sinth = math.cos(th), math.sin(th)
+        tmp = (force + pml * th_dot ** 2 * sinth) / mtot
+        th_acc = (self.GRAV * sinth - costh * tmp) / (
+            self.LEN * (4.0 / 3.0 - self.MPOLE * costh ** 2 / mtot))
+        x_acc = tmp - pml * th_acc * costh / mtot
+        x += self.TAU * x_dot
+        x_dot += self.TAU * x_acc
+        th += self.TAU * th_dot
+        th_dot += self.TAU * th_acc
+        self.state = np.array([x, x_dot, th, th_dot])
+        done = (abs(x) > self.X_LIM or abs(th) > self.THETA_LIM)
+        return self.state.copy(), 1.0, done
+
+
+class ActorCritic(gluon.Block):
+    def __init__(self, n_actions=2, hidden=64, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.dense = nn.Dense(hidden, activation='relu')
+            self.action_head = nn.Dense(n_actions)
+            self.value_head = nn.Dense(1)
+
+    def forward(self, x):
+        h = self.dense(x)
+        return self.action_head(h), self.value_head(h)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--episodes', type=int, default=120)
+    parser.add_argument('--max-steps', type=int, default=200)
+    parser.add_argument('--gamma', type=float, default=0.99)
+    parser.add_argument('--lr', type=float, default=3e-2)
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--target', type=float, default=40.0,
+                        help='required mean episode length over the last 20')
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    env = CartPole(rng)
+    net = ActorCritic()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    l1 = gluon.loss.L1Loss()
+
+    lengths = []
+    for ep in range(args.episodes):
+        # --- rollout (no tape): sample actions from the current policy
+        state = env.reset()
+        states, actions, rewards = [], [], []
+        for t in range(args.max_steps):
+            states.append(state.astype(np.float32))
+            logits, _ = net(mx.nd.array(state[None].astype(np.float32)))
+            prob = mx.nd.softmax(logits)[0].asnumpy()
+            action = int(rng.choice(2, p=prob / prob.sum()))
+            actions.append(action)
+            state, r, done = env.step(action)
+            rewards.append(r)
+            if done:
+                break
+        # discounted returns, normalized
+        R, returns = 0.0, []
+        for r in reversed(rewards):
+            R = r + args.gamma * R
+            returns.append(R)
+        returns = np.asarray(returns[::-1], np.float32)
+        returns = (returns - returns.mean()) / (returns.std() + 1e-6)
+        # --- one recorded batched forward for the whole episode
+        T = len(states)
+        s_nd = mx.nd.array(np.stack(states))
+        ret_nd = mx.nd.array(returns.reshape(T, 1))
+        with autograd.record():
+            logits, values = net(s_nd)
+            logp_all = mx.nd.log_softmax(logits)
+            logp = mx.nd.pick(logp_all, mx.nd.array(
+                np.asarray(actions, np.float32)), axis=1)
+            adv = returns - values.asnumpy().ravel()
+            pg = -(logp * mx.nd.array(adv)).sum()
+            vl = l1(values, ret_nd).sum()
+            loss = pg + vl
+        loss.backward()
+        trainer.step(1)
+        lengths.append(len(rewards))
+        if (ep + 1) % 20 == 0:
+            logging.info('episode %d: mean length (last 20) %.1f', ep + 1,
+                         np.mean(lengths[-20:]))
+    final = float(np.mean(lengths[-20:]))
+    first = float(np.mean(lengths[:20]))
+    logging.info('episode length %.1f -> %.1f', first, final)
+    assert final > args.target, 'did not learn: %.1f' % final
+    print('actor_critic ok: %.1f -> %.1f' % (first, final))
+
+
+if __name__ == '__main__':
+    main()

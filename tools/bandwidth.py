@@ -141,6 +141,11 @@ def measure_dist(sizes, iters, n_servers=1, timeout_s=600):
             results.append({'op': parts[0], 'bytes': int(parts[1]),
                             'time_ms': float(parts[3]),
                             'GBps': float(parts[5])})
+    if not results:
+        # a format drift in measure_kvstore's print must not silently
+        # drop the dist tier from the report
+        raise SystemExit('no dist rows parsed from worker output:\n'
+                         + out[-2000:])
     return results
 
 
