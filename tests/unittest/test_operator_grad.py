@@ -130,3 +130,106 @@ def test_batch_dot_and_topk_backward():
         .astype(np.float32)
     t = mx.sym.topk(mx.sym.Variable('data'), k=2, ret_typ='value')
     check_numeric_gradient(t, {'data': x}, **KW)
+
+
+def test_unary_family_numeric_grad():
+    """Numeric-gradient sweep over the differentiable unary family
+    (reference test_operator.py's check_numeric_gradient pattern)."""
+    cases = {
+        'tanh': (-2, 2), 'sigmoid': (-3, 3), 'exp': (-1, 1),
+        'log': (0.2, 3), 'sqrt': (0.2, 4), 'rsqrt': (0.3, 3),
+        'square': (-2, 2), 'cbrt': (0.2, 3), 'expm1': (-1, 1),
+        'log1p': (-0.5, 2), 'arctan': (-2, 2), 'sinh': (-1.5, 1.5),
+        'cosh': (-1.5, 1.5), 'softsign': (-2, 2), 'erf': (-2, 2),
+        'gamma': (1.2, 3), 'gammaln': (1.2, 3),
+    }
+    rng = np.random.RandomState(0)
+    for name, (lo, hi) in cases.items():
+        data = mx.sym.Variable('data')
+        s = mx.sym.sum(getattr(mx.sym, name)(data))
+        x = rng.uniform(lo, hi, (3, 4)).astype(np.float32)
+        check_numeric_gradient(s, {'data': x}, **KW)
+
+
+def test_binary_broadcast_numeric_grad():
+    rng = np.random.RandomState(1)
+    a = rng.uniform(0.5, 2.0, (3, 1, 4)).astype(np.float32)
+    b = rng.uniform(0.5, 2.0, (1, 2, 4)).astype(np.float32)
+    for op in ['broadcast_add', 'broadcast_mul', 'broadcast_div',
+               'broadcast_power', 'broadcast_hypot']:
+        lhs, rhs = mx.sym.Variable('lhs'), mx.sym.Variable('rhs')
+        s = mx.sym.sum(getattr(mx.sym, op)(lhs, rhs))
+        check_numeric_gradient(s, {'lhs': a, 'rhs': b}, **KW)
+    # maximum: operands separated beyond the fd eps so the subgradient
+    # is stable (both winner directions exercised)
+    lhs, rhs = mx.sym.Variable('lhs'), mx.sym.Variable('rhs')
+    s = mx.sym.sum(mx.sym.broadcast_maximum(lhs, rhs))
+    check_numeric_gradient(s, {'lhs': a, 'rhs': b + 1.5}, **KW)
+    check_numeric_gradient(s, {'lhs': a + 3.0, 'rhs': b}, **KW)
+
+
+def test_layer_ops_numeric_grad():
+    """Composite layers against finite differences: conv+bias, FC
+    no-flatten, LeakyReLU modes, Embedding, SequenceMask."""
+    rng = np.random.RandomState(2)
+
+    data = mx.sym.Variable('data')
+    w = mx.sym.Variable('w')
+    b = mx.sym.Variable('b')
+    conv = mx.sym.sum(mx.sym.Convolution(
+        data, w, b, kernel=(3, 3), num_filter=4, pad=(1, 1), stride=(2, 2)))
+    check_numeric_gradient(conv, {
+        'data': rng.randn(2, 3, 7, 7).astype(np.float32),
+        'w': rng.randn(4, 3, 3, 3).astype(np.float32) * 0.5,
+        'b': rng.randn(4).astype(np.float32) * 0.1}, **KW)
+
+    fc = mx.sym.sum(mx.sym.FullyConnected(
+        data, w, b, num_hidden=5, flatten=False))
+    check_numeric_gradient(fc, {
+        'data': rng.randn(2, 3, 4).astype(np.float32),
+        'w': rng.randn(5, 4).astype(np.float32) * 0.5,
+        'b': rng.randn(5).astype(np.float32) * 0.1}, **KW)
+
+    for act in ['leaky', 'elu']:
+        s = mx.sym.sum(mx.sym.LeakyReLU(data, act_type=act, slope=0.3))
+        check_numeric_gradient(
+            s, {'data': rng.randn(3, 4).astype(np.float32) + 0.1}, **KW)
+
+    emb_w = mx.sym.Variable('emb_w')
+    emb = mx.sym.sum(mx.sym.Embedding(data, emb_w, input_dim=6,
+                                      output_dim=3))
+    # gradient flows to the table, not the (integer) indices
+    ex = emb.bind(mx.cpu(),
+                  {'data': mx.nd.array([[1., 4.], [2., 5.]]),
+                   'emb_w': mx.nd.array(rng.randn(6, 3).astype(np.float32))},
+                  args_grad={'emb_w': mx.nd.zeros((6, 3))},
+                  grad_req={'data': 'null', 'emb_w': 'write'})
+    ex.forward(is_train=True)
+    ex.backward(mx.nd.ones(()))
+    g = ex.grad_dict['emb_w'].asnumpy()
+    want = np.zeros((6, 3))
+    for idx in [1, 4, 2, 5]:
+        want[idx] += 1
+    np.testing.assert_allclose(g, want, rtol=1e-5)
+
+    # SequenceMask: gradient passes only inside each sequence's length
+    sm = mx.sym.sum(mx.sym.SequenceMask(
+        data, mx.sym.Variable('len'), use_sequence_length=True))
+    x = rng.randn(4, 2, 3).astype(np.float32)   # (T, B, D)
+    check_numeric_gradient(sm, {'data': x,
+                                'len': np.array([2., 4.], np.float32)},
+                           grad_nodes=['data'], **KW)
+
+
+def test_softmax_family_numeric_grad():
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 6).astype(np.float32)
+    w = rng.randn(4, 6).astype(np.float32)
+    data = mx.sym.Variable('data')
+    wsym = mx.sym.Variable('w')
+    for fn in ['softmax', 'log_softmax']:
+        # fixed weights give a non-trivial cotangent; only data is
+        # perturbed numerically (grad_nodes)
+        s = mx.sym.sum(getattr(mx.sym, fn)(data) * wsym)
+        check_numeric_gradient(s, {'data': x, 'w': w},
+                               grad_nodes=['data'], **KW)
