@@ -1,0 +1,138 @@
+"""Word-level language model — reference example/gluon/word_language_model.
+
+Embedding -> multi-layer LSTM -> decoder with OPTIONAL weight tying
+(decoder shares the embedding matrix), truncated-BPTT training with
+hidden-state carry and gradient clipping — the reference's training
+loop shape. Corpus: a synthetic second-order Markov language, so the
+model has real structure to learn and perplexity has a known floor.
+
+    python word_language_model.py --epochs 8 --tied
+"""
+import argparse
+import logging
+import math
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn, rnn
+
+
+class RNNModel(gluon.Block):
+    def __init__(self, vocab, embed, hidden, layers, tied=False, **kw):
+        super().__init__(**kw)
+        self.tied = tied
+        with self.name_scope():
+            self.embedding = nn.Embedding(vocab, embed)
+            self.lstm = rnn.LSTM(hidden, num_layers=layers,
+                                 input_size=embed)
+            if tied:
+                assert embed == hidden, 'tying needs embed == hidden'
+                self.decoder = nn.Dense(vocab, flatten=False,
+                                        params=self.embedding.params)
+            else:
+                self.decoder = nn.Dense(vocab, flatten=False)
+
+    def forward(self, inputs, state):
+        emb = self.embedding(inputs)               # (T, B, E)
+        out, state = self.lstm(emb, state)         # (T, B, H)
+        return self.decoder(out), state
+
+    def begin_state(self, batch_size):
+        return self.lstm.begin_state(batch_size=batch_size)
+
+
+def markov_corpus(n_tokens, vocab, seed=0):
+    """Second-order Markov chain with sparse transitions: entropy well
+    below log(vocab), so an LSTM that uses context wins clearly."""
+    rng = np.random.RandomState(seed)
+    nxt = rng.randint(0, vocab, (vocab, vocab, 3))  # 3 choices per bigram
+    toks = [0, 1]
+    for _ in range(n_tokens - 2):
+        a, b = toks[-2], toks[-1]
+        toks.append(int(nxt[a, b, rng.randint(3)]))
+    return np.asarray(toks, np.int32)
+
+
+def batchify(data, batch_size):
+    n = len(data) // batch_size
+    return data[:n * batch_size].reshape(batch_size, n).T  # (T, B)
+
+
+def detach(state):
+    return [s.detach() for s in state] if isinstance(state, (list, tuple)) \
+        else state.detach()
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=8)
+    p.add_argument('--batch-size', type=int, default=16)
+    p.add_argument('--bptt', type=int, default=16)
+    p.add_argument('--vocab', type=int, default=40)
+    p.add_argument('--embed', type=int, default=64)
+    p.add_argument('--hidden', type=int, default=64)
+    p.add_argument('--layers', type=int, default=2)
+    p.add_argument('--tokens', type=int, default=12000)
+    p.add_argument('--lr', type=float, default=0.01)
+    p.add_argument('--clip', type=float, default=1.0)
+    p.add_argument('--tied', action='store_true')
+    p.add_argument('--seed', type=int, default=0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+
+    data = batchify(markov_corpus(args.tokens, args.vocab, args.seed),
+                    args.batch_size)
+    model = RNNModel(args.vocab, args.embed, args.hidden, args.layers,
+                     tied=args.tied)
+    model.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    uniform_ppl = float(args.vocab)
+    first_ppl = last_ppl = None
+    for epoch in range(args.epochs):
+        total_loss, total_cnt = 0.0, 0
+        state = model.begin_state(args.batch_size)
+        for i in range(0, data.shape[0] - 1, args.bptt):
+            # clamp the final window (reference example's shape)
+            L = min(args.bptt, data.shape[0] - 1 - i)
+            if L < 2:
+                break
+            x = mx.nd.array(data[i:i + L])
+            y = mx.nd.array(data[i + 1:i + 1 + L])
+            state = detach(state)   # truncate BPTT at the window edge
+            with autograd.record():
+                out, state = model(x, state)
+                loss = loss_fn(out.reshape((-1, args.vocab)),
+                               y.reshape((-1,))).mean()
+            loss.backward()
+            # global grad-norm clipping (reference clip_global_norm)
+            grads = [p_.grad() for p_ in model.collect_params().values()
+                     if p_.grad_req != 'null']
+            gluon.utils.clip_global_norm(grads, args.clip)
+            trainer.step(1)
+            total_loss += float(loss.asnumpy()) * x.shape[0]
+            total_cnt += x.shape[0]
+        ppl = math.exp(total_loss / total_cnt)
+        if first_ppl is None:
+            first_ppl = ppl
+        last_ppl = ppl
+        logging.info('epoch %d perplexity %.1f (uniform %.0f)', epoch,
+                     ppl, uniform_ppl)
+    assert last_ppl < 0.5 * uniform_ppl, \
+        'LM did not learn: ppl %.1f vs uniform %.0f' % (last_ppl,
+                                                        uniform_ppl)
+    print('word_language_model ok: ppl %.1f -> %.1f%s'
+          % (first_ppl, last_ppl, ' (tied)' if args.tied else ''))
+
+
+if __name__ == '__main__':
+    main()

@@ -38,6 +38,7 @@ CASES = [
      ['--model', 'resnet18_v1', '--epochs', '1', '--samples', '64',
       '--image-size', '16', '--batch-size', '16']),
     ('gluon/dcgan.py', ['--epochs', '2', '--batches', '12']),
+    ('gluon/word_language_model.py', ['--tied', '--epochs', '6']),
     ('gluon/actor_critic.py', ['--episodes', '80', '--max-steps', '120',
                                '--target', '60']),
     ('cnn_text_classification/train.py', ['--epochs', '3']),
