@@ -1,0 +1,37 @@
+#!/bin/bash
+# One-shot TPU evidence capture. Run when the axon tunnel is healthy
+# (e.g. triggered by a probe loop): records everything the TPU-gated
+# verdict items need into docs/tpu_artifacts/.
+#
+#   bash tools/tpu_capture.sh
+#
+# Captures:
+#   1. tests/tpu consistency tier (MXTPU_TEST_TPU=1)
+#   2. bench.py (default path)
+#   3. bench.py with MXTPU_CONV_BWD_PATCHES=1 (the grad-weight lever)
+set -u
+cd "$(dirname "$0")/.."
+OUT=docs/tpu_artifacts
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+
+echo "== probing chip =="
+timeout 240 python -c 'import jax; d=jax.devices(); print("TPU OK:", d)' \
+  || { echo "chip unreachable; aborting"; exit 1; }
+
+echo "== 1/3 TPU consistency tier =="
+MXTPU_TEST_TPU=1 timeout 3000 python -m pytest tests/tpu -v \
+  > "$OUT/tpu_consistency_$STAMP.log" 2>&1
+echo "rc=$? (log: $OUT/tpu_consistency_$STAMP.log)"
+
+echo "== 2/3 bench (default) =="
+MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
+  > "$OUT/bench_default_$STAMP.json" 2> "$OUT/bench_default_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_default_$STAMP.json"
+
+echo "== 3/3 bench (MXTPU_CONV_BWD_PATCHES=1) =="
+MXTPU_CONV_BWD_PATCHES=1 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
+  > "$OUT/bench_patches_$STAMP.json" 2> "$OUT/bench_patches_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_patches_$STAMP.json"
+
+echo "== done; commit docs/tpu_artifacts =="
