@@ -30,7 +30,12 @@ _DTYPE_STR = {
 
 
 def np_dtype(dtype):
-    """Normalize any dtype spec (str, np.dtype, jnp dtype, type) to np.dtype."""
+    """Normalize any dtype spec (str, np.dtype, jnp dtype, type).
+    'bfloat16' maps to the jnp scalar class (the convention jnp.zeros
+    etc. accept); everything else goes through np.dtype, which also
+    resolves the bf16 scalar class itself via ml_dtypes — so the
+    function is idempotent. NOTE: str() of the bf16 CLASS is not a
+    parseable dtype name; pass dtype objects around, not str(dtype)."""
     if dtype is None:
         return np.dtype('float32')
     if isinstance(dtype, str) and dtype == 'bfloat16':

@@ -478,11 +478,13 @@ def simple_bind(symbol, ctx, grad_req='write', type_dict=None, group2ctx=None,
     arg_types, _, aux_types = symbol.infer_type(**type_dict)
     args = {}
     for name, sh, it in zip(arg_names, arg_shapes, arg_types):
-        dt = str(np_dtype(type_dict.get(name, it)))
-        args[name] = nd_zeros(sh, ctx=ctx, dtype=dt)
+        # keep the dtype OBJECT: str() of the bf16 scalar class is not a
+        # parseable dtype name (np_dtype is idempotent)
+        args[name] = nd_zeros(sh, ctx=ctx,
+                              dtype=np_dtype(type_dict.get(name, it)))
     aux = {}
     for name, sh, it in zip(aux_names, aux_shapes, aux_types):
-        aux[name] = nd_zeros(sh, ctx=ctx, dtype=str(np_dtype(it)))
+        aux[name] = nd_zeros(sh, ctx=ctx, dtype=np_dtype(it))
     grads = None
     req_of = (lambda n: grad_req) if isinstance(grad_req, str) else \
         (lambda n: grad_req[arg_names.index(n)] if isinstance(grad_req, (list, tuple))

@@ -310,9 +310,16 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req='write',
     and gradients (reference test_utils.py check_consistency — the CPU-vs-GPU
     test pattern, here CPU-vs-TPU / dtype-vs-dtype)."""
     if tol is None:
-        tol = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-3,
+        tol = {np.dtype(np.float16): 1e-1, 'bfloat16': 1e-1,
+               np.dtype(np.float32): 1e-3,
                np.dtype(np.float64): 1e-5, np.dtype(np.uint8): 0,
                np.dtype(np.int32): 0}
+    else:
+        # normalize caller keys to _tol_key's convention (bf16 np.dtype
+        # keys == 'bfloat16' but do not HASH-match the string)
+        tol = {('bfloat16' if getattr(k, 'name', None) == 'bfloat16'
+                or k == 'bfloat16' else np.dtype(k)): v
+               for k, v in tol.items()}
 
     assert len(ctx_list) > 1
     if isinstance(sym_, sym.Symbol):
@@ -344,8 +351,11 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req='write',
             for k, v in aux_params.items():
                 exe.aux_dict[k][:] = v
 
-    dtypes = [np.dtype(exe.outputs[0].asnumpy().dtype) for exe in exe_list]
-    max_idx = np.argmax([t.itemsize for t in dtypes])
+    # key by the executor's REAL output dtype: asnumpy() widens bf16 to
+    # fp32 and would silently pick the fp32 tolerance
+    dtypes = [_tol_key(exe.outputs[0]) for exe in exe_list]
+    max_idx = int(np.argmax([2 if d == 'bfloat16' else np.dtype(d).itemsize
+                             for d in dtypes]))
 
     for exe in exe_list:
         exe.forward(is_train=(grad_req != 'null'))

@@ -1,0 +1,50 @@
+"""Cross-dtype consistency matrix (reference test_operator_gpu.py
+check_consistency pattern: the same net on fp32/bf16/fp16 must agree to
+half-precision tolerance in outputs AND gradients)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import check_consistency
+
+
+def _conv_net():
+    data = mx.sym.Variable('data')
+    x = mx.sym.Convolution(data, kernel=(3, 3), num_filter=8, pad=(1, 1),
+                           name='c1')
+    x = mx.sym.Activation(x, act_type='relu')
+    # avg (not max) pooling: half-precision rounding can flip a max
+    # argmax between dtypes, rerouting gradients pointwise (the
+    # reference's cross-dtype checks avoid max-pool ties the same way)
+    x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type='avg')
+    x = mx.sym.FullyConnected(mx.sym.flatten(x), num_hidden=8, name='fc')
+    return x
+
+
+def _ctx(dtype, shape=(2, 3, 8, 8)):
+    return {'ctx': mx.cpu(), 'data': shape,
+            'type_dict': {'data': dtype}}
+
+
+def test_conv_net_dtype_consistency():
+    check_consistency(_conv_net(),
+                      [_ctx('float32'), _ctx('bfloat16'),
+                       _ctx('float16')], scale=0.5)
+
+
+def test_norm_stack_dtype_consistency():
+    data = mx.sym.Variable('data')
+    x = mx.sym.LayerNorm(data, name='ln')
+    x = mx.sym.FullyConnected(x, num_hidden=6, name='fc')
+    x = mx.sym.softmax(x)
+    check_consistency(x, [_ctx('float32', (4, 10)),
+                          _ctx('bfloat16', (4, 10))], scale=0.5)
+
+
+def test_elemwise_chain_dtype_consistency():
+    data = mx.sym.Variable('data')
+    x = mx.sym.tanh(data) * mx.sym.sigmoid(data) + mx.sym.sqrt(
+        mx.sym.abs(data) + 1.0)
+    check_consistency(x, [_ctx('float32', (3, 5)),
+                          _ctx('bfloat16', (3, 5)),
+                          _ctx('float16', (3, 5))], scale=1.0)
