@@ -48,3 +48,38 @@ def test_elemwise_chain_dtype_consistency():
     check_consistency(x, [_ctx('float32', (3, 5)),
                           _ctx('bfloat16', (3, 5)),
                           _ctx('float16', (3, 5))], scale=1.0)
+
+
+_OP_CASES = [
+    ('Convolution', lambda d: mx.sym.Convolution(d, kernel=(3, 3),
+                                                 num_filter=4, pad=(1, 1)),
+     (2, 3, 6, 6)),
+    ('Deconvolution', lambda d: mx.sym.Deconvolution(
+        d, kernel=(2, 2), num_filter=3, stride=(2, 2), no_bias=True),
+     (2, 3, 4, 4)),
+    ('FullyConnected', lambda d: mx.sym.FullyConnected(d, num_hidden=6),
+     (4, 5)),
+    ('BatchNorm', lambda d: mx.sym.BatchNorm(d, fix_gamma=False),
+     (4, 3, 5, 5)),
+    ('Dropout-test', lambda d: mx.sym.Dropout(d, p=0.5), (4, 6)),
+    ('Embedding', lambda d: mx.sym.Embedding(
+        mx.sym.BlockGrad(mx.sym.Cast(d, dtype='int32')), input_dim=8,
+        output_dim=4), (3, 4)),
+    ('batch_dot', lambda d: mx.sym.batch_dot(d, d), (2, 3, 3)),
+    ('log_softmax', mx.sym.log_softmax, (4, 7)),
+    ('LRN', lambda d: mx.sym.LRN(d, nsize=3), (2, 4, 5, 5)),
+    ('InstanceNorm', mx.sym.InstanceNorm, (2, 3, 6, 6)),
+]
+
+
+@pytest.mark.parametrize('name,build,shape',
+                         _OP_CASES, ids=[c[0] for c in _OP_CASES])
+def test_per_op_dtype_consistency(name, build, shape):
+    """fp32-vs-bf16 agreement per op, outputs and gradients."""
+    sym_ = build(mx.sym.Variable('data'))
+    # eval-only where training-mode randomness (dropout masks) or
+    # integer inputs (Embedding) make gradients non-comparable
+    grad_req = 'null' if name in ('Embedding', 'Dropout-test') else 'write'
+    check_consistency(sym_,
+                      [_ctx('float32', shape), _ctx('bfloat16', shape)],
+                      scale=0.5, grad_req=grad_req)
