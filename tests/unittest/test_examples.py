@@ -20,6 +20,7 @@ CASES = [
     ('image-classification/train_imagenet.py',
      ['--num-layers', '18', '--image-shape', '3,32,32', '--num-classes', '5',
       '--samples', '32', '--batch-size', '16', '--benchmark', '1']),
+    ('rcnn/train_rcnn_lite.py', []),
     ('ssd/train_ssd.py', ['--epochs', '40', '--samples', '32',
                           '--batch-size', '16', '--min-recall', '0.15']),
     ('rnn/model_parallel_lstm.py', ['--steps', '30', '--num-layers', '2',
