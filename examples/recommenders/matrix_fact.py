@@ -1,0 +1,104 @@
+"""Matrix-factorization recommender — reference example/recommenders
+(demo1-MF): user/item Embedding factors trained on ratings with L2
+loss, the classic collaborative-filtering baseline.
+
+Hermetic: ratings come from a planted low-rank model plus noise, so the
+learned factors must recover it — test RMSE is asserted against the
+noise floor.
+
+    python matrix_fact.py --epochs 8
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+class MFBlock(gluon.Block):
+    def __init__(self, n_users, n_items, k, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.user = nn.Embedding(n_users, k)
+            self.item = nn.Embedding(n_items, k)
+            self.user_b = nn.Embedding(n_users, 1)
+            self.item_b = nn.Embedding(n_items, 1)
+
+    def forward(self, users, items):
+        p = self.user(users)
+        q = self.item(items)
+        return ((p * q).sum(axis=1) + self.user_b(users).reshape((-1,)) +
+                self.item_b(items).reshape((-1,)))
+
+
+def planted_ratings(rng, n_users, n_items, k, n_obs, noise=0.1):
+    U = rng.randn(n_users, k) / np.sqrt(k)
+    V = rng.randn(n_items, k) / np.sqrt(k)
+    bu = rng.randn(n_users) * 0.3
+    bi = rng.randn(n_items) * 0.3
+    u = rng.randint(0, n_users, n_obs)
+    i = rng.randint(0, n_items, n_obs)
+    r = (U[u] * V[i]).sum(1) + bu[u] + bi[i] + noise * rng.randn(n_obs)
+    return (u.astype(np.float32), i.astype(np.float32),
+            r.astype(np.float32))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument('--epochs', type=int, default=8)
+    p.add_argument('--batch-size', type=int, default=512)
+    p.add_argument('--users', type=int, default=200)
+    p.add_argument('--items', type=int, default=150)
+    p.add_argument('--rank', type=int, default=8)
+    p.add_argument('--obs', type=int, default=8000)
+    p.add_argument('--lr', type=float, default=0.05)
+    p.add_argument('--noise', type=float, default=0.1)
+    p.add_argument('--seed', type=int, default=0)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(args.seed)
+    rng = np.random.RandomState(args.seed)
+
+    u, i, r = planted_ratings(rng, args.users, args.items, args.rank,
+                              args.obs, args.noise)
+    n_train = int(0.9 * args.obs)
+    net = MFBlock(args.users, args.items, args.rank)
+    net.initialize(mx.init.Normal(0.05))
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    l2 = gluon.loss.L2Loss()
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(n_train)
+        tot = cnt = 0
+        for s in range(0, n_train, args.batch_size):
+            idx = perm[s:s + args.batch_size]
+            bu = mx.nd.array(u[idx])
+            bi = mx.nd.array(i[idx])
+            br = mx.nd.array(r[idx])
+            with autograd.record():
+                loss = l2(net(bu, bi), br).mean()
+            loss.backward()
+            trainer.step(1)
+            tot += float(loss.asnumpy()) * len(idx)
+            cnt += len(idx)
+        pred = net(mx.nd.array(u[n_train:]),
+                   mx.nd.array(i[n_train:])).asnumpy()
+        rmse = float(np.sqrt(np.mean((pred - r[n_train:]) ** 2)))
+        logging.info('epoch %d train-loss %.4f test RMSE %.3f', epoch,
+                     tot / cnt, rmse)
+    # the planted noise floor is `noise`; require getting close to it
+    assert rmse < 3.0 * args.noise, 'RMSE too high: %.3f' % rmse
+    print('matrix factorization ok: test RMSE %.3f (noise %.2f)'
+          % (rmse, args.noise))
+
+
+if __name__ == '__main__':
+    main()

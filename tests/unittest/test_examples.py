@@ -40,6 +40,9 @@ CASES = [
       '--image-size', '16', '--batch-size', '16']),
     ('gluon/dcgan.py', ['--epochs', '2', '--batches', '12']),
     ('gluon/word_language_model.py', ['--tied', '--epochs', '6']),
+    ('gluon/super_resolution.py', ['--epochs', '12', '--samples', '96',
+                                   '--min-psnr', '18']),
+    ('recommenders/matrix_fact.py', []),
     ('gluon/actor_critic.py', ['--episodes', '80', '--max-steps', '120',
                                '--target', '60']),
     ('cnn_text_classification/train.py', ['--epochs', '3']),
