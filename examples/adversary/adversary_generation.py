@@ -1,0 +1,101 @@
+"""FGSM adversarial examples — reference example/adversary/adversary_generation.ipynb.
+
+Trains a small MLP classifier, then perturbs test inputs along the sign
+of the input gradient (Goodfellow et al., FGSM) and measures the
+accuracy collapse. Hermetic: well-separated Gaussian blobs stand in for
+MNIST so the clean model is near-perfect and the adversarial direction
+is exactly learnable.
+
+    python adversary_generation.py --epochs 10 --epsilon 0.25
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+NCLASS = 5
+DIM = 256
+
+
+def blobs(rng, n, centers):
+    labels = rng.randint(0, NCLASS, size=n)
+    x = centers[labels] + 0.25 * rng.randn(n, DIM).astype(np.float32)
+    return x.astype(np.float32), labels.astype(np.float32)
+
+
+def accuracy(net, x, y):
+    pred = net(mx.nd.array(x)).asnumpy().argmax(axis=1)
+    return float((pred == y).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=10)
+    ap.add_argument('--samples', type=int, default=512)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--epsilon', type=float, default=0.25)
+    ap.add_argument('--lr', type=float, default=0.1)
+    ap.add_argument('--min-drop', type=float, default=0.2,
+                    help='required clean-vs-adversarial accuracy drop')
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(7)
+    centers = rng.randn(NCLASS, DIM).astype(np.float32) * 0.3
+    xtr, ytr = blobs(rng, args.samples, centers)
+    xte, yte = blobs(rng, args.samples // 2, centers)
+
+    net = nn.Sequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation='relu'), nn.Dense(NCLASS))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': args.lr, 'momentum': 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(xtr))
+        tot = 0.0
+        for i in range(0, len(xtr), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data = mx.nd.array(xtr[idx])
+            label = mx.nd.array(ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.mean().asscalar()) * len(idx)
+        logging.info('epoch %d loss %.4f', epoch, tot / len(xtr))
+
+    clean_acc = accuracy(net, xte, yte)
+
+    # FGSM: x_adv = x + eps * sign(dL/dx)
+    data = mx.nd.array(xte)
+    label = mx.nd.array(yte)
+    data.attach_grad()
+    with autograd.record():
+        loss = loss_fn(net(data), label)
+    loss.backward()
+    x_adv = data + args.epsilon * mx.nd.sign(data.grad)
+    adv_acc = accuracy(net, x_adv.asnumpy(), yte)
+
+    drop = clean_acc - adv_acc
+    logging.info('clean acc %.3f  adversarial acc %.3f  drop %.3f',
+                 clean_acc, adv_acc, drop)
+    assert clean_acc > 0.9, 'clean model failed to train: %.3f' % clean_acc
+    assert drop >= args.min_drop, (
+        'FGSM attack too weak: drop %.3f < %.3f' % (drop, args.min_drop))
+    print('adversary: clean=%.3f adv=%.3f drop=%.3f' %
+          (clean_acc, adv_acc, drop))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,107 @@
+"""CustomOp softmax — reference example/numpy-ops/custom_softmax.py.
+
+Defines softmax cross-entropy as a python CustomOp (numpy forward /
+backward, registered via mx.operator.register) and trains an MLP with
+it through the Module API — demonstrating the legacy python-operator
+bridge end to end. Hermetic synthetic blobs stand in for MNIST.
+
+    python custom_softmax.py --epochs 8
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+NCLASS = 6
+DIM = 24
+
+
+class Softmax(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        x = in_data[0].asnumpy()
+        y = np.exp(x - x.max(axis=1).reshape((x.shape[0], 1)))
+        y /= y.sum(axis=1).reshape((x.shape[0], 1))
+        self.assign(out_data[0], req[0], mx.nd.array(y))
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        lab = in_data[1].asnumpy().ravel().astype(np.int64)
+        y = out_data[0].asnumpy().copy()
+        y[np.arange(lab.shape[0]), lab] -= 1.0
+        self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+
+@mx.operator.register('example_softmax')
+class SoftmaxProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super(SoftmaxProp, self).__init__(need_top_grad=False)
+
+    def list_arguments(self):
+        return ['data', 'label']
+
+    def list_outputs(self):
+        return ['output']
+
+    def infer_shape(self, in_shape):
+        data_shape = in_shape[0]
+        label_shape = (in_shape[0][0],)
+        return [data_shape, label_shape], [data_shape], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Softmax()
+
+
+def blobs(rng, n, centers):
+    labels = rng.randint(0, NCLASS, size=n)
+    x = centers[labels] + 0.4 * rng.randn(n, DIM).astype(np.float32)
+    return x.astype(np.float32), labels.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=8)
+    ap.add_argument('--samples', type=int, default=512)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--lr', type=float, default=0.02,
+                    help='the CustomOp backward emits unnormalized batch '
+                         'gradients (reference custom_softmax.py), so keep '
+                         'lr small')
+    ap.add_argument('--min-acc', type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(11)
+    centers = rng.randn(NCLASS, DIM).astype(np.float32) * 2.0
+    xtr, ytr = blobs(rng, args.samples, centers)
+    xte, yte = blobs(rng, args.samples // 2, centers)
+    train = mx.io.NDArrayIter(xtr, ytr, args.batch_size, shuffle=True,
+                              label_name='softmax_label')
+    val = mx.io.NDArrayIter(xte, yte, args.batch_size,
+                            label_name='softmax_label')
+
+    data = mx.sym.Variable('data')
+    label = mx.sym.Variable('softmax_label')
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=64, name='fc1')
+    act1 = mx.sym.Activation(data=fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(data=act1, num_hidden=NCLASS, name='fc2')
+    net = mx.sym.Custom(data=fc2, label=label, op_type='example_softmax',
+                        name='softmax')
+
+    mod = mx.mod.Module(symbol=net, context=mx.current_context(),
+                        label_names=('softmax_label',))
+    mod.fit(train, eval_data=val, eval_metric='acc', optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9},
+            num_epoch=args.epochs)
+    score = dict(mod.score(val, ['acc']))
+    logging.info('validation acc %.3f', score['accuracy'])
+    assert score['accuracy'] >= args.min_acc, score
+    print('custom_softmax: acc=%.3f' % score['accuracy'])
+
+
+if __name__ == '__main__':
+    main()

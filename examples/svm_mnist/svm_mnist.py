@@ -1,0 +1,76 @@
+"""L2-SVM output layer — reference example/svm_mnist/svm_mnist.py.
+
+MLP trained with the SVMOutput symbol (squared hinge loss on the margin)
+instead of softmax, via the Module API. Hermetic: separable Gaussian
+blobs stand in for the PCA-projected MNIST of the reference; both the
+L2-SVM (default) and L1-SVM (--use-linear) objectives are exercised.
+
+    python svm_mnist.py --epochs 10
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+NCLASS = 10
+DIM = 48
+
+
+def blobs(rng, n, centers):
+    labels = rng.randint(0, NCLASS, size=n)
+    x = centers[labels] + 0.4 * rng.randn(n, DIM).astype(np.float32)
+    return x.astype(np.float32), labels.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=10)
+    ap.add_argument('--samples', type=int, default=640)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--lr', type=float, default=0.005,
+                    help='the hinge gradient is unnormalized (reference '
+                         'svm_output-inl.h), so keep lr small')
+    ap.add_argument('--use-linear', action='store_true',
+                    help='L1-SVM objective instead of L2-SVM')
+    ap.add_argument('--min-acc', type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(3)
+    centers = rng.randn(NCLASS, DIM).astype(np.float32) * 1.8
+    xtr, ytr = blobs(rng, args.samples, centers)
+    xte, yte = blobs(rng, args.samples // 2, centers)
+    train = mx.io.NDArrayIter(xtr, ytr, args.batch_size, shuffle=True,
+                              label_name='svm_label')
+    val = mx.io.NDArrayIter(xte, yte, args.batch_size,
+                            label_name='svm_label')
+
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=128, name='fc1')
+    act1 = mx.sym.Activation(data=fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(data=act1, num_hidden=64, name='fc2')
+    act2 = mx.sym.Activation(data=fc2, act_type='relu', name='relu2')
+    fc3 = mx.sym.FullyConnected(data=act2, num_hidden=NCLASS, name='fc3')
+    net = mx.sym.SVMOutput(data=fc3, name='svm',
+                           use_linear=args.use_linear)
+
+    mod = mx.mod.Module(symbol=net, context=mx.current_context(),
+                        label_names=('svm_label',))
+    mod.fit(train, eval_data=val, eval_metric='acc', optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9,
+                              'wd': 1e-4},
+            num_epoch=args.epochs)
+    score = dict(mod.score(val, ['acc']))
+    logging.info('validation acc %.3f', score['accuracy'])
+    assert score['accuracy'] >= args.min_acc, score
+    print('svm_mnist: acc=%.3f' % score['accuracy'])
+
+
+if __name__ == '__main__':
+    main()

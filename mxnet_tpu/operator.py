@@ -172,32 +172,98 @@ def custom_eager(*args, **kwargs):
                          aux=aux_nd)
 
 
-@_reg.register('Custom', variadic=True, key_var_num_args='num_args',
-               differentiable=False, train_aware=True)
-def _custom_fn(attrs, *arrays):
-    """Host-python bridge: executes the CustomOp eagerly via pure_callback
-    is NOT used — Custom ops run outside jit in the imperative path and in
-    the executor's staged mode (reference runs them on a dedicated thread,
-    custom.cc:380-405, ExecType::kLocal). Aux states here are per-call
-    buffers (trailing inputs persist only as executor-bound arrays; true
-    in-place aux mutation needs the eager path)."""
-    op_type = attrs['op_type']
+def _make_prop(attrs):
     prop_kwargs = {k: v for k, v in attrs.items()
                    if k not in _CUSTOM_RESERVED}
-    prop = _CUSTOM_OPS[op_type](**prop_kwargs)
-    in_all = [NDArray(a, None) for a in arrays]
+    return _CUSTOM_OPS[attrs['op_type']](**prop_kwargs)
+
+
+def _custom_shape(attrs, in_shapes):
+    """shape_fn for the traced executor path (host_bridge): delegate to
+    the prop's infer_shape callback (the reference routes
+    CustomOpProp::InferShape to the same python callbacks,
+    custom.cc:160-220). Trailing aux-state inputs are split off first,
+    mirroring _split_aux — infer_shape sees argument shapes only.
+    Output dtypes are reported as None ("same as input 0", the
+    CustomOpProp.infer_type default): shape_fn has no dtype
+    information, so props whose outputs change dtype relative to input
+    0 are only supported imperatively."""
+    prop = _make_prop(attrs)
+    shapes, _ = _split_aux(prop, list(in_shapes))
+    _, out_shapes, _ = prop.infer_shape([list(s) for s in shapes])
+    return [tuple(s) for s in out_shapes], [None] * len(out_shapes)
+
+
+# One CustomOp instance per graph node (keyed by the node's attrs dict,
+# which host_bridge passes identically to forward and backward): the
+# reference binds one operator per executor (custom.cc CreateOperatorEx),
+# and ops commonly stash forward state on `self` for backward. The attrs
+# tuple keeps a strong ref so the id can't be recycled.
+_OP_INSTANCES = {}
+
+
+def _node_operator(attrs, prop, shapes, in_types):
+    ent = _OP_INSTANCES.get(id(attrs))
+    if ent is not None and ent[0] is attrs:
+        return ent[1]
+    op = prop.create_operator(None, [tuple(s) for s in shapes], in_types)
+    _OP_INSTANCES[id(attrs)] = (attrs, op)
+    return op
+
+
+@_reg.register('Custom', variadic=True, key_var_num_args='num_args',
+               host=True, shape_fn=_custom_shape, train_aware=True)
+def _custom_fn(attrs, *arrays):
+    """Host-python bridge: under a traced executor this runs inside
+    jax.pure_callback (host_bridge — the reference's ExecType::kLocal,
+    custom.cc:380-405 runs the python callbacks on a dedicated thread
+    the same way). Aux states here are per-call buffers (trailing inputs
+    persist only as executor-bound arrays; true in-place aux mutation
+    needs the eager path)."""
+    import jax.numpy as jnp
+    prop = _make_prop(attrs)
+    in_all = [NDArray(jnp.asarray(a)) for a in arrays]
     inputs, aux_nd = _split_aux(prop, in_all)
     out_shapes, out_types, aux_nd, in_types, shapes = \
         _infer_and_alloc(prop, inputs, aux_nd)
     out_nd = [zeros(tuple(s), dtype=t)
               for s, t in zip(out_shapes, out_types)]
-    op = prop.create_operator(None, [tuple(s) for s in shapes], in_types)
+    op = _node_operator(attrs, prop, shapes, in_types)
     op.forward(is_train=attrs.get('__is_train__', False),
                req=['write'] * len(out_nd), in_data=inputs, out_data=out_nd,
                aux=aux_nd)
     if len(out_nd) == 1:
         return out_nd[0]._data
     return tuple(o._data for o in out_nd)
+
+
+def _custom_backward(attrs, gouts, ins, outs):
+    """legacy_backward hook (host_bridge custom_vjp): routes cotangents
+    through the user's CustomOp.backward (reference custom.cc backward
+    entry)."""
+    import jax.numpy as jnp
+    prop = _make_prop(attrs)
+    in_all = [NDArray(jnp.asarray(a)) for a in ins]
+    inputs, aux_nd = _split_aux(prop, in_all)
+    if aux_nd is None:
+        aux_nd = []
+    out_nd = [NDArray(jnp.asarray(o)) for o in outs]
+    gout_nd = [NDArray(jnp.asarray(g)) for g in gouts]
+    in_grad = [zeros(tuple(a.shape), dtype=a.dtype) for a in inputs]
+    op = _node_operator(attrs, prop, [tuple(a.shape) for a in inputs],
+                        [a.dtype for a in inputs])
+    op.backward(req=['write'] * len(in_grad), out_grad=gout_nd,
+                in_data=inputs, out_data=out_nd, in_grad=in_grad,
+                aux=aux_nd)
+    grads = [np.asarray(g.asnumpy(), dtype=np.asarray(i).dtype)
+             for g, i in zip(in_grad, ins)]
+    # aux inputs (if bound as trailing executor inputs) get zero grads
+    for extra in ins[len(grads):]:
+        grads.append(np.zeros_like(np.asarray(extra)))
+    return tuple(grads)
+
+
+_reg.get('Custom').legacy_backward = _custom_backward
 
 
 # ---------------------------------------------------------------------------

@@ -479,6 +479,37 @@ def _invoke_sym(op_name, input_syms, kwargs):
                 if nxt is not None:
                     merged.append(nxt)
         inputs = merged
+    elif op.variadic and named:
+        # keyword symbol inputs to a variadic op (the reference's Custom
+        # example style: mx.sym.Custom(data=..., label=..., op_type=...)).
+        # For Custom the prop declares the input order; otherwise keep
+        # keyword insertion order. Mixing positional and keyword symbol
+        # inputs is ambiguous for variable-length ops — reject it (the
+        # reference errors the same way, symbol.py _compose).
+        if inputs:
+            raise ValueError(
+                'operator %s takes variable-length inputs: pass symbol '
+                'inputs either all positionally or all by keyword, not '
+                'mixed' % op_name)
+        order = None
+        if op_name == 'Custom' and 'op_type' in kwargs:
+            from ..operator import _CUSTOM_OPS, _CUSTOM_RESERVED
+            prop_kwargs = {k: v for k, v in kwargs.items()
+                           if k not in _CUSTOM_RESERVED
+                           and k != op.key_var_num_args}
+            try:
+                prop = _CUSTOM_OPS[kwargs['op_type']](**prop_kwargs)
+                # aux states bind as trailing inputs (reference custom.cc
+                # input layout), so they belong in the keyword order too
+                order = list(prop.list_arguments()) + \
+                    list(prop.list_auxiliary_states())
+            except Exception:
+                order = None
+        if order:
+            inputs = inputs + [named[n] for n in order if n in named] + \
+                [v for k, v in named.items() if k not in order]
+        else:
+            inputs = inputs + list(named.values())
     if op.variadic and op.key_var_num_args and op.key_var_num_args not in kwargs:
         kwargs[op.key_var_num_args] = len(inputs)
     # auto-create missing trailing parameter variables (MXNet creates

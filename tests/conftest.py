@@ -16,16 +16,46 @@ import re
 _flags = os.environ.get('XLA_FLAGS', '')
 _flags = re.sub(r'--xla_force_host_platform_device_count=\d+', '', _flags)
 os.environ['XLA_FLAGS'] = (_flags + ' --xla_force_host_platform_device_count=8').strip()
-os.environ['JAX_PLATFORMS'] = 'cpu'
+
+# MXTPU_TEST_TPU=1 (the tests/tpu consistency tier) needs the real chip
+# AND the host cpu backend visible side by side; everything else pins the
+# virtual 8-device CPU mesh.
+_platforms = (os.environ.get('MXTPU_TEST_PLATFORMS', 'axon,cpu')
+              if os.environ.get('MXTPU_TEST_TPU') == '1' else 'cpu')
+if _platforms != 'cpu':
+    # probe the chip in a throwaway subprocess first: a wedged tunnel
+    # hangs backend init in-process for minutes and would kill the whole
+    # pytest session at conftest import instead of skipping the tier
+    import subprocess
+    import sys
+    try:
+        _ok = subprocess.run(
+            [sys.executable, '-c',
+             'import jax; assert any(d.platform == "tpu" '
+             'for d in jax.devices())'],
+            capture_output=True,
+            timeout=int(os.environ.get('MXTPU_TEST_TPU_PROBE_TIMEOUT',
+                                       '240'))).returncode == 0
+    except subprocess.TimeoutExpired:
+        _ok = False
+    if not _ok:
+        sys.stderr.write('[conftest] MXTPU_TEST_TPU=1 but the chip probe '
+                         'failed; falling back to the CPU mesh (tests/tpu '
+                         'will skip)\n')
+        _platforms = 'cpu'
+os.environ['JAX_PLATFORMS'] = _platforms
 
 import jax  # noqa: E402
 
-jax.config.update('jax_platforms', 'cpu')
+jax.config.update('jax_platforms', _platforms)
 # full-f32 matmul/conv so finite-difference gradient checks are meaningful
 # (the default bf16-grade MXU precision is what bench/production uses)
 jax.config.update('jax_default_matmul_precision', 'float32')
 
-assert len(jax.devices()) == 8, 'virtual 8-device CPU mesh failed to come up'
+if _platforms == 'cpu':
+    assert len(jax.devices()) == 8, 'virtual 8-device CPU mesh failed to come up'
+else:
+    assert len(jax.devices('cpu')) == 8, 'cpu mesh missing beside the chip'
 
 
 def pytest_configure(config):

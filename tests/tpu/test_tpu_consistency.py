@@ -5,7 +5,7 @@ cross-compare outputs and gradients via check_consistency).
 Gated behind MXTPU_TEST_TPU=1 because the default harness pins the
 virtual CPU mesh (tests/conftest.py) and the single real chip sits
 behind a tunnel that cannot be probed cheaply from a collection pass.
-Run manually on TPU hardware:
+Run manually on TPU hardware (tools/tpu_capture.sh does this):
 
     MXTPU_TEST_TPU=1 python -m pytest tests/tpu -q -p no:cacheprovider
 """
@@ -26,29 +26,130 @@ pytestmark = pytest.mark.skipif(
     reason='no TPU device')
 
 
-def _ctxs(shape):
-    return [{'ctx': mx.cpu(), 'data': shape, 'type_dict': {'data': np.float32}},
-            {'ctx': mx.tpu(), 'data': shape, 'type_dict': {'data': np.float32}}]
+def _ctxs(shapes, dtype=np.float32):
+    """cpu + tpu ctx specs for a dict of input shapes (fp32 on both)."""
+    td = {k: dtype for k in shapes}
+    return [dict(ctx=mx.cpu(), type_dict=dict(td), **shapes),
+            dict(ctx=mx.tpu(), type_dict=dict(td), **shapes)]
 
 
-def test_fc_consistency():
-    s = mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=8,
-                              name='fc')
-    check_consistency(s, _ctxs((4, 16)))
+def _v(name='data'):
+    return mx.sym.Variable(name)
 
 
-def test_conv_bn_relu_consistency():
-    d = mx.sym.Variable('data')
-    s = mx.sym.Convolution(d, kernel=(3, 3), num_filter=8, pad=(1, 1),
-                           name='c')
-    s = mx.sym.BatchNorm(s, name='bn')
-    s = mx.sym.Activation(s, act_type='relu')
-    check_consistency(s, _ctxs((2, 4, 8, 8)))
+# (id, symbol builder, input shapes, kwargs for check_consistency)
+SWEEP = [
+    ('fc', lambda: mx.sym.FullyConnected(_v(), num_hidden=8, name='fc'),
+     {'data': (4, 16)}, {}),
+    ('fc_no_bias', lambda: mx.sym.FullyConnected(_v(), num_hidden=8,
+                                                 no_bias=True, name='fc'),
+     {'data': (4, 16)}, {}),
+    ('conv_bn_relu', lambda: mx.sym.Activation(
+        mx.sym.BatchNorm(mx.sym.Convolution(
+            _v(), kernel=(3, 3), num_filter=8, pad=(1, 1), name='c'),
+            name='bn'), act_type='relu'),
+     {'data': (2, 4, 8, 8)}, {}),
+    ('conv_strided', lambda: mx.sym.Convolution(
+        _v(), kernel=(3, 3), num_filter=8, stride=(2, 2), name='c'),
+     {'data': (2, 4, 9, 9)}, {}),
+    ('conv_dilated', lambda: mx.sym.Convolution(
+        _v(), kernel=(3, 3), num_filter=8, dilate=(2, 2), pad=(2, 2),
+        name='c'),
+     {'data': (2, 4, 8, 8)}, {}),
+    ('conv_grouped', lambda: mx.sym.Convolution(
+        _v(), kernel=(3, 3), num_filter=8, num_group=4, pad=(1, 1),
+        name='c'),
+     {'data': (2, 8, 8, 8)}, {}),
+    ('conv1d', lambda: mx.sym.Convolution(
+        _v(), kernel=(3,), num_filter=8, pad=(1,), name='c'),
+     {'data': (2, 4, 16)}, {}),
+    ('deconv', lambda: mx.sym.Deconvolution(
+        _v(), kernel=(4, 4), num_filter=6, stride=(2, 2), pad=(1, 1),
+        name='dc'),
+     {'data': (2, 4, 7, 7)}, {}),
+    ('pool_max', lambda: mx.sym.Pooling(
+        _v(), kernel=(2, 2), stride=(2, 2), pool_type='max'),
+     {'data': (2, 3, 8, 8)}, {}),
+    ('pool_avg', lambda: mx.sym.Pooling(
+        _v(), kernel=(3, 3), stride=(2, 2), pad=(1, 1), pool_type='avg'),
+     {'data': (2, 3, 9, 9)}, {}),
+    ('pool_global', lambda: mx.sym.Pooling(
+        _v(), kernel=(1, 1), global_pool=True, pool_type='avg'),
+     {'data': (2, 3, 8, 8)}, {}),
+    ('softmax_out', lambda: mx.sym.SoftmaxOutput(
+        mx.sym.flatten(_v()), name='sm'),
+     {'data': (2, 3, 8, 8)}, {}),
+    ('log_softmax', lambda: mx.sym.log_softmax(_v(), axis=-1),
+     {'data': (4, 10)}, {}),
+    ('layernorm', lambda: mx.sym.LayerNorm(_v(), name='ln'),
+     {'data': (4, 16)}, {}),
+    ('instancenorm', lambda: mx.sym.InstanceNorm(_v(), name='in'),
+     {'data': (2, 4, 6, 6)}, {}),
+    ('l2norm', lambda: mx.sym.L2Normalization(_v()),
+     {'data': (4, 16)}, {}),
+    ('leaky_elu', lambda: mx.sym.LeakyReLU(_v(), act_type='elu'),
+     {'data': (4, 16)}, {}),
+    ('act_tanh_sigmoid', lambda: mx.sym.Activation(
+        mx.sym.Activation(_v(), act_type='tanh'), act_type='sigmoid'),
+     {'data': (4, 16)}, {}),
+    ('embedding', lambda: mx.sym.Embedding(
+        _v(), input_dim=20, output_dim=8, name='emb'),
+     {'data': (4, 6)}, {'grad_req': 'null'}),
+    ('batch_dot', lambda: mx.sym.batch_dot(
+        mx.sym.slice_axis(_v(), axis=1, begin=0, end=4),
+        mx.sym.slice_axis(_v(), axis=1, begin=4, end=8),
+        transpose_b=True),
+     {'data': (2, 8, 5)}, {}),
+    ('reduce_mix', lambda: mx.sym.sum(
+        mx.sym.mean(_v(), axis=2, keepdims=True), axis=1),
+     {'data': (3, 4, 5, 6)}, {}),
+    ('transpose_reshape', lambda: mx.sym.reshape(
+        mx.sym.transpose(_v(), axes=(0, 2, 3, 1)), shape=(0, -1)),
+     {'data': (2, 3, 4, 5)}, {}),
+    ('upsampling', lambda: mx.sym.UpSampling(
+        _v(), scale=2, sample_type='nearest'),
+     {'data': (2, 3, 5, 5)}, {}),
+    ('clip_abs', lambda: mx.sym.clip(mx.sym.abs(_v()), 0.1, 0.8),
+     {'data': (5, 3, 4)}, {}),
+    ('smooth_l1', lambda: mx.sym.smooth_l1(_v(), scalar=1.0),
+     {'data': (4, 9)}, {}),
+    ('topk_argmax', lambda: mx.sym.topk(_v(), k=3, axis=-1),
+     {'data': (4, 10)}, {'grad_req': 'null'}),
+    ('rnn_lstm', lambda: mx.sym.RNN(
+        _v(), state_size=8, num_layers=1, mode='lstm', name='rnn'),
+     {'data': (5, 2, 6)}, {'tol': {np.float32: 2e-3}}),
+    ('dot', lambda: mx.sym.dot(
+        mx.sym.slice_axis(_v(), axis=0, begin=0, end=4),
+        mx.sym.slice_axis(_v(), axis=0, begin=4, end=8),
+        transpose_b=True),
+     {'data': (8, 12)}, {}),
+]
 
 
-def test_pooling_softmax_consistency():
-    d = mx.sym.Variable('data')
-    s = mx.sym.Pooling(d, kernel=(2, 2), stride=(2, 2), pool_type='max')
-    s = mx.sym.flatten(s)
-    s = mx.sym.SoftmaxOutput(s, name='sm')
-    check_consistency(s, _ctxs((2, 3, 8, 8)))
+@pytest.mark.parametrize('name,build,shapes,kw',
+                         SWEEP, ids=[c[0] for c in SWEEP])
+def test_op_consistency(name, build, shapes, kw):
+    check_consistency(build(), _ctxs(shapes), **kw)
+
+
+# bf16-on-TPU vs fp32-on-CPU: the production mixed-precision numerics.
+BF16_SWEEP = ['fc', 'conv_bn_relu', 'pool_avg', 'layernorm', 'log_softmax']
+
+
+@pytest.mark.parametrize('name', BF16_SWEEP)
+def test_bf16_tpu_vs_fp32_cpu(name):
+    case = {c[0]: c for c in SWEEP}[name]
+    _, build, shapes, kw = case
+    import jax
+    import jax.numpy as jnp
+    ctxs = [dict(ctx=mx.cpu(),
+                 type_dict={k: np.float32 for k in shapes}, **shapes),
+            dict(ctx=mx.tpu(),
+                 type_dict={k: jnp.bfloat16 for k in shapes}, **shapes)]
+    kw = dict(kw)
+    kw.pop('tol', None)
+    # production bench/serving runs MXU-rate bf16 matmuls; the harness
+    # conftest forces full-f32 matmul precision for finite-difference
+    # tests, so undo it here to compare the real production numerics
+    with jax.default_matmul_precision('bfloat16'):
+        check_consistency(build(), ctxs, **kw)

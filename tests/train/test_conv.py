@@ -54,6 +54,7 @@ def _lenet_symbol():
 
 
 def _fit_and_score(sym, train, val, num_epoch, optimizer_params, flat):
+    mx.random.seed(7)   # deterministic init regardless of suite order
     mod = mx.module.Module(sym, context=mx.current_context())
     mod.fit(train, eval_data=val, num_epoch=num_epoch,
             optimizer='sgd', optimizer_params=optimizer_params,

@@ -46,6 +46,9 @@ CASES = [
     ('gluon/actor_critic.py', ['--episodes', '80', '--max-steps', '120',
                                '--target', '60']),
     ('cnn_text_classification/train.py', ['--epochs', '3']),
+    ('adversary/adversary_generation.py', ['--epochs', '8']),
+    ('numpy-ops/custom_softmax.py', ['--epochs', '8']),
+    ('svm_mnist/svm_mnist.py', ['--epochs', '10']),
 ]
 
 

@@ -200,3 +200,89 @@ def test_no_gradient_and_cross_device_copy():
         mx.nd._internal._CrossDeviceCopy(x).asnumpy(), [1., 2.])
     s = mx.nd._internal._broadcast_backward(mx.nd.ones((2, 3)), axis=0)
     np.testing.assert_array_equal(s.asnumpy(), [2., 2., 2.])
+
+
+def test_custom_symbolic_kwargs_and_traced_backward():
+    """mx.sym.Custom with keyword symbol inputs (reference
+    example/numpy-ops/custom_softmax.py style) composes in
+    list_arguments order and trains through the traced executor."""
+    class CESoftmax(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            x = in_data[0].asnumpy()
+            y = np.exp(x - x.max(axis=1, keepdims=True))
+            y /= y.sum(axis=1, keepdims=True)
+            self.assign(out_data[0], req[0], mx.nd.array(y))
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            lab = in_data[1].asnumpy().ravel().astype(np.int64)
+            y = out_data[0].asnumpy().copy()
+            y[np.arange(lab.shape[0]), lab] -= 1.0
+            self.assign(in_grad[0], req[0], mx.nd.array(y))
+
+    @mx.operator.register('t_ce_softmax')
+    class CEProp(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ['data', 'label']
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return CESoftmax()
+
+    d = mx.sym.Variable('data')
+    l = mx.sym.Variable('softmax_label')
+    fc = mx.sym.FullyConnected(d, num_hidden=3, name='fc')
+    # label= before data= on purpose: order must come from the prop,
+    # not keyword insertion
+    net = mx.sym.Custom(label=l, data=fc, op_type='t_ce_softmax',
+                        name='softmax')
+    assert net.list_arguments() == \
+        ['data', 'fc_weight', 'fc_bias', 'softmax_label']
+    exe = net.simple_bind(mx.cpu(), data=(6, 4), softmax_label=(6,))
+    rs = np.random.RandomState(0)
+    exe.arg_dict['data'][:] = rs.randn(6, 4)
+    exe.arg_dict['softmax_label'][:] = rs.randint(0, 3, 6)
+    exe.forward(is_train=True)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy().sum(axis=1),
+                               np.ones(6), rtol=1e-5)
+    exe.backward(exe.outputs)
+    # softmax CE gradient wrt fc weights must be nonzero
+    assert np.abs(exe.grad_dict['fc_weight'].asnumpy()).sum() > 0
+
+
+def test_custom_aux_states_symbolic_shape():
+    """shape inference slices trailing aux inputs off before calling the
+    prop's infer_shape (reference custom.cc input layout)."""
+    class MovAvg(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            self.assign(out_data[0], req[0], in_data[0])
+
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            self.assign(in_grad[0], req[0], out_grad[0])
+
+    @mx.operator.register('t_movavg')
+    class MovAvgProp(mx.operator.CustomOpProp):
+        def list_arguments(self):
+            return ['data']
+
+        def list_auxiliary_states(self):
+            return ['hist']
+
+        def infer_shape(self, in_shape):
+            data, = in_shape  # must receive argument shapes only
+            return [data], [data], [data]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return MovAvg()
+
+    d = mx.sym.Variable('data')
+    h = mx.sym.Variable('hist')
+    net = mx.sym.Custom(hist=h, data=d, op_type='t_movavg', name='ma')
+    exe = net.simple_bind(mx.cpu(), data=(3, 2), hist=(3, 2))
+    exe.arg_dict['data'][:] = np.ones((3, 2))
+    exe.forward(is_train=False)
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), np.ones((3, 2)))
