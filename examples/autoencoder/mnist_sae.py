@@ -1,0 +1,116 @@
+"""Stacked (denoising) autoencoder — reference example/autoencoder/
+mnist_sae.py + autoencoder.py/model.py: greedy layer-wise pretraining of
+each encoder/decoder pair, then end-to-end fine-tuning, scored by
+reconstruction MSE. Hermetic: band-limited synthetic images stand in
+for MNIST so the low-dimensional code is exactly learnable.
+
+    python mnist_sae.py --pretrain-epochs 6 --finetune-epochs 8
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+DIM = 64 * 4  # 16x16 images, flattened
+
+
+def images(rng, n):
+    """Low-rank images: random mixtures of 8 fixed smooth basis images."""
+    yy, xx = np.meshgrid(np.linspace(0, 1, 16), np.linspace(0, 1, 16),
+                         indexing='ij')
+    basis = [np.sin(2 * np.pi * (fx * xx + fy * yy))
+             for fx, fy in [(1, 0), (0, 1), (1, 1), (2, 0),
+                            (0, 2), (2, 1), (1, 2), (2, 2)]]
+    basis = np.stack([b.ravel() for b in basis])          # (8, 256)
+    codes = rng.randn(n, 8).astype(np.float32)
+    x = codes @ basis.astype(np.float32)
+    return (x / np.abs(x).max()).astype(np.float32)
+
+
+class AELayer(gluon.Block):
+    def __init__(self, n_in, n_hidden, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.enc = nn.Dense(n_hidden, activation='tanh', in_units=n_in)
+            self.dec = nn.Dense(n_in, in_units=n_hidden)
+
+    def forward(self, x):
+        return self.dec(self.enc(x))
+
+
+def train(block, forward, x, epochs, lr, rng, noise=0.0, tag=''):
+    trainer = gluon.Trainer(block.collect_params(), 'adam',
+                            {'learning_rate': lr})
+    loss_fn = gluon.loss.L2Loss()
+    n = len(x)
+    for epoch in range(epochs):
+        perm = rng.permutation(n)
+        tot = 0.0
+        for i in range(0, n, 64):
+            idx = perm[i:i + 64]
+            clean = mx.nd.array(x[idx])
+            noisy = clean
+            if noise:
+                noisy = clean + noise * mx.nd.array(
+                    rng.randn(*clean.shape).astype(np.float32))
+            with autograd.record():
+                loss = loss_fn(forward(noisy), clean)
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.mean().asscalar()) * len(idx)
+        logging.info('%s epoch %d loss %.5f', tag, epoch, tot / n)
+    return tot / n
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--pretrain-epochs', type=int, default=6)
+    ap.add_argument('--finetune-epochs', type=int, default=8)
+    ap.add_argument('--samples', type=int, default=768)
+    ap.add_argument('--lr', type=float, default=2e-3)
+    ap.add_argument('--max-mse', type=float, default=0.01,
+                    help='required final reconstruction L2Loss')
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(5)
+    x = images(rng, args.samples)
+
+    l1 = AELayer(DIM, 64)
+    l2 = AELayer(64, 16)
+    for layer in (l1, l2):
+        layer.initialize(mx.init.Xavier())
+
+    # greedy layer-wise pretraining (reference model.py layerwise loop)
+    train(l1, lambda v: l1(v), x, args.pretrain_epochs, args.lr, rng,
+          noise=0.1, tag='pretrain-l1')
+    h = l1.enc(mx.nd.array(x)).asnumpy()
+    train(l2, lambda v: l2(v), h, args.pretrain_epochs, args.lr, rng,
+          noise=0.1, tag='pretrain-l2')
+
+    # end-to-end fine-tune of the unrolled stack
+    class Stack(gluon.Block):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            self.l1, self.l2 = l1, l2
+
+        def forward(self, v):
+            return self.l1.dec(self.l2(self.l1.enc(v)))
+
+    stack = Stack()
+    final = train(stack, stack, x, args.finetune_epochs, args.lr, rng,
+                  tag='finetune')
+    assert final < args.max_mse, 'reconstruction too lossy: %.5f' % final
+    print('mnist_sae: final_mse=%.5f' % final)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,91 @@
+"""Sorting with a bidirectional LSTM — reference example/bi-lstm-sort/
+lstm_sort.py: read a sequence of tokens and emit the same tokens in
+sorted order, one output per position, trained with per-step softmax.
+The bidirectional encoding is what makes position-wise sorting
+learnable (each step must see the whole sequence).
+
+    python lstm_sort.py --epochs 20
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, rnn
+
+VOCAB = 20
+SEQ = 6
+
+
+class SortNet(gluon.Block):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.embed = nn.Embedding(VOCAB, 16)
+            self.lstm = rnn.LSTM(64, num_layers=2, bidirectional=True)
+            self.out = nn.Dense(VOCAB, flatten=False)
+
+    def forward(self, x):          # (T, N) int tokens
+        h = self.lstm(self.embed(x))
+        return self.out(h)         # (T, N, VOCAB)
+
+
+def batches(rng, n):
+    x = rng.randint(0, VOCAB, size=(n, SEQ))
+    y = np.sort(x, axis=1)
+    return x.astype(np.float32), y.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=20)
+    ap.add_argument('--samples', type=int, default=2048)
+    ap.add_argument('--batch-size', type=int, default=128)
+    ap.add_argument('--lr', type=float, default=5e-3)
+    ap.add_argument('--min-acc', type=float, default=0.9,
+                    help='per-position accuracy floor on held-out data')
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(3)
+
+    rng = np.random.RandomState(8)
+    xtr, ytr = batches(rng, args.samples)
+    xte, yte = batches(rng, args.samples // 8)
+
+    net = SortNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(xtr))
+        tot = 0.0
+        for i in range(0, len(xtr), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data = mx.nd.array(xtr[idx].T)          # (T, N)
+            lab = mx.nd.array(ytr[idx].T)           # (T, N)
+            with autograd.record():
+                logits = net(data)                  # (T, N, V)
+                loss = loss_fn(logits.reshape((-1, VOCAB)),
+                               lab.reshape((-1,)))
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.mean().asscalar()) * len(idx)
+        logging.info('epoch %d loss %.4f', epoch, tot / len(xtr))
+
+    pred = net(mx.nd.array(xte.T)).asnumpy().argmax(axis=-1)   # (T, N)
+    acc = float((pred.T == yte).mean())
+    logging.info('per-position sort accuracy %.3f', acc)
+    assert acc >= args.min_acc, 'sorting failed: %.3f' % acc
+    print('lstm_sort: acc=%.3f' % acc)
+
+
+if __name__ == '__main__':
+    main()

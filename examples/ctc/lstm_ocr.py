@@ -1,0 +1,118 @@
+"""LSTM + CTC sequence recognition — reference example/ctc/lstm_ocr.py
+(warp-ctc captcha OCR): an LSTM reads image columns and CTC aligns the
+per-column predictions to an unsegmented digit-sequence label.
+Hermetic: each digit is a fixed random glyph of 3 columns, sequences
+vary in length 3-5, rendered with jitter; greedy CTC decode is scored
+by full-sequence match.
+
+    python lstm_ocr.py --epochs 25
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn, rnn
+
+NDIGIT = 10          # alphabet 1..10, blank 0
+GLYPH_W = 3          # columns per glyph
+H = 12               # rows per column
+MAXLEN = 5
+T = MAXLEN * GLYPH_W + 2
+
+
+class OCRNet(gluon.Block):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.lstm = rnn.LSTM(48, num_layers=1, bidirectional=True)
+            self.fc = nn.Dense(NDIGIT + 1, flatten=False)
+
+    def forward(self, x):          # x: (T, N, H)
+        return self.fc(self.lstm(x))   # (T, N, NDIGIT+1)
+
+
+def make_data(rng, n, glyphs):
+    xs = np.zeros((n, T, H), np.float32)
+    labels = np.full((n, MAXLEN), -1, np.float32)
+    for i in range(n):
+        k = rng.randint(3, MAXLEN + 1)
+        digits = rng.randint(0, NDIGIT, k)
+        col = 1
+        for j, d in enumerate(digits):
+            xs[i, col:col + GLYPH_W] = glyphs[d]
+            col += GLYPH_W
+            labels[i, j] = d + 1          # 0 is the CTC blank
+        xs[i] += 0.1 * rng.randn(T, H)
+    return xs, labels
+
+
+def greedy_decode(logits):
+    """Collapse repeats then drop blanks (standard CTC greedy path)."""
+    best = logits.argmax(axis=-1)         # (T, N)
+    out = []
+    for n in range(best.shape[1]):
+        seq, prev = [], 0
+        for t in range(best.shape[0]):
+            c = int(best[t, n])
+            if c != 0 and c != prev:
+                seq.append(c)
+            prev = c
+        out.append(seq)
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=25)
+    ap.add_argument('--samples', type=int, default=384)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--lr', type=float, default=1e-2)
+    ap.add_argument('--min-seq-acc', type=float, default=0.85)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(1)
+
+    rng = np.random.RandomState(2)
+    glyphs = rng.randn(NDIGIT, GLYPH_W, H).astype(np.float32)
+    xs, labels = make_data(rng, args.samples, glyphs)
+    xte, lte = make_data(rng, args.samples // 4, glyphs)
+
+    net = OCRNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    # TNC layout straight out of the LSTM; padding_mask -1
+    ctc = gluon.loss.CTCLoss(layout='TNC', label_layout='NT')
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(xs))
+        tot = 0.0
+        for i in range(0, len(xs), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data = mx.nd.array(xs[idx].transpose(1, 0, 2))   # (T,N,H)
+            lab = mx.nd.array(labels[idx])
+            with autograd.record():
+                loss = ctc(net(data), lab).mean()
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.asscalar()) * len(idx)
+        logging.info('epoch %d ctc loss %.4f', epoch, tot / len(xs))
+
+    logits = net(mx.nd.array(xte.transpose(1, 0, 2))).asnumpy()
+    decoded = greedy_decode(logits)
+    truth = [[int(v) for v in row if v > 0] for row in lte]
+    acc = float(np.mean([d == t for d, t in zip(decoded, truth)]))
+    logging.info('sequence accuracy %.3f', acc)
+    assert acc >= args.min_seq_acc, 'CTC OCR failed: seq acc %.3f' % acc
+    print('lstm_ocr: seq_acc=%.3f' % acc)
+
+
+if __name__ == '__main__':
+    main()

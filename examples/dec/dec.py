@@ -1,0 +1,145 @@
+"""Deep Embedded Clustering — reference example/dec/dec.py (Xie et al.
+2016): pretrain an autoencoder, initialize cluster centroids with
+k-means in code space, then refine encoder + centroids against the
+sharpened auxiliary target distribution (KL self-training). Hermetic:
+Gaussian clusters embedded through a fixed nonlinear map, so the true
+partition is recoverable.
+
+    python dec.py --pretrain-epochs 10 --dec-iters 60
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+DIM = 48
+NCLUST = 4
+NZ = 6
+
+
+def cluster_acc(pred, truth):
+    """Best-matching assignment accuracy (Hungarian-lite: greedy works
+    for well-separated synthetic clusters)."""
+    remaining = set(range(NCLUST))
+    total = 0
+    for c in range(NCLUST):
+        best, best_n = None, -1
+        for t in remaining:
+            n = int(((pred == c) & (truth == t)).sum())
+            if n > best_n:
+                best, best_n = t, n
+        remaining.discard(best)
+        total += best_n
+    return total / len(pred)
+
+
+def make_data(rng, n):
+    centers = rng.randn(NCLUST, NZ).astype(np.float32) * 3.0
+    lab = rng.randint(0, NCLUST, n)
+    z = centers[lab] + 0.4 * rng.randn(n, NZ).astype(np.float32)
+    mix = rng.randn(NZ, DIM).astype(np.float32)
+    x = np.tanh(z @ mix) + 0.05 * rng.randn(n, DIM).astype(np.float32)
+    return x.astype(np.float32), lab
+
+
+def kmeans(z, k, rng, iters=20):
+    cent = z[rng.choice(len(z), k, replace=False)].copy()
+    for _ in range(iters):
+        d = ((z[:, None] - cent[None]) ** 2).sum(-1)
+        a = d.argmin(1)
+        for c in range(k):
+            if (a == c).any():
+                cent[c] = z[a == c].mean(0)
+    return cent
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--pretrain-epochs', type=int, default=10)
+    ap.add_argument('--dec-iters', type=int, default=60)
+    ap.add_argument('--samples', type=int, default=768)
+    ap.add_argument('--lr', type=float, default=2e-3)
+    ap.add_argument('--min-acc', type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(6)
+
+    rng = np.random.RandomState(17)
+    x, truth = make_data(rng, args.samples)
+
+    enc = nn.Sequential()
+    dec_net = nn.Sequential()
+    with enc.name_scope():
+        enc.add(nn.Dense(32, activation='tanh'), nn.Dense(NZ))
+    with dec_net.name_scope():
+        dec_net.add(nn.Dense(32, activation='tanh'), nn.Dense(DIM))
+    enc.initialize(mx.init.Xavier())
+    dec_net.initialize(mx.init.Xavier())
+
+    # --- autoencoder pretraining
+    params = list(enc.collect_params().values()) + \
+        list(dec_net.collect_params().values())
+    trainer = gluon.Trainer(enc.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    trainer2 = gluon.Trainer(dec_net.collect_params(), 'adam',
+                             {'learning_rate': args.lr})
+    l2 = gluon.loss.L2Loss()
+    for epoch in range(args.pretrain_epochs):
+        perm = rng.permutation(len(x))
+        tot = 0.0
+        for i in range(0, len(x), 64):
+            data = mx.nd.array(x[perm[i:i + 64]])
+            with autograd.record():
+                loss = l2(dec_net(enc(data)), data)
+            loss.backward()
+            trainer.step(data.shape[0])
+            trainer2.step(data.shape[0])
+            tot += float(loss.mean().asscalar()) * data.shape[0]
+        logging.info('pretrain epoch %d mse %.5f', epoch, tot / len(x))
+
+    # --- centroid init by k-means in code space
+    z = enc(mx.nd.array(x)).asnumpy()
+    centroids = mx.nd.array(kmeans(z, NCLUST, rng))
+    centroids.attach_grad()
+
+    def soft_assign(z_nd):
+        """Student-t similarity (DEC eq. 1)."""
+        d2 = ((z_nd.expand_dims(1) - centroids.expand_dims(0)) ** 2).sum(-1)
+        q = 1.0 / (1.0 + d2)
+        return q / q.sum(axis=1, keepdims=True)
+
+    # --- DEC refinement: KL(p || q) with sharpened targets
+    for it in range(args.dec_iters):
+        data = mx.nd.array(x)
+        qn = soft_assign(enc(data))
+        p = (qn ** 2 / qn.sum(axis=0, keepdims=True)).asnumpy()
+        p = mx.nd.array(p / p.sum(axis=1, keepdims=True))
+        with autograd.record():
+            q = soft_assign(enc(data))
+            kl = (p * ((p + 1e-10).log() - (q + 1e-10).log())).sum(axis=1)
+            loss = kl.mean()
+        loss.backward()
+        trainer.step(len(x))
+        centroids -= args.lr * 10 * centroids.grad
+        if it % 15 == 0:
+            pred = q.asnumpy().argmax(1)
+            logging.info('dec iter %d kl %.5f acc %.3f', it,
+                         float(loss.asscalar()), cluster_acc(pred, truth))
+
+    pred = soft_assign(enc(mx.nd.array(x))).asnumpy().argmax(1)
+    acc = cluster_acc(pred, truth)
+    logging.info('final cluster accuracy %.3f', acc)
+    assert acc >= args.min_acc, 'DEC failed: %.3f' % acc
+    print('dec: cluster_acc=%.3f' % acc)
+
+
+if __name__ == '__main__':
+    main()

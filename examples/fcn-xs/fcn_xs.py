@@ -1,0 +1,103 @@
+"""FCN semantic segmentation — reference example/fcn-xs/fcn_xs.py +
+symbol_fcnxs.py: a conv encoder downsamples, a 1x1 score head predicts
+per-class maps, and a transposed convolution upsamples back to
+per-pixel predictions (the FCN-32s/16s/8s pattern, compressed).
+Hermetic: images contain bright geometric blobs on noise; the task is
+pixel-wise blob-vs-background labeling.
+
+    python fcn_xs.py --epochs 12
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+NCLASS = 2
+HW = 24
+
+
+class FCN(gluon.Block):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.c1 = nn.Conv2D(16, 3, padding=1, activation='relu')
+            self.p1 = nn.MaxPool2D(2)                      # /2
+            self.c2 = nn.Conv2D(32, 3, padding=1, activation='relu')
+            self.p2 = nn.MaxPool2D(2)                      # /4
+            self.score = nn.Conv2D(NCLASS, 1)              # 1x1 head
+            self.up = nn.Conv2DTranspose(NCLASS, 8, strides=4,
+                                         padding=2)        # x4 back
+
+    def forward(self, x):
+        h = self.p2(self.c2(self.p1(self.c1(x))))
+        return self.up(self.score(h))      # (N, NCLASS, HW, HW)
+
+
+def blobs(rng, n):
+    x = 0.3 * rng.randn(n, 1, HW, HW).astype(np.float32)
+    y = np.zeros((n, HW, HW), np.float32)
+    for i in range(n):
+        for _ in range(rng.randint(1, 3)):
+            cy, cx = rng.randint(4, HW - 4, 2)
+            r = rng.randint(2, 5)
+            yy, xx = np.ogrid[:HW, :HW]
+            mask = (yy - cy) ** 2 + (xx - cx) ** 2 <= r * r
+            x[i, 0][mask] += 2.0
+            y[i][mask] = 1.0
+    return x, y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=12)
+    ap.add_argument('--samples', type=int, default=384)
+    ap.add_argument('--batch-size', type=int, default=32)
+    ap.add_argument('--lr', type=float, default=2e-3)
+    ap.add_argument('--min-iou', type=float, default=0.6)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(4)
+
+    rng = np.random.RandomState(14)
+    xtr, ytr = blobs(rng, args.samples)
+    xte, yte = blobs(rng, args.samples // 4)
+
+    net = FCN()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    # per-pixel softmax CE (reference uses SoftmaxOutput multi_output)
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss(axis=1)
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(xtr))
+        tot = 0.0
+        for i in range(0, len(xtr), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data, lab = mx.nd.array(xtr[idx]), mx.nd.array(ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(data), lab)
+            loss.backward()
+            trainer.step(len(idx))
+            tot += float(loss.mean().asscalar()) * len(idx)
+        logging.info('epoch %d loss %.4f', epoch, tot / len(xtr))
+
+    pred = net(mx.nd.array(xte)).asnumpy().argmax(axis=1)
+    inter = float(np.logical_and(pred == 1, yte == 1).sum())
+    union = float(np.logical_or(pred == 1, yte == 1).sum())
+    iou = inter / max(union, 1.0)
+    logging.info('foreground IoU %.3f', iou)
+    assert iou >= args.min_iou, 'segmentation failed: IoU %.3f' % iou
+    print('fcn_xs: iou=%.3f' % iou)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,101 @@
+"""Multi-task training — reference example/multi-task/example_multi_task.py:
+one shared trunk with two softmax heads (digit class + a derived binary
+task), trained jointly through a Group symbol with a per-head accuracy
+metric. Hermetic blobs stand in for MNIST; task 2 is parity of the
+class index.
+
+    python example_multi_task.py --epochs 10
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+NCLASS = 10
+DIM = 32
+
+
+def build_network():
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data=data, num_hidden=64, name='fc1')
+    act1 = mx.sym.Activation(data=fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(data=act1, num_hidden=NCLASS, name='fc2')
+    sm1 = mx.sym.SoftmaxOutput(data=fc2, name='softmax1')
+    fc3 = mx.sym.FullyConnected(data=act1, num_hidden=2, name='fc3')
+    sm2 = mx.sym.SoftmaxOutput(data=fc3, name='softmax2')
+    return mx.sym.Group([sm1, sm2])
+
+
+class MultiAccuracy(mx.metric.EvalMetric):
+    """Reference example_multi_task.py Multi_Accuracy: one accuracy
+    per output head."""
+
+    def __init__(self, num=2):
+        self.num = num
+        super().__init__('multi-accuracy')
+
+    def reset(self):
+        self.num_inst = [0] * self.num
+        self.sum_metric = [0.0] * self.num
+
+    def update(self, labels, preds):
+        for i in range(self.num):
+            pred = preds[i].asnumpy().argmax(axis=1)
+            lab = labels[i].asnumpy().astype(np.int64).ravel()
+            self.sum_metric[i] += (pred == lab).sum()
+            self.num_inst[i] += len(lab)
+
+    def get(self):
+        accs = [s / max(n, 1)
+                for s, n in zip(self.sum_metric, self.num_inst)]
+        return (['task%d-acc' % i for i in range(self.num)], accs)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=10)
+    ap.add_argument('--samples', type=int, default=640)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--lr', type=float, default=0.1)
+    ap.add_argument('--min-acc', type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(4)
+    centers = rng.randn(NCLASS, DIM).astype(np.float32) * 2.0
+    lab = rng.randint(0, NCLASS, args.samples)
+    x = (centers[lab] + 0.4 * rng.randn(args.samples, DIM)).astype(np.float32)
+    y1 = lab.astype(np.float32)
+    y2 = (lab % 2).astype(np.float32)
+
+    train = mx.io.NDArrayIter(x, {'softmax1_label': y1,
+                                  'softmax2_label': y2},
+                              args.batch_size, shuffle=True)
+
+    mod = mx.mod.Module(build_network(),
+                        label_names=('softmax1_label', 'softmax2_label'))
+    metric = MultiAccuracy()
+    mod.fit(train, eval_metric=metric, optimizer='sgd',
+            optimizer_params={'learning_rate': args.lr, 'momentum': 0.9},
+            num_epoch=args.epochs)
+
+    metric.reset()
+    train.reset()
+    for batch in train:
+        mod.forward(batch, is_train=False)
+        metric.update(batch.label, mod.get_outputs())
+    names, accs = metric.get()
+    logging.info('final %s', dict(zip(names, accs)))
+    assert all(a >= args.min_acc for a in accs), dict(zip(names, accs))
+    print('multi_task: ' +
+          ' '.join('%s=%.3f' % (n, a) for n, a in zip(names, accs)))
+
+
+if __name__ == '__main__':
+    main()

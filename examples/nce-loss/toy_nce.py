@@ -1,0 +1,106 @@
+"""Noise-contrastive estimation — reference example/nce-loss/toy_nce.py:
+train a many-class softmax-like model with NCE (binary logistic
+discrimination of the true class against k sampled noise classes)
+instead of a full softmax, then verify the full-softmax accuracy the
+cheap objective induces.
+
+    python toy_nce.py --epochs 15
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+NCLASS = 256      # large output vocabulary (what makes NCE worth it)
+DIM = 32
+K = 8             # noise samples per example
+
+
+class NCEModel(gluon.Block):
+    """Feature trunk + per-class output embeddings and biases; NCE
+    scores are dot(feature, class_embedding) + bias for just the
+    sampled classes (reference nce.py nce_loss structure)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.trunk = nn.Dense(64, activation='relu')
+            self.feat = nn.Dense(32)
+            self.class_embed = nn.Embedding(NCLASS, 32)
+            self.class_bias = nn.Embedding(NCLASS, 1)
+
+    def score(self, x, classes):
+        """classes: (N, 1+K) int — scores for true + noise classes."""
+        f = self.feat(self.trunk(x))                    # (N, 32)
+        w = self.class_embed(classes)                   # (N, 1+K, 32)
+        b = self.class_bias(classes).reshape((0, -1))   # (N, 1+K)
+        return (w * f.expand_dims(axis=1)).sum(axis=-1) + b
+
+    def full_scores(self, x):
+        f = self.feat(self.trunk(x))                    # (N, 32)
+        allw = self.class_embed.weight.data()           # (C, 32)
+        allb = self.class_bias.weight.data().reshape((-1,))
+        return mx.nd.dot(f, allw.T) + allb
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=15)
+    ap.add_argument('--samples', type=int, default=2048)
+    ap.add_argument('--batch-size', type=int, default=128)
+    ap.add_argument('--lr', type=float, default=5e-3)
+    ap.add_argument('--min-acc', type=float, default=0.8)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(5)
+
+    rng = np.random.RandomState(6)
+    centers = rng.randn(NCLASS, DIM).astype(np.float32) * 2.0
+    lab = rng.randint(0, NCLASS, args.samples)
+    x = (centers[lab] + 0.3 * rng.randn(args.samples, DIM)).astype(np.float32)
+    xte_lab = rng.randint(0, NCLASS, 512)
+    xte = (centers[xte_lab] + 0.3 * rng.randn(512, DIM)).astype(np.float32)
+
+    net = NCEModel()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    bce = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(x))
+        tot = 0.0
+        for i in range(0, len(x), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            n = len(idx)
+            noise = rng.randint(0, NCLASS, size=(n, K))
+            classes = np.concatenate([lab[idx][:, None], noise], axis=1)
+            target = np.zeros((n, 1 + K), np.float32)
+            target[:, 0] = 1.0
+            data = mx.nd.array(x[idx])
+            cls = mx.nd.array(classes.astype(np.float32))
+            with autograd.record():
+                scores = net.score(data, cls)
+                loss = bce(scores, mx.nd.array(target))
+            loss.backward()
+            trainer.step(n)
+            tot += float(loss.mean().asscalar()) * n
+        logging.info('epoch %d nce loss %.4f', epoch, tot / len(x))
+
+    pred = net.full_scores(mx.nd.array(xte)).asnumpy().argmax(axis=1)
+    acc = float((pred == xte_lab).mean())
+    logging.info('full-softmax accuracy from NCE training: %.3f', acc)
+    assert acc >= args.min_acc, 'NCE training failed: %.3f' % acc
+    print('toy_nce: acc=%.3f' % acc)
+
+
+if __name__ == '__main__':
+    main()

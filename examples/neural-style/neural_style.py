@@ -1,0 +1,116 @@
+"""Neural style transfer — reference example/neural-style/nstyle.py
+(Gatys et al.): optimize the pixels of an image so a conv net's deep
+features match a content image while the Gram matrices of shallower
+features match a style image. Hermetic: the feature extractor is a
+fixed random conv stack (style transfer needs fixed features, not
+trained ones) and content/style images are synthetic textures.
+
+    python neural_style.py --steps 150
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+HW = 32
+
+
+class Features(gluon.Block):
+    """Fixed random conv stack; returns (style_feats, content_feat)."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.c1 = nn.Conv2D(8, 3, padding=1, activation='relu')
+            self.c2 = nn.Conv2D(16, 3, strides=2, padding=1,
+                                activation='relu')
+            self.c3 = nn.Conv2D(32, 3, strides=2, padding=1,
+                                activation='relu')
+
+    def forward(self, x):
+        f1 = self.c1(x)
+        f2 = self.c2(f1)
+        f3 = self.c3(f2)
+        return [f1, f2], f3
+
+
+def gram(f):
+    """Channel co-occurrence matrix (style representation)."""
+    b, c, h, w = f.shape
+    m = f.reshape((c, h * w))
+    return mx.nd.dot(m, m.T) / (c * h * w)
+
+
+def texture(rng, freq):
+    yy, xx = np.meshgrid(np.linspace(0, 1, HW), np.linspace(0, 1, HW),
+                         indexing='ij')
+    img = np.zeros((HW, HW), np.float32)
+    for _ in range(4):
+        fy, fx = rng.rand(2) * freq
+        img += np.sin(2 * np.pi * (fy * yy + fx * xx) + rng.rand() * 6.28)
+    return img[None, None].astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=150)
+    ap.add_argument('--lr', type=float, default=0.05)
+    ap.add_argument('--style-weight', type=float, default=50.0)
+    ap.add_argument('--min-drop', type=float, default=0.8,
+                    help='required relative total-loss drop')
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(11)
+
+    rng = np.random.RandomState(21)
+    content_img = mx.nd.array(texture(rng, 2.0))   # low-freq "photo"
+    style_img = mx.nd.array(texture(rng, 8.0))     # high-freq "painting"
+
+    net = Features()
+    net.initialize(mx.init.Xavier())               # fixed random weights
+
+    style_feats, _ = net(style_img)
+    style_grams = [gram(f) for f in style_feats]
+    _, content_feat = net(content_img)
+
+    img = content_img.copy() + 0.1 * mx.nd.random.normal(
+        shape=content_img.shape)
+    img.attach_grad()
+    trainer_like_lr = args.lr
+
+    first = last = None
+    for step in range(args.steps):
+        with autograd.record():
+            feats, cfeat = net(img)
+            content_loss = ((cfeat - content_feat) ** 2).mean()
+            style_loss = sum(((gram(f) - g) ** 2).sum()
+                             for f, g in zip(feats, style_grams))
+            loss = content_loss + args.style_weight * style_loss
+        loss.backward()
+        img -= trainer_like_lr * img.grad / \
+            (mx.nd.abs(img.grad).mean() + 1e-8)    # normalized GD (ref trick)
+        v = float(loss.asscalar())
+        if first is None:
+            first = v
+        last = v
+        if step % 25 == 0:
+            logging.info('step %d loss %.5f (content %.5f style %.5f)',
+                         step, v, float(content_loss.asscalar()),
+                         float(style_loss.asscalar()))
+
+    drop = 1.0 - last / first
+    logging.info('loss %.5f -> %.5f (drop %.1f%%)', first, last, 100 * drop)
+    assert drop >= args.min_drop, 'style optimization stalled: %.3f' % drop
+    print('neural_style: loss_drop=%.3f' % drop)
+
+
+if __name__ == '__main__':
+    main()

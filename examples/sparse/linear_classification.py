@@ -1,0 +1,99 @@
+"""Sparse linear classification — reference example/sparse/
+linear_classification.py: logistic regression over high-dimensional
+sparse features fed by LibSVMIter (CSR batches), weights updated through
+the transposed sparse dot. Hermetic: a synthetic bag-of-words-style
+libsvm file (few active features per sample, labels from a sparse
+ground-truth weight vector) is generated on the fly.
+
+    python linear_classification.py --epochs 12
+"""
+import argparse
+import logging
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+NFEAT = 400
+NNZ = 12  # active features per sample
+
+
+def write_libsvm(path, rng, n, w_true):
+    with open(path, 'w') as f:
+        for _ in range(n):
+            cols = np.sort(rng.choice(NFEAT, NNZ, replace=False))
+            vals = rng.rand(NNZ).astype(np.float32) + 0.5
+            y = 1 if vals @ w_true[cols] > 0 else 0
+            f.write('%d %s\n' % (y, ' '.join(
+                '%d:%.4f' % (c, v) for c, v in zip(cols, vals))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=15)
+    ap.add_argument('--samples', type=int, default=4096)
+    ap.add_argument('--batch-size', type=int, default=128)
+    ap.add_argument('--lr', type=float, default=1.0)
+    ap.add_argument('--min-acc', type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    rng = np.random.RandomState(12)
+    w_true = rng.randn(NFEAT).astype(np.float32)
+    tmp = tempfile.mkdtemp()
+    train_svm = os.path.join(tmp, 'train.libsvm')
+    test_svm = os.path.join(tmp, 'test.libsvm')
+    write_libsvm(train_svm, rng, args.samples, w_true)
+    write_libsvm(test_svm, rng, args.samples // 4, w_true)
+
+    train = mx.io.LibSVMIter(data_libsvm=train_svm, data_shape=(NFEAT,),
+                             batch_size=args.batch_size)
+
+    w = mx.nd.zeros((NFEAT, 1))
+    b = mx.nd.zeros((1,))
+    for epoch in range(args.epochs):
+        train.reset()
+        tot, seen = 0.0, 0
+        for batch in train:
+            data, lab = batch.data[0], batch.label[0]
+            n = data.shape[0]
+            z = mx.nd.sparse.dot(data, w).reshape((-1,)) + b
+            p = 1.0 / (1.0 + (-z).exp())
+            err = p - lab
+            # logistic-loss gradient via the transposed sparse dot
+            # (a RowSparseNDArray, like the reference's sparse grads)
+            gw = (1.0 / n) * mx.nd.sparse.dot(data, err.reshape((-1, 1)),
+                                              transpose_a=True)
+            gb = err.mean()
+            w = w - (args.lr * gw).tostype('default')
+            b -= args.lr * gb
+            eps = 1e-7
+            tot += float((-(lab * (p + eps).log() +
+                            (1 - lab) * (1 - p + eps).log())).sum().asscalar())
+            seen += n
+        logging.info('epoch %d logloss %.4f', epoch, tot / seen)
+
+    test = mx.io.LibSVMIter(data_libsvm=test_svm, data_shape=(NFEAT,),
+                            batch_size=args.batch_size, round_batch=False)
+    correct = total = 0
+    for batch in test:
+        z = mx.nd.sparse.dot(batch.data[0], w).reshape((-1,)) + b
+        pred = z.asnumpy() > 0
+        lab = batch.label[0].asnumpy() > 0.5
+        pad = getattr(batch, 'pad', 0) or 0
+        n = len(lab) - pad
+        correct += (pred[:n] == lab[:n]).sum()
+        total += n
+    acc = correct / max(total, 1)
+    logging.info('test accuracy %.3f', acc)
+    assert acc >= args.min_acc, 'sparse LR failed: %.3f' % acc
+    print('linear_classification: acc=%.3f' % acc)
+
+
+if __name__ == '__main__':
+    main()

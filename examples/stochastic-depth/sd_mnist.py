@@ -1,0 +1,114 @@
+"""Stochastic depth — reference example/stochastic-depth/sd_mnist.py +
+sd_module.py (Huang et al. 2016): residual blocks are randomly dropped
+during training (identity passthrough) and always kept, scaled by their
+survival probability, at inference.
+
+    python sd_mnist.py --epochs 10
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+NCLASS = 5
+
+
+class SDBlock(gluon.Block):
+    """Residual conv block dropped with prob (1 - p_survive) in train
+    mode (reference sd_module.py's random-number-gated module list)."""
+
+    def __init__(self, channels, p_survive, **kw):
+        super().__init__(**kw)
+        self.p_survive = p_survive
+        with self.name_scope():
+            self.c1 = nn.Conv2D(channels, 3, padding=1, activation='relu')
+            self.c2 = nn.Conv2D(channels, 3, padding=1)
+
+    def forward(self, x):
+        res = self.c2(self.c1(x))
+        if autograd.is_training():
+            if float(np.random.rand()) < self.p_survive:
+                return mx.nd.relu(x + res)
+            return x                           # dropped: identity
+        return mx.nd.relu(x + self.p_survive * res)
+
+
+class SDNet(gluon.Block):
+    def __init__(self, n_blocks=4, **kw):
+        super().__init__(**kw)
+        with self.name_scope():
+            self.stem = nn.Conv2D(16, 3, padding=1, activation='relu')
+            self.blocks = nn.Sequential()
+            # linearly decaying survival probability (paper's rule)
+            for i in range(n_blocks):
+                p = 1.0 - 0.5 * (i + 1) / n_blocks
+                self.blocks.add(SDBlock(16, p))
+            self.pool = nn.MaxPool2D(2)
+            self.out = nn.Dense(NCLASS)
+
+    def forward(self, x):
+        return self.out(self.pool(self.blocks(self.stem(x))))
+
+
+def shapes_data(rng, n, protos):
+    """5-class synthetic images from shared prototype patterns."""
+    lab = rng.randint(0, NCLASS, n)
+    x = protos[lab] + 0.4 * rng.randn(n, 1, 12, 12).astype(np.float32)
+    return x.astype(np.float32), lab.astype(np.float32)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=10)
+    ap.add_argument('--samples', type=int, default=512)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--lr', type=float, default=0.05)
+    ap.add_argument('--min-acc', type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(2)
+    np.random.seed(2)
+
+    rng = np.random.RandomState(13)
+    protos = rng.randn(NCLASS, 1, 12, 12).astype(np.float32)
+    xtr, ytr = shapes_data(rng, args.samples, protos)
+    xte, yte = shapes_data(rng, args.samples // 4, protos)
+
+    net = SDNet()
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': args.lr, 'momentum': 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(xtr))
+        tot = 0.0
+        for i in range(0, len(xtr), args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data, lab = mx.nd.array(xtr[idx]), mx.nd.array(ytr[idx])
+            with autograd.record():
+                loss = loss_fn(net(data), lab)
+            loss.backward()
+            # dropped blocks contribute no grads this step — that is the
+            # point of stochastic depth
+            trainer.step(len(idx), ignore_stale_grad=True)
+            tot += float(loss.mean().asscalar()) * len(idx)
+        logging.info('epoch %d loss %.4f', epoch, tot / len(xtr))
+
+    pred = net(mx.nd.array(xte)).asnumpy().argmax(axis=1)
+    acc = float((pred == yte).mean())
+    logging.info('test accuracy %.3f', acc)
+    assert acc >= args.min_acc, 'stochastic depth failed: %.3f' % acc
+    print('sd_mnist: acc=%.3f' % acc)
+
+
+if __name__ == '__main__':
+    main()

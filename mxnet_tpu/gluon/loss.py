@@ -7,7 +7,7 @@ from .block import HybridBlock
 
 __all__ = ['Loss', 'L2Loss', 'L1Loss', 'SigmoidBinaryCrossEntropyLoss',
            'SigmoidBCELoss', 'SoftmaxCrossEntropyLoss', 'SoftmaxCELoss',
-           'KLDivLoss']
+           'KLDivLoss', 'CTCLoss']
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -126,3 +126,41 @@ class KLDivLoss(Loss):
         loss = label * (F.log(label + 1e-12) - output)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return F.mean(loss, axis=self._batch_axis, exclude=True)
+
+
+class CTCLoss(Loss):
+    """Connectionist Temporal Classification loss (reference loss.py:302).
+
+    ``data`` is an unsoftmaxed activation tensor (``layout`` 'NTC' or
+    'TNC'); ``label`` an index matrix ('NT' or 'TN'). With
+    ``blank_label='first'`` (the contrib op default) index 0 is the
+    blank, so label values are 1..alphabet_size-1. Label lengths come
+    from ``label_lengths`` or the first occurrence of ``padding_mask``.
+    Output shape (batch_size,).
+    """
+
+    def __init__(self, layout='NTC', label_layout='NT', padding_mask=-1,
+                 weight=None, **kwargs):
+        assert layout in ('NTC', 'TNC'), layout
+        assert label_layout in ('NT', 'TN'), label_layout
+        self._layout = layout
+        self._label_layout = label_layout
+        self._padding_mask = padding_mask
+        batch_axis = label_layout.find('N')
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, data, label,
+                       data_lengths=None, label_lengths=None,
+                       sample_weight=None):
+        if self._layout == 'NTC':
+            data = F.swapaxes(data, 0, 1)
+        if self._batch_axis == 1:
+            label = F.swapaxes(label, 0, 1)
+        kwargs = {'use_data_lengths': data_lengths is not None,
+                  'use_label_lengths': label_lengths is not None}
+        if self._padding_mask is not None:
+            kwargs['padding_mask'] = self._padding_mask
+        inputs = [data, label] + \
+            [x for x in (data_lengths, label_lengths) if x is not None]
+        loss = F.contrib.CTCLoss(*inputs, **kwargs)
+        return _apply_weighting(F, loss, self._weight, sample_weight)

@@ -574,6 +574,11 @@ def _binary(lhs, rhs, op_broadcast, op_scalar):
         return invoke(op_broadcast, [lhs, rhs], {})
     if isinstance(rhs, numeric_types):
         return invoke(op_scalar, [lhs], {'scalar': float(rhs)})
+    from .sparse import BaseSparseNDArray
+    if isinstance(rhs, BaseSparseNDArray):
+        # dense (op) sparse emits dense, like the reference's elemwise
+        # dense/sparse fallbacks
+        return invoke(op_broadcast, [lhs, rhs.tostype('default')], {})
     raise TypeError('type %s not supported' % str(type(rhs)))
 
 

@@ -67,6 +67,38 @@ class BaseSparseNDArray:
         return '<%s %s @%s>' % (type(self).__name__,
                                 'x'.join(map(str, self._shape)), self._ctx)
 
+    # dense-fallback arithmetic (reference elemwise ops accept
+    # dense/sparse mixes and emit dense): subclasses override the cases
+    # that stay sparse (scalar mul on row_sparse, rsp+rsp add)
+    def _dense(self, other):
+        return other.tostype('default') \
+            if isinstance(other, BaseSparseNDArray) else other
+
+    def __sub__(self, other):
+        return self.tostype('default') - self._dense(other)
+
+    def __rsub__(self, other):
+        return self._dense(other) - self.tostype('default')
+
+    def __truediv__(self, other):
+        return self.tostype('default') / self._dense(other)
+
+    def __rtruediv__(self, other):
+        return self._dense(other) / self.tostype('default')
+
+    def __neg__(self):
+        return self * -1.0
+
+    def __add__(self, other):
+        return self.tostype('default') + self._dense(other)
+
+    __radd__ = __add__
+
+    def __mul__(self, other):
+        return self.tostype('default') * self._dense(other)
+
+    __rmul__ = __mul__
+
 
 class RowSparseNDArray(BaseSparseNDArray):
     """rows `indices` hold `data`; all other rows are zero
@@ -107,9 +139,7 @@ class RowSparseNDArray(BaseSparseNDArray):
     def __add__(self, other):
         if isinstance(other, RowSparseNDArray):
             return add(self, other)
-        return self.tostype('default') + (
-            other.tostype('default') if isinstance(other, BaseSparseNDArray)
-            else other)
+        return self.tostype('default') + self._dense(other)
 
     __radd__ = __add__
 
@@ -117,11 +147,20 @@ class RowSparseNDArray(BaseSparseNDArray):
         if np.isscalar(other):
             return RowSparseNDArray(self.data * other, self.indices,
                                     self._shape, self._ctx)
-        return self.tostype('default') * (
-            other.tostype('default') if isinstance(other, BaseSparseNDArray)
-            else other)
+        return self.tostype('default') * self._dense(other)
 
     __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        if np.isscalar(other):
+            return RowSparseNDArray(self.data / other, self.indices,
+                                    self._shape, self._ctx)
+        return self.tostype('default') / self._dense(other)
+
+    def __sub__(self, other):
+        if isinstance(other, RowSparseNDArray):
+            return add(self, other * -1.0)
+        return self.tostype('default') - self._dense(other)
 
 
 class CSRNDArray(BaseSparseNDArray):

@@ -126,7 +126,8 @@ def invoke_custom(op, inputs, out_shapes, out_dtypes=None, aux=None):
     return out_nd[0] if len(out_nd) == 1 else out_nd
 
 
-_CUSTOM_RESERVED = ('op_type', 'num_args', '__is_train__', 'name')
+_CUSTOM_RESERVED = ('op_type', 'num_args', '__is_train__', 'name',
+                    '__op_instance__')
 
 
 def _split_aux(prop, arrays):
@@ -194,20 +195,18 @@ def _custom_shape(attrs, in_shapes):
     return [tuple(s) for s in out_shapes], [None] * len(out_shapes)
 
 
-# One CustomOp instance per graph node (keyed by the node's attrs dict,
-# which host_bridge passes identically to forward and backward): the
-# reference binds one operator per executor (custom.cc CreateOperatorEx),
-# and ops commonly stash forward state on `self` for backward. The attrs
-# tuple keeps a strong ref so the id can't be recycled.
-_OP_INSTANCES = {}
-
-
 def _node_operator(attrs, prop, shapes, in_types):
-    ent = _OP_INSTANCES.get(id(attrs))
-    if ent is not None and ent[0] is attrs:
-        return ent[1]
-    op = prop.create_operator(None, [tuple(s) for s in shapes], in_types)
-    _OP_INSTANCES[id(attrs)] = (attrs, op)
+    """One CustomOp instance per executor node: host_bridge passes the
+    same (executor-copied) attrs dict to forward and backward, so the
+    instance is stashed on it — ops commonly cache forward state on
+    ``self`` for backward, and the reference binds one operator per
+    executor the same way (custom.cc CreateOperatorEx). Lifetime is the
+    executor's, not the process's."""
+    op = attrs.get('__op_instance__')
+    if op is None:
+        op = prop.create_operator(None, [tuple(s) for s in shapes],
+                                  in_types)
+        attrs['__op_instance__'] = op
     return op
 
 

@@ -179,43 +179,79 @@ def _dequantize(attrs, data, min_range, max_range):
     return (data.astype(jnp.float32) - qmin) * scale + min_range
 
 
-@register('_contrib_CTCLoss', input_names=['data', 'label'],
+@register('_contrib_CTCLoss',
+          input_names=['data', 'label', 'data_lengths', 'label_lengths'],
+          optional_inputs={'data_lengths': 'use_data_lengths',
+                           'label_lengths': 'use_label_lengths'},
           param_defaults={'use_data_lengths': False, 'use_label_lengths': False,
-                          'blank_label': 'first'})
-def _ctc_loss(attrs, data, label):
+                          'blank_label': 'first', 'padding_mask': None})
+def _ctc_loss(attrs, data, label, *opt):
     """Reference contrib/ctc_loss.cc (warp-ctc). Forward-backward in log
-    space via lax.scan; blank index 0 ('first' convention)."""
+    space via lax.scan. blank_label 'first' reserves index 0 for blank
+    (labels 1..V-1), 'last' reserves V-1 (labels 0..V-2). Label lengths
+    come from the label_lengths input (use_label_lengths), the first
+    occurrence of padding_mask, or the count of non-blank-convention
+    padding entries; data_lengths freezes the alpha recursion per sample
+    past its length."""
+    use_dl = attrs.get('use_data_lengths', False)
+    use_ll = attrs.get('use_label_lengths', False)
+    opt = [o for o in opt if o is not None]
+    data_lengths = opt.pop(0) if use_dl and opt else None
+    label_lengths = opt.pop(0) if use_ll and opt else None
+
     T, N, V = data.shape
+    blank_first = attrs.get('blank_label', 'first') == 'first'
+    blank = 0 if blank_first else V - 1
     logp = jax.nn.log_softmax(data, axis=-1)
     labels = label.astype(jnp.int32)  # (N, L)
     L = labels.shape[1]
+
+    pad = attrs.get('padding_mask', None)
+    if label_lengths is not None:
+        lab_len = label_lengths.astype(jnp.int32)
+    elif pad is not None:
+        is_pad = labels == int(pad)
+        lab_len = jnp.where(is_pad.any(axis=1),
+                            jnp.argmax(is_pad, axis=1), L)
+    elif blank_first:
+        lab_len = jnp.sum(labels > 0, axis=1)
+    else:
+        lab_len = jnp.sum((labels >= 0) & (labels < V - 1), axis=1)
+
+    # entries past each sample's length must not poison the `same` mask
+    # or gather with out-of-range values (padding_mask may be -1)
+    valid = jnp.arange(L)[None, :] < lab_len[:, None]
+    labels = jnp.where(valid, jnp.clip(labels, 0, V - 1), blank)
+
     # extended label seq: blank interleaved — length 2L+1
     S = 2 * L + 1
-    ext = jnp.zeros((N, S), dtype=jnp.int32)
+    ext = jnp.full((N, S), blank, dtype=jnp.int32)
     ext = ext.at[:, 1::2].set(labels)
-    lab_len = jnp.sum(labels > 0, axis=1) if not attrs.get('use_label_lengths') \
-        else jnp.sum(labels >= 0, axis=1)
     ext_len = 2 * lab_len + 1
 
     neg_inf = -1e10
     alpha0 = jnp.full((N, S), neg_inf)
-    alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+    alpha0 = alpha0.at[:, 0].set(logp[0, :, blank])
     alpha0 = alpha0.at[:, 1].set(jnp.take_along_axis(logp[0], ext[:, 1:2], 1)[:, 0])
 
     same = jnp.concatenate([jnp.zeros((N, 2), bool),
                             ext[:, 2:] == ext[:, :-2]], axis=1)
-    is_blank = (ext == 0)
+    is_blank = (ext == blank)
 
-    def step(alpha, logp_t):
+    def step(alpha, xs):
+        logp_t, t = xs
         a1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
         a2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
         a2 = jnp.where(is_blank | same, neg_inf, a2)
         merged = jnp.logaddexp(jnp.logaddexp(alpha, a1), a2)
         emit = jnp.take_along_axis(logp_t, ext, axis=1)
         new_alpha = merged + emit
+        if data_lengths is not None:
+            live = (t < data_lengths.astype(jnp.int32))[:, None]
+            new_alpha = jnp.where(live, new_alpha, alpha)
         return new_alpha, None
 
-    alphaT, _ = jax.lax.scan(step, alpha0, logp[1:])
+    alphaT, _ = jax.lax.scan(step, alpha0, (logp[1:], jnp.arange(1, T)))
     idx_last = jnp.clip(ext_len - 1, 0, S - 1)
     idx_prev = jnp.clip(ext_len - 2, 0, S - 1)
     ll = jnp.logaddexp(

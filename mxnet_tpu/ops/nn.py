@@ -666,6 +666,7 @@ _svm_cvjp.defvjp(_svm_fwd, _svm_bwd)
 # Sequence ops — reference sequence_last/mask/reverse-inl.h
 # ---------------------------------------------------------------------------
 @register('SequenceLast', input_names=['data', 'sequence_length'],
+          optional_inputs={'sequence_length': 'use_sequence_length'},
           param_defaults={'use_sequence_length': False, 'axis': 0})
 def _sequence_last(attrs, data, seq_len=None):
     if not attrs.get('use_sequence_length', False) or seq_len is None:
@@ -676,6 +677,7 @@ def _sequence_last(attrs, data, seq_len=None):
 
 
 @register('SequenceMask', input_names=['data', 'sequence_length'],
+          optional_inputs={'sequence_length': 'use_sequence_length'},
           param_defaults={'use_sequence_length': False, 'value': 0.0,
                           'axis': 0})
 def _sequence_mask(attrs, data, seq_len=None):
@@ -688,6 +690,7 @@ def _sequence_mask(attrs, data, seq_len=None):
 
 
 @register('SequenceReverse', input_names=['data', 'sequence_length'],
+          optional_inputs={'sequence_length': 'use_sequence_length'},
           param_defaults={'use_sequence_length': False, 'axis': 0})
 def _sequence_reverse(attrs, data, seq_len=None):
     if not attrs.get('use_sequence_length', False) or seq_len is None:

@@ -37,7 +37,7 @@ class OpDef:
                  param_defaults=None, differentiable=True, variadic=False,
                  mutate_inputs=None, needs_rng=False, num_visible_outputs=None,
                  train_aware=False, aux_inputs=(), key_var_num_args=None,
-                 host=False, shape_fn=None, doc=None):
+                 host=False, shape_fn=None, doc=None, optional_inputs=None):
         self.name = name
         self.fn = fn
         self.num_outputs = num_outputs  # int or callable(attrs)->int
@@ -60,6 +60,10 @@ class OpDef:
         # (out_shapes, out_dtypes); without one the op is imperative-only.
         self.host = host
         self.shape_fn = shape_fn
+        # {input_name: gate_attr}: the input exists only when the gate
+        # attr is truthy (CTCLoss lengths, Sequence* sequence_length) —
+        # keeps symbol compose from fabricating variables for them
+        self.optional_inputs = dict(optional_inputs or {})
         self.doc = doc or (fn.__doc__ or '')
 
     def n_outputs(self, attrs):
@@ -73,11 +77,21 @@ class OpDef:
         return n(attrs) if callable(n) else n
 
     def arg_names(self, attrs=None, num_args=None):
-        """Input names; variadic ops expand arg0..argN-1."""
+        """Input names; variadic ops expand arg0..argN-1. Optional
+        inputs are dropped unless their gate attr is truthy."""
         if self.variadic:
             n = num_args if num_args is not None else 0
             return ['arg%d' % i for i in range(n)]
-        return list(self.input_names)
+        names = list(self.input_names)
+        if self.optional_inputs:
+            attrs = attrs or {}
+            def _on(gate):
+                v = attrs.get(gate, self.param_defaults.get(gate, False))
+                return v not in (False, 'False', '0', 0, None, 'false')
+            names = [n for n in names
+                     if n not in self.optional_inputs
+                     or _on(self.optional_inputs[n])]
+        return names
 
 
 def register(name, **kwargs):

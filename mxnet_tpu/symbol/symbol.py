@@ -506,8 +506,13 @@ def _invoke_sym(op_name, input_syms, kwargs):
             except Exception:
                 order = None
         if order:
-            inputs = inputs + [named[n] for n in order if n in named] + \
-                [v for k, v in named.items() if k not in order]
+            unknown = [k for k in named if k not in order]
+            if unknown:
+                raise ValueError(
+                    'unknown keyword input(s) %s for Custom op %r; '
+                    'declared inputs are %s' %
+                    (unknown, kwargs.get('op_type'), order))
+            inputs = inputs + [named[n] for n in order if n in named]
         else:
             inputs = inputs + list(named.values())
     if op.variadic and op.key_var_num_args and op.key_var_num_args not in kwargs:

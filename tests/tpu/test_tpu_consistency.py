@@ -111,6 +111,15 @@ SWEEP = [
      {'data': (2, 3, 5, 5)}, {}),
     ('clip_abs', lambda: mx.sym.clip(mx.sym.abs(_v()), 0.1, 0.8),
      {'data': (5, 3, 4)}, {}),
+    ('seq_mask', lambda: mx.sym.SequenceMask(
+        _v(), use_sequence_length=False, value=0.0),
+     {'data': (5, 3, 4)}, {}),
+    ('ctc', lambda: mx.sym.contrib.CTCLoss(
+        _v(), mx.sym.slice_axis(mx.sym.slice_axis(mx.sym.clip(
+            mx.sym.reshape(mx.sym.Variable('data'), shape=(12, 5)),
+            0, 3), axis=0, begin=0, end=2), axis=1, begin=0, end=2),
+        name='ctc'),
+     {'data': (6, 2, 5)}, {'grad_req': 'null'}),
     ('smooth_l1', lambda: mx.sym.smooth_l1(_v(), scalar=1.0),
      {'data': (4, 9)}, {}),
     ('topk_argmax', lambda: mx.sym.topk(_v(), k=3, axis=-1),

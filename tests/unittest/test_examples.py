@@ -49,6 +49,19 @@ CASES = [
     ('adversary/adversary_generation.py', ['--epochs', '8']),
     ('numpy-ops/custom_softmax.py', ['--epochs', '8']),
     ('svm_mnist/svm_mnist.py', ['--epochs', '10']),
+    ('autoencoder/mnist_sae.py', ['--pretrain-epochs', '4',
+                                  '--finetune-epochs', '6']),
+    ('vae/vae.py', ['--epochs', '12']),
+    ('multi-task/example_multi_task.py', ['--epochs', '8']),
+    ('ctc/lstm_ocr.py', ['--epochs', '25']),
+    ('bi-lstm-sort/lstm_sort.py', ['--epochs', '25']),
+    ('nce-loss/toy_nce.py', ['--epochs', '12']),
+    ('sparse/linear_classification.py', []),
+    ('stochastic-depth/sd_mnist.py', []),
+    ('fcn-xs/fcn_xs.py', []),
+    ('neural-style/neural_style.py', ['--steps', '120']),
+    ('dec/dec.py', ['--pretrain-epochs', '8', '--dec-iters', '45']),
+    ('memcost/memcost.py', []),
 ]
 
 

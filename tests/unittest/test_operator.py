@@ -334,3 +334,64 @@ def test_ctc_loss():
     loss = nd.invoke('_contrib_CTCLoss', [nd.array(data), nd.array(label)], {})
     assert loss.shape == (N,)
     assert (loss.asnumpy() > 0).all()
+
+
+def test_ctc_loss_lengths_and_padding():
+    # padding_mask, explicit label_lengths, and data_lengths must agree
+    T, N, V = 6, 2, 5
+    rs = np.random.RandomState(0)
+    data = rs.randn(T, N, V).astype(np.float32)
+    label_pad = nd.array([[1., 2., -1., -1.], [3., 2., 2., -1.]])
+    loss_pad = nd.invoke('_contrib_CTCLoss', [nd.array(data), label_pad],
+                         {'padding_mask': -1})
+    label_len = nd.array([[1., 2., 0., 0.], [3., 2., 2., 0.]])
+    loss_len = nd.invoke(
+        '_contrib_CTCLoss',
+        [nd.array(data), label_len, nd.array([2., 3.])],
+        {'use_label_lengths': True})
+    assert_almost_equal(loss_pad.asnumpy(), loss_len.asnumpy(), rtol=1e-4, atol=1e-4)
+
+    # data_lengths: truncating the time axis == passing shorter data
+    short = nd.invoke('_contrib_CTCLoss',
+                      [nd.array(data[:4]), label_pad],
+                      {'padding_mask': -1})
+    trunc = nd.invoke(
+        '_contrib_CTCLoss',
+        [nd.array(data), label_pad, nd.array([4., 4.])],
+        {'use_data_lengths': True, 'padding_mask': -1})
+    assert_almost_equal(short.asnumpy(), trunc.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_loss_blank_last():
+    # 'last' convention: blank is V-1, labels 0..V-2; relabeling a
+    # 'first'-convention problem must give the identical loss
+    T, N, V = 5, 2, 4
+    rs = np.random.RandomState(1)
+    data = rs.randn(T, N, V).astype(np.float32)
+    first = nd.invoke('_contrib_CTCLoss',
+                      [nd.array(data), nd.array([[1., 2.], [3., 0.]])], {})
+    # move the blank channel from 0 to V-1 and shift labels down by 1
+    data_last = np.concatenate([data[..., 1:], data[..., :1]], axis=-1)
+    last = nd.invoke('_contrib_CTCLoss',
+                     [nd.array(data_last), nd.array([[0., 1.], [2., -1.]])],
+                     {'blank_label': 'last', 'padding_mask': -1})
+    assert_almost_equal(first.asnumpy(), last.asnumpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_gluon_ctc_loss():
+    from mxnet_tpu import gluon, autograd
+    lf = gluon.loss.CTCLoss()          # NTC, padding -1
+    rs = np.random.RandomState(2)
+    data = nd.array(rs.randn(2, 6, 5).astype(np.float32))
+    label = nd.array([[1., 2., -1., -1.], [3., 2., 2., -1.]])
+    data.attach_grad()
+    with autograd.record():
+        loss = lf(data, label)
+    loss.backward()
+    assert loss.shape == (2,)
+    assert (loss.asnumpy() > 0).all()
+    assert float(nd.abs(data.grad).sum().asscalar()) > 0
+    # TNC layout path agrees with NTC
+    lf_t = gluon.loss.CTCLoss(layout='TNC')
+    loss_t = lf_t(data.transpose((1, 0, 2)), label)
+    assert_almost_equal(loss.asnumpy(), loss_t.asnumpy(), rtol=1e-4, atol=1e-4)

@@ -218,3 +218,32 @@ class TestKVStoreRowSparse:
         expected = np.zeros(shape, 'f4')
         expected[[1, 5]] = dense[[1, 5]]
         np.testing.assert_allclose(got, expected)
+
+
+def test_dense_sparse_mixed_arithmetic():
+    """dense (op) sparse and sparse (op) dense emit dense results
+    (reference elemwise dense/sparse fallbacks); row_sparse scalar
+    mul/div and rsp-rsp add/sub stay sparse."""
+    w = mx.nd.ones((4, 2))
+    rsp = mx.nd.sparse.row_sparse_array(
+        (np.full((2, 2), 2., 'float32'), [0, 2]), shape=(4, 2))
+    np.testing.assert_allclose((w - rsp).asnumpy(),
+                               [[-1, -1], [1, 1], [-1, -1], [1, 1]])
+    np.testing.assert_allclose((rsp - w).asnumpy(),
+                               [[1, 1], [-1, -1], [1, 1], [-1, -1]])
+    np.testing.assert_allclose((w + rsp).asnumpy(),
+                               [[3, 3], [1, 1], [3, 3], [1, 1]])
+    half = rsp / 2
+    assert type(half).__name__ == 'RowSparseNDArray'
+    np.testing.assert_allclose(half.tostype('default').asnumpy(),
+                               [[1, 1], [0, 0], [1, 1], [0, 0]])
+    neg = -rsp
+    assert type(neg).__name__ == 'RowSparseNDArray'
+    diff = rsp - rsp
+    assert type(diff).__name__ == 'RowSparseNDArray'
+    assert float(diff.tostype('default').asnumpy().sum()) == 0.0
+    csr = mx.nd.sparse.csr_matrix(
+        (np.ones(2, 'float32'), np.array([0, 1]), np.array([0, 1, 2])),
+        shape=(2, 2))
+    np.testing.assert_allclose((mx.nd.ones((2, 2)) * csr).asnumpy(),
+                               [[1, 0], [0, 1]])

@@ -1,0 +1,20 @@
+#!/bin/bash
+# Tunnel recovery watcher: probe the chip in a throwaway subprocess every
+# ~8 min; on the first healthy probe, run tools/tpu_capture.sh once and
+# exit. Writes progress to docs/tpu_artifacts/watch.log.
+cd "$(dirname "$0")/.."
+OUT=docs/tpu_artifacts
+mkdir -p "$OUT"
+LOG="$OUT/watch.log"
+for i in $(seq 1 "${1:-60}"); do
+  echo "$(date -u +%H:%M:%S) probe $i" >> "$LOG"
+  if timeout 240 python -c 'import jax; assert any(d.platform=="tpu" for d in jax.devices())' 2>>"$LOG"; then
+    echo "$(date -u +%H:%M:%S) chip healthy; capturing" >> "$LOG"
+    bash tools/tpu_capture.sh >> "$LOG" 2>&1
+    echo "$(date -u +%H:%M:%S) capture done" >> "$LOG"
+    exit 0
+  fi
+  sleep 480
+done
+echo "$(date -u +%H:%M:%S) gave up" >> "$LOG"
+exit 1
