@@ -1,0 +1,78 @@
+"""Stochastic Gradient Langevin Dynamics — reference example/
+bayesian-methods/sgld.ipynb (Welling & Teh 2011): the 'sgld' optimizer
+injects N(0, sqrt(lr)) noise into each SGD step, turning optimization
+into posterior sampling. Hermetic: Bayesian linear regression, whose
+exact Gaussian posterior the SGLD iterates must reproduce.
+
+    python sgld.py --steps 4000
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+DIM = 3
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=4000)
+    ap.add_argument('--burnin', type=int, default=1000)
+    ap.add_argument('--samples', type=int, default=256)
+    ap.add_argument('--lr', type=float, default=1e-3)
+    ap.add_argument('--noise', type=float, default=0.5,
+                    help='observation noise std')
+    ap.add_argument('--tol-mean', type=float, default=0.15)
+    ap.add_argument('--tol-std', type=float, default=0.5,
+                    help='relative tolerance on posterior std')
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(9)
+
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(DIM).astype(np.float32)
+    X = rng.randn(args.samples, DIM).astype(np.float32)
+    y = X @ w_true + args.noise * rng.randn(args.samples).astype(np.float32)
+
+    # exact posterior: w ~ N(mu, S), S = (X'X/s^2 + I)^-1 (unit prior)
+    s2 = args.noise ** 2
+    S = np.linalg.inv(X.T @ X / s2 + np.eye(DIM))
+    mu = S @ (X.T @ y) / s2
+
+    # SGLD over the unnormalized log posterior. The optimizer expects
+    # the gradient of the SUMMED negative log posterior.
+    w = mx.nd.zeros((DIM,))
+    opt = mx.optimizer.create('sgld', learning_rate=args.lr,
+                              rescale_grad=1.0, wd=0.0)
+    updater = mx.optimizer.get_updater(opt)
+    chain = []
+    Xn, yn = mx.nd.array(X), mx.nd.array(y)
+    for step in range(args.steps):
+        resid = mx.nd.dot(Xn, w) - yn
+        grad = mx.nd.dot(Xn.T, resid) / s2 + w   # -dlogp/dw (unit prior)
+        updater(0, grad, w)
+        if step >= args.burnin:
+            chain.append(w.asnumpy().copy())
+        if step % 1000 == 0:
+            logging.info('step %d w %s', step, w.asnumpy())
+
+    chain = np.stack(chain)
+    emp_mu, emp_std = chain.mean(0), chain.std(0)
+    logging.info('posterior mean: exact %s  sgld %s', mu, emp_mu)
+    logging.info('posterior std : exact %s  sgld %s', np.sqrt(np.diag(S)),
+                 emp_std)
+    assert np.abs(emp_mu - mu).max() < args.tol_mean, (emp_mu, mu)
+    rel = np.abs(emp_std - np.sqrt(np.diag(S))) / np.sqrt(np.diag(S))
+    assert rel.max() < args.tol_std, (emp_std, np.sqrt(np.diag(S)))
+    print('sgld: mean_err=%.4f std_rel_err=%.3f'
+          % (np.abs(emp_mu - mu).max(), rel.max()))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,123 @@
+"""Dense-Sparse-Dense training — reference example/dsd/ (Han et al.
+2017): train dense, prune the smallest weights and retrain under the
+sparsity mask, then remove the mask and retrain densely — the final
+dense model should match or beat the first dense pass.
+
+    python dsd.py --epochs 6
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+NCLASS = 6
+DIM = 32
+
+
+def blobs(rng, n, centers):
+    lab = rng.randint(0, NCLASS, n)
+    x = centers[lab] + 0.5 * rng.randn(n, DIM).astype(np.float32)
+    return x.astype(np.float32), lab.astype(np.float32)
+
+
+def train_phase(net, x, y, epochs, lr, rng, masks=None, tag=''):
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': lr, 'momentum': 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(epochs):
+        perm = rng.permutation(len(x))
+        tot = 0.0
+        for i in range(0, len(x), 64):
+            idx = perm[i:i + 64]
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(x[idx])),
+                               mx.nd.array(y[idx]))
+            loss.backward()
+            trainer.step(len(idx))
+            if masks:
+                # sparse phase: keep pruned weights at exactly zero
+                for p, m in masks.items():
+                    d = p.data()
+                    p.set_data(d * m)
+            tot += float(loss.mean().asscalar()) * len(idx)
+        logging.info('%s epoch %d loss %.4f', tag, epoch, tot / len(x))
+
+
+def accuracy(net, x, y):
+    return float((net(mx.nd.array(x)).asnumpy().argmax(1) == y).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=6)
+    ap.add_argument('--samples', type=int, default=768)
+    ap.add_argument('--lr', type=float, default=0.05)
+    ap.add_argument('--sparsity', type=float, default=0.5)
+    ap.add_argument('--min-acc', type=float, default=0.9)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(8)
+
+    rng = np.random.RandomState(19)
+    centers = rng.randn(NCLASS, DIM).astype(np.float32) * 1.5
+    xtr, ytr = blobs(rng, args.samples, centers)
+    xte, yte = blobs(rng, args.samples // 4, centers)
+
+    net = nn.Sequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation='relu'),
+                nn.Dense(64, activation='relu'), nn.Dense(NCLASS))
+    net.initialize(mx.init.Xavier())
+
+    # phase 1: dense
+    train_phase(net, xtr, ytr, args.epochs, args.lr, rng, tag='dense')
+    acc_dense = accuracy(net, xte, yte)
+
+    # prune: zero the smallest |w| per weight matrix
+    masks = {}
+    pruned = total = 0
+    for name, p in net.collect_params().items():
+        if not name.endswith('weight'):
+            continue
+        w = p.data().asnumpy()
+        thresh = np.quantile(np.abs(w), args.sparsity)
+        m = (np.abs(w) > thresh).astype(np.float32)
+        masks[p] = mx.nd.array(m)
+        p.set_data(p.data() * masks[p])
+        pruned += int((m == 0).sum())
+        total += m.size
+    logging.info('pruned %d/%d weights (%.0f%%)', pruned, total,
+                 100 * pruned / total)
+    acc_pruned = accuracy(net, xte, yte)
+
+    # phase 2: sparse retrain under the mask
+    train_phase(net, xtr, ytr, args.epochs, args.lr / 2, rng, masks=masks,
+                tag='sparse')
+    acc_sparse = accuracy(net, xte, yte)
+    # the mask must really be enforced
+    for p, m in masks.items():
+        w = p.data().asnumpy()
+        assert np.abs(w[m.asnumpy() == 0]).max() == 0.0
+
+    # phase 3: dense retrain (mask lifted)
+    train_phase(net, xtr, ytr, args.epochs, args.lr / 4, rng, tag='redense')
+    acc_final = accuracy(net, xte, yte)
+
+    logging.info('acc dense %.3f -> pruned %.3f -> sparse %.3f -> final %.3f',
+                 acc_dense, acc_pruned, acc_sparse, acc_final)
+    assert acc_final >= args.min_acc, acc_final
+    assert acc_final >= acc_dense - 0.02, (acc_dense, acc_final)
+    print('dsd: dense=%.3f sparse=%.3f final=%.3f'
+          % (acc_dense, acc_sparse, acc_final))
+
+
+if __name__ == '__main__':
+    main()

@@ -207,6 +207,11 @@ class Executor:
     # -- forward ----------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         """Reference executor.py:89 / GraphExecutor::Forward."""
+        from . import profiler as _profiler
+        with _profiler.maybe_span('executor.forward', 'executor'):
+            return self._forward_impl(is_train, **kwargs)
+
+    def _forward_impl(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
             if k in self.arg_dict:
                 if isinstance(v, NDArray):
@@ -263,6 +268,11 @@ class Executor:
     # -- backward ---------------------------------------------------------
     def backward(self, out_grads=None, is_train=True):
         """Reference GraphExecutor::Backward (graph_executor.cc:93)."""
+        from . import profiler as _profiler
+        with _profiler.maybe_span('executor.backward', 'executor'):
+            return self._backward_impl(out_grads, is_train)
+
+    def _backward_impl(self, out_grads=None, is_train=True):
         if self._use_staged():
             return self._backward_staged(out_grads)
         if self._pending is not None:

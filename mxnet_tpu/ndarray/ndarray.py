@@ -499,6 +499,12 @@ def invoke(op_name, inputs, attrs=None, out=None):
     Engine::PushAsync. Here: cached jit closure + (if recording) jax.vjp;
     JAX's async dispatch replaces the engine push.
     """
+    from .. import profiler as _profiler
+    with _profiler.maybe_span(op_name):
+        return _invoke_impl(op_name, inputs, attrs, out)
+
+
+def _invoke_impl(op_name, inputs, attrs=None, out=None):
     op = _reg.get(op_name)
     attrs = normalize_attrs(attrs or {})
     if op.train_aware:

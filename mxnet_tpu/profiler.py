@@ -64,6 +64,52 @@ def record_event(name, start_us, end_us, category='operator'):
                                  'pid': os.getpid(), 'tid': threading.get_ident()})
 
 
+def is_running():
+    """Fast gate for callers that would otherwise pay timing overhead."""
+    return _state['running']
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def maybe_span(name, category='operator'):
+    """span(...) when profiling is on, a shared no-op otherwise — the
+    one-liner gate for hot call sites (eager invoke, executor fwd/bwd)."""
+    return span(name, category) if _state['running'] else _NULL_SPAN
+
+
+class span:
+    """Time a host-side region into the trace (executor fwd/bwd, eager
+    invokes). Events are dispatch-side spans — inside a fused XLA step
+    the per-op schedule belongs to the XLA trace, not this one."""
+
+    __slots__ = ('name', 'cat', 't0')
+
+    def __init__(self, name, category='operator'):
+        self.name = name
+        self.cat = category
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *a):
+        if _state['running']:
+            t1 = time.time()
+            record_event(self.name, int(self.t0 * 1e6), int(t1 * 1e6),
+                         self.cat)
+
+
 def dump_profile():
     """Reference profiler.py:57 — writes Chrome trace-event JSON (python
     events merged with the native engine's op spans)."""

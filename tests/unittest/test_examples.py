@@ -62,6 +62,9 @@ CASES = [
     ('neural-style/neural_style.py', ['--steps', '120']),
     ('dec/dec.py', ['--pretrain-epochs', '8', '--dec-iters', '45']),
     ('memcost/memcost.py', []),
+    ('bayesian-methods/sgld.py', ['--steps', '3000']),
+    ('dsd/dsd.py', []),
+    ('profiler/profiler_demo.py', []),
 ]
 
 
