@@ -441,13 +441,14 @@ def main():
             'metric': 'transformer_train_throughput_bf16',
             'value': round(tok_s, 1),
             'unit': 'tokens/sec',
-            # the perf north star is 50% MFU; report progress against it
-            'vs_baseline': round(mfu / 0.5, 3) if mfu is not None else 0.0,
             'batch': int(images.shape[0]),
             'seq': int(images.shape[1]),
             'device': kind or platform,
             'platform': platform,
         }
+        if mfu is not None:
+            # the perf north star is 50% MFU; report progress against it
+            out['vs_baseline'] = round(mfu / 0.5, 3)
     else:
         img_s = bench_steps * BATCH / dt
         _log('%.2f img/s over %d steps (%.2fs); device=%s mfu=%s'

@@ -47,7 +47,10 @@ def main():
     mx.profiler.profiler_set_state('run')
     for _ in range(args.steps):
         exe.forward(is_train=True)
-        exe.backward(exe.outputs)
+        # no head grads: SoftmaxOutput is a loss layer, and arg-less
+        # backward keeps the executor's fused fwd+bwd path (passing
+        # exe.outputs would materialize a second, separate forward)
+        exe.backward()
         for k, g in exe.grad_dict.items():
             if g is not None and k not in ('data', 'softmax_label'):
                 exe.arg_dict[k][:] = exe.arg_dict[k] - 0.05 * g

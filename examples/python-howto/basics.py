@@ -1,0 +1,105 @@
+"""Python API how-to — reference example/python-howto/ (data_iter.py,
+monitor_weights.py, multiple_outputs.py): a guided tour of the NDArray /
+Symbol / Module fundamentals, each section self-checking.
+
+    python basics.py
+"""
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+
+
+def section_ndarray():
+    """NDArray: device arrays with numpy semantics + lazy execution."""
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.arange(6).reshape((2, 3))
+    c = (a + b * 2).asnumpy()
+    np.testing.assert_allclose(c, [[1, 3, 5], [7, 9, 11]])
+    # autograd on plain arrays
+    x = mx.nd.array([1., 2., 3.])
+    x.attach_grad()
+    with mx.autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2., 4., 6.])
+
+
+def section_custom_iter():
+    """Reference data_iter.py: a hand-rolled DataIter."""
+    class SimpleIter(mx.io.DataIter):
+        def __init__(self, n_batches=4, batch_size=8):
+            super().__init__(batch_size)
+            self.n = n_batches
+            self.i = 0
+            self.provide_data = [mx.io.DataDesc('data', (batch_size, 5))]
+            self.provide_label = [mx.io.DataDesc('softmax_label',
+                                                 (batch_size,))]
+
+        def reset(self):
+            self.i = 0
+
+        def next(self):
+            if self.i >= self.n:
+                raise StopIteration
+            self.i += 1
+            return mx.io.DataBatch(
+                data=[mx.nd.ones((self.batch_size, 5)) * self.i],
+                label=[mx.nd.zeros((self.batch_size,))])
+
+    it = SimpleIter()
+    seen = sum(1 for _ in it)
+    assert seen == 4
+    it.reset()
+    assert float(next(iter(it)).data[0].asnumpy().mean()) == 1.0
+
+
+def section_multiple_outputs():
+    """Reference multiple_outputs.py: Group symbols expose every head."""
+    data = mx.sym.Variable('data')
+    fc = mx.sym.FullyConnected(data, num_hidden=4, name='fc')
+    out = mx.sym.Group([mx.sym.softmax(fc), mx.sym.BlockGrad(fc)])
+    exe = out.simple_bind(mx.cpu(), data=(2, 3))
+    exe.arg_dict['data'][:] = np.ones((2, 3), np.float32)
+    exe.forward()
+    assert len(exe.outputs) == 2
+    np.testing.assert_allclose(exe.outputs[0].asnumpy().sum(axis=1),
+                               [1., 1.], rtol=1e-5)
+
+
+def section_monitor():
+    """Reference monitor_weights.py: Monitor taps executor tensors."""
+    seen = []
+    mon = mx.monitor.Monitor(1, stat_func=lambda d: mx.nd.array(
+        [float(mx.nd.abs(d).mean().asscalar())]),
+        pattern='.*weight')
+    data = mx.sym.Variable('data')
+    net = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(data, num_hidden=2, name='fc'),
+        name='softmax')
+    exe = net.simple_bind(mx.cpu(), data=(4, 3), softmax_label=(4,))
+    mon.install(exe)
+    exe.arg_dict['data'][:] = np.random.randn(4, 3)
+    mon.tic()
+    exe.forward(is_train=True)
+    stats = mon.toc()
+    seen = [name for (_, name, _) in stats]
+    assert any('weight' in n for n in seen), seen
+
+
+def main():
+    logging.basicConfig(level=logging.INFO)
+    for fn in (section_ndarray, section_custom_iter,
+               section_multiple_outputs, section_monitor):
+        fn()
+        logging.info('%s OK', fn.__name__)
+    print('python_howto: 4 sections OK')
+
+
+if __name__ == '__main__':
+    main()

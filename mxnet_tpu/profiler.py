@@ -57,11 +57,14 @@ def profiler_set_state(state='stop'):
 
 
 def record_event(name, start_us, end_us, category='operator'):
-    """Host-side event hook (engine profiler OprExecStat analog)."""
+    """Host-side event hook (engine profiler OprExecStat analog).
+    Thread-safe: prefetch iterators invoke ops off the main thread."""
     if _state['running']:
-        _state['events'].append({'name': name, 'cat': category, 'ph': 'X',
-                                 'ts': start_us, 'dur': end_us - start_us,
-                                 'pid': os.getpid(), 'tid': threading.get_ident()})
+        ev = {'name': name, 'cat': category, 'ph': 'X',
+              'ts': start_us, 'dur': end_us - start_us,
+              'pid': os.getpid(), 'tid': threading.get_ident()}
+        with _lock:
+            _state['events'].append(ev)
 
 
 def is_running():
@@ -115,8 +118,9 @@ def dump_profile():
     events merged with the native engine's op spans)."""
     # drain python events (the native dump below also drains its buffer,
     # so repeated dumps are symmetric: each event appears exactly once)
-    events = list(_state['events'])
-    _state['events'] = []
+    with _lock:
+        events = list(_state['events'])
+        _state['events'] = []
     from . import _native
     lib = _native.get_lib()
     if lib is not None:

@@ -65,6 +65,8 @@ CASES = [
     ('bayesian-methods/sgld.py', ['--steps', '3000']),
     ('dsd/dsd.py', []),
     ('profiler/profiler_demo.py', []),
+    ('module/mnist_mlp.py', []),
+    ('python-howto/basics.py', []),
 ]
 
 
