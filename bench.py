@@ -35,6 +35,11 @@ BATCH = int(os.environ.get('MXTPU_BENCH_BATCH', '32'))
 # matmul-dominated MFU probe: GPT-style decoder, flash-attention Pallas
 # kernel + fused rmsnorm; tpu_capture.sh records both)
 MODEL = os.environ.get('MXTPU_BENCH_MODEL', 'resnet50')
+# steps fused into one XLA call via lax.scan (in-graph train loop, the
+# standard TPU pattern). Each compiled(...) dispatch crosses the axon
+# tunnel; at ~ms RTTs a per-step dispatch caps throughput regardless of
+# chip speed — suspected cause of the round-3 1273-vs-2393 img/s gap.
+STEPS_PER_CALL = int(os.environ.get('MXTPU_BENCH_STEPS_PER_CALL', '8'))
 WARMUP_STEPS = 3
 INIT_ATTEMPTS = int(os.environ.get('MXTPU_BENCH_INIT_ATTEMPTS', '2'))
 INIT_TIMEOUT_S = float(os.environ.get('MXTPU_BENCH_INIT_TIMEOUT', '180'))
@@ -145,12 +150,14 @@ def _shrink_for_cpu():
     """Shrink the workload so a CPU run (fallback or cpu-only host)
     yields a number quickly instead of risking the harness timeout on a
     CPU-compiled ResNet."""
-    global BATCH, WARMUP_STEPS
+    global BATCH, WARMUP_STEPS, STEPS_PER_CALL
     if 'MXTPU_BENCH_BATCH' not in os.environ:
         BATCH = 8
         if MODEL == 'transformer':
             os.environ['MXTPU_BENCH_BATCH'] = '1'
     WARMUP_STEPS = 1
+    if 'MXTPU_BENCH_STEPS_PER_CALL' not in os.environ:
+        STEPS_PER_CALL = 1   # dispatch overhead is irrelevant on CPU
     for k, v in (('MXTPU_BENCH_DMODEL', '256'), ('MXTPU_BENCH_LAYERS', '2'),
                  ('MXTPU_BENCH_SEQ', '256'), ('MXTPU_BENCH_VOCAB', '1024')):
         os.environ.setdefault(k, v)
@@ -397,12 +404,29 @@ def main():
         tokens_per_batch = None
     _log('build+init: %.1fs' % (time.perf_counter() - t))
 
+    if STEPS_PER_CALL > 1:
+        inner = step
+
+        def step(masters, aux, vel, images, labels, key):
+            def body(carry, _):
+                m, a, v = carry
+                m, a, v, loss = inner(m, a, v, images, labels, key)
+                return (m, a, v), loss
+            (m, a, v), losses = jax.lax.scan(
+                body, (masters, aux, vel), None, length=STEPS_PER_CALL)
+            return m, a, v, losses[-1]
+        _log('fusing %d steps per device call (lax.scan)' % STEPS_PER_CALL)
+
     t = time.perf_counter()
     _log('compiling (first compile can take 20-40s)...')
     jstep = jax.jit(step, donate_argnums=(0, 1, 2))
     lowered = jstep.lower(masters, aux, vel, images, labels, key)
     compiled = lowered.compile()
     flops_per_step = _step_flops(compiled)
+    # XLA cost analysis counts a scan (while-loop) body ONCE regardless
+    # of trip count (verified: identical flops at 1 vs 8 steps/call), so
+    # scale to per-dispatch flops here
+    flops_per_step *= STEPS_PER_CALL
     _log('compile: %.1fs, step flops=%.3e'
          % (time.perf_counter() - t, flops_per_step))
 
@@ -433,9 +457,10 @@ def main():
     peak, kind = _peak_flops(devices[0])
     mfu = (flops_per_step * bench_steps / dt / peak) if peak else None
     if MODEL == 'transformer':
-        tok_s = bench_steps * tokens_per_batch / dt
-        _log('%.0f tokens/s over %d steps (%.2fs); device=%s mfu=%s'
-             % (tok_s, bench_steps, dt, kind,
+        tok_s = bench_steps * STEPS_PER_CALL * tokens_per_batch / dt
+        _log('%.0f tokens/s over %d calls x %d steps (%.2fs); '
+             'device=%s mfu=%s'
+             % (tok_s, bench_steps, STEPS_PER_CALL, dt, kind,
                 '%.1f%%' % (100 * mfu) if mfu is not None else 'n/a'))
         out = {
             'metric': 'transformer_train_throughput_bf16',
@@ -445,14 +470,16 @@ def main():
             'seq': int(images.shape[1]),
             'device': kind or platform,
             'platform': platform,
+            'steps_per_call': STEPS_PER_CALL,
         }
         if mfu is not None:
             # the perf north star is 50% MFU; report progress against it
             out['vs_baseline'] = round(mfu / 0.5, 3)
     else:
-        img_s = bench_steps * BATCH / dt
-        _log('%.2f img/s over %d steps (%.2fs); device=%s mfu=%s'
-             % (img_s, bench_steps, dt, kind,
+        img_s = bench_steps * STEPS_PER_CALL * BATCH / dt
+        _log('%.2f img/s over %d calls x %d steps (%.2fs); '
+             'device=%s mfu=%s'
+             % (img_s, bench_steps, STEPS_PER_CALL, dt, kind,
                 '%.1f%%' % (100 * mfu) if mfu is not None else 'n/a'))
         out = {
             'metric': 'resnet50_train_throughput_bf16',
@@ -462,6 +489,7 @@ def main():
             'batch': BATCH,
             'device': kind or platform,
             'platform': platform,
+            'steps_per_call': STEPS_PER_CALL,
         }
     if mfu is not None:
         out['mfu'] = round(mfu, 4)

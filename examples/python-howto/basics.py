@@ -74,7 +74,6 @@ def section_multiple_outputs():
 
 def section_monitor():
     """Reference monitor_weights.py: Monitor taps executor tensors."""
-    seen = []
     mon = mx.monitor.Monitor(1, stat_func=lambda d: mx.nd.array(
         [float(mx.nd.abs(d).mean().asscalar())]),
         pattern='.*weight')
