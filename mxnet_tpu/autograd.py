@@ -18,7 +18,9 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ['record', 'pause', 'train_mode', 'predict_mode', 'is_recording',
-           'is_training', 'mark_variables', 'backward', 'grad_and_loss', 'grad']
+           'is_training', 'mark_variables', 'backward', 'grad_and_loss',
+           'grad', 'Function', 'get_symbol', 'set_recording',
+           'set_training']
 
 _state = threading.local()
 
@@ -266,3 +268,81 @@ def grad(func, argnum=None):
     def wrapped(*args):
         return grad_and_loss(func, argnum)(*args)[0]
     return wrapped
+
+
+def get_symbol(x):
+    """Export the recorded computation history of ``x`` as a Symbol
+    (reference autograd.py:273 / MXAutogradGetSymbol)."""
+    from ._c_api_impl import autograd_get_symbol
+    return autograd_get_symbol(x)
+
+
+class Function:
+    """User-defined differentiable function (reference autograd.py:292):
+    define ``forward`` and ``backward``; during gradient computation the
+    custom backward replaces the chain rule. Example::
+
+        class sigmoid(Function):
+            def forward(self, x):
+                y = 1 / (1 + mx.nd.exp(-x))
+                self.save_for_backward(y)
+                return y
+
+            def backward(self, dy):
+                y, = self.saved_tensors
+                return dy * y * (1 - y)
+    """
+
+    def __init__(self):
+        self._used = False
+        self.saved_tensors = ()
+
+    def save_for_backward(self, *args):
+        self.saved_tensors = args
+
+    def forward(self, *inputs):
+        raise NotImplementedError()
+
+    def backward(self, *output_grads):
+        raise NotImplementedError()
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray, _parent_entry
+        assert not self._used, \
+            'Each Function instance can only be called once. ' \
+            'Please create another instance.'
+        self._used = True
+
+        prev = is_recording()
+        if prev:
+            set_recording(False)
+        try:
+            outputs = self.forward(*inputs)
+        finally:
+            if prev:
+                set_recording(True)
+        if not prev:
+            return outputs
+
+        single = isinstance(outputs, NDArray)
+        outs = (outputs,) if single else tuple(outputs)
+
+        def vjp_fn(cots):
+            cots_t = (cots,) if len(outs) == 1 else tuple(cots)
+            rets = self.backward(*[NDArray(c, None) for c in cots_t])
+            if isinstance(rets, NDArray):
+                rets = (rets,)
+            assert len(rets) == len(inputs), (
+                '%s.backward must return exactly as many NDArrays as '
+                'forward takes arguments (expected %d, got %d)'
+                % (type(self).__name__, len(inputs), len(rets)))
+            return tuple(r._data for r in rets)
+
+        node = record_op(vjp_fn, [_parent_entry(i) for i in inputs],
+                         len(outs), len(inputs),
+                         op_info=('_CustomFunction', {}))
+        node.head_ids = [(tuple(o.shape), o._data.dtype) for o in outs]
+        for i, o in enumerate(outs):
+            o._node = node
+            o._out_idx = i
+        return outputs

@@ -70,3 +70,156 @@ def normalize_value(v):
 
 def attr_key(attrs):
     return tuple(sorted(attrs.items(), key=lambda kv: kv[0]))
+
+
+# ---------------------------------------------------------------------------
+# ctypes-era helpers kept for source compatibility (reference base.py:
+# check_call, c_array, ctypes2buffer, ctypes2numpy_shared, c_str,
+# build_param_doc, add_fileline_to_docstring, MXCallbackList and the
+# Symbol/Sparse capability exceptions). Third-party reference code
+# imports these from mxnet.base; they operate on the real C ABI types
+# when the native library is loaded.
+# ---------------------------------------------------------------------------
+
+class NotImplementedForSymbol(MXNetError):
+    """Reference base.py: op available for NDArray but not Symbol."""
+
+    def __init__(self, function, alias, *args):
+        super().__init__()
+        self.function = function.__name__
+        self.alias = alias
+        self.args = [str(type(a)) for a in args]
+
+    def __str__(self):
+        msg = 'Function %s' % self.function
+        if self.alias:
+            msg += ' (namely operator "%s")' % self.alias
+        if self.args:
+            msg += ' with arguments (%s)' % ', '.join(self.args)
+        return msg + ' is not supported for Symbol and only available ' \
+                     'in NDArray.'
+
+
+class NotSupportedForSparseNDArray(MXNetError):
+    """Reference base.py: op not available for sparse storage types."""
+
+    def __init__(self, function, alias, *args):
+        super().__init__()
+        self.function = function.__name__
+        self.alias = alias
+        self.args = [str(type(a)) for a in args]
+
+    def __str__(self):
+        msg = 'Function %s' % self.function
+        if self.alias:
+            msg += ' (namely operator "%s")' % self.alias
+        if self.args:
+            msg += ' with arguments (%s)' % ', '.join(self.args)
+        return msg + ' is not supported for SparseNDArray and only ' \
+                     'available in NDArray.'
+
+
+import ctypes as _ctypes  # noqa: E402  (compat helpers below)
+
+
+class MXCallbackList(_ctypes.Structure):
+    """Reference base.py: the C callback-list struct (num_callbacks,
+    callbacks, contexts) used by the custom-op/custom-function
+    protocols; layout matches include/mxnet_tpu/c_api.h."""
+    _fields_ = [('num_callbacks', _ctypes.c_int),
+                ('callbacks', _ctypes.POINTER(_ctypes.CFUNCTYPE(_ctypes.c_int))),
+                ('contexts', _ctypes.POINTER(_ctypes.c_void_p))]
+
+
+def check_call(ret):
+    """Reference base.py:108: raise MXNetError on a nonzero C return.
+    With the native library loaded, MXTGetLastError (the engine's
+    last-error slot, src/engine.cc) carries the detail."""
+    if ret != 0:
+        msg = None
+        try:
+            from ._native import get_lib
+            lib = get_lib()
+            if lib is not None:
+                msg = lib.MXTGetLastError().decode('utf-8') or None
+        except Exception:
+            msg = None
+        raise MXNetError(msg or 'C API call failed with status %d' % ret)
+
+
+def c_str(string):
+    """Create a ctypes char* from a python string."""
+    return _ctypes.c_char_p(string.encode('utf-8'))
+
+
+def c_array(ctype, values):
+    """Create a ctypes array from a python list (reference base.py:135)."""
+    return (ctype * len(values))(*values)
+
+
+def ctypes2buffer(cptr, length):
+    """Convert a ctypes pointer to a python bytearray."""
+    if not isinstance(cptr, _ctypes.POINTER(_ctypes.c_char)):
+        raise TypeError('expected char pointer')
+    res = bytearray(length)
+    rptr = (_ctypes.c_char * length).from_buffer(res)
+    if not _ctypes.memmove(rptr, cptr, length):
+        raise RuntimeError('memmove failed')
+    return res
+
+
+def ctypes2numpy_shared(cptr, shape):
+    """Wrap a ctypes float pointer as a shared-memory numpy array."""
+    import numpy as _np
+    if not isinstance(cptr, _ctypes.POINTER(_ctypes.c_float)):
+        raise RuntimeError('expected float pointer')
+    size = 1
+    for s in shape:
+        size *= s
+    dbuffer = (_ctypes.c_float * size).from_address(
+        _ctypes.addressof(cptr.contents))
+    return _np.frombuffer(dbuffer, dtype=_np.float32).reshape(shape)
+
+
+def build_param_doc(arg_names, arg_types, arg_descs, remove_dup=True):
+    """Build an operator parameter docstring block (reference
+    base.py:186)."""
+    param_keys = set()
+    param_str = []
+    for key, type_info, desc in zip(arg_names, arg_types, arg_descs):
+        if key in param_keys and remove_dup:
+            continue
+        if key == 'num_args':
+            continue
+        param_keys.add(key)
+        ret = '%s : %s' % (key, type_info)
+        if len(desc) != 0:
+            ret += '\n    ' + desc
+        param_str.append(ret)
+    return 'Parameters\n----------\n%s\n' % str.join('\n', param_str)
+
+
+def add_fileline_to_docstring(module, incursive=True):
+    """Append the definition position to every function docstring in a
+    module (reference base.py:214) — a doc-tooling hook."""
+    import inspect
+    import sys as _sys
+
+    def _add(obj):
+        try:
+            fname = inspect.getsourcefile(obj)
+            line = inspect.getsourcelines(obj)[-1]
+        except Exception:
+            return
+        if obj.__doc__ and 'From:' not in obj.__doc__:
+            obj.__doc__ += '\n\nFrom:%s:%d' % (fname, line)
+
+    if isinstance(module, str):
+        module = _sys.modules[module]
+    for _, obj in module.__dict__.items():
+        if inspect.isfunction(obj):
+            _add(obj)
+        elif inspect.isclass(obj) and incursive:
+            for _, meth in obj.__dict__.items():
+                if inspect.isfunction(meth):
+                    _add(meth)

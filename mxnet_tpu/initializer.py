@@ -14,6 +14,7 @@ from . import ndarray as nd
 from . import random as _random
 
 __all__ = ['InitDesc', 'Initializer', 'Uniform', 'Normal', 'Orthogonal',
+           'LSTMBias',
            'Xavier', 'MSRAPrelu', 'Bilinear', 'One', 'Zero', 'Constant',
            'Load', 'Mixed', 'register', 'init']
 
@@ -122,6 +123,26 @@ class One(Initializer):
     def _init_weight(self, _, arr):
         arr[:] = 1.0
 
+    _init_default = _init_weight
+
+
+@register
+class LSTMBias(Initializer):
+    """All LSTM biases 0 except the forget gate at ``forget_bias``
+    (reference initializer.py:653, Jozefowicz et al. 2015)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        arr[:] = 0.0
+        num_hidden = int(arr.shape[0] / 4)
+        arr[num_hidden:2 * num_hidden] = self.forget_bias
+
+    # our dispatch routes '*_bias' names here (reference reaches its
+    # _init_weight through per-param __init__ attrs instead)
+    _init_bias = _init_weight
     _init_default = _init_weight
 
 

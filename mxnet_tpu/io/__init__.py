@@ -25,7 +25,7 @@ from ..base import MXNetError
 
 __all__ = ['DataDesc', 'DataBatch', 'DataIter', 'NDArrayIter', 'CSVIter',
            'MNISTIter', 'ResizeIter', 'PrefetchingIter', 'ImageRecordIter',
-           'ImageDetRecordIter', 'LibSVMIter']
+           'ImageDetRecordIter', 'LibSVMIter', 'MXDataIter']
 
 
 class DataDesc(namedtuple('DataDesc', ['name', 'shape'])):
@@ -741,3 +741,67 @@ class ImageDetRecordIter(DataIter):
 
     def iter_next(self):
         return self._inner.iter_next()
+
+
+class MXDataIter(DataIter):
+    """Wrapper around an engine-owned iterator handle (reference
+    io.py:758 wraps a ctypes DataIterHandle; here the handle IS the
+    underlying python iterator object — the same object the C ABI's
+    MXDataIterCreateIter hands out through the embedded interpreter).
+    Exposes the handle-style protocol: next/getdata/getlabel/getpad
+    with single-buffer semantics."""
+
+    def __init__(self, handle, data_name='data',
+                 label_name='softmax_label', **_):
+        if not isinstance(handle, DataIter):
+            raise TypeError('MXDataIter wraps a data-iterator handle; '
+                            'got %r' % (handle,))
+        super().__init__(getattr(handle, 'batch_size', 1))
+        self.handle = handle
+        self._debug_skip_load = False
+        self.first_batch = handle.next()
+        data, label = self.first_batch.data[0], self.first_batch.label[0]
+        self.provide_data = [DataDesc(data_name, data.shape, data.dtype)]
+        self.provide_label = [DataDesc(label_name, label.shape,
+                                       label.dtype)]
+        self._current = None
+
+    def debug_skip_load(self):
+        """Reference parity: skip loading and return the first batch."""
+        self._debug_skip_load = True
+
+    def reset(self):
+        self._current = None
+        self.first_batch = None
+        self.handle.reset()
+
+    def next(self):
+        if self._debug_skip_load and self.first_batch is not None:
+            self._current = self.first_batch
+            return self.first_batch
+        if self.first_batch is not None:
+            batch, self.first_batch = self.first_batch, None
+            self._current = batch
+            return batch
+        self._current = self.handle.next()
+        return self._current
+
+    def iter_next(self):
+        try:
+            self.next()
+            return True
+        except StopIteration:
+            self._current = None
+            return False
+
+    def getdata(self):
+        return self._current.data[0]
+
+    def getlabel(self):
+        return self._current.label[0]
+
+    def getindex(self):
+        return getattr(self._current, 'index', None)
+
+    def getpad(self):
+        return getattr(self._current, 'pad', 0) or 0

@@ -32,3 +32,7 @@ def get_logger(name=None, filename=None, filemode=None, level=logging.WARNING):
     logger.setLevel(level)
     logger._mxtpu_init = True
     return logger
+
+
+# reference log.py exports the camelCase name as well
+getLogger = get_logger

@@ -12,6 +12,7 @@ import numpy
 from . import ndarray
 
 __all__ = ['EvalMetric', 'CompositeEvalMetric', 'Accuracy', 'TopKAccuracy',
+           'Torch', 'Caffe',
            'F1', 'Perplexity', 'MAE', 'MSE', 'RMSE', 'CrossEntropy', 'Loss',
            'PearsonCorrelation', 'CustomMetric', 'np', 'create', 'check_label_shapes']
 
@@ -351,6 +352,22 @@ class Loss(EvalMetric):
         for pred in preds:
             self.sum_metric += pred.asnumpy().sum()
             self.num_inst += pred.size
+
+
+@register()
+class Torch(Loss):
+    """Dummy metric for torch criterions (reference metric.py:1002)."""
+
+    def __init__(self, name='torch', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
+
+
+@register()
+class Caffe(Loss):
+    """Dummy metric for caffe criterions (reference metric.py:1011)."""
+
+    def __init__(self, name='caffe', output_names=None, label_names=None):
+        super().__init__(name, output_names, label_names)
 
 
 class CustomMetric(EvalMetric):

@@ -1,0 +1,8 @@
+"""Legacy compatibility shims (reference python/mxnet/misc.py): the
+pre-lr_scheduler learning-rate classes some old scripts import."""
+from .lr_scheduler import FactorScheduler, LRScheduler
+
+__all__ = ['FactorScheduler', 'LearningRateScheduler']
+
+# the ancient name for the scheduler base class
+LearningRateScheduler = LRScheduler

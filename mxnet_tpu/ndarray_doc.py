@@ -1,0 +1,64 @@
+"""Per-operator docstring addenda for the ndarray namespace (reference
+python/mxnet/ndarray_doc.py): subclass NDArrayDoc with the operator's
+name to append examples to the generated wrapper's docstring."""
+from .base import build_param_doc as _build_param_doc  # noqa: F401
+
+__all__ = ['NDArrayDoc']
+
+
+class NDArrayDoc(object):
+    """Base class: subclasses named ``<op>Doc`` contribute their
+    docstring to the generated ``nd.<op>`` wrapper."""
+
+
+class ReshapeDoc(NDArrayDoc):
+    """
+    Examples
+    --------
+    >>> x = mx.nd.arange(6).reshape((2, 3))
+    >>> x.shape
+    (2, 3)
+    """
+
+
+class elemwise_addDoc(NDArrayDoc):
+    """
+    Example
+    -------
+    >>> (mx.nd.ones((2,)) + mx.nd.ones((2,))).asnumpy()
+    array([ 2.,  2.], dtype=float32)
+    """
+
+
+class BroadcastToDoc(NDArrayDoc):
+    """
+    Examples
+    --------
+    >>> mx.nd.ones((1, 3)).broadcast_to((2, 3)).shape
+    (2, 3)
+    """
+
+
+class CustomDoc(NDArrayDoc):
+    """
+    Example
+    -------
+    >>> mx.nd.Custom(x, label, op_type='my_softmax')
+    """
+
+
+def _build_doc(func_name, desc, arg_names, arg_types, arg_desc,
+               key_var_num_args=None, ret_type=None):
+    """Assemble a generated-wrapper docstring (reference
+    ndarray_doc.py:_build_doc)."""
+    doc_str = desc + '\n\n' + _build_param_doc(arg_names, arg_types,
+                                               arg_desc)
+    if key_var_num_args:
+        doc_str += '\nThis function supports variable length of '
+        doc_str += 'positional input.\n'
+    if ret_type:
+        doc_str += '\nReturns\n-------\n%s\n    The result.' % ret_type
+    hook = globals().get('%sDoc' % func_name)
+    if hook and hook.__doc__:
+        doc_str += hook.__doc__
+    return doc_str

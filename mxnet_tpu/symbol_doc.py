@@ -1,0 +1,91 @@
+"""Per-operator docstring addenda for the symbol namespace (reference
+python/mxnet/symbol_doc.py): subclass SymbolDoc with the operator's
+name to append examples to the generated wrapper's docstring."""
+from .base import build_param_doc as _build_param_doc  # noqa: F401
+
+__all__ = ['SymbolDoc']
+
+
+class SymbolDoc(object):
+    """Base class: subclasses named ``<op>Doc`` contribute their
+    docstring to the generated ``sym.<op>`` wrapper. Also hosts the
+    doc-test helpers the reference exposed here."""
+
+    @staticmethod
+    def get_output_shape(sym, **input_shapes):
+        """Infer and return output shapes keyed by output name."""
+        _, s_outputs, _ = sym.infer_shape(**input_shapes)
+        return dict(zip(sym.list_outputs(), s_outputs))
+
+
+class ActivationDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> relu = mx.sym.Activation(data, act_type='relu')
+    """
+
+
+class DropoutDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> out = mx.sym.Dropout(data, p=0.5)
+    """
+
+
+class EmbeddingDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> emb = mx.sym.Embedding(data, input_dim=1000, output_dim=16)
+    """
+
+
+class FlattenDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> flat = mx.sym.Flatten(data)
+    """
+
+
+class FullyConnectedDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> fc = mx.sym.FullyConnected(data, num_hidden=128)
+    """
+
+
+class ConcatDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> out = mx.sym.Concat(a, b, dim=1)
+    """
+
+
+class BroadcastPlusDoc(SymbolDoc):
+    """
+    Examples
+    --------
+    >>> c = mx.sym.broadcast_plus(a, b)
+    """
+
+
+def _build_doc(func_name, desc, arg_names, arg_types, arg_desc,
+               key_var_num_args=None, ret_type=None):
+    """Assemble a generated-wrapper docstring (reference
+    symbol_doc.py:_build_doc)."""
+    doc_str = desc + '\n\n' + _build_param_doc(arg_names, arg_types,
+                                               arg_desc)
+    if key_var_num_args:
+        doc_str += '\nThis function supports variable length of '
+        doc_str += 'positional input.\n'
+    if ret_type:
+        doc_str += '\nReturns\n-------\n%s\n    The result.' % ret_type
+    hook = globals().get('%sDoc' % func_name)
+    if hook and hook.__doc__:
+        doc_str += hook.__doc__
+    return doc_str

@@ -381,3 +381,233 @@ def check_consistency(sym_, ctx_list, scale=1.0, grad_req='write',
                     assert_almost_equal(g.asnumpy(), gt['grads'][name],
                                         rtol=t, atol=t)
     return gt
+
+
+# ---------------------------------------------------------------------------
+# remaining reference test_utils surface (reference test_utils.py): nan-
+# tolerant comparisons, reduction/compat helpers, env/system utilities.
+# ---------------------------------------------------------------------------
+
+def rand_shape_nd(num_dim, dim=10):
+    """Random shape with ``num_dim`` dims, each in [1, dim]."""
+    return tuple(np.random.randint(1, dim + 1, size=num_dim))
+
+
+def np_reduce(dat, axis, keepdims, numpy_reduce_func):
+    """Reduce with per-axis looping (reference test_utils.py:np_reduce —
+    the oracle used against symbolic reduce ops)."""
+    if isinstance(axis, int):
+        axis = [axis]
+    else:
+        axis = list(axis) if axis is not None else range(len(dat.shape))
+    ret = dat
+    for i in reversed(sorted(axis)):
+        ret = numpy_reduce_func(ret, axis=i)
+    if keepdims:
+        keepdims_shape = list(dat.shape)
+        for i in axis:
+            keepdims_shape[i] = 1
+        ret = ret.reshape(tuple(keepdims_shape))
+    return ret
+
+
+def almost_equal_ignore_nan(a, b, rtol=None, atol=None):
+    """Element-wise closeness, ignoring positions where either side is
+    NaN."""
+    a = np.copy(np.asarray(a))
+    b = np.copy(np.asarray(b))
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    return almost_equal(a, b, rtol, atol)
+
+
+def assert_almost_equal_ignore_nan(a, b, rtol=None, atol=None,
+                                   names=('a', 'b')):
+    a = np.copy(np.asarray(a))
+    b = np.copy(np.asarray(b))
+    nan_mask = np.logical_or(np.isnan(a), np.isnan(b))
+    a[nan_mask] = 0
+    b[nan_mask] = 0
+    assert_almost_equal(a, b, rtol, atol, names)
+
+
+def find_max_violation(a, b, rtol=None, atol=None):
+    """Location and value of the maximum relative error."""
+    a, b = np.asarray(a), np.asarray(b)
+    rtol = get_rtol(a, b, rtol)
+    atol = get_atol(a, b, atol)
+    diff = np.abs(a - b)
+    tol = atol + rtol * np.abs(b)
+    violation = diff / (tol + 1e-20)
+    loc = np.unravel_index(np.argmax(violation), violation.shape)
+    return loc, float(violation[loc])
+
+
+def same_array(array1, array2):
+    """Whether two NDArrays share one memory block (reference
+    test_utils.py:same_array — mutate-and-compare probe)."""
+    array1[:] += 1
+    if not same(array1.asnumpy(), array2.asnumpy()):
+        array1[:] -= 1
+        return False
+    array1[:] -= 1
+    return same(array1.asnumpy(), array2.asnumpy())
+
+
+def random_arrays(*shapes):
+    """One random fp32 ndarray per shape (scalars for ())."""
+    arrays = [np.random.randn(*s).astype(np.float32)
+              if len(s) else np.float32(np.random.randn()) for s in shapes]
+    return arrays[0] if len(arrays) == 1 else arrays
+
+
+def random_sample(population, k):
+    """k elements sampled without replacement, order randomized."""
+    assert 0 <= k <= len(population)
+    population_copy = population[:]
+    np.random.shuffle(population_copy)
+    return population_copy[0:k]
+
+
+def retry(n):
+    """Test decorator: retry flaky (randomized) tests up to n times."""
+    assert n > 0
+
+    def decorate(f):
+        import functools
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError as e:
+                    if i == n - 1:
+                        raise e
+        return wrapper
+    return decorate
+
+
+def discard_stderr():
+    """Context manager silencing C-level stderr (reference
+    test_utils.py:discard_stderr)."""
+    import contextlib
+    import os as _os
+
+    @contextlib.contextmanager
+    def _ctx():
+        stderr_fileno = 2
+        old_stderr = _os.dup(stderr_fileno)
+        try:
+            with open(_os.devnull, 'w') as bit_bucket:
+                _os.dup2(bit_bucket.fileno(), stderr_fileno)
+                yield
+        finally:
+            _os.dup2(old_stderr, stderr_fileno)
+            _os.close(old_stderr)
+    return _ctx()
+
+
+def set_env_var(key, val, default_val=''):
+    """Set an env var, returning the previous value."""
+    import os as _os
+    prev_val = _os.environ.get(key, default_val)
+    _os.environ[key] = val
+    return prev_val
+
+
+def list_gpus():
+    """Indices of visible accelerator devices (the reference shelled out
+    to nvidia-smi; here: jax's non-cpu devices)."""
+    import jax
+    try:
+        return [d.id for d in jax.devices() if d.platform != 'cpu']
+    except RuntimeError:
+        return []
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Reference test_utils.py:download. This environment has no
+    network egress: local file:// paths (or existing local files) are
+    copied; anything else raises with that explanation."""
+    import os as _os
+    import shutil
+    src = url[7:] if url.startswith('file://') else url
+    if fname is None:
+        fname = url.split('/')[-1]
+    if dirname is not None:
+        fname = _os.path.join(dirname, fname)
+        _os.makedirs(dirname, exist_ok=True)
+    if _os.path.exists(fname) and not overwrite:
+        return fname
+    if _os.path.exists(src):
+        if _os.path.abspath(src) != _os.path.abspath(fname):
+            shutil.copyfile(src, fname)
+        return fname
+    raise IOError('download(%r): no network egress in this environment; '
+                  'place the file locally and pass its path' % url)
+
+
+def get_mnist():
+    """MNIST-format dict (train_data/label, test_data/label). Real idx
+    files are used when present in ./data; otherwise the io tier's
+    synthetic class-separable MNIST stands in (hermetic CI)."""
+    from .io import MNISTIter
+    out = {}
+    for split, image, label, n in (
+            ('train', 'data/train-images-idx3-ubyte',
+             'data/train-labels-idx1-ubyte', 2048),
+            ('test', 'data/t10k-images-idx3-ubyte',
+             'data/t10k-labels-idx1-ubyte', 512)):
+        it = MNISTIter(image=image, label=label, batch_size=n,
+                       shuffle=False, flat=False)
+        batch = next(iter(it))
+        out['%s_data' % split] = batch.data[0].asnumpy()
+        out['%s_label' % split] = batch.label[0].asnumpy()
+    return out
+
+
+def check_speed(sym, location=None, ctx=None, N=20, grad_req=None,
+                typ='whole', **kwargs):
+    """Time forward (typ='forward') or forward+backward (typ='whole')
+    executions per second (reference test_utils.py:check_speed)."""
+    import time as _time
+    ctx = ctx or default_context()
+    if grad_req is None:
+        grad_req = 'write' if typ == 'whole' else 'null'
+    if location is None:
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx, **kwargs)
+        location = {k: np.random.normal(size=arr.shape, scale=1.0)
+                    for k, arr in exe.arg_dict.items()}
+    else:
+        exe = sym.simple_bind(grad_req=grad_req, ctx=ctx,
+                              **{k: v.shape for k, v in location.items()})
+    for name, iarr in location.items():
+        exe.arg_dict[name][:] = iarr.astype(exe.arg_dict[name].dtype)
+
+    if typ == 'whole':
+        def run():
+            exe.forward(is_train=True)
+            exe.backward(out_grads=exe.outputs)
+    elif typ == 'forward':
+        def run():
+            exe.forward(is_train=False)
+    else:
+        raise ValueError('typ can only be "whole" or "forward"')
+    def barrier():
+        # fetch outputs AND grads: the final backward program is
+        # enqueued after the forward output, so an output fetch alone
+        # would leave one backward untimed
+        exe.outputs[0].asnumpy()
+        for g in (exe.grad_arrays or []):
+            if g is not None:
+                g.asnumpy()
+
+    run()                      # warmup + compile
+    barrier()
+    tic = _time.time()
+    for _ in range(N):
+        run()
+    barrier()
+    return (_time.time() - tic) / N

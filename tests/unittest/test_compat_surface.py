@@ -1,0 +1,151 @@
+"""Reference API-surface compat additions (round 3): autograd.Function,
+tape->symbol export, base ctypes helpers, LSTMBias, MXDataIter, legacy
+metric/doc/misc modules, test_utils long tail.
+
+Reference files: python/mxnet/{autograd,base,initializer,io,metric,
+misc,ndarray_doc,symbol_doc,test_utils}.py
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, test_utils
+
+
+def test_autograd_function_custom_backward():
+    class sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + mx.nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            y, = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = mx.nd.array([0.0, 1.0, -2.0])
+    x.attach_grad()
+    w = mx.nd.array([1., 2., 3.])
+    with autograd.record():
+        loss = (sigmoid()(x) * w).sum()
+    loss.backward()
+    yn = 1 / (1 + np.exp(-x.asnumpy()))
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               w.asnumpy() * yn * (1 - yn), rtol=1e-5)
+    # single-use contract
+    f = sigmoid()
+    f(mx.nd.ones((2,)))
+    with pytest.raises(AssertionError):
+        f(mx.nd.ones((2,)))
+
+
+def test_autograd_get_symbol():
+    a = mx.nd.array([1., 2.])
+    a.attach_grad()
+    with autograd.record():
+        b = mx.nd.exp(a) + 1
+    s = autograd.get_symbol(b)
+    assert s.list_arguments() == ['var0']
+    exe = s.simple_bind(mx.cpu(), var0=(2,), grad_req='null')
+    exe.arg_dict['var0'][:] = a.asnumpy()
+    exe.forward()
+    np.testing.assert_allclose(exe.outputs[0].asnumpy(), b.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_base_compat_helpers():
+    import ctypes
+    from mxnet_tpu import base
+    arr = base.c_array(ctypes.c_int, [1, 2, 3])
+    assert list(arr) == [1, 2, 3]
+    doc = base.build_param_doc(['alpha'], ['float'], ['scaling factor'])
+    assert 'alpha : float' in doc and 'scaling factor' in doc
+    err = base.NotImplementedForSymbol(test_base_compat_helpers, 'op')
+    assert 'not supported for Symbol' in str(err)
+    err2 = base.NotSupportedForSparseNDArray(test_base_compat_helpers, None)
+    assert 'SparseNDArray' in str(err2)
+    assert base.MXCallbackList._fields_[0][0] == 'num_callbacks'
+    buf = ctypes.create_string_buffer(b'abc')
+    got = base.ctypes2buffer(ctypes.cast(buf, ctypes.POINTER(ctypes.c_char)), 3)
+    assert bytes(got) == b'abc'
+
+
+def test_lstm_bias_initializer():
+    arr = mx.nd.zeros((12,))
+    mx.init.LSTMBias(forget_bias=2.0)('lstm0_i2h_bias', arr)
+    expect = np.zeros(12)
+    expect[3:6] = 2.0
+    np.testing.assert_allclose(arr.asnumpy(), expect)
+
+
+def test_mxdataiter_wrapper():
+    inner = mx.io.NDArrayIter(np.arange(32, dtype=np.float32).reshape(8, 4),
+                              np.zeros(8, np.float32), batch_size=4)
+    it = mx.io.MXDataIter(inner)
+    assert it.provide_data[0].shape == (4, 4)
+    batches = list(it)
+    assert len(batches) == 2
+    it.reset()
+    assert it.iter_next()
+    assert it.getdata().shape == (4, 4)
+    assert it.getpad() == 0
+    with pytest.raises(TypeError):
+        mx.io.MXDataIter('not-a-handle')
+
+
+def test_legacy_metric_and_misc_modules():
+    for name in ('torch', 'caffe'):
+        m = mx.metric.create(name)
+        m.update(None, [mx.nd.array([1.0, 3.0])])
+        assert m.get()[1] == 2.0
+    from mxnet_tpu import misc
+    assert misc.LearningRateScheduler is mx.lr_scheduler.LRScheduler
+    assert misc.FactorScheduler is mx.lr_scheduler.FactorScheduler
+    from mxnet_tpu import ndarray_doc, symbol_doc
+    assert ndarray_doc.NDArrayDoc and symbol_doc.SymbolDoc
+    d = symbol_doc._build_doc('FullyConnected', 'desc.', ['num_hidden'],
+                              ['int'], ['hidden dim'])
+    assert 'num_hidden : int' in d and 'mx.sym.FullyConnected' in d
+    shapes = symbol_doc.SymbolDoc.get_output_shape(
+        mx.sym.FullyConnected(mx.sym.Variable('data'), num_hidden=4),
+        data=(2, 8))
+    assert list(shapes.values())[0] == (2, 4)
+
+
+def test_test_utils_long_tail():
+    tu = test_utils
+    assert tu.np_reduce(np.ones((2, 3, 4)), [0, 2], True, np.sum).shape \
+        == (1, 3, 1)
+    assert len(tu.rand_shape_nd(3, dim=5)) == 3
+    a = np.array([1.0, np.nan, 2.0])
+    b = np.array([1.0, np.nan, 2.0])
+    assert tu.almost_equal_ignore_nan(a, b)
+    tu.assert_almost_equal_ignore_nan(a, b)
+    loc, viol = tu.find_max_violation(np.array([1., 2.]),
+                                      np.array([1., 2.2]))
+    assert loc == (1,)
+    x = mx.nd.ones((3,))
+    assert tu.same_array(x, x)
+    assert not tu.same_array(mx.nd.ones((3,)), mx.nd.ones((3,)))
+    assert sorted(tu.random_sample([1, 2, 3, 4], 2))[0] in (1, 2, 3)
+    calls = []
+
+    @tu.retry(3)
+    def flaky():
+        calls.append(1)
+        if len(calls) < 2:
+            raise AssertionError('first try fails')
+    flaky()
+    assert len(calls) == 2
+    prev = tu.set_env_var('MXTPU_TEST_DUMMY', 'x', 'none')
+    assert prev == 'none'
+    assert tu.list_gpus() == []          # cpu mesh harness
+    m = tu.get_mnist()
+    assert m['train_data'].shape[1:] == (1, 28, 28)
+    assert m['test_label'].shape[0] == m['test_data'].shape[0]
+    dt = tu.check_speed(mx.sym.FullyConnected(mx.sym.Variable('data'),
+                                              num_hidden=4),
+                        data=(4, 8), N=2)
+    assert dt >= 0
+    with tu.discard_stderr():
+        pass
