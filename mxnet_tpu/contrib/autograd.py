@@ -5,7 +5,8 @@ is mx.autograd.
 from .. import autograd as _ag
 
 __all__ = ['set_is_training', 'train_section', 'test_section',
-           'backward', 'grad_and_loss', 'grad', 'mark_variables']
+           'backward', 'grad_and_loss', 'grad', 'mark_variables',
+           'TrainingStateScope', 'compute_gradient']
 
 
 def set_is_training(is_train):
@@ -47,3 +48,14 @@ def backward(outputs, out_grads=None, retain_graph=False):
 grad_and_loss = _ag.grad_and_loss
 grad = _ag.grad
 mark_variables = _ag.mark_variables
+
+
+# reference contrib/autograd.py:53 exports the scope class itself and a
+# compute_gradient helper
+TrainingStateScope = _Section
+
+
+def compute_gradient(outputs):
+    """Compute gradients of outputs w.r.t. marked variables
+    (reference contrib/autograd.py:105)."""
+    _ag.backward(outputs)

@@ -13,7 +13,7 @@ import numpy as np
 
 from ... import ndarray as nd
 from ...io import synthetic_mnist
-from .dataset import Dataset
+from .dataset import Dataset, RecordFileDataset
 
 __all__ = ['MNIST', 'FashionMNIST', 'CIFAR10']
 
@@ -110,3 +110,65 @@ class CIFAR10(_DownloadedDataset):
             data = (data * 255).astype(np.uint8)
         self._data = [nd.array(x, dtype=np.uint8) for x in data]
         self._label = label
+
+
+class ImageFolderDataset(Dataset):
+    """Images stored as ``root/<class>/<file>.jpg`` (reference
+    data/vision.py:233): class names come from the sorted folder names.
+
+    ``transform`` receives ``(data, label)`` and returns the same pair.
+    """
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = ['.jpg', '.jpeg', '.png']
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                ext = os.path.splitext(filename)[1].lower()
+                if ext not in self._exts:
+                    continue
+                self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from ... import image
+        img = image.imread(self.items[idx][0], flag=self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)
+
+
+class ImageRecordDataset(RecordFileDataset):
+    """Images packed in a RecordIO file (reference data/vision.py:300):
+    each record is an image-record header + encoded image, as written
+    by tools/im2rec.py."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        super().__init__(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from ... import image, recordio
+        record = super().__getitem__(idx)
+        header, img_bytes = recordio.unpack(record)
+        img = image.imdecode(img_bytes, flag=self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

@@ -1,0 +1,44 @@
+"""Pre-trained model parameter store (reference gluon/model_zoo/
+model_store.py). This environment has no network egress, so
+``get_model_file`` resolves ONLY against the local directory (drop
+``<name>.params`` files there yourself); the rest of the API —
+existence checks, purge, the sha1 table protocol — behaves as the
+reference's."""
+import os
+
+__all__ = ['get_model_file', 'purge']
+
+# name -> sha1 of the published .params (reference _model_sha1); empty
+# here because nothing can be fetched without egress — local files are
+# trusted as-is.
+_model_sha1 = {}
+
+
+def short_hash(name):
+    if name not in _model_sha1:
+        raise ValueError('Pretrained model for %s is not available.' % name)
+    return _model_sha1[name][:8]
+
+
+def get_model_file(name, local_dir=os.path.expanduser('~/.mxnet/models/')):
+    """Return the path of a locally present pre-trained parameter file.
+
+    The reference downloads from the model zoo on miss; without network
+    egress a miss raises with instructions instead."""
+    file_path = os.path.join(local_dir, '%s.params' % name)
+    if os.path.exists(file_path):
+        return file_path
+    raise IOError(
+        'Pretrained model file %s is not present and this environment '
+        'has no network egress. Place the reference-format .params file '
+        'at that path (checkpoints interoperate, docs/migration.md), or '
+        'train from scratch with pretrained=False.' % file_path)
+
+
+def purge(local_dir=os.path.expanduser('~/.mxnet/models/')):
+    """Remove all cached model files (reference model_store.py:108)."""
+    if not os.path.isdir(local_dir):
+        return
+    for f in os.listdir(local_dir):
+        if f.endswith('.params'):
+            os.remove(os.path.join(local_dir, f))

@@ -124,6 +124,17 @@ def _make_E(prefix):
     return out
 
 
+def make_aux(classes):
+    """Auxiliary classifier head (reference vision/inception.py:145)."""
+    out = nn.HybridSequential(prefix='')
+    out.add(nn.AvgPool2D(pool_size=5, strides=3))
+    out.add(_make_basic_conv(channels=128, kernel_size=1))
+    out.add(_make_basic_conv(channels=768, kernel_size=5))
+    out.add(nn.Flatten())
+    out.add(nn.Dense(classes))
+    return out
+
+
 class Inception3(HybridBlock):
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)

@@ -253,3 +253,47 @@ class ImageDetIter(ImageIter):
                          pad=0, index=None,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly select one augmenter to apply, or skip with
+    ``skip_prob`` (reference detection.py DetRandomSelectAug)."""
+
+    def __init__(self, aug_list, skip_prob=0):
+        self.aug_list = list(aug_list)
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if random.random() < self.skip_prob or not self.aug_list:
+            return src, label
+        return random.choice(self.aug_list)(src, label)
+
+
+def CreateMultiRandCropAugmenter(min_object_covered=0.1,
+                                 aspect_ratio_range=(0.75, 1.33),
+                                 area_range=(0.05, 1.0),
+                                 min_eject_coverage=0.3, max_attempts=50,
+                                 skip_prob=0):
+    """Multiple random-crop augmenters, one chosen per sample
+    (reference detection.py:417). List-valued parameters are aligned
+    pairwise; scalar parameters broadcast. Each entry maps onto this
+    module's simplified DetRandomCropAug (area_range -> crop scale,
+    max_attempts -> trials); coverage thresholds are handled by the
+    center-in-crop keep rule."""
+    def listify(p):
+        return p if isinstance(p, list) else [p]
+
+    params = [listify(min_object_covered), listify(aspect_ratio_range),
+              listify(area_range), listify(min_eject_coverage),
+              listify(max_attempts)]
+    num = max(len(p) for p in params)
+    for i, p in enumerate(params):
+        if len(p) != num:
+            assert len(p) == 1, 'parameter lists must align or be scalar'
+            params[i] = p * num
+    augs = []
+    for _, _, area, _, attempts in zip(*params):
+        lo = float(area[0]) if isinstance(area, (tuple, list)) else 0.05
+        augs.append(DetRandomCropAug(min_scale=max(lo, 0.05) ** 0.5,
+                                     max_trials=int(attempts)))
+    return DetRandomSelectAug(augs, skip_prob=skip_prob)

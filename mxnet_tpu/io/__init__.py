@@ -760,10 +760,14 @@ class MXDataIter(DataIter):
         self.handle = handle
         self._debug_skip_load = False
         self.first_batch = handle.next()
-        data, label = self.first_batch.data[0], self.first_batch.label[0]
+        data = self.first_batch.data[0]
         self.provide_data = [DataDesc(data_name, data.shape, data.dtype)]
-        self.provide_label = [DataDesc(label_name, label.shape,
-                                       label.dtype)]
+        if self.first_batch.label:
+            label = self.first_batch.label[0]
+            self.provide_label = [DataDesc(label_name, label.shape,
+                                           label.dtype)]
+        else:
+            self.provide_label = []
         self._current = None
 
     def debug_skip_load(self):
@@ -798,7 +802,7 @@ class MXDataIter(DataIter):
         return self._current.data[0]
 
     def getlabel(self):
-        return self._current.label[0]
+        return self._current.label[0] if self._current.label else None
 
     def getindex(self):
         return getattr(self._current, 'index', None)

@@ -109,6 +109,39 @@ def negative(data):
 def true_divide(lhs, rhs):
     return divide(lhs, rhs)
 
+
+def modulo(lhs, rhs):
+    if isinstance(rhs, NDArray):
+        return invoke('broadcast_mod', [lhs, rhs], {})
+    return invoke('_mod_scalar', [lhs], {'scalar': float(rhs)})
+
+
+def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,
+             channels=3, mean=None):
+    """Decode an image bytestring to NDArray (reference
+    ndarray.py:imdecode over the cv codec op). ``clip_rect``
+    (x0, y0, x1, y1) crops after decode."""
+    import numpy as _np
+    flag = 1 if channels == 3 else 0
+    buf = array(_np.frombuffer(
+        str_img if isinstance(str_img, bytes) else str_img.encode('latin1'),
+        dtype=_np.uint8), dtype=_np.uint8)
+    img = invoke('_cvimdecode', [buf], {'flag': flag, 'to_rgb': False})
+    x0, y0, x1, y1 = clip_rect
+    if x1 > x0 and y1 > y0:
+        img = img[y0:y1, x0:x1]
+    if mean is not None:
+        img = img - mean
+    if out is not None:
+        # a 4-D out is a pre-allocated batch; `index` picks the slot
+        # (reference ndarray.py:imdecode)
+        if out.ndim == 4:
+            out[index] = img
+        else:
+            out[:] = img
+        return out
+    return img
+
 from . import contrib  # noqa: E402,F401  (mx.nd.contrib.*)
 
 

@@ -690,3 +690,17 @@ def onehot_encode(indices, out):
     res = invoke('one_hot', [indices], {'depth': depth})
     out._set_data(res._data)
     return out
+
+
+def __getattr__(name):
+    """Deep-import compat: the reference defines module-level helpers
+    (multiply, maximum, imdecode, ...) in ndarray/ndarray.py itself;
+    here they live on the package — forward lookups there."""
+    if name.startswith('_'):
+        raise AttributeError(name)
+    import sys as _s
+    pkg = _s.modules[__package__]
+    if hasattr(pkg, name):
+        return getattr(pkg, name)
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))

@@ -19,6 +19,8 @@ import warnings
 
 import numpy as np
 
+from .ndarray import empty, zeros  # noqa: F401  (reference utils.py re-exports)
+
 from .ndarray import NDArray, array
 
 __all__ = ['save', 'load']

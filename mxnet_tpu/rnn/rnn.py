@@ -2,7 +2,8 @@
 from .. import model
 from .rnn_cell import BaseRNNCell
 
-__all__ = ['save_rnn_checkpoint', 'load_rnn_checkpoint', 'do_rnn_checkpoint']
+__all__ = ['rnn_unroll', 'save_rnn_checkpoint', 'load_rnn_checkpoint',
+           'do_rnn_checkpoint']
 
 
 def _in_cells(cells):
@@ -36,3 +37,22 @@ def do_rnn_checkpoint(cells, prefix, period=1):
         if (iter_no + 1) % period == 0:
             save_rnn_checkpoint(cells, prefix, iter_no + 1, sym, arg, aux)
     return _callback
+
+
+def rnn_unroll(cell, length, inputs=None, begin_state=None,
+               input_prefix='', layout='NTC'):
+    """Deprecated (reference rnn/rnn.py:26): use cell.unroll directly.
+    With ``inputs=None`` the legacy form creates one
+    ``<input_prefix>t%d_data`` Variable per step, as the reference's
+    unroll did."""
+    import warnings
+
+    from .. import symbol
+    warnings.warn('rnn_unroll is deprecated. '
+                  'Please call cell.unroll directly.')
+    if inputs is None:
+        inputs = [symbol.Variable('%st%d_data' % (input_prefix, i))
+                  for i in range(length)]
+    outputs, states = cell.unroll(length=length, inputs=inputs,
+                                  begin_state=begin_state, layout=layout)
+    return outputs, states

@@ -11,7 +11,8 @@ from ..base import string_types
 
 __all__ = ['BaseRNNCell', 'RNNCell', 'LSTMCell', 'GRUCell', 'FusedRNNCell',
            'SequentialRNNCell', 'BidirectionalCell', 'DropoutCell',
-           'ModifierCell', 'ZoneoutCell', 'ResidualCell', 'RNNParams']
+           'ModifierCell', 'ZoneoutCell', 'ResidualCell', 'RNNParams',
+           'BaseConvRNNCell', 'ConvRNNCell', 'ConvLSTMCell', 'ConvGRUCell']
 
 
 class RNNParams:
@@ -680,3 +681,148 @@ def _cells_pack_weights(cells, args):
     for cell in cells:
         args = cell.pack_weights(args)
     return args
+
+
+class BaseConvRNNCell(BaseRNNCell):
+    """Convolutional RNN cell base (reference rnn_cell.py BaseConvRNNCell):
+    i2h and h2h are Convolutions over NCHW feature maps instead of
+    FullyConnected over vectors; states are feature maps."""
+
+    def __init__(self, input_shape, num_hidden, h2h_kernel=(3, 3),
+                 h2h_dilate=(1, 1), i2h_kernel=(3, 3), i2h_stride=(1, 1),
+                 i2h_pad=(1, 1), i2h_dilate=(1, 1), activation='tanh',
+                 prefix='', params=None, conv_layout='NCHW'):
+        super().__init__(prefix=prefix, params=params)
+        assert h2h_kernel[0] % 2 == 1 and h2h_kernel[1] % 2 == 1, \
+            'h2h_kernel must be odd, got %s' % str(h2h_kernel)
+        self._h2h_kernel = h2h_kernel
+        self._h2h_pad = (h2h_dilate[0] * (h2h_kernel[0] - 1) // 2,
+                         h2h_dilate[1] * (h2h_kernel[1] - 1) // 2)
+        self._h2h_dilate = h2h_dilate
+        self._i2h_kernel = i2h_kernel
+        self._i2h_stride = i2h_stride
+        self._i2h_pad = i2h_pad
+        self._i2h_dilate = i2h_dilate
+        self._num_hidden = num_hidden
+        self._input_shape = input_shape
+        self._activation = activation
+
+        # state shape = the i2h conv's output shape at batch 0
+        probe = symbol.Convolution(
+            symbol.Variable('data'), num_filter=num_hidden,
+            kernel=i2h_kernel, stride=i2h_stride, pad=i2h_pad,
+            dilate=i2h_dilate)
+        _, out_shapes, _ = probe.infer_shape(data=input_shape)
+        self._state_shape = (0,) + tuple(out_shapes[0][1:])
+
+        self._iW = self.params.get('i2h_weight')
+        self._hW = self.params.get('h2h_weight')
+        self._iB = self.params.get('i2h_bias')
+        self._hB = self.params.get('h2h_bias')
+
+    @property
+    def _num_gates(self):
+        return len(self._gate_names)
+
+    @property
+    def state_info(self):
+        return [{'shape': self._state_shape, '__layout__': 'NCHW'}]
+
+    def _conv_forward(self, inputs, states, name):
+        i2h = symbol.Convolution(
+            inputs, weight=self._iW, bias=self._iB,
+            num_filter=self._num_hidden * self._num_gates,
+            kernel=self._i2h_kernel, stride=self._i2h_stride,
+            pad=self._i2h_pad, dilate=self._i2h_dilate,
+            name='%si2h' % name)
+        h2h = symbol.Convolution(
+            states[0], weight=self._hW, bias=self._hB,
+            num_filter=self._num_hidden * self._num_gates,
+            kernel=self._h2h_kernel, pad=self._h2h_pad,
+            dilate=self._h2h_dilate, name='%sh2h' % name)
+        return i2h, h2h
+
+
+class ConvRNNCell(BaseConvRNNCell):
+    """Plain convolutional RNN (reference rnn_cell.py ConvRNNCell)."""
+
+    def __init__(self, input_shape, num_hidden, prefix='ConvRNN_', **kw):
+        super().__init__(input_shape, num_hidden, prefix=prefix, **kw)
+
+    @property
+    def _gate_names(self):
+        return ('',)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = '%st%d_' % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        out = symbol.Activation(i2h + h2h, act_type=self._activation,
+                                name='%sout' % name)
+        return out, [out]
+
+
+class ConvLSTMCell(BaseConvRNNCell):
+    """Convolutional LSTM (reference rnn_cell.py ConvLSTMCell,
+    Shi et al. 2015): LSTM gating over feature maps."""
+
+    def __init__(self, input_shape, num_hidden, prefix='ConvLSTM_',
+                 forget_bias=1.0, **kw):
+        super().__init__(input_shape, num_hidden, prefix=prefix, **kw)
+        self._forget_bias = forget_bias
+
+    @property
+    def _gate_names(self):
+        return ('_i', '_f', '_c', '_o')
+
+    @property
+    def state_info(self):
+        return [{'shape': self._state_shape, '__layout__': 'NCHW'},
+                {'shape': self._state_shape, '__layout__': 'NCHW'}]
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = '%st%d_' % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        gates = i2h + h2h
+        sliced = symbol.SliceChannel(gates, num_outputs=4,
+                                     name='%sslice' % name)
+        in_gate = symbol.Activation(sliced[0], act_type='sigmoid')
+        forget_gate = symbol.Activation(sliced[1] + self._forget_bias,
+                                        act_type='sigmoid')
+        in_transform = symbol.Activation(sliced[2],
+                                         act_type=self._activation)
+        out_gate = symbol.Activation(sliced[3], act_type='sigmoid')
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * symbol.Activation(next_c,
+                                              act_type=self._activation)
+        return next_h, [next_h, next_c]
+
+
+class ConvGRUCell(BaseConvRNNCell):
+    """Convolutional GRU (reference rnn_cell.py ConvGRUCell)."""
+
+    def __init__(self, input_shape, num_hidden, prefix='ConvGRU_', **kw):
+        super().__init__(input_shape, num_hidden, prefix=prefix, **kw)
+
+    @property
+    def _gate_names(self):
+        return ('_r', '_z', '_o')
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        name = '%st%d_' % (self._prefix, self._counter)
+        i2h, h2h = self._conv_forward(inputs, states, name)
+        i2h_s = symbol.SliceChannel(i2h, num_outputs=3,
+                                    name='%si2h_slice' % name)
+        h2h_s = symbol.SliceChannel(h2h, num_outputs=3,
+                                    name='%sh2h_slice' % name)
+        reset = symbol.Activation(i2h_s[0] + h2h_s[0], act_type='sigmoid',
+                                  name='%sr' % name)
+        update = symbol.Activation(i2h_s[1] + h2h_s[1], act_type='sigmoid',
+                                   name='%sz' % name)
+        cand = symbol.Activation(i2h_s[2] + reset * h2h_s[2],
+                                 act_type=self._activation,
+                                 name='%sh' % name)
+        next_h = (1.0 - update) * cand + update * states[0]
+        return next_h, [next_h]

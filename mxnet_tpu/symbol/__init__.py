@@ -17,6 +17,45 @@ def arange(start, stop=None, step=1.0, repeat=1, dtype='float32', **kwargs):
     return _register.make_sym_function('_arange')(start=start, stop=stop, step=step,
                                                   repeat=repeat, dtype=dtype, **kwargs)
 
+def full(shape, val, dtype='float32', **kwargs):
+    """Symbol filled with ``val`` (reference symbol.py:full)."""
+    z = zeros(shape, dtype=dtype, **kwargs)
+    return _register.make_sym_function('_plus_scalar')(z, scalar=float(val))
+
+
+def _sym_or_scalar_binary(lhs, rhs, sym_op, lscalar_op, rscalar_op):
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _register.make_sym_function(sym_op)(lhs, rhs)
+    if isinstance(lhs, Symbol):
+        return _register.make_sym_function(rscalar_op)(lhs, scalar=float(rhs))
+    if isinstance(rhs, Symbol):
+        return _register.make_sym_function(lscalar_op)(rhs, scalar=float(lhs))
+    raise TypeError('at least one argument must be a Symbol')
+
+
+def maximum(lhs, rhs):
+    return _sym_or_scalar_binary(lhs, rhs, '_maximum',
+                                 '_maximum_scalar', '_maximum_scalar')
+
+
+def minimum(lhs, rhs):
+    return _sym_or_scalar_binary(lhs, rhs, '_minimum',
+                                 '_minimum_scalar', '_minimum_scalar')
+
+
+def hypot(lhs, rhs):
+    """sqrt(lhs^2 + rhs^2) elementwise (reference symbol.py:hypot)."""
+    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
+        return _register.make_sym_function('_hypot')(lhs, rhs)
+    if isinstance(lhs, Symbol):
+        return _register.make_sym_function('_hypot_scalar')(
+            lhs, scalar=float(rhs))
+    if isinstance(rhs, Symbol):
+        return _register.make_sym_function('_hypot_scalar')(
+            rhs, scalar=float(lhs))
+    raise TypeError('at least one argument must be a Symbol')
+
+
 from . import contrib  # noqa: E402,F401  (mx.sym.contrib.*)
 from . import linalg    # noqa: E402,F401  (mx.sym.linalg.*)
 from . import random    # noqa: E402,F401  (mx.sym.random.*)

@@ -604,3 +604,17 @@ def load_json(json_str):
 def load(fname):
     with open(fname) as f:
         return load_json(f.read())
+
+
+def __getattr__(name):
+    """Deep-import compat: the reference defines module-level helpers
+    (arange, maximum, hypot, ...) in symbol/symbol.py itself; here they
+    live on the package — forward lookups there."""
+    if name.startswith('_'):
+        raise AttributeError(name)
+    import sys as _s
+    pkg = _s.modules[__package__]
+    if hasattr(pkg, name):
+        return getattr(pkg, name)
+    raise AttributeError('module %r has no attribute %r'
+                         % (__name__, name))
