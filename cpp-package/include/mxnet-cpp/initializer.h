@@ -1,0 +1,199 @@
+/*
+ * initializer.h — C++ parameter initializers.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/initializer.h (Initializer
+ * base dispatching on parameter name + Constant/Zero/One/Uniform/
+ * Normal/Bilinear/Xavier, and lr_scheduler.h's LRScheduler/
+ * FactorScheduler kept here as one compact surface).
+ */
+#ifndef MXNET_TPU_CPP_INITIALIZER_H_
+#define MXNET_TPU_CPP_INITIALIZER_H_
+
+#include <cmath>
+#include <random>
+#include <string>
+#include <vector>
+
+#include "MxNetCpp.h"
+
+namespace mxnet {
+namespace cpp {
+
+class Initializer {
+ public:
+  virtual ~Initializer() {}
+
+  virtual void operator()(const std::string &name, NDArray *arr) {
+    if (EndsWith(name, "weight") || EndsWith(name, "parameters"))
+      InitWeight(arr);
+    else if (EndsWith(name, "bias") || EndsWith(name, "beta") ||
+             EndsWith(name, "moving_mean") || EndsWith(name, "mean"))
+      Fill(arr, 0.0f);
+    else if (EndsWith(name, "gamma") || EndsWith(name, "moving_var") ||
+             EndsWith(name, "var"))
+      Fill(arr, 1.0f);
+    else
+      InitWeight(arr);
+  }
+
+ protected:
+  virtual void InitWeight(NDArray *arr) = 0;
+
+  static bool EndsWith(const std::string &s, const std::string &suf) {
+    return s.size() >= suf.size() &&
+           s.compare(s.size() - suf.size(), suf.size(), suf) == 0;
+  }
+  static void Fill(NDArray *arr, float v) {
+    std::vector<float> buf(arr->Size(), v);
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+  static std::mt19937 &Rng() {
+    static std::mt19937 rng(0);
+    return rng;
+  }
+};
+
+class Constant : public Initializer {
+ public:
+  explicit Constant(float value) : value_(value) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override { Fill(arr, value_); }
+  float value_;
+};
+
+class Zero : public Constant {
+ public:
+  Zero() : Constant(0.0f) {}
+};
+
+class One : public Constant {
+ public:
+  One() : Constant(1.0f) {}
+};
+
+class Uniform : public Initializer {
+ public:
+  explicit Uniform(float scale) : lo_(-scale), hi_(scale) {}
+  Uniform(float lo, float hi) : lo_(lo), hi_(hi) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override {
+    std::uniform_real_distribution<float> d(lo_, hi_);
+    std::vector<float> buf(arr->Size());
+    for (auto &v : buf) v = d(Rng());
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+  float lo_, hi_;
+};
+
+class Normal : public Initializer {
+ public:
+  Normal(float mu, float sigma) : mu_(mu), sigma_(sigma) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override {
+    std::normal_distribution<float> d(mu_, sigma_);
+    std::vector<float> buf(arr->Size());
+    for (auto &v : buf) v = d(Rng());
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+  float mu_, sigma_;
+};
+
+class Bilinear : public Initializer {
+ public:
+  Bilinear() {}
+
+ protected:
+  /* upsampling-deconv kernel (reference initializer.h Bilinear) */
+  void InitWeight(NDArray *arr) override {
+    Shape shape = arr->GetShape();
+    std::vector<float> buf(arr->Size());
+    int width = shape[shape.size() - 1];
+    int fi = (width + 1) / 2;
+    float f = static_cast<float>(fi);
+    float c = (2 * f - 1 - fi % 2) / (2.0f * f);
+    for (size_t i = 0; i < buf.size(); ++i) {
+      float x = i % width;
+      float y = (i / width) % shape[shape.size() - 2];
+      buf[i] = (1 - std::fabs(x / f - c)) * (1 - std::fabs(y / f - c));
+    }
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+};
+
+class Xavier : public Initializer {
+ public:
+  enum RandType { gaussian, uniform };
+  enum FactorType { avg, in, out };
+  explicit Xavier(RandType rand_type = gaussian,
+                  FactorType factor_type = avg, float magnitude = 3)
+      : rand_type_(rand_type), factor_type_(factor_type),
+        magnitude_(magnitude) {}
+
+ protected:
+  void InitWeight(NDArray *arr) override {
+    Shape shape = arr->GetShape();
+    float hw = 1.0f;
+    for (size_t i = 2; i < shape.size(); ++i) hw *= shape[i];
+    float fan_in = (shape.size() > 1 ? shape[1] : shape[0]) * hw;
+    float fan_out = shape[0] * hw;
+    float factor = fan_in;
+    if (factor_type_ == avg) factor = (fan_in + fan_out) / 2.0f;
+    if (factor_type_ == out) factor = fan_out;
+    float scale = std::sqrt(magnitude_ / std::max(factor, 1.0f));
+    std::vector<float> buf(arr->Size());
+    if (rand_type_ == uniform) {
+      std::uniform_real_distribution<float> d(-scale, scale);
+      for (auto &v : buf) v = d(Rng());
+    } else {
+      std::normal_distribution<float> d(0.0f, scale);
+      for (auto &v : buf) v = d(Rng());
+    }
+    arr->SyncCopyFromCPU(buf.data(), buf.size());
+  }
+  RandType rand_type_;
+  FactorType factor_type_;
+  float magnitude_;
+};
+
+/* -- learning-rate schedules (reference lr_scheduler.h) -------------- */
+
+class LRScheduler {
+ public:
+  explicit LRScheduler(float base_lr = 0.01f) : base_lr_(base_lr) {}
+  virtual ~LRScheduler() {}
+  void SetLR(float lr) { base_lr_ = lr; }
+  virtual float GetLR(unsigned num_update) = 0;
+
+ protected:
+  float base_lr_;
+};
+
+class FactorScheduler : public LRScheduler {
+ public:
+  explicit FactorScheduler(int step, float factor = 1.0f,
+                           float stop_factor_lr = 1e-8f)
+      : step_(step), factor_(factor), stop_factor_lr_(stop_factor_lr) {}
+
+  float GetLR(unsigned num_update) override {
+    while (num_update > unsigned(count_ + step_)) {
+      count_ += step_;
+      base_lr_ *= factor_;
+      if (base_lr_ < stop_factor_lr_) base_lr_ = stop_factor_lr_;
+    }
+    return base_lr_;
+  }
+
+ private:
+  int count_ = 0;
+  int step_;
+  float factor_;
+  float stop_factor_lr_;
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_TPU_CPP_INITIALIZER_H_

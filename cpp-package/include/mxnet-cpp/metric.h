@@ -1,0 +1,142 @@
+/*
+ * metric.h — C++ evaluation metrics.
+ *
+ * Reference: cpp-package/include/mxnet-cpp/metric.h (EvalMetric base +
+ * Accuracy/LogLoss/MAE/MSE/RMSE over host-fetched predictions).
+ */
+#ifndef MXNET_TPU_CPP_METRIC_H_
+#define MXNET_TPU_CPP_METRIC_H_
+
+#include <algorithm>
+#include <cmath>
+#include <string>
+#include <vector>
+
+#include "MxNetCpp.h"
+
+namespace mxnet {
+namespace cpp {
+
+class EvalMetric {
+ public:
+  explicit EvalMetric(const std::string &name, int num = 0)
+      : name_(name), num_(num) {}
+  virtual ~EvalMetric() {}
+  virtual void Update(const NDArray &labels,
+                      const NDArray &preds) = 0;
+  void Reset() {
+    num_inst = 0;
+    sum_metric = 0.0f;
+  }
+  float Get() const { return sum_metric / std::max<size_t>(num_inst, 1); }
+  void GetNameValue() const {}
+
+ protected:
+  std::string name_;
+  int num_;
+  float sum_metric = 0.0f;
+  size_t num_inst = 0;
+
+  static void CheckLabelShapes(const NDArray &labels, const NDArray &preds,
+                               bool strict = false) {
+    if (strict && labels.Size() != preds.Size())
+      throw std::runtime_error("label/pred size mismatch");
+  }
+};
+
+class Accuracy : public EvalMetric {
+ public:
+  Accuracy() : EvalMetric("accuracy") {}
+
+  void Update(const NDArray &labels,
+              const NDArray &preds) override {
+    std::vector<float> lab = labels.AsVector();
+    std::vector<float> prd = preds.AsVector();
+    Shape ps = preds.GetShape();
+    size_t batch = ps[0];
+    if (lab.size() != batch)
+      throw std::runtime_error("Accuracy: labels must be (batch,)");
+    size_t ncls = prd.size() / std::max<size_t>(batch, 1);
+    for (size_t i = 0; i < batch; ++i) {
+      size_t best = 0;
+      for (size_t c = 1; c < ncls; ++c)
+        if (prd[i * ncls + c] > prd[i * ncls + best]) best = c;
+      sum_metric += (static_cast<size_t>(lab[i]) == best) ? 1.0f : 0.0f;
+      num_inst += 1;
+    }
+  }
+};
+
+class LogLoss : public EvalMetric {
+ public:
+  LogLoss() : EvalMetric("logloss") {}
+
+  void Update(const NDArray &labels,
+              const NDArray &preds) override {
+    const float eps = 1e-15f;
+    std::vector<float> lab = labels.AsVector();
+    std::vector<float> prd = preds.AsVector();
+    Shape ps = preds.GetShape();
+    size_t batch = ps[0];
+    if (lab.size() != batch)
+      throw std::runtime_error("LogLoss: labels must be (batch,)");
+    size_t ncls = prd.size() / std::max<size_t>(batch, 1);
+    for (size_t i = 0; i < batch; ++i) {
+      float p = prd[i * ncls + static_cast<size_t>(lab[i])];
+      sum_metric += -std::log(std::max(p, eps));
+      num_inst += 1;
+    }
+  }
+};
+
+class MAE : public EvalMetric {
+ public:
+  MAE() : EvalMetric("mae") {}
+
+  void Update(const NDArray &labels,
+              const NDArray &preds) override {
+    CheckLabelShapes(labels, preds, true);
+    std::vector<float> lab = labels.AsVector();
+    std::vector<float> prd = preds.AsVector();
+    for (size_t i = 0; i < prd.size(); ++i)
+      sum_metric += std::fabs(lab[i] - prd[i]);
+    num_inst += prd.size();
+  }
+};
+
+class MSE : public EvalMetric {
+ public:
+  MSE() : EvalMetric("mse") {}
+
+  void Update(const NDArray &labels,
+              const NDArray &preds) override {
+    CheckLabelShapes(labels, preds, true);
+    std::vector<float> lab = labels.AsVector();
+    std::vector<float> prd = preds.AsVector();
+    for (size_t i = 0; i < prd.size(); ++i)
+      sum_metric += (lab[i] - prd[i]) * (lab[i] - prd[i]);
+    num_inst += prd.size();
+  }
+};
+
+class RMSE : public EvalMetric {
+ public:
+  RMSE() : EvalMetric("rmse") {}
+
+  void Update(const NDArray &labels,
+              const NDArray &preds) override {
+    CheckLabelShapes(labels, preds, true);
+    std::vector<float> lab = labels.AsVector();
+    std::vector<float> prd = preds.AsVector();
+    float sq = 0.0f;
+    for (size_t i = 0; i < prd.size(); ++i)
+      sq += (lab[i] - prd[i]) * (lab[i] - prd[i]);
+    sum_metric += std::sqrt(sq / std::max<size_t>(prd.size(), 1));
+    num_inst += 1;
+  }
+};
+
+}  // namespace cpp
+}  // namespace mxnet
+
+#endif  // MXNET_TPU_CPP_METRIC_H_

@@ -123,12 +123,62 @@ class RMSPropOptimizer : public Optimizer {
   }
 };
 
+class AdaGradOptimizer : public Optimizer {
+ public:
+  /* reference cpp-package optimizer.h AdaGradOptimizer: host-side
+   * history update (the python AdaGrad composes generic ops the same
+   * way; there is no fused kernel in the registry by design) */
+  void Update(int index, NDArray *weight, const NDArray &grad) override {
+    NDArray *hist = State(index, *weight);
+    float lr = ParamOr("lr", 0.01f);
+    float eps = ParamOr("eps", 1e-7f);
+    float wd = ParamOr("wd", 0.f);
+    std::vector<float> w = weight->AsVector();
+    std::vector<float> g = grad.AsVector();
+    std::vector<float> h = hist->AsVector();
+    for (size_t i = 0; i < w.size(); ++i) {
+      h[i] += g[i] * g[i];
+      w[i] -= lr * (g[i] / std::sqrt(h[i] + eps) + wd * w[i]);
+    }
+    hist->SyncCopyFromCPU(h.data(), h.size());
+    weight->SyncCopyFromCPU(w.data(), w.size());
+  }
+};
+
+class AdaDeltaOptimizer : public Optimizer {
+ public:
+  /* reference cpp-package optimizer.h AdaDeltaOptimizer (Zeiler 2012) */
+  void Update(int index, NDArray *weight, const NDArray &grad) override {
+    NDArray *acc_g = State(index, *weight, 0);
+    NDArray *acc_d = State(index, *weight, 1);
+    float rho = ParamOr("rho", 0.9f);
+    float eps = ParamOr("epsilon", 1e-5f);
+    float wd = ParamOr("wd", 0.f);
+    std::vector<float> w = weight->AsVector();
+    std::vector<float> g = grad.AsVector();
+    std::vector<float> ag = acc_g->AsVector();
+    std::vector<float> ad = acc_d->AsVector();
+    for (size_t i = 0; i < w.size(); ++i) {
+      float gi = g[i] + wd * w[i];
+      ag[i] = rho * ag[i] + (1 - rho) * gi * gi;
+      float delta = std::sqrt(ad[i] + eps) / std::sqrt(ag[i] + eps) * gi;
+      ad[i] = rho * ad[i] + (1 - rho) * delta * delta;
+      w[i] -= delta;
+    }
+    acc_g->SyncCopyFromCPU(ag.data(), ag.size());
+    acc_d->SyncCopyFromCPU(ad.data(), ad.size());
+    weight->SyncCopyFromCPU(w.data(), w.size());
+  }
+};
+
 class OptimizerRegistry {
  public:
   static Optimizer *Find(const std::string &name) {
     if (name == "sgd") return new SGDOptimizer();
     if (name == "adam") return new AdamOptimizer();
     if (name == "rmsprop") return new RMSPropOptimizer();
+    if (name == "adagrad") return new AdaGradOptimizer();
+    if (name == "adadelta") return new AdaDeltaOptimizer();
     throw std::runtime_error("unknown optimizer " + name);
   }
 };

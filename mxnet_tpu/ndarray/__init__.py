@@ -111,9 +111,11 @@ def true_divide(lhs, rhs):
 
 
 def modulo(lhs, rhs):
-    if isinstance(rhs, NDArray):
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
         return invoke('broadcast_mod', [lhs, rhs], {})
-    return invoke('_mod_scalar', [lhs], {'scalar': float(rhs)})
+    if isinstance(lhs, NDArray):
+        return invoke('_mod_scalar', [lhs], {'scalar': float(rhs)})
+    return invoke('_rmod_scalar', [rhs], {'scalar': float(lhs)})
 
 
 def imdecode(str_img, clip_rect=(0, 0, 0, 0), out=None, index=0,

@@ -45,15 +45,8 @@ def minimum(lhs, rhs):
 
 def hypot(lhs, rhs):
     """sqrt(lhs^2 + rhs^2) elementwise (reference symbol.py:hypot)."""
-    if isinstance(lhs, Symbol) and isinstance(rhs, Symbol):
-        return _register.make_sym_function('_hypot')(lhs, rhs)
-    if isinstance(lhs, Symbol):
-        return _register.make_sym_function('_hypot_scalar')(
-            lhs, scalar=float(rhs))
-    if isinstance(rhs, Symbol):
-        return _register.make_sym_function('_hypot_scalar')(
-            rhs, scalar=float(lhs))
-    raise TypeError('at least one argument must be a Symbol')
+    return _sym_or_scalar_binary(lhs, rhs, '_hypot',
+                                 '_hypot_scalar', '_hypot_scalar')
 
 
 from . import contrib  # noqa: E402,F401  (mx.sym.contrib.*)

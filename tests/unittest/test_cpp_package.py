@@ -71,3 +71,11 @@ def test_op_h_is_up_to_date(tmp_path):
                                   'mxnet-cpp', 'op.h')).read()
     assert open(out).read() == committed, \
         'op.h is stale: rerun python cpp-package/OpWrapperGenerator.py'
+
+
+@pytest.mark.slow
+def test_cpp_train_api_example(tmp_path):
+    """Xavier initializer + OptimizerRegistry adagrad/adadelta +
+    Accuracy/LogLoss metrics + FactorScheduler, pure C++ (the
+    initializer.h/metric.h surfaces of the reference cpp-package)."""
+    _build_and_run('train_api.cpp', 'TRAIN_API_OK', tmp_path)

@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Kill stray training processes on a cluster (reference
+tools/kill-mxnet.py — ssh'ed a ps|grep|kill pipeline to every host in a
+hostfile). Same contract here, plus a `local` mode matching
+tools/launch.py's local launcher.
+
+    python kill_mxnet.py <hostfile|local> [user] [prog]
+
+With `local`, kills this host's processes whose command line matches
+``prog`` (default: mxnet_tpu) and that carry the DMLC_* launch env.
+"""
+import getpass
+import os
+import signal
+import subprocess
+import sys
+
+
+def _kill_cmd(user, prog):
+    return ("ps aux | grep -v grep | grep -v kill_mxnet | grep '%s' | "
+            "awk '{if($1==\"%s\")print $2;}' | xargs -r kill -9"
+            % (prog, user))
+
+
+def kill_local(prog):
+    out = subprocess.run(['ps', '-eo', 'pid,command'],
+                         capture_output=True, text=True).stdout
+    me = os.getpid()
+    killed = []
+    for line in out.splitlines()[1:]:
+        parts = line.strip().split(None, 1)
+        if len(parts) != 2:
+            continue
+        pid, cmd = int(parts[0]), parts[1]
+        if pid == me or 'kill_mxnet' in cmd:
+            continue
+        if prog in cmd and ('launch.py' in cmd or 'DMLC' in cmd
+                            or 'kvstore_server' in cmd):
+            try:
+                os.kill(pid, signal.SIGKILL)
+                killed.append(pid)
+            except ProcessLookupError:
+                pass
+    print('killed %d local processes: %s' % (len(killed), killed))
+    return 0
+
+
+def main():
+    if len(sys.argv) < 2:
+        print('usage: %s <hostfile|local> [user] [prog]' % sys.argv[0])
+        return 1
+    target = sys.argv[1]
+    user = sys.argv[2] if len(sys.argv) > 2 else getpass.getuser()
+    prog = sys.argv[3] if len(sys.argv) > 3 else 'mxnet_tpu'
+    if target == 'local':
+        return kill_local(prog)
+    with open(target) as f:
+        hosts = [h.strip() for h in f if h.strip()]
+    cmd = _kill_cmd(user, prog)
+    print(cmd)
+    for host in hosts:
+        print('killing on %s' % host)
+        subprocess.run(['ssh', '-o', 'StrictHostKeyChecking=no',
+                        '%s@%s' % (user, host), cmd])
+    print('Done killing %r for %r on %d hosts' % (prog, user, len(hosts)))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
