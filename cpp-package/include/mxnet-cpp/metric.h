@@ -82,7 +82,10 @@ class LogLoss : public EvalMetric {
       throw std::runtime_error("LogLoss: labels must be (batch,)");
     size_t ncls = prd.size() / std::max<size_t>(batch, 1);
     for (size_t i = 0; i < batch; ++i) {
-      float p = prd[i * ncls + static_cast<size_t>(lab[i])];
+      long cls = static_cast<long>(lab[i]);
+      if (cls < 0 || cls >= static_cast<long>(ncls))
+        continue;  /* ignore-label convention (-1) / malformed labels */
+      float p = prd[i * ncls + static_cast<size_t>(cls)];
       sum_metric += -std::log(std::max(p, eps));
       num_inst += 1;
     }

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ['ring_attention', 'blockwise_attention', 'ulysses_attention',
+           'striped_attention', 'stripe_layout', 'unstripe_layout',
            'make_ring_attention', 'attention_reference']
 
 _NEG = -1e30
@@ -205,7 +206,8 @@ def make_ring_attention(mesh, axis='sp', causal=False, impl='ring', scale=None):
     (output stays sequence-sharded, matching the input layout)."""
     from jax.sharding import PartitionSpec as P
     from jax import shard_map
-    fn = {'ring': ring_attention, 'ulysses': ulysses_attention}[impl]
+    fn = {'ring': ring_attention, 'ulysses': ulysses_attention,
+          'striped': striped_attention}[impl]
     spec = P(None, axis, None, None)
 
     @functools.partial(shard_map, mesh=mesh.mesh, in_specs=(spec, spec, spec),
@@ -213,3 +215,67 @@ def make_ring_attention(mesh, axis='sp', causal=False, impl='ring', scale=None):
     def apply(q, k, v):
         return fn(q, k, v, axis=axis, causal=causal, scale=scale)
     return apply
+
+
+def stripe_layout(x, sp, axis=1):
+    """Reorder the sequence axis so CONTIGUOUS sharding over ``sp``
+    devices yields the striped (round-robin) layout: shard s holds
+    global positions s, s+sp, s+2sp, ... (Striped Attention, Brandon et
+    al. 2023, arXiv:2311.09431). Apply before shard_map, invert with
+    :func:`unstripe_layout`."""
+    T = x.shape[axis]
+    assert T % sp == 0, 'seq length must divide the sp axis'
+    shape = list(x.shape)
+    # [..., T, ...] -> [..., T//sp, sp, ...] -> [..., sp, T//sp, ...]
+    x = x.reshape(shape[:axis] + [T // sp, sp] + shape[axis + 1:])
+    x = jnp.swapaxes(x, axis, axis + 1)
+    return x.reshape(shape)
+
+
+def unstripe_layout(x, sp, axis=1):
+    """Inverse of :func:`stripe_layout`."""
+    T = x.shape[axis]
+    shape = list(x.shape)
+    x = x.reshape(shape[:axis] + [sp, T // sp] + shape[axis + 1:])
+    x = jnp.swapaxes(x, axis, axis + 1)
+    return x.reshape(shape)
+
+
+def striped_attention(q, k, v, axis='sp', causal=True, scale=None):
+    """Striped ring attention (Brandon et al. 2023): with the
+    round-robin token layout (:func:`stripe_layout`), every ring step
+    computes a near-triangular block, so causal work is load-balanced
+    across the ring — the contiguous-chunk schedule leaves early
+    devices idle for late chunks and vice versa.
+
+    Mask per step (device ``my`` holding k-chunk from ``src``): global
+    positions are ``gq_i = my + sp*i``, ``gk_j = src + sp*j``, so
+    ``gq_i >= gk_j`` reduces to ``i >= j`` when ``src <= my`` and
+    ``i > j`` otherwise. Call under shard_map, inputs in striped
+    layout."""
+    B, Tl, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    n = lax.psum(1, axis)
+    my = lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    i = jnp.arange(Tl)
+    tri_incl = (i[:, None] >= i[None, :])[None, None]
+    tri_strict = (i[:, None] > i[None, :])[None, None]
+
+    def body(step, carry):
+        kk, vv, acc, m, l = carry
+        src = (my - step) % n
+        mask = jnp.where(src <= my, tri_incl, tri_strict) if causal \
+            else None
+        acc, m, l = _block_accum(q, kk, vv, (acc, m, l), scale, mask)
+        kk = lax.ppermute(kk, axis, perm)
+        vv = lax.ppermute(vv, axis, perm)
+        return kk, vv, acc, m, l
+
+    init = (k, v,
+            jnp.zeros_like(q),
+            jnp.full((B, H, Tl), _NEG, q.dtype),
+            jnp.zeros((B, H, Tl), q.dtype))
+    _, _, acc, m, l = lax.fori_loop(0, n, body, init)
+    return _finalize(acc, l)

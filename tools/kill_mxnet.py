@@ -22,6 +22,16 @@ def _kill_cmd(user, prog):
             % (prog, user))
 
 
+def _has_dmlc_env(pid):
+    """launch.py passes the DMLC_* role protocol through the child env,
+    not the command line — /proc/<pid>/environ is the truth."""
+    try:
+        with open('/proc/%d/environ' % pid, 'rb') as f:
+            return b'DMLC_' in f.read()
+    except OSError:
+        return False
+
+
 def kill_local(prog):
     out = subprocess.run(['ps', '-eo', 'pid,command'],
                          capture_output=True, text=True).stdout
@@ -35,7 +45,8 @@ def kill_local(prog):
         if pid == me or 'kill_mxnet' in cmd:
             continue
         if prog in cmd and ('launch.py' in cmd or 'DMLC' in cmd
-                            or 'kvstore_server' in cmd):
+                            or 'kvstore_server' in cmd
+                            or _has_dmlc_env(pid)):
             try:
                 os.kill(pid, signal.SIGKILL)
                 killed.append(pid)
