@@ -58,10 +58,13 @@ class Accuracy : public EvalMetric {
       throw std::runtime_error("Accuracy: labels must be (batch,)");
     size_t ncls = prd.size() / std::max<size_t>(batch, 1);
     for (size_t i = 0; i < batch; ++i) {
+      long cls = static_cast<long>(lab[i]);
+      if (cls < 0)
+        continue;  /* ignore-label convention (-1) */
       size_t best = 0;
       for (size_t c = 1; c < ncls; ++c)
         if (prd[i * ncls + c] > prd[i * ncls + best]) best = c;
-      sum_metric += (static_cast<size_t>(lab[i]) == best) ? 1.0f : 0.0f;
+      sum_metric += (static_cast<size_t>(cls) == best) ? 1.0f : 0.0f;
       num_inst += 1;
     }
   }

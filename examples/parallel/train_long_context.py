@@ -31,10 +31,11 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 from mxnet_tpu import parallel as par
-from mxnet_tpu.parallel.ring_attention import ring_attention
+from mxnet_tpu.parallel.ring_attention import (ring_attention,
+                                                striped_attention)
 
 
-def make_model_fns(vocab, d_model, n_heads):
+def make_model_fns(vocab, d_model, n_heads, attn='ring'):
     head_dim = d_model // n_heads
 
     def init(key):
@@ -56,8 +57,11 @@ def make_model_fns(vocab, d_model, n_heads):
         q = (x @ params['wq']).reshape(*x.shape[:2], n_heads, head_dim)
         k = (x @ params['wk']).reshape(*x.shape[:2], n_heads, head_dim)
         v = (x @ params['wv']).reshape(*x.shape[:2], n_heads, head_dim)
-        # ring attention over the sp axis: K/V blocks rotate the ring
-        att = ring_attention(q, k, v, axis='sp', causal=True)
+        # ring attention over the sp axis: K/V blocks rotate the ring.
+        # 'striped' expects round-robin token layout (see main) and
+        # balances the causal load across the ring (arXiv:2311.09431)
+        attend = striped_attention if attn == 'striped' else ring_attention
+        att = attend(q, k, v, axis='sp', causal=True)
         att = att.reshape(*x.shape[:2], d_model)
         x = x + att @ params['wo']
         x = x + jax.nn.relu(x @ params['wf'])           # cheap mixer
@@ -96,12 +100,14 @@ def main():
     p.add_argument('--steps', type=int, default=200)
     p.add_argument('--lr', type=float, default=3e-3)
     p.add_argument('--seed', type=int, default=0)
+    p.add_argument('--attn', choices=('ring', 'striped'), default='ring')
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
     mesh = par.make_mesh({'dp': args.dp, 'sp': args.sp})
     rng = np.random.RandomState(args.seed)
-    init, forward = make_model_fns(args.vocab, args.d_model, args.heads)
+    init, forward = make_model_fns(args.vocab, args.d_model,
+                                   args.heads, attn=args.attn)
     params = init(jax.random.PRNGKey(args.seed))
 
     data_spec = P('dp', 'sp')
@@ -142,10 +148,18 @@ def main():
              jnp.zeros((), jnp.int32))
 
     uniform = np.log(2.0)   # YES/NO at the answer position
+    if args.attn == 'striped':
+        # host-side stripe_layout permutation: position t'*sp + s moves
+        # to shard s slot t' (matches parallel.stripe_layout)
+        stripe_order = np.concatenate([np.arange(s, args.seq, args.sp)
+                                       for s in range(args.sp)])
     first = last = None
     for i in range(args.steps):
         toks, tgts, mask = needle_batch(rng, args.batch, args.seq,
                                         args.vocab)
+        if args.attn == 'striped':
+            toks, tgts, mask = (toks[:, stripe_order], tgts[:, stripe_order],
+                                mask[:, stripe_order])
         state, loss = sharded_step(state, jnp.asarray(toks),
                                    jnp.asarray(tgts), jnp.asarray(mask))
         loss = float(loss)

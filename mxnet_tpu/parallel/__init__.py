@@ -22,7 +22,9 @@ from .sharding import (ShardingPlan, data_parallel_plan, constrain,
                        shard_params, replicate_params)
 from .data_parallel import make_train_step, ShardedTrainer
 from .ring_attention import (ring_attention, blockwise_attention,
-                             ulysses_attention, make_ring_attention,
+                             ulysses_attention, striped_attention,
+                             stripe_layout, unstripe_layout,
+                             make_ring_attention,
                              attention_reference)
 from .pipeline import PipelineStage, pipeline_apply, stack_stage_params
 from .multihost import (init_multihost, global_mesh, process_index,
@@ -38,6 +40,7 @@ __all__ = [
     'replicate_params',
     'make_train_step', 'ShardedTrainer',
     'ring_attention', 'blockwise_attention', 'ulysses_attention',
+    'striped_attention', 'stripe_layout', 'unstripe_layout',
     'make_ring_attention', 'attention_reference',
     'PipelineStage', 'pipeline_apply', 'stack_stage_params',
     'TransformerConfig', 'full_mesh', 'make_5d_train_step',

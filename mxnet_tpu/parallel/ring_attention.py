@@ -224,7 +224,9 @@ def stripe_layout(x, sp, axis=1):
     al. 2023, arXiv:2311.09431). Apply before shard_map, invert with
     :func:`unstripe_layout`."""
     T = x.shape[axis]
-    assert T % sp == 0, 'seq length must divide the sp axis'
+    if T % sp != 0:
+        raise ValueError('sp (%d) must divide the sequence length (%d)'
+                         % (sp, T))
     shape = list(x.shape)
     # [..., T, ...] -> [..., T//sp, sp, ...] -> [..., sp, T//sp, ...]
     x = x.reshape(shape[:axis] + [T // sp, sp] + shape[axis + 1:])

@@ -32,6 +32,8 @@ CASES = [
      ['--num-epochs', '1', '--batch-size', '16', '--num-hidden', '32',
       '--num-embed', '16', '--num-layers', '1', '--vocab', '50']),
     ('parallel/train_long_context.py', ['--steps', '200']),
+    ('parallel/train_long_context.py', ['--steps', '200',
+                                        '--attn', 'striped']),
     ('parallel/train_5d_transformer.py',
      ['--pp', '2', '--dp', '2', '--tp', '2', '--steps', '3', '--seq', '8',
       '--d-model', '16', '--batch', '4', '--vocab', '32']),

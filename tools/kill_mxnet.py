@@ -27,7 +27,8 @@ def _has_dmlc_env(pid):
     not the command line — /proc/<pid>/environ is the truth."""
     try:
         with open('/proc/%d/environ' % pid, 'rb') as f:
-            return b'DMLC_' in f.read()
+            return any(entry.startswith(b'DMLC_')
+                       for entry in f.read().split(b'\0'))
     except OSError:
         return False
 
@@ -52,6 +53,8 @@ def kill_local(prog):
                 killed.append(pid)
             except ProcessLookupError:
                 pass
+            except PermissionError:
+                print('skipping pid %d (owned by another user)' % pid)
     print('killed %d local processes: %s' % (len(killed), killed))
     return 0
 
