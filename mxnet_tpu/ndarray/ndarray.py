@@ -344,6 +344,13 @@ class NDArray:
     def broadcast_to(self, shape):
         return invoke('broadcast_to', [self], {'shape': tuple(shape)})
 
+    def broadcast_axes(self, axis=(), size=()):
+        return invoke('broadcast_axes', [self],
+                      {'axis': (axis,) if isinstance(axis, int) else
+                       tuple(axis),
+                       'size': (size,) if isinstance(size, int) else
+                       tuple(size)})
+
     def transpose(self, *axes):
         if len(axes) == 1 and isinstance(axes[0], (list, tuple)):
             axes = tuple(axes[0])

@@ -336,10 +336,25 @@ class Symbol:
         ex = self.bind(ctx, kwargs)
         return ex.forward()
 
-    # convenience op methods mirroring mx.sym.<op>(self, ...)
+    # convenience op methods mirroring mx.sym.<op>(self, ...); positional
+    # scalars map onto declared params in order, the generated-signature
+    # convention (symbol/register.py make_sym_function)
     def _op_method(name):  # noqa: N805
         def method(self, *args, **kwargs):
-            return _invoke_sym(name, [self] + [a for a in args if isinstance(a, Symbol)], kwargs)
+            inputs = [self] + [a for a in args if isinstance(a, Symbol)]
+            pos_attrs = [a for a in args
+                         if not isinstance(a, Symbol) and a is not None]
+            if pos_attrs:
+                for pname in _reg.get(name).param_defaults:
+                    if not pos_attrs:
+                        break
+                    if pname not in kwargs:
+                        kwargs[pname] = pos_attrs.pop(0)
+                if pos_attrs:
+                    raise TypeError(
+                        '%s: %d positional argument(s) beyond the '
+                        'declared params' % (name, len(pos_attrs)))
+            return _invoke_sym(name, inputs, kwargs)
         return method
 
     for _n in ['sum', 'mean', 'max', 'min', 'prod', 'argmax', 'argmin',
@@ -347,9 +362,51 @@ class Symbol:
                'sigmoid', 'relu', 'tanh', 'softmax', 'log_softmax',
                'transpose', 'expand_dims', 'squeeze', 'clip', 'flatten',
                'sort', 'argsort', 'topk', 'take', 'one_hot', 'pick', 'tile',
-               'repeat', 'dot']:
+               'repeat', 'dot', 'broadcast_axes', 'broadcast_to', 'ceil',
+               'fix', 'flip', 'floor', 'nanprod', 'nansum', 'ones_like',
+               'pad', 'rint', 'round', 'slice', 'split', 'swapaxes',
+               'trunc', 'zeros_like']:
         locals()[_n] = _op_method(_n)
     del _op_method, _n
+
+    def copy(self):
+        """Deep graph copy (reference MXSymbolCopy): mutating attrs on
+        the copy must not leak into the original."""
+        memo = {}
+
+        def clone(node):
+            if id(node) in memo:
+                return memo[id(node)]
+            new = Node(node.op, dict(node.attrs),
+                       [(clone(p), i) for p, i in node.inputs],
+                       node.name, dict(node.attr_dict), node._num_args)
+            memo[id(node)] = new
+            return new
+
+        return Symbol([(clone(n), i) for n, i in self._outputs])
+
+    def list_attr(self, recursive=False):
+        """User attrs of the head node (reference symbol.py:list_attr);
+        recursive=True raises like modern reference versions — use
+        attr_dict() for the whole graph."""
+        if recursive:
+            raise DeprecationWarning(
+                'list_attr(recursive=True) is deprecated: use attr_dict()')
+        if len(self._outputs) == 1:
+            return dict(self._outputs[0][0].attr_dict)
+        return {}
+
+    def debug_str(self):
+        """Human-readable graph dump (reference Symbol::DebugStr)."""
+        lines = []
+        for n in self._topo():
+            if n.is_variable():
+                lines.append('Variable:%s' % n.name)
+            else:
+                ins = ', '.join('%s[%d]' % (p.name, i) for p, i in n.inputs)
+                lines.append('Op:%s, Name=%s\nInputs:\n\t%s'
+                             % (n.op, n.name, ins))
+        return '\n'.join(lines) + '\n'
 
     def reshape(self, *shape, **kwargs):
         if len(shape) == 1 and isinstance(shape[0], (list, tuple)):
@@ -535,6 +592,22 @@ def _invoke_sym(op_name, input_syms, kwargs):
             inputs.append(Variable('%s_%s' % (final_name, pname)))
         return create(op_name, inputs, kwargs, final_name)
     return create(op_name, inputs, kwargs, name)
+
+
+def _not_for_symbol(name):
+    def method(self, *args, **kwargs):
+        from ..base import NotImplementedForSymbol
+        raise NotImplementedForSymbol(method, None, *args)
+    method.__name__ = name
+    method.__doc__ = ('NDArray-only operation: not supported for Symbol '
+                      '(reference symbol.py raises the same).')
+    return method
+
+
+for _n in ('asnumpy', 'asscalar', 'as_in_context', 'backward', 'detach',
+           'wait_to_read'):
+    setattr(Symbol, _n, _not_for_symbol(_n))
+del _not_for_symbol
 
 
 def _sym_binary(lhs, rhs, op_name, elem_name):

@@ -278,3 +278,43 @@ def test_contrib_autograd_scope_and_multicrop():
     label = np.array([[0, 0.2, 0.2, 0.8, 0.8]], 'float32')
     out, lab = aug(src, label.copy())
     assert out.ndim == 3 and lab.shape == (1, 5)
+
+
+def test_ndarray_symbol_method_sugar():
+    """Reference NDArray/Symbol expose op sugar as methods; Symbol's
+    NDArray-only methods raise NotImplementedForSymbol."""
+    x = mx.nd.array(np.arange(6).reshape(2, 1, 3).astype('float32'))
+    assert x.broadcast_axes(axis=1, size=4).shape == (2, 4, 3)
+    assert x.broadcast_to((2, 5, 3)).shape == (2, 5, 3)
+    assert x.swapaxes(0, 2).shape == (3, 1, 2)
+    np.testing.assert_allclose(x.flip(axis=2).asnumpy()[0, 0], [2, 1, 0])
+    assert x.slice(begin=(0, 0, 1), end=(2, 1, 3)).shape == (2, 1, 2)
+    assert [a.shape for a in x.split(num_outputs=3, axis=2)] == \
+        [(2, 1, 1)] * 3
+
+    s = mx.sym.Variable('data')
+    for name in ('round', 'floor', 'ceil', 'trunc', 'fix', 'rint',
+                 'zeros_like', 'ones_like', 'nansum', 'nanprod'):
+        assert getattr(s, name)().list_arguments() == ['data']
+    assert len(list(s.split(num_outputs=2, axis=1))) == 2
+    assert s.swapaxes(dim1=0, dim2=1).list_arguments() == ['data']
+    # positional scalars map onto declared params like the generated fns
+    e = s.swapaxes(0, 1).simple_bind(mx.cpu(), data=(2, 3))
+    e.forward()
+    assert e.outputs[0].shape == (3, 2)
+    assert len(list(s.split(2, 1))) == 2
+    with pytest.raises(TypeError):
+        s.round(1, 2, 3, 4, 5, 6, 7, 8)    # too many positionals
+    assert 'Variable:data' in s.round().debug_str()
+    assert mx.sym.Variable('w', lr_mult=2.0).list_attr() == \
+        {'__lr_mult__': '2.0'}
+    # copy() is a DEEP graph copy: attr edits must not leak back
+    a = mx.sym.Variable('w', lr_mult=1.0)
+    b = a.copy()
+    b._set_attr(__lr_mult__='9.0')
+    assert a.list_attr() == {'__lr_mult__': '1.0'}
+    assert b.list_attr() == {'__lr_mult__': '9.0'}
+    for name in ('asnumpy', 'asscalar', 'backward', 'detach',
+                 'wait_to_read'):
+        with pytest.raises(mx.base.NotImplementedForSymbol):
+            getattr(s, name)()
