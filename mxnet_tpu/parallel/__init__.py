@@ -21,6 +21,7 @@ from .collectives import (allreduce, allgather, reduce_scatter, ring_permute,
 from .sharding import (ShardingPlan, data_parallel_plan, constrain,
                        shard_params, replicate_params)
 from .data_parallel import make_train_step, ShardedTrainer
+from . import checkpoint  # noqa: F401  (sharded SPMD checkpointing)
 from .ring_attention import (ring_attention, blockwise_attention,
                              ulysses_attention, striped_attention,
                              stripe_layout, unstripe_layout,
@@ -39,6 +40,7 @@ __all__ = [
     'ShardingPlan', 'data_parallel_plan', 'constrain', 'shard_params',
     'replicate_params',
     'make_train_step', 'ShardedTrainer',
+    'checkpoint',
     'ring_attention', 'blockwise_attention', 'ulysses_attention',
     'striped_attention', 'stripe_layout', 'unstripe_layout',
     'make_ring_attention', 'attention_reference',

@@ -1,0 +1,74 @@
+"""Sharded checkpoint / resume for SPMD training state.
+
+The reference's checkpointing (model.py save_checkpoint, reference
+model.py:340) gathers every parameter to one host — fine for one
+machine, quadratically painful for a sharded multi-host run. This is
+the TPU-native tier: orbax-checkpoint writes each host's shards of a
+``jax.Array`` pytree in parallel and restores them onto the SAME mesh
+sharding without ever materialising the full state anywhere.
+
+    from mxnet_tpu.parallel import checkpoint as ckpt
+    mngr = ckpt.manager('/path/ckpts', max_to_keep=3)
+    ckpt.save(mngr, step, train_state)          # shard-parallel write
+    state = ckpt.restore(mngr, template=train_state)   # same shardings
+    step = mngr.latest_step()
+
+Interop note: for reference-format `.params` files keep using
+``mx.model.save_checkpoint`` / ``nd.save`` (docs/migration.md) — this
+tier is for large sharded SPMD state, the two are complementary.
+"""
+import jax
+
+__all__ = ['manager', 'save', 'restore', 'latest_step']
+
+
+def _ocp():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+def manager(directory, max_to_keep=None, save_interval_steps=1):
+    """A CheckpointManager rooted at ``directory`` (created if needed).
+
+    In a multi-host run every process must call this with the same
+    directory (a path visible to all hosts); orbax coordinates the
+    barrier/commit protocol across processes."""
+    import os
+    ocp = _ocp()
+    options = ocp.CheckpointManagerOptions(
+        max_to_keep=max_to_keep, save_interval_steps=save_interval_steps)
+    return ocp.CheckpointManager(os.path.abspath(str(directory)),
+                                 options=options)
+
+
+def save(mngr, step, state, wait=True):
+    """Write ``state`` (a pytree of jax.Arrays — sharded arrays are
+    written shard-parallel) at ``step``."""
+    ocp = _ocp()
+    saved = mngr.save(int(step), args=ocp.args.StandardSave(state))
+    if wait:
+        mngr.wait_until_finished()
+    return saved
+
+
+def restore(mngr, template, step=None):
+    """Restore onto the shardings/dtypes of ``template`` (typically the
+    freshly-initialised train state — its NamedShardings tell orbax
+    where every shard belongs). ``step=None`` = latest."""
+    ocp = _ocp()
+    if step is None:
+        step = mngr.latest_step()
+    if step is None:
+        raise FileNotFoundError('no checkpoint found in %s'
+                                % mngr.directory)
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, 'sharding',
+                                                        None)),
+        template)
+    return mngr.restore(int(step),
+                        args=ocp.args.StandardRestore(abstract))
+
+
+def latest_step(mngr):
+    return mngr.latest_step()

@@ -342,18 +342,23 @@ class Symbol:
     def _op_method(name):  # noqa: N805
         def method(self, *args, **kwargs):
             inputs = [self] + [a for a in args if isinstance(a, Symbol)]
-            pos_attrs = [a for a in args
-                         if not isinstance(a, Symbol) and a is not None]
+            pos_attrs = [a for a in args if not isinstance(a, Symbol)]
             if pos_attrs:
-                for pname in _reg.get(name).param_defaults:
-                    if not pos_attrs:
-                        break
-                    if pname not in kwargs:
-                        kwargs[pname] = pos_attrs.pop(0)
-                if pos_attrs:
+                params = list(_reg.get(name).param_defaults)
+                if len(pos_attrs) > len(params):
                     raise TypeError(
                         '%s: %d positional argument(s) beyond the '
-                        'declared params' % (name, len(pos_attrs)))
+                        'declared params'
+                        % (name, len(pos_attrs) - len(params)))
+                # python call semantics: positionals fill params in
+                # declaration order; a clash with a kwarg is an error,
+                # and None is a real value (axis=None etc.)
+                for pname, val in zip(params, pos_attrs):
+                    if pname in kwargs:
+                        raise TypeError(
+                            '%s() got multiple values for argument %r'
+                            % (name, pname))
+                    kwargs[pname] = val
             return _invoke_sym(name, inputs, kwargs)
         return method
 
@@ -371,19 +376,15 @@ class Symbol:
 
     def copy(self):
         """Deep graph copy (reference MXSymbolCopy): mutating attrs on
-        the copy must not leak into the original."""
+        the copy must not leak into the original. Iterative over the
+        topo order — graphs can be deeper than the recursion limit."""
         memo = {}
-
-        def clone(node):
-            if id(node) in memo:
-                return memo[id(node)]
-            new = Node(node.op, dict(node.attrs),
-                       [(clone(p), i) for p, i in node.inputs],
-                       node.name, dict(node.attr_dict), node._num_args)
-            memo[id(node)] = new
-            return new
-
-        return Symbol([(clone(n), i) for n, i in self._outputs])
+        for node in self._topo():          # parents precede consumers
+            memo[id(node)] = Node(
+                node.op, dict(node.attrs),
+                [(memo[id(p)], i) for p, i in node.inputs],
+                node.name, dict(node.attr_dict), node._num_args)
+        return Symbol([(memo[id(n)], i) for n, i in self._outputs])
 
     def list_attr(self, recursive=False):
         """User attrs of the head node (reference symbol.py:list_attr);
