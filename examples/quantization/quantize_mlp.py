@@ -1,0 +1,106 @@
+"""Post-training quantization — reference contrib quantize/dequantize
+ops (src/operator/contrib/quantize.cc): train an MLP in float, quantize
+its weights to uint8 with per-tensor min/max calibration, run inference
+with on-the-fly dequantize, and gate the accuracy drop.
+
+    python quantize_mlp.py --epochs 8
+"""
+import argparse
+import logging
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+NCLASS = 8
+DIM = 48
+
+
+def blobs(rng, n, centers):
+    lab = rng.randint(0, NCLASS, n)
+    x = centers[lab] + 0.45 * rng.randn(n, DIM).astype(np.float32)
+    return x.astype(np.float32), lab.astype(np.float32)
+
+
+def quantize_params(net):
+    """uint8-quantize every weight/bias; returns {name: (q, mn, mx)}."""
+    stored = {}
+    for name, p in net.collect_params().items():
+        w = p.data()
+        w_np = w.asnumpy()
+        lo = float(w_np.min())
+        hi = float(w_np.max()) + 1e-8
+        q, qmin, qmax = mx.nd.contrib.quantize(
+            w, mx.nd.array([lo]), mx.nd.array([hi]), out_type='uint8')
+        stored[name] = (q, qmin, qmax)
+    return stored
+
+
+def load_quantized(net, stored):
+    for name, p in net.collect_params().items():
+        q, qmin, qmax = stored[name]
+        deq = mx.nd.contrib.dequantize(q, qmin, qmax, out_type='float32')
+        p.set_data(deq.reshape(p.data().shape))
+
+
+def accuracy(net, x, y):
+    return float((net(mx.nd.array(x)).asnumpy().argmax(1) == y).mean())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=8)
+    ap.add_argument('--samples', type=int, default=768)
+    ap.add_argument('--lr', type=float, default=0.1)
+    ap.add_argument('--max-drop', type=float, default=0.02,
+                    help='allowed accuracy drop after uint8 quantization')
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(10)
+
+    rng = np.random.RandomState(23)
+    centers = rng.randn(NCLASS, DIM).astype(np.float32) * 1.6
+    xtr, ytr = blobs(rng, args.samples, centers)
+    xte, yte = blobs(rng, args.samples // 4, centers)
+
+    net = nn.Sequential()
+    with net.name_scope():
+        net.add(nn.Dense(64, activation='relu'), nn.Dense(NCLASS))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                            {'learning_rate': args.lr, 'momentum': 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(args.epochs):
+        perm = rng.permutation(len(xtr))
+        for i in range(0, len(xtr), 64):
+            idx = perm[i:i + 64]
+            with autograd.record():
+                loss = loss_fn(net(mx.nd.array(xtr[idx])),
+                               mx.nd.array(ytr[idx]))
+            loss.backward()
+            trainer.step(len(idx))
+
+    acc_fp32 = accuracy(net, xte, yte)
+    stored = quantize_params(net)
+    nbytes_fp32 = sum(p.data().size * 4
+                      for p in net.collect_params().values())
+    nbytes_q = sum(q.size + 8 for q, _, _ in stored.values())
+    load_quantized(net, stored)
+    acc_q = accuracy(net, xte, yte)
+
+    logging.info('fp32 acc %.3f -> uint8 acc %.3f (weights %.1fx smaller)',
+                 acc_fp32, acc_q, nbytes_fp32 / nbytes_q)
+    assert acc_fp32 > 0.9, acc_fp32
+    assert acc_fp32 - acc_q <= args.max_drop, (acc_fp32, acc_q)
+    print('quantize_mlp: fp32=%.3f uint8=%.3f compression=%.1fx'
+          % (acc_fp32, acc_q, nbytes_fp32 / nbytes_q))
+
+
+if __name__ == '__main__':
+    main()

@@ -69,6 +69,7 @@ CASES = [
     ('profiler/profiler_demo.py', []),
     ('module/mnist_mlp.py', []),
     ('python-howto/basics.py', []),
+    ('quantization/quantize_mlp.py', []),
 ]
 
 
