@@ -38,8 +38,10 @@ MODEL = os.environ.get('MXTPU_BENCH_MODEL', 'resnet50')
 # steps fused into one XLA call via lax.scan (in-graph train loop, the
 # standard TPU pattern). Each compiled(...) dispatch crosses the axon
 # tunnel; at ~ms RTTs a per-step dispatch caps throughput regardless of
-# chip speed — suspected cause of the round-3 1273-vs-2393 img/s gap.
-STEPS_PER_CALL = int(os.environ.get('MXTPU_BENCH_STEPS_PER_CALL', '8'))
+# chip speed — measured A/B on 2026-07-31: spc=1 1596 img/s, spc=8
+# 2468, spc=32 2552, spc=64 2572 (saturated). 32 balances the gain
+# against warmup cost on a flaky tunnel.
+STEPS_PER_CALL = int(os.environ.get('MXTPU_BENCH_STEPS_PER_CALL', '32'))
 WARMUP_STEPS = 3
 INIT_ATTEMPTS = int(os.environ.get('MXTPU_BENCH_INIT_ATTEMPTS', '2'))
 INIT_TIMEOUT_S = float(os.environ.get('MXTPU_BENCH_INIT_TIMEOUT', '180'))

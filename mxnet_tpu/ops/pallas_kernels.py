@@ -43,6 +43,46 @@ def _interpret():
     return jax.default_backend() != 'tpu'
 
 
+def _block_ok(blk, dim):
+    """Mosaic's second-to-minor block rule (jax pallas/mosaic/lowering.py
+    _check_block_mappings): a second-to-minor block dim is legal iff it
+    equals the array dim or is a multiple of 8. (Minor dims and rank-1
+    blocks need %128 or equality instead — here every minor dim and
+    every rank-1 block equals its array dim: full feature rows, full
+    (D,) params, and the [.., blk, 1] columns that carry per-row
+    outputs.) Interpret mode (the CPU test mesh) does NOT enforce any
+    of this, so every block-size choice goes through these helpers to
+    keep CPU-green == TPU-lowerable."""
+    return blk == dim or blk % 8 == 0
+
+
+def _pick_block(want, n):
+    """Largest Mosaic-legal divisor of ``n`` that is <= want. Falls back
+    to the whole axis (always legal, but only sensible when the full
+    block fits VMEM — the row kernels pre-pad ``n`` to a multiple of 8
+    via :func:`_pad_rows` so they never take the fallback on awkward
+    sizes; flash q tiles share the fallback with the by-design
+    full-axis K/V blocks)."""
+    for b in range(min(want, n), 0, -1):
+        if n % b == 0 and _block_ok(b, n):
+            return b
+    return n
+
+
+def _pad_and_block(want, n):
+    """(pad, blk) for tiling ``n`` rows at ~``want``: pad rows up to the
+    next multiple of 8 when ``n`` has no Mosaic-legal divisor <= want,
+    then pick the largest legal divisor of ``n + pad``. Keeps wide row
+    kernels (e.g. a [N, vocab] xent) from falling back to a whole-array
+    block that cannot fit VMEM when N has no small legal divisor
+    (N = 2 * prime, ...). ``want`` is clamped to >= 8 internally so
+    that once padded to a multiple of 8, blk=8 always qualifies — the
+    fallback is only reachable for n <= want (small full blocks)."""
+    want = max(want, 8)
+    pad = (-n) % 8 if (n > want and _pick_block(want, n) == n) else 0
+    return pad, _pick_block(want, n + pad)
+
+
 # ---------------------------------------------------------------------------
 # Flash attention
 # ---------------------------------------------------------------------------
@@ -91,8 +131,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, causal, scale, blk_q,
     acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc, m, l))
     o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
     # log-sum-exp of the scaled scores per query row — lets callers (ring
-    # attention) merge normalized per-chunk outputs exactly
-    lse_ref[0] = (m[:, 0] + jnp.log(jnp.maximum(l[:, 0], 1e-30)))
+    # attention) merge normalized per-chunk outputs exactly. Kept as a
+    # [blk_q, 1] column: a (1, blk_q, 1) block is Mosaic-legal (minor dim
+    # equals the array's), a (1, blk_q) one is not (second-to-minor 1).
+    lse_ref[0] = (m + jnp.log(jnp.maximum(l, 1e-30)))
 
 
 def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
@@ -104,34 +146,51 @@ def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
         # reject rather than return silently-wrong finite values
         raise ValueError('causal attention requires Tq <= Tk '
                          '(got Tq=%d, Tk=%d)' % (Tq, Tk))
-    blk_q = min(blk_q, Tq)
-    blk_k = min(blk_k, Tk)
-    if Tq % blk_q or Tk % blk_k:
-        raise ValueError('seq lengths must divide block sizes '
-                         '(Tq=%d/%d, Tk=%d/%d)' % (Tq, blk_q, Tk, blk_k))
+    if Tk == 0:
+        # softmax over an empty key set is undefined (NaN in the
+        # oracle); fail loudly instead of tracing a 0-size block
+        raise ValueError('attention requires at least one key (Tk=0)')
+    if B * H == 0 or Tq == 0:        # empty batch/seq: nothing to launch
+        return (jnp.zeros((B, Tq, H, D), q.dtype),
+                jnp.zeros((B, H, Tq), jnp.float32))
+    # block_q/block_k are advisory: coerced to the largest Mosaic-legal
+    # divisor of the axis (<= requested). The q axis is PADDED (zeros,
+    # sliced off below) when it has no small legal divisor — a
+    # whole-axis blk_q would put an O(Tq x blk_k) score tile in VMEM.
+    # blk_k may fall back to Tk: the K/V blocks are full-axis by design,
+    # and the score tile stays bounded by blk_q rows.
+    pad_q, blk_q = _pad_and_block(min(blk_q, Tq), Tq)
+    blk_k = _pick_block(blk_k, Tk)
     # [B, T, H, D] -> [B*H, T, D] for a clean 2-d grid
     qh = q.transpose(0, 2, 1, 3).reshape(B * H, Tq, D)
     kh = k.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
     vh = v.transpose(0, 2, 1, 3).reshape(B * H, Tk, D)
+    if pad_q:
+        # zero q rows appended past Tq: their scores are 0 -> a uniform
+        # finite softmax; the causal offset keys off the ORIGINAL Tq and
+        # the rows are sliced off below, so real rows are untouched
+        qh = jnp.concatenate(
+            [qh, jnp.zeros((B * H, pad_q, D), qh.dtype)], axis=1)
+    Tq_p = Tq + pad_q
 
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
                                blk_q=blk_q, blk_k=blk_k, offset=Tk - Tq)
     out, lse = pl.pallas_call(
         kernel,
-        grid=(B * H, Tq // blk_q),
+        grid=(B * H, Tq_p // blk_q),
         in_specs=[
             pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, Tk, D), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[pl.BlockSpec((1, blk_q, D), lambda b, i: (b, i, 0)),
-                   pl.BlockSpec((1, blk_q), lambda b, i: (b, i))],
-        out_shape=[jax.ShapeDtypeStruct((B * H, Tq, D), q.dtype),
-                   jax.ShapeDtypeStruct((B * H, Tq), jnp.float32)],
+                   pl.BlockSpec((1, blk_q, 1), lambda b, i: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, Tq_p, D), q.dtype),
+                   jax.ShapeDtypeStruct((B * H, Tq_p, 1), jnp.float32)],
         interpret=_interpret(),
     )(qh, kh, vh)
-    out = out.reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
-    lse = lse.reshape(B, H, Tq)
+    out = out[:, :Tq].reshape(B, H, Tq, D).transpose(0, 2, 1, 3)
+    lse = lse[:, :Tq].reshape(B, H, Tq)
     return out, lse
 
 
@@ -139,7 +198,12 @@ def _flash_fwd_impl(q, k, v, causal, scale, blk_q, blk_k):
 def flash_attention(q, k, v, causal=False, scale=None, block_q=128,
                     block_k=128):
     """Memory-efficient attention; shapes [B, T, H, D] like
-    ring_attention.attention_reference (its numeric oracle)."""
+    ring_attention.attention_reference (its numeric oracle).
+
+    ``block_q``/``block_k`` are advisory tile sizes: they are coerced to
+    the largest Mosaic-legal divisor of the respective sequence axis
+    (so non-dividing or non-8-multiple requests silently shrink/grow
+    rather than erroring)."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
     return _flash_fwd_impl(q, k, v, causal, s, block_q, block_k)[0]
 
@@ -237,20 +301,21 @@ def _norm_call(kernel, arrs, x, block_rows=256):
     D = x.shape[-1]
     x2 = x.reshape(-1, D)
     N = x2.shape[0]
-    blk = block_rows
-    while N % blk:
-        blk //= 2
-    blk = max(blk, 1)
+    if N == 0:                       # empty batch: nothing to launch
+        return x2.reshape(lead + (D,))
+    pad, blk = _pad_and_block(block_rows, N)
+    if pad:
+        x2 = jnp.concatenate([x2, jnp.zeros((pad, D), x2.dtype)])
     out = pl.pallas_call(
         kernel,
-        grid=(N // blk,),
+        grid=((N + pad) // blk,),
         in_specs=[pl.BlockSpec((blk, D), lambda i: (i, 0))] +
                  [pl.BlockSpec((D,), lambda i: (0,))] * len(arrs),
         out_specs=pl.BlockSpec((blk, D), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((N, D), x.dtype),
+        out_shape=jax.ShapeDtypeStruct((N + pad, D), x.dtype),
         interpret=_interpret(),
     )(x2, *arrs)
-    return out.reshape(lead + (D,))
+    return out[:N].reshape(lead + (D,))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -345,6 +410,9 @@ fused_softmax.defvjp(_softmax_fwd, _softmax_bwd)
 # ---------------------------------------------------------------------------
 
 def _xent_kernel(logits_ref, labels_ref, loss_ref):
+    # labels/loss ride as [blk, 1] columns: rank-1 blocks would need
+    # blk % 128 == 0 on real TPU (Mosaic's rank-1 rule); a [blk, 1]
+    # block only needs blk % 8 with its minor dim equal to the array's
     x = logits_ref[:].astype(jnp.float32)          # [blk, V]
     m = x.max(axis=-1, keepdims=True)
     lse = jnp.log(jnp.sum(jnp.exp(x - m), axis=-1)) + m[:, 0]
@@ -352,7 +420,7 @@ def _xent_kernel(logits_ref, labels_ref, loss_ref):
     cols = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
     onehot = cols == labels_ref[:].reshape(n, 1)
     gold = jnp.sum(jnp.where(onehot, x, 0.0), axis=-1)
-    loss_ref[:] = (lse - gold).astype(loss_ref.dtype)
+    loss_ref[:] = (lse - gold).astype(loss_ref.dtype)[:, None]
 
 
 @jax.custom_vjp
@@ -360,19 +428,21 @@ def softmax_xent(logits, labels):
     """Per-example CE loss [N] from logits [N, V] + int labels [N],
     without materializing softmax in HBM."""
     N, V = logits.shape
-    blk = 128
-    while N % blk:
-        blk //= 2
-    blk = max(blk, 1)
+    if N == 0:                       # empty batch: nothing to launch
+        return jnp.zeros((0,), jnp.float32)
+    pad, blk = _pad_and_block(128, N)
+    if pad:
+        logits = jnp.concatenate([logits, jnp.zeros((pad, V), logits.dtype)])
+        labels = jnp.concatenate([labels, jnp.zeros((pad,), labels.dtype)])
     return pl.pallas_call(
         _xent_kernel,
-        grid=(N // blk,),
+        grid=((N + pad) // blk,),
         in_specs=[pl.BlockSpec((blk, V), lambda i: (i, 0)),
-                  pl.BlockSpec((blk,), lambda i: (i,))],
-        out_specs=pl.BlockSpec((blk,), lambda i: (i,)),
-        out_shape=jax.ShapeDtypeStruct((N,), jnp.float32),
+                  pl.BlockSpec((blk, 1), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((blk, 1), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N + pad, 1), jnp.float32),
         interpret=_interpret(),
-    )(logits, labels)
+    )(logits, labels[:, None])[:N, 0]
 
 
 def _xent_fwd(logits, labels):
