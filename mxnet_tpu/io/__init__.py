@@ -407,8 +407,15 @@ class MNISTIter(NDArrayIter):
             images = images.reshape(images.shape[0], -1)
         else:
             images = images.reshape(images.shape[0], 1, 28, 28)
+        if shuffle:
+            # reference iter_mnist.cc shuffles ONCE at init with `seed`;
+            # reset() rewinds to the SAME order. Scripts rely on this:
+            # e.g. module/mnist_mlp.py aligns predict(merge_batches=False)
+            # outputs against a second pass of the iterator by index.
+            perm = np.random.RandomState(seed).permutation(len(labels))
+            images, labels = images[perm], labels[perm]
         super().__init__(images, labels, batch_size=batch_size,
-                         shuffle=bool(shuffle), last_batch_handle='discard',
+                         shuffle=False, last_batch_handle='discard',
                          label_name='softmax_label')
 
 

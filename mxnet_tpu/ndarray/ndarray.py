@@ -152,6 +152,12 @@ class NDArray:
         arr = np.asarray(self._data)
         if arr.dtype == jnp.bfloat16:
             arr = arr.astype(np.float32)
+        if not arr.flags.writeable:
+            # reference asnumpy() copies device->host: callers own the
+            # result and may mutate it (e.g. the CustomOp examples do
+            # y[i, l] -= 1 on a forward output); np.asarray over a
+            # jax.Array is a read-only view of the device buffer
+            arr = arr.copy()
         return arr
 
     def asscalar(self):

@@ -64,7 +64,12 @@ class BucketSentenceIter(DataIter):
             buff = np.full((buckets[buck],), invalid_label, dtype=dtype)
             buff[:len(sent)] = sent
             self.data[buck].append(buff)
-        self.data = [np.asarray(i, dtype=dtype) for i in self.data]
+        # an empty bucket's asarray is 1-D (0,); give it the (0, length)
+        # shape so reset()'s label[:, :-1] slicing stays valid (the
+        # reference never hits this — PTB fills every default bucket)
+        self.data = [np.asarray(i, dtype=dtype) if i else
+                     np.empty((0, b), dtype=dtype)
+                     for i, b in zip(self.data, buckets)]
 
         self.batch_size = batch_size
         self.buckets = buckets

@@ -537,18 +537,11 @@ def _invoke_sym(op_name, input_syms, kwargs):
                 if nxt is not None:
                     merged.append(nxt)
         inputs = merged
-    elif op.variadic and named:
+    elif op.variadic and (named or op_name == 'Custom'):
         # keyword symbol inputs to a variadic op (the reference's Custom
         # example style: mx.sym.Custom(data=..., label=..., op_type=...)).
         # For Custom the prop declares the input order; otherwise keep
-        # keyword insertion order. Mixing positional and keyword symbol
-        # inputs is ambiguous for variable-length ops — reject it (the
-        # reference errors the same way, symbol.py _compose).
-        if inputs:
-            raise ValueError(
-                'operator %s takes variable-length inputs: pass symbol '
-                'inputs either all positionally or all by keyword, not '
-                'mixed' % op_name)
+        # keyword insertion order.
         order = None
         if op_name == 'Custom' and 'op_type' in kwargs:
             from ..operator import _CUSTOM_OPS, _CUSTOM_RESERVED
@@ -563,16 +556,49 @@ def _invoke_sym(op_name, input_syms, kwargs):
                     list(prop.list_auxiliary_states())
             except Exception:
                 order = None
-        if order:
+        if order is not None:
+            # Custom with a declared input order: merge positional and
+            # keyword inputs, and AUTO-CREATE a <name>_<arg> Variable
+            # for every declared input not passed (reference compose
+            # semantics — e.g. Custom(data=fc3, name='softmax',
+            # op_type='softmax') grows a 'softmax_label' input, which
+            # FeedForward/Module label binding relies on).
             unknown = [k for k in named if k not in order]
             if unknown:
                 raise ValueError(
                     'unknown keyword input(s) %s for Custom op %r; '
                     'declared inputs are %s' %
                     (unknown, kwargs.get('op_type'), order))
-            inputs = inputs + [named[n] for n in order if n in named]
-        else:
-            inputs = inputs + list(named.values())
+            final_name = NameManager.current().get(name, 'custom')
+            merged = []
+            pos_iter = iter(inputs)
+            for n in order:
+                if n in named:
+                    merged.append(named[n])
+                    continue
+                nxt = next(pos_iter, None)
+                merged.append(nxt if nxt is not None
+                              else Variable('%s_%s' % (final_name, n)))
+            leftover = list(pos_iter)
+            if leftover:
+                raise ValueError(
+                    'Custom op %r takes inputs %s; %d extra positional '
+                    'input(s) given' % (kwargs.get('op_type'), order,
+                                        len(leftover)))
+            if op.key_var_num_args and op.key_var_num_args not in kwargs:
+                kwargs[op.key_var_num_args] = len(merged)
+            return create(op_name, merged, kwargs, final_name)
+        # Mixing positional and keyword symbol inputs is ambiguous for
+        # variable-length ops without a declared order — reject it (the
+        # reference errors the same way, symbol.py _compose). A
+        # positional-only Custom whose prop failed to instantiate above
+        # composes as before (prop errors surface at bind/exec time).
+        if inputs and named:
+            raise ValueError(
+                'operator %s takes variable-length inputs: pass symbol '
+                'inputs either all positionally or all by keyword, not '
+                'mixed' % op_name)
+        inputs = inputs + list(named.values())
     if op.variadic and op.key_var_num_args and op.key_var_num_args not in kwargs:
         kwargs[op.key_var_num_args] = len(inputs)
     # auto-create missing trailing parameter variables (MXNet creates

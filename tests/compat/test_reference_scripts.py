@@ -53,11 +53,13 @@ def _write_idx(dirpath, train_n=4096, test_n=1024, gz=True):
             f.write(labels.tobytes())
 
 
-def _run_reference_script(script_path, argv, cwd, timeout=540):
+def _run_reference_script(script_path, argv, cwd, timeout=540,
+                          extra_preamble=''):
     """Execute an unmodified reference script with the mxnet alias on
     PYTHONPATH. The -c shim only pins the platform to CPU (sitecustomize
-    pre-pins a TPU platform) and sets argv — the script file is run
-    verbatim via runpy."""
+    pre-pins a TPU platform), optionally applies an environment-era
+    compat alias (``extra_preamble``, e.g. numpy 1.x's np.int), and sets
+    argv — the script file is run verbatim via runpy."""
     env = dict(os.environ)
     env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
     env['JAX_PLATFORMS'] = 'cpu'
@@ -65,6 +67,7 @@ def _run_reference_script(script_path, argv, cwd, timeout=540):
     script_dir = os.path.dirname(script_path)
     code = (
         "import jax; jax.config.update('jax_platforms','cpu');"
+        + extra_preamble +
         "import sys, runpy; sys.path.insert(0, %r); sys.argv=[%r]+%r;"
         "runpy.run_path(%r, run_name='__main__')"
         % (script_dir, os.path.basename(script_path), argv, script_path))
@@ -105,3 +108,96 @@ def test_gluon_image_classification_unmodified(tmp_path):
     assert float(accs[-1]) > 0.5, out[-4000:]
     # the script's own save_params output exists
     assert os.path.exists(str(tmp_path / 'image-classifier-resnet18_v1-1.params'))
+
+
+def test_numpy_ops_custom_softmax_unmodified(tmp_path):
+    """example/numpy-ops/custom_softmax.py:1-89 — a host-python CustomOp
+    (forward + backward in numpy) registered via mx.operator.register
+    and trained with the legacy FeedForward API. The strongest compat
+    probe for the CustomOp bridge: the script is the reference's own.
+
+    The runner preamble aliases np.int (removed in numpy 2.x) — an
+    environment-era shim, not a framework one; the script itself is
+    untouched."""
+    _write_idx(str(tmp_path / 'data'), train_n=2048, test_n=512, gz=False)
+    script = os.path.join(REF_EXAMPLE, 'numpy-ops', 'custom_softmax.py')
+    env_shim = "import numpy; numpy.int = int;"
+    proc = _run_reference_script(script, [], cwd=str(tmp_path),
+                                 extra_preamble=env_shim, timeout=900)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert float(accs[-1]) > 0.9, out[-4000:]
+
+
+def test_module_mnist_mlp_unmodified(tmp_path):
+    """example/module/mnist_mlp.py — the Module API tour (manual
+    forward/backward/update loop, fit, iter_predict, predict with and
+    without merge_batches, score). The script writes its data dir next
+    to itself (utils.get_data.get_mnist(basedir/data)), so the module/
+    and utils/ trees are copied VERBATIM to a scratch dir (byte-for-byte
+    — the reference tree is read-only here) and pre-seeded."""
+    import shutil
+    for d in ('module', 'utils'):
+        shutil.copytree(os.path.join(REF_EXAMPLE, d), str(tmp_path / d))
+    # the script's fixed recipe (Uniform(0.01) init, 3-layer MLP, lr
+    # 0.01, n_epoch=2) needs ~1000 updates to leave the tiny-logit
+    # plateau — same count it gets on real MNIST (2 x 600 batches)
+    _write_idx(str(tmp_path / 'module' / 'data'), train_n=49152,
+               test_n=2048, gz=False)
+    script = str(tmp_path / 'module' / 'mnist_mlp.py')
+    proc = _run_reference_script(script, [], cwd=str(tmp_path), timeout=900)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    m = re.findall(r'validation Accuracy: ([0-9.]+)', out)
+    assert m, out[-4000:]
+    assert float(m[-1]) > 0.9, out[-4000:]
+    accs = re.findall(r'accuracy=([0-9.]+)', out)
+    assert accs and float(accs[-1]) > 0.9, out[-4000:]
+
+
+def _write_ptb_like(dirpath, n_train=240, n_test=60, vocab=24, seed=5):
+    """Tiny PTB-shaped corpus: each sentence walks an arithmetic cycle
+    over a small vocab, so next-word entropy is low and an LSTM's
+    perplexity falls fast."""
+    os.makedirs(dirpath, exist_ok=True)
+    rng = np.random.RandomState(seed)
+    words = ['w%02d' % i for i in range(vocab)]
+
+    def sentences(n):
+        out = []
+        for _ in range(n):
+            start = rng.randint(vocab)
+            step = rng.choice([1, 2])
+            length = rng.randint(5, 19)
+            out.append(' '.join(words[(start + step * t) % vocab]
+                                for t in range(length)))
+        return '\n'.join(out) + '\n'
+    with open(os.path.join(dirpath, 'ptb.train.txt'), 'w') as f:
+        f.write(sentences(n_train))
+    with open(os.path.join(dirpath, 'ptb.test.txt'), 'w') as f:
+        f.write(sentences(n_test))
+
+
+def test_rnn_lstm_bucketing_unmodified(tmp_path):
+    """example/rnn/lstm_bucketing.py — BucketingModule + SequentialRNNCell
+    + BucketSentenceIter + Perplexity metric over ./data/ptb.*.txt,
+    exactly the reference's LSTM-LM recipe."""
+    _write_ptb_like(str(tmp_path / 'data'), n_train=600, n_test=120)
+    script = os.path.join(REF_EXAMPLE, 'rnn', 'lstm_bucketing.py')
+    proc = _run_reference_script(
+        script,
+        ['--num-epochs', '6', '--num-layers', '1', '--num-hidden', '64',
+         '--num-embed', '32', '--batch-size', '16', '--lr', '0.5',
+         '--disp-batches', '20'],
+        cwd=str(tmp_path), timeout=900)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    ppl = [float(p) for p in
+           re.findall(r'Train-perplexity=([0-9.]+)', out)]
+    assert len(ppl) >= 2, out[-4000:]
+    # the corpus is near-deterministic (cyclic walks): a learning LSTM
+    # leaves untrained ~vocab-size perplexity far behind
+    assert ppl[-1] < 3.0, ppl
+    assert all(np.isfinite(p) for p in ppl), ppl
