@@ -569,22 +569,28 @@ def _invoke_sym(op_name, input_syms, kwargs):
                     'unknown keyword input(s) %s for Custom op %r; '
                     'declared inputs are %s' %
                     (unknown, kwargs.get('op_type'), order))
-            final_name = NameManager.current().get(name, 'custom')
-            merged = []
-            pos_iter = iter(inputs)
-            for n in order:
-                if n in named:
-                    merged.append(named[n])
-                    continue
-                nxt = next(pos_iter, None)
-                merged.append(nxt if nxt is not None
-                              else Variable('%s_%s' % (final_name, n)))
-            leftover = list(pos_iter)
-            if leftover:
+            if len(inputs) > len(order):
                 raise ValueError(
                     'Custom op %r takes inputs %s; %d extra positional '
                     'input(s) given' % (kwargs.get('op_type'), order,
-                                        len(leftover)))
+                                        len(inputs) - len(order)))
+            final_name = NameManager.current().get(name, 'custom')
+            merged = []
+            for idx, n in enumerate(order):
+                if idx < len(inputs):
+                    # positionals fill the LEADING declared slots only —
+                    # re-slotting a positional around a keyword-bound
+                    # name would silently build the wrong graph
+                    if n in named:
+                        raise ValueError(
+                            'Custom op %r input %r is bound both '
+                            'positionally and by keyword' %
+                            (kwargs.get('op_type'), n))
+                    merged.append(inputs[idx])
+                elif n in named:
+                    merged.append(named[n])
+                else:
+                    merged.append(Variable('%s_%s' % (final_name, n)))
             if op.key_var_num_args and op.key_var_num_args not in kwargs:
                 kwargs[op.key_var_num_args] = len(merged)
             return create(op_name, merged, kwargs, final_name)

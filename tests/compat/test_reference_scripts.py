@@ -201,3 +201,42 @@ def test_rnn_lstm_bucketing_unmodified(tmp_path):
     # leaves untrained ~vocab-size perplexity far behind
     assert ppl[-1] < 3.0, ppl
     assert all(np.isfinite(p) for p in ppl), ppl
+
+
+def _write_cifar_rec(path, n, seed):
+    """Class-separable 28x28x3 JPEG records in the reference's packed
+    RecordIO format (IRHeader + encoded image, tools/im2rec layout)."""
+    from mxnet_tpu.recordio import MXRecordIO, IRHeader, pack_img
+    protos = np.random.RandomState(43).rand(10, 28, 28, 3)
+    rng = np.random.RandomState(seed)
+    rec = MXRecordIO(path, 'w')
+    for i in range(n):
+        lab = int(rng.randint(10))
+        img = np.clip(protos[lab] + 0.25 * rng.randn(28, 28, 3), 0, 1)
+        rec.write(pack_img(IRHeader(0, float(lab), i, 0),
+                           (img * 255).astype(np.uint8),
+                           quality=95, img_fmt='.jpg'))
+    rec.close()
+
+
+def test_train_cifar10_unmodified(tmp_path):
+    """example/image-classification/train_cifar10.py — the full
+    common/fit + common/data + symbols/resnet recipe over JPEG RecordIO
+    files (ImageRecordIter with the script's augmentation level). The
+    rec files are pre-seeded so the script's download_file calls
+    short-circuit on existence."""
+    os.makedirs(str(tmp_path / 'data'))
+    _write_cifar_rec(str(tmp_path / 'data' / 'cifar10_train.rec'), 2048, 3)
+    _write_cifar_rec(str(tmp_path / 'data' / 'cifar10_val.rec'), 512, 9)
+    script = os.path.join(REF_EXAMPLE, 'image-classification',
+                          'train_cifar10.py')
+    proc = _run_reference_script(
+        script,
+        ['--num-epochs', '3', '--num-layers', '8', '--batch-size', '64',
+         '--num-examples', '2048', '--lr', '0.05', '--disp-batches', '10'],
+        cwd=str(tmp_path), timeout=1100)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert float(accs[-1]) > 0.85, out[-4000:]

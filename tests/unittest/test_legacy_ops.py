@@ -286,3 +286,45 @@ def test_custom_aux_states_symbolic_shape():
     exe.arg_dict['data'][:] = np.ones((3, 2))
     exe.forward(is_train=False)
     np.testing.assert_allclose(exe.outputs[0].asnumpy(), np.ones((3, 2)))
+
+
+def test_custom_symbol_auto_created_inputs():
+    """Custom symbols grow a <name>_<arg> Variable for each declared
+    input not passed (reference compose semantics; mnist/custom_softmax
+    scripts rely on the auto-created softmax_label). Positionals fill
+    the leading declared slots only; duplicates and overflow raise."""
+    import mxnet_tpu as mx
+
+    class Prop(mx.operator.CustomOpProp):
+        def __init__(self):
+            super().__init__(need_top_grad=False)
+
+        def list_arguments(self):
+            return ['data', 'label']
+
+        def list_outputs(self):
+            return ['output']
+
+        def infer_shape(self, in_shape):
+            return [in_shape[0], (in_shape[0][0],)], [in_shape[0]], []
+
+        def create_operator(self, ctx, shapes, dtypes):
+            class Op(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0], in_data[0])
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0], out_data[0])
+            return Op()
+
+    mx.operator.register('autoinput_probe')(Prop)
+    d = mx.sym.Variable('d')
+    s = mx.sym.Custom(data=d, name='soft', op_type='autoinput_probe')
+    assert s.list_arguments() == ['d', 'soft_label']
+    s2 = mx.sym.Custom(d, name='s2', op_type='autoinput_probe')
+    assert s2.list_arguments() == ['d', 's2_label']
+    with pytest.raises(ValueError, match='both'):
+        mx.sym.Custom(d, data=d, op_type='autoinput_probe')
+    with pytest.raises(ValueError, match='extra positional'):
+        mx.sym.Custom(d, d, d, op_type='autoinput_probe')
