@@ -299,8 +299,31 @@ class Executor:
                          for s, d in self._out_shapes(arg_data, aux_data))
         if isinstance(out_grads, NDArray):
             out_grads = [out_grads]
-        return tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
-                     for g in out_grads)
+        heads = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
+                      for g in out_grads)
+        # head grads handed over from ANOTHER module's executor live on
+        # that module's devices (SequentialModule chains modules across
+        # device groups); pull them onto this computation's output
+        # sharding — the reference's engine does this copy implicitly
+        # via cross-context dependency edges. Target shardings: the
+        # materialized outputs when available (callers that build head
+        # grads have read get_outputs()); else, for a single-device
+        # computation, the args' device.
+        outs = self.outputs_cached
+        if outs and len(outs) == len(heads):
+            return tuple(
+                g if getattr(g, 'sharding', None) == o._data.sharding
+                else jax.device_put(g, o._data.sharding)
+                for g, o in zip(heads, outs))
+        arg_shardings = {a.sharding for a in arg_data
+                         if hasattr(a, 'sharding')}
+        if len(arg_shardings) == 1:
+            (sh,) = arg_shardings
+            if len(sh.device_set) == 1:
+                heads = tuple(
+                    g if getattr(g, 'sharding', None) == sh
+                    else jax.device_put(g, sh) for g in heads)
+        return heads
 
     def _out_shapes(self, arg_data, aux_data):
         key = tuple((a.shape, str(a.dtype)) for a in arg_data)

@@ -131,21 +131,26 @@ def test_numpy_ops_custom_softmax_unmodified(tmp_path):
     assert float(accs[-1]) > 0.9, out[-4000:]
 
 
-def test_module_mnist_mlp_unmodified(tmp_path):
-    """example/module/mnist_mlp.py — the Module API tour (manual
-    forward/backward/update loop, fit, iter_predict, predict with and
-    without merge_batches, score). The script writes its data dir next
-    to itself (utils.get_data.get_mnist(basedir/data)), so the module/
-    and utils/ trees are copied VERBATIM to a scratch dir (byte-for-byte
-    — the reference tree is read-only here) and pre-seeded."""
+def _seed_module_tree(tmp_path):
+    """Copy the module/ and utils/ trees VERBATIM to a scratch dir (the
+    scripts write their data dir next to themselves via
+    utils.get_data.get_mnist(basedir/data), and the reference tree is
+    read-only here) and pre-seed the data. Sample count: the scripts'
+    fixed recipes (Uniform(0.01) init, 3-layer MLP, lr 0.01, n_epoch=2)
+    need ~1000 updates to leave the tiny-logit plateau — the same count
+    they get on real MNIST (2 x 600 batches)."""
     import shutil
     for d in ('module', 'utils'):
         shutil.copytree(os.path.join(REF_EXAMPLE, d), str(tmp_path / d))
-    # the script's fixed recipe (Uniform(0.01) init, 3-layer MLP, lr
-    # 0.01, n_epoch=2) needs ~1000 updates to leave the tiny-logit
-    # plateau — same count it gets on real MNIST (2 x 600 batches)
     _write_idx(str(tmp_path / 'module' / 'data'), train_n=49152,
                test_n=2048, gz=False)
+
+
+def test_module_mnist_mlp_unmodified(tmp_path):
+    """example/module/mnist_mlp.py — the Module API tour (manual
+    forward/backward/update loop, fit, iter_predict, predict with and
+    without merge_batches, score)."""
+    _seed_module_tree(tmp_path)
     script = str(tmp_path / 'module' / 'mnist_mlp.py')
     proc = _run_reference_script(script, [], cwd=str(tmp_path), timeout=900)
     out = proc.stdout + proc.stderr
@@ -240,3 +245,20 @@ def test_train_cifar10_unmodified(tmp_path):
     accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
     assert accs, out[-4000:]
     assert float(accs[-1]) > 0.85, out[-4000:]
+
+
+def test_module_sequential_unmodified(tmp_path):
+    """example/module/sequential_module.py — SequentialModule chaining
+    two Modules with demo_data_model_parallelism=True: mod1 on contexts
+    [gpu(0), gpu(1)], mod2 on [gpu(2), gpu(3)] (our virtual device
+    groups), so the UNMODIFIED script drives model parallelism (module
+    chain) x data parallelism (2 devices per module) including the
+    cross-device head-gradient handoff in backward."""
+    _seed_module_tree(tmp_path)
+    script = str(tmp_path / 'module' / 'sequential_module.py')
+    proc = _run_reference_script(script, [], cwd=str(tmp_path), timeout=900)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert float(accs[-1]) > 0.9, out[-4000:]
