@@ -58,6 +58,16 @@ def mirror_wrap(f):
     return jax.checkpoint(f)
 
 
+def _align_head(g, sharding):
+    """Move a head-gradient (cotangent) onto the primal's sharding if it
+    arrived committed elsewhere — SequentialModule hands gradients
+    across module device groups; the reference engine does this copy
+    implicitly via cross-context dependency edges."""
+    if getattr(g, 'sharding', None) == sharding:
+        return g
+    return jax.device_put(g, sharding)
+
+
 def _entry_key(node, idx):
     return (id(node), idx)
 
@@ -311,18 +321,14 @@ class Executor:
         # computation, the args' device.
         outs = self.outputs_cached
         if outs and len(outs) == len(heads):
-            return tuple(
-                g if getattr(g, 'sharding', None) == o._data.sharding
-                else jax.device_put(g, o._data.sharding)
-                for g, o in zip(heads, outs))
+            return tuple(_align_head(g, o._data.sharding)
+                         for g, o in zip(heads, outs))
         arg_shardings = {a.sharding for a in arg_data
                          if hasattr(a, 'sharding')}
         if len(arg_shardings) == 1:
             (sh,) = arg_shardings
             if len(sh.device_set) == 1:
-                heads = tuple(
-                    g if getattr(g, 'sharding', None) == sh
-                    else jax.device_put(g, sh) for g in heads)
+                heads = tuple(_align_head(g, sh) for g in heads)
         return heads
 
     def _out_shapes(self, arg_data, aux_data):
@@ -423,6 +429,10 @@ class Executor:
                 out_grads = [out_grads]
             heads = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
                           for g in out_grads)
+            # cross-device handoff (see _head_grads): cotangents must
+            # live where the primals do
+            heads = tuple(_align_head(g, o.sharding)
+                          for g, o in zip(heads, outs))
         (grads,) = vjp(heads)
         self.outputs_cached = [from_jax(o, self._ctx) for o in outs]
         self._assign_grads(grads)
