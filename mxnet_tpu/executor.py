@@ -429,6 +429,10 @@ class Executor:
                 out_grads = [out_grads]
             heads = tuple(g._data if isinstance(g, NDArray) else jnp.asarray(g)
                           for g in out_grads)
+            if len(heads) != len(outs):
+                raise ValueError(
+                    'backward got %d head gradients for %d outputs'
+                    % (len(heads), len(outs)))
             # cross-device handoff (see _head_grads): cotangents must
             # live where the primals do
             heads = tuple(_align_head(g, o.sharding)

@@ -548,12 +548,14 @@ def _invoke_sym(op_name, input_syms, kwargs):
             prop_kwargs = {k: v for k, v in kwargs.items()
                            if k not in _CUSTOM_RESERVED
                            and k != op.key_var_num_args}
+            n_args = 0
             try:
                 prop = _CUSTOM_OPS[kwargs['op_type']](**prop_kwargs)
                 # aux states bind as trailing inputs (reference custom.cc
                 # input layout), so they belong in the keyword order too
-                order = list(prop.list_arguments()) + \
-                    list(prop.list_auxiliary_states())
+                args_order = list(prop.list_arguments())
+                n_args = len(args_order)
+                order = args_order + list(prop.list_auxiliary_states())
             except Exception:
                 order = None
         if order is not None:
@@ -576,6 +578,7 @@ def _invoke_sym(op_name, input_syms, kwargs):
                                         len(inputs) - len(order)))
             final_name = NameManager.current().get(name, 'custom')
             merged = []
+            omitted_aux = None
             for idx, n in enumerate(order):
                 if idx < len(inputs):
                     # positionals fill the LEADING declared slots only —
@@ -588,9 +591,32 @@ def _invoke_sym(op_name, input_syms, kwargs):
                             (kwargs.get('op_type'), n))
                     merged.append(inputs[idx])
                 elif n in named:
+                    if omitted_aux is not None:
+                        # trailing inputs map to aux slots by position:
+                        # a gap would silently misbind this one
+                        raise ValueError(
+                            'Custom op %r: aux input %r passed but '
+                            'earlier aux %r omitted' %
+                            (kwargs.get('op_type'), n, omitted_aux))
                     merged.append(named[n])
-                else:
+                elif idx < n_args:
+                    # missing ARGUMENTS become <name>_<arg> Variables
+                    # (reference compose semantics: softmax_label).
+                    # Missing AUX states are NOT created — the bind
+                    # machinery allocates them from the prop's
+                    # infer_shape, like any layer's auxiliary state.
                     merged.append(Variable('%s_%s' % (final_name, n)))
+                else:
+                    omitted_aux = n
+            # aux states are all-or-nothing: trailing inputs map to aux
+            # slots by position, so a partial suffix would misbind
+            # (operator.py _split_aux splits only on an exact count)
+            n_aux_given = len(merged) - n_args
+            if n_aux_given not in (0, len(order) - n_args):
+                raise ValueError(
+                    'Custom op %r: pass all %d aux states or none '
+                    '(%d given)' % (kwargs.get('op_type'),
+                                    len(order) - n_args, n_aux_given))
             if op.key_var_num_args and op.key_var_num_args not in kwargs:
                 kwargs[op.key_var_num_args] = len(merged)
             return create(op_name, merged, kwargs, final_name)

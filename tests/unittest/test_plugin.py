@@ -240,3 +240,34 @@ def test_custom_op_persistent_aux_states():
         out = nd.Custom(x, count, op_type='aux_counter_test')
         np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
     np.testing.assert_allclose(count.asnumpy(), [3.0])
+
+
+def test_custom_symbolic_partial_aux_rejected():
+    """Trailing inputs map to aux slots by position, so passing a
+    partial aux suffix would misbind silently — it must raise."""
+    import pytest
+
+    @op_mod.register('two_aux_test')
+    class _TwoAuxProp(op_mod.CustomOpProp):
+        def list_auxiliary_states(self):
+            return ['s1', 's2']
+
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], [[1], [1]]
+
+        def create_operator(self, ctx, shapes, dtypes):
+            return _Counter()
+
+    x = mx.sym.Variable('x')
+    with pytest.raises(ValueError, match='all 2 aux states or none'):
+        mx.sym.Custom(x, mx.sym.Variable('s1v'),
+                      op_type='two_aux_test', num_args=1)
+    with pytest.raises(ValueError, match='all 2 aux states or none'):
+        mx.sym.Custom(data=x, s1=mx.sym.Variable('s1v'),
+                      op_type='two_aux_test')
+    # all aux or none both compose fine
+    assert mx.sym.Custom(x, op_type='two_aux_test',
+                         num_args=1).list_arguments() == ['x']
+    both = mx.sym.Custom(x, mx.sym.Variable('s1v'), mx.sym.Variable('s2v'),
+                         op_type='two_aux_test', num_args=1)
+    assert both.list_arguments() == ['x', 's1v', 's2v']
