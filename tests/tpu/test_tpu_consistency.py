@@ -162,3 +162,68 @@ def test_bf16_tpu_vs_fp32_cpu(name):
     # tests, so undo it here to compare the real production numerics
     with jax.default_matmul_precision('bfloat16'):
         check_consistency(build(), ctxs, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernels compiled FOR REAL on the chip vs their jnp oracles.
+# Interpret mode on the CPU mesh does not enforce Mosaic's block rules
+# (the round-3 transformer bench failed lowering on a CPU-green kernel:
+# docs/tpu_artifacts/bench_transformer_20260731T111706Z.log), so these
+# cases make every tier capture a hardware-lowering proof — including
+# the awkward shapes that take the _pad_and_block padding paths.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('Tq,blk', [(128, 128), (28, 8)],
+                         ids=['aligned', 'padded_q'])
+def test_pallas_flash_attention_on_chip(Tq, blk):
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops.pallas_kernels import flash_attention, _flash_ref
+    rng = np.random.RandomState(0)
+    mk = lambda: jax.device_put(  # noqa: E731
+        jnp.asarray(rng.randn(2, Tq, 2, 16), jnp.float32),
+        mx.tpu().jax_device)
+    q, k, v = (mk() for _ in range(3))
+    for causal in (False, True):
+        out = flash_attention(q, k, v, causal, None, blk, blk)
+        ref = _flash_ref(q, k, v, causal, 16 ** -0.5)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-2, atol=2e-2)
+
+
+@pytest.mark.parametrize('kernel', ['rmsnorm', 'layernorm', 'softmax',
+                                    'xent'])
+def test_pallas_row_kernels_on_chip(kernel):
+    """fused row kernels at N=1006 (= 2*503, the row-padding path)
+    compiled on hardware vs jnp oracles — one verdict per kernel so a
+    capture log records every kernel's lowering status."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import pallas_kernels as pk
+    rng = np.random.RandomState(1)
+    dev = mx.tpu().jax_device
+    x = jax.device_put(jnp.asarray(rng.randn(1006, 128), jnp.float32), dev)
+    x32 = np.asarray(x)
+    e = np.exp(x32 - x32.max(-1, keepdims=True))
+
+    if kernel == 'rmsnorm':
+        g = jax.device_put(jnp.ones((128,), jnp.float32), dev)
+        got = np.asarray(pk.fused_rmsnorm(x, g))
+        want = x32 / np.sqrt((x32 ** 2).mean(-1, keepdims=True) + 1e-6)
+    elif kernel == 'layernorm':
+        g = jax.device_put(jnp.ones((128,), jnp.float32), dev)
+        b = jax.device_put(jnp.zeros((128,), jnp.float32), dev)
+        got = np.asarray(pk.fused_layernorm(x, g, b))
+        mu = x32.mean(-1, keepdims=True)
+        want = (x32 - mu) / np.sqrt(
+            ((x32 - mu) ** 2).mean(-1, keepdims=True) + 1e-5)
+    elif kernel == 'softmax':
+        got = np.asarray(pk.fused_softmax(x))
+        want = e / e.sum(-1, keepdims=True)
+    else:
+        labels = jax.device_put(
+            jnp.asarray(rng.randint(0, 128, (1006,)), jnp.int32), dev)
+        got = np.asarray(pk.softmax_xent(x, labels))
+        lse = np.log(e.sum(-1)) + x32.max(-1)
+        want = lse - x32[np.arange(1006), np.asarray(labels)]
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
