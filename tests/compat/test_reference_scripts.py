@@ -122,8 +122,11 @@ def test_numpy_ops_custom_softmax_unmodified(tmp_path):
     _write_idx(str(tmp_path / 'data'), train_n=2048, test_n=512, gz=False)
     script = os.path.join(REF_EXAMPLE, 'numpy-ops', 'custom_softmax.py')
     env_shim = "import numpy; numpy.int = int;"
+    # 20 fixed epochs of host-python pure_callback steps: ~40 s alone,
+    # but the single-core box can stretch that badly under concurrent
+    # compile jobs — budget generously
     proc = _run_reference_script(script, [], cwd=str(tmp_path),
-                                 extra_preamble=env_shim, timeout=900)
+                                 extra_preamble=env_shim, timeout=2400)
     out = proc.stdout + proc.stderr
     assert proc.returncode == 0, out[-4000:]
     accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
