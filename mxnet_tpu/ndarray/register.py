@@ -13,10 +13,56 @@ from .ndarray import NDArray, invoke
 __all__ = ['make_nd_function', 'install_ops']
 
 
+_sparse_mod = None
+
+
+def _sparse():
+    global _sparse_mod
+    if _sparse_mod is None:
+        from . import sparse as sp
+        _sparse_mod = sp
+    return _sparse_mod
+
+
+def _lower_sparse(a):
+    """Sparse containers participate in the dense op namespace by
+    dense-lowering (the SURVEY §8 ADR: TPU compute is dense-tiled; the
+    real sparse kernels live in nd.sparse.*)."""
+    if isinstance(a, _sparse().BaseSparseNDArray):
+        return a.tostype('default')
+    return a
+
+
 def make_nd_function(op_name):
     op = _reg.get(op_name)
+    routes_sparse_dot = op_name == 'dot'   # hoisted off the hot path
 
     def fn(*args, **kwargs):
+        if args or kwargs:
+            sp = _sparse()
+            if routes_sparse_dot and args and \
+                    isinstance(args[0], sp.CSRNDArray):
+                # reference dot dispatches on storage type: csr lhs uses
+                # the real sparse kernel (gather + segment_sum), same
+                # numerics as dense-lowering but O(nnz). transpose_b has
+                # no sparse kernel — fall through to dense-lowering.
+                tb = bool(kwargs.get('transpose_b', False)) or \
+                    (len(args) > 3 and bool(args[3]))
+                if not tb:
+                    # (lhs, rhs, transpose_a, transpose_b): sparse.dot's
+                    # signature matches the dense op's, so positional
+                    # and rhs=/transpose_a= spellings pass through
+                    res = sp.dot(*args, **{k: v for k, v in kwargs.items()
+                                           if k in ('rhs', 'transpose_a',
+                                                    'transpose_b')})
+                    out_nd = kwargs.get('out')
+                    if out_nd is not None:
+                        out_nd._data = res._data
+                        return out_nd
+                    return res
+            args = [_lower_sparse(a) for a in args]
+            kwargs = {k: (v if k == 'out' else _lower_sparse(v))
+                      for k, v in kwargs.items()}
         out = kwargs.pop('out', None)
         kwargs.pop('name', None)
         inputs = []

@@ -57,10 +57,12 @@ def rand_ndarray(shape, stype='default', density=None, dtype=None):
 
 
 def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
-                        data_init=None, rsp_indices=None):
+                        data_init=None, rsp_indices=None,
+                        distribution=None):
     """(sparse NDArray, (data, idx...)) pair — reference
     test_utils.py:rand_sparse_ndarray. Explicit ``rsp_indices`` pins the
-    stored rows of a row_sparse array; ``data_init`` fills values."""
+    stored rows of a row_sparse array; ``data_init`` fills values;
+    csr ``distribution`` is 'uniform' (default) or 'powerlaw'."""
     from .ndarray.sparse import row_sparse_array, csr_matrix
     density = 0.5 if density is None else density
     dtype = np.float32 if dtype is None else np.dtype(dtype)
@@ -78,14 +80,58 @@ def rand_sparse_ndarray(shape, stype, density=None, dtype=None,
                                dtype=dtype)
         return arr, (vals.astype(dtype), idx)
     if stype == 'csr':
-        dense = np.random.uniform(-1, 1, shape)
-        dense *= np.random.uniform(0, 1, shape) < density
+        if distribution == 'powerlaw':
+            dense = _get_powerlaw_dataset_csr(shape[0], shape[1], density)
+        elif distribution in (None, 'uniform'):
+            dense = _get_uniform_dataset_csr(shape[0], shape[1], density)
+        else:
+            raise ValueError('unknown csr distribution %r' % distribution)
         if data_init is not None:
             dense[dense != 0] = data_init
         arr = csr_matrix(dense.astype(dtype), dtype=dtype)
         return arr, (arr.data.asnumpy(), arr.indptr.asnumpy(),
                      arr.indices.asnumpy())
     raise ValueError(stype)
+
+
+def _validate_csr_generation_inputs(num_rows, num_cols, density):
+    """Shared sanity checks for the csr dataset generators (reference
+    test_utils.py has the same guard for its uniform/powerlaw csr
+    factories)."""
+    if num_rows <= 0 or num_cols <= 0:
+        raise ValueError('csr shape must be positive, got (%d, %d)'
+                         % (num_rows, num_cols))
+    if not 0 <= density <= 1:
+        raise ValueError('density must be in [0, 1], got %s' % density)
+
+
+def _get_uniform_dataset_csr(num_rows, num_cols, density=0.1):
+    """Dense ndarray whose nonzeros are uniformly scattered — the
+    reference's uniform csr dataset distribution."""
+    _validate_csr_generation_inputs(num_rows, num_cols, density)
+    dense = np.random.uniform(-1, 1, (num_rows, num_cols))
+    dense *= np.random.uniform(0, 1, (num_rows, num_cols)) < density
+    return dense
+
+
+def _get_powerlaw_dataset_csr(num_rows, num_cols, density=0.1):
+    """Dense ndarray whose per-row nonzero count doubles row to row
+    until the density budget is spent — the reference's powerlaw csr
+    distribution, modeling the skewed feature popularity real CTR/LibSVM
+    datasets have (a few hot rows, a long sparse tail)."""
+    _validate_csr_generation_inputs(num_rows, num_cols, density)
+    budget = int(num_rows * num_cols * density)
+    dense = np.zeros((num_rows, num_cols))
+    nnz_row = 1
+    for i in range(num_rows):
+        take = min(nnz_row, num_cols, budget)
+        if take <= 0:
+            break
+        cols = np.random.choice(num_cols, size=take, replace=False)
+        dense[i, cols] = np.random.uniform(-1, 1, take)
+        budget -= take
+        nnz_row *= 2
+    return dense
 
 
 # per-dtype default tolerances (reference test_utils.py:62 default_rtols).

@@ -247,3 +247,76 @@ def test_dense_sparse_mixed_arithmetic():
         shape=(2, 2))
     np.testing.assert_allclose((mx.nd.ones((2, 2)) * csr).asnumpy(),
                                [[1, 0], [0, 1]])
+
+
+def test_rand_sparse_csr_distributions():
+    """test_utils csr dataset distributions (reference uniform/powerlaw
+    generators): correct shape/density ballpark, powerlaw rows skewed."""
+    from mxnet_tpu.test_utils import rand_sparse_ndarray
+    np.random.seed(0)
+    arr, (data, indptr, indices) = rand_sparse_ndarray(
+        (64, 32), 'csr', density=0.2, distribution='uniform')
+    dense = arr.asnumpy()
+    assert dense.shape == (64, 32)
+    nnz = (dense != 0).sum()
+    assert 0.1 < nnz / dense.size < 0.35
+    arr, _ = rand_sparse_ndarray((64, 32), 'csr', density=0.2,
+                                 distribution='powerlaw')
+    row_nnz = (arr.asnumpy() != 0).sum(axis=1)
+    # doubling rows: early rows sparse, later rows saturate or budget
+    # runs out — strictly nondecreasing until the cap/budget edge
+    assert row_nnz[0] == 1 and row_nnz.max() > 4
+    import pytest
+    with pytest.raises(ValueError):
+        rand_sparse_ndarray((8, 8), 'csr', density=1.5)
+    with pytest.raises(ValueError):
+        rand_sparse_ndarray((8, 8), 'csr', density=0.5,
+                            distribution='zipf')
+
+
+def test_dense_namespace_accepts_sparse_inputs():
+    """Reference nd.* ops dispatch on storage type: nd.dot(csr, dense)
+    uses the sparse kernel; other dense-namespace ops dense-lower
+    sparse containers (SURVEY ADR)."""
+    from mxnet_tpu.test_utils import rand_sparse_ndarray
+    np.random.seed(2)
+    csr, _ = rand_sparse_ndarray((32, 12), 'csr', density=0.3)
+    w = mx.nd.array(np.random.randn(12, 4).astype(np.float32))
+    out = mx.nd.dot(csr, w)
+    np.testing.assert_allclose(out.asnumpy(), csr.asnumpy() @ w.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    wt = mx.nd.array(np.random.randn(32, 4).astype(np.float32))
+    outT = mx.nd.dot(csr, wt, transpose_a=True)
+    np.testing.assert_allclose(outT.asnumpy(),
+                               csr.asnumpy().T @ wt.asnumpy(),
+                               rtol=1e-5, atol=1e-5)
+    rsp, _ = rand_sparse_ndarray((16, 8), 'row_sparse', density=0.4)
+    s = mx.nd.sum(rsp)
+    np.testing.assert_allclose(float(s.asscalar()), rsp.asnumpy().sum(),
+                               rtol=1e-5)
+    e = mx.nd.elemwise_add(rsp, rsp)
+    np.testing.assert_allclose(e.asnumpy(), 2 * rsp.asnumpy(), rtol=1e-5)
+
+
+def test_dense_namespace_sparse_edge_spellings():
+    """Review-pinned edge spellings: rhs= keyword, out= buffer,
+    transpose_b fallback to dense-lowering, keyword-only sparse input."""
+    from mxnet_tpu.test_utils import rand_sparse_ndarray
+    np.random.seed(3)
+    csr, _ = rand_sparse_ndarray((32, 12), 'csr', density=0.3)
+    w = mx.nd.array(np.random.randn(12, 4).astype(np.float32))
+    ref = csr.asnumpy() @ w.asnumpy()
+    np.testing.assert_allclose(mx.nd.dot(csr, rhs=w).asnumpy(), ref,
+                               rtol=1e-5, atol=1e-5)
+    buf = mx.nd.zeros((32, 4))
+    r = mx.nd.dot(csr, w, out=buf)
+    assert r is buf
+    np.testing.assert_allclose(buf.asnumpy(), ref, rtol=1e-5, atol=1e-5)
+    w2 = mx.nd.array(np.random.randn(4, 12).astype(np.float32))
+    np.testing.assert_allclose(
+        mx.nd.dot(csr, w2, transpose_b=True).asnumpy(),
+        csr.asnumpy() @ w2.asnumpy().T, rtol=1e-4, atol=1e-4)
+    rsp, _ = rand_sparse_ndarray((16, 8), 'row_sparse', density=0.4)
+    s = mx.nd.sum(data=rsp)
+    np.testing.assert_allclose(float(s.asscalar()), rsp.asnumpy().sum(),
+                               rtol=1e-5)
