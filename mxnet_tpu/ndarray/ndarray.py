@@ -575,6 +575,13 @@ def _invoke_impl(op_name, inputs, attrs=None, out=None):
     if out is not None:
         outs_list = out if isinstance(out, (list, tuple)) else [out]
         for dst, src in zip(outs_list, results):
+            # the reference rejects a shape-mismatched out buffer at
+            # shape-inference time (SetShapeType); rebinding would
+            # silently change dst.shape for downstream holders
+            if dst._data.shape != src._data.shape:
+                raise ValueError(
+                    'out has shape %s but %s produced %s'
+                    % (dst._data.shape, op_name, src._data.shape))
             dst._set_data(src._data, src._node, src._out_idx)
         return out
 

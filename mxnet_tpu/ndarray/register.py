@@ -57,7 +57,27 @@ def make_nd_function(op_name):
                                                     'transpose_b')})
                     out_nd = kwargs.get('out')
                     if out_nd is not None:
-                        out_nd._data = res._data
+                        if tuple(out_nd.shape) != tuple(res.shape):
+                            raise ValueError(
+                                'out has shape %s but dot produced %s'
+                                % (out_nd.shape, res.shape))
+                        if isinstance(out_nd, sp.BaseSparseNDArray):
+                            # sparse out buffer: rebind its payload
+                            # (stype must match the kernel's result)
+                            res_st = getattr(res, 'stype', 'default')
+                            if res_st != out_nd.stype:
+                                raise ValueError(
+                                    'out has stype %s but dot produced '
+                                    '%s' % (out_nd.stype, res_st))
+                            out_nd.data = res.data
+                            out_nd.indices = res.indices
+                            if out_nd.stype == 'csr':
+                                out_nd.indptr = res.indptr
+                            return out_nd
+                        # dense out: the reference densifies the sparse
+                        # kernel's result (csr^T . dense -> row_sparse)
+                        # into the provided dense buffer
+                        out_nd._data = _lower_sparse(res)._data
                         return out_nd
                     return res
             args = [_lower_sparse(a) for a in args]

@@ -60,7 +60,7 @@ def _pick_block(want, n):
     """Largest Mosaic-legal divisor of ``n`` that is <= want. Falls back
     to the whole axis (always legal, but only sensible when the full
     block fits VMEM — the row kernels pre-pad ``n`` to a multiple of 8
-    via :func:`_pad_rows` so they never take the fallback on awkward
+    via :func:`_pad_and_block` so they never take the fallback on awkward
     sizes; flash q tiles share the fallback with the by-design
     full-axis K/V blocks)."""
     for b in range(min(want, n), 0, -1):

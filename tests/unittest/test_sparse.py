@@ -320,3 +320,23 @@ def test_dense_namespace_sparse_edge_spellings():
     s = mx.nd.sum(data=rsp)
     np.testing.assert_allclose(float(s.asscalar()), rsp.asnumpy().sum(),
                                rtol=1e-5)
+    # transpose_a route returns a row_sparse result from the sparse
+    # kernel; with a dense out= buffer the reference densifies into it
+    wt = mx.nd.array(np.random.randn(32, 4).astype(np.float32))
+    bufT = mx.nd.zeros((12, 4))
+    rT = mx.nd.dot(csr, wt, transpose_a=True, out=bufT)
+    assert rT is bufT
+    np.testing.assert_allclose(bufT.asnumpy(),
+                               csr.asnumpy().T @ wt.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+    # row_sparse out buffer: payload rebound, not silently stale
+    rsp_buf, _ = rand_sparse_ndarray((12, 4), 'row_sparse', density=0.5)
+    rS = mx.nd.dot(csr, wt, transpose_a=True, out=rsp_buf)
+    assert rS is rsp_buf
+    np.testing.assert_allclose(rS.asnumpy(),
+                               csr.asnumpy().T @ wt.asnumpy(),
+                               rtol=1e-4, atol=1e-4)
+    # mismatched sparse out stype raises loudly
+    csr_buf, _ = rand_sparse_ndarray((12, 4), 'csr', density=0.5)
+    with pytest.raises(ValueError):
+        mx.nd.dot(csr, wt, transpose_a=True, out=csr_buf)
