@@ -77,7 +77,9 @@ def make_nd_function(op_name):
                         # dense out: the reference densifies the sparse
                         # kernel's result (csr^T . dense -> row_sparse)
                         # into the provided dense buffer
-                        out_nd._data = _lower_sparse(res)._data
+                        # _set_data also clears any stale autograd
+                        # node the buffer carried from a previous op
+                        out_nd._set_data(_lower_sparse(res)._data)
                         return out_nd
                     return res
             args = [_lower_sparse(a) for a in args]
