@@ -146,6 +146,16 @@ flags.declare('MXTPU_KVSTORE_DEBUG', bool, False,
 flags.declare('MXTPU_NO_SPMD_MODULE', bool, False,
               'Disable the fused single-program (GSPMD) lowering for '
               'multi-context Module; fall back to the per-device loop')
+flags.declare('MXTPU_FUSED_FIT', bool, True,
+              'Allow Module.fit to compile a window of N train steps '
+              'into one XLA call (lax.scan) when eligible '
+              '(module/fused_fit.py); 0 forces the per-batch loop')
+flags.declare('MXTPU_FIT_STEPS_PER_CALL', int, 0,
+              'Window size for the fused Module.fit fast path; 0 = '
+              'auto (32 on TPU, 4 elsewhere)', min_value=0)
+flags.declare('MXTPU_F16_AS_BF16', bool, False,
+              'Resolve float16 dtype requests to bfloat16, the TPU '
+              'native half type (the MXU has no fp16 datapath)')
 flags.declare('MXTPU_EXEC_BULK_EXEC_MAX_NODE_TRAIN', int, 15,
               'Max ops bulked into one engine push by the executor',
               aliases=('MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN',), min_value=1)

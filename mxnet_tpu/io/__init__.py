@@ -575,52 +575,85 @@ def _read_imgrec(path_imgrec, data_shape, scale, means, stds):
 class ImageRecordIter(DataIter):
     """Reference src/io/iter_image_recordio_2.cc — RecordIO image pipeline.
 
-    Parses the packed RecordIO format written by tools/im2rec (recordio.py
-    here), applies the core augmentations and batches. Raw-pixel records
-    (IRHeader flag-encoded) are supported; JPEG decode requires pillow.
+    Streaming (round 4): a framing-only offset scan at construction,
+    then a producer thread + ``preprocess_threads`` decode/augment
+    workers + a ``prefetch_buffer``-bounded batch queue
+    (io/image_record.py). Memory is O(batch x prefetch), independent of
+    dataset size; augmentation (rand_crop / rand_mirror / scale jitter
+    / pad) is per-image, matching image_aug_default.cc.
     """
 
     def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
                  shuffle=False, mean_r=0, mean_g=0, mean_b=0, std_r=1,
                  std_g=1, std_b=1, scale=1.0, rand_crop=False,
                  rand_mirror=False, preprocess_threads=4, round_batch=True,
+                 prefetch_buffer=4, resize=-1, pad=0, fill_value=127,
+                 max_random_scale=1.0, min_random_scale=1.0, num_parts=1,
+                 part_index=0, data_name='data', label_name='softmax_label',
                  **kwargs):
         super().__init__(batch_size)
+        from .image_record import StreamingImageRecordIter
         self.data_shape = tuple(data_shape)
-        data, labels = _read_imgrec(path_imgrec, self.data_shape, scale,
-                                    (mean_r, mean_g, mean_b),
-                                    (std_r, std_g, std_b))
-        label = np.asarray(labels, dtype=np.float32)
-        if label_width == 1 and label.ndim > 1:
-            label = label[:, 0]
-        self._inner = NDArrayIter(data, label, batch_size=batch_size,
-                                  shuffle=shuffle,
-                                  last_batch_handle='pad' if round_batch else 'discard')
-        self._rand_mirror = rand_mirror
+        self._data_name = data_name
+        self._label_name = label_name
+        self._label_width = label_width
+        self._stream = StreamingImageRecordIter(
+            path_imgrec, self.data_shape, batch_size,
+            label_width=label_width, shuffle=shuffle,
+            mean=(mean_r, mean_g, mean_b), std=(std_r, std_g, std_b),
+            scale=scale, rand_crop=rand_crop, rand_mirror=rand_mirror,
+            preprocess_threads=preprocess_threads,
+            prefetch_buffer=prefetch_buffer, round_batch=round_batch,
+            resize=resize, pad=pad, fill_value=fill_value,
+            max_random_scale=max_random_scale,
+            min_random_scale=min_random_scale,
+            num_parts=num_parts, part_index=part_index, aug_kwargs=kwargs)
+        self._pending = None
+        self._exhausted = False
 
     @property
     def provide_data(self):
-        return self._inner.provide_data
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self.data_shape)]
 
     @property
     def provide_label(self):
-        return self._inner.provide_label
+        shape = (self.batch_size,) if self._label_width == 1 else \
+            (self.batch_size, self._label_width)
+        return [DataDesc(self._label_name, shape)]
 
     def reset(self):
-        self._inner.reset()
+        self._stream.start_epoch()
+        self._pending = None
+        self._exhausted = False
 
     def next(self):
-        batch = self._inner.next()
-        if self._rand_mirror and _random.host_rng().rand() < 0.5:
-            batch = DataBatch([d.flip(axis=3) if d.ndim == 4 else d
-                               for d in batch.data],
-                              batch.label, batch.pad, batch.index,
-                              provide_data=batch.provide_data,
-                              provide_label=batch.provide_label)
-        return batch
+        if self._pending is not None:
+            batch, self._pending = self._pending, None
+            return batch
+        if self._exhausted:
+            raise StopIteration
+        item = self._stream.next_batch()
+        if item is None:
+            self._exhausted = True
+            raise StopIteration
+        data, label, pad = item
+        from .. import ndarray as _nd
+        return DataBatch(data=[_nd.array(data)], label=[_nd.array(label)],
+                         pad=pad, index=None,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
 
     def iter_next(self):
-        return self._inner.iter_next()
+        if self._pending is not None:
+            return True
+        if self._exhausted:
+            return False
+        try:
+            self._pending = self.next()
+            return True
+        except StopIteration:
+            return False
 
 
 class ImageDetRecordIter(DataIter):

@@ -161,9 +161,28 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # TPU fast path: compile a window of N steps into one XLA call
+        # (lax.scan) when the module/optimizer/metric combination allows
+        # it — same numerics, one dispatch per window instead of four
+        # per batch (see module/fused_fit.py). Falls back silently.
+        fused = None
+        if monitor is None:
+            from .fused_fit import FusedFitLoop
+            fused = FusedFitLoop.build(self, eval_metric,
+                                       logger=self.logger)
+
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
             eval_metric.reset()
+            if fused is not None:
+                nbatch = fused.run_epoch(train_data, eval_metric, epoch,
+                                         batch_end_callback)
+                self._fit_epoch_end(epoch, eval_metric, tic,
+                                    epoch_end_callback, eval_data,
+                                    validation_metric, eval_end_callback,
+                                    eval_batch_end_callback)
+                train_data.reset()
+                continue
             nbatch = 0
             data_iter = iter(train_data)
             end_of_batch = False
@@ -190,26 +209,35 @@ class BaseModule:
                         callback(batch_end_params)
                 nbatch += 1
 
-            for name, val in eval_metric.get_name_value():
-                self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
-            toc = time.time()
-            self.logger.info('Epoch[%d] Time cost=%.3f', epoch, (toc - tic))
-
-            arg_params_, aux_params_ = self.get_params()
-            self.set_params(arg_params_, aux_params_)
-            if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params_, aux_params_)
-
-            if eval_data:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info('Epoch[%d] Validation-%s=%f', epoch, name, val)
-
+            self._fit_epoch_end(epoch, eval_metric, tic, epoch_end_callback,
+                                eval_data, validation_metric,
+                                eval_end_callback, eval_batch_end_callback)
             train_data.reset()
+
+    def _fit_epoch_end(self, epoch, eval_metric, tic, epoch_end_callback,
+                       eval_data, validation_metric, eval_end_callback,
+                       eval_batch_end_callback):
+        """Epoch-end bookkeeping shared by the reference per-batch loop
+        and the fused fast path (reference base_module.py:528-553)."""
+        for name, val in eval_metric.get_name_value():
+            self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
+        toc = time.time()
+        self.logger.info('Epoch[%d] Time cost=%.3f', epoch, (toc - tic))
+
+        arg_params_, aux_params_ = self.get_params()
+        self.set_params(arg_params_, aux_params_)
+        if epoch_end_callback is not None:
+            for callback in _as_list(epoch_end_callback):
+                callback(epoch, self.symbol, arg_params_, aux_params_)
+
+        if eval_data:
+            res = self.score(eval_data, validation_metric,
+                             score_end_callback=eval_end_callback,
+                             batch_end_callback=eval_batch_end_callback,
+                             epoch=epoch)
+            for name, val in res:
+                self.logger.info('Epoch[%d] Validation-%s=%f',
+                                 epoch, name, val)
 
     # -- parameter contract (implemented by subclasses) --------------------
     @property

@@ -250,6 +250,35 @@ def test_train_cifar10_unmodified(tmp_path):
     assert float(accs[-1]) > 0.85, out[-4000:]
 
 
+def test_train_imagenet_benchmark_unmodified(tmp_path):
+    """example/image-classification/train_imagenet.py --benchmark 1 —
+    THE north-star workload's own script (symbols/resnet resnet-50,
+    common/fit.fit, kvstore 'device', SGD + MultiFactor lr schedule,
+    Speedometer callbacks) on synthetic data (SyntheticDataIter,
+    common/data.py:75 — no dataset needed; NOTE its epoch is a fixed
+    500 batches regardless of --num-examples). Verbatim script; shrunk
+    shapes via its own CLI (8-layer cifar-style resnet, 28x28 images,
+    batch 16) so a single-core CPU run clears 500 batches. This is the
+    path the TPU fused-fit artifact times at full shape
+    (docs/perf.md round-4)."""
+    script = os.path.join(REF_EXAMPLE, 'image-classification',
+                          'train_imagenet.py')
+    proc = _run_reference_script(
+        script,
+        ['--benchmark', '1', '--num-layers', '8', '--image-shape',
+         '3,28,28', '--batch-size', '16', '--num-epochs', '1',
+         '--disp-batches', '50'],
+        cwd=str(tmp_path), timeout=1500)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    # Speedometer lines prove the fit loop ran and measured throughput
+    speeds = re.findall(r'Speed: ([0-9.]+) samples/sec', out)
+    assert speeds, out[-4000:]
+    accs = re.findall(r'Train-accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert all(np.isfinite(float(a)) for a in accs), accs
+
+
 def test_module_sequential_unmodified(tmp_path):
     """example/module/sequential_module.py — SequentialModule chaining
     two Modules with demo_data_model_parallelism=True: mod1 on contexts

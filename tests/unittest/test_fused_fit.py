@@ -1,0 +1,209 @@
+"""Fused Module.fit fast path (module/fused_fit.py).
+
+The contract under test: with MXTPU_FUSED_FIT on (default), fit
+compiles W steps per device call yet produces IDENTICAL parameters and
+per-batch metric values to the reference per-batch loop (reference
+base_module.py:376) across kvstore modes, update ops, SPMD contexts,
+and window-tail sizes.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import metric as metric_mod
+from mxnet_tpu.module.fused_fit import FusedFitLoop
+
+
+def _mlp_mod(n=56, batch=8, ctx=None, n_classes=4, seed=7):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=n_classes, name='fc2')
+    out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    X = np.random.randn(n, 10).astype(np.float32)
+    y = (np.random.rand(n) * n_classes).astype(int).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
+                           label_name='softmax_label')
+    return mx.mod.Module(out, context=ctx or mx.cpu()), it
+
+
+def _fit(fused, kvstore='local', momentum=0.9, metric='acc', cb=None,
+         **build_kw):
+    os.environ['MXTPU_FUSED_FIT'] = '1' if fused else '0'
+    try:
+        mod, it = _mlp_mod(**build_kw)
+        mod.fit(it, num_epoch=2, optimizer='sgd',
+                optimizer_params=(('learning_rate', 0.1),
+                                  ('momentum', momentum)),
+                kvstore=kvstore, eval_metric=metric,
+                batch_end_callback=cb)
+        args, auxs = mod.get_params()
+        return ({k: v.asnumpy() for k, v in args.items()}, mod)
+    finally:
+        os.environ.pop('MXTPU_FUSED_FIT', None)
+
+
+def _assert_same(a, b):
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=1e-5, atol=1e-6,
+                                    err_msg=k)
+
+
+@pytest.mark.parametrize('kvstore', ['local', 'device', None])
+def test_fused_matches_reference_loop(kvstore):
+    """Identical final params + identical per-batch metric trajectory
+    across kvstore modes (updater path and update-on-kvstore path)."""
+    traj_f, traj_u = [], []
+    a_f, _ = _fit(True, kvstore=kvstore,
+                  cb=lambda p: traj_f.append(
+                      p.eval_metric.get_name_value()[0][1]))
+    a_u, _ = _fit(False, kvstore=kvstore,
+                  cb=lambda p: traj_u.append(
+                      p.eval_metric.get_name_value()[0][1]))
+    _assert_same(a_f, a_u)
+    np.testing.assert_allclose(traj_f, traj_u, atol=1e-9)
+    assert len(traj_f) == 14  # 7 batches x 2 epochs: callback per batch
+
+
+def test_fused_window_tail():
+    """56/8 = 7 batches vs window 4: one fused window + a 3-batch tail
+    through the reference path per epoch, interleaved safely."""
+    a_f, _ = _fit(True)
+    a_u, _ = _fit(False)
+    _assert_same(a_f, a_u)
+
+
+def test_fused_plain_sgd_no_momentum():
+    a_f, _ = _fit(True, momentum=0.0)
+    a_u, _ = _fit(False, momentum=0.0)
+    _assert_same(a_f, a_u)
+
+
+def test_fused_spmd_multi_device():
+    """8-CPU-device SPMD executor group under the fused window: params
+    replicated on the mesh, batch stacks dp-sharded."""
+    ctx = [mx.cpu(i) for i in range(8)]
+    a_f, _ = _fit(True, ctx=ctx, n=64, kvstore='device')
+    a_u, _ = _fit(False, ctx=ctx, n=64, kvstore='device')
+    _assert_same(a_f, a_u)
+
+
+def test_fused_composite_metric_values():
+    comp_f = metric_mod.CompositeEvalMetric()
+    comp_f.add('acc')
+    comp_f.add(metric_mod.TopKAccuracy(top_k=3))
+    comp_f.add('ce')
+    comp_u = metric_mod.CompositeEvalMetric()
+    comp_u.add('acc')
+    comp_u.add(metric_mod.TopKAccuracy(top_k=3))
+    comp_u.add('ce')
+    vf, vu = [], []
+    _fit(True, metric=comp_f, n_classes=6, n=48, batch=6,
+         cb=lambda p: vf.append(tuple(
+             v for _, v in p.eval_metric.get_name_value())))
+    _fit(False, metric=comp_u, n_classes=6, n=48, batch=6,
+         cb=lambda p: vu.append(tuple(
+             v for _, v in p.eval_metric.get_name_value())))
+    np.testing.assert_allclose(np.array(vf), np.array(vu),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_fused_eligibility_gates():
+    """Unsupported configurations decline the fast path (None) instead
+    of changing behavior."""
+    mod, it = _mlp_mod()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.init_optimizer(kvstore='device', optimizer='sgd')
+    os.environ['MXTPU_FUSED_FIT'] = '1'
+    try:
+        assert FusedFitLoop.build(mod, metric_mod.create('acc')) is not None
+        # unsupported metric
+        assert FusedFitLoop.build(mod, metric_mod.create('mse')) is None
+        # flag off
+        os.environ['MXTPU_FUSED_FIT'] = '0'
+        assert FusedFitLoop.build(mod, metric_mod.create('acc')) is None
+        os.environ['MXTPU_FUSED_FIT'] = '1'
+        # non-SGD optimizer
+        mod2, it2 = _mlp_mod()
+        mod2.bind(data_shapes=it2.provide_data,
+                  label_shapes=it2.provide_label)
+        mod2.init_params()
+        mod2.init_optimizer(kvstore='device', optimizer='adam')
+        assert FusedFitLoop.build(mod2, metric_mod.create('acc')) is None
+    finally:
+        os.environ.pop('MXTPU_FUSED_FIT', None)
+
+
+def test_fused_scheduler_no_recompile_and_window_aligned_equality():
+    """lr enters the compiled window as a traced scalar: a scheduler
+    that changes lr every W updates (window-aligned) yields the exact
+    reference trajectory AND one compiled program despite the lr
+    changing across windows."""
+    import mxnet_tpu.module.fused_fit as ff
+    W = ff._window_size()
+    results = {}
+    for fused in (True, False):
+        os.environ['MXTPU_FUSED_FIT'] = '1' if fused else '0'
+        try:
+            mod, it = _mlp_mod(n=64, batch=8)
+            sched = mx.lr_scheduler.FactorScheduler(step=W, factor=0.5)
+            mod.fit(it, num_epoch=2, optimizer='sgd',
+                    optimizer_params=(('learning_rate', 0.2),
+                                      ('momentum', 0.9),
+                                      ('lr_scheduler', sched)),
+                    kvstore='local', eval_metric='acc')
+            args, _ = mod.get_params()
+            results[fused] = {k: v.asnumpy() for k, v in args.items()}
+        finally:
+            os.environ.pop('MXTPU_FUSED_FIT', None)
+    _assert_same(results[True], results[False])
+
+
+def test_fused_program_cache_single_entry_across_lr_changes():
+    """Directly: 3 windows with 3 different lrs compile ONE program."""
+    os.environ['MXTPU_FUSED_FIT'] = '1'
+    try:
+        mod, it = _mlp_mod(n=96, batch=8)   # 12 batches = 3 windows @ W=4
+        sched = mx.lr_scheduler.FactorScheduler(step=2, factor=0.7)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params()
+        mod.init_optimizer(kvstore='local', optimizer='sgd',
+                           optimizer_params=(('learning_rate', 0.1),
+                                             ('momentum', 0.9),
+                                             ('lr_scheduler', sched)))
+        loop = FusedFitLoop.build(mod, metric_mod.create('acc'))
+        assert loop is not None
+        loop.run_epoch(it, metric_mod.create('acc'), 0, None)
+        assert len(loop._programs) == 1
+    finally:
+        os.environ.pop('MXTPU_FUSED_FIT', None)
+
+
+def test_fused_optimizer_state_roundtrip(tmp_path):
+    """Optimizer state written back by the fused path is the state the
+    checkpoint APIs see: save after fused fit == save after reference
+    fit (same trajectory, same momentum buffers)."""
+    paths = {}
+    for fused in (True, False):
+        _, mod = _fit(fused, kvstore='local')
+        p = str(tmp_path / ('states_%d' % fused))
+        mod.save_optimizer_states(p)
+        paths[fused] = p
+    import pickle
+    sf = pickle.loads(open(paths[True], 'rb').read())
+    su = pickle.loads(open(paths[False], 'rb').read())
+    assert set(sf.keys()) == set(su.keys())
+    for k in sf:
+        a, b = sf[k], su[k]
+        if a is None:
+            assert b is None
+            continue
+        np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
+                                   rtol=1e-5, atol=1e-6)

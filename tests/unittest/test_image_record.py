@@ -1,0 +1,211 @@
+"""Streaming ImageRecordIter (io/image_record.py).
+
+Reference behaviors under test (src/io/iter_image_recordio_2.cc +
+image_aug_default.cc + iter_prefetcher.h): per-image rand_crop /
+rand_mirror (not per-batch), honored preprocess_threads, bounded
+prefetch (dataset never resident), shuffle-is-permutation, round_batch
+padding, num_parts sharding, and reproducibility under mx.random.seed.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu.recordio import MXRecordIO, IRHeader, pack_img
+from mxnet_tpu.io.image_record import scan_record_offsets
+
+
+def _write_rec(path, n, hw=12, seed=0, encode='.raw', labeler=None):
+    rng = np.random.RandomState(seed)
+    rec = MXRecordIO(path, 'w')
+    imgs = []
+    for i in range(n):
+        img = (rng.rand(hw, hw, 3) * 255).astype(np.uint8)
+        imgs.append(img)
+        lab = float(labeler(i) if labeler else i % 7)
+        rec.write(pack_img(IRHeader(0, lab, i, 0), img, img_fmt=encode))
+    rec.close()
+    return imgs
+
+
+def test_offset_scan_counts_records(tmp_path):
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 17)
+    assert len(scan_record_offsets(p)) == 17
+
+
+def test_sequential_batches_and_values(tmp_path):
+    """No shuffle/augment: batches reproduce the packed pixels exactly
+    (scale/mean/std applied)."""
+    p = str(tmp_path / 'a.rec')
+    imgs = _write_rec(p, 8, hw=6)
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                             batch_size=4, scale=1.0 / 255)
+    batches = list(it)
+    assert len(batches) == 2
+    got = batches[0].data[0].asnumpy()
+    want = np.stack([im.transpose(2, 0, 1) for im in imgs[:4]]) / 255.0
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    np.testing.assert_allclose(batches[0].label[0].asnumpy(),
+                               [0, 1, 2, 3], atol=0)
+
+
+def test_round_batch_pad_wraps(tmp_path):
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 10, hw=6)
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                             batch_size=4, round_batch=True)
+    batches = list(it)
+    assert [b.pad for b in batches] == [0, 0, 2]
+    # padded tail wraps to the head records
+    np.testing.assert_allclose(batches[2].label[0].asnumpy()[-2:], [0, 1])
+    it2 = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                              batch_size=4, round_batch=False)
+    assert len(list(it2)) == 2
+
+
+def test_rand_mirror_is_per_image(tmp_path):
+    """The round-3 gap: one coin per BATCH is wrong; each image flips
+    independently (image_aug_default.cc). With 32 images the chance of
+    a uniform batch is 2^-31."""
+    p = str(tmp_path / 'a.rec')
+    imgs = _write_rec(p, 32, hw=6)
+    mx.random.seed(5)
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                             batch_size=32, rand_mirror=True)
+    got = next(iter(it)).data[0].asnumpy()
+    flipped = []
+    for i, im in enumerate(imgs):
+        chw = im.transpose(2, 0, 1).astype(np.float32)
+        if np.allclose(got[i], chw):
+            flipped.append(False)
+        elif np.allclose(got[i], chw[:, :, ::-1]):
+            flipped.append(True)
+        else:
+            raise AssertionError('image %d is neither original nor '
+                                 'mirrored' % i)
+    assert any(flipped) and not all(flipped)
+
+
+def test_rand_crop_is_per_image(tmp_path):
+    """Each image draws its own crop offset: crops of a coordinate ramp
+    differ across the batch."""
+    p = str(tmp_path / 'a.rec')
+    rec = MXRecordIO(p, 'w')
+    ramp = np.tile(np.arange(16, dtype=np.uint8)[None, :, None] * 10,
+                   (16, 1, 3))
+    for i in range(16):
+        rec.write(pack_img(IRHeader(0, float(i), i, 0), ramp,
+                           img_fmt='.raw'))
+    rec.close()
+    mx.random.seed(11)
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 8, 8),
+                             batch_size=16, rand_crop=True)
+    got = next(iter(it)).data[0].asnumpy()
+    # the x-offset of each crop is its first column value / 10
+    offs = {int(round(got[i, 0, 0, 0] / 10)) for i in range(16)}
+    assert len(offs) > 1, 'all crops identical — per-batch, not per-image'
+    # without rand_crop: center crop for every image
+    it2 = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 8, 8),
+                              batch_size=16)
+    got2 = next(iter(it2)).data[0].asnumpy()
+    assert {int(round(got2[i, 0, 0, 0] / 10)) for i in range(16)} == {4}
+
+
+def test_shuffle_is_seeded_permutation(tmp_path):
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 24, hw=6)
+    mx.random.seed(3)
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                             batch_size=8, shuffle=True)
+    labs = np.concatenate([b.label[0].asnumpy() for b in it])
+    full = np.arange(24) % 7
+    assert sorted(labs.tolist()) == sorted(full.tolist())
+    assert not np.array_equal(labs, full)   # actually shuffled
+    mx.random.seed(3)
+    it2 = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                              batch_size=8, shuffle=True)
+    labs2 = np.concatenate([b.label[0].asnumpy() for b in it2])
+    np.testing.assert_allclose(labs, labs2)   # seed-reproducible
+
+
+def test_num_parts_sharding(tmp_path):
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 12, hw=6, labeler=lambda i: i)
+    seen = []
+    for part in range(3):
+        it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                                 batch_size=4, num_parts=3,
+                                 part_index=part)
+        seen.append(np.concatenate([b.label[0].asnumpy() for b in it]))
+    allsee = sorted(np.concatenate(seen).tolist())
+    assert allsee == list(range(12))
+    assert seen[0].tolist() == [0, 3, 6, 9]
+
+
+def test_reset_mid_epoch_and_reuse(tmp_path):
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 16, hw=6, labeler=lambda i: i)
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                             batch_size=4)
+    next(it)
+    it.reset()   # abandon a running producer mid-epoch
+    labs = np.concatenate([b.label[0].asnumpy() for b in it])
+    np.testing.assert_allclose(labs, np.arange(16))
+    it.reset()
+    assert len(list(it)) == 4
+
+
+def test_preprocess_threads_honored_and_equal(tmp_path):
+    """Thread count changes execution, not results."""
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 20, hw=6)
+    outs = []
+    for t in (1, 4):
+        it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                                 batch_size=5, preprocess_threads=t)
+        outs.append(np.concatenate([b.data[0].asnumpy() for b in it]))
+    np.testing.assert_allclose(outs[0], outs[1])
+
+
+def test_pad_and_fill_value(tmp_path):
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 4, hw=6)
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 10, 10),
+                             batch_size=4, pad=2, fill_value=9)
+    got = next(iter(it)).data[0].asnumpy()
+    assert got.shape == (4, 3, 10, 10)
+    np.testing.assert_allclose(got[:, :, 0, 0], 9.0)   # padded corner
+
+
+def test_unsupported_augmenter_warns_once(tmp_path):
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 4, hw=6)
+    with pytest.warns(UserWarning, match='max_rotate_angle'):
+        mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                            batch_size=4, max_rotate_angle=10)
+
+
+def test_jpeg_stream(tmp_path):
+    pytest.importorskip('PIL')
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 6, hw=8, encode='.jpg')
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 8, 8),
+                             batch_size=3)
+    bs = list(it)
+    assert len(bs) == 2 and bs[0].data[0].shape == (3, 3, 8, 8)
+
+
+def test_decode_error_surfaces_to_consumer(tmp_path):
+    """A corrupt record raises in the consumer thread, not silently in
+    the producer."""
+    p = str(tmp_path / 'a.rec')
+    rec = MXRecordIO(p, 'w')
+    rec.write(b'not-an-image-record')
+    rec.close()
+    it = mio.ImageRecordIter(path_imgrec=p, data_shape=(3, 6, 6),
+                             batch_size=1)
+    with pytest.raises(Exception):
+        next(it)
