@@ -340,14 +340,18 @@ class FusedFitLoop:
                     else:  # mp_sgd_mom_update: (w_half, new_mom, new_w32)
                         new_params[ci] = res[0]
                         new_states[j] = (res[1], res[2])
-                pieces = tuple(fn(outs, labels) for fn in stat_fns)
+                # all metric stats packed into ONE vector per step so
+                # the host needs a single fetch per window (each fetch
+                # through a tunneled runtime costs a full RTT)
+                pieces = jnp.stack([v for fn in stat_fns
+                                    for v in fn(outs, labels)])
                 return (tuple(new_params), tuple(new_states), new_aux), \
                     pieces
 
             (p, s, a), pieces = jax.lax.scan(
                 body, (params, states, aux),
                 (jnp.arange(W), data_stack, label_stack))
-            return p, s, a, pieces
+            return p, s, a, pieces   # pieces: (W, 2 * n_metrics)
 
         return jax.jit(window_fn, donate_argnums=(0, 1, 2))
 
@@ -399,7 +403,10 @@ class FusedFitLoop:
         key = arrays
         def shard(stack):
             if self._mesh is None:
-                return stack
+                # source arrays may be committed to the host device
+                # (cpu_pinned iterators); the window runs where the
+                # executor's params live
+                return jax.device_put(stack, self._exec._ctx.jax_device())
             from jax.sharding import NamedSharding, PartitionSpec as P
             spec = P(*((None, 'dp') + (None,) * (stack.ndim - 2)))
             return jax.device_put(stack, NamedSharding(self._mesh, spec))
@@ -424,7 +431,27 @@ class FusedFitLoop:
         from .base_module import _as_list
         from .. import random as _random
         m = self.module
+
+        def apply_stats(pieces, nbatch):
+            """One host fetch for the window's packed stats, then exact
+            per-batch metric application + callbacks."""
+            host = np.asarray(pieces)          # (W, 2 * n_metrics)
+            for i in range(host.shape[0]):
+                for j, child in enumerate(self.children):
+                    child.sum_metric += float(host[i, 2 * j])
+                    child.num_inst += int(host[i, 2 * j + 1])
+                if batch_end_callback is not None:
+                    p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                      eval_metric=eval_metric,
+                                      locals=locals())
+                    for cb in _as_list(batch_end_callback):
+                        cb(p)
+                nbatch += 1
+            return nbatch
+
         nbatch = 0
+        pending = None   # previous window's stats, fetched AFTER the
+        # next window is dispatched so the RTT overlaps device compute
         it = iter(train_data)
         done = False
         while not done:
@@ -436,6 +463,9 @@ class FusedFitLoop:
                     done = True
                     break
             if len(batches) < self.window:
+                if pending is not None:
+                    nbatch = apply_stats(pending, nbatch)
+                    pending = None
                 for b in batches:   # tail: reference per-batch path
                     m.forward_backward(b)
                     m.update()
@@ -470,18 +500,13 @@ class FusedFitLoop:
                 params, states, aux, data_stack, label_stack,
                 self._base_key, lr_arr, wd_arr)
             self._writeback(params, states, aux)
-
-            # one host fetch per window: per-step (sum, count) stats
-            host = [(np.asarray(s), np.asarray(c)) for s, c in pieces]
-            for i in range(self.window):
-                for child, (s, c) in zip(self.children, host):
-                    child.sum_metric += float(s[i])
-                    child.num_inst += int(c[i])
-                if batch_end_callback is not None:
-                    p = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                      eval_metric=eval_metric,
-                                      locals=locals())
-                    for cb in _as_list(batch_end_callback):
-                        cb(p)
-                nbatch += 1
+            # dispatch is async: fetch the PREVIOUS window's stats now,
+            # while this window computes — the fetch RTT disappears
+            # behind device time (callbacks run one window late; values
+            # and cadence are unchanged)
+            if pending is not None:
+                nbatch = apply_stats(pending, nbatch)
+            pending = pieces
+        if pending is not None:
+            nbatch = apply_stats(pending, nbatch)
         return nbatch
