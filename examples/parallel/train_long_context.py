@@ -32,7 +32,8 @@ from jax.sharding import PartitionSpec as P
 
 from mxnet_tpu import parallel as par
 from mxnet_tpu.parallel.ring_attention import (ring_attention,
-                                                striped_attention)
+                                                striped_attention,
+                                                ulysses_attention)
 
 
 def make_model_fns(vocab, d_model, n_heads, attn='ring'):
@@ -60,7 +61,8 @@ def make_model_fns(vocab, d_model, n_heads, attn='ring'):
         # ring attention over the sp axis: K/V blocks rotate the ring.
         # 'striped' expects round-robin token layout (see main) and
         # balances the causal load across the ring (arXiv:2311.09431)
-        attend = striped_attention if attn == 'striped' else ring_attention
+        attend = {'ring': ring_attention, 'striped': striped_attention,
+                  'ulysses': ulysses_attention}[attn]
         att = attend(q, k, v, axis='sp', causal=True)
         att = att.reshape(*x.shape[:2], d_model)
         x = x + att @ params['wo']
@@ -100,10 +102,14 @@ def main():
     p.add_argument('--steps', type=int, default=200)
     p.add_argument('--lr', type=float, default=3e-3)
     p.add_argument('--seed', type=int, default=0)
-    p.add_argument('--attn', choices=('ring', 'striped'), default='ring')
+    p.add_argument('--attn', choices=('ring', 'striped', 'ulysses'),
+                   default='ring')
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
 
+    if args.attn == 'ulysses' and args.heads % args.sp:
+        p.error('--attn ulysses needs --heads divisible by --sp '
+                '(all_to_all moves whole heads across the axis)')
     mesh = par.make_mesh({'dp': args.dp, 'sp': args.sp})
     rng = np.random.RandomState(args.seed)
     init, forward = make_model_fns(args.vocab, args.d_model,

@@ -106,6 +106,7 @@ class _GraphProgram:
                         env[_entry_key(node, 0)] = arg_arrays[arg_index[node.name]]
                     continue
                 op = node.opdef()
+                _reg.record(op)
                 attrs = dict(node.attrs)
                 if op.train_aware:
                     attrs['__is_train__'] = is_train
@@ -376,6 +377,7 @@ class Executor:
                 env[_entry_key(node, 0)] = jax.device_put(src._data, dev)
                 continue
             op = node.opdef()
+            _reg.record(op)
             attrs = dict(node.attrs)
             if op.train_aware:
                 attrs['__is_train__'] = bool(is_train)

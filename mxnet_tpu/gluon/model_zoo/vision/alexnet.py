@@ -1,9 +1,27 @@
-"""AlexNet (reference python/mxnet/gluon/model_zoo/vision/alexnet.py)."""
+"""AlexNet (Krizhevsky et al. 2012) as a config-table build.
+
+Parity target: python/mxnet/gluon/model_zoo/vision/alexnet.py (the
+reference hand-writes the layer stack; here the architecture lives in
+two tables and a loop). Child-block ORDER matches the reference so
+auto-generated parameter names — and therefore checkpoints — stay
+compatible.
+"""
 from ....context import cpu
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ['AlexNet', 'alexnet']
+
+# feature extractor: ('C', channels, kernel, stride, pad) | ('M',) maxpool
+_FEATURES = (
+    ('C', 64, 11, 4, 2), ('M',),
+    ('C', 192, 5, 1, 2), ('M',),
+    ('C', 384, 3, 1, 1),
+    ('C', 256, 3, 1, 1),
+    ('C', 256, 3, 1, 1), ('M',),
+)
+_HIDDEN = 4096
+_DROP = 0.5
 
 
 class AlexNet(HybridBlock):
@@ -12,32 +30,26 @@ class AlexNet(HybridBlock):
         with self.name_scope():
             self.features = nn.HybridSequential(prefix='')
             with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation='relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation='relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation='relu'))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation='relu'))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation='relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
+                for spec in _FEATURES:
+                    if spec[0] == 'M':
+                        self.features.add(nn.MaxPool2D(pool_size=3,
+                                                       strides=2))
+                    else:
+                        _, ch, k, s, p = spec
+                        self.features.add(nn.Conv2D(
+                            ch, kernel_size=k, strides=s, padding=p,
+                            activation='relu'))
                 self.features.add(nn.Flatten())
             self.classifier = nn.HybridSequential(prefix='')
             with self.classifier.name_scope():
-                self.classifier.add(nn.Dense(4096, activation='relu'))
-                self.classifier.add(nn.Dropout(0.5))
-                self.classifier.add(nn.Dense(4096, activation='relu'))
-                self.classifier.add(nn.Dropout(0.5))
+                for _ in range(2):
+                    self.classifier.add(nn.Dense(_HIDDEN,
+                                                 activation='relu'))
+                    self.classifier.add(nn.Dropout(_DROP))
                 self.classifier.add(nn.Dense(classes))
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.classifier(x)
-        return x
+        return self.classifier(self.features(x))
 
 
 def alexnet(pretrained=False, ctx=cpu(), **kwargs):

@@ -75,6 +75,7 @@ class DenseNet(HybridBlock):
         return x
 
 
+# depth -> (stem width, growth rate, per-stage layer counts)
 densenet_spec = {121: (64, 32, [6, 12, 24, 16]),
                  161: (96, 48, [6, 12, 36, 24]),
                  169: (64, 32, [6, 12, 32, 32]),
@@ -89,17 +90,16 @@ def get_densenet(num_layers, pretrained=False, ctx=cpu(), **kwargs):
     return net
 
 
-def densenet121(**kwargs):
-    return get_densenet(121, **kwargs)
+def _shortcut(depth):
+    def f(**kwargs):
+        return get_densenet(depth, **kwargs)
+    f.__name__ = 'densenet%d' % depth
+    f.__doc__ = 'DenseNet-%d (get_densenet shortcut).' % depth
+    return f
 
 
-def densenet161(**kwargs):
-    return get_densenet(161, **kwargs)
-
-
-def densenet169(**kwargs):
-    return get_densenet(169, **kwargs)
-
-
-def densenet201(**kwargs):
-    return get_densenet(201, **kwargs)
+# densenet121 ... densenet201, generated from the table
+for _d in sorted(densenet_spec):
+    _fn = _shortcut(_d)
+    globals()[_fn.__name__] = _fn
+del _d, _fn

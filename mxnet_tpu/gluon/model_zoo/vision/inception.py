@@ -1,4 +1,13 @@
-"""Inception V3 (reference python/mxnet/gluon/model_zoo/vision/inception.py)."""
+"""Inception V3 (Szegedy et al. 2015) as a spec-table build.
+
+Parity target: python/mxnet/gluon/model_zoo/vision/inception.py. The
+reference spells each grid cell out as nested `_make_branch` calls;
+here the whole architecture is a table of compact conv-spec strings
+(`"192x7.1s2p3.0"` = 192 channels, 7x1 kernel, stride 2, pad (3,0))
+parsed by one builder. Cell prefixes (A1_...E2_) and within-cell child
+order match the reference so auto-generated parameter names stay
+checkpoint-compatible.
+"""
 from ....context import cpu
 from ...block import HybridBlock
 from ... import nn
@@ -6,130 +15,128 @@ from ... import nn
 __all__ = ['Inception3', 'inception_v3']
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix='')
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation('relu'))
-    return out
+def _parse_conv(tok):
+    """'CHxK[.K2][sS][pP[.P2]]' -> Conv2D kwargs (BN+relu added by
+    _unit). Examples: '64x1', '96x3p1', '384x3s2', '192x1.7p0.3'."""
+    ch, rest = tok.split('x', 1)
+    kw = {'channels': int(ch), 'use_bias': False}
+
+    def grab(marker):
+        nonlocal rest
+        if marker in rest:
+            rest, val = rest.split(marker, 1)
+            return val
+        return None
+
+    pad = grab('p')
+    stride = grab('s')
+
+    def pair(v):
+        if v is None:
+            return None
+        return tuple(int(x) for x in v.split('.')) if '.' in v else int(v)
+
+    kw['kernel_size'] = pair(rest)
+    if stride is not None:
+        kw['strides'] = pair(stride)
+    if pad is not None:
+        kw['padding'] = pair(pad)
+    return kw
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix='')
-    if use_pool == 'avg':
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == 'max':
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ['channels', 'kernel_size', 'strides', 'padding']
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+def _unit(tok):
+    """One conv-BN-relu unit from a spec token."""
+    seq = nn.HybridSequential(prefix='')
+    seq.add(nn.Conv2D(**_parse_conv(tok)))
+    seq.add(nn.BatchNorm(epsilon=0.001))
+    seq.add(nn.Activation('relu'))
+    return seq
 
 
-class _Concurrent(HybridBlock):
-    """Concat the outputs of parallel branches (gluon.contrib analog)."""
-
-    def __init__(self, **kwargs):
-        super().__init__(**kwargs)
+class _Fanout(HybridBlock):
+    """Run every child on the same input and concat on channels."""
 
     def add(self, block):
         self.register_child(block)
 
     def hybrid_forward(self, F, x):
-        outs = [block(x) for block in self._children]
-        return F.Concat(*outs, dim=1)
+        return F.Concat(*[c(x) for c in self._children], dim=1)
 
 
-def _make_A(pool_features, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, None, 1)))
-        out.add(_make_branch('avg', (pool_features, 1, None, None)))
-    return out
+def _branch(spec):
+    """Build one branch from a comma-joined spec: optional leading
+    'avg'/'max' pool, conv tokens, and an optional trailing fanout
+    'a|b' (the E-cell 1x3 / 3x1 split)."""
+    seq = nn.HybridSequential(prefix='')
+    for tok in spec.split(','):
+        if tok == 'avg':
+            seq.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+        elif tok == 'max':
+            seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+        elif '|' in tok:
+            fan = _Fanout(prefix='')
+            for sub in tok.split('|'):
+                fan.add(_unit(sub))
+            seq.add(fan)
+        else:
+            seq.add(_unit(tok))
+    return seq
 
 
-def _make_B(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, 2, None)))
-        out.add(_make_branch('max'))
-    return out
+def _cell(prefix, branch_specs):
+    cell = _Fanout(prefix=prefix)
+    with cell.name_scope():
+        for spec in branch_specs:
+            cell.add(_branch(spec))
+    return cell
 
 
-def _make_C(channels_7x7, prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch('avg', (192, 1, None, None)))
-    return out
+# stem tokens ('M' = 3x3/2 maxpool) and the grid-cell table. Constants
+# are the published Inception-v3 architecture.
+_STEM = ('32x3s2', '32x3', '64x3p1', 'M', '80x1', '192x3', 'M')
 
 
-def _make_D(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)), (192, 3, 2, None)))
-        out.add(_make_branch('max'))
-    return out
+def _a_cell(pool_ch):
+    return ('64x1',
+            '48x1,64x5p2',
+            '64x1,96x3p1,96x3p1',
+            'avg,%dx1' % pool_ch)
 
 
-class _SubBranch(HybridBlock):
-    def __init__(self, branches, **kwargs):
-        super().__init__(**kwargs)
-        for b in branches:
-            self.register_child(b)
+def _c_cell(c7):
+    d = {'c': c7}
+    return ('192x1',
+            '%(c)dx1,%(c)dx1.7p0.3,192x7.1p3.0' % d,
+            '%(c)dx1,%(c)dx7.1p3.0,%(c)dx1.7p0.3,%(c)dx7.1p3.0,'
+            '192x1.7p0.3' % d,
+            'avg,192x1')
 
-    def hybrid_forward(self, F, x):
-        return F.Concat(*[b(x) for b in self._children], dim=1)
 
-
-def _make_E(prefix):
-    out = _Concurrent(prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
-        b1 = _make_branch(None, (384, 1, None, None))
-        b1a = _make_branch(None, (384, (1, 3), None, (0, 1)))
-        b1b = _make_branch(None, (384, (3, 1), None, (1, 0)))
-        seq1 = nn.HybridSequential(prefix='')
-        seq1.add(b1)
-        seq1.add(_SubBranch([b1a, b1b]))
-        out.add(seq1)
-        b2 = _make_branch(None, (448, 1, None, None), (384, 3, None, 1))
-        b2a = _make_branch(None, (384, (1, 3), None, (0, 1)))
-        b2b = _make_branch(None, (384, (3, 1), None, (1, 0)))
-        seq2 = nn.HybridSequential(prefix='')
-        seq2.add(b2)
-        seq2.add(_SubBranch([b2a, b2b]))
-        out.add(seq2)
-        out.add(_make_branch('avg', (192, 1, None, None)))
-    return out
+_E_SPLIT = '384x1.3p0.1|384x3.1p1.0'
+_CELLS = (
+    ('A1_', _a_cell(32)),
+    ('A2_', _a_cell(64)),
+    ('A3_', _a_cell(64)),
+    ('B_', ('384x3s2', '64x1,96x3p1,96x3s2', 'max')),
+    ('C1_', _c_cell(128)),
+    ('C2_', _c_cell(160)),
+    ('C3_', _c_cell(160)),
+    ('C4_', _c_cell(192)),
+    ('D_', ('192x1,320x3s2',
+            '192x1,192x1.7p0.3,192x7.1p3.0,192x3s2', 'max')),
+    ('E1_', ('320x1', '384x1,' + _E_SPLIT, '448x1,384x3p1,' + _E_SPLIT,
+             'avg,192x1')),
+    ('E2_', ('320x1', '384x1,' + _E_SPLIT, '448x1,384x3p1,' + _E_SPLIT,
+             'avg,192x1')),
+)
 
 
 def make_aux(classes):
     """Auxiliary classifier head (reference vision/inception.py:145)."""
     out = nn.HybridSequential(prefix='')
     out.add(nn.AvgPool2D(pool_size=5, strides=3))
-    out.add(_make_basic_conv(channels=128, kernel_size=1))
-    out.add(_make_basic_conv(channels=768, kernel_size=5))
+    out.add(_unit('128x1'))
+    out.add(_unit('768x5'))
     out.add(nn.Flatten())
     out.add(nn.Dense(classes))
     return out
@@ -140,34 +147,17 @@ class Inception3(HybridBlock):
         super().__init__(**kwargs)
         with self.name_scope():
             self.features = nn.HybridSequential(prefix='')
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, 'A1_'))
-            self.features.add(_make_A(64, 'A2_'))
-            self.features.add(_make_A(64, 'A3_'))
-            self.features.add(_make_B('B_'))
-            self.features.add(_make_C(128, 'C1_'))
-            self.features.add(_make_C(160, 'C2_'))
-            self.features.add(_make_C(160, 'C3_'))
-            self.features.add(_make_C(192, 'C4_'))
-            self.features.add(_make_D('D_'))
-            self.features.add(_make_E('E1_'))
-            self.features.add(_make_E('E2_'))
+            for tok in _STEM:
+                self.features.add(nn.MaxPool2D(pool_size=3, strides=2)
+                                  if tok == 'M' else _unit(tok))
+            for prefix, branches in _CELLS:
+                self.features.add(_cell(prefix, branches))
             self.features.add(nn.AvgPool2D(pool_size=8))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.Dense(classes)
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def inception_v3(pretrained=False, ctx=cpu(), **kwargs):

@@ -237,70 +237,55 @@ class ResNetV2(HybridBlock):
         return x
 
 
-resnet_spec = {18: ('basic_block', [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-               34: ('basic_block', [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-               50: ('bottle_neck', [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-               101: ('bottle_neck', [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-               152: ('bottle_neck', [3, 8, 36, 3], [64, 256, 512, 1024, 2048])}
+# depth -> (block kind, per-stage block counts); stage channels derive
+# from the kind (the bottleneck's 4x expansion is the paper's constant)
+_STAGES = {18: ('basic_block', (2, 2, 2, 2)),
+           34: ('basic_block', (3, 4, 6, 3)),
+           50: ('bottle_neck', (3, 4, 6, 3)),
+           101: ('bottle_neck', (3, 4, 23, 3)),
+           152: ('bottle_neck', (3, 8, 36, 3))}
+_STAGE_CHANNELS = {'basic_block': (64, 64, 128, 256, 512),
+                   'bottle_neck': (64, 256, 512, 1024, 2048)}
+resnet_spec = {d: (kind, list(counts), list(_STAGE_CHANNELS[kind]))
+               for d, (kind, counts) in _STAGES.items()}
 
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [{'basic_block': BasicBlockV1,
-                          'bottle_neck': BottleneckV1},
-                         {'basic_block': BasicBlockV2,
-                          'bottle_neck': BottleneckV2}]
+_VERSIONS = ({'net': ResNetV1, 'basic_block': BasicBlockV1,
+              'bottle_neck': BottleneckV1},
+             {'net': ResNetV2, 'basic_block': BasicBlockV2,
+              'bottle_neck': BottleneckV2})
+# kept for API parity with the reference module surface
+resnet_net_versions = [v['net'] for v in _VERSIONS]
+resnet_block_versions = [{k: v[k] for k in ('basic_block', 'bottle_neck')}
+                         for v in _VERSIONS]
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=cpu(), **kwargs):
     """Reference resnet.py:355."""
-    assert num_layers in resnet_spec, \
-        'Invalid number of layers: %d. Options are %s' % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert version >= 1 and version <= 2, \
-        'Invalid resnet version: %d. Options are 1 and 2.' % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    if num_layers not in resnet_spec:
+        raise ValueError('Invalid number of layers: %d. Options are %s'
+                         % (num_layers, sorted(resnet_spec)))
+    if version not in (1, 2):
+        raise ValueError('Invalid resnet version: %d. Options are 1 and 2.'
+                         % version)
+    kind, counts, channels = resnet_spec[num_layers]
+    picked = _VERSIONS[version - 1]
+    net = picked['net'](picked[kind], counts, channels, **kwargs)
     if pretrained:
         raise ValueError('no pretrained weights available (zero-egress build)')
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _shortcut(version, depth):
+    def f(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+    f.__name__ = 'resnet%d_v%d' % (depth, version)
+    f.__doc__ = 'ResNet-%d V%d (get_resnet shortcut).' % (depth, version)
+    return f
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+# resnet18_v1 ... resnet152_v2, generated from the table
+for _v in (1, 2):
+    for _d in sorted(_STAGES):
+        _fn = _shortcut(_v, _d)
+        globals()[_fn.__name__] = _fn
+del _v, _d, _fn

@@ -1,77 +1,71 @@
-"""SqueezeNet (reference python/mxnet/gluon/model_zoo/vision/squeezenet.py)."""
+"""SqueezeNet 1.0/1.1 (Iandola et al. 2016) as a config-table build.
+
+Parity target: python/mxnet/gluon/model_zoo/vision/squeezenet.py. Each
+version is one table row: the stem conv spec plus a sequence of fire
+squeeze widths interleaved with 'M' maxpool markers (expand widths are
+always 4x the squeeze width, split evenly between the 1x1 and 3x3
+paths — the paper's fixed ratio). Child order matches the reference
+for checkpoint-compatible parameter naming.
+"""
 from ....context import cpu
 from ...block import HybridBlock
 from ... import nn
 
 __all__ = ['SqueezeNet', 'squeezenet1_0', 'squeezenet1_1', 'get_squeezenet']
 
+# version -> ((stem_channels, stem_kernel), layout); layout entries:
+# int = fire module squeeze width, 'M' = ceil-mode 3x3/2 maxpool
+_LAYOUT = {
+    '1.0': ((96, 7), ('M', 16, 16, 32, 'M', 32, 48, 48, 64, 'M', 64)),
+    '1.1': ((64, 3), ('M', 16, 16, 'M', 32, 32, 'M', 48, 48, 64, 64)),
+}
 
-def _make_fire(squeeze_channels, expand1x1_channels, expand3x3_channels):
-    out = nn.HybridSequential(prefix='')
-    out.add(_make_fire_conv(squeeze_channels, 1))
-    paths = _FireExpand(expand1x1_channels, expand3x3_channels)
-    out.add(paths)
-    return out
 
-
-def _make_fire_conv(channels, kernel_size, padding=0):
-    out = nn.HybridSequential(prefix='')
-    out.add(nn.Conv2D(channels, kernel_size, padding=padding))
-    out.add(nn.Activation('relu'))
-    return out
+def _conv_relu(channels, kernel, padding=0):
+    seq = nn.HybridSequential(prefix='')
+    seq.add(nn.Conv2D(channels, kernel, padding=padding))
+    seq.add(nn.Activation('relu'))
+    return seq
 
 
 class _FireExpand(HybridBlock):
-    def __init__(self, expand1x1_channels, expand3x3_channels, **kwargs):
+    """The fire module's parallel 1x1 / 3x3 expand paths."""
+
+    def __init__(self, e1, e3, **kwargs):
         super().__init__(**kwargs)
-        self.p1 = _make_fire_conv(expand1x1_channels, 1)
-        self.p2 = _make_fire_conv(expand3x3_channels, 3, 1)
+        self.p1 = _conv_relu(e1, 1)
+        self.p2 = _conv_relu(e3, 3, 1)
 
     def hybrid_forward(self, F, x):
         return F.Concat(self.p1(x), self.p2(x), dim=1)
 
 
+def _fire(squeeze):
+    seq = nn.HybridSequential(prefix='')
+    seq.add(_conv_relu(squeeze, 1))
+    seq.add(_FireExpand(squeeze * 4, squeeze * 4))
+    return seq
+
+
 class SqueezeNet(HybridBlock):
     def __init__(self, version, classes=1000, **kwargs):
         super().__init__(**kwargs)
-        assert version in ['1.0', '1.1'], \
-            'Unsupported SqueezeNet version %s: 1.0 or 1.1 expected' % version
+        if version not in _LAYOUT:
+            raise ValueError(
+                'Unsupported SqueezeNet version %s: 1.0 or 1.1 expected'
+                % version)
+        (stem_ch, stem_k), layout = _LAYOUT[version]
         with self.name_scope():
             self.features = nn.HybridSequential(prefix='')
-            if version == '1.0':
-                self.features.add(nn.Conv2D(96, kernel_size=7, strides=2))
-                self.features.add(nn.Activation('relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(64, 256, 256))
-            else:
-                self.features.add(nn.Conv2D(64, kernel_size=3, strides=2))
-                self.features.add(nn.Activation('relu'))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(_make_fire(16, 64, 64))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(_make_fire(32, 128, 128))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
-                                               ceil_mode=True))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(48, 192, 192))
-                self.features.add(_make_fire(64, 256, 256))
-                self.features.add(_make_fire(64, 256, 256))
+            self.features.add(nn.Conv2D(stem_ch, kernel_size=stem_k,
+                                        strides=2))
+            self.features.add(nn.Activation('relu'))
+            for item in layout:
+                if item == 'M':
+                    self.features.add(nn.MaxPool2D(pool_size=3, strides=2,
+                                                   ceil_mode=True))
+                else:
+                    self.features.add(_fire(item))
             self.features.add(nn.Dropout(0.5))
             self.output = nn.HybridSequential(prefix='')
             self.output.add(nn.Conv2D(classes, kernel_size=1))
@@ -80,9 +74,7 @@ class SqueezeNet(HybridBlock):
             self.output.add(nn.Flatten())
 
     def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+        return self.output(self.features(x))
 
 
 def get_squeezenet(version, pretrained=False, ctx=cpu(), **kwargs):

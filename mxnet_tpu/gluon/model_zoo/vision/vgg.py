@@ -7,10 +7,12 @@ from ... import nn
 __all__ = ['VGG', 'vgg11', 'vgg13', 'vgg16', 'vgg19', 'vgg11_bn', 'vgg13_bn',
            'vgg16_bn', 'vgg19_bn', 'get_vgg']
 
-vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+# depth -> convs per stage; every variant shares the same stage widths
+_STAGE_WIDTHS = (64, 128, 256, 512, 512)
+_DEPTHS = {11: (1, 1, 2, 2, 2), 13: (2, 2, 2, 2, 2),
+           16: (2, 2, 3, 3, 3), 19: (2, 2, 4, 4, 4)}
+vgg_spec = {d: (list(counts), list(_STAGE_WIDTHS))
+            for d, counts in _DEPTHS.items()}
 
 
 class VGG(HybridBlock):
@@ -61,37 +63,20 @@ def get_vgg(num_layers, pretrained=False, ctx=cpu(), **kwargs):
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _shortcut(depth, bn):
+    def f(**kwargs):
+        if bn:
+            kwargs['batch_norm'] = True
+        return get_vgg(depth, **kwargs)
+    f.__name__ = 'vgg%d%s' % (depth, '_bn' if bn else '')
+    f.__doc__ = 'VGG-%d%s (get_vgg shortcut).' % (depth,
+                                                  ' + BatchNorm' if bn else '')
+    return f
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs['batch_norm'] = True
-    return get_vgg(19, **kwargs)
+# vgg11 ... vgg19_bn, generated from the table
+for _d in sorted(_DEPTHS):
+    for _bn in (False, True):
+        _fn = _shortcut(_d, _bn)
+        globals()[_fn.__name__] = _fn
+del _d, _bn, _fn

@@ -519,6 +519,7 @@ def invoke(op_name, inputs, attrs=None, out=None):
 
 def _invoke_impl(op_name, inputs, attrs=None, out=None):
     op = _reg.get(op_name)
+    _reg.record(op)   # execution-based coverage gate (conftest)
     attrs = normalize_attrs(attrs or {})
     if op.train_aware:
         attrs['__is_train__'] = _ag.is_training()

@@ -21,6 +21,7 @@ Conventions:
 - ``needs_rng`` ops receive a uint32 PRNG key as their LAST array argument.
 """
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -122,9 +123,57 @@ def list_ops():
     return sorted(_OPS)
 
 
+def op_alias_groups():
+    """Registration names grouped by shared OpDef: [[name, alias, ...]].
+    The single source of alias resolution for the coverage gates
+    (tests/conftest.py, test_op_sweep.py) — invoking any name in a
+    group covers the whole group."""
+    groups = {}
+    for n in list_ops():
+        groups.setdefault(id(_OPS[n]), []).append(n)
+    return list(groups.values())
+
+
+# -- execution-based coverage bookkeeping (tests/conftest.py gate) ----------
+# Recording is keyed off the env at import so the per-invoke cost is a
+# single branch when off. Canonical op names land in _INVOKED at every
+# execution chokepoint (eager jit closures, apply_op, host bridges, the
+# executor's traced/staged node loops); atexit appends them to
+# MXTPU_OP_COVERAGE_FILE so subprocess test cases (examples, compat
+# scripts) count toward the suite-wide union.
+_INVOKED = set()
+_COVERAGE_FILE = os.environ.get('MXTPU_OP_COVERAGE_FILE', '')
+_COVERING = bool(_COVERAGE_FILE) or \
+    os.environ.get('MXTPU_OP_COVERAGE', '') not in ('', '0')
+
+
+def record(op):
+    if _COVERING:
+        _INVOKED.add(op.name)
+
+
+def invoked_names():
+    return frozenset(_INVOKED)
+
+
+def _flush_invoked():
+    if _COVERAGE_FILE and _INVOKED:
+        try:
+            with open(_COVERAGE_FILE, 'a') as f:
+                f.write('\n'.join(sorted(_INVOKED)) + '\n')
+        except OSError:
+            pass
+
+
+if _COVERING:
+    import atexit
+    atexit.register(_flush_invoked)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_impl(name, akey):
     op = _OPS[name]
+    record(op)
     attrs = dict(akey)
 
     def f(*arrays):
@@ -161,7 +210,9 @@ def jitted(name, attrs):
 def apply_op(name, attrs, *arrays):
     """Uncached direct application (used inside symbol executors where the
     surrounding graph is already being traced under one jit)."""
-    return _OPS[name].fn(attrs, *arrays)
+    op = _OPS[name]
+    record(op)
+    return op.fn(attrs, *arrays)
 
 
 def host_bridge(op, attrs):
@@ -173,6 +224,7 @@ def host_bridge(op, attrs):
 
     Requires op.shape_fn; host ops without one (data-dependent output
     shapes, e.g. _cvimdecode) cannot enter traced programs."""
+    record(op)
     import numpy as np
     if op.shape_fn is None:
         raise MXNetError(

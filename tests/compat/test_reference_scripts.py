@@ -27,9 +27,12 @@ import pytest
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
 REF_EXAMPLE = '/root/reference/example'
 
-pytestmark = pytest.mark.skipif(
-    not os.path.isdir(REF_EXAMPLE),
-    reason='reference example tree not present on this machine')
+pytestmark = [
+    pytest.mark.convergence,
+    pytest.mark.skipif(
+        not os.path.isdir(REF_EXAMPLE),
+        reason='reference example tree not present on this machine'),
+]
 
 
 def _synthetic_mnist(n, seed):

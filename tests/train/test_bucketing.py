@@ -12,6 +12,8 @@ import pytest
 
 import mxnet_tpu as mx
 
+
+pytestmark = pytest.mark.convergence
 BUCKETS = [8, 16]
 VOCAB = 30
 

@@ -14,6 +14,8 @@ import pytest
 import mxnet_tpu as mx
 
 
+pytestmark = pytest.mark.convergence
+
 def _mnist_iters(batch_size=100, flat=False):
     train = mx.io.MNISTIter(image='train-images-idx3-ubyte',
                             label='train-labels-idx1-ubyte',

@@ -6,11 +6,14 @@ A small conv net trains in bfloat16 compute with fp32 master weights
 must reach a clearly-better-than-chance accuracy.
 """
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd
 from mxnet_tpu.io import synthetic_mnist
 
+
+pytestmark = pytest.mark.convergence
 
 def _net():
     data = mx.sym.Variable('data')

@@ -11,6 +11,8 @@ import sys
 
 import pytest
 
+
+pytestmark = pytest.mark.convergence
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
 
 CASES = [
@@ -34,6 +36,8 @@ CASES = [
     ('parallel/train_long_context.py', ['--steps', '200']),
     ('parallel/train_long_context.py', ['--steps', '200',
                                         '--attn', 'striped']),
+    ('parallel/train_long_context.py', ['--steps', '200',
+                                        '--attn', 'ulysses']),
     ('parallel/train_5d_transformer.py',
      ['--pp', '2', '--dp', '2', '--tp', '2', '--steps', '3', '--seq', '8',
       '--d-model', '16', '--batch', '4', '--vocab', '32']),

@@ -235,6 +235,35 @@ def test_ring_attention_gradients_match_reference():
                                    rtol=2e-2, atol=2e-3, err_msg=name)
 
 
+@pytest.mark.parametrize('causal', [False, True])
+def test_ulysses_attention_gradients_match_reference(causal):
+    """Ulysses backward parity (VERDICT r3 #7): grads through the two
+    all_to_alls (heads<->seq transposes) must match the single-device
+    oracle — an SP mode you cannot backprop through is inference-only."""
+    import jax
+    import jax.numpy as jnp
+    mesh = make_mesh({'sp': 4})
+    B, T, H, D = 2, 128, 4, 16     # H % sp == 0, the Ulysses contract
+    rng = np.random.RandomState(1)
+    q, k, v = (jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.5)
+               for _ in range(3))
+    apply = make_ring_attention(mesh, axis='sp', causal=causal,
+                                impl='ulysses')
+
+    def uly_loss(q, k, v):
+        return (apply(q, k, v).astype(jnp.float32) ** 2).mean()
+
+    def ref_loss(q, k, v):
+        return (attention_reference(q, k, v, causal=causal)
+                .astype(jnp.float32) ** 2).mean()
+
+    g_uly = jax.grad(uly_loss, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(ref_loss, argnums=(0, 1, 2))(q, k, v)
+    for gu, gf, name in zip(g_uly, g_ref, 'qkv'):
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gf),
+                                   rtol=2e-2, atol=2e-3, err_msg=name)
+
+
 def test_shard_updates_matches_unsharded():
     """ZeRO-style weight-update sharding (arXiv:2004.13336): identical
     training trajectory, optimizer states physically dp-sharded."""
