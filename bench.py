@@ -29,12 +29,22 @@ import time
 
 import numpy as np
 
-BASELINE_IMG_S = 181.53  # P100, batch 32, docs/how_to/perf.md:150-190
-BATCH = int(os.environ.get('MXTPU_BENCH_BATCH', '32'))
-# 'resnet50' (the baseline-comparable default) or 'transformer' (the
-# matmul-dominated MFU probe: GPT-style decoder, flash-attention Pallas
-# kernel + fused rmsnorm; tpu_capture.sh records both)
+# P100 batch-32 training rows, docs/how_to/perf.md:150-190 (AlexNet is
+# the table's 8x-batch column: batch 256)
+BASELINE_IMG_S = {'resnet50': 181.53, 'alexnet': 1869.69,
+                  'inceptionv3': 129.98}
+# 'resnet50' (the baseline-comparable default), 'alexnet'/'inceptionv3'
+# (the other two train_imagenet.py perf-table columns), or 'transformer'
+# (the matmul-dominated MFU probe: GPT-style decoder, flash-attention
+# Pallas kernel + fused rmsnorm; tpu_capture.sh records both)
 MODEL = os.environ.get('MXTPU_BENCH_MODEL', 'resnet50')
+BATCH = int(os.environ.get('MXTPU_BENCH_BATCH',
+                           '256' if MODEL == 'alexnet' else '32'))
+# gradient-memory tradeoff knob (BASELINE.md "Memory-mirroring"); same
+# values the executor honors: '1' = full remat, 'dots' = keep matmuls
+MIRROR = os.environ.get('MXTPU_BACKWARD_DO_MIRROR',
+                        os.environ.get('MXNET_BACKWARD_DO_MIRROR', ''))
+MIRROR = '' if MIRROR in ('', '0', 'false', 'False') else MIRROR
 # steps fused into one XLA call via lax.scan (in-graph train loop, the
 # standard TPU pattern). Each compiled(...) dispatch crosses the axon
 # tunnel; at ~ms RTTs a per-step dispatch caps throughput regardless of
@@ -251,18 +261,21 @@ def build_transformer_step():
 def build_train_step():
     import jax
     import jax.numpy as jnp
-    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.executor import _GraphProgram
     from mxnet_tpu.ops.registry import get as get_op
 
-    net = resnet50_v1()
+    zoo_name = {'resnet50': 'resnet50_v1', 'alexnet': 'alexnet',
+                'inceptionv3': 'inceptionv3'}[MODEL]
+    image = 299 if MODEL == 'inceptionv3' else 224
+    data_shape = (BATCH, 3, image, image)
+    net = vision.get_model(zoo_name, classes=1000)
     net.hybridize()
     _, sym = net._get_graph(
-        type('P', (), {'shape': (BATCH, 3, 224, 224),
+        type('P', (), {'shape': data_shape,
                        'context': None})())  # placeholder-shaped trace
     prog = _GraphProgram(sym)
-    arg_shapes, _, aux_shapes = sym.infer_shape(
-        data=(BATCH, 3, 224, 224))
+    arg_shapes, _, aux_shapes = sym.infer_shape(data=data_shape)
     arg_names, aux_names = prog.arg_names, prog.aux_names
 
     rng = np.random.RandomState(0)
@@ -273,6 +286,12 @@ def build_train_step():
     aux_arrays = tuple(jnp.asarray(_host_init(n, s, rng))
                        for n, s in zip(aux_names, aux_shapes))
     runner = prog.make_runner()
+    if MIRROR:
+        policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                  if MIRROR == 'dots' else None)
+        runner = jax.checkpoint(runner, policy=policy, static_argnums=(3,))
+        _log('backward mirroring ON (%s): forward rematerialized in bwd'
+             % MIRROR)
     mp_update = get_op('mp_sgd_mom_update').fn
 
     lr, momentum, wd = 0.1, 0.9, 1e-4
@@ -311,8 +330,7 @@ def build_train_step():
         return tuple(new_masters), new_aux, tuple(new_vel), loss
 
     vel = tuple(jnp.zeros_like(m) for m in masters)
-    images = jnp.asarray(rng.standard_normal((BATCH, 3, 224, 224)),
-                         jnp.bfloat16)
+    images = jnp.asarray(rng.standard_normal(data_shape), jnp.bfloat16)
     labels = jnp.asarray(rng.randint(0, 1000, (BATCH,)), jnp.int32)
     key = jax.random.PRNGKey(0)
     return step, tuple(masters), aux_arrays, vel, images, labels, key
@@ -340,6 +358,19 @@ def _step_flops(compiled):
     except Exception as e:  # noqa: BLE001
         _log('cost_analysis unavailable: %s' % e)
         return 0.0
+
+
+def _temp_bytes(compiled):
+    """XLA's planned scratch (activation) memory for the computation —
+    the number the backward-mirror knob trades against throughput."""
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        return int(getattr(ma, 'temp_size_in_bytes', 0))
+    except Exception as e:  # noqa: BLE001
+        _log('memory_analysis unavailable: %s' % e)
+        return 0
 
 
 def _peak_flops(device):
@@ -401,7 +432,8 @@ def main():
             build_transformer_step()
         tokens_per_batch = int(images.shape[0] * images.shape[1])
     else:
-        _log('building ResNet-50 train step (bf16 compute, fp32 masters)...')
+        _log('building %s train step (bf16 compute, fp32 masters)...'
+             % MODEL)
         step, masters, aux, vel, images, labels, key = build_train_step()
         tokens_per_batch = None
     _log('build+init: %.1fs' % (time.perf_counter() - t))
@@ -429,8 +461,9 @@ def main():
     # of trip count (verified: identical flops at 1 vs 8 steps/call), so
     # scale to per-dispatch flops here
     flops_per_step *= STEPS_PER_CALL
-    _log('compile: %.1fs, step flops=%.3e'
-         % (time.perf_counter() - t, flops_per_step))
+    temp_bytes = _temp_bytes(compiled)
+    _log('compile: %.1fs, step flops=%.3e, xla temp=%.1f MiB'
+         % (time.perf_counter() - t, flops_per_step, temp_bytes / 2**20))
 
     t = time.perf_counter()
     for _ in range(WARMUP_STEPS):
@@ -484,10 +517,10 @@ def main():
              % (img_s, bench_steps, STEPS_PER_CALL, dt, kind,
                 '%.1f%%' % (100 * mfu) if mfu is not None else 'n/a'))
         out = {
-            'metric': 'resnet50_train_throughput_bf16',
+            'metric': '%s_train_throughput_bf16' % MODEL,
             'value': round(img_s, 2),
             'unit': 'images/sec',
-            'vs_baseline': round(img_s / BASELINE_IMG_S, 3),
+            'vs_baseline': round(img_s / BASELINE_IMG_S[MODEL], 3),
             'batch': BATCH,
             'device': kind or platform,
             'platform': platform,
@@ -495,6 +528,10 @@ def main():
         }
     if mfu is not None:
         out['mfu'] = round(mfu, 4)
+    if temp_bytes:
+        out['xla_temp_bytes'] = temp_bytes
+    if MIRROR:
+        out['backward_mirror'] = MIRROR
     if platform.startswith('cpu'):
         out['note'] = ('cpu run at reduced batch; not config-comparable '
                        'to the batch-32 GPU baseline')

@@ -21,17 +21,44 @@ from mxnet_tpu import nd
 from mxnet_tpu.gluon.model_zoo import vision
 
 
+def _symbol_forward(model, batch_size, image_size):
+    """Symbol-defined networks (the reference scores inception-bn from
+    symbols/, not the model zoo): bind once, return forward thunk."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from symbols.inception_bn import get_symbol
+    sym = get_symbol(num_classes=1000,
+                     image_shape='3,%d,%d' % (image_size, image_size))
+    mod = mx.mod.Module(sym, context=mx.cpu()
+                        if not mx.context.num_gpus() else mx.gpu())
+    shape = (batch_size, 3, image_size, image_size)
+    mod.bind(data_shapes=[('data', shape)], for_training=False)
+    mod.init_params(initializer=mx.init.Xavier())
+    batch = mx.io.DataBatch(data=[nd.array(np.random.standard_normal(
+        shape).astype('float32'))], label=None)
+
+    def forward():
+        mod.forward(batch, is_train=False)
+        return mod.get_outputs()[0]
+    return forward
+
+
 def score(model, batch_size, image_size=224, repeats=20):
-    net = vision.get_model(model, classes=1000)
-    net.initialize(mx.init.Xavier())
-    net.hybridize()
-    x = nd.array(np.random.standard_normal(
-        (batch_size, 3, image_size, image_size)).astype('float32'))
-    out = net(x)
+    if model == 'inception-bn':
+        forward = _symbol_forward(model, batch_size, image_size)
+    else:
+        net = vision.get_model(model, classes=1000)
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        x = nd.array(np.random.standard_normal(
+            (batch_size, 3, image_size, image_size)).astype('float32'))
+
+        def forward():
+            return net(x)
+    out = forward()
     out.wait_to_read()  # compile
     tic = time.time()
     for _ in range(repeats):
-        out = net(x)
+        out = forward()
     out.wait_to_read()
     return repeats * batch_size / (time.time() - tic)
 

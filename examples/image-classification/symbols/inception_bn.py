@@ -1,0 +1,118 @@
+"""Symbolic Inception-BN (BN-GoogLeNet) builder.
+
+Mirrors the role of example/image-classification/symbols/inception-bn.py
+in the reference (Ioffe & Szegedy, arXiv:1502.03167): the 224px network
+is a 7x7 stem, a 1x1/3x3 second stage, then ten inception blocks in a
+config table; small images (<=28px) get the compact CIFAR variant. The
+block layout is expressed as a spec table rather than unrolled calls;
+written against the mxnet_tpu Symbol API.
+"""
+import mxnet_tpu as mx
+
+_EPS = 1e-10 + 1e-5
+_MOM = 0.9
+
+# 224px trunk: (name, kind, spec)
+#   'mix'  spec = (n1x1, red3x3, n3x3, red_d3x3, n_d3x3, pool_type, n_proj)
+#   'down' spec = (red3x3, n3x3, red_d3x3, n_d3x3)  — stride-2, +maxpool branch
+_BLOCKS_224 = [
+    ('3a', 'mix', (64, 64, 64, 64, 96, 'avg', 32)),
+    ('3b', 'mix', (64, 64, 96, 64, 96, 'avg', 64)),
+    ('3c', 'down', (128, 160, 64, 96)),
+    ('4a', 'mix', (224, 64, 96, 96, 128, 'avg', 128)),
+    ('4b', 'mix', (192, 96, 128, 96, 128, 'avg', 128)),
+    ('4c', 'mix', (160, 128, 160, 128, 160, 'avg', 128)),
+    ('4d', 'mix', (96, 128, 192, 160, 192, 'avg', 128)),
+    ('4e', 'down', (128, 192, 192, 256)),
+    ('5a', 'mix', (352, 192, 320, 160, 224, 'avg', 128)),
+    ('5b', 'mix', (352, 192, 320, 192, 224, 'max', 128)),
+]
+
+# compact trunk for small images: (name, kind, spec)
+#   'simple' spec = (n1x1, n3x3); 'shrink' spec = (n3x3,) — stride-2 conv+pool
+_BLOCKS_SMALL = [
+    ('in3a', 'simple', (32, 32)),
+    ('in3b', 'simple', (32, 48)),
+    ('in3c', 'shrink', (80,)),
+    ('in4a', 'simple', (112, 48)),
+    ('in4b', 'simple', (96, 64)),
+    ('in4c', 'simple', (80, 80)),
+    ('in4d', 'simple', (48, 96)),
+    ('in4e', 'shrink', (96,)),
+    ('in5a', 'simple', (176, 160)),
+    ('in5b', 'simple', (176, 160)),
+]
+
+
+def _unit(x, filters, kernel, name, stride=(1, 1), pad=(0, 0)):
+    """conv -> BN -> relu, the paper's replacement for conv -> relu."""
+    x = mx.sym.Convolution(data=x, num_filter=filters, kernel=kernel,
+                           stride=stride, pad=pad, name='conv_' + name)
+    x = mx.sym.BatchNorm(data=x, fix_gamma=False, eps=_EPS, momentum=_MOM,
+                         name='bn_' + name)
+    return mx.sym.Activation(data=x, act_type='relu', name='relu_' + name)
+
+
+def _branch3x3(x, red, out, name, double, stride=(1, 1)):
+    """1x1 reduce then one (or two, 'double') 3x3 convs."""
+    tag = ('%s_double_3x3' if double else '%s_3x3') % name
+    b = _unit(x, red, (1, 1), tag + '_reduce')
+    if double:
+        b = _unit(b, out, (3, 3), tag + '_0', pad=(1, 1))
+        return _unit(b, out, (3, 3), tag + '_1', stride=stride, pad=(1, 1))
+    return _unit(b, out, (3, 3), tag, stride=stride, pad=(1, 1))
+
+
+def _block(x, name, kind, spec):
+    if kind == 'mix':
+        n1, r3, n3, rd, nd, pool, proj = spec
+        p = mx.sym.Pooling(data=x, kernel=(3, 3), stride=(1, 1), pad=(1, 1),
+                           pool_type=pool,
+                           name='%s_pool_%s_pool' % (pool, name))
+        parts = [_unit(x, n1, (1, 1), name + '_1x1'),
+                 _branch3x3(x, r3, n3, name, double=False),
+                 _branch3x3(x, rd, nd, name, double=True),
+                 _unit(p, proj, (1, 1), name + '_proj')]
+    elif kind == 'down':
+        r3, n3, rd, nd = spec
+        parts = [_branch3x3(x, r3, n3, name, double=False, stride=(2, 2)),
+                 _branch3x3(x, rd, nd, name, double=True, stride=(2, 2)),
+                 mx.sym.Pooling(data=x, kernel=(3, 3), stride=(2, 2),
+                                pad=(1, 1), pool_type='max',
+                                name='max_pool_%s_pool' % name)]
+    elif kind == 'simple':
+        n1, n3 = spec
+        parts = [_unit(x, n1, (1, 1), name + '_1x1'),
+                 _unit(x, n3, (3, 3), name + '_3x3', pad=(1, 1))]
+    else:  # 'shrink'
+        (n3,) = spec
+        parts = [_unit(x, n3, (3, 3), name + '_conv', stride=(2, 2),
+                       pad=(1, 1)),
+                 mx.sym.Pooling(data=x, kernel=(3, 3), stride=(2, 2),
+                                pad=(1, 1), pool_type='max',
+                                name=name + '_pool')]
+    return mx.sym.Concat(*parts, name='ch_concat_%s_chconcat' % name)
+
+
+def get_symbol(num_classes=1000, image_shape='3,224,224', **kwargs):
+    _, height, _ = (int(d) for d in image_shape.split(','))
+    data = mx.sym.Variable('data')
+    if height <= 28:
+        body = _unit(data, 96, (3, 3), '1', pad=(1, 1))
+        blocks = _BLOCKS_SMALL
+    else:
+        body = _unit(data, 64, (7, 7), '1', stride=(2, 2), pad=(3, 3))
+        body = mx.sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                              pool_type='max', name='pool_1')
+        body = _unit(body, 64, (1, 1), '2_red')
+        body = _unit(body, 192, (3, 3), '2', pad=(1, 1))
+        body = mx.sym.Pooling(data=body, kernel=(3, 3), stride=(2, 2),
+                              pool_type='max', name='pool_2')
+        blocks = _BLOCKS_224
+    for name, kind, spec in blocks:
+        body = _block(body, name, kind, spec)
+    body = mx.sym.Pooling(data=body, kernel=(7, 7), stride=(1, 1),
+                          pool_type='avg', name='global_pool')
+    body = mx.sym.Flatten(data=body)
+    body = mx.sym.FullyConnected(data=body, num_hidden=num_classes)
+    return mx.sym.SoftmaxOutput(data=body, name='softmax')

@@ -69,11 +69,16 @@ void InitPython() {
   }
 }
 
+/* guarded so the amalgamated single-TU build (amalgamation/) sees one
+ * definition; c_predict_api.cc carries the same block */
+#ifndef MXTPU_GIL_DEFINED
+#define MXTPU_GIL_DEFINED
 struct Gil {
   PyGILState_STATE state;
   Gil() { state = PyGILState_Ensure(); }
   ~Gil() { PyGILState_Release(state); }
 };
+#endif
 
 int Fail() {
   PyObject *t = nullptr, *v = nullptr, *tb = nullptr;

@@ -31,11 +31,14 @@ using mxtpu_capi::Bridge;
 using mxtpu_capi::EnsureBridge;
 using mxtpu_capi::FailFromPython;
 
+#ifndef MXTPU_GIL_DEFINED
+#define MXTPU_GIL_DEFINED
 struct Gil {
   PyGILState_STATE state;
   Gil() { state = PyGILState_Ensure(); }
   ~Gil() { PyGILState_Release(state); }
 };
+#endif
 
 thread_local std::vector<mx_uint> pred_shape;
 

@@ -182,7 +182,7 @@ def test_pallas_flash_attention_on_chip(Tq, blk):
     rng = np.random.RandomState(0)
     mk = lambda: jax.device_put(  # noqa: E731
         jnp.asarray(rng.randn(2, Tq, 2, 16), jnp.float32),
-        mx.tpu().jax_device)
+        mx.tpu().jax_device())
     q, k, v = (mk() for _ in range(3))
     for causal in (False, True):
         out = flash_attention(q, k, v, causal, None, blk, blk)
@@ -201,7 +201,7 @@ def test_pallas_row_kernels_on_chip(kernel):
     import jax.numpy as jnp
     from mxnet_tpu.ops import pallas_kernels as pk
     rng = np.random.RandomState(1)
-    dev = mx.tpu().jax_device
+    dev = mx.tpu().jax_device()
     x = jax.device_put(jnp.asarray(rng.randn(1006, 128), jnp.float32), dev)
     x32 = np.asarray(x)
     e = np.exp(x32 - x32.max(-1, keepdims=True))

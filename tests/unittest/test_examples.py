@@ -30,6 +30,8 @@ CASES = [
                                     '--lr', '0.02']),
     ('image-classification/benchmark_score.py',
      ['--model', 'resnet18_v1', '--batch-sizes', '2', '--image-size', '64']),
+    ('image-classification/benchmark_score.py',
+     ['--model', 'inception-bn', '--batch-sizes', '2', '--image-size', '28']),
     ('rnn/lstm_bucketing.py',
      ['--num-epochs', '1', '--batch-size', '16', '--num-hidden', '32',
       '--num-embed', '16', '--num-layers', '1', '--vocab', '50']),

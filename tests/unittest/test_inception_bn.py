@@ -1,0 +1,59 @@
+"""Inception-BN symbol (examples/image-classification/symbols/inception_bn).
+
+Mirrors the reference's symbols/inception-bn.py surface: the 224px
+scoring/training trunk (docs/how_to/perf.md table column) and the
+compact <=28px variant, both built from the spec table.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..',
+                                'examples', 'image-classification'))
+
+import mxnet_tpu as mx
+from symbols.inception_bn import get_symbol
+
+
+def test_infer_shape_224():
+    sym = get_symbol(num_classes=1000, image_shape='3,224,224')
+    args = sym.list_arguments()
+    # stem + 10 inception blocks + classifier all BN'd
+    assert 'conv_1_weight' in args and 'bn_5b_proj_gamma' in args
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(2, 3, 224, 224))
+    assert out_shapes[0] == (2, 1000)
+    shapes = dict(zip(args, arg_shapes))
+    # stage-2 3x3 and the 5b concat input channel math
+    assert shapes['conv_2_weight'] == (192, 64, 3, 3)
+    # 5a concat = 352 + 320 + 224 + 128 = 1024 channels into 5b
+    assert shapes['conv_5b_1x1_weight'][1] == 1024
+
+
+def test_small_variant_trains():
+    sym = get_symbol(num_classes=10, image_shape='3,28,28')
+    arg_shapes, out_shapes, _ = sym.infer_shape(data=(4, 3, 28, 28))
+    assert out_shapes[0] == (4, 10)
+
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((8, 3, 28, 28)).astype(np.float32)
+    y = rng.randint(0, 10, (8,)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=4, label_name='softmax_label')
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.1),))
+    before = {k: v.asnumpy().copy() for k, v in mod.get_params()[0].items()}
+    for batch in it:
+        mod.forward_backward(batch)
+        mod.update()
+    after = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    moved = sum(float(np.abs(after[k] - before[k]).sum()) for k in after)
+    assert np.isfinite(moved) and moved > 0
+    # inference forward produces a probability simplex (SoftmaxOutput)
+    it.reset()
+    mod_scores = mod.predict(it).asnumpy()
+    np.testing.assert_allclose(mod_scores.sum(-1), 1.0, rtol=1e-4)

@@ -30,12 +30,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 P100 = {
     ('alexnet', 1): 624.84, ('alexnet', 32): 4883.77,
     ('vgg16', 1): 294.6, ('vgg16', 32): 854.4,
+    ('inception-bn', 1): 139.82, ('inception-bn', 32): 1197.74,
     ('inceptionv3', 1): 80.17, ('inceptionv3', 32): 493.72,
     ('resnet50_v1', 1): 162.27, ('resnet50_v1', 32): 713.17,
     ('resnet152_v1', 1): 58.99, ('resnet152_v1', 32): 294.17,
 }
-DEFAULT_MODELS = ['alexnet', 'vgg16', 'inceptionv3', 'resnet50_v1',
-                  'resnet152_v1']
+# pretrained-model speed table, single K80 batch 32
+# (example/image-classification/README.md:147-157)
+K80_PRETRAINED = {
+    ('inception-bn', 32): 152.0,
+    ('resnet18_v1', 32): 185.0, ('resnet34_v1', 32): 172.0,
+    ('resnet50_v1', 32): 109.0, ('resnet101_v1', 32): 78.0,
+    ('resnet152_v1', 32): 57.0,
+}
+DEFAULT_MODELS = ['alexnet', 'vgg16', 'inception-bn', 'inceptionv3',
+                  'resnet18_v1', 'resnet34_v1', 'resnet50_v1',
+                  'resnet101_v1', 'resnet152_v1']
 
 
 def _log(msg):
@@ -63,13 +73,23 @@ def build_forward(model, batch):
     from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu.executor import _GraphProgram
 
-    image = 299 if 'inception' in model else 224
+    image = 299 if model == 'inceptionv3' else 224
     shape = (batch, 3, image, image)
-    net = vision.get_model(model, classes=1000)
-    net.initialize(mx.init.Xavier())
-    net.hybridize()
-    _, sym = net._get_graph(
-        type('P', (), {'shape': shape, 'context': None})())
+    if model == 'inception-bn':
+        # symbol-defined network (examples/image-classification/symbols)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            'examples', 'image-classification'))
+        from symbols.inception_bn import get_symbol
+        # SoftmaxOutput's label input is unused in inference mode
+        sym = get_symbol(num_classes=1000,
+                         image_shape='3,%d,%d' % (image, image))
+    else:
+        net = vision.get_model(model, classes=1000)
+        net.initialize(mx.init.Xavier())
+        net.hybridize()
+        _, sym = net._get_graph(
+            type('P', (), {'shape': shape, 'context': None})())
     prog = _GraphProgram(sym)
     arg_shapes, _, aux_shapes = sym.infer_shape(data=shape)
     runner = prog.make_runner()
@@ -122,13 +142,25 @@ def build_forward(model, batch):
     # XLA cost analysis counts a scan body ONCE regardless of trip
     # count (verified in bench.py): total = 1 body + 1 final forward
     flops = float(cost.get('flops', 0.0)) / 2.0
-    return compiled, tuple(args), aux, x, reps, flops
+    try:
+        ma = compiled.memory_analysis()
+        if isinstance(ma, (list, tuple)):
+            ma = ma[0]
+        n_param = sum(int(np.prod(s)) for n, s in
+                      zip(prog.arg_names, arg_shapes)
+                      if n not in ('data', 'softmax_label'))
+        n_param += sum(int(np.prod(s)) for s in aux_shapes)
+        mem = {'xla_temp_bytes': int(ma.temp_size_in_bytes),
+               'param_bytes': 2 * n_param}   # bf16 resident weights
+    except Exception:  # noqa: BLE001
+        mem = {}
+    return compiled, tuple(args), aux, x, reps, flops, mem
 
 
 def score(model, batch, peak):
     import jax
     t = time.perf_counter()
-    compiled, args, aux, x, reps, flops = build_forward(model, batch)
+    compiled, args, aux, x, reps, flops, mem = build_forward(model, batch)
     _log('%s b%d: compile %.1fs (reps=%d)'
          % (model, batch, time.perf_counter() - t, reps))
     float(np.asarray(compiled(args, aux, x)))   # warmup + barrier
@@ -145,8 +177,12 @@ def score(model, batch, peak):
            'dtype': 'bfloat16'}
     if (model, batch) in P100:
         row['vs_p100'] = round(ips / P100[(model, batch)], 2)
+    if (model, batch) in K80_PRETRAINED:
+        row['vs_k80_pretrained'] = round(
+            ips / K80_PRETRAINED[(model, batch)], 2)
     if mfu is not None:
         row['mfu'] = round(mfu, 4)
+    row.update(mem)
     print(json.dumps(row), flush=True)
     _log('%s b%d: %.1f img/s (%.2fx P100)'
          % (model, batch, ips, row.get('vs_p100', 0)))
@@ -163,9 +199,9 @@ def main():
         _log('chip unreachable')
         sys.exit(2)
     import jax
+    from bench import _peak_flops   # shared device-kind -> peak table
     dev = jax.devices()[0]
-    kind = (getattr(dev, 'device_kind', '') or '').lower()
-    peak = 197e12 if 'v5' in kind else 0.0
+    peak, _kind = _peak_flops(dev)
     _log('backend: %s' % dev)
     rows = []
     for model in args.models.split(','):
@@ -175,12 +211,15 @@ def main():
             except Exception as e:  # noqa: BLE001
                 _log('%s b%d FAILED: %s' % (model, b, e))
     ok = [r for r in rows if 'vs_p100' in r]
+    k80 = [r for r in rows if 'vs_k80_pretrained' in r]
     summary = {'metric': 'benchmark_score_summary',
                'value': round(min((r['vs_p100'] for r in ok), default=0.0),
                               2),
                'unit': 'min_vs_p100',
                'all_above_p100': bool(ok) and all(
                    r['vs_p100'] >= 1.0 for r in ok),
+               'all_above_k80_pretrained': bool(k80) and all(
+                   r['vs_k80_pretrained'] >= 1.0 for r in k80),
                'rows': rows}
     print(json.dumps(summary), flush=True)
 

@@ -19,32 +19,32 @@ echo "== probing chip =="
 timeout 240 python -c 'import jax; d=jax.devices(); print("TPU OK:", d)' \
   || { echo "chip unreachable; aborting"; exit 1; }
 
-echo "== 1/6 TPU consistency tier =="
+echo "== 1/9 TPU consistency tier =="
 MXTPU_TEST_TPU=1 timeout 3000 python -m pytest tests/tpu -v \
   > "$OUT/tpu_consistency_$STAMP.log" 2>&1
 echo "rc=$? (log: $OUT/tpu_consistency_$STAMP.log)"
 
-echo "== 2/6 bench (default) =="
+echo "== 2/9 bench (default) =="
 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
   > "$OUT/bench_default_$STAMP.json" 2> "$OUT/bench_default_$STAMP.log"
 echo "rc=$?"; tail -1 "$OUT/bench_default_$STAMP.json"
 
-echo "== 3/6 bench (MXTPU_CONV_BWD_PATCHES=1) =="
+echo "== 3/9 bench (MXTPU_CONV_BWD_PATCHES=1) =="
 MXTPU_CONV_BWD_PATCHES=1 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
   > "$OUT/bench_patches_$STAMP.json" 2> "$OUT/bench_patches_$STAMP.log"
 echo "rc=$?"; tail -1 "$OUT/bench_patches_$STAMP.json"
 
-echo "== 4/6 bench (transformer MFU probe) =="
+echo "== 4/9 bench (transformer MFU probe) =="
 MXTPU_BENCH_MODEL=transformer MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
   > "$OUT/bench_transformer_$STAMP.json" 2> "$OUT/bench_transformer_$STAMP.log"
 echo "rc=$?"; tail -1 "$OUT/bench_transformer_$STAMP.json"
 
-echo "== 5/6 bench (steps_per_call=1 A/B: dispatch-bound or compute-bound?) =="
+echo "== 5/9 bench (steps_per_call=1 A/B: dispatch-bound or compute-bound?) =="
 MXTPU_BENCH_STEPS_PER_CALL=1 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
   > "$OUT/bench_spc1_$STAMP.json" 2> "$OUT/bench_spc1_$STAMP.log"
 echo "rc=$?"; tail -1 "$OUT/bench_spc1_$STAMP.json"
 
-echo "== 6/6 pure-JAX control (framework-overhead bound) =="
+echo "== 6/9 pure-JAX control (framework-overhead bound) =="
 timeout 900 python tools/purejax_resnet50.py control \
   > "$OUT/purejax_control_$STAMP.json" 2> "$OUT/purejax_control_$STAMP.log"
 echo "rc=$?"; tail -1 "$OUT/purejax_control_$STAMP.json"
@@ -54,5 +54,30 @@ if [ -n "${MXTPU_CAPTURE_BREAKDOWN:-}" ]; then
     > "$OUT/conv_breakdown_$STAMP.json" 2> "$OUT/conv_breakdown_$STAMP.log"
   echo "breakdown rc=$?"
 fi
+
+echo "== 7/9 training-table sweep (BASELINE train table cols 1-2) =="
+MXTPU_BENCH_MODEL=alexnet MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
+  > "$OUT/bench_alexnet_$STAMP.json" 2> "$OUT/bench_alexnet_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_alexnet_$STAMP.json"
+MXTPU_BENCH_MODEL=inceptionv3 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
+  > "$OUT/bench_inceptionv3_$STAMP.json" 2> "$OUT/bench_inceptionv3_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_inceptionv3_$STAMP.json"
+
+echo "== 8/9 memory-mirror A/B (BASELINE mirror table; inception-v3) =="
+MXTPU_BENCH_MODEL=inceptionv3 MXTPU_BACKWARD_DO_MIRROR=dots \
+  MXTPU_BENCH_BUDGET=600 timeout 900 python bench.py \
+  > "$OUT/bench_inceptionv3_mirror_$STAMP.json" \
+  2> "$OUT/bench_inceptionv3_mirror_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_inceptionv3_mirror_$STAMP.json"
+MXTPU_BENCH_MODEL=inceptionv3 MXTPU_BACKWARD_DO_MIRROR=1 \
+  MXTPU_BENCH_BATCH=128 MXTPU_BENCH_BUDGET=600 timeout 900 python bench.py \
+  > "$OUT/bench_inceptionv3_mirror_b128_$STAMP.json" \
+  2> "$OUT/bench_inceptionv3_mirror_b128_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_inceptionv3_mirror_b128_$STAMP.json"
+
+echo "== 9/9 inference scoring tier (BASELINE tables 1+3) =="
+timeout 3000 python tools/score_bench.py \
+  > "$OUT/score_$STAMP.json" 2> "$OUT/score_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/score_$STAMP.json"
 
 echo "== done; commit docs/tpu_artifacts =="

@@ -1,0 +1,27 @@
+#!/bin/bash
+# Transformer MFU/long-context sweep (round 4): probes whether larger
+# d_model, longer sequences, or spc=64 move the 56.1% round-3 MFU, and
+# banks a long-context (seq 8192/16384, flash-attention Pallas) on-chip
+# artifact. One TPU process at a time — run only when the chip is free.
+set -u
+cd "$(dirname "$0")/.."
+OUT=docs/tpu_artifacts
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+
+run () {  # run <tag> <env...>
+  tag=$1; shift
+  echo "== transformer $tag =="
+  env "$@" MXTPU_BENCH_MODEL=transformer MXTPU_BENCH_BUDGET=420 \
+    timeout 600 python bench.py \
+    > "$OUT/bench_tf_${tag}_$STAMP.json" 2> "$OUT/bench_tf_${tag}_$STAMP.log"
+  echo "rc=$?"; tail -1 "$OUT/bench_tf_${tag}_$STAMP.json"
+}
+
+run d2048L8   MXTPU_BENCH_DMODEL=2048 MXTPU_BENCH_BATCH=4
+run spc64     MXTPU_BENCH_STEPS_PER_CALL=64
+run seq2048   MXTPU_BENCH_SEQ=2048 MXTPU_BENCH_BATCH=4
+run seq8192   MXTPU_BENCH_SEQ=8192 MXTPU_BENCH_BATCH=1
+run seq16384  MXTPU_BENCH_SEQ=16384 MXTPU_BENCH_BATCH=1 \
+              MXTPU_BENCH_STEPS_PER_CALL=8
+echo "== done =="
