@@ -11,75 +11,84 @@ from .parameter import ParameterDict, Parameter
 __all__ = ['Trainer']
 
 
-class Trainer:
-    def __init__(self, params, optimizer, optimizer_params=None, kvstore='device'):
-        if isinstance(params, (dict, ParameterDict)):
-            params = list(params.values())
-        if not isinstance(params, (list, tuple)):
+def _trainable(params):
+    """Validate and flatten the params argument; keep grad-bearing ones."""
+    if isinstance(params, (dict, ParameterDict)):
+        params = list(params.values())
+    if not isinstance(params, (list, tuple)):
+        raise ValueError(
+            'First argument must be a list or dict of Parameters, '
+            'got %s.' % (type(params)))
+    for p in params:
+        if not isinstance(p, Parameter):
             raise ValueError(
                 'First argument must be a list or dict of Parameters, '
-                'got %s.' % (type(params)))
-        self._params = []
-        for param in params:
-            if not isinstance(param, Parameter):
-                raise ValueError(
-                    'First argument must be a list or dict of Parameters, '
-                    'got list of %s.' % (type(param)))
-            if param.grad_req != 'null':
-                self._params.append(param)
-        self._scale = float(optimizer_params.get('rescale_grad', 1.0)) \
-            if optimizer_params else 1.0
-        self._contexts = self._check_contexts()
-        self._init_optimizer(optimizer, optimizer_params or {})
-        self._kv_initialized = False
-        self._kvstore = kvstore
+                'got list of %s.' % (type(p)))
+    return [p for p in params if p.grad_req != 'null']
 
-    def _check_contexts(self):
-        contexts = None
-        for param in self._params:
-            ctx = param.list_ctx()
-            assert contexts is None or contexts == ctx, \
-                'All Parameters must be initialized on the same set of contexts, ' \
-                'but Parameter %s is initialized on %s while previous Parameters ' \
-                'are initialized on %s.' % (param.name, str(ctx), str(contexts))
-            contexts = ctx
-        return contexts
+
+class Trainer:
+    """Steps an optimizer over a Block's parameters, aggregating
+    gradients across the parameters' contexts through a KVStore (or
+    per-context Updaters when no store is warranted)."""
+
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore='device'):
+        self._params = _trainable(params)
+        self._scale = float((optimizer_params or {}).get('rescale_grad', 1.0))
+        self._contexts = self._shared_contexts()
+        self._init_optimizer(optimizer, optimizer_params or {})
+        self._kvstore = kvstore
+        self._kv_initialized = False
+
+    def _shared_contexts(self):
+        """All parameters must live on one common context list."""
+        seen = None
+        for p in self._params:
+            ctx = p.list_ctx()
+            if seen is not None and seen != ctx:
+                raise AssertionError(
+                    'All Parameters must be initialized on the same set of '
+                    'contexts, but Parameter %s is initialized on %s while '
+                    'previous Parameters are initialized on %s.'
+                    % (p.name, str(ctx), str(seen)))
+            seen = ctx
+        return seen
 
     def _init_optimizer(self, optimizer, optimizer_params):
-        param_dict = {i: param for i, param in enumerate(self._params)}
+        by_index = dict(enumerate(self._params))
         if isinstance(optimizer, opt.Optimizer):
-            assert not optimizer_params, \
-                'optimizer_params must be None if optimizer is an Optimizer ' \
-                'instance'
+            if optimizer_params:
+                raise AssertionError('optimizer_params must be None if '
+                                     'optimizer is an Optimizer instance')
             self._optimizer = optimizer
-            self._optimizer.param_dict = param_dict
+            optimizer.param_dict = by_index
         else:
-            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+            self._optimizer = opt.create(optimizer, param_dict=by_index,
                                          **optimizer_params)
         self._updaters = [opt.get_updater(self._optimizer)
                           for _ in self._contexts]
 
     def _init_kvstore(self):
-        """Reference trainer.py:95."""
-        arg_arrays = {param.name: param.data(self._contexts[0])
-                      for param in self._params}
+        """Decide the gradient-aggregation path once, lazily.
+        Reference trainer.py:95."""
+        sample = {p.name: p.data(self._contexts[0]) for p in self._params}
         kvstore, update_on_kvstore = _create_kvstore(
-            self._kvstore, len(self._contexts), arg_arrays)
-        if kvstore:
+            self._kvstore, len(self._contexts), sample)
+        if not kvstore:
+            self._kvstore, self._update_on_kvstore = None, False
+        else:
             if 'dist' in kvstore.type:
                 update_on_kvstore = False
-            for i, param in enumerate(self._params):
-                param_arrays = param.list_data()
-                kvstore.init(i, param_arrays[0])
+            for i, p in enumerate(self._params):
+                replicas = p.list_data()
+                kvstore.init(i, replicas[0])
                 if update_on_kvstore:
-                    kvstore.pull(i, param_arrays, priority=-i)
+                    kvstore.pull(i, replicas, priority=-i)
             if update_on_kvstore:
                 kvstore.set_optimizer(self._optimizer)
             self._kvstore = kvstore
             self._update_on_kvstore = update_on_kvstore
-        else:
-            self._kvstore = None
-            self._update_on_kvstore = False
         self._kv_initialized = True
 
     @property
@@ -89,42 +98,48 @@ class Trainer:
     def set_learning_rate(self, lr):
         self._optimizer.set_learning_rate(lr)
 
+    def _assert_fresh(self, param):
+        for data in param.list_data():
+            if data._fresh_grad:
+                raise UserWarning(
+                    'Gradient of Parameter `%s` on context %s has not '
+                    'been updated by backward since last `step`. This '
+                    'could mean a bug in your model that made it only '
+                    'use a subset of the Parameters (Blocks) for this '
+                    'iteration. If you are intentionally only using a '
+                    'subset, call step with ignore_stale_grad=True to '
+                    'suppress this warning and skip updating of '
+                    'Parameters with stale gradient' % (
+                        param.name, str(data.context)))
+
     def step(self, batch_size, ignore_stale_grad=False):
-        """Reference trainer.py:116."""
+        """Aggregate gradients and apply one optimizer update.
+        Reference trainer.py:116."""
         if not self._kv_initialized:
             self._init_kvstore()
-
         self._optimizer.rescale_grad = self._scale / batch_size
 
         for i, param in enumerate(self._params):
             if param.grad_req == 'null':
                 continue
             if not ignore_stale_grad:
-                for data in param.list_data():
-                    if data._fresh_grad:
-                        raise UserWarning(
-                            'Gradient of Parameter `%s` on context %s has not '
-                            'been updated by backward since last `step`. This '
-                            'could mean a bug in your model that made it only '
-                            'use a subset of the Parameters (Blocks) for this '
-                            'iteration. If you are intentionally only using a '
-                            'subset, call step with ignore_stale_grad=True to '
-                            'suppress this warning and skip updating of '
-                            'Parameters with stale gradient' % (
-                                param.name, str(data.context)))
-            if self._kvstore:
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                if self._update_on_kvstore:
-                    self._kvstore.pull(i, param.list_data(), priority=-i)
-                    continue
-                self._kvstore.pull(i, param.list_grad(), priority=-i)
+                self._assert_fresh(param)
 
-            for upd, arr, grad in zip(self._updaters, param.list_data(),
-                                      param.list_grad()):
-                if not ignore_stale_grad or not arr._fresh_grad:
-                    upd(i, grad, arr)
-                    arr._fresh_grad = True
-        # reset for next iteration's staleness tracking
+            store = self._kvstore
+            if store:
+                store.push(i, param.list_grad(), priority=-i)
+                if self._update_on_kvstore:
+                    # server-side update: fetch fresh weights, done
+                    store.pull(i, param.list_data(), priority=-i)
+                    continue
+                store.pull(i, param.list_grad(), priority=-i)
+
+            for updater, weight, grad in zip(
+                    self._updaters, param.list_data(), param.list_grad()):
+                if not ignore_stale_grad or not weight._fresh_grad:
+                    updater(i, grad, weight)
+                    weight._fresh_grad = True
+        # arm staleness tracking for the next backward
         for param in self._params:
             for data in param.list_data():
                 data._fresh_grad = True
@@ -137,8 +152,9 @@ class Trainer:
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
         else:
+            blob = self._updaters[0].get_states(dump_optimizer=True)
             with open(fname, 'wb') as fout:
-                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+                fout.write(blob)
 
     def load_states(self, fname):
         """Reference trainer.py:178."""
@@ -149,8 +165,8 @@ class Trainer:
             self._optimizer = self._kvstore._updater.optimizer
         else:
             with open(fname, 'rb') as f:
-                states = f.read()
+                blob = f.read()
             for updater in self._updaters:
-                updater.set_states(states)
+                updater.set_states(blob)
                 updater.optimizer = self._updaters[0].optimizer
             self._optimizer = self._updaters[0].optimizer

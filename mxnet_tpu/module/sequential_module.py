@@ -12,6 +12,12 @@ __all__ = ['SequentialModule']
 
 
 class SequentialModule(BaseModule):
+    """Runs constituent modules back to back: forward threads each
+    module's outputs into the next one's data, backward threads input
+    gradients the other way. Per-module metadata selects which layers
+    see labels (``take_labels``) and whether data names are rewired to
+    the next module's inputs (``auto_wiring``)."""
+
     META_TAKE_LABELS = 'take_labels'
     META_AUTO_WIRING = 'auto_wiring'
 
@@ -21,31 +27,34 @@ class SequentialModule(BaseModule):
         self._metas = []
         self._label_shapes = None
         self._data_shapes = None
-        self._meta_keys = set([getattr(SequentialModule, x)
-                               for x in dir(SequentialModule)
-                               if x.startswith('META_')])
+        self._meta_keys = {getattr(SequentialModule, name)
+                           for name in dir(SequentialModule)
+                           if name.startswith('META_')}
 
     def add(self, module, **kwargs):
+        unknown = set(kwargs) - self._meta_keys
+        if unknown:
+            raise AssertionError('Unknown meta "%s", a typo?'
+                                 % unknown.pop())
         self._modules.append(module)
-        for key in kwargs:
-            assert key in self._meta_keys, 'Unknown meta "%s", a typo?' % key
         self._metas.append(kwargs)
+        # a structural change invalidates every derived state
         self.binded = False
         self.params_initialized = False
         self.optimizer_initialized = False
         return self
 
+    def _takes_labels(self, index):
+        return bool(self._metas[index].get(self.META_TAKE_LABELS))
+
+    # -- shapes/names delegate to the chain's ends ------------------------
     @property
     def data_names(self):
-        if len(self._modules) > 0:
-            return self._modules[0].data_names
-        return []
+        return self._modules[0].data_names if self._modules else []
 
     @property
     def output_names(self):
-        if len(self._modules) > 0:
-            return self._modules[-1].output_names
-        return []
+        return self._modules[-1].output_names if self._modules else []
 
     @property
     def data_shapes(self):
@@ -64,13 +73,12 @@ class SequentialModule(BaseModule):
 
     def get_params(self):
         assert self.binded and self.params_initialized
-        arg_params = dict()
-        aux_params = dict()
+        arg_params, aux_params = {}, {}
         for module in self._modules:
             arg, aux = module.get_params()
             arg_params.update(arg)
             aux_params.update(aux)
-        return (arg_params, aux_params)
+        return arg_params, aux_params
 
     def init_params(self, initializer=Uniform(0.01), arg_params=None,
                     aux_params=None, allow_missing=False, force_init=False,
@@ -83,21 +91,24 @@ class SequentialModule(BaseModule):
                                aux_params=aux_params,
                                allow_missing=allow_missing,
                                force_init=force_init, allow_extra=allow_extra)
-
-        def _check_name(known_names, new_names, modules, i):
-            for name in new_names:
-                assert not name in known_names, 'Duplicated parameter names: ' + \
-                    ('name "%s" in layer %d (%s) is already ' % (name, i, type(modules[i]))) + \
-                    ('used in layer %d (%s).' % (known_names[name], type(modules[known_names[name]])))
-                known_names[name] = i
-
-        arg_names = dict()
-        aux_names = dict()
-        for i_layer, module in enumerate(self._modules):
-            arg_params, aux_params = module.get_params()
-            _check_name(arg_names, arg_params.keys(), self._modules, i_layer)
-            _check_name(aux_names, aux_params.keys(), self._modules, i_layer)
+        self._assert_unique_params()
         self.params_initialized = True
+
+    def _assert_unique_params(self):
+        """No parameter name may appear in two chained modules (arg and
+        aux namespaces are independent, as in the reference)."""
+        arg_owner, aux_owner = {}, {}
+        for index, module in enumerate(self._modules):
+            arg, aux = module.get_params()
+            for owner, names in ((arg_owner, arg), (aux_owner, aux)):
+                for name in names:
+                    if name in owner:
+                        raise AssertionError(
+                            'Duplicated parameter names: name "%s" in layer '
+                            '%d (%s) is already used in layer %d (%s).'
+                            % (name, index, type(module), owner[name],
+                               type(self._modules[owner[name]])))
+                    owner[name] = index
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
@@ -108,40 +119,36 @@ class SequentialModule(BaseModule):
         if inputs_need_grad:
             assert for_training
         assert shared_module is None, 'Shared module is not supported'
-        assert len(self._modules) > 0, 'Attempting to bind an empty SequentialModule'
+        assert self._modules, 'Attempting to bind an empty SequentialModule'
 
         self.binded = True
         self._label_shapes = label_shapes
 
-        my_data_shapes = data_shapes
-        anybody_ever_needs_label = False
-        for i_layer, module in enumerate(self._modules):
-            meta = self._metas[i_layer]
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
-                my_label_shapes = label_shapes
-                anybody_ever_needs_label = True
-            else:
-                my_label_shapes = None
+        chained_shapes = data_shapes
+        label_consumed = False
+        for index, module in enumerate(self._modules):
+            if self._takes_labels(index):
+                label_consumed = True
+            if self._metas[index].get(self.META_AUTO_WIRING, False):
+                names = module.data_names
+                assert len(names) == len(chained_shapes)
+                chained_shapes = [
+                    (name, shape)
+                    for name, (_, shape) in zip(names, chained_shapes)]
+            module.bind(
+                data_shapes=chained_shapes,
+                label_shapes=label_shapes if self._takes_labels(index)
+                else None,
+                for_training=for_training,
+                # interior modules always need input grads to continue
+                # the chain rule upstream
+                inputs_need_grad=bool(inputs_need_grad or
+                                      (for_training and index > 0)),
+                force_rebind=force_rebind, shared_module=None,
+                grad_req=grad_req)
+            chained_shapes = module.output_shapes
 
-            my_inputs_need_grad = bool(inputs_need_grad or
-                                       (for_training and i_layer > 0))
-
-            if meta.get(SequentialModule.META_AUTO_WIRING, False):
-                data_names = module.data_names
-                assert len(data_names) == len(my_data_shapes)
-                my_data_shapes = [(new_name, shape) for (new_name, (_, shape))
-                                  in zip(data_names, my_data_shapes)]
-
-            module.bind(data_shapes=my_data_shapes,
-                        label_shapes=my_label_shapes,
-                        for_training=for_training,
-                        inputs_need_grad=my_inputs_need_grad,
-                        force_rebind=force_rebind, shared_module=None,
-                        grad_req=grad_req)
-            my_data_shapes = module.output_shapes
-
-        if not anybody_ever_needs_label:
+        if not label_consumed:
             self._label_shapes = None
 
     def init_optimizer(self, kvstore='local', optimizer='sgd',
@@ -159,24 +166,26 @@ class SequentialModule(BaseModule):
 
     def forward(self, data_batch, is_train=None):
         assert self.binded and self.params_initialized
-        data_batch = copy.copy(data_batch)
-        for i_layer, module in enumerate(self._modules):
-            module.forward(data_batch, is_train=is_train)
-            if i_layer + 1 == len(self._modules):
+        batch = copy.copy(data_batch)
+        last = len(self._modules) - 1
+        for index, module in enumerate(self._modules):
+            module.forward(batch, is_train=is_train)
+            if index == last:
                 break
-            data_batch.data = module.get_outputs()
-            if hasattr(data_batch, 'provide_data'):
-                data_names = [x[0] for x in module.output_shapes]
-                assert len(data_names) == len(data_batch.data)
-                data_batch.provide_data = [(name, x.shape) for name, x in
-                                           zip(data_names, data_batch.data)]
+            # next module consumes this one's outputs as its data
+            batch.data = module.get_outputs()
+            if hasattr(batch, 'provide_data'):
+                names = [name for name, _ in module.output_shapes]
+                assert len(names) == len(batch.data)
+                batch.provide_data = [(name, out.shape) for name, out
+                                      in zip(names, batch.data)]
 
     def backward(self, out_grads=None):
         assert self.binded and self.params_initialized
-        for i_layer, module in reversed(list(zip(range(len(self._modules)),
-                                                 self._modules))):
+        for index in range(len(self._modules) - 1, -1, -1):
+            module = self._modules[index]
             module.backward(out_grads=out_grads)
-            if i_layer == 0:
+            if index == 0:
                 break
             out_grads = module.get_input_grads()
 
@@ -192,15 +201,15 @@ class SequentialModule(BaseModule):
             merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and self.inputs_need_grad
+        assert self.binded and self.params_initialized and \
+            self.inputs_need_grad
         return self._modules[0].get_input_grads(
             merge_multi_context=merge_multi_context)
 
     def update_metric(self, eval_metric, labels):
         assert self.binded and self.params_initialized
-        for meta, module in zip(self._metas, self._modules):
-            if SequentialModule.META_TAKE_LABELS in meta and \
-                    meta[SequentialModule.META_TAKE_LABELS]:
+        for index, module in enumerate(self._modules):
+            if self._takes_labels(index):
                 module.update_metric(eval_metric, labels)
 
     def install_monitor(self, mon):

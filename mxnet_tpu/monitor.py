@@ -1,78 +1,93 @@
 """Monitor — per-op output statistics during execution.
 
-Reference: python/mxnet/monitor.py:143 (regex-selected per-op stats via the
-executor monitor callback, Monitor.tic/toc/toc_print).
+Reference: python/mxnet/monitor.py:143 (regex-selected per-op stats via
+the executor monitor callback; tic arms a window every ``interval``
+steps, toc drains it plus the matching weight arrays).
 """
 import logging
 import re
+from collections import namedtuple
 from math import sqrt
-
-from .ndarray import NDArray
 
 __all__ = ['Monitor']
 
+_Record = namedtuple('_Record', ['step', 'name', 'stat'])
+
+
+def _rms_stat(x):
+    """Default statistic: RMS of the tensor, as a string."""
+    return str((x.norm() / sqrt(x.size)).asscalar())
+
 
 class Monitor:
+    """Collects a statistic for every executor output whose name matches
+    ``pattern``, on every ``interval``-th step between tic() and toc().
+
+    install() hooks an Executor's monitor callback; Module.fit calls
+    tic/toc_print around each batch when given a monitor.
+    """
+
     def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
-        if stat_func is None:
-            def asum_stat(x):
-                return str((x.norm() / sqrt(x.size)).asscalar())
-            stat_func = asum_stat
-        self.stat_func = stat_func
         self.interval = interval
-        self.activated = False
-        self.queue = []
+        self.stat_func = stat_func or _rms_stat
+        self.sort = sort
+        self.re_prog = re.compile(pattern)
         self.step = 0
         self.exes = []
-        self.re_prog = re.compile(pattern)
-        self.sort = sort
+        self.activated = False
+        self.queue = []
+
+        monitor = self
 
         def stat_helper(name, array):
-            if not self.activated or not self.re_prog.match(name):
-                return
-            self.queue.append((self.step, name, self.stat_func(array)))
+            # invoked by the executor for every op output while armed
+            if monitor.activated and monitor.re_prog.match(name):
+                monitor.queue.append(
+                    _Record(monitor.step, name, monitor.stat_func(array)))
         self.stat_helper = stat_helper
 
     def install(self, exe):
+        """Register with an executor; may be called for many executors."""
         exe.set_monitor_callback(self.stat_helper)
         self.exes.append(exe)
 
+    def _barrier(self):
+        for exe in self.exes:
+            for array in exe.arg_arrays:
+                array.wait_to_read()
+
     def tic(self):
+        """Open a collection window if this step is on the interval."""
         if self.step % self.interval == 0:
-            for exe in self.exes:
-                for array in exe.arg_arrays:
-                    array.wait_to_read()
+            self._barrier()
             self.queue = []
             self.activated = True
         self.step += 1
 
     def toc(self):
+        """Close the window: also sample matching weight arrays, then
+        return [(step, name, tab-joined stat string), ...]."""
         if not self.activated:
             return []
-        for exe in self.exes:
-            for array in exe.arg_arrays:
-                array.wait_to_read()
+        self._barrier()
         for exe in self.exes:
             for name, array in exe.arg_dict.items():
                 if self.re_prog.match(name):
-                    self.queue.append((self.step, name, self.stat_func(array)))
+                    self.queue.append(
+                        _Record(self.step, name, self.stat_func(array)))
         self.activated = False
-        res = []
-        if self.sort:
-            self.queue.sort(key=lambda x: x[1])
-        for n, k, v_list in self.queue:
-            if isinstance(v_list, NDArray):
-                v_list = [v_list]
-            if not isinstance(v_list, list):
-                v_list = [v_list]
-            s = ''
-            for v in v_list:
-                s += str(v) + '\t'
-            res.append((n, k, s))
+        pending = sorted(self.queue, key=lambda r: r.name) if self.sort \
+            else self.queue
+        results = [(r.step, r.name, self._render(r.stat)) for r in pending]
         self.queue = []
-        return res
+        return results
+
+    @staticmethod
+    def _render(stat):
+        values = stat if isinstance(stat, list) else [stat]
+        return ''.join(str(v) + '\t' for v in values)
 
     def toc_print(self):
-        res = self.toc()
-        for n, k, v in res:
-            logging.info('Batch: {:7d} {:30s} {:s}'.format(n, k, v))
+        """toc() and log each row."""
+        for step, name, stat in self.toc():
+            logging.info('Batch: {:7d} {:30s} {:s}'.format(step, name, stat))
