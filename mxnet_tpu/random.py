@@ -5,6 +5,7 @@ Reference: python/mxnet/random.py (mx.random.seed) + src/resource.cc:84
 counter-split key; every random op consumes one fresh subkey, passed to the
 op as a trailing array argument so the op itself stays pure/jittable.
 """
+import os as _os
 import random as _pyrandom
 import threading
 
@@ -17,12 +18,27 @@ _lock = threading.Lock()
 # lazy: creating a key initializes the jax backend, which must not happen
 # at import time (slow/fragile through the TPU tunnel)
 _key = None
+# MXTPU_SEED: seed every framework stream at import, exactly as if the
+# process's first statement were mx.random.seed(N) — lets unmodified
+# scripts (which never call seed) run hermetically, e.g. in CI. The
+# device key stream honors it too (next_key's lazy init uses PRNGKey(N)
+# directly, with no extra host draw).
+_env_seed = None
+_env_raw = _os.environ.get('MXTPU_SEED', '').strip()
+if _env_raw:
+    try:
+        _env_seed = int(_env_raw)
+    except ValueError:
+        import warnings as _warnings
+        _warnings.warn('MXTPU_SEED=%r is not an integer; ignoring it'
+                       % _env_raw)
 # framework-private host-side stream for initializers / iterator shuffles.
 # Private so mx.random.seed is hermetic WITHOUT clobbering the user's
 # process-global numpy state (the reference's mx.random.seed doesn't
 # touch numpy either).
-_host_rng = _np.random.RandomState()
-_host_pyrng = _pyrandom.Random()
+_host_rng = _np.random.RandomState(
+    _env_seed % (2 ** 32) if _env_seed is not None else None)
+_host_pyrng = _pyrandom.Random(_env_seed)
 
 
 def host_rng():
@@ -53,6 +69,11 @@ def next_key():
     global _key
     with _lock:
         if _key is None:
-            _key = jax.random.PRNGKey(_host_rng.randint(0, 2**31 - 1))
+            # MXTPU_SEED path: PRNGKey(N) directly, exactly what
+            # mx.random.seed(N) would have set — and no host draw, so
+            # host-stream consumers stay aligned with the seed() path
+            _key = jax.random.PRNGKey(
+                _env_seed if _env_seed is not None
+                else _host_rng.randint(0, 2**31 - 1))
         _key, sub = jax.random.split(_key)
         return sub

@@ -67,6 +67,9 @@ def _run_reference_script(script_path, argv, cwd, timeout=540,
     env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
     env['JAX_PLATFORMS'] = 'cpu'
     env['PYTHONPATH'] = os.path.join(ROOT, 'python') + os.pathsep + ROOT
+    # hermetic init/shuffle streams for scripts that never call
+    # mx.random.seed (see MXTPU_SEED in docs/env_vars.md)
+    env.setdefault('MXTPU_SEED', '2027')
     script_dir = os.path.dirname(script_path)
     code = (
         "import jax; jax.config.update('jax_platforms','cpu');"
@@ -216,9 +219,19 @@ def test_rnn_lstm_bucketing_unmodified(tmp_path):
 
 def _write_cifar_rec(path, n, seed):
     """Class-separable 28x28x3 JPEG records in the reference's packed
-    RecordIO format (IRHeader + encoded image, tools/im2rec layout)."""
+    RecordIO format (IRHeader + encoded image, tools/im2rec layout).
+
+    Prototypes are horizontally SYMMETRIC: the script trains with the
+    reference's per-image rand_mirror, and an asymmetric prototype set
+    would make each mirrored image a novel class (the round-3 loader
+    ignored per-image augmentation, which hid this; the round-4
+    pipeline applies it faithfully)."""
     from mxnet_tpu.recordio import MXRecordIO, IRHeader, pack_img
     protos = np.random.RandomState(43).rand(10, 28, 28, 3)
+    protos = (protos + protos[:, :, ::-1]) / 2.0   # mirror-invariant
+    # symmetrizing halves the inter-class contrast; restore it so the
+    # 3-epoch budget separates classes at the same SNR as before
+    protos = np.clip(0.5 + 2.5 * (protos - 0.5), 0.0, 1.0)
     rng = np.random.RandomState(seed)
     rec = MXRecordIO(path, 'w')
     for i in range(n):

@@ -107,3 +107,47 @@ def test_symbol_random_ops_in_graph():
     a = ex.forward()[0].asnumpy()
     assert a.shape == (2, 2)
     assert (a >= 0).all() and (a <= 2).all()
+
+
+def test_env_seed_matches_explicit_seed():
+    """MXTPU_SEED=N must behave exactly as if the process began with
+    mx.random.seed(N): same device key stream (no extra host draw) and
+    same host-stream state (docs/env_vars.md)."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    body = (
+        "import jax; jax.config.update('jax_platforms', 'cpu');"
+        "import sys; sys.path.insert(0, %r);"
+        "{pre}"
+        "import mxnet_tpu as mx; from mxnet_tpu import nd;"
+        "{seed}"
+        "u = nd.random.uniform(shape=(4,)).asnumpy().tolist();"
+        "h = mx.random.host_rng().randint(0, 10**9);"
+        "print('OUT', u, h)" % repo)
+
+    def run(pre_env, body_):
+        env = {k: v for k, v in os.environ.items()
+               if not (k.startswith(('AXON_', 'TPU_', 'PALLAS_'))
+                       or k in ('_AXON_REGISTERED', 'PJRT_LIBRARY_PATH',
+                                'MXTPU_SEED'))}
+        env['JAX_PLATFORMS'] = 'cpu'
+        env.update(pre_env)
+        out = subprocess.run([sys.executable, '-c', body_], env=env,
+                             capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return [ln for ln in out.stdout.splitlines()
+                if ln.startswith('OUT')][0]
+
+    via_env = run({'MXTPU_SEED': '11'},
+                  body.format(pre='', seed=''))
+    via_call = run({}, body.format(pre='', seed='mx.random.seed(11);'))
+    assert via_env == via_call
+    # malformed values must not break import
+    bad = run({'MXTPU_SEED': 'auto'},
+              body.format(pre='import warnings;'
+                          'warnings.simplefilter("ignore");', seed=''))
+    assert bad.startswith('OUT')
