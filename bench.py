@@ -294,7 +294,11 @@ def build_train_step():
              % MIRROR)
     mp_update = get_op('mp_sgd_mom_update').fn
 
-    lr, momentum, wd = 0.1, 0.9, 1e-4
+    # BN-free AlexNet diverges (loss=nan by warmup) at the BN-nets' 0.1:
+    # its 9216->4096 FC stack amplifies He-init activations with nothing
+    # renormalizing them. 0.01 is the original AlexNet recipe's lr.
+    lr = 0.01 if MODEL == 'alexnet' else 0.1
+    momentum, wd = 0.9, 1e-4
     attrs = {'lr': lr, 'momentum': momentum, 'wd': wd,
              'rescale_grad': 1.0, 'clip_gradient': -1.0}
 

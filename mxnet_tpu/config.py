@@ -133,6 +133,11 @@ flags.declare('MXTPU_CONV_BWD_PATCHES', bool, False,
               'compute conv2d weight gradients as an explicit im2col '
               'patches-matmul instead of conv_backprop_filter '
               '(groups=1 2D convs only; see docs/perf.md)')
+flags.declare('MXTPU_CONV_STEM_S2D', bool, False,
+              'rewrite thin-input strided convs (cin<=4, stride>1: the '
+              'image-network stem) into space-to-depth + stride-1 convs; '
+              'exact reparametrization that the MXU tiles far better than '
+              'a cin=3 strided conv (see docs/perf.md)')
 flags.declare('MXTPU_FORCE_PALLAS', bool, False,
               'Dispatch LayerNorm/softmax/attention to the Pallas kernels '
               'even off-TPU (interpret mode; exercises the kernel path on '

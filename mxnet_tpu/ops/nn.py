@@ -109,6 +109,10 @@ def _channels_last_conv(data, weight, w_layout, **conv_kwargs):
 
 def _conv_nd(data, weight, stride, dilate, pad, groups):
     from ..config import flags as _flags
+    if (_flags.get('MXTPU_CONV_STEM_S2D') and groups == 1
+            and data.ndim == 4 and data.shape[1] <= 4
+            and min(stride) > 1 and dilate == (1,) * len(dilate)):
+        return _conv2d_stem_s2d(data, weight, stride, pad)
     if (_flags.get('MXTPU_CONV_BWD_PATCHES') and groups == 1
             and data.ndim == 4):
         return _conv2d_patches_bwd(data, weight, stride, dilate, pad)
@@ -116,6 +120,67 @@ def _conv_nd(data, weight, stride, dilate, pad, groups):
         data, weight, 'OI', window_strides=stride,
         padding=[(p, p) for p in pad], rhs_dilation=dilate,
         feature_group_count=groups)
+
+
+def _conv2d_stem_s2d(data, weight, stride, pad):
+    """Thin-input strided conv as space-to-depth + stride-1 conv.
+
+    The image-network stem (ResNet 7x7/s2, AlexNet 11x11/s4,
+    Inception 3x3/s2 — all cin=3) is the worst conv shape on the MXU:
+    3 input channels leave the 128x128 systolic array ~98% idle and the
+    stride-2 footprint defeats XLA's tiling (measured 11-13% MFU,
+    docs/tpu_artifacts/conv_breakdown_*.json). Re-expressing it over
+    the s-strided phase decomposition x2[qh, qw, c*s^2 + rh*s + rw] =
+    x[s*qh+rh, s*qw+rw] turns it into a dense stride-1 conv with
+    cin*s^2 channels — exactly the MLPerf-ResNet space-to-depth trick,
+    derived here as a pure reparametrization (no train-recipe change):
+
+      y[p] = sum_j w[j] x[s*p + j - p0]          (original, per dim)
+
+    Shift the kernel by d = (-p0) mod s so p0+d = s*P, split the tap
+    index j+d = s*t + r; then y[p] = sum_{t,r} w'[s*t+r] x2[p+t-P, r]
+    — a T-tap stride-1 conv over q with T = ceil((k+d)/s). Zero-padded
+    taps add (T*s/k)^2-fold nominal FLOPs on a shape whose utilization
+    improves by much more (A/B'd on chip; opt-in MXTPU_CONV_STEM_S2D).
+    Backward needs no custom rule: the transforms are linear jnp ops,
+    and the weight gradient of the stride-1 conv flows back through
+    their transpose onto the original 7x7 layout.
+    """
+    N, C, H, W = data.shape
+    O = weight.shape[0]
+    sh, sw = stride
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    ph, pw = pad
+    out_h = (H + 2 * ph - kh) // sh + 1
+    out_w = (W + 2 * pw - kw) // sw + 1
+
+    def _geom(k, s, p, size, out):
+        d = (-p) % s                  # kernel left-shift to align phases
+        P = (p + d) // s              # q-space left margin
+        T = -((k + d) // -s)          # taps over q (ceil)
+        lo = s * P                    # input left pad
+        hi = s * (out - 1 + T - P) - size  # right pad to cover last tap
+        hi = max(hi, 0)
+        hi += (s - (lo + size + hi) % s) % s  # phase split needs s | len
+        return d, T, lo, hi
+
+    dh, Th, lo_h, hi_h = _geom(kh, sh, ph, H, out_h)
+    dw, Tw, lo_w, hi_w = _geom(kw, sw, pw, W, out_w)
+
+    x = jnp.pad(data, ((0, 0), (0, 0), (lo_h, hi_h), (lo_w, hi_w)))
+    qh, qw = x.shape[2] // sh, x.shape[3] // sw
+    x = x.reshape(N, C, qh, sh, qw, sw)
+    x = jnp.transpose(x, (0, 1, 3, 5, 2, 4)).reshape(N, C * sh * sw, qh, qw)
+
+    w = jnp.pad(weight, ((0, 0), (0, 0),
+                         (dh, Th * sh - kh - dh), (dw, Tw * sw - kw - dw)))
+    w = w.reshape(O, C, Th, sh, Tw, sw)
+    w = jnp.transpose(w, (0, 1, 3, 5, 2, 4)).reshape(O, C * sh * sw, Th, Tw)
+
+    out = _channels_last_conv(
+        x, w, 'OI', window_strides=(1, 1), padding=[(0, 0), (0, 0)],
+        rhs_dilation=(1, 1), feature_group_count=1)
+    return out[:, :, :out_h, :out_w]
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))

@@ -1,0 +1,144 @@
+"""MXTPU_CONV_STEM_S2D=1 parity: the space-to-depth stem rewrite
+(ops/nn.py _conv2d_stem_s2d) equals the plain strided conv to numerical
+precision, forward and backward, across the stem geometries it targets
+(ResNet 7x7/s2/p3, AlexNet 11x11/s4/p2, Inception 3x3/s2) plus
+awkward sizes/phases.
+
+The flag is parsed once per process, so each mode runs in ONE fresh
+subprocess computing every case (2 jax startups total) — same recipe
+as test_conv_patches.py.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CASES = [
+    # (in_shape, w_shape, stride, pad)
+    ((2, 3, 38, 38), (8, 3, 7, 7), (2, 2), (3, 3)),    # ResNet stem geometry
+    ((2, 3, 47, 47), (8, 3, 11, 11), (4, 4), (2, 2)),  # AlexNet stem geometry
+    ((2, 3, 33, 33), (8, 3, 3, 3), (2, 2), (0, 0)),    # Inception-v3 stem
+    ((1, 3, 30, 30), (4, 3, 3, 3), (2, 2), (1, 1)),    # p aligned to s
+    ((2, 1, 21, 25), (5, 1, 5, 5), (2, 2), (2, 2)),    # cin=1, non-square, odd
+    ((1, 4, 26, 26), (6, 4, 7, 7), (2, 2), (3, 3)),    # cin=4 (upper bound)
+    ((2, 3, 29, 29), (7, 3, 5, 3), (3, 3), (1, 1)),    # s=3, non-square kernel
+    ((1, 3, 24, 24), (4, 3, 4, 4), (2, 2), (1, 1)),    # even kernel
+]
+
+_PROBE = r'''
+import os, sys, json
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import jax.numpy as jnp
+from mxnet_tpu.ops.nn import _conv_nd
+
+results = []
+for (ishape, wshape, stride, pad) in json.loads(sys.argv[1]):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(*ishape), jnp.float32)
+    w = jnp.asarray(rng.randn(*wshape), jnp.float32)
+
+    def loss(x, w):
+        return jnp.sum(jnp.tanh(_conv_nd(x, w, tuple(stride), (1, 1),
+                                         tuple(pad), 1)))
+
+    val, (gx, gw) = jax.value_and_grad(loss, argnums=(0, 1))(x, w)
+    results.append(dict(val=float(val),
+                        gx=np.asarray(gx).ravel().tolist(),
+                        gw=np.asarray(gw).ravel().tolist()))
+print(json.dumps(results))
+'''
+
+
+def _run_probe(s2d):
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO
+    env['JAX_PLATFORMS'] = 'cpu'
+    if s2d:
+        env['MXTPU_CONV_STEM_S2D'] = '1'
+    else:
+        env.pop('MXTPU_CONV_STEM_S2D', None)
+    r = subprocess.run([sys.executable, '-c', _PROBE, json.dumps(_CASES)],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+_TRAIN_DRIVE = r'''
+import os, sys, json
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import nn, Trainer
+from mxnet_tpu import autograd, nd
+
+mx.random.seed(7)
+net = nn.Sequential()
+with net.name_scope():
+    net.add(nn.Conv2D(16, kernel_size=7, strides=2, padding=3))  # stem
+    net.add(nn.Activation('relu'))
+    net.add(nn.GlobalAvgPool2D())
+    net.add(nn.Dense(10))
+net.initialize(mx.init.Xavier())
+trainer = Trainer(net.collect_params(), 'sgd', {'learning_rate': 0.05})
+loss_fn = mx.gluon.loss.SoftmaxCrossEntropyLoss()
+rng = np.random.RandomState(0)
+X = nd.array(rng.randn(64, 3, 32, 32).astype('float32'))
+Y = nd.array(rng.randint(0, 10, size=(64,)).astype('float32'))
+losses = []
+for step in range(8):
+    with autograd.record():
+        L = loss_fn(net(X), Y).mean()
+    L.backward()
+    trainer.step(1)
+    losses.append(float(L.asnumpy()))
+print(json.dumps(losses))
+'''
+
+
+def _run_train(s2d):
+    env = dict(os.environ)
+    env['PYTHONPATH'] = REPO
+    env['JAX_PLATFORMS'] = 'cpu'
+    if s2d:
+        env['MXTPU_CONV_STEM_S2D'] = '1'
+    else:
+        env.pop('MXTPU_CONV_STEM_S2D', None)
+    r = subprocess.run([sys.executable, '-c', _TRAIN_DRIVE],
+                       env=env, capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stderr[-2000:]
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def test_stem_s2d_training_trajectory_tracks():
+    """End-to-end through the user surface (Gluon record/backward/
+    Trainer.step): the flag-on loss trajectory must track flag-off to
+    fp32 noise — an exact reparametrization changes no training math —
+    and the loss must decrease."""
+    off = _run_train(s2d=False)
+    on = _run_train(s2d=True)
+    np.testing.assert_allclose(off, on, rtol=2e-3, atol=1e-4)
+    assert all(b < a for a, b in zip(off, off[1:])), off
+
+
+def test_stem_s2d_matches_default():
+    default = _run_probe(s2d=False)
+    rewritten = _run_probe(s2d=True)
+    for case, a, b in zip(_CASES, default, rewritten):
+        np.testing.assert_allclose(a['val'], b['val'], rtol=1e-5,
+                                   err_msg=str(case))
+        # FULL-array parity: any phase/reshape slip must fail loudly.
+        # atol 5e-5 absorbs fp32 accumulation-order noise (the rewrite
+        # changes the contraction order); a real phase bug is O(1) off.
+        np.testing.assert_allclose(a['gx'], b['gx'], rtol=1e-4, atol=5e-5,
+                                   err_msg=str(case))
+        np.testing.assert_allclose(a['gw'], b['gw'], rtol=1e-4, atol=5e-5,
+                                   err_msg=str(case))
