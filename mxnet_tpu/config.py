@@ -138,6 +138,12 @@ flags.declare('MXTPU_CONV_STEM_S2D', bool, False,
               'image-network stem) into space-to-depth + stride-1 convs; '
               'exact reparametrization that the MXU tiles far better than '
               'a cin=3 strided conv (see docs/perf.md)')
+flags.declare('MXTPU_PROFILER_XLA_TRACE', str, 'auto',
+              "Attach jax.profiler alongside the host-span trace when the "
+              "profiler runs: '1' always, '0' never, 'auto' = only on "
+              "backends where a killed trace cannot wedge the device "
+              "claim (skips the tunneled axon platform)",
+              choices={'0', '1', 'auto'})
 flags.declare('MXTPU_FORCE_PALLAS', bool, False,
               'Dispatch LayerNorm/softmax/attention to the Pallas kernels '
               'even off-TPU (interpret mode; exercises the kernel path on '

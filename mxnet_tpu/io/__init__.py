@@ -433,7 +433,8 @@ class CSVIter(NDArrayIter):
     """Reference src/io/iter_csv.cc."""
 
     def __init__(self, data_csv, data_shape, label_csv=None, label_shape=(1,),
-                 batch_size=1, round_batch=True, **kwargs):
+                 batch_size=1, round_batch=True, data_name='data',
+                 label_name='softmax_label', **kwargs):
         data = np.loadtxt(data_csv, delimiter=',', dtype=np.float32)
         data = data.reshape((-1,) + tuple(data_shape))
         label = None
@@ -446,7 +447,7 @@ class CSVIter(NDArrayIter):
             label = np.zeros(data.shape[0], dtype=np.float32)
         super().__init__(data, label, batch_size=batch_size,
                          last_batch_handle='pad' if round_batch else 'discard',
-                         data_name='data', label_name='label')
+                         data_name=data_name, label_name=label_name)
 
 
 class LibSVMIter(DataIter):
@@ -461,7 +462,8 @@ class LibSVMIter(DataIter):
     """
 
     def __init__(self, data_libsvm, data_shape, label_libsvm=None,
-                 label_shape=None, batch_size=1, round_batch=True, **kwargs):
+                 label_shape=None, batch_size=1, round_batch=True,
+                 data_name='data', label_name='softmax_label', **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape) if not isinstance(
             data_shape, int) else (data_shape,)
@@ -479,10 +481,14 @@ class LibSVMIter(DataIter):
             raise ValueError('fewer rows (%d) than batch_size (%d)'
                              % (self.num_data, batch_size))
         self.round_batch = round_batch
-        self.provide_data = [DataDesc('data', (batch_size,) + self.data_shape)]
+        # naming matches the reference frontend: every C++-registered
+        # iterator surfaces through MXDataIter whose defaults are
+        # data_name='data', label_name='softmax_label' (python io.py:766)
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape)]
         lshape = (batch_size,) if self._labels.ndim == 1 else \
             (batch_size,) + self._labels.shape[1:]
-        self.provide_label = [DataDesc('label', lshape)]
+        self.provide_label = [DataDesc(label_name, lshape)]
         self.reset()
 
     @staticmethod

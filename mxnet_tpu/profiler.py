@@ -9,6 +9,7 @@ device traces viewable in TensorBoard/Perfetto — same role, richer data.
 A lightweight host-side op-timeline (chrome trace JSON) is kept for parity
 with the reference's output format.
 """
+import atexit
 import json
 import os
 import threading
@@ -20,8 +21,37 @@ __all__ = ['profiler_set_config', 'profiler_set_state', 'dump_profile',
            'Profiler']
 
 _state = {'mode': 'symbolic', 'filename': 'profile.json', 'running': False,
-          'events': [], 'jax_dir': None}
+          'events': [], 'jax_dir': None, 'ran': False, 'dumped': False}
 _lock = threading.Lock()
+
+
+def _xla_trace_allowed():
+    """Whether to attach jax.profiler alongside the host-span trace.
+
+    NEVER against the tunneled axon chip: a killed traced process wedges
+    the tunnel claim for hours (verify SKILL.md, round-2 incident).
+    MXTPU_PROFILER_XLA_TRACE=0/1 overrides in either direction."""
+    from .config import flags
+    ov = flags.get('MXTPU_PROFILER_XLA_TRACE')
+    if ov != 'auto':
+        return ov == '1'
+    try:
+        return jax.default_backend() != 'axon'
+    except Exception:
+        return False
+
+
+def _atexit_dump():
+    """Reference initialize.cc:57-67 — the profile is written at process
+    exit even when the script never calls dump_profile (the example
+    scripts rely on this). A dump the user already made is not clobbered."""
+    if _state['running']:
+        profiler_set_state('stop')
+    if _state['ran'] and not _state['dumped']:
+        try:
+            dump_profile()
+        except Exception:
+            pass
 
 
 def profiler_set_config(mode='symbolic', filename='profile.json'):
@@ -39,14 +69,20 @@ def profiler_set_state(state='stop'):
     with _lock:
         if state == 'run' and not _state['running']:
             _state['running'] = True
+            if not _state['ran']:
+                _state['ran'] = True
+                atexit.register(_atexit_dump)
+            _state['dumped'] = False
             _state['events'] = []
             _state['start'] = time.time()
-            jax_dir = os.path.splitext(_state['filename'])[0] + '_xla'
-            try:
-                jax.profiler.start_trace(jax_dir)
-                _state['jax_dir'] = jax_dir
-            except Exception:
-                _state['jax_dir'] = None
+            _state['jax_dir'] = None
+            if _xla_trace_allowed():
+                jax_dir = os.path.splitext(_state['filename'])[0] + '_xla'
+                try:
+                    jax.profiler.start_trace(jax_dir)
+                    _state['jax_dir'] = jax_dir
+                except Exception:
+                    _state['jax_dir'] = None
         elif state == 'stop' and _state['running']:
             _state['running'] = False
             if _state['jax_dir']:
@@ -136,6 +172,7 @@ def dump_profile():
             os.unlink(path)
     with open(_state['filename'], 'w') as f:
         json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    _state['dumped'] = True
 
 
 class Profiler:

@@ -312,3 +312,50 @@ def test_module_sequential_unmodified(tmp_path):
     accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
     assert accs, out[-4000:]
     assert float(accs[-1]) > 0.9, out[-4000:]
+
+
+def _write_avazu_style_libsvm(path, rows=2048, nfeat=1000000, seed=3):
+    """Synthetic avazu-shaped libsvm (1M sparse features, ~20 nnz/row,
+    binary labels) — get_libsvm_data skips its download when the file
+    already exists (example/sparse/get_data.py:24)."""
+    rng = np.random.RandomState(seed)
+    with open(path, 'w') as f:
+        for _ in range(rows):
+            nnz = rng.randint(10, 30)
+            idx = np.sort(rng.choice(nfeat, size=nnz, replace=False))
+            sig = (idx < nfeat // 2).sum() - nnz / 2.0
+            label = 1 if sig + rng.randn() * 2 > 0 else 0
+            feats = ' '.join('%d:%.4f' % (j, rng.rand()) for j in idx)
+            f.write('%d %s\n' % (label, feats))
+
+
+def test_sparse_linear_classification_unmodified(tmp_path):
+    """example/sparse/linear_classification.py — the reference's sparse
+    showcase, verbatim: LibSVMIter CSR batches, a row_sparse weight,
+    manual kv.row_sparse_pull(row_ids=batch.data[0].indices) against
+    Module internals (_exec_group.param_names/param_arrays), and the
+    legacy profiler API (--profiler 1 exercises profiler_set_config/
+    set_state plus the reference's dump-at-exit behavior). The script's
+    argmax-Accuracy over its single-logit SoftmaxOutput is degenerate
+    by design (constant = label-0 share) — the reference behaves the
+    same; the gate is end-to-end execution with finite metrics and the
+    profile artifact on disk."""
+    os.makedirs(str(tmp_path / 'data'), exist_ok=True)
+    _write_avazu_style_libsvm(str(tmp_path / 'data' / 'avazu-app.t'))
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'sparse', 'linear_classification.py'),
+        ['--kvstore', 'local', '--batch-size', '256', '--num-epoch', '1',
+         '--profiler', '1'],
+        cwd=str(tmp_path), timeout=900)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    # numpy>=2 prints np.float64(0.48...), numpy 1.x prints the bare float
+    accs = re.findall(r"'accuracy', (?:np\.float64\()?([0-9.]+)\)?", out)
+    assert accs, out[-4000:]
+    assert all(np.isfinite(float(a)) for a in accs), accs
+    assert re.search(r'time cost = [0-9.]+', out), out[-2000:]
+    prof = tmp_path / 'profile_output_1.json'
+    assert prof.exists(), out[-2000:]
+    import json as _json
+    events = _json.load(open(str(prof)))['traceEvents']
+    assert len(events) > 0, 'profile dumped but empty'

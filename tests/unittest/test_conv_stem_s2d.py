@@ -56,7 +56,9 @@ print(json.dumps(results))
 '''
 
 
-def _run_probe(s2d):
+def _run_flagged(src, s2d, argv=()):
+    """One fresh subprocess per flag mode (flags parse once per process);
+    returns the JSON the probe prints on its last line."""
     env = dict(os.environ)
     env['PYTHONPATH'] = REPO
     env['JAX_PLATFORMS'] = 'cpu'
@@ -64,10 +66,14 @@ def _run_probe(s2d):
         env['MXTPU_CONV_STEM_S2D'] = '1'
     else:
         env.pop('MXTPU_CONV_STEM_S2D', None)
-    r = subprocess.run([sys.executable, '-c', _PROBE, json.dumps(_CASES)],
+    r = subprocess.run([sys.executable, '-c', src] + list(argv),
                        env=env, capture_output=True, text=True, timeout=600)
     assert r.returncode == 0, r.stderr[-2000:]
     return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def _run_probe(s2d):
+    return _run_flagged(_PROBE, s2d, [json.dumps(_CASES)])
 
 
 _TRAIN_DRIVE = r'''
@@ -105,17 +111,7 @@ print(json.dumps(losses))
 
 
 def _run_train(s2d):
-    env = dict(os.environ)
-    env['PYTHONPATH'] = REPO
-    env['JAX_PLATFORMS'] = 'cpu'
-    if s2d:
-        env['MXTPU_CONV_STEM_S2D'] = '1'
-    else:
-        env.pop('MXTPU_CONV_STEM_S2D', None)
-    r = subprocess.run([sys.executable, '-c', _TRAIN_DRIVE],
-                       env=env, capture_output=True, text=True, timeout=600)
-    assert r.returncode == 0, r.stderr[-2000:]
-    return json.loads(r.stdout.strip().splitlines()[-1])
+    return _run_flagged(_TRAIN_DRIVE, s2d)
 
 
 def test_stem_s2d_training_trajectory_tracks():
