@@ -129,7 +129,15 @@ class FeedForward:
                  begin_epoch=0, **kwargs):
         from .module import Module
         from .initializer import Uniform
-        self.symbol = symbol
+        if isinstance(symbol, sym.Symbol) or not callable(symbol):
+            self.symbol = symbol
+            self._sym_gen = None
+        else:
+            # reference model.py:460-464: a callable symbol is a
+            # sym_gen(bucket_key) for bucketing iterators; kept so every
+            # fit() re-lowers through BucketingModule
+            self.symbol = None
+            self._sym_gen = symbol
         self.ctx = ctx
         self.num_epoch = num_epoch
         self.optimizer = optimizer
@@ -145,15 +153,35 @@ class FeedForward:
             batch_end_callback=None, epoch_end_callback=None, logger=None,
             work_load_list=None, monitor=None, eval_end_callback=None,
             eval_batch_end_callback=None):
-        from .module import Module
+        from .module import Module, BucketingModule
         if not isinstance(X, io.DataIter):
             X = io.NDArrayIter(X, y, batch_size=min(self.numpy_batch_size,
                                                     _num_samples(X)),
                                shuffle=True)
-        self._module = Module(self.symbol,
-                              data_names=[d[0] for d in X.provide_data],
-                              label_names=[l[0] for l in X.provide_label],
-                              context=self.ctx)
+        data_names = [d[0] for d in X.provide_data]
+        label_names = [l[0] for l in X.provide_label]
+        if self._sym_gen is not None:
+            # reference model.py:797-798: the resolved default-bucket
+            # symbol is kept for save()/checkpointing (widest bucket,
+            # rnn/rnn.py's convention); the cache both dedups the
+            # resolve here with BucketingModule.bind's and speeds
+            # per-bucket switches
+            gen, cache = self._sym_gen, {}
+
+            def _gen(key):
+                if key not in cache:
+                    cache[key] = gen(key)
+                return cache[key], data_names, label_names
+
+            self._module = BucketingModule(
+                _gen, default_bucket_key=X.default_bucket_key,
+                context=self.ctx)
+            self.symbol = _gen(X.default_bucket_key)[0]
+        else:
+            self._module = Module(self.symbol,
+                                  data_names=data_names,
+                                  label_names=label_names,
+                                  context=self.ctx)
         self._module.fit(X, eval_data=eval_data, eval_metric=eval_metric,
                          kvstore=kvstore, initializer=self.initializer,
                          arg_params=self.arg_params, aux_params=self.aux_params,

@@ -14,11 +14,26 @@ from ..base import np_dtype
 from .registry import register, register_alias
 
 
-@register('Reshape', param_defaults={'shape': (), 'reverse': False})
+@register('Reshape', param_defaults={'shape': (), 'reverse': False,
+                                     'target_shape': (), 'keep_highest': False})
 def _reshape(attrs, x):
     """Reference matrix_op.cc Reshape incl. special codes 0,-1,-2,-3,-4
-    (matrix_op-inl.h InferReshapeShape)."""
-    target = list(attrs['shape'])
+    (matrix_op-inl.h InferReshapeShape) and the deprecated legacy
+    ``target_shape``/``keep_highest`` params (matrix_op-inl.h:159-182:
+    0 = the one inferred dim, keep_highest pins dim0 to the input's)
+    that 2017-era scripts like bi-lstm-sort's lstm.py:117 still use."""
+    target = list(attrs.get('shape') or ())
+    legacy = list(attrs.get('target_shape') or ())
+    if not target and legacy:
+        out = list(legacy)
+        keep = attrs.get('keep_highest', False)
+        if keep:
+            out[0] = x.shape[0]
+        start = 1 if keep else 0
+        inferred = [i for i in range(start, len(out)) if out[i] == 0]
+        if len(inferred) == 1:
+            out[inferred[0]] = -1      # jnp.reshape infers the open dim
+        return jnp.reshape(x, tuple(out))
     if attrs.get('reverse', False):
         # reverse semantics: match trailing dims first
         src = list(x.shape)[::-1]

@@ -1193,3 +1193,26 @@ def test_deconvolution_bf16_backward():
     assert str(x.grad.dtype) == 'bfloat16'
     assert x.grad.shape == x.shape and w.grad.shape == w.shape
     assert float(nd.sum(nd.abs(w.grad)).asnumpy()) > 0
+
+
+def test_reshape_legacy_target_shape():
+    """Deprecated Reshape(target_shape=, keep_highest=) params
+    (matrix_op-inl.h:159-182): 0 marks the one inferred dim;
+    keep_highest pins dim0 to the input's. 2017-era scripts
+    (bi-lstm-sort lstm.py:117) still use them."""
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+    # (0,) -> fully flattened
+    flat = nd.Reshape(x, target_shape=(0,))
+    assert flat.shape == (24,)
+    np.testing.assert_allclose(flat.asnumpy(), np.arange(24))
+    # explicit dims with one inferred
+    r = nd.Reshape(x, target_shape=(6, 0))
+    assert r.shape == (6, 4)
+    # keep_highest: dim0 from input, trailing inferred
+    k = nd.Reshape(x, target_shape=(7, 0), keep_highest=True)
+    assert k.shape == (2, 12)
+    # symbolic path: shape inference must agree
+    s = mx.sym.Variable('a')
+    out = mx.sym.Reshape(s, target_shape=(0,))
+    _, oshape, _ = out.infer_shape(a=(2, 3, 4))
+    assert tuple(oshape[0]) == (24,)
