@@ -187,6 +187,9 @@ class FeedForward:
                          arg_params=self.arg_params, aux_params=self.aux_params,
                          optimizer=self.optimizer, optimizer_params=self.kwargs,
                          begin_epoch=self.begin_epoch, num_epoch=self.num_epoch,
+                         monitor=monitor,
+                         eval_end_callback=eval_end_callback,
+                         eval_batch_end_callback=eval_batch_end_callback,
                          batch_end_callback=batch_end_callback,
                          epoch_end_callback=epoch_end_callback)
         self.arg_params, self.aux_params = self._module.get_params()

@@ -35,6 +35,7 @@ class BucketingModule(BaseModule):
         self._curr_module = None
         self._curr_bucket_key = None
         self._params_dirty = False
+        self._monitor = None
 
     def _reset_bind(self):
         self.binded = False
@@ -172,6 +173,8 @@ class BucketingModule(BaseModule):
                         self._curr_module.inputs_need_grad,
                         force_rebind=False,
                         shared_module=self._buckets[self._default_bucket_key])
+            if self._monitor is not None:
+                module.install_monitor(self._monitor)
             self._buckets[bucket_key] = module
         self._curr_module = self._buckets[bucket_key]
         self._curr_bucket_key = bucket_key
@@ -236,7 +239,10 @@ class BucketingModule(BaseModule):
         self.optimizer_initialized = True
 
     def install_monitor(self, mon):
+        """Reference bucketing_module.py:505-510: the monitor is saved so
+        switch_bucket can install it on lazily-created bucket modules."""
         assert self.binded
+        self._monitor = mon
         for mod in self._buckets.values():
             mod.install_monitor(mon)
 

@@ -18,6 +18,12 @@ def _axis(attrs):
     return int(ax)
 
 
+def _scalar1(out):
+    """Full reductions yield a (1,) scalar array, not 0-d
+    (broadcast_reduce_op.h:148 Shape1(1)); scripts index reduce(x)[0]."""
+    return out.reshape(1) if out.ndim == 0 else out
+
+
 def _r(name, f, differentiable=True, aliases=()):
     @register(name, param_defaults={'axis': None, 'keepdims': False,
                                     'exclude': False},
@@ -28,7 +34,8 @@ def _r(name, f, differentiable=True, aliases=()):
             axes = (ax,) if isinstance(ax, int) else ax
             ax = tuple(i for i in range(x.ndim) if i not in
                        tuple(a % x.ndim for a in axes))
-        return _f(x, axis=ax, keepdims=bool(attrs.get('keepdims', False)))
+        return _scalar1(
+            _f(x, axis=ax, keepdims=bool(attrs.get('keepdims', False))))
     for a in aliases:
         register_alias(a, name)
     return op
@@ -48,9 +55,12 @@ def _norm(attrs, x):
     ax = _axis(attrs)
     ordv = attrs.get('ord', 2)
     if ordv == 1:
-        return jnp.sum(jnp.abs(x), axis=ax, keepdims=bool(attrs.get('keepdims', False)))
-    return jnp.sqrt(jnp.sum(jnp.square(x), axis=ax,
-                            keepdims=bool(attrs.get('keepdims', False))))
+        out = jnp.sum(jnp.abs(x), axis=ax,
+                      keepdims=bool(attrs.get('keepdims', False)))
+    else:
+        out = jnp.sqrt(jnp.sum(jnp.square(x), axis=ax,
+                               keepdims=bool(attrs.get('keepdims', False))))
+    return _scalar1(out)
 
 
 def _arg(name, f):

@@ -418,3 +418,61 @@ def test_bi_lstm_sort_unmodified(tmp_path):
     preds = [l.strip() for l in proc.stdout.strip().splitlines()[-5:]]
     vocab = {str(x) for x in range(100, 140)} | {'<eos>'}
     assert len(preds) == 5 and all(p in vocab for p in preds), preds
+
+
+def test_monitor_weights_unmodified(tmp_path):
+    """example/python-howto/monitor_weights.py — FeedForward with a
+    Monitor(100, norm_stat) installed through fit(monitor=...): per-op
+    output stats AND regex-matched weight arrays logged every interval
+    (reference monitor.py:143 protocol, norm stat via mx.nd.norm)."""
+    _write_idx(str(tmp_path / 'data'), train_n=4096, test_n=1024, gz=False)
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'python-howto', 'monitor_weights.py'),
+        [], cwd=str(tmp_path), timeout=1200)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert float(accs[-1]) > 0.9, out[-4000:]
+    # monitor rows: every interval, outputs + weights with the stat value
+    # (NDArray str leads with a newline, so the value is on the next line)
+    rows = re.findall(r'Batch:\s+\d+ (fc\d_(?:output|weight|bias))', out)
+    assert {'fc1_output', 'fc1_weight', 'fc3_bias'} <= set(rows), \
+        sorted(set(rows))
+
+
+# sklearn removed fetch_mldata in 0.20 AND mldata.org itself is defunct
+# — even a period-correct sklearn cannot fetch this dataset anymore. The
+# shim is data provisioning (same role as the pre-seeded data/ dirs
+# above), returning the synthetic MNIST distribution as the Bunch shape
+# the 2017 API produced; the script body runs untouched.
+_FETCH_MLDATA_SRC = """
+import sklearn.datasets as _skd
+def _fetch_mldata(name, data_home=None):
+    from mxnet_tpu.io import synthetic_mnist
+    import numpy as _n
+    images, labels = synthetic_mnist(70000, seed=3)
+    class Bunch: pass
+    b = Bunch()
+    b.data = (images.reshape(70000, 784) * 255).astype(_n.float64)
+    b.target = labels.astype(_n.float64)
+    return b
+_skd.fetch_mldata = _fetch_mldata
+"""
+# the preamble is spliced into a one-line -c string, so wrap in exec()
+_FETCH_MLDATA_SHIM = 'exec(%r);' % _FETCH_MLDATA_SRC
+
+
+def test_svm_mnist_unmodified(tmp_path):
+    """example/svm_mnist/svm_mnist.py — the L2-SVM objective
+    (SVMOutput) trained through Module.fit on PCA-reduced noisy MNIST:
+    convergence-gates the SVMOutput gradient end-to-end."""
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'svm_mnist', 'svm_mnist.py'),
+        [], cwd=str(tmp_path), timeout=1800,
+        extra_preamble=_FETCH_MLDATA_SHIM)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    accs = re.findall(r'Validation-accuracy=([0-9.]+)', out)
+    assert accs, out[-4000:]
+    assert float(accs[-1]) > 0.9, out[-4000:]

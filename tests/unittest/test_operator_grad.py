@@ -205,7 +205,7 @@ def test_layer_ops_numeric_grad():
                   args_grad={'emb_w': mx.nd.zeros((6, 3))},
                   grad_req={'data': 'null', 'emb_w': 'write'})
     ex.forward(is_train=True)
-    ex.backward(mx.nd.ones(()))
+    ex.backward(mx.nd.ones((1,)))  # full-reduce sum outputs (1,), Shape1(1)
     g = ex.grad_dict['emb_w'].asnumpy()
     want = np.zeros((6, 3))
     for idx in [1, 4, 2, 5]:
