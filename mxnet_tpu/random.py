@@ -12,7 +12,9 @@ import threading
 import jax
 import numpy as _np
 
-__all__ = ['seed', 'next_key', 'host_rng', 'host_pyrng']
+__all__ = ['seed', 'next_key', 'host_rng', 'host_pyrng',
+           'uniform', 'normal', 'gamma', 'exponential', 'poisson',
+           'negative_binomial', 'generalized_negative_binomial']
 
 _lock = threading.Lock()
 # lazy: creating a key initializes the jax backend, which must not happen
@@ -77,3 +79,27 @@ def next_key():
                 else _host_rng.randint(0, 2**31 - 1))
         _key, sub = jax.random.split(_key)
         return sub
+
+
+def _sampler(op_name):
+    # reference random.py:25-31 re-exports the sampling ops at module
+    # level (uniform/normal/... — in 0.11 these are the scalar-param
+    # SampleUniformParam family); resolved lazily so importing
+    # mx.random never forces the op registry/backend up
+    def fn(*args, **kwargs):
+        from . import ndarray as _nd
+        return getattr(_nd, op_name)(*args, **kwargs)
+    fn.__name__ = op_name
+    fn.__doc__ = ('mx.random.%s — alias of nd.%s (reference '
+                  'random.py:25-31)' % (op_name, op_name))
+    return fn
+
+
+uniform = _sampler('uniform')
+normal = _sampler('normal')
+gamma = _sampler('random_gamma')
+exponential = _sampler('random_exponential')
+poisson = _sampler('random_poisson')
+negative_binomial = _sampler('random_negative_binomial')
+generalized_negative_binomial = _sampler(
+    'random_generalized_negative_binomial')

@@ -511,3 +511,24 @@ def test_rnn_time_major_unmodified(tmp_path):
     # chance is ~25 (24 tokens + pad); the fused-RNN LM must beat it
     # and keep improving across the two epochs
     assert ppls[-1] < 23 and ppls[-1] < ppls[0], ppls
+
+
+def test_profiler_executor_unmodified(tmp_path):
+    """example/profiler/profiler_executor.py — the profiler example:
+    profiler_set_config('symbolic') + set_state around a Module
+    forward/backward/update loop (ccsgd optimizer, random-batch drive
+    via mx.random.uniform — reference random.py:25's module-level
+    sampler aliases), dump-at-exit profile artifact. The time.clock
+    preamble restores the pre-3.8 stdlib API (environment-era shim)."""
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'profiler', 'profiler_executor.py'),
+        [], cwd=str(tmp_path), timeout=900,
+        extra_preamble="import time; time.clock = time.process_time;")
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert re.search(r'executor [0-9.]+ ms / iteration', out), out[-2000:]
+    prof = tmp_path / 'profile_executor_5iter.json'
+    assert prof.exists(), out[-2000:]
+    import json as _json
+    events = _json.load(open(str(prof)))['traceEvents']
+    assert events, 'profile dumped but empty'
