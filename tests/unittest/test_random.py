@@ -151,3 +151,23 @@ def test_env_seed_matches_explicit_seed():
               body.format(pre='import warnings;'
                           'warnings.simplefilter("ignore");', seed=''))
     assert bad.startswith('OUT')
+
+
+def test_module_level_samplers():
+    """Reference random.py:25-31 re-exports the sampling ops at module
+    level; scripts call mx.random.uniform(low, high, shape=..., ctx=...)
+    (example/profiler/profiler_executor.py:117)."""
+    u = mx.random.uniform(-1.0, 1.0, shape=(64,), ctx=mx.cpu())
+    a = u.asnumpy()
+    assert a.shape == (64,) and a.min() >= -1.0 and a.max() <= 1.0
+    n = mx.random.normal(0.0, 1.0, shape=(3, 4))
+    assert n.shape == (3, 4)
+    g = mx.random.gamma(2.0, 1.0, shape=(8,))
+    assert (g.asnumpy() > 0).all()
+    e = mx.random.exponential(1.0, shape=(8,))
+    assert (e.asnumpy() >= 0).all()
+    p = mx.random.poisson(3.0, shape=(8,))
+    assert (p.asnumpy() >= 0).all()
+    nb = mx.random.negative_binomial(2, 0.4, shape=(8,))
+    gnb = mx.random.generalized_negative_binomial(2.0, 0.3, shape=(8,))
+    assert nb.shape == (8,) and gnb.shape == (8,)
