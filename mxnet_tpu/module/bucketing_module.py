@@ -155,6 +155,10 @@ class BucketingModule(BaseModule):
         self._curr_module = module
         self._curr_bucket_key = self._default_bucket_key
         self._buckets[self._default_bucket_key] = module
+        if self._monitor is not None:
+            # a force_rebind recreates the default bucket; the saved
+            # monitor must follow it or default-key batches go silent
+            module.install_monitor(self._monitor)
 
         if self.params_initialized:
             self.set_params(self._arg_params, self._aux_params)
@@ -239,8 +243,11 @@ class BucketingModule(BaseModule):
         self.optimizer_initialized = True
 
     def install_monitor(self, mon):
-        """Reference bucketing_module.py:505-510: the monitor is saved so
-        switch_bucket can install it on lazily-created bucket modules."""
+        """Install on every live bucket AND save the monitor so
+        bind/switch_bucket install it on later-created bucket modules
+        (the reference's install_monitor, bucketing_module.py:496-500,
+        only covers already-created buckets — lazily-created ones went
+        silently unmonitored; fixed here rather than mirrored)."""
         assert self.binded
         self._monitor = mon
         for mod in self._buckets.values():

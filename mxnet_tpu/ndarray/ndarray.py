@@ -14,6 +14,7 @@ ThreadedVar dependency-queue machinery (threaded_engine.h:111-213) with no
 loss of semantics.
 """
 import functools
+import re
 
 import numpy as np
 
@@ -520,6 +521,16 @@ def invoke(op_name, inputs, attrs=None, out=None):
 def _invoke_impl(op_name, inputs, attrs=None, out=None):
     op = _reg.get(op_name)
     _reg.record(op)   # execution-based coverage gate (conftest)
+    # ctx is an op kwarg in the reference (SampleUniformParam etc. carry
+    # a ctx field): it directs placement, never reaches the kernel, and
+    # must not key the jit cache
+    req_ctx = None
+    if attrs and 'ctx' in attrs:
+        req_ctx = attrs.pop('ctx')
+        if req_ctx is not None and not isinstance(req_ctx, Context):
+            # string spelling 'cpu(0)' / 'gpu(1)' (the C-API kwarg form)
+            m = re.match(r'(\w+)\((\d+)\)', str(req_ctx))
+            req_ctx = Context(m.group(1), int(m.group(2))) if m else None
     attrs = normalize_attrs(attrs or {})
     if op.train_aware:
         attrs['__is_train__'] = _ag.is_training()
@@ -529,7 +540,7 @@ def _invoke_impl(op_name, inputs, attrs=None, out=None):
     if op.needs_rng:
         arrays.append(_random.next_key())
 
-    ctx = inputs[0]._ctx if inputs else current_context()
+    ctx = inputs[0]._ctx if inputs else (req_ctx or current_context())
 
     recording = _ag.is_recording() and op.differentiable and any(
         i._node is not None or i._leaf is not None for i in inputs)
@@ -564,6 +575,12 @@ def _invoke_impl(op_name, inputs, attrs=None, out=None):
     for in_idx, out_idx in op.mutate_inputs.items():
         if out_idx < len(outs_t):
             inputs[in_idx]._data = outs_t[out_idx]
+
+    if req_ctx is not None and not inputs:
+        # honor the requested device for source ops (zero-input
+        # samplers/initializers): data must live where _ctx says it does
+        dev = req_ctx.jax_device()
+        outs_t = tuple(jax.device_put(o, dev) for o in outs_t)
 
     n_vis = op.n_visible_outputs(attrs)
     results = []

@@ -91,9 +91,18 @@ def make_nd_function(op_name):
         pos_inputs = [a for a in args if isinstance(a, NDArray)]
         # scalar positional args map onto declared params in order
         # (matches the generated-signature convention of ndarray/op.py);
-        # a positional None is an omitted optional input, not a param
-        pos_attrs = [a for a in args
-                     if not isinstance(a, NDArray) and a is not None]
+        # a positional None is an omitted optional input, not a param.
+        # A positional Context is the ctx kwarg (samplers' generated
+        # signature ends ...shape, ctx, dtype), never a scalar param
+        from ..context import Context as _Ctx
+        pos_attrs = []
+        for a in args:
+            if isinstance(a, (NDArray, type(None))):
+                continue
+            if isinstance(a, _Ctx):
+                kwargs.setdefault('ctx', a)
+            else:
+                pos_attrs.append(a)
         if pos_attrs:
             for pname in op.param_defaults:
                 if not pos_attrs:

@@ -19,7 +19,9 @@ def _shape(attrs):
     s = attrs.get('shape', ())
     if isinstance(s, int):
         return (s,)
-    return tuple(s) if s else ()
+    # omitted shape draws ONE sample as a (1,) array, not 0-d
+    # (sample_op.h: TShape() default -> Shape1(1)); scripts index [0]
+    return tuple(s) if s else (1,)
 
 
 def _dt(attrs):

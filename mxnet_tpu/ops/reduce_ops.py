@@ -72,11 +72,12 @@ def _arg(name, f):
             res = _f(x.ravel(), axis=0)
             if attrs.get('keepdims', False):
                 res = res.reshape((1,) * x.ndim)
-            return res.astype(jnp.float32)
+            # ReduceAxisShapeImpl: global argmax/argmin is Shape1(1)
+            return _scalar1(res.astype(jnp.float32))
         res = _f(x, axis=int(ax))
         if attrs.get('keepdims', False):
             res = jnp.expand_dims(res, int(ax))
-        return res.astype(jnp.float32)
+        return _scalar1(res.astype(jnp.float32))
     return op
 
 

@@ -22,6 +22,7 @@ __all__ = ['profiler_set_config', 'profiler_set_state', 'dump_profile',
 
 _state = {'mode': 'symbolic', 'filename': 'profile.json', 'running': False,
           'events': [], 'jax_dir': None, 'ran': False, 'dumped': False}
+_written = set()   # profile paths THIS process wrote (merge on re-dump)
 _lock = threading.Lock()
 
 
@@ -44,10 +45,13 @@ def _xla_trace_allowed():
 def _atexit_dump():
     """Reference initialize.cc:57-67 — the profile is written at process
     exit even when the script never calls dump_profile (the example
-    scripts rely on this). A dump the user already made is not clobbered."""
+    scripts rely on this). Events recorded AFTER a mid-run user dump are
+    flushed too: dump_profile merges into a file this process already
+    wrote, so a periodic-dump pattern loses nothing and an
+    already-complete dump is simply rewritten unchanged."""
     if _state['running']:
         profiler_set_state('stop')
-    if _state['ran'] and not _state['dumped']:
+    if _state['ran'] and (_state['events'] or not _state['dumped']):
         try:
             dump_profile()
         except Exception:
@@ -170,8 +174,18 @@ def dump_profile():
                     events.extend(json.load(f).get('traceEvents', []))
         finally:
             os.unlink(path)
-    with open(_state['filename'], 'w') as f:
+    path = _state['filename']
+    if path in _written and os.path.exists(path):
+        # repeated dumps in one process accumulate (each drain appears
+        # exactly once): merge with what this process wrote before
+        try:
+            with open(path) as f:
+                events = json.load(f).get('traceEvents', []) + events
+        except Exception:
+            pass
+    with open(path, 'w') as f:
         json.dump({'traceEvents': events, 'displayTimeUnit': 'ms'}, f)
+    _written.add(path)
     _state['dumped'] = True
 
 

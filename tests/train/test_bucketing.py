@@ -86,3 +86,53 @@ def test_bucketing_lm_perplexity():
 
     # the bucketing machinery must have bound one executor per bucket
     assert len(getattr(model, '_buckets', {})) >= 2 or True
+
+
+def test_monitor_survives_rebind_and_new_buckets():
+    """install_monitor must follow lazily-created bucket modules AND a
+    force_rebind-recreated default bucket — the monitor is saved on the
+    BucketingModule, not only fanned out to live buckets."""
+    import numpy as np
+
+    def sym_gen(L):
+        # param shapes must not depend on the bucket key (shared master
+        # weights): embed + time-sum + FC
+        data = mx.sym.Variable('data')
+        label = mx.sym.Variable('softmax_label')
+        emb = mx.sym.Embedding(data, input_dim=10, output_dim=8,
+                               name='embed')
+        pooled = mx.sym.sum(emb, axis=1)
+        fc = mx.sym.FullyConnected(pooled, num_hidden=8, name='fc')
+        return (mx.sym.SoftmaxOutput(fc, label, name='softmax'),
+                ('data',), ('softmax_label',))
+
+    model = mx.mod.BucketingModule(sym_gen=sym_gen, default_bucket_key=6,
+                                   context=mx.cpu())
+    dshape = [('data', (4, 6))]
+    lshape = [('softmax_label', (4,))]
+    model.bind(data_shapes=dshape, label_shapes=lshape)
+    model.init_params()
+
+    seen = []
+    mon = mx.mon.Monitor(1, lambda d: mx.nd.norm(d) / np.sqrt(d.size))
+    model.install_monitor(mon)
+
+    def run_batch(key, width):
+        batch = mx.io.DataBatch(
+            [mx.nd.array(np.random.randint(0, 10, size=(4, width)).astype("float32"))],
+            [mx.nd.array(np.zeros(4))], bucket_key=key,
+            provide_data=[('data', (4, width))],
+            provide_label=[('softmax_label', (4,))])
+        mon.tic()
+        model.forward(batch, is_train=True)
+        rows = mon.toc()
+        seen.append([r[1] for r in rows])
+        return rows
+
+    assert run_batch(6, 6), 'default bucket unmonitored'
+    assert run_batch(4, 4), 'lazily-created bucket unmonitored'
+    # force_rebind recreates the default bucket: the SAVED monitor must
+    # follow it without a fresh install_monitor call
+    model.bind(data_shapes=dshape, label_shapes=lshape, force_rebind=True)
+    model.init_params(force_init=True)
+    assert run_batch(6, 6), 'default bucket unmonitored after rebind'
