@@ -532,3 +532,19 @@ def test_profiler_executor_unmodified(tmp_path):
     import json as _json
     events = _json.load(open(str(prof)))['traceEvents']
     assert events, 'profile dumped but empty'
+
+
+def test_debug_conv_unmodified(tmp_path):
+    """example/python-howto/debug_conv.py — executor-group internals as
+    a user surface: mod._exec_group.install_monitor(mon), forward with
+    a duck-typed batch (an object exposing only .data), default Monitor
+    stat. Prints the 1x1x5x5 conv output."""
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'python-howto', 'debug_conv.py'),
+        [], cwd=str(tmp_path), timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    # a 4-D numpy print: four opening brackets then 5 rows of 5 floats
+    assert re.search(r'\[\[\[\[', proc.stdout), out[-2000:]
+    rows = re.findall(r'\[\s*-?\d+\.\d+', proc.stdout)
+    assert len(rows) >= 5, proc.stdout[-2000:]
