@@ -325,7 +325,15 @@ class FusedRNNCell(BaseRNNCell):
         self._get_next_state = get_next_state
         self._forget_bias = forget_bias
         self._directions = 2 if bidirectional else 1
-        self._parameter = self.params.get('parameters')
+        # the flat parameter vector carries its own initializer as the
+        # variable's __init__ attr (reference rnn_cell.py:578-580): a
+        # global Xavier cannot init a 1-D vector, and the gate/bias
+        # layout needs init.FusedRNN's unpack-init-repack
+        from .. import initializer as _init
+        self._parameter = self.params.get(
+            'parameters', init=_init.FusedRNN(
+                None, num_hidden, num_layers, mode,
+                bidirectional=bidirectional, forget_bias=forget_bias))
 
     @property
     def state_info(self):

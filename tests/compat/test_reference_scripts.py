@@ -548,3 +548,44 @@ def test_debug_conv_unmodified(tmp_path):
     assert re.search(r'\[\[\[\[', proc.stdout), out[-2000:]
     rows = re.findall(r'\[\s*-?\d+\.\d+', proc.stdout)
     assert len(rows) >= 5, proc.stdout[-2000:]
+
+
+def _write_markov_ptb(dirpath, nvocab=24, seed_train=0, seed_test=1):
+    """PTB-shaped text with first-order Markov structure (one shared
+    chain; samples differ) so a perplexity gate has something to learn."""
+    os.makedirs(dirpath, exist_ok=True)
+    trans = np.random.RandomState(42).dirichlet(np.ones(nvocab) * 0.05,
+                                                size=nvocab)
+    words = ['w%d' % i for i in range(nvocab)]
+    for name, n, seed in (('ptb.train.txt', 2000, seed_train),
+                          ('ptb.test.txt', 600, seed_test)):
+        r = np.random.RandomState(seed)
+        with open(os.path.join(dirpath, name), 'w') as f:
+            for _ in range(n):
+                L = r.randint(5, 45)
+                s = [r.randint(nvocab)]
+                for _ in range(L - 1):
+                    s.append(int(r.choice(nvocab, p=trans[s[-1]])))
+                f.write(' '.join(words[i] for i in s) + '\n')
+
+
+def test_cudnn_lstm_bucketing_unmodified(tmp_path):
+    """example/rnn/cudnn_lstm_bucketing.py — FusedRNNCell (the cuDNN
+    fused-kernel cell) through mx.rnn.encode_sentences +
+    BucketSentenceIter(layout='TN') + BucketingModule.fit. Exercises
+    the init.FusedRNN attachment (the flat parameter vector carries its
+    own initializer as the variable __init__ attr; a global Xavier
+    cannot init a 1-D vector). Perplexity-gated on Markov data: must
+    end decisively below the ~24 uniform bound."""
+    _write_markov_ptb(str(tmp_path / 'data'))
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'rnn', 'cudnn_lstm_bucketing.py'),
+        ['--num-epochs', '3', '--num-hidden', '64', '--num-embed', '64',
+         '--batch-size', '32', '--disp-batches', '20', '--lr', '0.05'],
+        cwd=str(tmp_path), timeout=1200)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    ppls = [float(p) for p in
+            re.findall(r'Validation-perplexity=([0-9.]+)', out)]
+    assert len(ppls) == 3, out[-4000:]
+    assert ppls[-1] < 20 and ppls[-1] < ppls[0], ppls
