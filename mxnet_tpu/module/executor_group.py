@@ -29,14 +29,31 @@ __all__ = ['DataParallelExecutorGroup', 'SPMDExecutorGroup']
 
 
 def _load_general(data, targets, major_axis):
-    """Load a list of batch arrays into per-device slices (reference :33)."""
-    for d_src, d_targets in zip(data, targets):
+    """Load a list of batch arrays into per-device slices (reference :33).
+
+    The device slice runs along each entry's BATCH axis (major_axis,
+    from the DataDesc layout) — slicing axis 0 unconditionally
+    truncated time-major 'TN' batches along TIME whenever T exceeded
+    the batch size (and silently no-op'd when T <= batch, python
+    slicing being clamped)."""
+    for d_src, d_targets, axis in zip(data, targets, major_axis):
         if isinstance(d_targets, nd.NDArray):
             d_src.copyto(d_targets)
-        else:
-            for slice_idx, d_dst in d_targets:
-                d_src_np = d_src.asnumpy()[slice_idx.start:slice_idx.stop]
-                d_dst._data = nd.array(d_src_np, ctx=d_dst.context)._data
+            continue
+        src_np = d_src.asnumpy()
+        for slice_idx, d_dst in d_targets:
+            if axis >= 0:
+                idx = [slice(None)] * src_np.ndim
+                idx[axis] = slice(slice_idx.start, slice_idx.stop)
+                part = src_np[tuple(idx)]
+            else:
+                part = src_np
+            if tuple(part.shape) != tuple(d_dst.shape):
+                raise ValueError(
+                    'batch slice has shape %s but the bound buffer is %s '
+                    '(batch axis %d)' % (part.shape, tuple(d_dst.shape),
+                                         axis))
+            d_dst._data = nd.array(part, ctx=d_dst.context)._data
 
 
 def _merge_multi_context(outputs, major_axis):
@@ -253,14 +270,21 @@ class DataParallelExecutorGroup:
             exec_.backward(out_grads=out_grads_slice)
 
     def update_metric(self, eval_metric, labels):
+        axes = self.label_layouts if self.label_layouts is not None \
+            else [0] * len(labels)
         for texec, islice in zip(self.execs, self.slices):
             labels_slice = []
-            for label in labels:
-                if islice.stop - islice.start == label.shape[0]:
+            for label, axis in zip(labels, axes):
+                # slice along the label's BATCH axis (TN layouts carry
+                # the batch on axis 1, reference executor_group.py:549)
+                if axis < 0 or \
+                        islice.stop - islice.start == label.shape[axis]:
                     labels_slice.append(label)
                 else:
+                    idx = [slice(None)] * len(label.shape)
+                    idx[axis] = islice
                     labels_slice.append(
-                        nd.array(label.asnumpy()[islice]))
+                        nd.array(label.asnumpy()[tuple(idx)]))
             eval_metric.update(labels_slice, texec.outputs)
 
     def install_monitor(self, mon):

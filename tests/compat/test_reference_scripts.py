@@ -589,3 +589,23 @@ def test_cudnn_lstm_bucketing_unmodified(tmp_path):
             re.findall(r'Validation-perplexity=([0-9.]+)', out)]
     assert len(ppls) == 3, out[-4000:]
     assert ppls[-1] < 20 and ppls[-1] < ppls[0], ppls
+
+
+def test_cudnn_lstm_bucketing_stack_rnn_unmodified(tmp_path):
+    """--stack-rnn: SequentialRNNCell of single-layer FusedRNNCells with
+    a DropoutCell between. This configuration's SliceChannel graph is
+    NOT shape-polymorphic, which is how it exposed the time-major
+    batch-truncation bug (_load_general slicing axis 0 on 'TN' data)."""
+    _write_markov_ptb(str(tmp_path / 'data'))
+    proc = _run_reference_script(
+        os.path.join(REF_EXAMPLE, 'rnn', 'cudnn_lstm_bucketing.py'),
+        ['--num-epochs', '3', '--num-hidden', '64', '--num-embed', '64',
+         '--batch-size', '32', '--stack-rnn', '1', '--dropout', '0.1',
+         '--lr', '0.05'],
+        cwd=str(tmp_path), timeout=1500)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    ppls = [float(p) for p in
+            re.findall(r'Validation-perplexity=([0-9.]+)', out)]
+    assert len(ppls) == 3, out[-4000:]
+    assert ppls[-1] < 20 and ppls[-1] < ppls[0], ppls

@@ -182,3 +182,37 @@ class TestPythonModule:
         mod.init_params()
         assert mod.params_initialized
         assert mod.get_params() == ({}, {})
+
+
+def test_time_major_batch_loading_full_length():
+    """Regression: _load_general/update_metric must slice along the
+    DataDesc layout's batch axis. With 'TN' data and T > batch_size the
+    old axis-0 slice truncated every sequence to batch_size timesteps —
+    silently, because shape-polymorphic graphs still compiled."""
+    import numpy as np
+    from mxnet_tpu.io import DataDesc
+
+    T, N = 40, 8
+    data = mx.sym.Variable('data')
+    # mean over time then FC: output depends on ALL timesteps
+    pooled = mx.sym.mean(data, axis=0)
+    fc = mx.sym.FullyConnected(pooled, num_hidden=3, name='fc')
+    out = mx.sym.SoftmaxOutput(fc, mx.sym.Variable('softmax_label'),
+                               name='softmax')
+
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[DataDesc('data', (T, N), layout='TN')],
+             label_shapes=[DataDesc('softmax_label', (N,), layout='N')])
+    mod.init_params()
+
+    x = np.zeros((T, N), dtype=np.float32)
+    x[N:] = 7.0    # signal lives PAST the first batch_size timesteps
+    batch = mx.io.DataBatch(
+        [mx.nd.array(x)], [mx.nd.array(np.zeros(N))],
+        provide_data=[DataDesc('data', (T, N), layout='TN')],
+        provide_label=[DataDesc('softmax_label', (N,), layout='N')])
+    mod.forward(batch, is_train=False)
+    # the bound buffer must hold the FULL (T, N) batch, tail included
+    loaded = mod._exec_group.execs[0].arg_dict['data'].asnumpy()
+    assert loaded.shape == (T, N), loaded.shape
+    np.testing.assert_allclose(loaded, x)
