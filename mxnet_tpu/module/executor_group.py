@@ -114,6 +114,18 @@ class DataParallelExecutorGroup:
         assert len(data_shapes) > 0
         major_axis = [DataDesc.get_batch_axis(getattr(d, 'layout', 'NCHW'))
                       for d in data_shapes]
+        if len(self.contexts) > 1 and any(a > 0 for a in major_axis):
+            # inputs/labels now slice along the layout axis, but this
+            # group's OUTPUT merge and head-grad slicing assume batch
+            # axis 0 — fail loudly rather than interleave time across
+            # devices. The SPMD group (homogeneous contexts, even batch)
+            # handles non-zero batch axes.
+            raise NotImplementedError(
+                'multi-device per-context execution with a non-leading '
+                'batch axis (layouts %s) is not supported; use equal '
+                'workloads so the SPMD group handles it, or batch-major '
+                'layouts' % [getattr(d, 'layout', 'NCHW')
+                             for d in data_shapes])
         for (name, shape), axis in zip(
                 [(d.name, d.shape) if isinstance(d, DataDesc) else d
                  for d in data_shapes], major_axis):
@@ -347,13 +359,18 @@ class SPMDExecutorGroup:
 
         self.mesh = Mesh(np.array([c.jax_device() for c in contexts]),
                          ('dp',))
-        self._shard_data = NamedSharding(self.mesh, P('dp'))
         self._replicate = NamedSharding(self.mesh, P())
 
         self._data_names = [d.name if isinstance(d, DataDesc) else d[0]
                             for d in data_shapes]
         self._label_names = [] if not label_shapes else \
             [d.name if isinstance(d, DataDesc) else d[0] for d in label_shapes]
+        # dp shards each input along ITS batch axis (a 'TN' layout puts
+        # the batch on axis 1; sharding axis 0 would split time)
+        self._batch_axes = {
+            (d.name if isinstance(d, DataDesc) else d[0]):
+            DataDesc.get_batch_axis(getattr(d, 'layout', 'NCHW'))
+            for d in list(data_shapes) + list(label_shapes or [])}
 
         if grad_req != 'null' and for_training:
             self.grad_req = {}
@@ -382,7 +399,11 @@ class SPMDExecutorGroup:
             shapes.update({(d.name if isinstance(d, DataDesc) else d[0]):
                            (d.shape if isinstance(d, DataDesc) else d[1])
                            for d in label_shapes})
-        self.batch_size = next(iter(shapes.values()))[0]
+        first = data_shapes[0]
+        first_axis = max(self._batch_axes.get(
+            first.name if isinstance(first, DataDesc) else first[0], 0), 0)
+        self.batch_size = (first.shape if isinstance(first, DataDesc)
+                           else first[1])[first_axis]
         exec_ = self.symbol.simple_bind(self.contexts[0],
                                         grad_req=self.grad_req, **shapes)
         self.execs = [exec_]
@@ -425,18 +446,26 @@ class SPMDExecutorGroup:
         for arr in e.aux_dict.values():
             arr._data = jax.device_put(arr._data, self._replicate)
 
+    def _shard_for(self, name, ndim):
+        axis = self._batch_axes.get(name, 0)
+        if axis < 0 or axis >= ndim:
+            return self._replicate
+        spec = [None] * ndim
+        spec[axis] = 'dp'
+        return NamedSharding(self.mesh, P(*spec))
+
     # -- step ------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
         e = self.execs[0]
         if is_train is None:
             is_train = self.for_training
         for name, src in zip(self._data_names, data_batch.data):
-            e.arg_dict[name]._data = jax.device_put(src._data,
-                                                    self._shard_data)
+            e.arg_dict[name]._data = jax.device_put(
+                src._data, self._shard_for(name, src._data.ndim))
         if self._label_names and data_batch.label:
             for name, src in zip(self._label_names, data_batch.label):
-                e.arg_dict[name]._data = jax.device_put(src._data,
-                                                        self._shard_data)
+                e.arg_dict[name]._data = jax.device_put(
+                    src._data, self._shard_for(name, src._data.ndim))
         self._place_replicated()
         e.forward(is_train=is_train)
 

@@ -10,6 +10,7 @@ import warnings
 from .. import context as ctx_mod
 from .. import ndarray as nd
 from .. import optimizer as opt
+from ..io import DataDesc
 from ..initializer import Uniform, InitDesc
 from ..model import (_create_kvstore, _initialize_kvstore, _update_params,
                      _update_params_on_kvstore, load_checkpoint,
@@ -213,7 +214,10 @@ class Module(BaseModule):
         # homogeneous multi-device lists lower to ONE GSPMD computation
         # over a dp mesh (grad all-reduce compiled into the step); the
         # per-context loop remains for unequal workloads / odd batches
-        batch_axis_size = self._data_shapes[0].shape[0]
+        d0 = self._data_shapes[0]
+        batch_axis = max(DataDesc.get_batch_axis(
+            getattr(d0, 'layout', 'NCHW')), 0)
+        batch_axis_size = d0.shape[batch_axis]
         group_cls = SPMDExecutorGroup if SPMDExecutorGroup.eligible(
             self._context, self._work_load_list, batch_axis_size,
             self._symbol) else DataParallelExecutorGroup
@@ -326,15 +330,23 @@ class Module(BaseModule):
         curr_data_shapes = tuple(i.shape for i in self._data_shapes)
         new_data_shapes = tuple(i.shape for i in data_batch.data)
         if curr_data_shapes != new_data_shapes:
+            def _redesc(desc, shape):
+                # keep layout/dtype: losing 'TN' here would flip the
+                # batch axis back to 0 at the rebind
+                new = type(desc)(desc.name, shape,
+                                 layout=getattr(desc, 'layout', 'NCHW'))
+                if hasattr(desc, 'dtype'):
+                    new.dtype = desc.dtype
+                return new
             if hasattr(data_batch, 'provide_data') and data_batch.provide_data:
                 new_dshape = data_batch.provide_data
             else:
-                new_dshape = [type(i)(i.name, shape) for i, shape in
+                new_dshape = [_redesc(i, shape) for i, shape in
                               zip(self._data_shapes, new_data_shapes)]
             if hasattr(data_batch, 'provide_label') and data_batch.provide_label:
                 new_lshape = data_batch.provide_label
             elif hasattr(data_batch, 'label') and data_batch.label:
-                new_lshape = [type(i)(i.name, j.shape) for i, j in
+                new_lshape = [_redesc(i, j.shape) for i, j in
                               zip(self._label_shapes, data_batch.label)]
             else:
                 new_lshape = None

@@ -7,6 +7,7 @@ from the in-process registry.
 """
 import functools
 
+from ..context import Context as _Ctx
 from ..ops import registry as _reg
 from .ndarray import NDArray, invoke
 
@@ -94,7 +95,6 @@ def make_nd_function(op_name):
         # a positional None is an omitted optional input, not a param.
         # A positional Context is the ctx kwarg (samplers' generated
         # signature ends ...shape, ctx, dtype), never a scalar param
-        from ..context import Context as _Ctx
         pos_attrs = []
         for a in args:
             if isinstance(a, (NDArray, type(None))):

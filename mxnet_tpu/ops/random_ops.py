@@ -150,7 +150,11 @@ def _elemwise_sample(name, sampler, in_names):
     def op(attrs, *args):
         key = args[-1]
         params = args[:-1]
-        extra = _shape(attrs)
+        # unlike the zero-input _random_* family, an omitted shape here
+        # means NO extra trailing dims (multisample_op.h concatenates an
+        # empty sshape): sample_uniform((3,) low, (3,) high) -> (3,)
+        s = attrs.get('shape', ())
+        extra = ((s,) if isinstance(s, int) else tuple(s)) if s else ()
         out_shape = params[0].shape + extra
         bparams = [jnp.reshape(p, p.shape + (1,) * len(extra)) for p in params]
         return sampler(key, bparams, out_shape).astype(_dt(attrs))
