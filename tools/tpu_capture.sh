@@ -59,9 +59,27 @@ echo "== 7/9 training-table sweep (BASELINE train table cols 1-2) =="
 MXTPU_BENCH_MODEL=alexnet MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
   > "$OUT/bench_alexnet_$STAMP.json" 2> "$OUT/bench_alexnet_$STAMP.log"
 echo "rc=$?"; tail -1 "$OUT/bench_alexnet_$STAMP.json"
-MXTPU_BENCH_MODEL=inceptionv3 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
+grep -o "loss=[^,]*" "$OUT/bench_alexnet_$STAMP.log" | tail -1  # nan check!
+# spc=8: the spc=32 scan-chain warmup at 299px wedged the tunnel once
+MXTPU_BENCH_MODEL=inceptionv3 MXTPU_BENCH_STEPS_PER_CALL=8 \
+  MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
   > "$OUT/bench_inceptionv3_$STAMP.json" 2> "$OUT/bench_inceptionv3_$STAMP.log"
 echo "rc=$?"; tail -1 "$OUT/bench_inceptionv3_$STAMP.json"
+
+echo "== 7b/9 stem space-to-depth A/B (MXTPU_CONV_STEM_S2D; docs/perf.md) =="
+MXTPU_CONV_STEM_S2D=1 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
+  > "$OUT/bench_s2d_$STAMP.json" 2> "$OUT/bench_s2d_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_s2d_$STAMP.json"
+MXTPU_CONV_STEM_S2D=1 MXTPU_BENCH_MODEL=alexnet MXTPU_BENCH_BUDGET=600 \
+  timeout 900 python bench.py \
+  > "$OUT/bench_alexnet_s2d_$STAMP.json" 2> "$OUT/bench_alexnet_s2d_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_alexnet_s2d_$STAMP.json"
+MXTPU_CONV_STEM_S2D=1 MXTPU_BENCH_MODEL=inceptionv3 \
+  MXTPU_BENCH_STEPS_PER_CALL=8 MXTPU_BENCH_BUDGET=600 \
+  timeout 900 python bench.py \
+  > "$OUT/bench_inceptionv3_s2d_$STAMP.json" \
+  2> "$OUT/bench_inceptionv3_s2d_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/bench_inceptionv3_s2d_$STAMP.json"
 
 echo "== 8/9 memory-mirror A/B (BASELINE mirror table; inception-v3) =="
 MXTPU_BENCH_MODEL=inceptionv3 MXTPU_BACKWARD_DO_MIRROR=dots \
