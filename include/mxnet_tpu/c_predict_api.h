@@ -52,6 +52,14 @@ int MXPredGetOutputShape(PredictorHandle handle, mx_uint index,
 int MXPredSetInput(PredictorHandle handle, const char *key,
                    const mx_float *data, mx_uint size);
 int MXPredForward(PredictorHandle handle);
+
+/*!
+ * Interactive stepping forward for progress display on slow models
+ * (reference include/mxnet/c_predict_api.h:160-169): call from step=0
+ * and keep incrementing until *step_left == 0, at which point the
+ * outputs are complete. Each step executes exactly one operator node.
+ */
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left);
 int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
                     mx_uint size);
 int MXPredFree(PredictorHandle handle);

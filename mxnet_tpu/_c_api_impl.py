@@ -705,7 +705,10 @@ class _Predictor:
                 params[k.split(':', 1)[-1]] = v
         ctx = _ctx(dev_type, dev_id)
         shapes = dict(zip(input_keys, [tuple(s) for s in input_shapes]))
-        arg_shapes, _, aux_shapes = sym.infer_shape(**shapes)
+        arg_shapes, out_shapes, aux_shapes = sym.infer_shape(**shapes)
+        # static output shapes: lets MXPredGetOutputShape size buffers
+        # without forcing a forward (esp. mid partial_forward pass)
+        self._out_shapes = [tuple(int(d) for d in s) for s in out_shapes]
         arg_names = sym.list_arguments()
         aux_names = sym.list_auxiliary_states()
         self.input_keys = list(input_keys)
@@ -734,9 +737,13 @@ class _Predictor:
         self.executor.forward(is_train=False)
         return 0
 
+    def partial_forward(self, step):
+        """MXPredPartialForward (reference include/mxnet/c_predict_api.h:169):
+        one operator per call for progress display; returns steps left."""
+        return self.executor.partial_forward(False, int(step))
+
     def get_output_shape(self, index):
-        out = self.executor.outputs[int(index)]
-        return tuple(int(d) for d in out.shape)
+        return self._out_shapes[int(index)]
 
     def get_output(self, index):
         out = self.executor.outputs[int(index)]

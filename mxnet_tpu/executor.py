@@ -86,6 +86,8 @@ class _GraphProgram:
         # traced; their presence forces the staged per-op path
         self.has_host_ops = any(not n.is_variable() and n.opdef().host
                                 for n in self.topo)
+        self.op_nodes = [n for n in self.topo if not n.is_variable()]
+        self.topo_index = {n: i for i, n in enumerate(self.topo)}
 
     def make_runner(self):
         """Build run(arg_arrays, aux_arrays, key, is_train) ->
@@ -197,6 +199,7 @@ class Executor:
 
         self.outputs_cached = None
         self._pending = None  # (arg jax arrays, aux jax arrays, key) for lazy train fwd
+        self._partial = None  # partial_forward stepping state
 
     def _canon_args(self, args, names, what, allow_missing=False):
         if isinstance(args, dict):
@@ -229,6 +232,7 @@ class Executor:
                     self.arg_dict[k]._data = v._data
                 else:
                     self.arg_dict[k]._data = jnp.asarray(np.asarray(v))
+        self._partial = None  # a full forward invalidates any stepping pass
         if self._use_staged():
             return self._forward_staged(is_train)
 
@@ -246,6 +250,72 @@ class Executor:
             self._write_aux(new_aux)
         self.outputs_cached = [from_jax(o, self._ctx) for o in outs]
         return self.outputs_cached
+
+    def partial_forward(self, is_train, step):
+        """Interactive stepping forward: execute exactly one operator
+        node per call (the GraphExecutor::PartialForward role; the
+        stepping loop contract is documented at reference
+        include/mxnet/c_predict_api.h:160-169 — call from step=0,
+        increment until the return value hits 0).
+
+        Unlike :meth:`forward`, which dispatches one fused XLA program,
+        each step here eagerly dispatches a single operator so callers
+        can report progress on slow models; intermediate buffers
+        persist in an env dict between calls.  Variable values are
+        snapshotted into the env when a pass starts — inputs written
+        mid-pass take effect on the next pass (restart at step 0), not
+        on the remaining steps of the current one.  Restarting at step
+        0 (or jumping to an arbitrary step) rebuilds the env and
+        replays up to that node.  An abandoned pass keeps its env (and
+        the device buffers it holds) until the next full forward,
+        param copy, or restart releases it.  Returns the number of
+        steps left.
+        """
+        prog = self._prog
+        n_steps = len(prog.op_nodes)
+        step = int(step)
+        if n_steps == 0:
+            # variable-only graph: outputs are just current variables
+            env = {}
+            for node in prog.topo:
+                if node.is_variable():
+                    self._env_put_variable(node, env)
+            self.outputs_cached = [from_jax(env[_entry_key(n, i)], self._ctx)
+                                   for n, i in prog.outputs]
+            return 0
+        if step < 0 or step >= n_steps:
+            return 0
+        st = self._partial
+        if st is None or st['next'] != step:
+            env = {}
+            for node in prog.topo:
+                if node.is_variable():
+                    self._env_put_variable(node, env)
+            st = self._partial = {'env': env, 'next': 0,
+                                  'key': _random.next_key(), 'new_aux': {}}
+            lo = 0
+        else:
+            lo = step
+        for k in range(lo, step + 1):
+            node = prog.op_nodes[k]
+            # deterministic per-node stream: fold the stepping pass's
+            # base key by topo position, like the jitted runner does
+            rng_key = functools.partial(jax.random.fold_in, st['key'],
+                                        prog.topo_index[node])
+            self._exec_node(node, st['env'], is_train, rng_key,
+                            new_aux=st['new_aux'])
+        st['next'] = step + 1
+        left = n_steps - step - 1
+        if left == 0:
+            self._pending = None
+            self.outputs_cached = [from_jax(st['env'][_entry_key(n, i)],
+                                            self._ctx)
+                                   for n, i in prog.outputs]
+            if is_train:
+                for name, v in st['new_aux'].items():
+                    self.aux_dict[name]._data = v
+            self._partial = None
+        return left
 
     def _lazy_outputs(self):
         self._out_handles = [from_jax(None, self._ctx)
@@ -364,45 +434,66 @@ class Executor:
                 return self._group2ctx[grp].jax_device()
         return self._ctx.jax_device()
 
+    def _env_put_variable(self, node, env):
+        """Load a variable node's current value into an eager env."""
+        src = (self.aux_dict[node.name] if node.name in self.aux_dict
+               else self.arg_dict[node.name])
+        env[_entry_key(node, 0)] = jax.device_put(src._data,
+                                                  self._node_device(node))
+
+    def _exec_node(self, node, env, is_train, rng_key, new_aux=None):
+        """Eagerly execute one non-variable node into ``env``.
+
+        Shared per-node dispatch for the staged forward and the
+        partial_forward stepping path: group2ctx device placement,
+        host-op direct call, monitor callbacks, and mutate_inputs aux
+        collection (into ``new_aux`` keyed by aux name, if given) all
+        live here so the two eager paths cannot drift.
+        """
+        dev = self._node_device(node)
+        op = node.opdef()
+        _reg.record(op)
+        attrs = dict(node.attrs)
+        if op.train_aware:
+            attrs['__is_train__'] = bool(is_train)
+        ins = [jax.device_put(env[_entry_key(p, i)], dev)
+               for p, i in node.inputs]
+        if op.needs_rng:
+            ins.append(rng_key())
+        outs = op.fn(attrs, *ins)
+        if not isinstance(outs, (tuple, list)):
+            outs = (outs,)
+        for i, o in enumerate(outs):
+            env[_entry_key(node, i)] = o
+        if self._monitor is not None:
+            # reference entry naming: <node>_output / <node>_output<i>
+            # (what Monitor patterns like '.*output.*' match against)
+            nvis = op.n_visible_outputs(node.attrs)
+            for i in range(nvis):
+                self._monitor('%s_output' % node.name if nvis == 1 else
+                              '%s_output%d' % (node.name, i),
+                              from_jax(outs[i], self._ctx))
+        if new_aux is not None:
+            for in_idx, out_idx in op.mutate_inputs.items():
+                if in_idx < len(node.inputs):
+                    src, _ = node.inputs[in_idx]
+                    if src.is_variable() and src.name in self.aux_dict:
+                        new_aux[src.name] = outs[out_idx]
+        return outs
+
     def _forward_staged(self, is_train):
         env = {}
         prog = self._prog
-        aux_index = {n: i for i, n in enumerate(prog.aux_names)}
-        arg_index = {n: i for i, n in enumerate(prog.arg_names)}
-        for ni, node in enumerate(prog.topo):
-            dev = self._node_device(node)
+        new_aux = {} if is_train else None
+        for node in prog.topo:
             if node.is_variable():
-                src = (self.aux_arrays[aux_index[node.name]] if node.name in aux_index
-                       else self.arg_arrays[arg_index[node.name]])
-                env[_entry_key(node, 0)] = jax.device_put(src._data, dev)
-                continue
-            op = node.opdef()
-            _reg.record(op)
-            attrs = dict(node.attrs)
-            if op.train_aware:
-                attrs['__is_train__'] = bool(is_train)
-            ins = [jax.device_put(env[_entry_key(p, i)], dev)
-                   for p, i in node.inputs]
-            if op.needs_rng:
-                ins.append(_random.next_key())
-            outs = op.fn(attrs, *ins)
-            if not isinstance(outs, (tuple, list)):
-                outs = (outs,)
-            for i, o in enumerate(outs):
-                env[_entry_key(node, i)] = o
-            if self._monitor is not None:
-                # reference entry naming: <node>_output / <node>_output<i>
-                # (what Monitor patterns like '.*output.*' match against)
-                nvis = op.n_visible_outputs(node.attrs)
-                for i in range(nvis):
-                    self._monitor('%s_output' % node.name if nvis == 1 else
-                                  '%s_output%d' % (node.name, i),
-                                  from_jax(outs[i], self._ctx))
-            if is_train:
-                for in_idx, out_idx in op.mutate_inputs.items():
-                    src, _ = node.inputs[in_idx]
-                    if src.is_variable() and src.name in aux_index:
-                        self.aux_arrays[aux_index[src.name]]._data = outs[out_idx]
+                self._env_put_variable(node, env)
+            else:
+                self._exec_node(node, env, is_train, _random.next_key,
+                                new_aux=new_aux)
+        if new_aux:
+            for name, v in new_aux.items():
+                self.aux_dict[name]._data = v
         self.outputs_cached = [from_jax(env[_entry_key(n, i)], self._ctx)
                                for n, i in prog.outputs]
         self._staged_env_inputs = None
@@ -451,6 +542,7 @@ class Executor:
 
     def copy_params_from(self, arg_params, aux_params=None,
                          allow_extra_params=False):
+        self._partial = None  # param writes invalidate a stepping pass
         dev = self._ctx.jax_device()
         for name, arr in arg_params.items():
             if name in self.arg_dict:

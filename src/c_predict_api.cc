@@ -173,6 +173,18 @@ int MXPredForward(PredictorHandle handle) {
   return 0;
 }
 
+int MXPredPartialForward(PredictorHandle handle, int step, int *step_left) {
+  PRED_BEGIN();
+  PyObject *r = PyObject_CallMethod((PyObject *)handle, "partial_forward",
+                                    "i", step);
+  CHECK_PYP(r);
+  long left = PyLong_AsLong(r);
+  Py_DECREF(r);
+  if (left < 0 && PyErr_Occurred()) return FailFromPython();
+  *step_left = (int)left;
+  return 0;
+}
+
 int MXPredGetOutput(PredictorHandle handle, mx_uint index, mx_float *data,
                     mx_uint size) {
   PRED_BEGIN();

@@ -286,6 +286,25 @@ static void test_predict(void) {
   CHECK_OK(MXPredGetOutput(pred, 0, output, 2));
   CHECK(fabsf(output[0] - 17.0f) < 1e-5f);  /* 7*1 + 10 */
   CHECK(fabsf(output[1] - 28.0f) < 1e-5f);  /* 8*1 + 20 */
+
+  /* stepping loop per the reference header's documented pattern
+     (include/mxnet/c_predict_api.h:160-169): new input so a stale
+     buffer can't fake the check */
+  float input2[3] = {1, 2, 3};
+  CHECK_OK(MXPredSetInput(pred, "data", input2, 3));
+  int step_left = 1, n_steps = 0;
+  for (int step = 0; step_left != 0; ++step) {
+    CHECK_OK(MXPredPartialForward(pred, step, &step_left));
+    ++n_steps;
+    CHECK(n_steps < 64);  /* must terminate */
+  }
+  CHECK(n_steps >= 1);
+  CHECK_OK(MXPredGetOutput(pred, 0, output, 2));
+  CHECK(fabsf(output[0] - 11.0f) < 1e-5f);  /* 1*1 + 10 */
+  CHECK(fabsf(output[1] - 22.0f) < 1e-5f);  /* 2*1 + 20 */
+  /* out-of-range step is a no-op reporting 0 left */
+  CHECK_OK(MXPredPartialForward(pred, 1000, &step_left));
+  CHECK(step_left == 0);
   CHECK_OK(MXPredFree(pred));
   CHECK_OK(MXSymbolFree(fc));
   CHECK_OK(MXNDArrayFree(aw));

@@ -115,3 +115,45 @@ def test_multi_output_executor():
     np.testing.assert_allclose(outs[0].asnumpy(), [2.0, 4.0])
     np.testing.assert_allclose(outs[1].asnumpy(), [2.0, 3.0])
     np.testing.assert_allclose(float(outs[2].asnumpy()), 3.0)
+
+
+def test_partial_forward_matches_forward():
+    """GraphExecutor::PartialForward role: stepping from 0 until
+    step_left==0 (reference include/mxnet/c_predict_api.h:160-169)
+    yields the same outputs as one fused forward, with a BN aux state
+    in the graph to exercise the aux env path."""
+    data = mx.sym.Variable('data')
+    net = mx.sym.FullyConnected(data, name='fc', num_hidden=8)
+    net = mx.sym.BatchNorm(net, name='bn')
+    net = mx.sym.Activation(net, act_type='relu', name='act')
+    net = mx.sym.FullyConnected(net, name='out', num_hidden=3)
+    ex = net.simple_bind(mx.cpu(), data=(2, 5), grad_req='null')
+    rng = RNG(7)
+    for k, v in ex.arg_dict.items():
+        v[:] = rng.randn(*v.shape).astype(np.float32)
+    ref = ex.forward(is_train=False)[0].asnumpy()
+
+    step_left, n_steps = 1, 0
+    step = 0
+    while step_left != 0:
+        step_left = ex.partial_forward(False, step)
+        step += 1
+        n_steps += 1
+        assert n_steps < 64
+    assert n_steps == 4  # fc, bn, act, out — one op node per step
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), ref,
+                               rtol=1e-5, atol=1e-5)
+
+    # restart at 0 with a new input recomputes (no stale env)
+    ex.arg_dict['data'][:] = rng.randn(2, 5).astype(np.float32)
+    ref2 = ex.forward(is_train=False)[0].asnumpy()
+    step_left, step = 1, 0
+    while step_left != 0:
+        step_left = ex.partial_forward(False, step)
+        step += 1
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), ref2,
+                               rtol=1e-5, atol=1e-5)
+    assert not np.allclose(ref, ref2)
+
+    # out-of-range step: no-op, 0 left
+    assert ex.partial_forward(False, 1000) == 0
