@@ -46,40 +46,49 @@ def log_train_metric(period, auto_reset=False):
 
 
 class Speedometer:
-    """Logs samples/sec every N batches (reference callback.py:114)."""
+    """Logs samples/sec every `frequent` batches (the role of reference
+    callback.py's Speedometer; the `Speed:` line format is pinned —
+    downstream scripts and the compat tests parse it).
+
+    Implemented as a rolling measurement window: the window opens on
+    the first batch of an epoch (a rewinding batch counter re-opens
+    it), and every time the batch counter lands on a multiple of
+    `frequent` the window's throughput is reported and a fresh window
+    opens.
+    """
 
     def __init__(self, batch_size, frequent=50, auto_reset=True):
         self.batch_size = batch_size
         self.frequent = frequent
-        self.init = False
-        self.tic = 0
-        self.last_count = 0
         self.auto_reset = auto_reset
+        self._window_open = None   # wall-clock when the window opened
+        self._prev_batch = None
 
     def __call__(self, param):
-        count = param.nbatch
-        if self.last_count > count:
-            self.init = False
-        self.last_count = count
-
-        if self.init:
-            if count % self.frequent == 0:
-                speed = self.frequent * self.batch_size / (time.time() - self.tic)
-                if param.eval_metric is not None:
-                    name_value = param.eval_metric.get_name_value()
-                    if self.auto_reset:
-                        param.eval_metric.reset()
-                    msg = 'Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec'
-                    msg += '\t%s=%f' * len(name_value)
-                    logging.info(msg, param.epoch, count, speed,
-                                 *sum(name_value, ()))
-                else:
-                    logging.info('Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec',
-                                 param.epoch, count, speed)
-                self.tic = time.time()
+        now = time.time()
+        rewound = (self._prev_batch is not None
+                   and param.nbatch < self._prev_batch)
+        self._prev_batch = param.nbatch
+        if self._window_open is None or rewound:
+            self._window_open = now
+            return
+        if param.nbatch % self.frequent:
+            return
+        speed = self.frequent * self.batch_size / (now - self._window_open)
+        metric = param.eval_metric
+        if metric is None:
+            logging.info('Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec',
+                         param.epoch, param.nbatch, speed)
         else:
-            self.init = True
-            self.tic = time.time()
+            pairs = metric.get_name_value()
+            if self.auto_reset:
+                metric.reset()
+            fields = [param.epoch, param.nbatch, speed]
+            for name, value in pairs:
+                fields.extend((name, value))
+            logging.info('Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec'
+                         + '\t%s=%f' * len(pairs), *fields)
+        self._window_open = time.time()
 
 
 class ProgressBar:
@@ -96,8 +105,14 @@ class ProgressBar:
 
 
 class LogValidationMetricsCallback:
+    """Epoch-end eval logger; the `Validation-` line format is pinned
+    (parsed by downstream scripts, so only the internals differ from
+    the reference's)."""
+
     def __call__(self, param):
-        if not param.eval_metric:
+        metric = param.eval_metric
+        if metric is None:
             return
-        for name, value in param.eval_metric.get_name_value():
-            logging.info('Epoch[%d] Validation-%s=%f', param.epoch, name, value)
+        for name, value in metric.get_name_value():
+            logging.info('Epoch[%d] Validation-%s=%f',
+                         param.epoch, name, value)

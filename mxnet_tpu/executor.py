@@ -276,10 +276,7 @@ class Executor:
         step = int(step)
         if n_steps == 0:
             # variable-only graph: outputs are just current variables
-            env = {}
-            for node in prog.topo:
-                if node.is_variable():
-                    self._env_put_variable(node, env)
+            env = self._snapshot_env()
             self.outputs_cached = [from_jax(env[_entry_key(n, i)], self._ctx)
                                    for n, i in prog.outputs]
             return 0
@@ -287,11 +284,7 @@ class Executor:
             return 0
         st = self._partial
         if st is None or st['next'] != step:
-            env = {}
-            for node in prog.topo:
-                if node.is_variable():
-                    self._env_put_variable(node, env)
-            st = self._partial = {'env': env, 'next': 0,
+            st = self._partial = {'env': self._snapshot_env(), 'next': 0,
                                   'key': _random.next_key(), 'new_aux': {}}
             lo = 0
         else:
@@ -440,6 +433,14 @@ class Executor:
                else self.arg_dict[node.name])
         env[_entry_key(node, 0)] = jax.device_put(src._data,
                                                   self._node_device(node))
+
+    def _snapshot_env(self):
+        """Fresh eager env with all variable values snapshotted."""
+        env = {}
+        for node in self._prog.topo:
+            if node.is_variable():
+                self._env_put_variable(node, env)
+        return env
 
     def _exec_node(self, node, env, is_train, rng_key, new_aux=None):
         """Eagerly execute one non-variable node into ``env``.

@@ -67,6 +67,15 @@ def _merge_multi_context(outputs, major_axis):
     return rets
 
 
+def _output_layouts(symbol):
+    """Per-output batch axis from each output's ``__layout__`` attr (the
+    reference derives merge/slice/shape axes the same way), so a
+    time-major ('TN') output reports/merges on its real batch axis
+    instead of assuming axis 0. -1 means no batch axis."""
+    return [DataDesc.get_batch_axis(symbol[name].attr('__layout__'))
+            for name in symbol.list_outputs()]
+
+
 class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
@@ -104,7 +113,7 @@ class DataParallelExecutorGroup:
         self.label_shapes = None
         self.data_layouts = None
         self.label_layouts = None
-        self.output_layouts = [0] * len(symbol.list_outputs())
+        self.output_layouts = _output_layouts(symbol)
         self.batch_size = None
 
         self.bind_exec(data_shapes, label_shapes, shared_group)
@@ -115,9 +124,10 @@ class DataParallelExecutorGroup:
         major_axis = [DataDesc.get_batch_axis(getattr(d, 'layout', 'NCHW'))
                       for d in data_shapes]
         if len(self.contexts) > 1 and any(a > 0 for a in major_axis):
-            # inputs/labels now slice along the layout axis, but this
-            # group's OUTPUT merge and head-grad slicing assume batch
-            # axis 0 — fail loudly rather than interleave time across
+            # output merge / head-grad slicing honor per-output layout
+            # axes, but INPUT loading across unequal per-device chunks
+            # with a non-leading batch axis is untested territory —
+            # fail loudly rather than risk interleaving time across
             # devices. The SPMD group (homogeneous contexts, even batch)
             # handles non-zero batch axes.
             raise NotImplementedError(
@@ -273,7 +283,11 @@ class DataParallelExecutorGroup:
                 out_grads_slice = []
                 for grad, axis in zip(out_grads, self.output_layouts):
                     if axis >= 0:
-                        og = nd.array(grad.asnumpy()[self.slices[i]],
+                        # slice the head gradient along the OUTPUT's
+                        # batch axis (a 'TNC' output's is 1, not 0)
+                        idx = [slice(None)] * len(grad.shape)
+                        idx[axis] = self.slices[i]
+                        og = nd.array(grad.asnumpy()[tuple(idx)],
                                       ctx=self.contexts[i])
                     else:
                         og = grad.as_in_context(self.contexts[i]) \
@@ -355,7 +369,7 @@ class SPMDExecutorGroup:
         self.inputs_need_grad = inputs_need_grad
         self.fixed_param_names = fixed_param_names or []
         self.logger = logger
-        self.output_layouts = [0] * len(symbol.list_outputs())
+        self.output_layouts = _output_layouts(symbol)
 
         self.mesh = Mesh(np.array([c.jax_device() for c in contexts]),
                          ('dp',))

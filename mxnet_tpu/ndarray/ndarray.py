@@ -526,6 +526,7 @@ def _invoke_impl(op_name, inputs, attrs=None, out=None):
     # must not key the jit cache
     req_ctx = None
     if attrs and 'ctx' in attrs:
+        attrs = dict(attrs)  # don't mutate the caller's (reusable) dict
         req_ctx = attrs.pop('ctx')
         if req_ctx is not None and not isinstance(req_ctx, Context):
             # string spelling 'cpu(0)' / 'gpu(1)' (the C-API kwarg form)

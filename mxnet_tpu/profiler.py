@@ -50,7 +50,13 @@ def _atexit_dump():
     wrote, so a periodic-dump pattern loses nothing and an
     already-complete dump is simply rewritten unchanged."""
     if _state['running']:
-        profiler_set_state('stop')
+        try:
+            # jax.profiler.stop_trace can raise during interpreter
+            # shutdown; an atexit hook must not turn a successful run
+            # into a nonzero exit
+            profiler_set_state('stop')
+        except Exception:
+            pass
     if _state['ran'] and (_state['events'] or not _state['dumped']):
         try:
             dump_profile()

@@ -216,3 +216,20 @@ def test_time_major_batch_loading_full_length():
     loaded = mod._exec_group.execs[0].arg_dict['data'].asnumpy()
     assert loaded.shape == (T, N), loaded.shape
     np.testing.assert_allclose(loaded, x)
+
+
+def test_time_major_output_shapes():
+    """Output layouts come from each output's __layout__ attr (ADVICE
+    r4): a 'TNC' output's leading dim is T and get_output_shapes must
+    not overwrite it with the batch size N."""
+    from mxnet_tpu.io import DataDesc
+    data = mx.sym.Variable('data')
+    out = mx.sym.Activation(data, act_type='tanh', name='act')
+    out._set_attr(__layout__='TNC')
+    mod = mx.mod.Module(out, context=mx.cpu(), data_names=['data'],
+                        label_names=None)
+    mod.bind(data_shapes=[DataDesc('data', (10, 4, 8), layout='TNC')],
+             for_training=False)
+    assert mod._exec_group.output_layouts == [1]
+    key, shape = mod._exec_group.get_output_shapes()[0]
+    assert shape == (10, 4, 8), shape
