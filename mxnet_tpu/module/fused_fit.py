@@ -10,25 +10,36 @@ XLA computation via lax.scan — the standard in-graph-train-loop TPU
 pattern — behind the unchanged Module.fit API:
 
 - numerics are identical to the per-batch path: the same _GraphProgram
-  runner, the same jax.vjp with all-ones head gradients, the same
-  registered sgd(_mom)/mp_sgd(_mom) update ops with the same attrs;
-- the eval metric is computed from in-graph sufficient statistics
-  (per-step correct/count sums), fetched once per window and applied
-  per batch on the host, so metric values and batch_end_callback
-  cadence match the reference loop exactly (callbacks fire in a burst
-  after each window — the one observable difference);
-- the learning rate enters the compiled program as a traced scalar
-  (no recompile when a scheduler moves it), sampled once per window
-  at the value the updater would use for the window's FIRST batch:
-  window-aligned scheduler boundaries are exact; a mid-window
-  boundary lands up to W-1 updates late. Bookkeeping (num_update)
-  advances per-batch as in the reference.
+  runner, the same jax.vjp with all-ones head gradients, and the same
+  registered fused update ops with the same attrs. Every optimizer
+  whose update() is a single registered op is supported — SGD/ccSGD
+  (incl. fp16 master weights), NAG, Adam, RMSProp (both forms), Ftrl —
+  via a per-optimizer plan that mirrors its op choice, static attrs,
+  state<->op-input order, and host-side lr transform (Adam's bias
+  correction);
+- metrics: Accuracy / TopKAccuracy / CrossEntropy (and composites of
+  them) are computed from in-graph sufficient statistics — per-step
+  sums packed into one vector, fetched once per window. ANY other
+  metric takes the host-fallback mode: the window returns the stacked
+  per-step outputs (one fetch per window) and eval_metric.update runs
+  per batch on the host exactly as the reference loop would. Either
+  way metric values and batch_end_callback cadence match the
+  reference loop exactly (callbacks fire in a burst after each window
+  — the one observable difference);
+- the learning rate enters the compiled program as a traced (W, n)
+  array sampled per batch on the host (no recompile when a scheduler
+  moves it), so scheduler boundaries are EXACT even mid-window, and
+  Adam's per-update-count bias correction is exact. Bookkeeping
+  (num_update) advances per-batch as in the reference;
+- grad_req='add' carries the gradient accumulators through the scan
+  and writes them back, matching the reference loop's accumulate-
+  without-clear semantics.
 
-Eligibility is conservative (build() returns None → fit falls back to
-the reference loop): plain Module, one executor (single context or
-SPMD group), non-staged graph, grad_req='write', type(optimizer) is
-SGD, single-process kvstore (None/'local'/'device'), and a metric
-composed of Accuracy / TopKAccuracy / CrossEntropy.
+Eligibility (build() returns None → fit falls back to the reference
+loop): plain Module, one executor (single context or SPMD group),
+non-staged graph, grad_req 'write'/'add', an optimizer with a plan
+(above; multi-precision only for SGD), and a single-process kvstore
+(None/'local'/'device' — dist kvstores need per-batch push/pull).
 
 Toggles: MXTPU_FUSED_FIT=0 disables; MXTPU_FIT_STEPS_PER_CALL sets W
 (default 32 on TPU, 4 elsewhere).
@@ -42,6 +53,7 @@ import jax.numpy as jnp
 
 from .. import metric as metric_mod
 from .. import optimizer as opt_mod
+from ..optimizer import _as_clip
 from ..executor import mirror_wrap
 from ..kvstore import _updater_key
 from ..ndarray.ndarray import NDArray, from_jax
@@ -61,6 +73,151 @@ def _window_size():
 
 def _is_half(dt):
     return str(dt) in ('float16', 'bfloat16')
+
+
+# ---------------------------------------------------------------------------
+# optimizer plans: one registered fused update op per optimizer
+# ---------------------------------------------------------------------------
+
+class _OptPlan:
+    """Expresses one optimizer's update() as its registered fused op
+    inside the scan body, mirroring the NDArray path exactly: op
+    choice, static attrs, host-side lr transform (e.g. Adam's bias
+    correction), and the state<->op-input-order mapping. All fused
+    update ops return (new_weight, *new_states) with states in input
+    order, so application in the scan body is generic."""
+
+    supports_mp = False
+
+    def __init__(self, opt):
+        self.opt = opt
+
+    _clip = staticmethod(_as_clip)   # None → -1.0 sentinel, shared
+    # with the imperative updaters so the convention lives in one place
+
+    def lr_wd(self, index):
+        """(lr, wd) the updater would use for the CURRENT update count
+        of `index` (call right after _update_count, like update())."""
+        return self.opt._get_lr(index), self.opt._get_wd(index)
+
+    def state_arrays(self, st):
+        """Optimizer state -> jax arrays in the op's input order."""
+        if st is None:
+            return []
+        if isinstance(st, tuple):
+            return [s._data for s in st]
+        return [st._data]
+
+    def writeback_state(self, st, arrays):
+        if st is None:
+            return
+        if isinstance(st, tuple):
+            for s, a in zip(st, arrays):
+                s._data = a
+        else:
+            st._data = arrays[0]
+
+
+class _SGDPlan(_OptPlan):
+    supports_mp = True
+
+    def mode(self, weight_dtype):
+        """Mirrors SGD.update_multi_precision's op choice."""
+        mp = self.opt.multi_precision and _is_half(weight_dtype)
+        mom = self.opt.momentum != 0.0
+        return ('mp_' if mp else '') + ('sgd_mom_update' if mom
+                                        else 'sgd_update')
+
+    def static_attrs(self):
+        o = self.opt
+        return {'momentum': o.momentum, 'rescale_grad': o.rescale_grad,
+                'clip_gradient': self._clip(o.clip_gradient)}
+
+    def state_arrays(self, st):
+        if isinstance(st, tuple):           # multi-precision (w32, mom)
+            w32, mom = st
+            if mom is None:
+                return [w32._data]          # mp_sgd_update(..., weight32)
+            return [mom._data, w32._data]   # mp_sgd_mom_update(.., mom, w32)
+        return [st._data] if st is not None else []
+
+    def writeback_state(self, st, arrays):
+        if isinstance(st, tuple):
+            w32, mom = st
+            if mom is None:
+                w32._data = arrays[0]
+            else:
+                mom._data = arrays[0]
+                w32._data = arrays[1]
+        elif st is not None:
+            st._data = arrays[0]
+
+
+class _NAGPlan(_SGDPlan):
+    supports_mp = False
+
+    def mode(self, weight_dtype):
+        return ('nag_mom_update' if self.opt.momentum != 0.0
+                else 'sgd_update')
+
+
+class _AdamPlan(_OptPlan):
+    def mode(self, weight_dtype):
+        return 'adam_update'
+
+    def static_attrs(self):
+        o = self.opt
+        return {'beta1': o.beta1, 'beta2': o.beta2, 'epsilon': o.epsilon,
+                'rescale_grad': o.rescale_grad,
+                'clip_gradient': self._clip(o.clip_gradient)}
+
+    def lr_wd(self, index):
+        """Adam.update's per-update-count bias correction, folded into
+        the per-batch lr row on the host."""
+        import math
+        o = self.opt
+        lr, wd = o._get_lr(index), o._get_wd(index)
+        t = o._index_update_count[index]
+        lr *= math.sqrt(1. - o.beta2 ** t) / (1. - o.beta1 ** t)
+        return lr, wd
+
+
+class _RMSPropPlan(_OptPlan):
+    def mode(self, weight_dtype):
+        return ('rmspropalex_update' if self.opt.centered
+                else 'rmsprop_update')
+
+    def static_attrs(self):
+        o = self.opt
+        attrs = {'gamma1': o.gamma1, 'epsilon': o.epsilon,
+                 'rescale_grad': o.rescale_grad,
+                 'clip_gradient': self._clip(o.clip_gradient),
+                 'clip_weights': self._clip(o.clip_weights)}
+        if o.centered:
+            attrs['gamma2'] = o.gamma2
+        return attrs
+
+
+class _FtrlPlan(_OptPlan):
+    def mode(self, weight_dtype):
+        return 'ftrl_update'
+
+    def static_attrs(self):
+        o = self.opt
+        return {'lamda1': o.lamda1, 'beta': o.beta,
+                'rescale_grad': o.rescale_grad,
+                'clip_gradient': self._clip(o.clip_gradient)}
+
+
+def _opt_plan(opt):
+    """Plan for this optimizer type, or None (→ reference loop).
+    Exact-type dispatch: a user subclass with an overridden update()
+    must not silently take the base class's fused form."""
+    table = {opt_mod.SGD: _SGDPlan, opt_mod.ccSGD: _SGDPlan,
+             opt_mod.NAG: _NAGPlan, opt_mod.Adam: _AdamPlan,
+             opt_mod.RMSProp: _RMSPropPlan, opt_mod.Ftrl: _FtrlPlan}
+    cls = table.get(type(opt))
+    return cls(opt) if cls is not None else None
 
 
 # ---------------------------------------------------------------------------
@@ -123,7 +280,7 @@ def _metric_plan(eval_metric):
 class FusedFitLoop:
     """One compiled W-step train window driving Module's state."""
 
-    def __init__(self, module, children, stat_fns, window):
+    def __init__(self, module, children, stat_fns, window, oplan):
         self.module = module
         self.children = children
         self.stat_fns = stat_fns
@@ -142,6 +299,8 @@ class FusedFitLoop:
         self._carry_names = [n for n in self._arg_names if n not in io_names]
         self._carry_pos = {n: i for i, n in enumerate(self._carry_names)}
         self._optimizer = module._optimizer
+        self._plan = oplan  # the instance build() validated eligibility on
+        self._accum = (module._grad_req == 'add')
         # SPMD group: every carried array must live replicated on the
         # mesh and batch stacks sharded over dp, or jit rejects the
         # mixed-device argument set
@@ -174,34 +333,51 @@ class FusedFitLoop:
         e = eg.execs[0]
         if e._use_staged() or e._monitor is not None:
             return None
-        if module._grad_req != 'write' or module.inputs_need_grad:
+        if module._grad_req not in ('write', 'add') \
+                or module.inputs_need_grad:
             return None
         opt = module._optimizer
-        if type(opt) is not opt_mod.SGD:
+        oplan = _opt_plan(opt)
+        if oplan is None:
             return None
+        if not oplan.supports_mp and opt.multi_precision and any(
+                _is_half(e.arg_dict[n]._data.dtype) for n in e._grad_names):
+            return None  # mp master-weight form only planned for SGD
         kv = module._kvstore
         if kv is not None and kv.type not in ('local', 'device'):
             return None
-        # the metric stat fns assume ONE 2-D (batch, classes) output and
-        # one label — the reference loop zips all output/label pairs
         shapes = {d.name: d.shape for d in
                   list(module.data_shapes) + list(module.label_shapes or [])}
         try:
             _, out_shapes, _ = module._symbol.infer_shape(**shapes)
         except Exception:  # noqa: BLE001 — undecidable shapes: fall back
             return None
-        if out_shapes is None or len(out_shapes) != 1 \
-                or len(out_shapes[0]) != 2:
+        if out_shapes is None:
             return None
-        if len(module._label_names) != 1:
-            return None
+        window = _window_size()
         plan = _metric_plan(eval_metric)
-        if plan is None:
-            return None
-        children, fns = plan
-        loop = FusedFitLoop(module, children, fns, _window_size())
-        logger.info('fused fit fast path active: %d steps/device-call',
-                    loop.window)
+        # the metric stat fns assume ONE 2-D (batch, classes) output and
+        # one label — other geometries use the host-fallback mode below
+        if plan is not None and (len(out_shapes) != 1
+                                 or len(out_shapes[0]) != 2
+                                 or len(module._label_names) != 1):
+            plan = None
+        if plan is not None:
+            children, fns = plan
+        else:
+            # host-fallback metric mode: the window ships the stacked
+            # per-step outputs (one fetch per window) and the metric's
+            # own update() runs per batch on the host. Bounded: W
+            # stacked fp32 outputs must stay under a device-memory cap.
+            est = 4 * window * sum(
+                int(np.prod(s)) for s in out_shapes if s)
+            if est > 256 * 1024 * 1024:
+                return None
+            children, fns = None, None
+        loop = FusedFitLoop(module, children, fns, window, oplan)
+        logger.info('fused fit fast path active: %d steps/device-call%s',
+                    loop.window,
+                    '' if fns is not None else ' (host-metric mode)')
         return loop
 
     # -- optimizer state ---------------------------------------------------
@@ -224,66 +400,43 @@ class FusedFitLoop:
                 upd.states_synced[key] = True
 
     def _state_arrays(self, n):
-        """Flatten one param's optimizer state into jax arrays in the
-        update op's INPUT order: () / (mom,) / (w32,) / (mom, w32)."""
         st = self._updater_obj().states[self._upd_keys[n]]
-        if isinstance(st, tuple):           # multi-precision (w32, mom)
-            w32, mom = st
-            if mom is None:
-                return [w32._data]          # mp_sgd_update(..., weight32)
-            return [mom._data, w32._data]   # mp_sgd_mom_update(.., mom, w32)
-        return [st._data] if st is not None else []
+        return self._plan.state_arrays(st)
 
     def _writeback_state(self, n, arrays):
-        upd = self._updater_obj()
-        st = upd.states[self._upd_keys[n]]
-        if isinstance(st, tuple):
-            w32, mom = st
-            if mom is None:
-                w32._data = arrays[0]
-            else:
-                mom._data = arrays[0]
-                w32._data = arrays[1]
-        elif st is not None:
-            st._data = arrays[0]
+        st = self._updater_obj().states[self._upd_keys[n]]
+        self._plan.writeback_state(st, arrays)
 
     # -- program -----------------------------------------------------------
-    def _static_attrs(self, n):
-        """Per-param attrs that never change across windows (lr/wd are
-        dynamic: they enter the compiled program as traced scalars so a
-        per-update lr scheduler never forces a recompile)."""
-        o = self._optimizer
-        clip = -1.0 if o.clip_gradient is None else float(o.clip_gradient)
-        return {'momentum': o.momentum, 'rescale_grad': o.rescale_grad,
-                'clip_gradient': clip}
+    def _static_attrs(self):
+        """Optimizer-wide attrs that never change across windows (lr/wd
+        are dynamic: they enter the compiled program as traced arrays
+        so a per-update lr scheduler never forces a recompile)."""
+        return self._plan.static_attrs()
 
     def _sample_window_lr(self):
-        """Advance the optimizer's update bookkeeping for the whole
-        window and return the (lr, wd) its updater would use for the
-        window's FIRST batch. Window-aligned scheduler boundaries are
-        thus exact; a mid-window boundary lands <=W-1 updates late
-        (see module docstring)."""
+        """Advance the optimizer's update bookkeeping batch-by-batch
+        (exactly as the reference loop's per-batch update() calls
+        would) and return (W, n_params) lr/wd arrays holding the value
+        the updater would use for EACH batch of the window — scheduler
+        boundaries and per-update-count transforms (Adam) are exact
+        even mid-window."""
         o = self._optimizer
-        for n in self._grad_names:            # the first batch's update
-            o._update_count(self._upd_keys[n])
-        lr = np.array([o._get_lr(self._upd_keys[n])
-                       for n in self._grad_names], np.float32)
-        wd = np.array([o._get_wd(self._upd_keys[n])
-                       for n in self._grad_names], np.float32)
-        for _ in range(self.window - 1):      # the rest of the window
-            for n in self._grad_names:
-                o._update_count(self._upd_keys[n])
+        n = len(self._grad_names)
+        lr = np.empty((self.window, n), np.float32)
+        wd = np.empty((self.window, n), np.float32)
+        for w in range(self.window):
+            for j, name in enumerate(self._grad_names):
+                idx = self._upd_keys[name]
+                o._update_count(idx)
+                lr[w, j], wd[w, j] = self._plan.lr_wd(idx)
         return lr, wd
 
     def _mode(self, n):
-        """Update-op choice per param — mirrors SGD.update_multi_precision."""
-        half = _is_half(self._exec.arg_dict[n]._data.dtype)
-        mp = self._optimizer.multi_precision and half
-        mom = self._optimizer.momentum != 0.0
-        return ('mp_' if mp else '') + ('sgd_mom_update' if mom
-                                        else 'sgd_update')
+        """Update-op choice per param, delegated to the optimizer plan."""
+        return self._plan.mode(self._exec.arg_dict[n]._data.dtype)
 
-    def _build_program(self, attrs_key, shapes_key):
+    def _build_program(self, static_attrs, shapes_key):
         run = self._run
         arg_pos = {n: i for i, n in enumerate(self._arg_names)}
         data_names = list(self.module._data_names)
@@ -291,17 +444,17 @@ class FusedFitLoop:
         carry_names = self._carry_names
         grad_names = self._grad_names
         grad_carry_idx = [self._carry_pos[n] for n in grad_names]
-        attrs_map = dict(attrs_key)
         modes = {n: self._mode(n) for n in grad_names}
         ops = {mode: _reg.get(mode) for mode in set(modes.values())}
         stat_fns = self.stat_fns
+        accum = self._accum
         W = self.window
 
-        def window_fn(params, states, aux, data_stack, label_stack, key,
-                      lr_arr, wd_arr):
+        def window_fn(params, states, aux, gaccs, data_stack, label_stack,
+                      key, lr_arr, wd_arr):
             def body(carry, xs):
-                params, states, aux = carry
-                step_i, datas, labels = xs
+                params, states, aux, gaccs = carry
+                step_i, datas, labels, lr_row, wd_row = xs
                 k = jax.random.fold_in(key, step_i)
 
                 def f(wrt):
@@ -321,39 +474,48 @@ class FusedFitLoop:
                 heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
                 zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
                 (grads,) = vjp((heads, zero_aux))
+                if accum:
+                    # grad_req='add': the reference loop accumulates
+                    # into grad buffers and never clears them
+                    grads = tuple(ga + g for ga, g in zip(gaccs, grads))
+                    gaccs = grads
 
                 new_params = list(params)
                 new_states = list(states)
                 for j, n in enumerate(grad_names):
                     ci = grad_carry_idx[j]
-                    w, g = params[ci], grads[j]
-                    mode = modes[n]
-                    attrs = dict(attrs_map[n])
-                    attrs['lr'] = lr_arr[j]   # traced: scheduler-safe
-                    attrs['wd'] = wd_arr[j]
-                    res = ops[mode].fn(attrs, w, g, *states[j])
-                    if mode == 'sgd_update':
+                    attrs = dict(static_attrs)
+                    attrs['lr'] = lr_row[j]   # traced: scheduler-safe
+                    attrs['wd'] = wd_row[j]
+                    # every fused update op returns (w, *states) with
+                    # states in input order — application is generic
+                    res = ops[modes[n]].fn(attrs, params[ci], grads[j],
+                                           *states[j])
+                    if isinstance(res, tuple):
+                        new_params[ci] = res[0]
+                        new_states[j] = tuple(res[1:])
+                    else:
                         new_params[ci] = res
-                    elif mode in ('sgd_mom_update', 'mp_sgd_update'):
-                        new_params[ci] = res[0]
-                        new_states[j] = (res[1],)
-                    else:  # mp_sgd_mom_update: (w_half, new_mom, new_w32)
-                        new_params[ci] = res[0]
-                        new_states[j] = (res[1], res[2])
-                # all metric stats packed into ONE vector per step so
-                # the host needs a single fetch per window (each fetch
-                # through a tunneled runtime costs a full RTT)
-                pieces = jnp.stack([v for fn in stat_fns
+                if stat_fns is not None:
+                    # all metric stats packed into ONE vector per step
+                    # so the host needs a single fetch per window (each
+                    # fetch through a tunneled runtime costs a full RTT)
+                    ys = jnp.stack([v for fn in stat_fns
                                     for v in fn(outs, labels)])
-                return (tuple(new_params), tuple(new_states), new_aux), \
-                    pieces
+                else:
+                    # host-fallback metric: ship the raw outputs; scan
+                    # stacks them into (W, ...) per output
+                    ys = outs
+                return (tuple(new_params), tuple(new_states), new_aux,
+                        gaccs), ys
 
-            (p, s, a), pieces = jax.lax.scan(
-                body, (params, states, aux),
-                (jnp.arange(W), data_stack, label_stack))
-            return p, s, a, pieces   # pieces: (W, 2 * n_metrics)
+            (p, s, a, g), ys = jax.lax.scan(
+                body, (params, states, aux, gaccs),
+                (jnp.arange(W), data_stack, label_stack,
+                 jnp.asarray(lr_arr), jnp.asarray(wd_arr)))
+            return p, s, a, g, ys
 
-        return jax.jit(window_fn, donate_argnums=(0, 1, 2))
+        return jax.jit(window_fn, donate_argnums=(0, 1, 2, 3))
 
     # -- per-epoch drive ---------------------------------------------------
     def _snapshot(self):
@@ -362,16 +524,19 @@ class FusedFitLoop:
         states = tuple(tuple(self._state_arrays(n))
                        for n in self._grad_names)
         aux = tuple(e.aux_dict[n]._data for n in self._aux_names)
+        gaccs = tuple(e.grad_dict[n]._data for n in self._grad_names) \
+            if self._accum else ()
         if self._mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             rep = NamedSharding(self._mesh, P())
             place = lambda t: jax.tree_util.tree_map(  # noqa: E731
                 lambda a: a if getattr(a, 'sharding', None) == rep
                 else jax.device_put(a, rep), t)
-            params, states, aux = place(params), place(states), place(aux)
-        return params, states, aux
+            params, states, aux, gaccs = (place(params), place(states),
+                                          place(aux), place(gaccs))
+        return params, states, aux, gaccs
 
-    def _writeback(self, params, states, aux):
+    def _writeback(self, params, states, aux, gaccs):
         e = self._exec
         m = self.module
         for n, v in zip(self._carry_names, params):
@@ -385,17 +550,22 @@ class FusedFitLoop:
                     store._data = e.arg_dict[n]._data
         for n, v in zip(self._aux_names, aux):
             e.aux_dict[n]._data = v
+        if self._accum:
+            for n, v in zip(self._grad_names, gaccs):
+                e.grad_dict[n]._data = v
         m._params_dirty = True
 
-    def _device_batches(self, batches):
-        """Stack W host batches into device (W, ...) arrays. Identity-
+    def _device_batches(self, snaps):
+        """Stack W draw-time array snapshots into device (W, ...)
+        arrays. `snaps` holds the jax arrays captured as each batch was
+        drawn (jax arrays are immutable, so the references stay valid
+        even if the iterator reuses its NDArray buffers). Identity-
         cached: synthetic/benchmark iterators yield the same arrays
         every batch, so the transfer happens once. The cache key holds
         STRONG references to the source arrays — identity is compared
         against live objects, so a freed array's id can never produce
         a false hit."""
-        arrays = [a._data for b in batches
-                  for a in list(b.data) + list(b.label)]
+        arrays = [a for ds, ls in snaps for a in ds + ls]
         if self._dev_cache_key is not None and \
                 len(arrays) == len(self._dev_cache_key) and \
                 all(a is c for a, c in zip(arrays, self._dev_cache_key)):
@@ -411,12 +581,12 @@ class FusedFitLoop:
             spec = P(*((None, 'dp') + (None,) * (stack.ndim - 2)))
             return jax.device_put(stack, NamedSharding(self._mesh, spec))
 
-        data_stack = [shard(jnp.stack([jnp.asarray(b.data[i]._data)
-                                       for b in batches]))
-                      for i in range(len(batches[0].data))]
-        label_stack = [shard(jnp.stack([jnp.asarray(b.label[i]._data)
-                                        for b in batches]))
-                       for i in range(len(batches[0].label))]
+        data_stack = [shard(jnp.stack([jnp.asarray(ds[i])
+                                       for ds, _ in snaps]))
+                      for i in range(len(snaps[0][0]))]
+        label_stack = [shard(jnp.stack([jnp.asarray(ls[i])
+                                        for _, ls in snaps]))
+                       for i in range(len(snaps[0][1]))]
         self._dev_cache_key = key
         self._dev_cache = (tuple(data_stack), tuple(label_stack))
         return self._dev_cache
@@ -432,14 +602,40 @@ class FusedFitLoop:
         from .. import random as _random
         m = self.module
 
-        def apply_stats(pieces, nbatch):
-            """One host fetch for the window's packed stats, then exact
-            per-batch metric application + callbacks."""
-            host = np.asarray(pieces)          # (W, 2 * n_metrics)
-            for i in range(host.shape[0]):
-                for j, child in enumerate(self.children):
-                    child.sum_metric += float(host[i, 2 * j])
-                    child.num_inst += int(host[i, 2 * j + 1])
+        try:
+            _host_dev = jax.local_devices(backend='cpu')[0]
+        except RuntimeError:
+            _host_dev = None
+
+        def host_nd(a):
+            """cpu-backed NDArray wrapper for already-host data, so the
+            metric's .asnumpy() calls cost no device round-trip."""
+            arr = jax.device_put(np.asarray(a), _host_dev) \
+                if _host_dev is not None else jnp.asarray(a)
+            return from_jax(arr, self._exec._ctx)
+
+        def apply_stats(pieces, labels_w, nbatch):
+            """One host fetch for the window's results, then exact
+            per-batch metric application + callbacks. Stats mode feeds
+            the packed sufficient-statistic sums into the metric
+            children; host-metric mode replays eval_metric.update with
+            each step's outputs against the window's own labels
+            (snapshotted at collection time — see below), the way the
+            reference loop's update_metric would."""
+            if self.stat_fns is not None:
+                host = np.asarray(pieces)      # (W, 2 * n_metrics)
+                steps = host.shape[0]
+            else:
+                outs_host = [np.asarray(o) for o in pieces]  # (W, ...)
+                steps = outs_host[0].shape[0]
+            for i in range(steps):
+                if self.stat_fns is not None:
+                    for j, child in enumerate(self.children):
+                        child.sum_metric += float(host[i, 2 * j])
+                        child.num_inst += int(host[i, 2 * j + 1])
+                else:
+                    preds = [host_nd(o[i]) for o in outs_host]
+                    eval_metric.update(labels_w[i], preds)
                 if batch_end_callback is not None:
                     p = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                       eval_metric=eval_metric,
@@ -452,24 +648,42 @@ class FusedFitLoop:
         nbatch = 0
         pending = None   # previous window's stats, fetched AFTER the
         # next window is dispatched so the RTT overlaps device compute
+        from ..io import DataBatch as _DataBatch
         it = iter(train_data)
         done = False
         while not done:
-            batches = []
+            # snapshot each batch's underlying jax arrays AT DRAW TIME:
+            # iterators may legally reuse their DataBatch/NDArray
+            # buffers for the next batch (the reference loop consumes
+            # each batch before drawing the next); jax arrays are
+            # immutable, so the draw-time references stay valid while
+            # the window is collected and the apply is deferred.
+            batches, snaps = [], []
             while len(batches) < self.window:
                 try:
-                    batches.append(next(it))
+                    b = next(it)
                 except StopIteration:
                     done = True
                     break
+                batches.append(b)
+                snaps.append((tuple(a._data for a in b.data),
+                              tuple(l._data for l in b.label)))
             if len(batches) < self.window:
                 if pending is not None:
-                    nbatch = apply_stats(pending, nbatch)
+                    nbatch = apply_stats(pending[0], pending[1], nbatch)
                     pending = None
-                for b in batches:   # tail: reference per-batch path
-                    m.forward_backward(b)
+                for b, (ds, ls) in zip(batches, snaps):
+                    # tail: reference per-batch path, on a rebuilt batch
+                    # (the original's buffers may have been overwritten
+                    # by later draws)
+                    sb = _DataBatch(
+                        data=[from_jax(d, self._exec._ctx) for d in ds],
+                        label=[from_jax(l, self._exec._ctx) for l in ls],
+                        pad=getattr(b, 'pad', None),
+                        index=getattr(b, 'index', None))
+                    m.forward_backward(sb)
                     m.update()
-                    m.update_metric(eval_metric, b.label)
+                    m.update_metric(eval_metric, sb.label)
                     if batch_end_callback is not None:
                         p = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                           eval_metric=eval_metric,
@@ -480,33 +694,39 @@ class FusedFitLoop:
                 break
 
             # one program per (static attrs, shapes); lr/wd enter as
-            # traced scalars sampled at each window start, so an lr
+            # traced arrays sampled at each window start, so an lr
             # scheduler never forces a recompile
-            attrs_key = tuple(
-                (n, tuple(sorted(self._static_attrs(n).items())))
-                for n in self._grad_names)
-            shapes_key = tuple((tuple(b.shape) for b in batches[0].data))
+            static_attrs = self._static_attrs()
+            attrs_key = tuple(sorted(static_attrs.items()))
+            shapes_key = tuple(tuple(d.shape) for d in snaps[0][0])
             prog_key = (attrs_key, shapes_key)
             if prog_key not in self._programs:
                 self._programs[prog_key] = self._build_program(
-                    {n: dict(a) for n, a in attrs_key}, shapes_key)
+                    static_attrs, shapes_key)
             window_fn = self._programs[prog_key]
 
-            params, states, aux = self._snapshot()
-            data_stack, label_stack = self._device_batches(batches)
+            # host-metric mode: keep per-batch label wrappers from the
+            # draw-time snapshots for the deferred eval_metric.update.
+            # Stats mode needs nothing from the host batches.
+            labels_snap = None
+            if self.stat_fns is None:
+                labels_snap = [[from_jax(l, self._exec._ctx) for l in ls]
+                               for _, ls in snaps]
+            params, states, aux, gaccs = self._snapshot()
+            data_stack, label_stack = self._device_batches(snaps)
             lr_arr, wd_arr = self._sample_window_lr()
             self._base_key = _random.next_key()
-            params, states, aux, pieces = window_fn(
-                params, states, aux, data_stack, label_stack,
+            params, states, aux, gaccs, pieces = window_fn(
+                params, states, aux, gaccs, data_stack, label_stack,
                 self._base_key, lr_arr, wd_arr)
-            self._writeback(params, states, aux)
+            self._writeback(params, states, aux, gaccs)
             # dispatch is async: fetch the PREVIOUS window's stats now,
             # while this window computes — the fetch RTT disappears
             # behind device time (callbacks run one window late; values
             # and cadence are unchanged)
             if pending is not None:
-                nbatch = apply_stats(pending, nbatch)
-            pending = pieces
+                nbatch = apply_stats(pending[0], pending[1], nbatch)
+            pending = (pieces, labels_snap)
         if pending is not None:
-            nbatch = apply_stats(pending, nbatch)
+            nbatch = apply_stats(pending[0], pending[1], nbatch)
         return nbatch

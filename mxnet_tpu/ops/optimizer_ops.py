@@ -67,6 +67,20 @@ def _mp_sgd_mom_update(attrs, weight, grad, mom, weight32):
     return w32.astype(weight.dtype), new_mom, w32
 
 
+@register('nag_mom_update', input_names=['weight', 'grad', 'mom'],
+          param_defaults={'lr': 0.01, 'momentum': 0.0, 'wd': 0.0,
+                          'rescale_grad': 1.0, 'clip_gradient': -1.0},
+          mutate_inputs={0: 0, 2: 1}, num_visible_outputs=1, num_outputs=2,
+          differentiable=False)
+def _nag_mom_update(attrs, weight, grad, mom):
+    """Nesterov momentum (reference optimizer_op.cc nag_mom_update):
+    the lookahead gradient g + momentum * new_mom steps the weight."""
+    g = _rescale_clip(grad, attrs) + attrs.get('wd', 0.0) * weight
+    m = attrs.get('momentum', 0.0)
+    new_mom = m * mom + g
+    return weight - attrs['lr'] * (g + m * new_mom), new_mom
+
+
 @register('adam_update', input_names=['weight', 'grad', 'mean', 'var'],
           param_defaults={'lr': 0.001, 'beta1': 0.9, 'beta2': 0.999,
                           'epsilon': 1e-8, 'wd': 0.0, 'rescale_grad': 1.0,

@@ -216,24 +216,22 @@ class SGD(Optimizer):
 
 @register
 class NAG(SGD):
-    """Nesterov accelerated SGD (reference :410)."""
+    """Nesterov accelerated SGD (reference :410) via the fused
+    nag_mom_update op — one HBM pass per param under jit, and the same
+    lowering the fused fit window uses, so the two paths agree
+    bit-for-bit."""
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
-        grad = grad * self.rescale_grad
-        if self.clip_gradient is not None:
-            grad = nd.clip(grad, -self.clip_gradient, self.clip_gradient)
+        kwargs = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                      clip_gradient=_as_clip(self.clip_gradient))
         if state is not None:
-            mom = state
-            mom *= self.momentum
-            grad += wd * weight
-            mom += grad
-            grad += self.momentum * mom
-            weight += -lr * grad
+            nd.nag_mom_update(weight, grad, state, out=weight,
+                              momentum=self.momentum, **kwargs)
         else:
-            weight += -lr * (grad + wd * weight)
+            nd.sgd_update(weight, grad, out=weight, **kwargs)
 
 
 @register

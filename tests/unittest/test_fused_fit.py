@@ -32,13 +32,22 @@ def _mlp_mod(n=56, batch=8, ctx=None, n_classes=4, seed=7):
 
 
 def _fit(fused, kvstore='local', momentum=0.9, metric='acc', cb=None,
+         optimizer='sgd', optimizer_params=None, grad_req='write',
          **build_kw):
     os.environ['MXTPU_FUSED_FIT'] = '1' if fused else '0'
     try:
         mod, it = _mlp_mod(**build_kw)
-        mod.fit(it, num_epoch=2, optimizer='sgd',
-                optimizer_params=(('learning_rate', 0.1),
-                                  ('momentum', momentum)),
+        if optimizer_params is None:
+            optimizer_params = (('learning_rate', 0.1),
+                                ('momentum', momentum))
+        if grad_req != 'write':
+            # pre-bind with the requested grad_req; fit()'s own bind
+            # call is then a no-op on the already-bound module
+            mod.bind(data_shapes=it.provide_data,
+                     label_shapes=it.provide_label, for_training=True,
+                     grad_req=grad_req)
+        mod.fit(it, num_epoch=2, optimizer=optimizer,
+                optimizer_params=optimizer_params,
                 kvstore=kvstore, eval_metric=metric,
                 batch_end_callback=cb)
         args, auxs = mod.get_params()
@@ -115,7 +124,7 @@ def test_fused_composite_metric_values():
 
 def test_fused_eligibility_gates():
     """Unsupported configurations decline the fast path (None) instead
-    of changing behavior."""
+    of changing behavior; widened ones engage it."""
     mod, it = _mlp_mod()
     mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
     mod.init_params()
@@ -123,36 +132,94 @@ def test_fused_eligibility_gates():
     os.environ['MXTPU_FUSED_FIT'] = '1'
     try:
         assert FusedFitLoop.build(mod, metric_mod.create('acc')) is not None
-        # unsupported metric
-        assert FusedFitLoop.build(mod, metric_mod.create('mse')) is None
+        # a metric without a stats plan takes the HOST-fallback mode
+        loop = FusedFitLoop.build(mod, metric_mod.create('mse'))
+        assert loop is not None and loop.stat_fns is None
         # flag off
         os.environ['MXTPU_FUSED_FIT'] = '0'
         assert FusedFitLoop.build(mod, metric_mod.create('acc')) is None
         os.environ['MXTPU_FUSED_FIT'] = '1'
-        # non-SGD optimizer
+        # Adam now has a plan (round-5 widening)
         mod2, it2 = _mlp_mod()
         mod2.bind(data_shapes=it2.provide_data,
                   label_shapes=it2.provide_label)
         mod2.init_params()
         mod2.init_optimizer(kvstore='device', optimizer='adam')
-        assert FusedFitLoop.build(mod2, metric_mod.create('acc')) is None
+        assert FusedFitLoop.build(mod2, metric_mod.create('acc')) is not None
+        # an optimizer with no fused plan still declines
+        mod3, it3 = _mlp_mod()
+        mod3.bind(data_shapes=it3.provide_data,
+                  label_shapes=it3.provide_label)
+        mod3.init_params()
+        mod3.init_optimizer(kvstore='device', optimizer='adadelta')
+        assert FusedFitLoop.build(mod3, metric_mod.create('acc')) is None
     finally:
         os.environ.pop('MXTPU_FUSED_FIT', None)
 
 
-def test_fused_scheduler_no_recompile_and_window_aligned_equality():
-    """lr enters the compiled window as a traced scalar: a scheduler
-    that changes lr every W updates (window-aligned) yields the exact
-    reference trajectory AND one compiled program despite the lr
-    changing across windows."""
+@pytest.mark.parametrize('opt,params', [
+    ('adam', (('learning_rate', 0.01),)),
+    ('nag', (('learning_rate', 0.05), ('momentum', 0.9))),
+    ('rmsprop', (('learning_rate', 0.01),)),
+    ('rmsprop', (('learning_rate', 0.01), ('centered', True))),
+    ('ftrl', (('learning_rate', 0.1),)),
+])
+def test_fused_matches_reference_loop_other_optimizers(opt, params):
+    """Round-5 widening: every optimizer with a fused-op plan produces
+    the reference loop's exact trajectory (Adam's per-update-count
+    bias correction is folded into the per-batch lr rows)."""
+    a_f, _ = _fit(True, optimizer=opt, optimizer_params=params)
+    a_u, _ = _fit(False, optimizer=opt, optimizer_params=params)
+    _assert_same(a_f, a_u)
+
+
+def test_fused_grad_req_add_matches_reference_loop():
+    """grad_req='add' carries the accumulators through the scan and
+    writes them back — same params AND same accumulated grad buffers
+    as the reference loop."""
+    grads = {}
+    args = {}
+    for fused in (True, False):
+        a, mod = _fit(fused, grad_req='add')
+        args[fused] = a
+        grads[fused] = {n: g.asnumpy().copy() for n, g in
+                        mod._exec_group.execs[0].grad_dict.items()
+                        if g is not None}
+    _assert_same(args[True], args[False])
+    _assert_same(grads[True], grads[False])
+
+
+def test_fused_custom_metric_host_mode_matches_reference_loop():
+    """A metric with no in-graph stats plan (user CustomMetric) runs in
+    host-fallback mode: same params and same per-batch metric values."""
+    def feval(label, pred):
+        return float(np.mean(np.abs(pred[np.arange(len(label)),
+                                         label.astype(int)] - 1.0)))
+    vf, vu = [], []
+    a_f, _ = _fit(True, metric=metric_mod.CustomMetric(feval, name='dist'),
+                  cb=lambda p: vf.append(p.eval_metric.get_name_value()[0][1]))
+    a_u, _ = _fit(False, metric=metric_mod.CustomMetric(feval, name='dist'),
+                  cb=lambda p: vu.append(p.eval_metric.get_name_value()[0][1]))
+    _assert_same(a_f, a_u)
+    np.testing.assert_allclose(vf, vu, rtol=1e-6, atol=1e-8)
+    assert len(vf) == 14
+
+
+@pytest.mark.parametrize('step_kind', ['aligned', 'mid_window'])
+def test_fused_scheduler_no_recompile_and_exact_equality(step_kind):
+    """lr enters the compiled window as traced per-batch rows: a
+    scheduler boundary yields the exact reference trajectory whether
+    it lands on a window edge or MID-window (round-5: per-step lr
+    sampling), with one compiled program despite the lr changing."""
     import mxnet_tpu.module.fused_fit as ff
     W = ff._window_size()
+    step = W if step_kind == 'aligned' else max(2, W - 1)
     results = {}
     for fused in (True, False):
         os.environ['MXTPU_FUSED_FIT'] = '1' if fused else '0'
         try:
             mod, it = _mlp_mod(n=64, batch=8)
-            sched = mx.lr_scheduler.FactorScheduler(step=W, factor=0.5)
+            sched = mx.lr_scheduler.FactorScheduler(step=step, factor=0.5)
             mod.fit(it, num_epoch=2, optimizer='sgd',
                     optimizer_params=(('learning_rate', 0.2),
                                       ('momentum', 0.9),
@@ -207,3 +274,81 @@ def test_fused_optimizer_state_roundtrip(tmp_path):
             continue
         np.testing.assert_allclose(a.asnumpy(), b.asnumpy(),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_fused_buffer_reusing_iterator_matches_reference_loop():
+    """Iterators may reuse their DataBatch/NDArray buffers between
+    batches (the reference engine copies on consumption): the fused
+    window snapshots the underlying jax arrays at draw time, so data,
+    labels, tail batches, and deferred host-metric application all see
+    each batch's own contents."""
+    from mxnet_tpu.io import DataBatch, DataDesc
+
+    class ReusingIter:
+        """Yields the SAME DataBatch/NDArray objects every batch,
+        mutating them in place."""
+
+        def __init__(self, X, Y, batch):
+            self.X, self.Y, self.batch = X, Y, batch
+            self._data = mx.nd.zeros((batch, X.shape[1]))
+            self._label = mx.nd.zeros((batch,))
+            self._b = DataBatch(data=[self._data], label=[self._label])
+            self.provide_data = [DataDesc('data', (batch, X.shape[1]))]
+            self.provide_label = [DataDesc('softmax_label', (batch,))]
+            self._i = 0
+
+        def __iter__(self):
+            return self
+
+        def reset(self):
+            self._i = 0
+
+        def __next__(self):
+            if (self._i + 1) * self.batch > len(self.X):
+                raise StopIteration
+            sl = slice(self._i * self.batch, (self._i + 1) * self.batch)
+            self._data[:] = self.X[sl]
+            self._label[:] = self.Y[sl]
+            self._i += 1
+            return self._b
+
+        next = __next__
+
+    def run(fused, metric, reuse):
+        os.environ['MXTPU_FUSED_FIT'] = '1' if fused else '0'
+        try:
+            mx.random.seed(11)
+            np.random.seed(11)
+            data = mx.sym.Variable('data')
+            fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+            act = mx.sym.Activation(fc1, act_type='relu')
+            fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+            out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+            X = np.random.randn(56, 10).astype(np.float32)
+            y = (np.random.rand(56) * 4).astype(int).astype(np.float32)
+            it = ReusingIter(X, y, 8) if reuse else \
+                mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False,
+                                  label_name='softmax_label')
+            mod = mx.mod.Module(out, context=mx.cpu())
+            traj = []
+            mod.fit(it, num_epoch=2, optimizer='sgd',
+                    optimizer_params=(('learning_rate', 0.1),
+                                      ('momentum', 0.9)),
+                    kvstore='local', eval_metric=metric,
+                    batch_end_callback=lambda p: traj.append(
+                        p.eval_metric.get_name_value()[0][1]))
+            args, _ = mod.get_params()
+            return {k: v.asnumpy() for k, v in args.items()}, traj
+        finally:
+            os.environ.pop('MXTPU_FUSED_FIT', None)
+
+    # oracle: the reference loop over a fresh-buffer iterator with the
+    # SAME data (the unfused loop on the reusing iterator itself reads
+    # labels after its prefetch overwrote them — the reference code's
+    # own draw-ahead ordering — so it is not the ground truth here)
+    for metric in ('acc', 'mse'):   # stats mode AND host-metric mode
+        a_f, t_f = run(True, metric, reuse=True)
+        a_u, t_u = run(False, metric, reuse=False)
+        _assert_same(a_f, a_u)
+        np.testing.assert_allclose(t_f, t_u, rtol=1e-6, atol=1e-8,
+                                   err_msg=metric)

@@ -189,6 +189,22 @@ def _s_mp_sgd_mom():
     np.testing.assert_allclose(outs[0].asnumpy(), want, rtol=1e-2)
 
 
+@spec('nag_mom_update')
+def _s_nag_mom():
+    r = _rng()
+    w, g, m = (r.randn(5).astype(np.float32) for _ in range(3))
+    attrs = {'lr': 0.1, 'momentum': 0.9, 'wd': 0.01, 'rescale_grad': 1.0,
+             'clip_gradient': -1.0}
+    w_nd, m_nd = _nd(w), _nd(m)
+    outs = _run('nag_mom_update', [w_nd, _nd(g), m_nd], attrs)
+    grad = g + 0.01 * w
+    mom = 0.9 * m + grad            # reference NAG: mom folds the grad,
+    want = w - 0.1 * (grad + 0.9 * mom)   # weight steps on the lookahead
+    np.testing.assert_allclose(m_nd.asnumpy(), mom, rtol=1e-5)
+    np.testing.assert_allclose(outs[0].asnumpy(), want, rtol=1e-5)
+    np.testing.assert_allclose(w_nd.asnumpy(), want, rtol=1e-5)
+
+
 @spec('rmsprop_update')
 def _s_rmsprop():
     r = _rng()
