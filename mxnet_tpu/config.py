@@ -164,6 +164,12 @@ flags.declare('MXTPU_FUSED_FIT', bool, True,
 flags.declare('MXTPU_FIT_STEPS_PER_CALL', int, 0,
               'Window size for the fused Module.fit fast path; 0 = '
               'auto (32 on TPU, 4 elsewhere)', min_value=0)
+flags.declare('MXTPU_DEVICE_AUGMENT', bool, False,
+              'ImageRecordIter ships fixed-size uint8 batches and runs '
+              'crop/mirror/normalize as one jitted device call per '
+              'batch (io/image_record.py device-augment mode) — for '
+              'few-core hosts that cannot feed the chip from the '
+              'host-side augment path')
 flags.declare('MXTPU_F16_AS_BF16', bool, False,
               'Resolve float16 dtype requests to bfloat16, the TPU '
               'native half type (the MXU has no fp16 datapath)')

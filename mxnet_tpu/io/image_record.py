@@ -121,7 +121,8 @@ class StreamingImageRecordIter:
                  rand_crop=False, rand_mirror=False, preprocess_threads=4,
                  prefetch_buffer=4, round_batch=True, resize=-1, pad=0,
                  fill_value=127, max_random_scale=1.0, min_random_scale=1.0,
-                 num_parts=1, part_index=0, aug_kwargs=None):
+                 num_parts=1, part_index=0, aug_kwargs=None,
+                 device_augment=False):
         self.path = path_imgrec
         self.data_shape = tuple(data_shape)
         self.batch_size = batch_size
@@ -146,6 +147,33 @@ class StreamingImageRecordIter:
                     'ImageRecordIter: augmenter %r is not applied by the '
                     'TPU pipeline (reference image_aug_default.cc '
                     'supports it; file an issue if needed)' % k,
+                    stacklevel=3)
+        # device-augment mode (VERDICT r4 #6 "feed the chip"): worker
+        # threads stop at a FIXED-SIZE uint8 HWC image — crop, mirror,
+        # and normalize move into one jitted device call per batch
+        # (io/__init__.py ImageRecordIter._device_aug). On a few-core
+        # host this removes the float conversion + crop from the
+        # decode-bound path; with RAW0 records host work is file reads.
+        self.device_augment = bool(int(device_augment))
+        self._src_hw = None
+        if self.device_augment:
+            C, H, W = self.data_shape
+            if self.resize > 0:
+                side = self.resize + 2 * self.pad
+                if side < max(H, W):
+                    raise ValueError(
+                        'device_augment: resize+2*pad (%d) must cover the '
+                        'crop %dx%d' % (side, H, W))
+                self._src_hw = (side, side)
+            if self.max_random_scale != 1.0 or self.min_random_scale != 1.0:
+                warnings.warn('device_augment: random scale jitter is not '
+                              'applied on-device; ignoring', stacklevel=3)
+            if self.resize > 0 and self.rand_crop:
+                warnings.warn(
+                    'device_augment: random crops sample from the CENTER '
+                    'square of the resized image (the host path samples '
+                    'the full resize-short rectangle) — the augmentation '
+                    'distribution differs on non-square sources',
                     stacklevel=3)
         # fused normalize: chw*scale, -mean, /std as ONE uint8->f32 LUT
         # per channel (the 3-pass float formulation costs ~1.7 ms per
@@ -213,6 +241,11 @@ class StreamingImageRecordIter:
             try:
                 B = self.batch_size
                 n = len(order)
+                if self.device_augment and self._src_hw is None and n:
+                    # infer the uniform source size on THIS thread before
+                    # the pool fans out (avoids a first-batch write race)
+                    reader.seek_pos(int(order[0]))
+                    self._decode_fixed(reader.read())
                 for start in range(0, n, B):
                     if stop.is_set():
                         return
@@ -232,11 +265,14 @@ class StreamingImageRecordIter:
                     # all augmentation randomness drawn HERE in bulk
                     # (one RandomState per batch, seeded from the epoch
                     # seed) — workers stay rng-free and cheap
-                    brng = np.random.RandomState(
-                        (seed + start) & 0x7fffffff)
-                    draws = brng.uniform(size=(len(idxs), 4))
-                    recs = list(pool.map(
-                        self._decode_augment, raws, draws))
+                    if self.device_augment:
+                        recs = list(pool.map(self._decode_fixed, raws))
+                    else:
+                        brng = np.random.RandomState(
+                            (seed + start) & 0x7fffffff)
+                        draws = brng.uniform(size=(len(idxs), 4))
+                        recs = list(pool.map(
+                            self._decode_augment, raws, draws))
                     data = np.stack([r[0] for r in recs])
                     label = np.stack([r[1] for r in recs])
                     if self.label_width == 1:
@@ -269,6 +305,66 @@ class StreamingImageRecordIter:
                         pass
 
     # -- per-image work (worker threads; numpy/PIL only, never jax) -------
+    def _label_of(self, header):
+        lab = np.atleast_1d(np.asarray(header.label, np.float32))
+        if self.label_width == 1:
+            return lab[:1]
+        return np.pad(lab[:self.label_width],
+                      (0, max(0, self.label_width - lab.size)))
+
+    def _coerce_channels(self, img):
+        C = self.data_shape[0]
+        if img.shape[2] != C:
+            if C == 3 and img.shape[2] == 1:
+                img = np.repeat(img, 3, axis=2)
+            elif C == 1:
+                img = img.mean(axis=2, keepdims=True).astype(img.dtype)
+        return img
+
+    def _decode_fixed(self, raw):
+        """device_augment worker: decode to a FIXED-SIZE uint8 HWC image
+        (resize-short + pad + center-crop-to-square when `resize` is
+        set; fixed-size records pass through, padded up to the crop
+        size if needed). All randomness and all float math happen on
+        device."""
+        header, payload = unpack(raw)
+        img = self._coerce_channels(_decode_hwc(payload))
+        _, H, W = self.data_shape
+        if self.resize > 0:
+            img = _resize_short(img, self.resize)
+            if self.pad > 0:
+                img = np.pad(img, ((self.pad, self.pad),
+                                   (self.pad, self.pad), (0, 0)),
+                             constant_values=self.fill_value)
+            S = self._src_hw[0]
+            ih, iw = img.shape[:2]
+            y, x = max(0, (ih - S) // 2), max(0, (iw - S) // 2)
+            img = img[y:y + S, x:x + S]
+            if img.shape[0] < S or img.shape[1] < S:
+                img = np.pad(img, ((0, S - img.shape[0]),
+                                   (0, S - img.shape[1]), (0, 0)),
+                             constant_values=self.fill_value)
+        else:
+            # same semantics as the host path: `pad` always applies,
+            # and undersized records are padded up to the crop size
+            if self.pad > 0:
+                img = np.pad(img, ((self.pad, self.pad),
+                                   (self.pad, self.pad), (0, 0)),
+                             constant_values=self.fill_value)
+            ih, iw = img.shape[:2]
+            if ih < H or iw < W:
+                img = np.pad(img, ((0, max(0, H - ih)),
+                                   (0, max(0, W - iw)), (0, 0)),
+                             constant_values=self.fill_value)
+            if self._src_hw is None:
+                self._src_hw = img.shape[:2]
+            if img.shape[:2] != self._src_hw:
+                raise ValueError(
+                    'device_augment without resize needs uniform record '
+                    'sizes: got %s after %s — set resize=<short side>'
+                    % (img.shape[:2], self._src_hw))
+        return img, self._label_of(header)
+
     def _decode_augment(self, raw, draws):
         """``draws`` = 4 uniforms from the producer's per-batch stream:
         (scale jitter, crop-y, crop-x, mirror coin)."""
@@ -304,20 +400,10 @@ class StreamingImageRecordIter:
         img = img[y:y + H, x:x + W]
         if self.rand_mirror and u_flip < 0.5:       # per-image coin
             img = img[:, ::-1]
-        if img.shape[2] != C:
-            if C == 3 and img.shape[2] == 1:
-                img = np.repeat(img, 3, axis=2)
-            elif C == 1:
-                img = img.mean(axis=2, keepdims=True).astype(img.dtype)
+        img = self._coerce_channels(img)
         # fused scale/mean/std via the per-channel uint8 LUT
         chw = np.empty((C, H, W), np.float32)
         for c in range(C):
             np.take(self._lut[c], img[:, :, c], out=chw[c])
 
-        lab = np.atleast_1d(np.asarray(header.label, np.float32))
-        if self.label_width == 1:
-            lab = lab[:1]
-        else:
-            lab = np.pad(lab[:self.label_width],
-                         (0, max(0, self.label_width - lab.size)))
-        return chw, lab
+        return chw, self._label_of(header)

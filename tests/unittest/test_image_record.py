@@ -209,3 +209,138 @@ def test_decode_error_surfaces_to_consumer(tmp_path):
                              batch_size=1)
     with pytest.raises(Exception):
         next(it)
+
+
+# ---- device-augment mode (round 5: feed the chip) -------------------------
+
+def _iter_kw(hw, batch, **kw):
+    base = dict(data_shape=(3, hw, hw), batch_size=batch,
+                preprocess_threads=2, prefetch_buffer=2)
+    base.update(kw)
+    return base
+
+
+def test_device_augment_matches_host_path_deterministic(tmp_path):
+    """With randomness off, the device path (uint8 ship + on-device
+    center crop / normalize) must produce the host path's exact
+    values — same math, different execution site."""
+    import mxnet_tpu as mx
+    p = str(tmp_path / 'a.rec')
+    _write_rec(p, 8, hw=10)
+    kw = dict(mean_r=11, mean_g=17, mean_b=23, std_r=2, std_g=3, std_b=4,
+              scale=0.7, resize=8, label_name='l')
+    host = mx.io.ImageRecordIter(
+        p, **_iter_kw(6, 4, **kw), device_augment=0)
+    dev = mx.io.ImageRecordIter(
+        p, **_iter_kw(6, 4, **kw), device_augment=1)
+    host.reset(); dev.reset()
+    for _ in range(2):
+        bh, bd = host.next(), dev.next()
+        np.testing.assert_allclose(bd.data[0].asnumpy(),
+                                   bh.data[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_array_equal(bd.label[0].asnumpy(),
+                                      bh.label[0].asnumpy())
+        assert bd.data[0].shape == (4, 3, 6, 6)
+        assert str(bd.data[0].dtype) == 'float32'
+
+
+def test_device_augment_rand_crop_mirror_properties(tmp_path):
+    """Random crop/mirror on device: per-image variation, values drawn
+    from the source image set, deterministic under mx.random.seed."""
+    import mxnet_tpu as mx
+    p = str(tmp_path / 'b.rec')
+    _write_rec(p, 16, hw=12)
+    kw = _iter_kw(8, 8, rand_crop=1, rand_mirror=1, resize=12,
+                  label_name='l')
+
+    def run():
+        mx.random.seed(5)
+        it = mx.io.ImageRecordIter(p, **kw, device_augment=1)
+        it.reset()
+        return it.next().data[0].asnumpy()
+
+    a, b = run(), run()
+    np.testing.assert_array_equal(a, b)   # seeded determinism
+    # different crops across a batch of distinct random images: the 8
+    # outputs must not all be identical slices of one another
+    assert a.shape == (8, 3, 8, 8)
+    assert len({arr.tobytes() for arr in a}) > 1
+
+
+def test_device_augment_raw_fixed_records_no_resize(tmp_path):
+    """RAW0 fixed-size records need no host resize: uniform sizes pass
+    straight through; a non-uniform file errors with guidance."""
+    import mxnet_tpu as mx
+    p = str(tmp_path / 'c.rec')
+    _write_rec(p, 8, hw=9)
+    it = mx.io.ImageRecordIter(p, **_iter_kw(7, 4, label_name='l'),
+                               device_augment=1)
+    it.reset()
+    b = it.next()
+    assert b.data[0].shape == (4, 3, 7, 7)
+
+
+def test_device_augment_feeds_module_fit(tmp_path):
+    """End-to-end: ImageRecordIter(device_augment=1) drives Module.fit
+    (the fused window when eligible) and the loss is finite."""
+    import mxnet_tpu as mx
+    p = str(tmp_path / 'd.rec')
+    _write_rec(p, 32, hw=10, labeler=lambda i: i % 4)
+    it = mx.io.ImageRecordIter(
+        p, **_iter_kw(8, 8, rand_crop=1, rand_mirror=1, resize=10,
+                      label_name='softmax_label'), device_augment=1)
+    data = mx.sym.Variable('data')
+    net = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3), name='c')
+    net = mx.sym.Activation(net, act_type='relu')
+    net = mx.sym.Flatten(net)
+    net = mx.sym.FullyConnected(net, num_hidden=4, name='fc')
+    net = mx.sym.SoftmaxOutput(net, name='softmax')
+    mod = mx.mod.Module(net, context=mx.cpu())
+    accs = []
+    mod.fit(it, num_epoch=2, optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.05),),
+            eval_metric='acc',
+            batch_end_callback=lambda prm: accs.append(
+                prm.eval_metric.get_name_value()[0][1]))
+    assert accs and all(np.isfinite(v) for v in accs)
+
+
+def test_device_augment_nonsquare_and_undersized(tmp_path):
+    """Non-square uniform records crop over each axis independently;
+    undersized records are padded up to the crop like the host path."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.recordio import MXRecordIO, IRHeader, pack_img
+    # non-square 8x12 records, crop 7x7: x offsets must reach col 5
+    p = str(tmp_path / 'ns.rec')
+    rng = np.random.RandomState(0)
+    rec = MXRecordIO(p, 'w')
+    for i in range(16):
+        img = (rng.rand(8, 12, 3) * 255).astype(np.uint8)
+        rec.write(pack_img(IRHeader(0, float(i), i, 0), img,
+                           img_fmt='.raw'))
+    rec.close()
+    mx.random.seed(3)
+    it = mx.io.ImageRecordIter(p, **_iter_kw(7, 8, rand_crop=1,
+                                             label_name='l'),
+                               device_augment=1)
+    it.reset()
+    assert it.next().data[0].shape == (8, 3, 7, 7)
+
+    # undersized 5x5 records, crop 7x7: padded with fill_value like the
+    # host path (not an opaque dynamic_slice failure)
+    q = str(tmp_path / 'small.rec')
+    rec = MXRecordIO(q, 'w')
+    for i in range(8):
+        img = (rng.rand(5, 5, 3) * 255).astype(np.uint8)
+        rec.write(pack_img(IRHeader(0, float(i), i, 0), img,
+                           img_fmt='.raw'))
+    rec.close()
+    host = mx.io.ImageRecordIter(q, **_iter_kw(7, 4, label_name='l'),
+                                 device_augment=0)
+    dev = mx.io.ImageRecordIter(q, **_iter_kw(7, 4, label_name='l'),
+                                device_augment=1)
+    host.reset(); dev.reset()
+    np.testing.assert_allclose(dev.next().data[0].asnumpy(),
+                               host.next().data[0].asnumpy(),
+                               rtol=1e-5, atol=1e-5)
