@@ -164,6 +164,11 @@ flags.declare('MXTPU_FUSED_FIT', bool, True,
 flags.declare('MXTPU_FIT_STEPS_PER_CALL', int, 0,
               'Window size for the fused Module.fit fast path; 0 = '
               'auto (32 on TPU, 4 elsewhere)', min_value=0)
+flags.declare('MXTPU_BN_ONEPASS', bool, True,
+              'BatchNorm training stats via one-pass moments '
+              '(sum/sum-of-squares in one fused HBM read of the '
+              'activation) instead of jnp.var\'s two-pass mean-then-'
+              'centered-square; 0 restores the two-pass form for A/B')
 flags.declare('MXTPU_DEVICE_AUGMENT', bool, False,
               'ImageRecordIter ships fixed-size uint8 batches and runs '
               'crop/mirror/normalize as one jitted device call per '

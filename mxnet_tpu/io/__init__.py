@@ -683,8 +683,10 @@ class ImageRecordIter(DataIter):
             # offsets range over each axis independently
             Sh, Sw = int(data_u8.shape[1]), int(data_u8.shape[2])
             p = self._aug_params
-            mean = jnp.asarray(p['mean'], jnp.float32)[:, None, None]
-            std = jnp.asarray(p['std'], jnp.float32)[:, None, None]
+            # slice to the target channel count (grayscale data_shape
+            # uses only the first channel's mean/std, like the host LUT)
+            mean = jnp.asarray(p['mean'][:C], jnp.float32)[:, None, None]
+            std = jnp.asarray(p['std'][:C], jnp.float32)[:, None, None]
             scale = jnp.float32(p['scale'])
             rand_crop, rand_mirror = p['rand_crop'], p['rand_mirror']
 

@@ -338,7 +338,12 @@ class StreamingImageRecordIter:
                              constant_values=self.fill_value)
             S = self._src_hw[0]
             ih, iw = img.shape[:2]
-            y, x = max(0, (ih - S) // 2), max(0, (iw - S) // 2)
+            # place the square so the device's later center crop lands
+            # exactly where the host path's single (long-crop)//2 crop
+            # would (the naive (long-S)//2 is off by 1 px when both
+            # parities are odd)
+            y = min(max(0, (ih - H) // 2 - (S - H) // 2), max(0, ih - S))
+            x = min(max(0, (iw - W) // 2 - (S - W) // 2), max(0, iw - S))
             img = img[y:y + S, x:x + S]
             if img.shape[0] < S or img.shape[1] < S:
                 img = np.pad(img, ((0, S - img.shape[0]),

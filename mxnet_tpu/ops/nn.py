@@ -107,6 +107,11 @@ def _channels_last_conv(data, weight, w_layout, **conv_kwargs):
     return jnp.transpose(out, to_first)
 
 
+def _bn_onepass():
+    from ..config import flags as _flags
+    return _flags.get('MXTPU_BN_ONEPASS')
+
+
 def _conv_nd(data, weight, stride, dilate, pad, groups):
     from ..config import flags as _flags
     if (_flags.get('MXTPU_CONV_STEM_S2D') and groups == 1
@@ -406,8 +411,24 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
         new_mm, new_mv = moving_mean, moving_var
     else:
         x32 = data.astype(jnp.float32)
-        mean = jnp.mean(x32, axis=reduce_axes)
-        var = jnp.var(x32, axis=reduce_axes)
+        if _bn_onepass():
+            # one-pass moments: sum and sum-of-squares reduce over the
+            # SAME read of x, so XLA's multi-output fusion computes the
+            # stats in ONE HBM pass of the activation instead of
+            # jnp.var's two (mean, then (x-mean)^2 — a data dependency
+            # no compiler can single-pass). f32 accumulation over the
+            # bf16 activations keeps E[x^2]-E[x]^2 cancellation benign
+            # at BN-scale ranges; var is clamped at 0 for safety.
+            # Role of the reference's single-pass CUDA stats kernel
+            # (src/operator/batch_norm.cu BatchNormalizationUpdateOutput).
+            n = x32.size // x32.shape[axis]
+            s1 = jnp.sum(x32, axis=reduce_axes)
+            s2 = jnp.sum(x32 * x32, axis=reduce_axes)
+            mean = s1 / n
+            var = jnp.maximum(s2 / n - mean * mean, 0.0)
+        else:               # MXTPU_BN_ONEPASS=0: the two-pass A/B base
+            mean = jnp.mean(x32, axis=reduce_axes)
+            var = jnp.var(x32, axis=reduce_axes)
         new_mm = momentum * moving_mean + (1 - momentum) * mean.astype(moving_mean.dtype)
         new_mv = momentum * moving_var + (1 - momentum) * var.astype(moving_var.dtype)
     inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)

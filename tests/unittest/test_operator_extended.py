@@ -1216,3 +1216,50 @@ def test_reshape_legacy_target_shape():
     out = mx.sym.Reshape(s, target_shape=(0,))
     _, oshape, _ = out.infer_shape(a=(2, 3, 4))
     assert tuple(oshape[0]) == (24,)
+
+
+def test_batchnorm_onepass_matches_twopass():
+    """MXTPU_BN_ONEPASS (one fused HBM read for sum/sumsq) must be a
+    pure scheduling change: training-mode outputs, moving-stat updates,
+    and input/param gradients match the two-pass jnp.var form."""
+    import subprocess
+    import sys
+    import os as _os
+    code = r'''
+import os
+os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'
+import jax; jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+import json
+import mxnet_tpu as mx
+from mxnet_tpu import autograd as ag
+
+np.random.seed(0)
+x = mx.nd.array((np.random.randn(4, 6, 5, 5) * 3 + 7).astype('float32'))
+g = mx.nd.array(np.random.rand(6).astype('float32') + 0.5)
+b = mx.nd.array(np.random.randn(6).astype('float32'))
+mm = mx.nd.zeros(6)
+mv = mx.nd.ones(6)
+x.attach_grad(); g.attach_grad()
+with ag.record():
+    y = mx.nd.BatchNorm(x, g, b, mm, mv, fix_gamma=False, eps=1e-3)
+    loss = (y * y).sum()
+loss.backward()
+out = {'y': y.asnumpy().tolist(), 'dx': x.grad.asnumpy().tolist(),
+       'dg': g.grad.asnumpy().tolist()}
+print(json.dumps(out))
+'''
+    outs = {}
+    for flag in ('0', '1'):
+        env = dict(_os.environ)
+        env['MXTPU_BN_ONEPASS'] = flag
+        env['JAX_PLATFORMS'] = 'cpu'
+        r = subprocess.run([sys.executable, '-c', code], env=env,
+                           capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr[-2000:]
+        import json
+        outs[flag] = json.loads(r.stdout.strip().splitlines()[-1])
+    for k in ('y', 'dx', 'dg'):
+        np.testing.assert_allclose(np.array(outs['1'][k]),
+                                   np.array(outs['0'][k]),
+                                   rtol=2e-5, atol=2e-5, err_msg=k)
