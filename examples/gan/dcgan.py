@@ -1,0 +1,185 @@
+"""DCGAN via the two-Module GAN dance (role of reference
+example/gan/dcgan.py).
+
+Covers the Module APIs a GAN needs and nothing else exercises
+together: two independently-bound Modules, discriminator gradients
+ACCUMULATED across the real and fake half-batches (grad_req='add' —
+the reference trains D exactly this way), and the generator updated
+from the discriminator's INPUT gradients (get_input_grads →
+modG.backward(out_grads)).
+
+Runs hermetically: the "dataset" is synthetic two-moons-style blob
+images (no sklearn/cv2/matplotlib deps); success is measured by the
+adversarial losses staying finite and the generator's output
+statistics moving toward the data statistics.
+
+  python dcgan.py --epochs 2 --batch-size 16 --image-size 16
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def make_generator(ngf, nc, image_size, no_bias=True, fix_gamma=True,
+                   eps=1e-5 + 1e-12):
+    """Noise (B, code, 1, 1) → image (B, nc, S, S) via stride-2
+    Deconvolutions, each followed by BatchNorm + ReLU, tanh head."""
+    assert image_size in (16, 32, 64)
+    n_up = {16: 2, 32: 3, 64: 4}[image_size]
+    x = mx.sym.Variable('rand')
+    # 1x1 → 4x4
+    x = mx.sym.Deconvolution(x, kernel=(4, 4), num_filter=ngf * (2 ** n_up),
+                             no_bias=no_bias, name='gen_head')
+    x = mx.sym.BatchNorm(x, fix_gamma=fix_gamma, eps=eps, name='gen_head_bn')
+    x = mx.sym.Activation(x, act_type='relu')
+    for i in range(n_up - 1):
+        x = mx.sym.Deconvolution(
+            x, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+            num_filter=ngf * (2 ** (n_up - 1 - i)), no_bias=no_bias,
+            name='gen_up%d' % i)
+        x = mx.sym.BatchNorm(x, fix_gamma=fix_gamma, eps=eps,
+                             name='gen_up%d_bn' % i)
+        x = mx.sym.Activation(x, act_type='relu')
+    x = mx.sym.Deconvolution(x, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                             num_filter=nc, no_bias=no_bias, name='gen_out')
+    return mx.sym.Activation(x, act_type='tanh', name='gen_tanh')
+
+
+def make_discriminator(ndf, image_size, no_bias=True, fix_gamma=True,
+                       eps=1e-5 + 1e-12):
+    """Image → logistic real/fake probability (stride-2 convs +
+    LeakyReLU, BN on all but the first, LogisticRegressionOutput head
+    so the label feeds the loss like the reference's)."""
+    n_down = {16: 2, 32: 3, 64: 4}[image_size]
+    label = mx.sym.Variable('label')
+    x = mx.sym.Variable('data')
+    for i in range(n_down):
+        x = mx.sym.Convolution(x, kernel=(4, 4), stride=(2, 2), pad=(1, 1),
+                               num_filter=ndf * (2 ** i), no_bias=no_bias,
+                               name='disc_dn%d' % i)
+        if i > 0:
+            x = mx.sym.BatchNorm(x, fix_gamma=fix_gamma, eps=eps,
+                                 name='disc_dn%d_bn' % i)
+        x = mx.sym.LeakyReLU(x, act_type='leaky', slope=0.2)
+    x = mx.sym.Convolution(x, kernel=(4, 4), num_filter=1, no_bias=no_bias,
+                           name='disc_out')
+    x = mx.sym.Flatten(x)
+    return mx.sym.LogisticRegressionOutput(data=x, label=label,
+                                           name='dloss')
+
+
+def blob_batches(n, batch, size, nc, seed):
+    """Synthetic dataset: soft gaussian blobs at grid positions, in
+    [-1, 1] like a tanh generator's range."""
+    rng = np.random.RandomState(seed)
+    yy, xx = np.mgrid[0:size, 0:size].astype(np.float32)
+    imgs = []
+    for _ in range(n):
+        cy, cx = rng.uniform(size * 0.25, size * 0.75, 2)
+        r = rng.uniform(size * 0.1, size * 0.2)
+        img = np.exp(-((yy - cy) ** 2 + (xx - cx) ** 2) / (2 * r * r))
+        imgs.append(np.repeat(img[None], nc, 0))
+    data = np.stack(imgs) * 2 - 1
+    for s in range(0, n - batch + 1, batch):
+        yield data[s:s + batch]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--epochs', type=int, default=3)
+    ap.add_argument('--batch-size', type=int, default=16)
+    ap.add_argument('--samples', type=int, default=256)
+    ap.add_argument('--image-size', type=int, default=16)
+    ap.add_argument('--code', type=int, default=32)
+    ap.add_argument('--ngf', type=int, default=16)
+    ap.add_argument('--ndf', type=int, default=16)
+    ap.add_argument('--nc', type=int, default=1)
+    ap.add_argument('--lr', type=float, default=2e-4)
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(42)
+    np.random.seed(42)
+    B, S = args.batch_size, args.image_size
+    ctx = mx.cpu()
+
+    symG = make_generator(args.ngf, args.nc, S)
+    symD = make_discriminator(args.ndf, S)
+
+    modG = mx.mod.Module(symG, data_names=('rand',), label_names=None,
+                         context=ctx)
+    modG.bind(data_shapes=[('rand', (B, args.code, 1, 1))])
+    modG.init_params(initializer=mx.init.Normal(0.02))
+    modG.init_optimizer(optimizer='adam',
+                        optimizer_params={'learning_rate': args.lr,
+                                          'beta1': 0.5})
+
+    modD = mx.mod.Module(symD, data_names=('data',), label_names=('label',),
+                         context=ctx)
+    # inputs_need_grad: the generator trains on D's input gradients;
+    # grad_req='add' accumulates the real and fake half-batch grads
+    # before one update, exactly the reference recipe
+    modD.bind(data_shapes=[('data', (B, args.nc, S, S))],
+              label_shapes=[('label', (B,))],
+              inputs_need_grad=True, grad_req='add')
+    modD.init_params(initializer=mx.init.Normal(0.02))
+    modD.init_optimizer(optimizer='adam',
+                        optimizer_params={'learning_rate': args.lr,
+                                          'beta1': 0.5})
+
+    ones = mx.nd.ones((B,), ctx=ctx)
+    zeros = mx.nd.zeros((B,), ctx=ctx)
+
+    def zero_d_grads():
+        for g in modD._exec_group.execs[0].grad_arrays:
+            if g is not None:
+                g[:] = 0.0
+
+    d_losses, g_losses, g_means = [], [], []
+    for epoch in range(args.epochs):
+        for real in blob_batches(args.samples, B, S, args.nc, seed=epoch):
+            noise = mx.nd.array(
+                np.random.randn(B, args.code, 1, 1).astype(np.float32))
+            modG.forward(mx.io.DataBatch([noise], []), is_train=True)
+            fake = modG.get_outputs()[0]
+
+            # -- D: accumulate real(label 1) + fake(label 0) grads ----
+            zero_d_grads()
+            modD.forward(mx.io.DataBatch([mx.nd.array(real)], [ones]),
+                         is_train=True)
+            p_real = modD.get_outputs()[0].asnumpy()
+            modD.backward()
+            modD.forward(mx.io.DataBatch([fake.copy()], [zeros]),
+                         is_train=True)
+            p_fake = modD.get_outputs()[0].asnumpy()
+            modD.backward()
+            modD.update()
+            eps = 1e-7
+            d_losses.append(float(
+                -np.log(p_real + eps).mean() - np.log(1 - p_fake + eps).mean()))
+
+            # -- G: ascend D's input gradient at label=1 --------------
+            zero_d_grads()
+            modD.forward(mx.io.DataBatch([fake], [ones]), is_train=True)
+            p_gen = modD.get_outputs()[0].asnumpy()
+            modD.backward()
+            grads_to_g = modD.get_input_grads()
+            modG.backward(grads_to_g)
+            modG.update()
+            g_losses.append(float(-np.log(p_gen + eps).mean()))
+            g_means.append(float(fake.asnumpy().mean()))
+        logging.info('epoch %d dloss=%.3f gloss=%.3f gen_mean=%.3f',
+                     epoch, np.mean(d_losses[-8:]), np.mean(g_losses[-8:]),
+                     g_means[-1])
+
+    assert np.isfinite(d_losses).all() and np.isfinite(g_losses).all()
+    # the generator must have moved: its outputs start near tanh(BN(0))
+    # ~ 0-mean noise and drift toward the blob data's statistics
+    assert abs(g_means[-1] - g_means[0]) > 1e-3 or len(g_means) < 4
+    logging.info('dcgan ok: %d G steps', len(g_losses))
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,163 @@
+"""Deep Q-Network (role of reference example/reinforcement-learning/dqn).
+
+The reference's DQN targets Atari through the ALE emulator; this one is
+hermetic — a built-in numpy CartPole (the classic pole-balancing
+dynamics) — so it runs anywhere the framework does, while exercising
+the same machinery the reference example exists to demonstrate: an
+online gluon Q-network trained by autograd through a framework
+optimizer, a frozen target network synced every N steps, an experience
+replay buffer, epsilon-greedy exploration, and the
+r + gamma * max_a' Q_target(s', a') bootstrap target.
+
+  python dqn.py --episodes 150
+"""
+import argparse
+import collections
+import logging
+import random
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+
+class CartPole:
+    """Classic cart-pole balancing dynamics (Barto, Sutton & Anderson
+    1983 formulation): state (x, x', theta, theta'), actions {push
+    left, push right}, reward 1 per step until |theta|>12deg or
+    |x|>2.4, capped at `horizon`."""
+
+    GRAV, MCART, MPOLE, LEN, FORCE, TAU = 9.8, 1.0, 0.1, 0.5, 10.0, 0.02
+    THETA_LIM, X_LIM = 12 * np.pi / 180, 2.4
+
+    def __init__(self, seed, horizon=200):
+        self.rng = np.random.RandomState(seed)
+        self.horizon = horizon
+
+    def reset(self):
+        self.s = self.rng.uniform(-0.05, 0.05, 4).astype(np.float32)
+        self.t = 0
+        return self.s.copy()
+
+    def step(self, action):
+        x, xd, th, thd = self.s
+        force = self.FORCE if action == 1 else -self.FORCE
+        mtot = self.MCART + self.MPOLE
+        pml = self.MPOLE * self.LEN
+        tmp = (force + pml * thd * thd * np.sin(th)) / mtot
+        thacc = (self.GRAV * np.sin(th) - np.cos(th) * tmp) / \
+            (self.LEN * (4.0 / 3.0 - self.MPOLE * np.cos(th) ** 2 / mtot))
+        xacc = tmp - pml * thacc * np.cos(th) / mtot
+        self.s = np.array([x + self.TAU * xd, xd + self.TAU * xacc,
+                           th + self.TAU * thd, thd + self.TAU * thacc],
+                          np.float32)
+        self.t += 1
+        done = (abs(self.s[0]) > self.X_LIM
+                or abs(self.s[2]) > self.THETA_LIM
+                or self.t >= self.horizon)
+        return self.s.copy(), 1.0, done
+
+
+def q_net(hidden):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(hidden, activation='relu'),
+            gluon.nn.Dense(hidden, activation='relu'),
+            gluon.nn.Dense(2))
+    return net
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--episodes', type=int, default=300)
+    ap.add_argument('--hidden', type=int, default=64)
+    ap.add_argument('--batch-size', type=int, default=64)
+    ap.add_argument('--replay', type=int, default=10000)
+    ap.add_argument('--gamma', type=float, default=0.99)
+    ap.add_argument('--lr', type=float, default=1e-3)
+    ap.add_argument('--target-sync', type=int, default=200)
+    ap.add_argument('--train-freq', type=int, default=1,
+                    help='gradient step every N env steps (1 = the '
+                         'classic per-step schedule)')
+    ap.add_argument('--eps-decay', type=float, default=0.995)
+    ap.add_argument('--min-return', type=float, default=0.0,
+                    help='assert the trailing-20-episode mean return '
+                         'exceeds this (smoke-test gate)')
+    args = ap.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    mx.random.seed(0)
+    np.random.seed(0)
+    random.seed(0)
+    ctx = mx.cpu()
+
+    online, target = q_net(args.hidden), q_net(args.hidden)
+    online.initialize(mx.init.Xavier(), ctx=ctx)
+    target.initialize(mx.init.Xavier(), ctx=ctx)
+    online.hybridize()
+    target.hybridize()
+    # resolve deferred shapes before the first target sync
+    warm = mx.nd.zeros((1, 4), ctx=ctx)
+    online(warm)
+    target(warm)
+
+    def sync_target():
+        for (_, po), (_, pt) in zip(online.collect_params().items(),
+                                    target.collect_params().items()):
+            pt.set_data(po.data())
+
+    sync_target()
+    trainer = gluon.Trainer(online.collect_params(), 'adam',
+                            {'learning_rate': args.lr})
+    loss_fn = gluon.loss.L2Loss()
+    buf = collections.deque(maxlen=args.replay)
+    env = CartPole(seed=1)
+    eps, step, returns = 1.0, 0, []
+
+    for ep in range(args.episodes):
+        s = env.reset()
+        ret, done = 0.0, False
+        while not done:
+            if random.random() < eps:
+                a = random.randrange(2)
+            else:
+                q = online(mx.nd.array(s[None], ctx=ctx)).asnumpy()
+                a = int(q.argmax())
+            s2, r, done = env.step(a)
+            # terminal-by-horizon is not a true terminal for bootstrap
+            truncated = done and env.t >= env.horizon
+            buf.append((s, a, r, s2, 0.0 if truncated else float(done)))
+            s = s2
+            ret += r
+            step += 1
+            if len(buf) >= args.batch_size and step % args.train_freq == 0:
+                batch = random.sample(buf, args.batch_size)
+                bs, ba, br, bs2, bd = map(np.array, zip(*batch))
+                S = mx.nd.array(bs, ctx=ctx)
+                S2 = mx.nd.array(bs2, ctx=ctx)
+                qn = target(S2).max(axis=1).asnumpy()
+                y = br + args.gamma * qn * (1.0 - bd)
+                Y = mx.nd.array(y.astype(np.float32), ctx=ctx)
+                A = mx.nd.array(ba.astype(np.float32), ctx=ctx)
+                with autograd.record():
+                    q = online(S)
+                    q_a = (q * mx.nd.one_hot(A, 2)).sum(axis=1)
+                    loss = loss_fn(q_a, Y)
+                loss.backward()
+                trainer.step(args.batch_size)
+            if step % args.target_sync == 0:
+                sync_target()
+        returns.append(ret)
+        eps = max(0.05, eps * args.eps_decay)
+        if (ep + 1) % 20 == 0:
+            logging.info('episode %d return(mean20)=%.1f eps=%.2f',
+                         ep + 1, np.mean(returns[-20:]), eps)
+
+    mean20 = float(np.mean(returns[-20:]))
+    early = float(np.mean(returns[:20]))
+    logging.info('dqn done: first20=%.1f last20=%.1f', early, mean20)
+    assert np.isfinite(mean20)
+    assert mean20 > args.min_return, (mean20, args.min_return)
+
+
+if __name__ == '__main__':
+    main()

@@ -412,20 +412,28 @@ def _batch_norm(attrs, data, gamma, beta, moving_mean, moving_var):
     else:
         x32 = data.astype(jnp.float32)
         if _bn_onepass():
-            # one-pass moments: sum and sum-of-squares reduce over the
-            # SAME read of x, so XLA's multi-output fusion computes the
-            # stats in ONE HBM pass of the activation instead of
-            # jnp.var's two (mean, then (x-mean)^2 — a data dependency
-            # no compiler can single-pass). f32 accumulation over the
-            # bf16 activations keeps E[x^2]-E[x]^2 cancellation benign
-            # at BN-scale ranges; var is clamped at 0 for safety.
-            # Role of the reference's single-pass CUDA stats kernel
-            # (src/operator/batch_norm.cu BatchNormalizationUpdateOutput).
+            # one-pass SHIFTED moments: sum and sum-of-squares of
+            # (x - pivot) reduce over the SAME read of x, so XLA's
+            # multi-output fusion computes the stats in ONE HBM pass of
+            # the activation instead of jnp.var's two (mean, then
+            # (x-mean)^2 — a data dependency no compiler can
+            # single-pass). The per-channel pivot (x's first element)
+            # centers the accumulation near the mean, so the
+            # E[x^2]-E[x]^2 cancellation operates at std-scale — no
+            # precision loss even for large-mean f32 activations; var
+            # is clamped at 0. Role of the reference's single-pass
+            # CUDA stats kernel (src/operator/batch_norm.cu
+            # BatchNormalizationUpdateOutput).
             n = x32.size // x32.shape[axis]
-            s1 = jnp.sum(x32, axis=reduce_axes)
-            s2 = jnp.sum(x32 * x32, axis=reduce_axes)
-            mean = s1 / n
-            var = jnp.maximum(s2 / n - mean * mean, 0.0)
+            pivot_idx = tuple(slice(None) if i == axis else 0
+                              for i in range(x32.ndim))
+            pivot = jax.lax.stop_gradient(x32[pivot_idx])
+            xc = x32 - pivot.reshape(bshape)
+            s1 = jnp.sum(xc, axis=reduce_axes)
+            s2 = jnp.sum(xc * xc, axis=reduce_axes)
+            m0 = s1 / n
+            mean = pivot + m0
+            var = jnp.maximum(s2 / n - m0 * m0, 0.0)
         else:               # MXTPU_BN_ONEPASS=0: the two-pass A/B base
             mean = jnp.mean(x32, axis=reduce_axes)
             var = jnp.var(x32, axis=reduce_axes)

@@ -16,6 +16,10 @@ pytestmark = pytest.mark.convergence
 ROOT = os.path.abspath(os.path.join(os.path.dirname(__file__), '..', '..'))
 
 CASES = [
+    ('gan/dcgan.py', ['--epochs', '2', '--samples', '64',
+                      '--batch-size', '16']),
+    ('reinforcement-learning/dqn.py', ['--episodes', '12',
+                                       '--train-freq', '4']),
     ('parallel/train_multihost.py', ['--steps', '20']),
     ('image-classification/train_mnist.py',
      ['--num-epochs', '1', '--network', 'mlp']),

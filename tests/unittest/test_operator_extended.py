@@ -1235,7 +1235,9 @@ import mxnet_tpu as mx
 from mxnet_tpu import autograd as ag
 
 np.random.seed(0)
-x = mx.nd.array((np.random.randn(4, 6, 5, 5) * 3 + 7).astype('float32'))
+import sys as _sys
+_mean = float(_sys.argv[1]) if len(_sys.argv) > 1 else 7.0
+x = mx.nd.array((np.random.randn(4, 6, 5, 5) * 3 + _mean).astype('float32'))
 g = mx.nd.array(np.random.rand(6).astype('float32') + 0.5)
 b = mx.nd.array(np.random.randn(6).astype('float32'))
 mm = mx.nd.zeros(6)
@@ -1249,17 +1251,37 @@ out = {'y': y.asnumpy().tolist(), 'dx': x.grad.asnumpy().tolist(),
        'dg': g.grad.asnumpy().tolist()}
 print(json.dumps(out))
 '''
-    outs = {}
-    for flag in ('0', '1'):
+    def run(flag, mean):
         env = dict(_os.environ)
         env['MXTPU_BN_ONEPASS'] = flag
         env['JAX_PLATFORMS'] = 'cpu'
-        r = subprocess.run([sys.executable, '-c', code], env=env,
+        r = subprocess.run([sys.executable, '-c', code, mean], env=env,
                            capture_output=True, text=True, timeout=300)
         assert r.returncode == 0, r.stderr[-2000:]
         import json
-        outs[flag] = json.loads(r.stdout.strip().splitlines()[-1])
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    # BN-typical regime: the two forms agree to float tolerance
+    outs = {flag: run(flag, '7') for flag in ('0', '1')}
     for k in ('y', 'dx', 'dg'):
         np.testing.assert_allclose(np.array(outs['1'][k]),
                                    np.array(outs['0'][k]),
                                    rtol=2e-5, atol=2e-5, err_msg=k)
+
+    # catastrophic-cancellation regime (mean >> std): BOTH f32 forms
+    # carry rounding error vs a float64 oracle here — the shifted-pivot
+    # one-pass must be at least as accurate as the two-pass jnp.var
+    np.random.seed(0)
+    x64 = (np.random.randn(4, 6, 5, 5) * 3 + 10000).astype(np.float32) \
+        .astype(np.float64)
+    g64 = (np.random.rand(6).astype(np.float32) + 0.5).astype(np.float64)
+    b64 = np.random.randn(6).astype(np.float32).astype(np.float64)
+    mean64 = x64.mean(axis=(0, 2, 3))
+    var64 = x64.var(axis=(0, 2, 3))
+    y64 = (x64 - mean64[None, :, None, None]) * \
+        (g64 / np.sqrt(var64 + 1e-3))[None, :, None, None] + \
+        b64[None, :, None, None]
+    outs = {flag: run(flag, '10000') for flag in ('0', '1')}
+    err1 = np.abs(np.array(outs['1']['y']) - y64).max()
+    err0 = np.abs(np.array(outs['0']['y']) - y64).max()
+    assert err1 <= err0 * 1.5 + 1e-6, (err1, err0)

@@ -1,0 +1,40 @@
+# Shared helpers for the chained round-5 capture scripts. Source me:
+#   . "$(dirname "$0")/tpu_capture_lib.sh"
+#
+# Discipline (memory: a second prober deepens a tunnel wedge):
+# - exactly ONE process probes the chip at a time; a chained script
+#   must HARD-FAIL (exit) if its predecessor never finishes, never
+#   fall through into concurrent probing/benching.
+
+# wait_for_predecessor <logfile> <done-regex> <proc-pattern>
+# Returns 0 when the predecessor finished (sentinel in its log or its
+# process gone); exits 1 if it is still alive when patience runs out.
+wait_for_predecessor() {
+  local log=$1 done_re=$2 pat=$3
+  for i in $(seq 1 140); do   # ~14 h patience
+    if grep -q "$done_re" "$log" 2>/dev/null; then
+      echo "predecessor finished (sentinel)"
+      return 0
+    fi
+    if ! pgrep -f "$pat" > /dev/null 2>&1; then
+      echo "predecessor process gone"
+      return 0
+    fi
+    sleep 360
+  done
+  echo "predecessor still running after patience window; NOT probing" \
+       "concurrently — giving up"
+  exit 1
+}
+
+probe_until_healthy() {
+  for i in $(seq 1 40); do
+    echo "$(date -u +%H:%M:%S) probe $i"
+    if timeout 240 python -c 'import jax; assert any(d.platform=="tpu" for d in jax.devices())' 2>/dev/null; then
+      echo "$(date -u +%H:%M:%S) chip healthy"
+      return 0
+    fi
+    sleep 480
+  done
+  return 1
+}

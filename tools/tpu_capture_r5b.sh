@@ -1,7 +1,7 @@
 #!/bin/bash
 # Chained round-5 capture, part B: waits for tpu_capture_r5.sh to
-# finish (DONE sentinel in its log, or its process exiting), then banks
-# the round-5 feature artifacts on the next healthy window:
+# finish, then banks the round-5 feature artifacts on the next healthy
+# window:
 #   1. fed_fit_bench — ImageRecordIter(device_augment) -> Module.fit
 #      ResNet-50 on chip (VERDICT r4 #6 "feed the chip")
 #   2. tests/tpu consistency tier (device-placement paths, incl. the
@@ -11,35 +11,14 @@
 #   setsid nohup bash tools/tpu_capture_r5b.sh > /tmp/capture_r5b.log 2>&1 < /dev/null &
 set -u
 cd "$(dirname "$0")/.."
+. tools/tpu_capture_lib.sh
 OUT=docs/tpu_artifacts
 mkdir -p "$OUT"
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
 echo "R5B CAPTURE STAMP=$STAMP"
 
-# -- wait for part A (single prober discipline: never probe while A runs)
-for i in $(seq 1 100); do
-  if grep -q 'R5 CAPTURE ALL DONE\|gave up before' /tmp/capture_r5.log 2>/dev/null; then
-    echo "part A finished (sentinel)"
-    break
-  fi
-  if ! pgrep -f 'tools/tpu_capture_r5\.sh' > /dev/null 2>&1; then
-    echo "part A process gone"
-    break
-  fi
-  sleep 360
-done
-
-probe_until_healthy() {
-  for i in $(seq 1 40); do
-    echo "$(date -u +%H:%M:%S) probe $i"
-    if timeout 240 python -c 'import jax; assert any(d.platform=="tpu" for d in jax.devices())' 2>/dev/null; then
-      echo "$(date -u +%H:%M:%S) chip healthy"
-      return 0
-    fi
-    sleep 480
-  done
-  return 1
-}
+wait_for_predecessor /tmp/capture_r5.log \
+  'R5 CAPTURE ALL DONE|gave up before' 'tools/tpu_capture_r5\.sh'
 
 probe_until_healthy || { echo "gave up before fed_fit"; exit 1; }
 echo "== fed_fit_bench (device_augment, RAW0) =="

@@ -9,34 +9,14 @@
 #   setsid nohup bash tools/tpu_capture_r5c.sh > /tmp/capture_r5c.log 2>&1 < /dev/null &
 set -u
 cd "$(dirname "$0")/.."
+. tools/tpu_capture_lib.sh
 OUT=docs/tpu_artifacts
 mkdir -p "$OUT"
 STAMP=$(date -u +%Y%m%dT%H%M%SZ)
 echo "R5C CAPTURE STAMP=$STAMP"
 
-for i in $(seq 1 100); do
-  if grep -q 'R5B CAPTURE ALL DONE\|gave up before' /tmp/capture_r5b.log 2>/dev/null; then
-    echo "part B finished (sentinel)"
-    break
-  fi
-  if ! pgrep -f 'tools/tpu_capture_r5b\.sh' > /dev/null 2>&1; then
-    echo "part B process gone"
-    break
-  fi
-  sleep 360
-done
-
-probe_until_healthy() {
-  for i in $(seq 1 40); do
-    echo "$(date -u +%H:%M:%S) probe $i"
-    if timeout 240 python -c 'import jax; assert any(d.platform=="tpu" for d in jax.devices())' 2>/dev/null; then
-      echo "$(date -u +%H:%M:%S) chip healthy"
-      return 0
-    fi
-    sleep 480
-  done
-  return 1
-}
+wait_for_predecessor /tmp/capture_r5b.log \
+  'R5B CAPTURE ALL DONE|gave up before' 'tools/tpu_capture_r5b\.sh'
 
 probe_until_healthy || { echo "gave up before bn A/B"; exit 1; }
 echo "== bench (MXTPU_BN_ONEPASS=0 control) =="
@@ -45,7 +25,7 @@ MXTPU_BN_ONEPASS=0 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
 echo "rc=$?"; tail -1 "$OUT/bench_bn_twopass_$STAMP.json"
 grep -o "loss=[^,]*" "$OUT/bench_bn_twopass_$STAMP.log" | tail -1
 
-# one-pass run under the same fresh window, so the A/B shares a window
+# one-pass run chasing the same window, so the A/B pair is comparable
 probe_until_healthy || { echo "gave up before bn onepass"; exit 1; }
 echo "== bench (MXTPU_BN_ONEPASS=1, same window) =="
 MXTPU_BN_ONEPASS=1 MXTPU_BENCH_BUDGET=900 timeout 1200 python bench.py \
