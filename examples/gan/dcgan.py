@@ -99,6 +99,8 @@ def main():
     ap.add_argument('--nc', type=int, default=1)
     ap.add_argument('--lr', type=float, default=2e-4)
     args = ap.parse_args()
+    assert args.samples >= args.batch_size, \
+        '--samples must cover at least one batch'
     logging.basicConfig(level=logging.INFO)
     mx.random.seed(42)
     np.random.seed(42)
@@ -133,9 +135,10 @@ def main():
     zeros = mx.nd.zeros((B,), ctx=ctx)
 
     def zero_d_grads():
-        for g in modD._exec_group.execs[0].grad_arrays:
-            if g is not None:
-                g[:] = 0.0
+        for e in modD._exec_group.execs:
+            for g in e.grad_arrays:
+                if g is not None:
+                    g[:] = 0.0
 
     d_losses, g_losses, g_means = [], [], []
     for epoch in range(args.epochs):

@@ -12,7 +12,7 @@
 wait_for_predecessor() {
   local log=$1 done_re=$2 pat=$3
   for i in $(seq 1 140); do   # ~14 h patience
-    if grep -q "$done_re" "$log" 2>/dev/null; then
+    if grep -qE "$done_re" "$log" 2>/dev/null; then
       echo "predecessor finished (sentinel)"
       return 0
     fi
