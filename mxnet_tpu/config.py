@@ -164,6 +164,13 @@ flags.declare('MXTPU_FUSED_FIT', bool, True,
 flags.declare('MXTPU_FIT_STEPS_PER_CALL', int, 0,
               'Window size for the fused Module.fit fast path; 0 = '
               'auto (32 on TPU, 4 elsewhere)', min_value=0)
+flags.declare('MXTPU_SHARDED_UPDATE', bool, True,
+              'Cross-replica weight-update sharding in the SPMD fused '
+              'fit window (arXiv:2004.13336): grads reduce-scatter, '
+              'each replica updates 1/dp of every dividing param, '
+              'weights all-gather — update HBM traffic and optimizer '
+              'math scale down by the dp factor; 0 keeps the '
+              'replicated update')
 flags.declare('MXTPU_BN_ONEPASS', bool, True,
               'BatchNorm training stats via one-pass moments '
               '(sum/sum-of-squares in one fused HBM read of the '

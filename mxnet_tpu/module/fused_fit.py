@@ -71,6 +71,12 @@ def _window_size():
     return 32 if jax.default_backend() == 'tpu' else 4
 
 
+def _shard_update_enabled():
+    from ..config import flags
+    flags.reload('MXTPU_SHARDED_UPDATE')
+    return flags.get('MXTPU_SHARDED_UPDATE')
+
+
 def _is_half(dt):
     return str(dt) in ('float16', 'bfloat16')
 
@@ -449,6 +455,27 @@ class FusedFitLoop:
         stat_fns = self.stat_fns
         accum = self._accum
         W = self.window
+        mesh = self._mesh
+        shard_update = _shard_update_enabled() and mesh is not None
+        if shard_update:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            dp = mesh.shape['dp']
+            row = NamedSharding(mesh, P('dp'))
+            rep = NamedSharding(mesh, P())
+
+            def to_shards(t):
+                """Constrain a tensor to row-sharding over dp for the
+                weight update when its leading dim divides dp (the
+                cross-replica weight-update sharding of
+                arXiv:2004.13336: the grad's all-reduce becomes a
+                reduce-scatter, each replica updates 1/dp of the
+                param, and the write-back all-gathers)."""
+                if t.ndim >= 1 and t.shape[0] % dp == 0:
+                    return jax.lax.with_sharding_constraint(t, row)
+                return t
+
+            def to_replicated(t):
+                return jax.lax.with_sharding_constraint(t, rep)
 
         def window_fn(params, states, aux, gaccs, data_stack, label_stack,
                       key, lr_arr, wd_arr):
@@ -487,15 +514,26 @@ class FusedFitLoop:
                     attrs = dict(static_attrs)
                     attrs['lr'] = lr_row[j]   # traced: scheduler-safe
                     attrs['wd'] = wd_row[j]
+                    w, g = params[ci], grads[j]
+                    st = states[j]
+                    if shard_update:
+                        w, g = to_shards(w), to_shards(g)
+                        st = tuple(to_shards(s) for s in st)
                     # every fused update op returns (w, *states) with
                     # states in input order — application is generic
-                    res = ops[modes[n]].fn(attrs, params[ci], grads[j],
-                                           *states[j])
-                    if isinstance(res, tuple):
-                        new_params[ci] = res[0]
+                    res = ops[modes[n]].fn(attrs, w, g, *st)
+                    if not isinstance(res, tuple):
+                        res = (res,)
+                    if shard_update:
+                        # only the WEIGHT re-gathers (the next forward
+                        # needs it whole); optimizer states stay
+                        # dp-sharded through the scan carry — the ZeRO
+                        # layout — and the body's to_shards on entry
+                        # keeps the carry's sharding equilibrium
+                        res = (to_replicated(res[0]),) + res[1:]
+                    new_params[ci] = res[0]
+                    if len(res) > 1:
                         new_states[j] = tuple(res[1:])
-                    else:
-                        new_params[ci] = res
                 if stat_fns is not None:
                     # all metric stats packed into ONE vector per step
                     # so the host needs a single fetch per window (each
