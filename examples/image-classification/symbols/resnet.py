@@ -58,13 +58,18 @@ def residual_unit(data, num_filter, stride, dim_match, name, bottleneck):
 
 
 def get_symbol(num_classes=1000, num_layers=50, image_shape='3,224,224',
-               **kwargs):
+               dtype='float32', **kwargs):
     bottleneck, units = _CONFIGS[num_layers]
     channels = [int(x) for x in image_shape.split(',')][0]  # noqa: F841
     filters = ([64, 256, 512, 1024, 2048] if bottleneck
                else [64, 64, 128, 256, 512])
 
     data = mx.sym.Variable('data')
+    if dtype == 'float16':
+        # the reference symbol's fp16 mode: one cast after data, so
+        # every weight downstream infers half precision (bf16 under
+        # MXTPU_F16_AS_BF16); the loss head computes in fp32 below
+        data = mx.sym.Cast(data=data, dtype='float16')
     body = mx.sym.Convolution(data=data, num_filter=filters[0],
                               kernel=(7, 7), stride=(2, 2), pad=(3, 3),
                               no_bias=True, name='conv0')
@@ -87,4 +92,6 @@ def get_symbol(num_classes=1000, num_layers=50, image_shape='3,224,224',
                            pool_type='avg', name='pool1')
     flat = mx.sym.Flatten(data=pool1)
     fc1 = mx.sym.FullyConnected(data=flat, num_hidden=num_classes, name='fc1')
+    if dtype == 'float16':
+        fc1 = mx.sym.Cast(data=fc1, dtype='float32')
     return mx.sym.SoftmaxOutput(data=fc1, name='softmax')

@@ -46,6 +46,11 @@ def main():
     parser.add_argument('--data-train', default=None,
                         help='RecordIO file of packed images')
     parser.add_argument('--model-prefix', default=None)
+    parser.add_argument('--dtype', default='float32',
+                        choices=['float32', 'float16'],
+                        help='float16 casts after data so every weight '
+                             'trains in half precision (bf16 on TPU '
+                             'under MXTPU_F16_AS_BF16)')
     args = parser.parse_args()
     logging.basicConfig(level=logging.INFO)
 
@@ -59,14 +64,16 @@ def main():
             batch_size=args.batch_size, shuffle=True)
 
     sym = get_symbol(num_classes=args.num_classes,
-                     num_layers=args.num_layers, image_shape=args.image_shape)
+                     num_layers=args.num_layers,
+                     image_shape=args.image_shape, dtype=args.dtype)
     mod = mx.mod.Module(symbol=sym, context=mx.current_context())
     mod.fit(train,
             eval_metric=['acc'],
             kvstore=args.kv_store,
             optimizer='sgd',
             optimizer_params={'learning_rate': args.lr, 'momentum': 0.9,
-                              'wd': 1e-4},
+                              'wd': 1e-4,
+                              'multi_precision': args.dtype == 'float16'},
             initializer=mx.init.Xavier(rnd_type='gaussian',
                                        factor_type='in', magnitude=2),
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 10),

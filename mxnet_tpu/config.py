@@ -171,11 +171,16 @@ flags.declare('MXTPU_SHARDED_UPDATE', bool, True,
               'weights all-gather — update HBM traffic and optimizer '
               'math scale down by the dp factor; 0 keeps the '
               'replicated update')
-flags.declare('MXTPU_BN_ONEPASS', bool, True,
+flags.declare('MXTPU_BN_ONEPASS', bool, False,
               'BatchNorm training stats via one-pass moments '
               '(sum/sum-of-squares in one fused HBM read of the '
               'activation) instead of jnp.var\'s two-pass mean-then-'
-              'centered-square; 0 restores the two-pass form for A/B')
+              'centered-square. Default OFF: the on-chip A/B measured '
+              'the one-pass form 5% SLOWER end-to-end on ResNet-50 '
+              '(2406 vs 2535 img/s, bench_bn_*_20260802T061225Z) — '
+              'XLA already fuses the two-pass stats into the '
+              'surrounding graph better than the pivoted '
+              'sum/sum-of-squares form')
 flags.declare('MXTPU_DEVICE_AUGMENT', bool, False,
               'ImageRecordIter ships fixed-size uint8 batches and runs '
               'crop/mirror/normalize as one jitted device call per '

@@ -613,6 +613,7 @@ class ImageRecordIter(DataIter):
             std=(std_r, std_g, std_b), rand_crop=bool(int(rand_crop)),
             rand_mirror=bool(int(rand_mirror)))
         self._aug_fn = None
+        self._defer_aug = False
         self._stream = StreamingImageRecordIter(
             path_imgrec, self.data_shape, batch_size,
             label_width=label_width, shuffle=shuffle,
@@ -656,7 +657,42 @@ class ImageRecordIter(DataIter):
             raise StopIteration
         data, label, pad = item
         from .. import ndarray as _nd
-        if self._device_augment:
+        if self._device_augment and self._defer_aug:
+            # deferred mode (enabled by the fused fit loop via
+            # defer_device_aug): hand over the raw uint8 batch AND its
+            # label HOST-resident; the consumer stacks a whole window
+            # and crosses to the device in ONE transfer, tracing
+            # device_aug_pure() INSIDE its compiled program. Per-batch
+            # device calls cost ~65-85 ms of pure dispatch latency on
+            # a tunneled runtime (measured 2026-08-02, the 221 img/s
+            # fed-fit plateau) — defer mode leaves zero of them
+            import jax
+            from ..context import current_context
+            from ..ndarray.ndarray import from_jax
+            ctx = current_context()
+            try:
+                host = jax.local_devices(backend='cpu')[0]
+            except RuntimeError:   # no cpu backend: plain jnp arrays
+                host = None
+
+            def host_nd(a):
+                # one host copy per batch (cpu-backend device_put);
+                # the window stack's np.asarray may copy again — the
+                # alternative (numpy inside NDArray._data) would break
+                # the wrapper's jax-array invariant for ~2 ms/batch,
+                # noise next to the 65-85 ms dispatches defer removes
+                if host is not None:
+                    arr = jax.device_put(np.ascontiguousarray(a), host)
+                else:
+                    import jax.numpy as jnp
+                    arr = jnp.asarray(a)
+                return from_jax(arr, ctx)
+
+            return DataBatch(data=[host_nd(data)], label=[host_nd(label)],
+                             pad=pad, index=None,
+                             provide_data=self.provide_data,
+                             provide_label=self.provide_label)
+        elif self._device_augment:
             data_nd = self._apply_device_aug(data)
         else:
             data_nd = _nd.array(data)
@@ -673,47 +709,85 @@ class ImageRecordIter(DataIter):
         decode-bound host cores (reference inline-augment role:
         src/io/iter_image_recordio_2.cc:122-130)."""
         import jax
-        import jax.numpy as jnp
         from .. import random as _random
         from ..ndarray.ndarray import from_jax
         from ..context import current_context
         if self._aug_fn is None:
-            C, H, W = self.data_shape
-            # source may be non-square (uniform raw records): crop
-            # offsets range over each axis independently
-            Sh, Sw = int(data_u8.shape[1]), int(data_u8.shape[2])
-            p = self._aug_params
-            # slice to the target channel count (grayscale data_shape
-            # uses only the first channel's mean/std, like the host LUT)
-            mean = jnp.asarray(p['mean'][:C], jnp.float32)[:, None, None]
-            std = jnp.asarray(p['std'][:C], jnp.float32)[:, None, None]
-            scale = jnp.float32(p['scale'])
-            rand_crop, rand_mirror = p['rand_crop'], p['rand_mirror']
-
-            def aug(batch, key):
-                B = batch.shape[0]
-                ky, kx, kf = jax.random.split(key, 3)
-                if rand_crop and (Sh > H or Sw > W):
-                    ys = jax.random.randint(ky, (B,), 0, Sh - H + 1)
-                    xs = jax.random.randint(kx, (B,), 0, Sw - W + 1)
-                else:
-                    ys = jnp.full((B,), (Sh - H) // 2, jnp.int32)
-                    xs = jnp.full((B,), (Sw - W) // 2, jnp.int32)
-                crop = lambda im, y, x: jax.lax.dynamic_slice(  # noqa: E731
-                    im, (y, x, 0), (H, W, C))
-                imgs = jax.vmap(crop)(batch, ys, xs)     # (B,H,W,C) u8
-                if rand_mirror:
-                    coins = jax.random.uniform(kf, (B,)) < 0.5
-                    imgs = jnp.where(coins[:, None, None, None],
-                                     imgs[:, :, ::-1, :], imgs)
-                chw = imgs.transpose(0, 3, 1, 2).astype(jnp.float32)
-                return (chw * scale - mean) / std
-
-            self._aug_fn = jax.jit(aug)
+            self._aug_fn = jax.jit(self.device_aug_pure())
         ctx = current_context()
         dev = jax.device_put(np.ascontiguousarray(data_u8),
                              ctx.jax_device())
         return from_jax(self._aug_fn(dev, _random.next_key()), ctx)
+
+    def device_aug_pure(self):
+        """The device-augment math as a PURE jax function
+        ``(uint8 (B, Sh, Sw, C'), key) -> float32 (B, C, H, W)`` —
+        source dims read off the traced batch, so one function serves
+        any record geometry. Eager mode jits it per batch
+        (_apply_device_aug); the fused fit loop traces it inside its
+        window program instead (defer_device_aug), which removes the
+        per-batch dispatch entirely."""
+        import jax
+        import jax.numpy as jnp
+        C, H, W = self.data_shape
+        p = self._aug_params
+        # slice to the target channel count (grayscale data_shape
+        # uses only the first channel's mean/std, like the host LUT)
+        mean_c = tuple(p['mean'][:C])
+        std_c = tuple(p['std'][:C])
+        scale_v = float(p['scale'])
+        rand_crop, rand_mirror = p['rand_crop'], p['rand_mirror']
+
+        def aug(batch, key):
+            B = batch.shape[0]
+            # source may be non-square (uniform raw records): crop
+            # offsets range over each axis independently
+            Sh, Sw = int(batch.shape[1]), int(batch.shape[2])
+            mean = jnp.asarray(mean_c, jnp.float32)[:, None, None]
+            std = jnp.asarray(std_c, jnp.float32)[:, None, None]
+            ky, kx, kf = jax.random.split(key, 3)
+            if rand_crop and (Sh > H or Sw > W):
+                ys = jax.random.randint(ky, (B,), 0, Sh - H + 1)
+                xs = jax.random.randint(kx, (B,), 0, Sw - W + 1)
+            else:
+                ys = jnp.full((B,), (Sh - H) // 2, jnp.int32)
+                xs = jnp.full((B,), (Sw - W) // 2, jnp.int32)
+            crop = lambda im, y, x: jax.lax.dynamic_slice(  # noqa: E731
+                im, (y, x, 0), (H, W, C))
+            imgs = jax.vmap(crop)(batch, ys, xs)     # (B,H,W,C) u8
+            if rand_mirror:
+                coins = jax.random.uniform(kf, (B,)) < 0.5
+                imgs = jnp.where(coins[:, None, None, None],
+                                 imgs[:, :, ::-1, :], imgs)
+            chw = imgs.transpose(0, 3, 1, 2).astype(jnp.float32)
+            return (chw * jnp.float32(scale_v) - mean) / std
+
+        return aug
+
+    def device_aug_signature(self):
+        """Hashable description of the augmentation MATH a consumer
+        bakes into a compiled program (fused-fit defer mode): two
+        iterators agreeing on this signature produce identical
+        device_aug_pure functions, so compiled windows may be shared;
+        any difference (mean/std/scale/rand flags/target shape) must
+        compile a fresh window."""
+        p = self._aug_params
+        return ('image-record-aug', tuple(self.data_shape), p['scale'],
+                tuple(p['mean']), tuple(p['std']),
+                p['rand_crop'], p['rand_mirror'])
+
+    def defer_device_aug(self, on):
+        """Switch deferred-augment mode (fused-fit internal protocol):
+        when on, next() returns RAW uint8 device batches and the
+        consumer must apply device_aug_pure() itself (in-graph). Only
+        meaningful in device-augment mode — returns whether the switch
+        engaged. Always flip back off (try/finally) so other consumers
+        of the same iterator (eval passes, score) see augmented
+        batches again."""
+        if not self._device_augment:
+            return False
+        self._defer_aug = bool(on)
+        return True
 
     def iter_next(self):
         if self._pending is not None:

@@ -168,8 +168,8 @@ class BaseModule:
         fused = None
         if monitor is None:
             from .fused_fit import FusedFitLoop
-            fused = FusedFitLoop.build(self, eval_metric,
-                                       logger=self.logger)
+            fused = FusedFitLoop.build_cached(self, eval_metric,
+                                              logger=self.logger)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()

@@ -77,6 +77,12 @@ def _shard_update_enabled():
     return flags.get('MXTPU_SHARDED_UPDATE')
 
 
+def _mirror_flag():
+    from ..config import flags
+    flags.reload('MXTPU_BACKWARD_DO_MIRROR')
+    return flags.get('MXTPU_BACKWARD_DO_MIRROR')
+
+
 def _is_half(dt):
     return str(dt) in ('float16', 'bfloat16')
 
@@ -294,6 +300,8 @@ class FusedFitLoop:
         self._programs = {}
         self._dev_cache_key = None
         self._dev_cache = None
+        import weakref
+        self._defer_fns = weakref.WeakKeyDictionary()
 
         e = module._exec_group.execs[0]
         self._exec = e
@@ -322,6 +330,82 @@ class FusedFitLoop:
             pnames = module._exec_group.param_names
             self._upd_keys = {n: pnames.index(n) for n in self._grad_names}
         self._ensure_states()
+
+    # -- reuse across fit() calls ------------------------------------------
+    @staticmethod
+    def _metric_sig(eval_metric):
+        """Value signature of the metric configuration (class + every
+        distinguishing kwarg: axis/top_k/eps/... all flow through
+        EvalMetric._kwargs into get_config). None = unsignable, never
+        reuse."""
+        if isinstance(eval_metric, metric_mod.CompositeEvalMetric):
+            leaves = list(eval_metric.metrics)
+        else:
+            leaves = [eval_metric]
+        try:
+            return repr([sorted(m.get_config().items(), key=str)
+                         for m in leaves])
+        except Exception:  # noqa: BLE001 — custom metric w/o get_config
+            return None
+
+    def _rebind_metric(self, eval_metric):
+        """Point the loop's stat writeback at the CURRENT fit() call's
+        metric objects (each call may construct fresh instances from
+        the same config — which is exactly what the reuse signature
+        guarantees, so the stat fns, which capture only config values
+        like top_k/eps, stay valid)."""
+        if isinstance(eval_metric, metric_mod.CompositeEvalMetric):
+            self.children = list(eval_metric.metrics)
+        elif self.children is not None:
+            self.children = [eval_metric]
+
+    @classmethod
+    def build_cached(cls, module, eval_metric, logger=logging):
+        """build(), but reuse the previous fit() call's loop — with its
+        compiled window programs — when everything the traced window
+        depends on is unchanged: same bound executor, same optimizer
+        instance, grad_req, kvstore mode, window size, remat/sharding
+        flags, and an equal-config metric.
+
+        An epoch-at-a-time driver (fit(begin_epoch=e, num_epoch=e+1)
+        in a loop — the resume / eval-between-epochs pattern) otherwise
+        pays a full retrace + XLA recompile of the window EVERY call:
+        measured ~20-40 s per compile on the tunneled chip vs ~2 s of
+        compute per 64-batch ImageNet epoch, the 49.8 img/s pathology
+        of docs/tpu_artifacts/fed_modulefit_20260802T061223Z."""
+        from ..config import flags
+        flags.reload('MXTPU_FUSED_FIT')
+        if not flags.get('MXTPU_FUSED_FIT'):
+            module.__dict__.pop('_fused_fit_cache', None)
+            return None
+        eg = getattr(module, '_exec_group', None)
+        execs = getattr(eg, 'execs', None) or []
+        sig = None
+        if len(execs) == 1 and execs[0]._monitor is None \
+                and not execs[0]._use_staged():
+            # a monitor installed (or staging forced) between fit()
+            # calls must invalidate reuse the same way build() rejects
+            # it — the per-batch reference loop is the one that honors
+            # monitor callbacks
+            msig = cls._metric_sig(eval_metric)
+            if msig is not None:
+                sig = (id(execs[0]), id(module._optimizer),
+                       module._grad_req,
+                       bool(module._update_on_kvstore),
+                       getattr(module._kvstore, 'type', None),
+                       _window_size(), bool(_shard_update_enabled()),
+                       str(_mirror_flag()), msig)
+        cached = module.__dict__.get('_fused_fit_cache')
+        if cached is not None and sig is not None and cached[0] == sig:
+            loop = cached[1]
+            loop._rebind_metric(eval_metric)
+            return loop
+        loop = cls.build(module, eval_metric, logger=logger)
+        if loop is not None and sig is not None:
+            module.__dict__['_fused_fit_cache'] = (sig, loop)
+        else:
+            module.__dict__.pop('_fused_fit_cache', None)
+        return loop
 
     # -- eligibility -------------------------------------------------------
     @staticmethod
@@ -456,6 +540,7 @@ class FusedFitLoop:
         accum = self._accum
         W = self.window
         mesh = self._mesh
+        defer_fn = self._defer_fn   # traced INTO the program (or None)
         shard_update = _shard_update_enabled() and mesh is not None
         if shard_update:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -483,6 +568,13 @@ class FusedFitLoop:
                 params, states, aux, gaccs = carry
                 step_i, datas, labels, lr_row, wd_row = xs
                 k = jax.random.fold_in(key, step_i)
+                if defer_fn is not None:
+                    # deferred device-augment: raw uint8 batch -> the
+                    # graph's float input, inside THIS program (zero
+                    # per-batch dispatches; iterator's eager mode runs
+                    # the identical math per batch)
+                    ka = jax.random.fold_in(k, 0x41554721)
+                    datas = (defer_fn(datas[0], ka),) + tuple(datas[1:])
 
                 def f(wrt):
                     full = [None] * len(arg_pos)
@@ -619,11 +711,27 @@ class FusedFitLoop:
             spec = P(*((None, 'dp') + (None,) * (stack.ndim - 2)))
             return jax.device_put(stack, NamedSharding(self._mesh, spec))
 
-        data_stack = [shard(jnp.stack([jnp.asarray(ds[i])
-                                       for ds, _ in snaps]))
+        def _on_host(a):
+            if isinstance(a, np.ndarray):
+                return True
+            try:
+                return all(d.platform == 'cpu' for d in a.devices())
+            except Exception:  # noqa: BLE001 — tracer/abstract array
+                return False
+
+        def stack(parts):
+            # host-resident parts (defer-mode uint8 batches and their
+            # labels) stack on the host so the whole window crosses to
+            # the device in shard()'s ONE device_put — W per-batch
+            # transfers each cost a full dispatch RTT on a tunneled
+            # runtime
+            if all(_on_host(p) for p in parts):
+                return np.stack([np.asarray(p) for p in parts])
+            return jnp.stack([jnp.asarray(p) for p in parts])
+
+        data_stack = [shard(stack([ds[i] for ds, _ in snaps]))
                       for i in range(len(snaps[0][0]))]
-        label_stack = [shard(jnp.stack([jnp.asarray(ls[i])
-                                        for _, ls in snaps]))
+        label_stack = [shard(stack([ls[i] for _, ls in snaps]))
                        for i in range(len(snaps[0][1]))]
         self._dev_cache_key = key
         self._dev_cache = (tuple(data_stack), tuple(label_stack))
@@ -637,8 +745,6 @@ class FusedFitLoop:
         paths interleave safely."""
         from ..model import BatchEndParam
         from .base_module import _as_list
-        from .. import random as _random
-        m = self.module
 
         try:
             _host_dev = jax.local_devices(backend='cpu')[0]
@@ -683,10 +789,63 @@ class FusedFitLoop:
                 nbatch += 1
             return nbatch
 
-        nbatch = 0
-        pending = None   # previous window's stats, fetched AFTER the
-        # next window is dispatched so the RTT overlaps device compute
         from ..io import DataBatch as _DataBatch
+        # deferred device-augment: when the iterator supports it, draw
+        # RAW uint8 batches and trace the augmentation inside the
+        # window program — each eager per-batch aug dispatch costs
+        # ~65-85 ms of tunnel latency (the 221 img/s fed-fit plateau,
+        # docs/perf.md round-5)
+        defer_switch = getattr(train_data, 'defer_device_aug', None)
+        self._defer_fn = None
+        self._defer_eager = None
+        self._defer_sig = False
+        if callable(defer_switch) and defer_switch(True):
+            # one pure fn per ITERATOR object (WeakKey: dies with it) —
+            # an unsigned iterator would otherwise key a fresh program
+            # every epoch through the identity fallback below
+            try:
+                self._defer_fn = self._defer_fns[train_data]
+            except KeyError:
+                self._defer_fn = train_data.device_aug_pure()
+                self._defer_fns[train_data] = self._defer_fn
+            # tail batches (< window) materialize per batch: ONE
+            # compiled call each, not the pure fn's ~10 eager ops
+            self._defer_eager = jax.jit(self._defer_fn)
+            # the aug MATH is baked into the compiled window, so the
+            # program key must carry its configuration — a second
+            # iterator with equal batch shapes but different
+            # mean/std/scale/rand flags must NOT reuse this program.
+            # Unsigned fallback keys by the LIVE function object (held
+            # by the key itself), never by a recyclable id()
+            sig_fn = getattr(train_data, 'device_aug_signature', None)
+            self._defer_sig = sig_fn() if callable(sig_fn) \
+                else ('defer-unsigned', self._defer_fn)
+        else:
+            defer_switch = None
+        try:
+            return self._run_epoch_inner(
+                train_data, eval_metric, epoch, batch_end_callback,
+                _DataBatch, apply_stats, host_nd)
+        finally:
+            if defer_switch is not None:
+                defer_switch(False)
+                self._defer_fn = None
+                self._defer_eager = None
+            # the loop now outlives fit() (build_cached): drop the last
+            # window's device stack + its strong host refs — the
+            # identity cache only ever hits while an epoch is running
+            self._dev_cache_key = None
+            self._dev_cache = None
+
+    def _run_epoch_inner(self, train_data, eval_metric, epoch,
+                         batch_end_callback, _DataBatch, apply_stats,
+                         host_nd):
+        from ..model import BatchEndParam
+        from .base_module import _as_list
+        from .. import random as _random
+        m = self.module
+        nbatch = 0
+        pending = None
         it = iter(train_data)
         done = False
         while not done:
@@ -701,6 +860,16 @@ class FusedFitLoop:
                 try:
                     b = next(it)
                 except StopIteration:
+                    if nbatch == 0 and not batches and pending is None:
+                        # exhausted before the FIRST batch (nbatch
+                        # counts applied stats, so also require no
+                        # pending window): the reference loop's
+                        # unguarded first next() (base_module.py:482)
+                        # raises here — fail just as loudly instead of
+                        # silently training a zero-batch epoch (callers
+                        # must reset() an iterator that a score()/
+                        # predict pass drained)
+                        raise
                     done = True
                     break
                 batches.append(b)
@@ -713,7 +882,12 @@ class FusedFitLoop:
                 for b, (ds, ls) in zip(batches, snaps):
                     # tail: reference per-batch path, on a rebuilt batch
                     # (the original's buffers may have been overwritten
-                    # by later draws)
+                    # by later draws). Deferred uint8 batches are
+                    # materialized eagerly here — one aug dispatch per
+                    # tail batch, exactly the eager mode's cost
+                    if self._defer_eager is not None:
+                        ds = (self._defer_eager(ds[0], _random.next_key()),
+                              ) + tuple(ds[1:])
                     sb = _DataBatch(
                         data=[from_jax(d, self._exec._ctx) for d in ds],
                         label=[from_jax(l, self._exec._ctx) for l in ls],
@@ -736,8 +910,9 @@ class FusedFitLoop:
             # scheduler never forces a recompile
             static_attrs = self._static_attrs()
             attrs_key = tuple(sorted(static_attrs.items()))
-            shapes_key = tuple(tuple(d.shape) for d in snaps[0][0])
-            prog_key = (attrs_key, shapes_key)
+            shapes_key = tuple((tuple(d.shape), str(d.dtype))
+                               for d in snaps[0][0])
+            prog_key = (attrs_key, shapes_key, self._defer_sig)
             if prog_key not in self._programs:
                 self._programs[prog_key] = self._build_program(
                     static_attrs, shapes_key)

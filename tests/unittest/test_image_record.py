@@ -436,3 +436,105 @@ def test_device_augment_spmd_fused_fit(tmp_path):
     for k in a_dev:
         np.testing.assert_allclose(a_dev[k], a_host[k], rtol=1e-5,
                                    atol=1e-5, err_msg=k)
+
+
+def test_device_augment_deferred_into_fused_window(tmp_path):
+    """When the fused fit loop drives a device-augment iterator, the
+    augmentation is traced INSIDE the window program (defer mode: raw
+    uint8 batches, zero per-batch aug dispatches — each eager dispatch
+    costs ~65-85 ms of tunnel latency, docs/perf.md round-5). With
+    randomness off the trajectory equals the unfused eager path
+    exactly; tail batches (< window) materialize eagerly; the
+    iterator's defer switch is always restored."""
+    import os
+    import mxnet_tpu as mx
+    from mxnet_tpu.module.fused_fit import FusedFitLoop
+    import mxnet_tpu.module.fused_fit as ff
+
+    p = str(tmp_path / 'defer.rec')
+    # 40 imgs / batch 4 = 10 batches: W=4 on cpu -> 2 windows + 2 tail
+    _write_rec(p, 40, hw=8, labeler=lambda i: i % 4)
+
+    def run(fused):
+        mx.random.seed(11)
+        np.random.seed(11)
+        it = mx.io.ImageRecordIter(
+            p, **_iter_kw(8, 4, label_name='softmax_label'),
+            device_augment=1)
+        data = mx.sym.Variable('data')
+        net = mx.sym.Flatten(data)
+        net = mx.sym.FullyConnected(net, num_hidden=4, name='fc')
+        net = mx.sym.SoftmaxOutput(net, name='softmax')
+        mod = mx.mod.Module(net, context=mx.cpu())
+        os.environ['MXTPU_FUSED_FIT'] = '1' if fused else '0'
+        try:
+            mod.fit(it, num_epoch=2, optimizer='sgd',
+                    optimizer_params=(('learning_rate', 0.1),),
+                    kvstore='local', eval_metric='acc')
+        finally:
+            os.environ.pop('MXTPU_FUSED_FIT', None)
+        return mod, it
+
+    mod_f, it_f = run(True)
+    # defer engaged: the cached loop compiled a defer-mode program
+    _, loop = mod_f.__dict__['_fused_fit_cache']
+    assert any(k[2] for k in loop._programs), list(loop._programs)
+    # ...exactly one program across both epochs (reuse, no retrace)
+    assert len(loop._programs) == 1
+    # switch restored for other consumers of the iterator
+    assert it_f._defer_aug is False
+    # eager batches augment again after the fit (f32 CHW, not uint8)
+    it_f.reset()
+    b = next(iter(it_f))
+    assert str(b.data[0].dtype) == 'float32'
+    assert b.data[0].shape[1:] == (3, 8, 8)
+
+    mod_u, _ = run(False)
+    a_f = {k: v.asnumpy() for k, v in mod_f.get_params()[0].items()}
+    a_u = {k: v.asnumpy() for k, v in mod_u.get_params()[0].items()}
+    assert a_f.keys() == a_u.keys()
+    for k in a_f:
+        np.testing.assert_allclose(a_f[k], a_u[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_defer_program_keyed_by_aug_config(tmp_path):
+    """Two device-augment iterators with EQUAL batch shapes but
+    different normalization must not share a compiled defer window:
+    the aug math is baked into the program, so the program key carries
+    device_aug_signature()."""
+    import os
+    import mxnet_tpu as mx
+
+    p = str(tmp_path / 'sig.rec')
+    _write_rec(p, 32, hw=8, labeler=lambda i: i % 4)
+
+    def build_mod():
+        data = mx.sym.Variable('data')
+        net = mx.sym.Flatten(data)
+        net = mx.sym.FullyConnected(net, num_hidden=4, name='fc')
+        net = mx.sym.SoftmaxOutput(net, name='softmax')
+        return mx.mod.Module(net, context=mx.cpu())
+
+    os.environ['MXTPU_FUSED_FIT'] = '1'
+    try:
+        mod = build_mod()
+        kw = dict(_iter_kw(8, 8, label_name='softmax_label'),
+                  device_augment=1)
+        it_a = mx.io.ImageRecordIter(p, **kw)
+        it_b = mx.io.ImageRecordIter(p, mean_r=100., std_r=7., **kw)
+        assert it_a.device_aug_signature() != it_b.device_aug_signature()
+        fit_kw = dict(optimizer='sgd',
+                      optimizer_params=(('learning_rate', 0.1),),
+                      kvstore='local', eval_metric='acc')
+        mod.fit(it_a, num_epoch=1, **fit_kw)
+        _, loop = mod.__dict__['_fused_fit_cache']
+        assert len(loop._programs) == 1
+        mod.fit(it_b, num_epoch=2, begin_epoch=1, **fit_kw)
+        _, loop2 = mod.__dict__['_fused_fit_cache']
+        assert loop2 is loop            # loop reused (module unchanged)
+        assert len(loop._programs) == 2  # ...but a FRESH aug program
+        keys = list(loop._programs)
+        assert keys[0][2] != keys[1][2]
+    finally:
+        os.environ.pop('MXTPU_FUSED_FIT', None)

@@ -59,6 +59,10 @@ def ensure_rec():
 
 
 def main():
+    import logging
+    # INFO so the artifact log shows "fused fit fast path active" —
+    # whether the window path engaged is part of the evidence
+    logging.basicConfig(level=logging.INFO)
     os.environ.setdefault('MXTPU_F16_AS_BF16', '1')
     ensure_rec()
     import mxnet_tpu as mx

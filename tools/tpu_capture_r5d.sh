@@ -1,0 +1,27 @@
+#!/bin/bash
+# Chained round-5 capture, part D: transport-bound evidence for the
+# fed-fit number. The 2026-08-02 fed_modulefit artifact measured 49.8
+# img/s — suspiciously equal to ~10 MB/s of uint8 source upload. This
+# banks the raw `jax.device_put` bandwidth of the exact batch shape so
+# the fed rate can be read against the tunnel's own ceiling.
+#
+# Launch detached:
+#   setsid nohup bash tools/tpu_capture_r5d.sh > /tmp/capture_r5d.log 2>&1 < /dev/null &
+set -u
+cd "$(dirname "$0")/.."
+. tools/tpu_capture_lib.sh
+OUT=docs/tpu_artifacts
+mkdir -p "$OUT"
+STAMP=$(date -u +%Y%m%dT%H%M%SZ)
+echo "R5D CAPTURE STAMP=$STAMP"
+
+wait_for_predecessor /tmp/capture_r5c.log \
+  'R5C CAPTURE ALL DONE|gave up before' 'tools/tpu_capture_r5c\.sh'
+
+probe_until_healthy || { echo "gave up before upload probe"; exit 1; }
+echo "== upload bandwidth probe (fed batch shape) =="
+timeout 600 python tools/upload_bw_probe.py \
+  > "$OUT/upload_bw_$STAMP.json" 2> "$OUT/upload_bw_$STAMP.log"
+echo "rc=$?"; tail -1 "$OUT/upload_bw_$STAMP.json"
+
+echo "== R5D CAPTURE ALL DONE =="

@@ -1,0 +1,59 @@
+"""Raw host->device upload bandwidth probe for the fed-fit bound.
+
+The fed `ImageRecordIter -> Module.fit` bench (tools/fed_fit_bench.py)
+must ship every uint8 source batch to the device, unlike the synthetic
+bench whose data lives on-device. On a real TPU host that transfer
+rides PCIe/DMA at GB/s; in this dev environment it crosses the axon
+tunnel. This probe times nothing but `jax.device_put` of the exact
+batch shape the fed bench uploads (B, S, S, 3) uint8, so the fed
+number can be read against the transport's own ceiling: if
+fed_img_s ~= probe_MBps / bytes_per_image, the framework streams at
+line rate and the gap to the synthetic rate is the tunnel, not the
+pipeline. Reference role: the in-process OMP feed of
+src/io/iter_image_recordio_2.cc never crosses a network hop.
+
+Prints ONE json line.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..'))
+
+BATCH = int(os.environ.get('MXTPU_BENCH_BATCH', 32))
+SRC = int(os.environ.get('MXTPU_FED_SRC', 256))
+REPS = int(os.environ.get('MXTPU_PROBE_REPS', 24))
+
+
+def main():
+    import jax
+    dev = jax.devices()[0]
+    rng = np.random.RandomState(0)
+    # distinct buffers so no caching layer can dedupe the transfer
+    batches = [rng.randint(0, 256, (BATCH, SRC, SRC, 3), np.uint8)
+               for _ in range(4)]
+    nbytes = batches[0].nbytes
+
+    # warmup (backend init + any lazy transfer setup)
+    jax.device_put(batches[0], dev).block_until_ready()
+
+    t0 = time.perf_counter()
+    for i in range(REPS):
+        jax.device_put(batches[i % 4], dev).block_until_ready()
+    dt = time.perf_counter() - t0
+
+    mbps = REPS * nbytes / dt / 1e6
+    img_s_ceiling = REPS * BATCH / dt
+    out = {'metric': 'host_to_device_upload_bw', 'value': round(mbps, 2),
+           'unit': 'MB/s', 'platform': dev.platform,
+           'batch_bytes': nbytes, 'reps': REPS,
+           'fed_img_s_ceiling': round(img_s_ceiling, 1),
+           'shape': [BATCH, SRC, SRC, 3], 'dtype': 'uint8'}
+    print(json.dumps(out))
+
+
+if __name__ == '__main__':
+    main()
