@@ -181,6 +181,24 @@ flags.declare('MXTPU_BN_ONEPASS', bool, False,
               'XLA already fuses the two-pass stats into the '
               'surrounding graph better than the pivoted '
               'sum/sum-of-squares form')
+flags.declare('MXTPU_HOST_CROP', bool, True,
+              'In ImageRecordIter device-augment mode, workers crop '
+              '(rand or center) to the target HxW before handover, so '
+              'the uploaded uint8 window carries H*W/S^2 of the source '
+              'bytes (23% fewer for 224^2 crops of 256^2 sources); '
+              'mirror + normalize stay on device. 0 ships the full '
+              'fixed-size source and crops on device')
+flags.declare('MXTPU_FUSED_FIT_PREFETCH', bool, True,
+              'Pipeline the fused-fit window input: window k+1\'s '
+              'host-stack + host->device transfer run on a side '
+              'thread while window k computes on device (np.stack '
+              'and the transfer release the GIL, so the overlap holds '
+              'even on a one-core host). 0 restores the serial '
+              'stack/put/dispatch/fetch order')
+flags.declare('MXTPU_FUSED_FIT_TIMING', bool, False,
+              'Log a per-epoch host-stage breakdown of the fused fit '
+              'loop (draw / stack+put / dispatch / stats-fetch) — the '
+              'diagnosis knob for fed-path throughput work')
 flags.declare('MXTPU_DEVICE_AUGMENT', bool, False,
               'ImageRecordIter ships fixed-size uint8 batches and runs '
               'crop/mirror/normalize as one jitted device call per '

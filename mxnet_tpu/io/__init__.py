@@ -596,7 +596,7 @@ class ImageRecordIter(DataIter):
                  prefetch_buffer=4, resize=-1, pad=0, fill_value=127,
                  max_random_scale=1.0, min_random_scale=1.0, num_parts=1,
                  part_index=0, data_name='data', label_name='softmax_label',
-                 device_augment=None, **kwargs):
+                 device_augment=None, host_crop=None, **kwargs):
         super().__init__(batch_size)
         from .image_record import StreamingImageRecordIter
         from ..config import flags
@@ -608,6 +608,9 @@ class ImageRecordIter(DataIter):
             # opt-in for unmodified scripts: MXTPU_DEVICE_AUGMENT=1
             device_augment = flags.get('MXTPU_DEVICE_AUGMENT')
         self._device_augment = bool(int(device_augment or 0))
+        if host_crop is None:
+            host_crop = flags.get('MXTPU_HOST_CROP')
+        self._host_crop = bool(int(host_crop or 0)) and self._device_augment
         self._aug_params = dict(
             scale=float(scale), mean=(mean_r, mean_g, mean_b),
             std=(std_r, std_g, std_b), rand_crop=bool(int(rand_crop)),
@@ -625,7 +628,7 @@ class ImageRecordIter(DataIter):
             max_random_scale=max_random_scale,
             min_random_scale=min_random_scale,
             num_parts=num_parts, part_index=part_index, aug_kwargs=kwargs,
-            device_augment=self._device_augment)
+            device_augment=self._device_augment, host_crop=self._host_crop)
         self._pending = None
         self._exhausted = False
 
@@ -737,6 +740,7 @@ class ImageRecordIter(DataIter):
         std_c = tuple(p['std'][:C])
         scale_v = float(p['scale'])
         rand_crop, rand_mirror = p['rand_crop'], p['rand_mirror']
+        pre_cropped = self._host_crop
 
         def aug(batch, key):
             B = batch.shape[0]
@@ -746,15 +750,20 @@ class ImageRecordIter(DataIter):
             mean = jnp.asarray(mean_c, jnp.float32)[:, None, None]
             std = jnp.asarray(std_c, jnp.float32)[:, None, None]
             ky, kx, kf = jax.random.split(key, 3)
-            if rand_crop and (Sh > H or Sw > W):
-                ys = jax.random.randint(ky, (B,), 0, Sh - H + 1)
-                xs = jax.random.randint(kx, (B,), 0, Sw - W + 1)
+            if pre_cropped:
+                # host-crop mode: workers already cropped to (H, W) —
+                # only mirror + normalize ride the device
+                imgs = batch
             else:
-                ys = jnp.full((B,), (Sh - H) // 2, jnp.int32)
-                xs = jnp.full((B,), (Sw - W) // 2, jnp.int32)
-            crop = lambda im, y, x: jax.lax.dynamic_slice(  # noqa: E731
-                im, (y, x, 0), (H, W, C))
-            imgs = jax.vmap(crop)(batch, ys, xs)     # (B,H,W,C) u8
+                if rand_crop and (Sh > H or Sw > W):
+                    ys = jax.random.randint(ky, (B,), 0, Sh - H + 1)
+                    xs = jax.random.randint(kx, (B,), 0, Sw - W + 1)
+                else:
+                    ys = jnp.full((B,), (Sh - H) // 2, jnp.int32)
+                    xs = jnp.full((B,), (Sw - W) // 2, jnp.int32)
+                crop = lambda im, y, x: jax.lax.dynamic_slice(  # noqa: E731
+                    im, (y, x, 0), (H, W, C))
+                imgs = jax.vmap(crop)(batch, ys, xs)     # (B,H,W,C) u8
             if rand_mirror:
                 coins = jax.random.uniform(kf, (B,)) < 0.5
                 imgs = jnp.where(coins[:, None, None, None],
@@ -774,7 +783,7 @@ class ImageRecordIter(DataIter):
         p = self._aug_params
         return ('image-record-aug', tuple(self.data_shape), p['scale'],
                 tuple(p['mean']), tuple(p['std']),
-                p['rand_crop'], p['rand_mirror'])
+                p['rand_crop'], p['rand_mirror'], self._host_crop)
 
     def defer_device_aug(self, on):
         """Switch deferred-augment mode (fused-fit internal protocol):

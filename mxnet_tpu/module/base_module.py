@@ -6,6 +6,7 @@ score/predict/forward_backward and the parameter-access contract.
 """
 import logging
 import time
+import warnings
 
 import numpy as np
 
@@ -35,12 +36,33 @@ def _check_input_names(symbol, names, typename, throw):
         logging.warning(msg)
 
 
+def _check_names_match(data_names, data_shapes, name, throw):
+    """Reference base_module.py:56 — input descriptor names must match
+    the module's declared names: mismatched data names raise; label
+    mismatches only warn (predict-time modules bind without labels).
+    Without this gate a wrong label_name surfaces much later as a
+    KeyError in the executor group (or trains silently through the
+    fused window's positional binding)."""
+    actual = [x[0] for x in data_shapes]
+    if sorted(data_names) != sorted(actual):
+        msg = "Data provided by %s_shapes don't match names specified by " \
+              "%s_names (%s vs. %s)" % (name, name, str(data_shapes),
+                                        str(data_names))
+        if throw:
+            raise ValueError(msg)
+        warnings.warn(msg)
+
+
 def _parse_data_desc(data_names, label_names, data_shapes, label_shapes):
     data_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
                    for x in data_shapes]
+    _check_names_match(data_names, data_shapes, 'data', True)
     if label_shapes is not None:
         label_shapes = [x if isinstance(x, DataDesc) else DataDesc(*x)
                         for x in label_shapes]
+        _check_names_match(label_names, label_shapes, 'label', False)
+    else:
+        _check_names_match(label_names, [], 'label', False)
     return data_shapes, label_shapes
 
 

@@ -76,6 +76,22 @@ def _output_layouts(symbol):
             for name in symbol.list_outputs()]
 
 
+def _check_label_args(label_shapes, arg_dict, symbol):
+    """A label name that isn't an argument of the bound symbol can only
+    come from a provide_label/label_names mismatch that the bind-time
+    name check already warned about (reference base_module.py:56 warns
+    for labels instead of raising) — fail like the reference's
+    simple_bind/infer_shape does at the same point, with the argument
+    list instead of a bare KeyError."""
+    for d in label_shapes:
+        name = d.name if isinstance(d, DataDesc) else d[0]
+        if name not in arg_dict:
+            raise ValueError(
+                "label '%s' is not an argument of the symbol (arguments:"
+                ' %s) — pass matching label_names to Module or rename '
+                'the iterator label' % (name, symbol.list_arguments()))
+
+
 class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
@@ -187,6 +203,8 @@ class DataParallelExecutorGroup:
                             for name, _ in [(d.name, d.shape) if isinstance(d, DataDesc)
                                             else d for d in data_shapes]]
         if label_shapes is not None and len(label_shapes) > 0:
+            _check_label_args(label_shapes, self.execs[0].arg_dict,
+                              self.symbol)
             self.label_arrays = [[(self.slices[i], e.arg_dict[name])
                                   for i, e in enumerate(self.execs)]
                                  for name, _ in [(d.name, d.shape) if isinstance(d, DataDesc)
@@ -379,6 +397,9 @@ class SPMDExecutorGroup:
                             for d in data_shapes]
         self._label_names = [] if not label_shapes else \
             [d.name if isinstance(d, DataDesc) else d[0] for d in label_shapes]
+        if label_shapes:
+            _check_label_args(label_shapes,
+                              dict.fromkeys(symbol.list_arguments()), symbol)
         # dp shards each input along ITS batch axis (a 'TN' layout puts
         # the batch on axis 1; sharding axis 0 would split time)
         self._batch_axes = {

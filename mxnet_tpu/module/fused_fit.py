@@ -45,6 +45,7 @@ Toggles: MXTPU_FUSED_FIT=0 disables; MXTPU_FIT_STEPS_PER_CALL sets W
 (default 32 on TPU, 4 elsewhere).
 """
 import logging
+import time
 
 import numpy as np
 
@@ -737,6 +738,18 @@ class FusedFitLoop:
         self._dev_cache = (tuple(data_stack), tuple(label_stack))
         return self._dev_cache
 
+    def _put_pool(self):
+        """One-thread executor for the pipelined window upload. A
+        single worker keeps transfers ordered; the loop object (cached
+        on the module across fit() calls) owns it for its lifetime."""
+        pool = getattr(self, '_put_pool_obj', None)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            pool = ThreadPoolExecutor(max_workers=1,
+                                      thread_name_prefix='mxtpu-fused-put')
+            self._put_pool_obj = pool
+        return pool
+
     def run_epoch(self, train_data, eval_metric, epoch,
                   batch_end_callback, monitor=None):
         """Run one epoch; returns the number of batches consumed.
@@ -847,8 +860,19 @@ class FusedFitLoop:
         nbatch = 0
         pending = None
         it = iter(train_data)
-        done = False
-        while not done:
+        # MXTPU_FUSED_FIT_TIMING=1: per-epoch host-stage breakdown
+        # (draw / stack+put / dispatch / stats-fetch) — the fed-path
+        # diagnosis knob; wall beyond these stages is device compute
+        # the host successfully hid
+        from ..config import flags as _flags
+        _timing = bool(_flags.get('MXTPU_FUSED_FIT_TIMING'))
+        _tm = {'draw': 0.0, 'put': 0.0, 'dispatch': 0.0, 'fetch': 0.0}
+        _clk = time.perf_counter
+        _ep_t0 = _clk() if _timing else 0.0
+        pool = self._put_pool() \
+            if _flags.get('MXTPU_FUSED_FIT_PREFETCH') else None
+
+        def collect():
             # snapshot each batch's underlying jax arrays AT DRAW TIME:
             # iterators may legally reuse their DataBatch/NDArray
             # buffers for the next batch (the reference loop consumes
@@ -856,90 +880,138 @@ class FusedFitLoop:
             # immutable, so the draw-time references stay valid while
             # the window is collected and the apply is deferred.
             batches, snaps = [], []
+            _t = _clk() if _timing else 0.0
             while len(batches) < self.window:
                 try:
                     b = next(it)
                 except StopIteration:
-                    if nbatch == 0 and not batches and pending is None:
-                        # exhausted before the FIRST batch (nbatch
-                        # counts applied stats, so also require no
-                        # pending window): the reference loop's
-                        # unguarded first next() (base_module.py:482)
-                        # raises here — fail just as loudly instead of
-                        # silently training a zero-batch epoch (callers
-                        # must reset() an iterator that a score()/
-                        # predict pass drained)
-                        raise
-                    done = True
                     break
                 batches.append(b)
                 snaps.append((tuple(a._data for a in b.data),
                               tuple(l._data for l in b.label)))
-            if len(batches) < self.window:
+            if _timing:
+                _tm['draw'] += _clk() - _t
+            return batches, snaps
+
+        def start_put(win_snaps):
+            """Begin the window's host-stack + device transfer; returns
+            a no-arg resolver. On the prefetch pool the stack + put for
+            window k+1 run on the side thread while window k computes on
+            device and k-1's stats fetch waits — np.stack's memcpy and
+            the transfer both release the GIL, so the overlap is real
+            even on a one-core host."""
+            if pool is None:
+                res = self._device_batches(win_snaps)
+                return lambda: res
+            return pool.submit(self._device_batches, win_snaps).result
+
+        batches, snaps = collect()
+        if not batches:
+            # exhausted before the FIRST batch: the reference loop's
+            # unguarded first next() (base_module.py:482) raises here —
+            # fail just as loudly instead of silently training a
+            # zero-batch epoch (callers must reset() an iterator that a
+            # score()/predict pass drained)
+            raise StopIteration(
+                'training iterator is exhausted at epoch start — '
+                'reset() it (a score()/predict pass leaves the '
+                'iterator drained, matching the reference fit loop)')
+        fut = start_put(snaps) if len(batches) == self.window else None
+        try:
+            while len(batches) == self.window:
+                # one program per (static attrs, shapes); lr/wd enter
+                # as traced arrays sampled at each window start, so an
+                # lr scheduler never forces a recompile
+                static_attrs = self._static_attrs()
+                attrs_key = tuple(sorted(static_attrs.items()))
+                shapes_key = tuple((tuple(d.shape), str(d.dtype))
+                                   for d in snaps[0][0])
+                prog_key = (attrs_key, shapes_key, self._defer_sig)
+                if prog_key not in self._programs:
+                    self._programs[prog_key] = self._build_program(
+                        static_attrs, shapes_key)
+                window_fn = self._programs[prog_key]
+
+                # host-metric mode: keep per-batch label wrappers from
+                # the draw-time snapshots for the deferred
+                # eval_metric.update. Stats mode needs nothing from the
+                # host batches.
+                labels_snap = None
+                if self.stat_fns is None:
+                    labels_snap = [[from_jax(l, self._exec._ctx)
+                                    for l in ls] for _, ls in snaps]
+                params, states, aux, gaccs = self._snapshot()
+                _t = _clk() if _timing else 0.0
+                data_stack, label_stack = fut()
+                if _timing:
+                    _now = _clk()
+                    _tm['put'] += _now - _t
+                    _t = _now
+                lr_arr, wd_arr = self._sample_window_lr()
+                self._base_key = _random.next_key()
+                params, states, aux, gaccs, pieces = window_fn(
+                    params, states, aux, gaccs, data_stack, label_stack,
+                    self._base_key, lr_arr, wd_arr)
+                self._writeback(params, states, aux, gaccs)
+                if _timing:
+                    _now = _clk()
+                    _tm['dispatch'] += _now - _t
+                    _t = _now
+                # dispatch is async: while this window computes, draw
+                # the NEXT window (its stack + transfer start on the
+                # side thread) and fetch the PREVIOUS window's stats —
+                # both the transfer and the fetch RTT disappear behind
+                # device time (callbacks run one window late; values
+                # and cadence are unchanged)
+                batches, snaps = collect()
+                fut = start_put(snaps) \
+                    if len(batches) == self.window else None
                 if pending is not None:
                     nbatch = apply_stats(pending[0], pending[1], nbatch)
-                    pending = None
-                for b, (ds, ls) in zip(batches, snaps):
-                    # tail: reference per-batch path, on a rebuilt batch
-                    # (the original's buffers may have been overwritten
-                    # by later draws). Deferred uint8 batches are
-                    # materialized eagerly here — one aug dispatch per
-                    # tail batch, exactly the eager mode's cost
-                    if self._defer_eager is not None:
-                        ds = (self._defer_eager(ds[0], _random.next_key()),
-                              ) + tuple(ds[1:])
-                    sb = _DataBatch(
-                        data=[from_jax(d, self._exec._ctx) for d in ds],
-                        label=[from_jax(l, self._exec._ctx) for l in ls],
-                        pad=getattr(b, 'pad', None),
-                        index=getattr(b, 'index', None))
-                    m.forward_backward(sb)
-                    m.update()
-                    m.update_metric(eval_metric, sb.label)
-                    if batch_end_callback is not None:
-                        p = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                          eval_metric=eval_metric,
-                                          locals=locals())
-                        for cb in _as_list(batch_end_callback):
-                            cb(p)
-                    nbatch += 1
-                break
-
-            # one program per (static attrs, shapes); lr/wd enter as
-            # traced arrays sampled at each window start, so an lr
-            # scheduler never forces a recompile
-            static_attrs = self._static_attrs()
-            attrs_key = tuple(sorted(static_attrs.items()))
-            shapes_key = tuple((tuple(d.shape), str(d.dtype))
-                               for d in snaps[0][0])
-            prog_key = (attrs_key, shapes_key, self._defer_sig)
-            if prog_key not in self._programs:
-                self._programs[prog_key] = self._build_program(
-                    static_attrs, shapes_key)
-            window_fn = self._programs[prog_key]
-
-            # host-metric mode: keep per-batch label wrappers from the
-            # draw-time snapshots for the deferred eval_metric.update.
-            # Stats mode needs nothing from the host batches.
-            labels_snap = None
-            if self.stat_fns is None:
-                labels_snap = [[from_jax(l, self._exec._ctx) for l in ls]
-                               for _, ls in snaps]
-            params, states, aux, gaccs = self._snapshot()
-            data_stack, label_stack = self._device_batches(snaps)
-            lr_arr, wd_arr = self._sample_window_lr()
-            self._base_key = _random.next_key()
-            params, states, aux, gaccs, pieces = window_fn(
-                params, states, aux, gaccs, data_stack, label_stack,
-                self._base_key, lr_arr, wd_arr)
-            self._writeback(params, states, aux, gaccs)
-            # dispatch is async: fetch the PREVIOUS window's stats now,
-            # while this window computes — the fetch RTT disappears
-            # behind device time (callbacks run one window late; values
-            # and cadence are unchanged)
-            if pending is not None:
-                nbatch = apply_stats(pending[0], pending[1], nbatch)
-            pending = (pieces, labels_snap)
+                pending = (pieces, labels_snap)
+                if _timing:
+                    _tm['fetch'] += _clk() - _t
+        finally:
+            # drain an in-flight prefetch before run_epoch's cache
+            # teardown (or an exception unwind) can race the side thread
+            if fut is not None and pool is not None:
+                try:
+                    fut()
+                except Exception:  # noqa: BLE001 — primary error wins
+                    pass
+        _t = _clk() if _timing else 0.0
         if pending is not None:
             nbatch = apply_stats(pending[0], pending[1], nbatch)
+        if _timing:
+            _tm['fetch'] += _clk() - _t
+        for b, (ds, ls) in zip(batches, snaps):
+            # tail (< window): reference per-batch path, on a rebuilt
+            # batch (the original's buffers may have been overwritten
+            # by later draws). Deferred uint8 batches are materialized
+            # eagerly here — one aug dispatch per tail batch, exactly
+            # the eager mode's cost
+            if self._defer_eager is not None:
+                ds = (self._defer_eager(ds[0], _random.next_key()),
+                      ) + tuple(ds[1:])
+            sb = _DataBatch(
+                data=[from_jax(d, self._exec._ctx) for d in ds],
+                label=[from_jax(l, self._exec._ctx) for l in ls],
+                pad=getattr(b, 'pad', None),
+                index=getattr(b, 'index', None))
+            m.forward_backward(sb)
+            m.update()
+            m.update_metric(eval_metric, sb.label)
+            if batch_end_callback is not None:
+                p = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                  eval_metric=eval_metric,
+                                  locals=locals())
+                for cb in _as_list(batch_end_callback):
+                    cb(p)
+            nbatch += 1
+        if _timing:
+            logging.info(
+                'fused_fit timing epoch=%d wall=%.3fs draw=%.3fs '
+                'put=%.3fs dispatch=%.3fs fetch=%.3fs', epoch,
+                _clk() - _ep_t0, _tm['draw'], _tm['put'],
+                _tm['dispatch'], _tm['fetch'])
         return nbatch

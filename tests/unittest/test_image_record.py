@@ -538,3 +538,77 @@ def test_defer_program_keyed_by_aug_config(tmp_path):
         assert keys[0][2] != keys[1][2]
     finally:
         os.environ.pop('MXTPU_FUSED_FIT', None)
+
+
+def test_host_crop_matches_device_crop_deterministic(tmp_path):
+    """host_crop=1 (workers crop to HxW before handover — 23% fewer
+    upload bytes for 224^2-from-256^2) must produce the device-crop
+    path's exact values with randomness off: the center-crop formulas
+    are shared, only the execution site moves."""
+    import mxnet_tpu as mx
+    p = str(tmp_path / 'hc.rec')
+    _write_rec(p, 8, hw=10)
+    kw = dict(mean_r=3, mean_g=5, mean_b=7, std_r=2, std_g=3, std_b=4,
+              scale=0.5, label_name='l')
+    a = mx.io.ImageRecordIter(p, **_iter_kw(6, 4, **kw),
+                              device_augment=1, host_crop=1)
+    b = mx.io.ImageRecordIter(p, **_iter_kw(6, 4, **kw),
+                              device_augment=1, host_crop=0)
+    a.reset(); b.reset()
+    for _ in range(2):
+        ba, bb = a.next(), b.next()
+        np.testing.assert_allclose(ba.data[0].asnumpy(),
+                                   bb.data[0].asnumpy(),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(ba.label[0].asnumpy(),
+                                      bb.label[0].asnumpy())
+
+
+def test_host_crop_defer_ships_cropped_uint8(tmp_path):
+    """In fused-fit defer mode a host-crop iterator hands over
+    (B, H, W, C) uint8 — the crop already applied — and its
+    device_aug_signature differs from the device-crop one, so the two
+    modes never share a compiled window."""
+    import mxnet_tpu as mx
+    p = str(tmp_path / 'hcd.rec')
+    _write_rec(p, 16, hw=10)
+    it = mx.io.ImageRecordIter(p, **_iter_kw(6, 4, label_name='l'),
+                               device_augment=1, host_crop=1)
+    it2 = mx.io.ImageRecordIter(p, **_iter_kw(6, 4, label_name='l'),
+                                device_augment=1, host_crop=0)
+    assert it.device_aug_signature() != it2.device_aug_signature()
+    assert it.defer_device_aug(True)
+    try:
+        b = next(iter(it))
+        d = b.data[0]
+        assert d.shape == (4, 6, 6, 3), d.shape      # pre-cropped HWC
+        assert str(d.dtype) == 'uint8'
+        # the pure fn consumes the pre-cropped batch directly
+        import jax
+        out = jax.jit(it.device_aug_pure())(
+            d.asnumpy(), jax.random.PRNGKey(0))
+        assert out.shape == (4, 3, 6, 6)
+    finally:
+        it.defer_device_aug(False)
+
+
+def test_host_crop_rand_crop_varies_and_is_seeded(tmp_path):
+    """Random host crops: per-image variation within a batch,
+    deterministic under mx.random.seed (offsets ride the producer's
+    per-batch RandomState, like the host-augment path)."""
+    import mxnet_tpu as mx
+    p = str(tmp_path / 'hcr.rec')
+    _write_rec(p, 16, hw=12)
+    kw = _iter_kw(8, 8, rand_crop=1, rand_mirror=1, label_name='l')
+
+    def run():
+        mx.random.seed(5)
+        it = mx.io.ImageRecordIter(p, **kw, device_augment=1,
+                                   host_crop=1)
+        it.reset()
+        return it.next().data[0].asnumpy()
+
+    a, b = run(), run()
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (8, 3, 8, 8)
+    assert len({arr.tobytes() for arr in a}) > 1

@@ -34,15 +34,25 @@ def main():
     rng = np.random.RandomState(0)
     # distinct buffers so no caching layer can dedupe the transfer
     batches = [rng.randint(0, 256, (BATCH, SRC, SRC, 3), np.uint8)
-               for _ in range(4)]
+               for _ in range(REPS)]
     nbytes = batches[0].nbytes
 
+    import jax.numpy as jnp
+
+    def landed(devs):
+        # host-fetch barrier over a value derived from EVERY buffer:
+        # block_until_ready can return EARLY through the tunnel
+        # (verify-skill note; the 2026-08-02 654 MB/s artifact was an
+        # artifact of that). One barrier for the whole train, so the
+        # per-fetch RTT is amortized and pure transfer time dominates
+        s = sum(jnp.sum(a[:, -1, -1, :].astype(jnp.int32)) for a in devs)
+        float(np.asarray(s))
+
     # warmup (backend init + any lazy transfer setup)
-    jax.device_put(batches[0], dev).block_until_ready()
+    landed([jax.device_put(batches[0], dev)])
 
     t0 = time.perf_counter()
-    for i in range(REPS):
-        jax.device_put(batches[i % 4], dev).block_until_ready()
+    landed([jax.device_put(b, dev) for b in batches])
     dt = time.perf_counter() - t0
 
     mbps = REPS * nbytes / dt / 1e6
