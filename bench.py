@@ -61,11 +61,9 @@ REPROBE_TIMEOUT_S = 120.0
 REPROBE_SLEEP_S = 45.0
 _START = time.perf_counter()
 
-# Peak dense bf16 FLOP/s per chip, by device_kind substring.
-_PEAK_FLOPS = [
-    ('v6', 918e12), ('v5p', 459e12), ('v5', 197e12),
-    ('v4', 275e12), ('v3', 123e12), ('v2', 45e12),
-]
+# Peak dense bf16 FLOP/s per chip lives in ONE place —
+# mxnet_tpu/telemetry/xla.py — shared by this bench's MFU and the
+# telemetry summary's xla.mfu gauge (see _peak_flops below).
 
 
 def _log(msg):
@@ -378,12 +376,9 @@ def _temp_bytes(compiled):
 
 
 def _peak_flops(device):
-    kind = getattr(device, 'device_kind', '') or ''
-    kind_l = kind.lower()
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind_l:
-            return peak, kind
-    return 0.0, kind
+    from mxnet_tpu.telemetry.xla import device_peak_flops
+    peak, _ = device_peak_flops(device)
+    return peak, getattr(device, 'device_kind', '') or ''
 
 
 def _late_tpu_attempt(remaining_s):
@@ -412,9 +407,47 @@ def _late_tpu_attempt(remaining_s):
     return None
 
 
+def _telemetry_breakdown(device):
+    """The dispatch/compile breakdown + peak device bytes from the
+    telemetry registry, as a JSON-ready dict (None when telemetry is
+    off or empty) — BENCH_*.json carries this from this round on."""
+    try:
+        from mxnet_tpu import telemetry as _tele
+        if not _tele.enabled():
+            return None
+        _tele.xla.sample_memory(device)
+        snap = _tele.snapshot()
+        tel = {}
+        c = snap['counters']
+        if c.get('xla.compiles'):
+            tel['compiles'] = int(c['xla.compiles'])
+            tel['compile_secs'] = round(c.get('xla.compile_secs', 0.0), 3)
+        h = snap['histograms'].get('bench.dispatch')
+        if h and h['count']:
+            tel['dispatch_ms'] = {k: round(h[k], 3)
+                                  for k in ('p50', 'p95', 'max')}
+        g = snap['gauges']
+        if 'xla.peak_bytes_in_use' in g:
+            tel['peak_device_bytes'] = int(g['xla.peak_bytes_in_use'])
+        if 'xla.bytes_in_use' in g:
+            tel['live_device_bytes'] = int(g['xla.bytes_in_use'])
+        return tel or None
+    except Exception as e:  # noqa: BLE001 — the bench number must survive
+        _log('telemetry fold-in failed: %s' % e)
+        return None
+
+
 def main():
     _log('python up, pid=%d — probing backend before any device work'
          % os.getpid())
+    # telemetry rides every bench run (ISSUE 1): the compile/dispatch
+    # breakdown and peak device bytes fold into the emitted JSON below.
+    # setdefault: an explicit MXTPU_TELEMETRY=0 still wins.
+    import tempfile
+    os.environ.setdefault('MXTPU_TELEMETRY', '1')
+    os.environ.setdefault('MXTPU_TELEMETRY_PATH',
+                          os.path.join(tempfile.gettempdir(),
+                                       'bench_telemetry.jsonl'))
     if os.environ.get('MXTPU_BENCH_DIRECT'):
         # child of a successful late reprobe: init the default backend
         # straight away (the parent just verified it is healthy)
@@ -455,6 +488,8 @@ def main():
             return m, a, v, losses[-1]
         _log('fusing %d steps per device call (lax.scan)' % STEPS_PER_CALL)
 
+    from mxnet_tpu import telemetry as _tele
+
     t = time.perf_counter()
     _log('compiling (first compile can take 20-40s)...')
     jstep = jax.jit(step, donate_argnums=(0, 1, 2))
@@ -465,6 +500,7 @@ def main():
     # of trip count (verified: identical flops at 1 vs 8 steps/call), so
     # scale to per-dispatch flops here
     flops_per_step *= STEPS_PER_CALL
+    _tele.xla.note_step_flops(flops_per_step / max(1, STEPS_PER_CALL))
     temp_bytes = _temp_bytes(compiled)
     _log('compile: %.1fs, step flops=%.3e, xla temp=%.1f MiB'
          % (time.perf_counter() - t, flops_per_step, temp_bytes / 2**20))
@@ -488,8 +524,13 @@ def main():
     _log('measuring %d steps...' % bench_steps)
     t0 = time.perf_counter()
     for _ in range(bench_steps):
-        masters, aux, vel, loss = compiled(
-            masters, aux, vel, images, labels, key)
+        # span = host-side dispatch cost per device call (the tunnel-RTT
+        # breakdown); device compute overlaps asynchronously behind it
+        with _tele.span('bench.dispatch', 'bench'):
+            masters, aux, vel, loss = compiled(
+                masters, aux, vel, images, labels, key)
+        # feeds the xla.mfu estimate together with note_step_flops above
+        _tele.counter('fit.steps').inc(STEPS_PER_CALL)
     float(np.asarray(loss))  # host fetch = true barrier (see warmup)
     dt = time.perf_counter() - t0
 
@@ -539,6 +580,9 @@ def main():
     if platform.startswith('cpu'):
         out['note'] = ('cpu run at reduced batch; not config-comparable '
                        'to the batch-32 GPU baseline')
+    tel = _telemetry_breakdown(devices[0])
+    if tel:
+        out['telemetry'] = tel
     # emit the measured number NOW so an interrupted reprobe window can
     # never lose it; if a real device recovers below, its JSON is
     # printed after — the LAST line is authoritative

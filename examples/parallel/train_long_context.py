@@ -27,8 +27,9 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), '..', '..'))
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
+
+from mxnet_tpu.parallel import shard_map  # version-stable kwarg spelling
 
 from mxnet_tpu import parallel as par
 from mxnet_tpu.parallel.ring_attention import (ring_attention,

@@ -59,6 +59,13 @@ from . import rtc
 from . import contrib
 from . import plugin
 from . import parallel
+from . import telemetry
+
+# Decide telemetry at import so the jax.monitoring compile listener is
+# installed before the process's FIRST compile (a fit run must log its
+# warmup compiles too). With MXTPU_TELEMETRY unset this is one cached
+# flag read and nothing else.
+telemetry.enabled()
 
 # Server/scheduler processes block in their role loop here and exit with the
 # job (reference python/mxnet/kvstore_server.py:75).

@@ -9,6 +9,8 @@ import math
 import sys
 import time
 
+from . import telemetry as _tele
+
 __all__ = ['Speedometer', 'do_checkpoint', 'module_checkpoint',
            'log_train_metric', 'ProgressBar', 'LogValidationMetricsCallback']
 
@@ -75,6 +77,9 @@ class Speedometer:
         if param.nbatch % self.frequent:
             return
         speed = self.frequent * self.batch_size / (now - self._window_open)
+        # telemetry mirror of the measurement (no-op when telemetry is
+        # off); the pinned `Speed:` log-line format below is unchanged
+        _tele.gauge('speedometer.samples_per_sec').set(round(speed, 2))
         metric = param.eval_metric
         if metric is None:
             logging.info('Iter[%d] Batch [%d]\tSpeed: %.2f samples/sec',

@@ -138,6 +138,19 @@ flags.declare('MXTPU_CONV_STEM_S2D', bool, False,
               'image-network stem) into space-to-depth + stride-1 convs; '
               'exact reparametrization that the MXU tiles far better than '
               'a cin=3 strided conv (see docs/perf.md)')
+flags.declare('MXTPU_TELEMETRY', bool, False,
+              'Runtime telemetry (mxnet_tpu/telemetry): span/counter/'
+              'gauge registry over the train hot path, XLA compile and '
+              'memory gauges, JSONL metrics log + end-of-run summary '
+              'table. Off = zero-overhead no-op path')
+flags.declare('MXTPU_TELEMETRY_PATH', str, 'telemetry.jsonl',
+              'Append-only JSONL metrics log written while '
+              'MXTPU_TELEMETRY=1 (one JSON record per line: spans, '
+              'compile events, end-of-run summary)')
+flags.declare('MXTPU_TELEMETRY_RETRACE_WARN', int, 5,
+              'Warn (once, loudly) when the same graph is compiled more '
+              'than this many times — the retrace-storm detector',
+              min_value=1)
 flags.declare('MXTPU_PROFILER_XLA_TRACE', str, 'auto',
               "Attach jax.profiler alongside the host-span trace when the "
               "profiler runs: '1' always, '0' never, 'auto' = only on "

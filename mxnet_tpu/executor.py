@@ -201,6 +201,17 @@ class Executor:
         self._pending = None  # (arg jax arrays, aux jax arrays, key) for lazy train fwd
         self._partial = None  # partial_forward stepping state
 
+        from . import telemetry as _tele
+        if _tele.enabled():
+            # retrace-storm detector: binding the same graph signature
+            # repeatedly (rebind-per-batch, reshape loops) recompiles
+            # the same XLA program each time
+            _tele.xla.note_retrace(
+                ('executor', tuple(self._prog.arg_names),
+                 tuple(symbol.list_outputs()),
+                 tuple((tuple(a.shape), str(a._data.dtype))
+                       for a in self.arg_arrays)))
+
     def _canon_args(self, args, names, what, allow_missing=False):
         if isinstance(args, dict):
             out = []
@@ -221,8 +232,8 @@ class Executor:
     # -- forward ----------------------------------------------------------
     def forward(self, is_train=False, **kwargs):
         """Reference executor.py:89 / GraphExecutor::Forward."""
-        from . import profiler as _profiler
-        with _profiler.maybe_span('executor.forward', 'executor'):
+        from . import telemetry as _tele
+        with _tele.span('executor.forward', 'executor'):
             return self._forward_impl(is_train, **kwargs)
 
     def _forward_impl(self, is_train=False, **kwargs):
@@ -342,8 +353,8 @@ class Executor:
     # -- backward ---------------------------------------------------------
     def backward(self, out_grads=None, is_train=True):
         """Reference GraphExecutor::Backward (graph_executor.cc:93)."""
-        from . import profiler as _profiler
-        with _profiler.maybe_span('executor.backward', 'executor'):
+        from . import telemetry as _tele
+        with _tele.span('executor.backward', 'executor'):
             return self._backward_impl(out_grads, is_train)
 
     def _backward_impl(self, out_grads=None, is_train=True):

@@ -15,10 +15,12 @@ import os
 import struct
 import gzip
 import threading
+import time
 
 import numpy as np
 
 from .. import random as _random
+from .. import telemetry as _tele
 
 from ..ndarray import NDArray, array
 from ..base import MXNetError
@@ -76,6 +78,7 @@ class DataIter:
 
     def next(self):
         if self.iter_next():
+            _tele.counter('io.batches').inc()
             return DataBatch(data=self.getdata(), label=self.getlabel(),
                              pad=self.getpad(), index=self.getindex())
         raise StopIteration
@@ -184,6 +187,7 @@ class NDArrayIter(DataIter):
 
     def next(self):
         if self.iter_next():
+            _tele.counter('io.batches').inc()
             return DataBatch(data=self.getdata(), label=self.getlabel(),
                              pad=self.getpad(), index=None,
                              provide_data=self.provide_data,
@@ -328,8 +332,17 @@ class PrefetchingIter(DataIter):
             e.set()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
+        if _tele.enabled():
+            # how long the consumer stalled on the producer thread(s) —
+            # the "is the input pipeline the bottleneck?" histogram
+            t0 = time.time()
+            for e in self.data_ready:
+                e.wait()
+            _tele.histogram('io.prefetch_wait').observe(
+                (time.time() - t0) * 1e3)
+        else:
+            for e in self.data_ready:
+                e.wait()
         if self.next_batch[0] is None:
             for i in self.next_batch:
                 assert i is None, 'Number of entry mismatches between iterators'
@@ -349,6 +362,8 @@ class PrefetchingIter(DataIter):
         return True
 
     def next(self):
+        # no io.batches inc here: the producer thread's inner
+        # iters[i].next() calls already count each batch once
         if self.iter_next():
             return self.current_batch
         raise StopIteration
@@ -658,6 +673,7 @@ class ImageRecordIter(DataIter):
         if item is None:
             self._exhausted = True
             raise StopIteration
+        _tele.counter('io.batches').inc()
         data, label, pad = item
         from .. import ndarray as _nd
         if self._device_augment and self._defer_aug:

@@ -20,10 +20,24 @@ import numpy as np
 import jax
 
 from . import optimizer as opt
+from . import telemetry as _tele
 from .ndarray import NDArray, zeros
 from .base import MXNetError
 
 __all__ = ['KVStore', 'create']
+
+
+def _tele_bytes(counter_name, values):
+    """Account logical payload bytes for a push/pull value list (flat
+    list or list-of-lists of NDArrays) into a telemetry counter."""
+    total = 0
+    for v in values:
+        for a in (v if isinstance(v, (list, tuple)) else [v]):
+            try:
+                total += int(np.prod(a.shape)) * np.dtype(a.dtype).itemsize
+            except Exception:  # noqa: BLE001 — exotic sparse/host types
+                pass
+    _tele.counter(counter_name).inc(total)
 
 
 def _ctx_group_key(arrs):
@@ -52,31 +66,38 @@ class KVStore:
     def push(self, key, value, priority=0):
         """Reduce value(s) per key; run updater or store the merged grad
         (reference kvstore_local.h:149 PushImpl)."""
-        keys, values = _key_value(key, value)
-        for k, vlist in zip(keys, values):
-            if not isinstance(vlist, (list, tuple)):
-                vlist = [vlist]
-            merged = self._reduce(vlist)
-            if self._updater is not None:
-                self._updater(_updater_key(k), merged, self._store[k])
-            else:
-                self._store[k] = merged
+        with _tele.span('kvstore.push', 'kvstore'):
+            keys, values = _key_value(key, value)
+            if _tele.enabled():
+                _tele_bytes('kvstore.push_bytes', values)
+            for k, vlist in zip(keys, values):
+                if not isinstance(vlist, (list, tuple)):
+                    vlist = [vlist]
+                merged = self._reduce(vlist)
+                if self._updater is not None:
+                    self._updater(_updater_key(k), merged, self._store[k])
+                else:
+                    self._store[k] = merged
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         """Broadcast stored value to out array(s) (kvstore_local.h:188)."""
         assert out is not None
-        keys, outs = _key_value(key, out)
-        for k, olist in zip(keys, outs):
-            if not isinstance(olist, (list, tuple)):
-                olist = [olist]
-            src = self._store[k]
-            for o in olist:
-                # cast to the destination's dtype (reference CopyFromTo):
-                # with multi-precision optimizers the store/updater holds
-                # fp32 masters while executors stay bound in bf16
-                o._data = jax.device_put(
-                    src._data.astype(o._data.dtype),
-                    o.context.jax_device())
+        with _tele.span('kvstore.pull', 'kvstore'):
+            keys, outs = _key_value(key, out)
+            if _tele.enabled():
+                _tele_bytes('kvstore.pull_bytes', outs)
+            for k, olist in zip(keys, outs):
+                if not isinstance(olist, (list, tuple)):
+                    olist = [olist]
+                src = self._store[k]
+                for o in olist:
+                    # cast to the destination's dtype (reference
+                    # CopyFromTo): with multi-precision optimizers the
+                    # store/updater holds fp32 masters while executors
+                    # stay bound in bf16
+                    o._data = jax.device_put(
+                        src._data.astype(o._data.dtype),
+                        o.context.jax_device())
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         """Reference kvstore_local.h:203 PullRowSparseImpl."""

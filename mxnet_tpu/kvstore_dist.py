@@ -37,8 +37,9 @@ import numpy as np
 
 import jax
 
+from . import telemetry as _tele
 from .base import MXNetError
-from .kvstore import KVStore, _key_value
+from .kvstore import KVStore, _key_value, _tele_bytes
 from .ndarray import NDArray
 from ._dist_proto import (send_msg, recv_msg, pack_array, unpack_array,
                           connect)
@@ -252,19 +253,24 @@ class KVStoreDist(KVStore):
 
     def push(self, key, value, priority=0):
         from .ndarray.sparse import RowSparseNDArray
-        keys, values = _key_value(key, value)
-        for k, vlist in zip(keys, values):
-            if not isinstance(vlist, (list, tuple)):
-                vlist = [vlist]
-            if isinstance(vlist[0], RowSparseNDArray):
-                self._push_row_sparse(k, vlist)
-                continue
-            merged = self._reduce(vlist).asnumpy()
-            if k not in self._key_meta:
-                self._key_meta[k] = (merged.shape, merged.dtype)
-            flat = merged.reshape(-1)
-            for sid, skey, sl in self._shards(k, merged.shape, merged.dtype):
-                self._conns[sid].submit(('push', skey, pack_array(flat[sl])))
+        with _tele.span('kvstore.push', 'kvstore'):
+            keys, values = _key_value(key, value)
+            if _tele.enabled():
+                _tele_bytes('kvstore.push_bytes', values)
+            for k, vlist in zip(keys, values):
+                if not isinstance(vlist, (list, tuple)):
+                    vlist = [vlist]
+                if isinstance(vlist[0], RowSparseNDArray):
+                    self._push_row_sparse(k, vlist)
+                    continue
+                merged = self._reduce(vlist).asnumpy()
+                if k not in self._key_meta:
+                    self._key_meta[k] = (merged.shape, merged.dtype)
+                flat = merged.reshape(-1)
+                for sid, skey, sl in self._shards(k, merged.shape,
+                                                  merged.dtype):
+                    self._conns[sid].submit(
+                        ('push', skey, pack_array(flat[sl])))
 
     def _push_row_sparse(self, k, vlist):
         """Row-sparse grads go whole to the key's home server (the
@@ -284,24 +290,27 @@ class KVStoreDist(KVStore):
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         assert out is not None
-        keys, outs = _key_value(key, out)
-        for k, olist in zip(keys, outs):
-            if not isinstance(olist, (list, tuple)):
-                olist = [olist]
-            shape, dtype = self._key_meta.get(
-                k, (olist[0].shape, olist[0].dtype))
-            shards = self._shards(k, shape, dtype)
-            futs = [(sl, self._conns[sid].submit(('pull', skey)))
-                    for sid, skey, sl in shards]
-            flat = np.empty(int(np.prod(shape)), dtype)
-            for sl, f in futs:
-                reply = f.wait()
-                assert reply and reply[0] == 'arr', reply
-                flat[sl] = unpack_array(reply[1]).reshape(-1)
-            arr = flat.reshape(shape)
-            for o in olist:
-                o._data = jax.device_put(
-                    arr.astype(o.dtype), o.context.jax_device())
+        with _tele.span('kvstore.pull', 'kvstore'):
+            keys, outs = _key_value(key, out)
+            if _tele.enabled():
+                _tele_bytes('kvstore.pull_bytes', outs)
+            for k, olist in zip(keys, outs):
+                if not isinstance(olist, (list, tuple)):
+                    olist = [olist]
+                shape, dtype = self._key_meta.get(
+                    k, (olist[0].shape, olist[0].dtype))
+                shards = self._shards(k, shape, dtype)
+                futs = [(sl, self._conns[sid].submit(('pull', skey)))
+                        for sid, skey, sl in shards]
+                flat = np.empty(int(np.prod(shape)), dtype)
+                for sl, f in futs:
+                    reply = f.wait()
+                    assert reply and reply[0] == 'arr', reply
+                    flat[sl] = unpack_array(reply[1]).reshape(-1)
+                arr = flat.reshape(shape)
+                for o in olist:
+                    o._data = jax.device_put(
+                        arr.astype(o.dtype), o.context.jax_device())
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         from .ndarray.sparse import RowSparseNDArray, row_sparse_array

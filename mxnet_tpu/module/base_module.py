@@ -12,6 +12,7 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import telemetry as _tele
 from ..io import DataDesc
 from ..model import BatchEndParam
 from ..initializer import Uniform
@@ -167,6 +168,9 @@ class BaseModule:
         """THE canonical train loop (reference base_module.py:376)."""
         assert num_epoch is not None, 'please specify number of epochs'
 
+        # decide telemetry before bind: the XLA compile listener must be
+        # live before this fit's first compile so warmups are counted
+        _tele.enabled()
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
                   for_training=True, force_rebind=force_rebind)
@@ -213,22 +217,32 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch)
-                except StopIteration:
-                    end_of_batch = True
-                self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
+                # per-batch telemetry: host-dispatch vs draw vs metric vs
+                # callback time (all no-ops unless MXTPU_TELEMETRY=1 or
+                # the chrome-trace profiler is running)
+                with _tele.span('fit.batch', 'fit'):
+                    with _tele.span('fit.dispatch', 'fit'):
+                        self.forward_backward(data_batch)
+                        self.update()
+                    _tele.counter('fit.steps').inc()
+                    try:
+                        with _tele.span('fit.draw', 'fit'):
+                            next_data_batch = next(data_iter)
+                        self.prepare(next_data_batch)
+                    except StopIteration:
+                        end_of_batch = True
+                    with _tele.span('fit.metric', 'fit'):
+                        self.update_metric(eval_metric, data_batch.label)
+                    if monitor is not None:
+                        monitor.toc_print()
+                    if batch_end_callback is not None:
+                        batch_end_params = BatchEndParam(epoch=epoch,
+                                                         nbatch=nbatch,
+                                                         eval_metric=eval_metric,
+                                                         locals=locals())
+                        with _tele.span('fit.callback', 'fit'):
+                            for callback in _as_list(batch_end_callback):
+                                callback(batch_end_params)
                 nbatch += 1
 
             self._fit_epoch_end(epoch, eval_metric, tic, epoch_end_callback,
@@ -241,6 +255,8 @@ class BaseModule:
                        eval_batch_end_callback):
         """Epoch-end bookkeeping shared by the reference per-batch loop
         and the fused fast path (reference base_module.py:528-553)."""
+        _tele.counter('fit.epochs').inc()
+        _tele.xla.sample_memory()   # live/peak device bytes, once per epoch
         for name, val in eval_metric.get_name_value():
             self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
         toc = time.time()

@@ -22,6 +22,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .. import context as ctx_mod
 from .. import ndarray as nd
+from .. import telemetry as _tele
 from ..io import DataDesc
 from ..executor import Executor
 
@@ -258,14 +259,16 @@ class DataParallelExecutorGroup:
             aux_params[name]._data = block[0]._data
 
     def forward(self, data_batch, is_train=None):
-        _load_general(data_batch.data, self.data_arrays, self.data_layouts)
-        if is_train is None:
-            is_train = self.for_training
-        if self.label_arrays is not None and data_batch.label:
-            _load_general(data_batch.label, self.label_arrays,
-                          self.label_layouts)
-        for e in self.execs:
-            e.forward(is_train=is_train)
+        with _tele.span('exec_group.forward', 'executor'):
+            _load_general(data_batch.data, self.data_arrays,
+                          self.data_layouts)
+            if is_train is None:
+                is_train = self.for_training
+            if self.label_arrays is not None and data_batch.label:
+                _load_general(data_batch.label, self.label_arrays,
+                              self.label_layouts)
+            for e in self.execs:
+                e.forward(is_train=is_train)
 
     def get_output_shapes(self):
         outputs = self.execs[0].outputs
@@ -295,6 +298,10 @@ class DataParallelExecutorGroup:
 
     def backward(self, out_grads=None):
         assert self.for_training, 're-bind with for_training=True to run backward'
+        with _tele.span('exec_group.backward', 'executor'):
+            self._backward_impl(out_grads)
+
+    def _backward_impl(self, out_grads):
         for i, exec_ in enumerate(self.execs):
             out_grads_slice = None
             if out_grads is not None:
@@ -491,23 +498,25 @@ class SPMDExecutorGroup:
 
     # -- step ------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
-        e = self.execs[0]
-        if is_train is None:
-            is_train = self.for_training
-        for name, src in zip(self._data_names, data_batch.data):
-            e.arg_dict[name]._data = jax.device_put(
-                src._data, self._shard_for(name, src._data.ndim))
-        if self._label_names and data_batch.label:
-            for name, src in zip(self._label_names, data_batch.label):
+        with _tele.span('exec_group.forward', 'executor'):
+            e = self.execs[0]
+            if is_train is None:
+                is_train = self.for_training
+            for name, src in zip(self._data_names, data_batch.data):
                 e.arg_dict[name]._data = jax.device_put(
                     src._data, self._shard_for(name, src._data.ndim))
-        self._place_replicated()
-        e.forward(is_train=is_train)
+            if self._label_names and data_batch.label:
+                for name, src in zip(self._label_names, data_batch.label):
+                    e.arg_dict[name]._data = jax.device_put(
+                        src._data, self._shard_for(name, src._data.ndim))
+            self._place_replicated()
+            e.forward(is_train=is_train)
 
     def backward(self, out_grads=None):
         assert self.for_training, \
             're-bind with for_training=True to run backward'
-        self.execs[0].backward(out_grads=out_grads)
+        with _tele.span('exec_group.backward', 'executor'):
+            self.execs[0].backward(out_grads=out_grads)
 
     # -- results ---------------------------------------------------------
     def get_output_shapes(self):

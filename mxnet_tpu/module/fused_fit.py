@@ -54,6 +54,7 @@ import jax.numpy as jnp
 
 from .. import metric as metric_mod
 from .. import optimizer as opt_mod
+from .. import telemetry as _tele
 from ..optimizer import _as_clip
 from ..executor import mirror_wrap
 from ..kvstore import _updater_key
@@ -759,6 +760,7 @@ class FusedFitLoop:
         from ..model import BatchEndParam
         from .base_module import _as_list
 
+        _tele.gauge('fused_fit.steps_per_call').set(self.window)
         try:
             _host_dev = jax.local_devices(backend='cpu')[0]
         except RuntimeError:
@@ -779,12 +781,15 @@ class FusedFitLoop:
             each step's outputs against the window's own labels
             (snapshotted at collection time — see below), the way the
             reference loop's update_metric would."""
-            if self.stat_fns is not None:
-                host = np.asarray(pieces)      # (W, 2 * n_metrics)
-                steps = host.shape[0]
-            else:
-                outs_host = [np.asarray(o) for o in pieces]  # (W, ...)
-                steps = outs_host[0].shape[0]
+            with _tele.span('fused_fit.fetch', 'fused_fit'):
+                # the window's one device->host fetch (full RTT on a
+                # tunneled runtime; everything after is host math)
+                if self.stat_fns is not None:
+                    host = np.asarray(pieces)      # (W, 2 * n_metrics)
+                    steps = host.shape[0]
+                else:
+                    outs_host = [np.asarray(o) for o in pieces]  # (W, ...)
+                    steps = outs_host[0].shape[0]
             for i in range(steps):
                 if self.stat_fns is not None:
                     for j, child in enumerate(self.children):
@@ -881,14 +886,15 @@ class FusedFitLoop:
             # the window is collected and the apply is deferred.
             batches, snaps = [], []
             _t = _clk() if _timing else 0.0
-            while len(batches) < self.window:
-                try:
-                    b = next(it)
-                except StopIteration:
-                    break
-                batches.append(b)
-                snaps.append((tuple(a._data for a in b.data),
-                              tuple(l._data for l in b.label)))
+            with _tele.span('fused_fit.draw', 'fused_fit'):
+                while len(batches) < self.window:
+                    try:
+                        b = next(it)
+                    except StopIteration:
+                        break
+                    batches.append(b)
+                    snaps.append((tuple(a._data for a in b.data),
+                                  tuple(l._data for l in b.label)))
             if _timing:
                 _tm['draw'] += _clk() - _t
             return batches, snaps
@@ -928,8 +934,14 @@ class FusedFitLoop:
                                    for d in snaps[0][0])
                 prog_key = (attrs_key, shapes_key, self._defer_sig)
                 if prog_key not in self._programs:
-                    self._programs[prog_key] = self._build_program(
-                        static_attrs, shapes_key)
+                    with _tele.span('fused_fit.build', 'fused_fit'):
+                        self._programs[prog_key] = self._build_program(
+                            static_attrs, shapes_key)
+                    # same-key rebuilds only happen when the program dict
+                    # was torn down; the storm detector keys on the
+                    # SHAPES — a shape/attr leaking into attrs_key shows
+                    # up as many builds of one shapes_key
+                    _tele.xla.note_retrace(('fused_fit.window', shapes_key))
                 window_fn = self._programs[prog_key]
 
                 # host-metric mode: keep per-batch label wrappers from
@@ -942,17 +954,21 @@ class FusedFitLoop:
                                     for l in ls] for _, ls in snaps]
                 params, states, aux, gaccs = self._snapshot()
                 _t = _clk() if _timing else 0.0
-                data_stack, label_stack = fut()
+                with _tele.span('fused_fit.put', 'fused_fit'):
+                    data_stack, label_stack = fut()
                 if _timing:
                     _now = _clk()
                     _tm['put'] += _now - _t
                     _t = _now
-                lr_arr, wd_arr = self._sample_window_lr()
-                self._base_key = _random.next_key()
-                params, states, aux, gaccs, pieces = window_fn(
-                    params, states, aux, gaccs, data_stack, label_stack,
-                    self._base_key, lr_arr, wd_arr)
-                self._writeback(params, states, aux, gaccs)
+                with _tele.span('fused_fit.dispatch', 'fused_fit'):
+                    lr_arr, wd_arr = self._sample_window_lr()
+                    self._base_key = _random.next_key()
+                    params, states, aux, gaccs, pieces = window_fn(
+                        params, states, aux, gaccs, data_stack, label_stack,
+                        self._base_key, lr_arr, wd_arr)
+                    self._writeback(params, states, aux, gaccs)
+                _tele.counter('fit.steps').inc(self.window)
+                _tele.counter('fused_fit.windows').inc()
                 if _timing:
                     _now = _clk()
                     _tm['dispatch'] += _now - _t
@@ -1000,6 +1016,7 @@ class FusedFitLoop:
                 index=getattr(b, 'index', None))
             m.forward_backward(sb)
             m.update()
+            _tele.counter('fit.steps').inc()
             m.update_metric(eval_metric, sb.label)
             if batch_end_callback is not None:
                 p = BatchEndParam(epoch=epoch, nbatch=nbatch,

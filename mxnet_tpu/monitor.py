@@ -15,7 +15,11 @@ _Record = namedtuple('_Record', ['step', 'name', 'stat'])
 
 
 def _rms_stat(x):
-    """Default statistic: RMS of the tensor, as a string."""
+    """Default statistic: RMS of the tensor, as a string. A zero-size
+    array (empty bucket slice, degenerate shape) has no RMS — report
+    'nan' instead of raising ZeroDivisionError mid-fit."""
+    if x.size == 0:
+        return 'nan'
     return str((x.norm() / sqrt(x.size)).asscalar())
 
 

@@ -29,7 +29,9 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+
+from ._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .mesh import DeviceMesh

@@ -1,0 +1,293 @@
+"""Runtime telemetry: spans, counters, gauges — the observability layer.
+
+The engine/executor/kvstore stack only earns "as fast as the hardware
+allows" if we can see where time goes. This package is the process-wide
+instrumentation the hot paths report through:
+
+- a metrics registry (:mod:`.registry`): counters, gauges, histograms
+  with recent-window p50/p95;
+- a low-overhead span tracer (:func:`span`): times a host-side region
+  into a histogram AND — whenever the chrome-trace profiler is running
+  — into the same trace file ``profiler.py`` writes, so telemetry
+  spans and engine op spans land on one timeline;
+- XLA gauges (:mod:`.xla`): compile count/seconds via jax.monitoring,
+  retrace-storm detection, live/peak device bytes, an MFU estimate;
+- exporters (:mod:`.export`): an append-only JSONL log plus an
+  end-of-run human-readable summary table.
+
+Everything is OFF by default. ``MXTPU_TELEMETRY=1`` turns it on;
+``MXTPU_TELEMETRY_PATH`` points the JSONL log (default
+``telemetry.jsonl``). While off, every entry point degrades to a
+shared no-op object — zero I/O, no registry writes, one cached-bool
+check per call site (asserted by tests/unittest/test_telemetry.py).
+
+Instrumented sites (the names to grep for in the log):
+``fit.batch`` / ``fit.dispatch`` / ``fit.metric`` / ``fit.callback``
+(reference per-batch loop), ``fused_fit.draw|put|dispatch|fetch|build``
++ gauge ``fused_fit.steps_per_call`` (compiled window loop),
+``executor.forward|backward``, ``exec_group.forward|backward``,
+``module.update``, histogram ``io.prefetch_wait`` + counter
+``io.batches``, ``kvstore.push|pull`` spans + ``kvstore.push_bytes`` /
+``kvstore.pull_bytes`` counters, gauge ``speedometer.samples_per_sec``,
+and the ``xla.*`` compile/memory metrics.
+"""
+import atexit
+import logging
+import os
+import threading
+import time
+
+from .registry import (Registry, NULL_COUNTER, NULL_GAUGE, NULL_HISTOGRAM)
+from . import export as _export
+from . import xla  # noqa: F401  (public submodule: telemetry.xla.*)
+
+__all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
+           'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
+           'get_registry']
+
+
+class _State:
+    __slots__ = ('decided', 'active', 'registry', 'sink', 't_start',
+                 'retraces', 'lock', 'summary_written')
+
+    def __init__(self):
+        self.decided = False
+        self.active = False
+        self.registry = Registry()
+        self.sink = None
+        self.t_start = None
+        self.retraces = {}
+        self.lock = threading.Lock()
+        self.summary_written = False
+
+
+_state = _State()
+_decide_lock = threading.Lock()
+_atexit_registered = False
+
+
+def _decide():
+    global _atexit_registered
+    with _decide_lock:
+        if _state.decided:
+            return _state.active
+        from ..config import flags
+        try:
+            on = bool(flags.get('MXTPU_TELEMETRY'))
+        except Exception:  # noqa: BLE001 — stripped builds without the flag
+            on = False
+        _state.active = on
+        _state.decided = True
+        if on:
+            _state.t_start = time.time()
+            from ..config import flags as _flags
+            try:
+                path = _flags.get('MXTPU_TELEMETRY_PATH')
+            except Exception:  # noqa: BLE001
+                path = ''
+            path = os.path.expanduser(path or 'telemetry.jsonl')
+            try:
+                _state.sink = _export.JsonlSink(path)
+                _state.sink.emit({'type': 'start', 'pid': os.getpid(),
+                                  'path': path})
+            except OSError as e:
+                logging.warning('telemetry: cannot open %s (%s) — metrics '
+                                'stay in-process, no JSONL log', path, e)
+                _state.sink = None
+            xla.install()
+            if not _atexit_registered:
+                _atexit_registered = True
+                atexit.register(shutdown)
+    return _state.active
+
+
+def enabled():
+    """Whether telemetry is on (decided once from MXTPU_TELEMETRY; the
+    first True decision opens the sink and installs the XLA listener).
+    Hot call sites rely on this being one attribute check after the
+    first call."""
+    if _state.decided:
+        return _state.active
+    return _decide()
+
+
+def get_registry():
+    return _state.registry
+
+
+def counter(name):
+    """Live counter when enabled, shared no-op otherwise."""
+    return _state.registry.counter(name) if enabled() else NULL_COUNTER
+
+
+def gauge(name):
+    return _state.registry.gauge(name) if enabled() else NULL_GAUGE
+
+
+def histogram(name):
+    return _state.registry.histogram(name) if enabled() else NULL_HISTOGRAM
+
+
+# -- span tracer -------------------------------------------------------------
+
+_TLS = threading.local()
+
+
+def _stack():
+    st = getattr(_TLS, 'stack', None)
+    if st is None:
+        st = _TLS.stack = []
+    return st
+
+
+class _NullSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Times a host region: histogram (ms) + JSONL record, and a
+    chrome-trace event whenever the profiler is running. Nesting is
+    tracked per-thread; the JSONL record carries the full path
+    ('fit.batch/fit.dispatch') so traces reconstruct the tree."""
+
+    __slots__ = ('name', 'cat', 't0', 'path')
+
+    def __init__(self, name, category):
+        self.name = name
+        self.cat = category
+
+    def __enter__(self):
+        stack = _stack()
+        self.path = (stack[-1].path + '/' + self.name) if stack else self.name
+        stack.append(self)
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *a):
+        t1 = time.time()
+        stack = _stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        elif self in stack:           # unwound out of order (exception)
+            stack.remove(self)
+        dur_ms = (t1 - self.t0) * 1e3
+        st = _state
+        if st.active:
+            st.registry.histogram(self.name).observe(dur_ms)
+            if st.sink is not None:
+                st.sink.emit({'type': 'span', 'name': self.name,
+                              'path': self.path, 't': self.t0,
+                              'dur_ms': round(dur_ms, 4)})
+        from .. import profiler as _profiler
+        if _profiler.is_running():
+            _profiler.record_event(self.name, int(self.t0 * 1e6),
+                                   int(t1 * 1e6), self.cat)
+
+
+def span(name, category='telemetry'):
+    """Context manager timing a host-side region.
+
+    Enabled telemetry: records a histogram observation (ms) under
+    ``name`` and appends a JSONL span record. Running profiler: emits a
+    chrome-trace event into profiler.py's timeline (this works even
+    with telemetry off, replacing profiler.maybe_span at call sites).
+    Neither: returns the shared no-op."""
+    if enabled():
+        return _Span(name, category)
+    from .. import profiler as _profiler
+    if _profiler.is_running():
+        return _Span(name, category)   # chrome-trace only; exit skips st
+    return _NULL_SPAN
+
+
+def current_span_path():
+    """Dotted path of the innermost open span on this thread (tests)."""
+    stack = getattr(_TLS, 'stack', None)
+    return stack[-1].path if stack else None
+
+
+def event(name, **fields):
+    """Append an ad-hoc JSONL record (type='event')."""
+    if enabled() and _state.sink is not None:
+        rec = {'type': 'event', 'name': name}
+        rec.update(fields)
+        _state.sink.emit(rec)
+
+
+# -- summary / shutdown ------------------------------------------------------
+
+def snapshot():
+    return _state.registry.snapshot()
+
+
+def summary():
+    """The human-readable end-of-run table, as a string."""
+    elapsed = (time.time() - _state.t_start) if _state.t_start else None
+    return _export.summary_table(_state.registry.snapshot(), elapsed)
+
+
+def write_summary(log=True):
+    """Sample the XLA gauges one last time, append the JSONL summary
+    record, and (by default) log the table. Returns the table string,
+    or None when telemetry is off."""
+    if not enabled():
+        return None
+    xla.sample_memory()
+    mfu = xla.mfu_estimate()
+    if mfu is not None:
+        _state.registry.gauge('xla.mfu').set(round(mfu, 4))
+    snap = _state.registry.snapshot()
+    elapsed = time.time() - _state.t_start
+    if _state.sink is not None:
+        _state.sink.emit({'type': 'summary',
+                          'elapsed_s': round(elapsed, 3),
+                          'snapshot': snap})
+        _state.sink.flush()
+    table = _export.summary_table(snap, elapsed)
+    if log:
+        logging.info('%s', table)
+    _state.summary_written = True
+    return table
+
+
+def shutdown():
+    """atexit hook: final summary + sink close. Idempotent — and when
+    the program already called write_summary() itself, that record IS
+    the end-of-run summary: no duplicate is appended here."""
+    st = _state
+    if not st.active:
+        return
+    if not st.summary_written:
+        try:
+            write_summary()
+        except Exception:  # noqa: BLE001 — an atexit hook must not raise
+            pass
+    if st.sink is not None:
+        try:
+            st.sink.close()
+        except Exception:  # noqa: BLE001
+            pass
+        st.sink = None
+    st.active = False
+
+
+def _reset_for_tests():
+    """Close the current epoch of telemetry state and re-read the flags
+    on next use (tests toggle MXTPU_TELEMETRY via monkeypatch +
+    config.flags.reload)."""
+    global _state
+    if _state.sink is not None:
+        try:
+            _state.sink.close()
+        except Exception:  # noqa: BLE001
+            pass
+    _state = _State()

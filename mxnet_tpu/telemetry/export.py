@@ -1,0 +1,110 @@
+"""Telemetry exporters: append-only JSONL log + human-readable summary.
+
+The JSONL sink is the machine-readable record a perf investigation
+greps after the fact: one JSON object per line, each with a ``type``
+('start', 'span', 'compile', 'retrace_storm', 'event', 'summary') and
+a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
+``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
+on a per-batch fsync.
+
+``summary_table`` renders a registry snapshot as the end-of-run table
+docs/perf.md documents ("Reading the telemetry summary").
+"""
+import json
+import threading
+import time
+
+__all__ = ['JsonlSink', 'summary_table']
+
+_FLUSH_EVERY = 64
+
+# Module-wide count of actual file I/O calls (open/write/flush) — the
+# zero-overhead tests assert this stays put while telemetry is off.
+_io_calls = 0
+
+
+class JsonlSink:
+    """Append-only JSONL writer; thread-safe, buffered."""
+
+    def __init__(self, path):
+        global _io_calls
+        self.path = path
+        self._lock = threading.Lock()
+        self._buf = []
+        self._closed = False
+        _io_calls += 1
+        self._f = open(path, 'a')
+
+    def emit(self, record):
+        if self._closed:
+            return
+        record.setdefault('t', time.time())
+        line = json.dumps(record)
+        with self._lock:
+            self._buf.append(line)
+            if len(self._buf) >= _FLUSH_EVERY:
+                self._flush_locked()
+
+    def _flush_locked(self):
+        global _io_calls
+        if self._buf and not self._closed:
+            _io_calls += 1
+            self._f.write('\n'.join(self._buf) + '\n')
+            self._f.flush()
+            self._buf = []
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def close(self):
+        with self._lock:
+            self._flush_locked()
+            if not self._closed:
+                self._closed = True
+                self._f.close()
+
+
+def _fmt(v):
+    if v is None:
+        return '-'
+    if isinstance(v, float):
+        if v != v:   # nan
+            return 'nan'
+        if abs(v) >= 1e6 or (abs(v) < 1e-3 and v != 0):
+            return '%.3e' % v
+        return '%.3f' % v
+    return str(v)
+
+
+def summary_table(snapshot, elapsed_s=None):
+    """Registry snapshot -> aligned text table (one block per kind)."""
+    lines = ['== telemetry summary%s ==' %
+             (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
+    counters = snapshot.get('counters', {})
+    gauges = snapshot.get('gauges', {})
+    hists = snapshot.get('histograms', {})
+    if counters:
+        lines.append('-- counters --')
+        w = max(len(n) for n in counters)
+        for name in sorted(counters):
+            lines.append('  %-*s  %s' % (w, name, _fmt(counters[name])))
+    if gauges:
+        lines.append('-- gauges --')
+        w = max(len(n) for n in gauges)
+        for name in sorted(gauges):
+            lines.append('  %-*s  %s' % (w, name, _fmt(gauges[name])))
+    if hists:
+        lines.append('-- histograms (ms) --')
+        w = max(len(n) for n in hists)
+        lines.append('  %-*s  %8s %10s %10s %10s %10s' %
+                     (w, 'name', 'count', 'mean', 'p50', 'p95', 'max'))
+        for name in sorted(hists):
+            st = hists[name]
+            lines.append('  %-*s  %8s %10s %10s %10s %10s' %
+                         (w, name, _fmt(st['count']), _fmt(st['mean']),
+                          _fmt(st['p50']), _fmt(st['p95']),
+                          _fmt(st['max'])))
+    if len(lines) == 1:
+        lines.append('  (no metrics recorded)')
+    return '\n'.join(lines)

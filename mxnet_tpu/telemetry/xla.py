@@ -1,0 +1,182 @@
+"""XLA-side telemetry: compile events, device memory, retraces, MFU.
+
+Compile observability comes from jax.monitoring: XLA emits
+``/jax/core/compile/backend_compile_duration`` once per backend
+compile, which feeds the ``xla.compiles`` counter, the accumulated
+``xla.compile_secs``, and a per-compile JSONL record. The listener is
+registered once per process and is a no-op while telemetry is off, so
+it can stay installed across test resets.
+
+Retrace detection is framework-side: the sites that BUILD compiled
+programs (Executor construction, the fused-fit window builder) call
+:func:`note_retrace` with a value key identifying the graph; the same
+key arriving more than ``MXTPU_TELEMETRY_RETRACE_WARN`` times is the
+classic retrace storm (a shape/attr leaking into the program key every
+batch — the 49.8 img/s pathology of docs/perf.md) and logs one loud
+warning plus a ``retrace_storm`` JSONL record.
+
+Memory gauges read ``device.memory_stats()`` (live/peak bytes on TPU;
+None on CPU — sampled best-effort). The MFU estimate needs the step
+FLOPs, which only the caller knows (bench.py computes it from XLA cost
+analysis): :func:`note_step_flops` feeds it, and the summary divides
+observed step rate * FLOPs by the device's peak.
+"""
+import logging
+import threading
+import time
+
+__all__ = ['install', 'note_retrace', 'note_step_flops', 'sample_memory',
+           'device_peak_flops', 'mfu_estimate']
+
+_COMPILE_EVENT_SUFFIX = 'backend_compile_duration'
+
+# Peak dense bf16 FLOP/s per chip, by device_kind substring (bench.py's
+# table; CPU/unknown kinds yield 0.0 = "no MFU estimate").
+_PEAK_FLOPS = [
+    ('v6', 918e12), ('v5p', 459e12), ('v5', 197e12),
+    ('v4', 275e12), ('v3', 123e12), ('v2', 45e12),
+]
+
+_installed = False
+_install_lock = threading.Lock()
+
+
+def _state():
+    from . import enabled
+    enabled()   # decide from the flag if nothing else has yet
+    from . import _state as st
+    return st
+
+
+def install():
+    """Register the jax.monitoring compile listener (once per process)."""
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        try:
+            import jax.monitoring as _mon
+            _mon.register_event_duration_secs_listener(_on_duration)
+            _installed = True
+        except Exception as e:  # noqa: BLE001 — observability must not kill
+            logging.debug('telemetry: jax.monitoring unavailable: %s', e)
+
+
+def _on_duration(event, duration, **kwargs):
+    st = _state()
+    if not st.active:
+        return
+    if event.endswith(_COMPILE_EVENT_SUFFIX):
+        st.registry.counter('xla.compiles').inc()
+        st.registry.counter('xla.compile_secs').inc(float(duration))
+        if st.sink is not None:
+            st.sink.emit({'type': 'compile', 't': time.time(),
+                          'dur_s': round(float(duration), 4)})
+
+
+def _retrace_threshold():
+    from ..config import flags
+    try:
+        return flags.get('MXTPU_TELEMETRY_RETRACE_WARN')
+    except Exception:  # noqa: BLE001 — undeclared in stripped builds
+        return 5
+
+
+def note_retrace(key):
+    """A compiled program for graph ``key`` was (re)built. The first
+    build is free; every further build of the SAME key counts as a
+    retrace, and crossing the warn threshold logs the storm once."""
+    st = _state()
+    if not st.active:
+        return
+    with st.lock:
+        n = st.retraces[key] = st.retraces.get(key, 0) + 1
+    if n > 1:
+        st.registry.counter('xla.retraces').inc()
+    thresh = _retrace_threshold()
+    if n == thresh + 1:
+        logging.warning(
+            'telemetry: retrace storm — the same graph was compiled %d '
+            'times (key=%s). A shape/dtype/attr is leaking into the '
+            'program cache key every batch; throughput is bounded by '
+            'compile time until it stops.', n, _short(key))
+        if st.sink is not None:
+            st.sink.emit({'type': 'retrace_storm', 'key': _short(key),
+                          'count': n})
+
+
+def _short(key, limit=200):
+    s = str(key)
+    return s if len(s) <= limit else s[:limit] + '...'
+
+
+def note_step_flops(flops):
+    """Record the per-training-step model FLOPs (enables the MFU
+    estimate; bench.py feeds XLA's own cost analysis here)."""
+    st = _state()
+    if st.active and flops:
+        st.registry.gauge('xla.step_flops').set(float(flops))
+
+
+def sample_memory(device=None):
+    """Update live/peak device-byte gauges from ``memory_stats()``.
+    Best-effort: CPU backends return None and are skipped."""
+    st = _state()
+    if not st.active:
+        return None
+    try:
+        if device is None:
+            import jax
+            devices = jax.local_devices()
+        else:
+            devices = [device]
+        for d in devices:
+            stats = d.memory_stats()
+            if not stats:
+                continue
+            live = stats.get('bytes_in_use')
+            peak = stats.get('peak_bytes_in_use')
+            if live is not None:
+                st.registry.gauge('xla.bytes_in_use').set(int(live))
+            if peak is not None:
+                st.registry.gauge('xla.peak_bytes_in_use').set(int(peak))
+            return stats
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('telemetry: memory_stats unavailable: %s', e)
+    return None
+
+
+def device_peak_flops(device=None):
+    """(peak_bf16_flops, device_kind) for the MFU denominator."""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        kind = (getattr(device, 'device_kind', '') or '').lower()
+        for sub, peak in _PEAK_FLOPS:
+            if sub in kind:
+                return peak, kind
+        return 0.0, kind
+    except Exception:  # noqa: BLE001
+        return 0.0, ''
+
+
+def mfu_estimate():
+    """step_flops * observed steps / elapsed / peak — or None when any
+    ingredient (FLOPs, a step count, a known chip) is missing. Reads
+    metrics with registry.get (never create-on-read: a missing
+    fit.steps must not plant a zero counter in the summary)."""
+    st = _state()
+    if not st.active:
+        return None
+    flops_g = st.registry.get('xla.step_flops')
+    steps_c = st.registry.get('fit.steps')
+    flops = flops_g.value if flops_g is not None else None
+    steps = steps_c.value if steps_c is not None else 0
+    elapsed = time.time() - st.t_start
+    if not flops or not steps or elapsed <= 0:
+        return None
+    peak, _ = device_peak_flops()
+    if not peak:
+        return None
+    return flops * steps / elapsed / peak

@@ -161,7 +161,7 @@ def test_ring_flash_vs_plain_accumulator():
     from mxnet_tpu.parallel import make_mesh
     from mxnet_tpu.parallel.ring_attention import ring_attention
     from jax.sharding import PartitionSpec as P
-    from jax import shard_map
+    from mxnet_tpu.parallel import shard_map
     import functools as ft
     mesh = make_mesh({'sp': 4})
     q = _rand(2, 64, 2, 16, seed=30)

@@ -11,8 +11,9 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
 import functools
+
+from mxnet_tpu.parallel import shard_map
 
 import mxnet_tpu as mx
 from mxnet_tpu.parallel import (

@@ -1,0 +1,129 @@
+"""Profiler dump semantics (mxnet_tpu/profiler.py).
+
+The contract: a mid-run dump_profile followed by the atexit re-dump
+(reference initialize.cc:57-67 writes the profile at process exit) must
+yield ONE valid chrome-trace JSON whose events are merged — every
+recorded event appears exactly once, never duplicated, never lost.
+Also covers: telemetry spans landing in the same chrome trace.
+"""
+import json
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import profiler
+from mxnet_tpu.config import flags
+
+
+@pytest.fixture
+def prof(tmp_path, monkeypatch):
+    """Profiler targeting a tmp file, XLA trace capture off (a CPU test
+    run must not spray TensorBoard trace dirs)."""
+    monkeypatch.setenv('MXTPU_PROFILER_XLA_TRACE', '0')
+    flags.reload('MXTPU_PROFILER_XLA_TRACE')
+    path = tmp_path / 'profile.json'
+    profiler.profiler_set_config('all', str(path))
+    yield path
+    if profiler.is_running():
+        profiler.profiler_set_state('stop')
+    # a dump may have registered this path as written; later tests use
+    # fresh tmp paths so no cross-test merge can occur
+    flags.reload('MXTPU_PROFILER_XLA_TRACE')
+
+
+def _names(path):
+    with open(path) as f:
+        doc = json.load(f)
+    assert 'traceEvents' in doc and 'displayTimeUnit' in doc
+    return [e['name'] for e in doc['traceEvents']]
+
+
+def test_dump_then_atexit_redump_merges_not_duplicates(prof):
+    """User dumps mid-run, records more events, then the atexit hook
+    re-dumps: one valid JSON, each event exactly once."""
+    profiler.profiler_set_state('run')
+    with profiler.span('ev_before_dump'):
+        pass
+    profiler.dump_profile()
+    assert _names(prof).count('ev_before_dump') == 1
+
+    with profiler.span('ev_after_dump'):
+        pass
+    profiler._atexit_dump()          # what process exit would run
+    names = _names(prof)
+    assert names.count('ev_before_dump') == 1, 'duplicated on re-dump'
+    assert names.count('ev_after_dump') == 1, 'post-dump event lost'
+    assert not profiler.is_running()  # the atexit hook stopped the run
+
+
+def test_atexit_redump_idempotent_when_complete(prof):
+    """A run that already dumped everything: the atexit re-dump must
+    leave the file unchanged (no duplication, still valid JSON)."""
+    profiler.profiler_set_state('run')
+    with profiler.span('only_event'):
+        pass
+    profiler.profiler_set_state('stop')
+    profiler.dump_profile()
+    before = _names(prof)
+    profiler._atexit_dump()
+    assert _names(prof) == before
+    assert before.count('only_event') == 1
+
+
+def test_periodic_dump_accumulates_each_event_once(prof):
+    """The periodic-dump pattern: dump after every burst; the final
+    file holds every burst's events exactly once."""
+    profiler.profiler_set_state('run')
+    for i in range(3):
+        with profiler.span('burst%d' % i):
+            pass
+        profiler.dump_profile()
+    profiler.profiler_set_state('stop')
+    profiler._atexit_dump()
+    names = _names(prof)
+    for i in range(3):
+        assert names.count('burst%d' % i) == 1
+
+
+def test_telemetry_spans_merge_into_chrome_trace(prof):
+    """telemetry.span events land in profiler.py's chrome trace while
+    the profiler runs — one timeline (ISSUE 1 tentpole (a)) — even
+    with MXTPU_TELEMETRY off."""
+    from mxnet_tpu import telemetry
+    assert not telemetry.enabled()
+    profiler.profiler_set_state('run')
+    with telemetry.span('tele_region', 'telemetry'):
+        pass
+    profiler.profiler_set_state('stop')
+    profiler.dump_profile()
+    with open(prof) as f:
+        events = json.load(f)['traceEvents']
+    ev = [e for e in events if e['name'] == 'tele_region']
+    assert len(ev) == 1
+    assert ev[0]['cat'] == 'telemetry'
+    assert ev[0]['ph'] == 'X' and ev[0]['dur'] >= 0
+
+
+def test_executor_spans_in_trace(prof):
+    """The executor's forward/backward show up on the trace (the
+    profiler path of the shared telemetry span gate)."""
+    import numpy as np
+    x = mx.sym.Variable('x')
+    y = mx.sym.FullyConnected(x, num_hidden=4, name='fc')
+    exe = y.simple_bind(mx.cpu(), x=(2, 3))
+    profiler.profiler_set_state('run')
+    exe.forward(is_train=True,
+                x=mx.nd.array(np.ones((2, 3), dtype=np.float32)))
+    exe.backward()
+    profiler.profiler_set_state('stop')
+    profiler.dump_profile()
+    names = _names(prof)
+    assert 'executor.forward' in names
+    assert 'executor.backward' in names
+
+
+def test_no_dump_without_run(tmp_path):
+    """dump only writes what was recorded; maybe_span outside a run is
+    the shared no-op."""
+    from mxnet_tpu.profiler import maybe_span, _NULL_SPAN
+    assert maybe_span('x') is _NULL_SPAN

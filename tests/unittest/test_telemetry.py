@@ -1,0 +1,405 @@
+"""Runtime telemetry subsystem (mxnet_tpu/telemetry).
+
+Contracts under test:
+- registry semantics: counter/gauge/histogram, kind conflicts, snapshot;
+- span tracer: nesting paths, histogram recording, exception unwind;
+- JSONL exporter round-trip;
+- the zero-overhead no-op path: with MXTPU_TELEMETRY unset a fit run
+  creates no file and makes ZERO telemetry I/O calls;
+- the acceptance run: with MXTPU_TELEMETRY=1 a short Module.fit on CPU
+  yields a JSONL log with fit-batch spans, at least one compile event,
+  and an end-of-run summary;
+- satellites: Speedometer gauge (pinned log format unchanged), kvstore
+  byte counters, retrace-storm warning, Monitor._rms_stat on empty.
+"""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.telemetry import export as tele_export
+from mxnet_tpu.telemetry.registry import Registry
+
+
+def _reload_tele_flags():
+    for f in ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_PATH',
+              'MXTPU_TELEMETRY_RETRACE_WARN'):
+        flags.reload(f)
+
+
+@pytest.fixture
+def tele_path(tmp_path, monkeypatch):
+    """Telemetry ON, logging to a tmp JSONL; restored OFF afterwards."""
+    path = tmp_path / 'telemetry.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    _reload_tele_flags()
+    telemetry._reset_for_tests()
+    yield path
+    # this teardown runs BEFORE monkeypatch's env undo, so drop the env
+    # here and reload: the flag cache must not keep the tmp values
+    telemetry._reset_for_tests()
+    monkeypatch.delenv('MXTPU_TELEMETRY', raising=False)
+    monkeypatch.delenv('MXTPU_TELEMETRY_PATH', raising=False)
+    _reload_tele_flags()
+
+
+@pytest.fixture
+def tele_off(monkeypatch):
+    """Telemetry decisively OFF (undo any earlier test's state)."""
+    monkeypatch.delenv('MXTPU_TELEMETRY', raising=False)
+    _reload_tele_flags()
+    telemetry._reset_for_tests()
+    yield
+    telemetry._reset_for_tests()
+    _reload_tele_flags()
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _mlp_fit(num_epoch=2, batch=8, n=32, cb=None):
+    np.random.seed(0)
+    mx.random.seed(0)
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    X = np.random.randn(n, 10).astype(np.float32)
+    y = (np.random.rand(n) * 4).astype(int).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name='softmax_label')
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.1),),
+            batch_end_callback=cb)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_semantics():
+    r = Registry()
+    c = r.counter('a')
+    c.inc()
+    c.inc(2)
+    c.inc(0.5)            # float increments (compile seconds)
+    assert c.value == 3.5
+    assert r.counter('a') is c          # create-once
+
+
+def test_gauge_semantics():
+    r = Registry()
+    g = r.gauge('g')
+    assert g.value is None
+    g.set(3)
+    g.set(7)
+    assert g.value == 7                 # last write wins
+
+
+def test_histogram_semantics():
+    r = Registry()
+    h = r.histogram('h')
+    for v in range(1, 101):
+        h.observe(v)
+    assert h.count == 100
+    assert h.min == 1 and h.max == 100
+    assert h.mean == pytest.approx(50.5)
+    assert h.percentile(0) == 1
+    assert h.percentile(100) == 100
+    assert h.percentile(50) in (50, 51)
+    assert h.percentile(95) in (95, 96)
+    st = h.stats()
+    assert st['count'] == 100 and st['p95'] in (95, 96)
+
+
+def test_histogram_empty():
+    h = Registry().histogram('h')
+    assert h.percentile(50) is None
+    assert h.stats()['mean'] is None
+
+
+def test_kind_conflict_raises():
+    r = Registry()
+    r.counter('x')
+    with pytest.raises(TypeError):
+        r.gauge('x')
+
+
+def test_snapshot_shape():
+    r = Registry()
+    r.counter('c').inc(2)
+    r.gauge('g').set(1.5)
+    r.histogram('h').observe(10)
+    snap = r.snapshot()
+    assert snap['counters'] == {'c': 2}
+    assert snap['gauges'] == {'g': 1.5}
+    assert snap['histograms']['h']['count'] == 1
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_paths(tele_path):
+    assert telemetry.enabled()
+    with telemetry.span('outer'):
+        assert telemetry.current_span_path() == 'outer'
+        with telemetry.span('inner'):
+            assert telemetry.current_span_path() == 'outer/inner'
+        assert telemetry.current_span_path() == 'outer'
+    assert telemetry.current_span_path() is None
+    reg = telemetry.get_registry()
+    assert reg.histogram('outer').count == 1
+    assert reg.histogram('inner').count == 1
+    telemetry.shutdown()
+    spans = [r for r in _records(tele_path) if r['type'] == 'span']
+    paths = {r['name']: r['path'] for r in spans}
+    assert paths == {'outer': 'outer', 'inner': 'outer/inner'}
+    # inner closed before outer, so it is emitted first
+    assert [r['name'] for r in spans] == ['inner', 'outer']
+
+
+def test_span_unwinds_on_exception(tele_path):
+    with pytest.raises(RuntimeError):
+        with telemetry.span('boom'):
+            raise RuntimeError('x')
+    assert telemetry.current_span_path() is None
+    assert telemetry.get_registry().histogram('boom').count == 1
+
+
+def test_span_noop_when_disabled(tele_off):
+    assert not telemetry.enabled()
+    s = telemetry.span('anything')
+    assert s is telemetry._NULL_SPAN
+    with s:
+        pass
+    # nothing registered anywhere
+    assert telemetry.get_registry().get('anything') is None
+
+
+# ---------------------------------------------------------------------------
+# JSONL exporter
+# ---------------------------------------------------------------------------
+
+def test_jsonl_roundtrip(tmp_path):
+    path = tmp_path / 'log.jsonl'
+    sink = tele_export.JsonlSink(str(path))
+    recs = [{'type': 'event', 'name': 'e%d' % i, 'i': i} for i in range(5)]
+    for r in recs:
+        sink.emit(dict(r))
+    sink.flush()
+    sink.emit({'type': 'event', 'name': 'after-flush'})
+    sink.close()
+    got = _records(path)
+    assert len(got) == 6
+    for r in got:
+        assert 't' in r                     # stamped on emit
+    assert [r.get('i') for r in got[:5]] == [0, 1, 2, 3, 4]
+    assert got[5]['name'] == 'after-flush'
+    sink.emit({'type': 'event'})            # post-close: dropped, no raise
+
+
+def test_jsonl_append_only(tmp_path):
+    path = tmp_path / 'log.jsonl'
+    s1 = tele_export.JsonlSink(str(path))
+    s1.emit({'type': 'event', 'name': 'first'})
+    s1.close()
+    s2 = tele_export.JsonlSink(str(path))
+    s2.emit({'type': 'event', 'name': 'second'})
+    s2.close()
+    assert [r['name'] for r in _records(path)] == ['first', 'second']
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead no-op path
+# ---------------------------------------------------------------------------
+
+def test_disabled_fit_zero_telemetry_io(tele_off, tmp_path):
+    """MXTPU_TELEMETRY unset: a fit run writes no file and makes zero
+    telemetry I/O calls (the acceptance criterion's negative half)."""
+    io_before = tele_export._io_calls
+    _mlp_fit(num_epoch=1)
+    assert tele_export._io_calls == io_before
+    assert telemetry._state.sink is None
+    assert not telemetry._state.active
+    # nothing leaked into the (inactive) registry either
+    assert telemetry.get_registry().names() == []
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           'telemetry.jsonl'))
+
+
+def test_disabled_metric_handles_are_noops(tele_off):
+    from mxnet_tpu.telemetry.registry import (NULL_COUNTER, NULL_GAUGE,
+                                              NULL_HISTOGRAM)
+    assert telemetry.counter('c') is NULL_COUNTER
+    assert telemetry.gauge('g') is NULL_GAUGE
+    assert telemetry.histogram('h') is NULL_HISTOGRAM
+    telemetry.counter('c').inc(5)
+    telemetry.gauge('g').set(5)
+    telemetry.histogram('h').observe(5)
+    assert telemetry.get_registry().names() == []
+
+
+# ---------------------------------------------------------------------------
+# the acceptance run: short Module.fit on CPU with telemetry on
+# ---------------------------------------------------------------------------
+
+def test_fit_telemetry_acceptance_reference_loop(tele_path, monkeypatch):
+    """Reference per-batch loop: the JSONL log carries fit-batch spans,
+    at least one compile event, and the end-of-run summary."""
+    monkeypatch.setenv('MXTPU_FUSED_FIT', '0')
+    _mlp_fit(num_epoch=2)
+    table = telemetry.write_summary(log=False)
+    telemetry.shutdown()
+    recs = _records(tele_path)
+    spans = [r for r in recs if r['type'] == 'span']
+    assert sum(1 for r in spans if r['name'] == 'fit.batch') == 8
+    for sub in ('fit.dispatch', 'fit.metric', 'executor.forward',
+                'executor.backward', 'module.update'):
+        assert any(r['name'] == sub for r in spans), sub
+    # nested spans carry their parent path
+    d = next(r for r in spans if r['name'] == 'fit.dispatch')
+    assert d['path'] == 'fit.batch/fit.dispatch'
+    assert any(r['type'] == 'compile' for r in recs)
+    summaries = [r for r in recs if r['type'] == 'summary']
+    assert summaries, 'no end-of-run summary record'
+    snap = summaries[-1]['snapshot']
+    assert snap['counters']['fit.steps'] == 8
+    assert snap['counters']['fit.epochs'] == 2
+    assert snap['counters']['io.batches'] == 8
+    assert snap['counters']['xla.compiles'] >= 1
+    assert snap['histograms']['fit.batch']['count'] == 8
+    # the human-readable table renders the same registry
+    assert 'fit.steps' in table and 'telemetry summary' in table
+
+
+def test_fit_telemetry_fused_loop(tele_path):
+    """Fused window path: window spans + steps-per-call gauge, and
+    fit.steps still counts every trained batch."""
+    _mlp_fit(num_epoch=2)
+    snap = telemetry.snapshot()
+    assert snap['counters']['fit.steps'] == 8
+    assert snap['counters']['fused_fit.windows'] >= 1
+    assert snap['gauges']['fused_fit.steps_per_call'] >= 1
+    for h in ('fused_fit.draw', 'fused_fit.put', 'fused_fit.dispatch',
+              'fused_fit.fetch', 'fused_fit.build'):
+        assert h in snap['histograms'], h
+    telemetry.shutdown()
+    recs = _records(tele_path)
+    assert any(r['type'] == 'span' and r['name'] == 'fused_fit.dispatch'
+               for r in recs)
+    assert any(r['type'] == 'compile' for r in recs)
+
+
+def test_fit_results_identical_with_telemetry(tele_path, monkeypatch):
+    """Instrumentation must not perturb training: same params with
+    telemetry on and off."""
+    a = {k: v.asnumpy() for k, v in _mlp_fit(num_epoch=1).get_params()[0]
+         .items()}
+    telemetry._reset_for_tests()
+    monkeypatch.delenv('MXTPU_TELEMETRY')
+    flags.reload('MXTPU_TELEMETRY')
+    b = {k: v.asnumpy() for k, v in _mlp_fit(num_epoch=1).get_params()[0]
+         .items()}
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_allclose(a[k], b[k], rtol=0, atol=0, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+def test_speedometer_gauge_and_pinned_format(tele_path, caplog):
+    """The samples/sec gauge is recorded without altering the pinned
+    `Speed:` log-line format the compat tests parse."""
+    import re
+    from mxnet_tpu.model import BatchEndParam
+    sm = mx.callback.Speedometer(batch_size=8, frequent=2)
+    with caplog.at_level(logging.INFO):
+        for nbatch in range(3):
+            sm(BatchEndParam(epoch=0, nbatch=nbatch, eval_metric=None,
+                             locals=None))
+    g = telemetry.get_registry().gauge('speedometer.samples_per_sec')
+    assert g.value is not None and g.value > 0
+    lines = [r.getMessage() for r in caplog.records]
+    hits = [ln for ln in lines
+            if re.search(r'Speed: ([0-9.]+) samples/sec', ln)]
+    assert len(hits) == 1
+    assert re.search(r'Iter\[0\] Batch \[2\]\tSpeed: [0-9.]+ samples/sec',
+                     hits[0])
+
+
+def test_kvstore_push_pull_counters(tele_path):
+    kv = mx.kv.create('local')
+    a = mx.nd.ones((4, 8))
+    kv.init('w', a)
+    kv.push('w', mx.nd.ones((4, 8)))
+    out = mx.nd.zeros((4, 8))
+    kv.pull('w', out=out)
+    reg = telemetry.get_registry()
+    assert reg.counter('kvstore.push_bytes').value == 4 * 8 * 4
+    assert reg.counter('kvstore.pull_bytes').value == 4 * 8 * 4
+    assert reg.histogram('kvstore.push').count == 1
+    assert reg.histogram('kvstore.pull').count == 1
+
+
+def test_prefetching_iter_counts_batches_once(tele_path):
+    """PrefetchingIter must not double-count io.batches: the inner
+    iterator's next() (on the producer thread) is the single count."""
+    X = np.zeros((32, 4), np.float32)
+    y = np.zeros((32,), np.float32)
+    it = mx.io.PrefetchingIter(mx.io.NDArrayIter(X, y, batch_size=8))
+    n = sum(1 for _ in it)
+    assert n == 4
+    reg = telemetry.get_registry()
+    # the producer may have prefetched past the consumer, but each
+    # batch is counted exactly once: never more than the 4 real batches
+    assert reg.counter('io.batches').value == 4
+    assert reg.histogram('io.prefetch_wait').count >= 4
+
+
+def test_retrace_storm_warns_once(tele_path, caplog):
+    key = ('test-graph', (1, 2, 3))
+    with caplog.at_level(logging.WARNING):
+        for _ in range(8):
+            telemetry.xla.note_retrace(key)
+    storms = [r for r in caplog.records if 'retrace storm' in r.getMessage()]
+    assert len(storms) == 1           # warned once, at threshold + 1
+    assert telemetry.get_registry().counter('xla.retraces').value == 7
+    telemetry.shutdown()
+    recs = _records(tele_path)
+    assert any(r['type'] == 'retrace_storm' for r in recs)
+
+
+def test_monitor_rms_stat_empty_array():
+    from mxnet_tpu.monitor import _rms_stat
+    assert _rms_stat(mx.nd.zeros((0,))) == 'nan'
+    assert _rms_stat(mx.nd.zeros((0, 4))) == 'nan'
+    # non-empty still numeric
+    v = float(_rms_stat(mx.nd.ones((2, 2))))
+    assert v == pytest.approx(1.0)
+
+
+def test_mfu_estimate_requires_ingredients(tele_path):
+    # no flops/steps recorded -> None (never a crash)
+    assert telemetry.xla.mfu_estimate() is None
+    telemetry.xla.note_step_flops(1e12)
+    assert telemetry.get_registry().gauge('xla.step_flops').value == 1e12
+
+
+def test_summary_table_renders_empty():
+    from mxnet_tpu.telemetry.export import summary_table
+    out = summary_table({'counters': {}, 'gauges': {}, 'histograms': {}})
+    assert 'no metrics recorded' in out
