@@ -407,6 +407,157 @@ def _late_tpu_attempt(remaining_s):
     return None
 
 
+def _wrap_steps_per_call(step):
+    """Fuse STEPS_PER_CALL steps into one device call via lax.scan —
+    shared by the measuring path and the compile-only probe children,
+    which must compile the SAME program or the warm-compile number
+    would time a cache miss of a different (unwrapped) computation."""
+    if STEPS_PER_CALL <= 1:
+        return step
+    import jax
+    inner = step
+
+    def step(masters, aux, vel, images, labels, key):
+        def body(carry, _):
+            m, a, v = carry
+            m, a, v, loss = inner(m, a, v, images, labels, key)
+            return (m, a, v), loss
+        (m, a, v), losses = jax.lax.scan(
+            body, (masters, aux, vel), None, length=STEPS_PER_CALL)
+        return m, a, v, losses[-1]
+
+    return step
+
+
+def _warm_compile_subprocess(platform, cache_override=None):
+    """Time the train-step compile in a fresh child process with the
+    persistent cache on (MXTPU_BENCH_COMPILE_ONLY short-circuits the
+    child right after its compile — it never EXECUTES the cache-served
+    executable, which jax 0.4.x CPU cannot safely do for conv
+    programs). Returns seconds or None."""
+    import subprocess
+    env = dict(os.environ)
+    env['MXTPU_BENCH_COMPILE_ONLY'] = '1'
+    env['MXTPU_BENCH_DIRECT'] = '1'   # this process verified the backend
+    if cache_override is not None:
+        env['MXTPU_COMPILE_CACHE'] = cache_override
+    if platform.startswith('cpu'):
+        env['JAX_PLATFORMS'] = 'cpu'
+    _log('probing warm-start compile in a fresh process...')
+    try:
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, capture_output=True, text=True,
+                              timeout=600)
+        for line in reversed((proc.stdout or '').strip().splitlines()):
+            try:
+                return float(json.loads(line)['compile_s'])
+            except (ValueError, KeyError, TypeError):
+                continue
+        _log('warm-compile probe produced no JSON (rc=%d): %s'
+             % (proc.returncode, (proc.stderr or '')[-300:]))
+    except Exception as e:  # noqa: BLE001 — the probe must never kill
+        _log('warm-compile probe failed: %s' % e)
+    return None
+
+
+def run_infer_bench(platform, kind):
+    """ResNet-50 inference throughput through the REAL Module.predict
+    API: the fused window path (module/fused_eval.py, one dispatch +
+    one fetch per W batches) vs the per-batch reference path
+    (MXTPU_FUSED_EVAL=0). bf16 compute via a Cast at the input —
+    type inference makes every downstream parameter bf16, mirroring
+    the training bench's compute dtype. Returns the JSON-ready dict
+    (both numbers printed; the fused one is the headline)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.config import flags as _flags
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    # a window of 8 keeps the synthetic set (2 windows) small enough to
+    # stage on the host while still amortizing dispatch 8x; the CPU
+    # fallback keeps its auto window (4) — dispatch is not its problem
+    saved_w = os.environ.get('MXTPU_EVAL_STEPS_PER_CALL')
+    if not platform.startswith('cpu'):
+        os.environ.setdefault('MXTPU_EVAL_STEPS_PER_CALL', '8')
+    _flags.reload('MXTPU_EVAL_STEPS_PER_CALL')
+    from mxnet_tpu.module.fused_eval import _eval_window
+    W = _eval_window()
+    batch = BATCH
+    # CPU fallback: smaller spatial + one window per pass — the CPU
+    # number is already marked non-config-comparable, and fwd compute
+    # (not dispatch) dominates there anyway
+    cpu = platform.startswith('cpu')
+    image = 112 if cpu else 224
+    n = (1 if cpu else 2) * W * batch
+    _log('building resnet50 inference module (bf16, batch %d, W=%d)...'
+         % (batch, W))
+    net = vision.get_model('resnet50_v1', classes=1000)
+    net.hybridize()
+    data_shape = (batch, 3, image, image)
+    _, sym = net._get_graph(
+        type('P', (), {'shape': data_shape, 'context': None})())
+    sym_bf = sym(data=mx.sym.Cast(mx.sym.Variable('data'),
+                                  dtype='bfloat16'))
+    ctx = mx.tpu() if platform.startswith('tpu') else mx.cpu()
+    mod = mx.mod.Module(sym_bf, label_names=[], context=ctx)
+    rng = np.random.RandomState(0)
+    X = rng.standard_normal((n, 3, image, image)).astype(np.float32)
+    it = mx.io.NDArrayIter(X, None, batch_size=batch)
+    mod.bind(data_shapes=it.provide_data, for_training=False)
+    mod.init_params()
+
+    def timed_predict():
+        it.reset()
+        t0 = time.perf_counter()
+        out = mod.predict(it, reset=False)
+        # host fetch = true barrier (per-batch predict is fully async;
+        # the fused path is already host-resident by construction)
+        np.asarray(out.asnumpy())
+        return n / (time.perf_counter() - t0)
+
+    results = {}
+    saved_fe = os.environ.get('MXTPU_FUSED_EVAL')
+    try:
+        for label, flag in (('fused', '1'), ('per_batch', '0')):
+            os.environ['MXTPU_FUSED_EVAL'] = flag
+            _flags.reload('MXTPU_FUSED_EVAL')
+            t = time.perf_counter()
+            timed_predict()       # warmup: compiles this path's program
+            _log('infer %s warmup: %.1fs' % (label,
+                                             time.perf_counter() - t))
+            results[label] = timed_predict()
+            _log('infer %s: %.2f img/s' % (label, results[label]))
+    finally:
+        # restore the caller's flags exactly (an explicit
+        # MXTPU_FUSED_EVAL=0 opt-out must survive this A/B, including
+        # into any late-reprobe child that inherits os.environ)
+        for var, saved in (('MXTPU_FUSED_EVAL', saved_fe),
+                           ('MXTPU_EVAL_STEPS_PER_CALL', saved_w)):
+            if saved is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = saved
+            _flags.reload(var)
+
+    out = {
+        'metric': 'resnet50_infer_throughput_bf16',
+        'value': round(results['fused'], 2),
+        'unit': 'images/sec',
+        'per_batch_value': round(results['per_batch'], 2),
+        'speedup_vs_per_batch': round(results['fused']
+                                      / max(results['per_batch'], 1e-9), 3),
+        'batch': batch,
+        'eval_steps_per_call': W,
+        'device': kind or platform,
+        'platform': platform,
+    }
+    if platform.startswith('cpu'):
+        out['note'] = ('cpu run: per-batch dispatch overhead is noise '
+                       'next to compute, so the window speedup only '
+                       'shows on a real (tunneled) device')
+    return out
+
+
 def _telemetry_breakdown(device):
     """The dispatch/compile breakdown + peak device bytes from the
     telemetry registry, as a JSON-ready dict (None when telemetry is
@@ -422,6 +573,11 @@ def _telemetry_breakdown(device):
         if c.get('xla.compiles'):
             tel['compiles'] = int(c['xla.compiles'])
             tel['compile_secs'] = round(c.get('xla.compile_secs', 0.0), 3)
+        if c.get('xla.cache_hits'):
+            # compiles served from the MXTPU_COMPILE_CACHE directory
+            tel['cache_hits'] = int(c['xla.cache_hits'])
+            tel['cache_saved_secs'] = round(
+                c.get('xla.cache_saved_secs', 0.0), 3)
         h = snap['histograms'].get('bench.dispatch')
         if h and h['count']:
             tel['dispatch_ms'] = {k: round(h[k], 3)
@@ -459,7 +615,36 @@ def main():
         devices, platform = init_backend()
     if platform.startswith('cpu'):
         _shrink_for_cpu()   # single decision point for every CPU path
+    else:
+        # persistent XLA compile cache rides every DEVICE bench run
+        # (ISSUE 2): a warm start skips the ~26s ResNet compile, and
+        # the cold/warm pair below quantifies it. Device platforms
+        # only: on jax 0.4.x the CPU backend's conv custom-call thunks
+        # do not survive executable deserialization — a cache-served
+        # ResNet step segfaults a few iterations in (measured here;
+        # trivial programs round-trip fine). setdefault: an explicit
+        # MXTPU_COMPILE_CACHE — including '' — still wins.
+        os.environ.setdefault('MXTPU_COMPILE_CACHE',
+                              os.path.join(tempfile.gettempdir(),
+                                           'mxtpu_bench_xla_cache'))
     import jax
+
+    if os.environ.get('MXTPU_BENCH_COMPILE_ONLY'):
+        # warm-compile probe child (_warm_compile_subprocess): build the
+        # same step, time ONE compile — served from MXTPU_COMPILE_CACHE
+        # when populated — and exit without executing anything
+        if MODEL == 'transformer':
+            step, masters, aux, vel, images, labels, key = \
+                build_transformer_step()
+        else:
+            step, masters, aux, vel, images, labels, key = build_train_step()
+        step = _wrap_steps_per_call(step)
+        t = time.perf_counter()
+        jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+            masters, aux, vel, images, labels, key).compile()
+        print(json.dumps({'compile_s': round(time.perf_counter() - t, 2)}),
+              flush=True)
+        return
 
     t = time.perf_counter()
     if MODEL == 'transformer':
@@ -476,16 +661,7 @@ def main():
     _log('build+init: %.1fs' % (time.perf_counter() - t))
 
     if STEPS_PER_CALL > 1:
-        inner = step
-
-        def step(masters, aux, vel, images, labels, key):
-            def body(carry, _):
-                m, a, v = carry
-                m, a, v, loss = inner(m, a, v, images, labels, key)
-                return (m, a, v), loss
-            (m, a, v), losses = jax.lax.scan(
-                body, (masters, aux, vel), None, length=STEPS_PER_CALL)
-            return m, a, v, losses[-1]
+        step = _wrap_steps_per_call(step)
         _log('fusing %d steps per device call (lax.scan)' % STEPS_PER_CALL)
 
     from mxnet_tpu import telemetry as _tele
@@ -495,6 +671,7 @@ def main():
     jstep = jax.jit(step, donate_argnums=(0, 1, 2))
     lowered = jstep.lower(masters, aux, vel, images, labels, key)
     compiled = lowered.compile()
+    compile_cold_s = time.perf_counter() - t
     flops_per_step = _step_flops(compiled)
     # XLA cost analysis counts a scan (while-loop) body ONCE regardless
     # of trip count (verified: identical flops at 1 vs 8 steps/call), so
@@ -503,7 +680,54 @@ def main():
     _tele.xla.note_step_flops(flops_per_step / max(1, STEPS_PER_CALL))
     temp_bytes = _temp_bytes(compiled)
     _log('compile: %.1fs, step flops=%.3e, xla temp=%.1f MiB'
-         % (time.perf_counter() - t, flops_per_step, temp_bytes / 2**20))
+         % (compile_cold_s, flops_per_step, temp_bytes / 2**20))
+
+    # cold vs warm compile (MXTPU_COMPILE_CACHE): a fresh child process
+    # builds the SAME step and times one compile, now served from the
+    # persistent cache — exactly what a restart pays. A subprocess
+    # keeps this process clean: no jax.clear_caches() mid-run, and a
+    # cache-deserialized executable is never executed here. On a warm
+    # START the 'cold' number above is itself cache-served; the
+    # cache_hits counter in the telemetry fold-in disambiguates.
+    cache_dir = os.environ.get('MXTPU_COMPILE_CACHE')
+    compile_warm_s = None
+    cache_cold_s = compile_cold_s
+    served_from_cache = None
+    if cache_dir and not platform.startswith('cpu'):
+        # device runtimes are single-tenant: a concurrent probe child
+        # would contend with THIS process's chip claim (and can deepen
+        # a wedged tunnel). The warm number is this run's own compile
+        # on the NEXT bench invocation — cache_hits marks a served one,
+        # so the BENCH_*.json history carries the cold/warm pair across
+        # runs instead of within one.
+        try:
+            served_from_cache = bool(
+                _tele.snapshot()['counters'].get('xla.cache_hits', 0))
+        except Exception:  # noqa: BLE001
+            served_from_cache = None
+        if served_from_cache:
+            _log('train-step compile served from the persistent cache '
+                 '(%.1fs)' % compile_cold_s)
+    elif platform.startswith('cpu'):
+        # CPU run: the measuring process keeps the cache OFF (see the
+        # segfault note above), but a cold+warm pair of compile-only
+        # children against a scratch dir still quantifies the cache
+        probe_dir = tempfile.mkdtemp(prefix='mxtpu_cc_probe_')
+        try:
+            c = _warm_compile_subprocess(platform,
+                                         cache_override=probe_dir)
+            if c is not None:
+                cache_cold_s = c
+                compile_warm_s = _warm_compile_subprocess(
+                    platform, cache_override=probe_dir)
+        finally:
+            # the scratch dir holds tens of MB of serialized ResNet
+            # executables per run — never leave it behind
+            import shutil
+            shutil.rmtree(probe_dir, ignore_errors=True)
+    if compile_warm_s is not None:
+        _log('compile with persistent cache: cold %.1fs -> warm %.1fs '
+             '(fresh processes)' % (cache_cold_s, compile_warm_s))
 
     t = time.perf_counter()
     for _ in range(WARMUP_STEPS):
@@ -577,12 +801,38 @@ def main():
         out['xla_temp_bytes'] = temp_bytes
     if MIRROR:
         out['backward_mirror'] = MIRROR
+    if compile_warm_s is not None:
+        # cpu form: measured by compile-only probe children against a
+        # discarded scratch dir; the measuring process itself ran with
+        # the cache off (no 'dir' — there is nothing durable to point
+        # at), so the pair quantifies what MXTPU_COMPILE_CACHE would
+        # refund on a warm start
+        out['compile_cache'] = {'cold_s': round(cache_cold_s, 2),
+                                'warm_s': round(compile_warm_s, 2),
+                                'probe': 'compile-only subprocesses, '
+                                         'scratch cache discarded'}
+    elif served_from_cache is not None:
+        # device form: one number per run; 'served_from_cache' says
+        # whether THIS run was the warm one (pair up across runs)
+        out['compile_cache'] = {'dir': cache_dir,
+                                'compile_s': round(compile_cold_s, 2),
+                                'served_from_cache': served_from_cache}
     if platform.startswith('cpu'):
         out['note'] = ('cpu run at reduced batch; not config-comparable '
                        'to the batch-32 GPU baseline')
     tel = _telemetry_breakdown(devices[0])
     if tel:
         out['telemetry'] = tel
+    # inference tier (ISSUE 2): fused Module.predict vs the per-batch
+    # path, printed BEFORE the training line — the LAST line stays the
+    # authoritative training number, and a failure here can never lose
+    # it
+    if MODEL == 'resnet50':
+        try:
+            kind_ = kind or platform
+            print(json.dumps(run_infer_bench(platform, kind_)), flush=True)
+        except Exception as e:  # noqa: BLE001
+            _log('infer bench failed (training number unaffected): %s' % e)
     # emit the measured number NOW so an interrupted reprobe window can
     # never lose it; if a real device recovers below, its JSON is
     # printed after — the LAST line is authoritative

@@ -67,6 +67,13 @@ from . import telemetry
 # flag read and nothing else.
 telemetry.enabled()
 
+# Persistent XLA compilation cache (MXTPU_COMPILE_CACHE): wired at
+# import, before the first compile, so warm starts skip the 20-40s
+# XLA compiles entirely. Off (empty) by default — one flag read.
+from .config import enable_compile_cache as _enable_compile_cache
+_enable_compile_cache()
+del _enable_compile_cache
+
 # Server/scheduler processes block in their role loop here and exit with the
 # job (reference python/mxnet/kvstore_server.py:75).
 from .kvstore_server import init_server_module_if_needed as _init_kv_server

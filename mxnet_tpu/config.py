@@ -177,6 +177,28 @@ flags.declare('MXTPU_FUSED_FIT', bool, True,
 flags.declare('MXTPU_FIT_STEPS_PER_CALL', int, 0,
               'Window size for the fused Module.fit fast path; 0 = '
               'auto (32 on TPU, 4 elsewhere)', min_value=0)
+flags.declare('MXTPU_FUSED_EVAL', bool, True,
+              'Allow score/predict/iter_predict to compile a window of '
+              'N forward steps into one XLA call (lax.scan) with '
+              'on-device metric accumulation or a stacked-output '
+              'fetch — one dispatch + one fetch per window instead of '
+              'two per batch (module/fused_eval.py); 0 forces the '
+              'per-batch loop')
+flags.declare('MXTPU_EVAL_STEPS_PER_CALL', int, 0,
+              'Window size for the fused eval/inference fast path; '
+              '0 = auto (32 on TPU, 4 elsewhere)', min_value=0)
+flags.declare('MXTPU_FUSED_EVAL_PREFETCH', bool, True,
+              'Pipeline the fused-eval window input: window k+1\'s '
+              'host-stack + host->device transfer run on a side '
+              'thread while window k computes on device; 0 restores '
+              'the serial stack/put/dispatch/fetch order')
+flags.declare('MXTPU_COMPILE_CACHE', str, '',
+              'Directory for jax\'s persistent XLA compilation cache '
+              '(jax_compilation_cache_dir), wired at package import so '
+              'even warmup compiles are cache-servable. Empty = off. '
+              'Warm starts reuse cached executables instead of paying '
+              'the 20-40s XLA compile; telemetry counts served '
+              'compiles under xla.cache_hits')
 flags.declare('MXTPU_SHARDED_UPDATE', bool, True,
               'Cross-replica weight-update sharding in the SPMD fused '
               'fit window (arXiv:2004.13336): grads reduce-scatter, '
@@ -238,6 +260,57 @@ flags.declare('MXTPU_NUM_HOSTS', int, 1,
 flags.declare('MXTPU_HOST_ID', int, 0,
               'This process\'s rank in the multi-host SPMD job',
               min_value=0)
+
+
+_compile_cache_enabled_here = False
+
+
+def enable_compile_cache():
+    """Wire jax's persistent XLA compilation cache to the
+    MXTPU_COMPILE_CACHE directory. Called once at package import —
+    before the process's first compile, so even warmup compiles are
+    cache-servable — and safe to call again after
+    ``flags.reload('MXTPU_COMPILE_CACHE')`` (tests). Returns the
+    directory when enabled, else None."""
+    global _compile_cache_enabled_here
+    path = flags.get('MXTPU_COMPILE_CACHE')
+    if not path:
+        # a re-call after the flag was CLEARED must actually switch the
+        # cache off — but ONLY a cache THIS function enabled: a user
+        # cache configured via JAX_COMPILATION_CACHE_DIR or a direct
+        # jax.config.update must survive importing the package
+        if _compile_cache_enabled_here:
+            try:
+                import jax
+                jax.config.update('jax_compilation_cache_dir', None)
+            except Exception:  # noqa: BLE001
+                pass
+            _compile_cache_enabled_here = False
+        return None
+    path = os.path.expanduser(path)
+    try:
+        import jax
+        os.makedirs(path, exist_ok=True)
+        # cache every executable, not just slow-to-compile ones: the
+        # flag is opt-in, and the tunneled-runtime compiles it targets
+        # are exactly the ones worth never repeating. Thresholds go
+        # FIRST and the directory — the on-switch — last, so a partial
+        # failure leaves the cache fully off, never half-configured
+        jax.config.update('jax_persistent_cache_min_compile_time_secs', 0.0)
+        jax.config.update('jax_persistent_cache_min_entry_size_bytes', 0)
+        jax.config.update('jax_compilation_cache_dir', path)
+        _compile_cache_enabled_here = True
+    except Exception as e:  # noqa: BLE001 — a bad cache dir must not
+        import logging      # take the process down
+        logging.warning('MXTPU_COMPILE_CACHE=%s not usable: %s', path, e)
+        if _compile_cache_enabled_here:
+            try:
+                jax.config.update('jax_compilation_cache_dir', None)
+            except Exception:  # noqa: BLE001
+                pass
+            _compile_cache_enabled_here = False
+        return None
+    return path
 
 
 # ---- dmlc::Parameter analog ----------------------------------------------

@@ -802,13 +802,15 @@ class ImageRecordIter(DataIter):
                 p['rand_crop'], p['rand_mirror'], self._host_crop)
 
     def defer_device_aug(self, on):
-        """Switch deferred-augment mode (fused-fit internal protocol):
-        when on, next() returns RAW uint8 device batches and the
-        consumer must apply device_aug_pure() itself (in-graph). Only
-        meaningful in device-augment mode — returns whether the switch
-        engaged. Always flip back off (try/finally) so other consumers
-        of the same iterator (eval passes, score) see augmented
-        batches again."""
+        """Switch deferred-augment mode (the compiled-window loops'
+        internal protocol — module/fused_fit.py today): when on,
+        next() returns RAW uint8 host batches and the consumer must
+        apply device_aug_pure() itself (in-graph). Only meaningful in
+        device-augment mode — returns whether the switch engaged.
+        Always flip back off (try/finally) so other consumers of the
+        same iterator see augmented batches again: the fused eval
+        window (module/fused_eval.py) and the per-batch score/predict
+        loops all draw through the eager per-batch augment path."""
         if not self._device_augment:
             return False
         self._defer_aug = bool(on)

@@ -86,6 +86,15 @@ class BaseModule:
         self.forward(data_batch, is_train=True)
         self.backward()
 
+    def _set_eval_rate(self, nbatches, batch_size, tic):
+        """eval_samples_per_sec gauge, the eval twin of the fit loop's
+        speedometer.samples_per_sec (no-op while telemetry is off)."""
+        if nbatches and batch_size:
+            dt = time.time() - tic
+            if dt > 0:
+                _tele.gauge('eval_samples_per_sec').set(
+                    round(nbatches * batch_size / dt, 2))
+
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0):
@@ -96,18 +105,39 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                       eval_metric=eval_metric, locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(params)
-            actual_num_batch += 1
+        tic = time.time()
+
+        # TPU fast path: compile a window of N forward steps + on-device
+        # metric accumulation into one XLA call (lax.scan) when the
+        # module/metric combination allows it — one dispatch and one
+        # fetch per window instead of two per batch (module/
+        # fused_eval.py). Falls back silently, like fit's fused window.
+        from .fused_eval import FusedEvalLoop
+        fused = FusedEvalLoop.build_cached(self, eval_metric,
+                                           logger=self.logger)
+        if fused is not None:
+            actual_num_batch = fused.run_score(eval_data, eval_metric,
+                                               num_batch,
+                                               batch_end_callback, epoch)
+        else:
+            actual_num_batch = 0
+            for nbatch, eval_batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                with _tele.span('eval.dispatch', 'eval'):
+                    self.forward(eval_batch, is_train=False)
+                with _tele.span('eval.metric', 'eval'):
+                    self.update_metric(eval_metric, eval_batch.label)
+                _tele.counter('eval.batches').inc()
+                if batch_end_callback is not None:
+                    params = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                           eval_metric=eval_metric,
+                                           locals=locals())
+                    for callback in _as_list(batch_end_callback):
+                        callback(params)
+                actual_num_batch += 1
+        self._set_eval_rate(actual_num_batch,
+                            getattr(eval_data, 'batch_size', 0), tic)
         if score_end_callback:
             params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
                                    eval_metric=eval_metric, locals=locals())
@@ -119,12 +149,23 @@ class BaseModule:
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
+        # fused window path (one dispatch + one fetch per N batches);
+        # silent fallback to the reference per-batch loop
+        from .fused_eval import FusedEvalLoop
+        fused = FusedEvalLoop.build_cached(self, None, logger=self.logger)
+        if fused is not None:
+            yield from fused.iter_windows(eval_data, num_batch)
+            return
         for nbatch, eval_batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
+            with _tele.span('eval.dispatch', 'eval'):
+                self.forward(eval_batch, is_train=False)
             pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
+            with _tele.span('eval.fetch', 'eval'):
+                outputs = [out[0:out.shape[0] - pad]
+                           for out in self.get_outputs()]
+            _tele.counter('eval.batches').inc()
             yield (outputs, nbatch, eval_batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
@@ -133,15 +174,29 @@ class BaseModule:
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
+        tic = time.time()
+        from .fused_eval import FusedEvalLoop
+        fused = FusedEvalLoop.build_cached(self, None, logger=self.logger)
         output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
+        if fused is not None:
+            # windowed forward: outputs arrive per batch already
+            # pad-trimmed and host-resident (one fetch per window)
+            for outputs, _, _ in fused.iter_windows(eval_data, num_batch):
+                output_list.append(outputs)
+        else:
+            for nbatch, eval_batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                with _tele.span('eval.dispatch', 'eval'):
+                    self.forward(eval_batch, is_train=False)
+                pad = eval_batch.pad
+                with _tele.span('eval.fetch', 'eval'):
+                    outputs = [out[0:out.shape[0] - pad].copy()
+                               for out in self.get_outputs()]
+                _tele.counter('eval.batches').inc()
+                output_list.append(outputs)
+        self._set_eval_rate(len(output_list),
+                            getattr(eval_data, 'batch_size', 0), tic)
         if len(output_list) == 0:
             return output_list
         if merge_batches:

@@ -362,6 +362,14 @@ class SPMDExecutorGroup:
     """
 
     @staticmethod
+    def window_sharding(mesh, ndim):
+        """NamedSharding for a (W, batch, ...) window stack fed to a
+        compiled multi-step window (the fused fit/eval loops): dp
+        shards the BATCH axis (axis 1 of the stack), the window axis
+        stays unsharded so lax.scan peels whole dp-sharded batches."""
+        return NamedSharding(mesh, P(*((None, 'dp') + (None,) * (ndim - 2))))
+
+    @staticmethod
     def eligible(contexts, workload, batch_size, symbol):
         from ..config import flags as _flags
         _flags.reload('MXTPU_NO_SPMD_MODULE')  # tests toggle it per-case

@@ -58,19 +58,16 @@ from .. import telemetry as _tele
 from ..optimizer import _as_clip
 from ..executor import mirror_wrap
 from ..kvstore import _updater_key
-from ..ndarray.ndarray import NDArray, from_jax
+from ..ndarray.ndarray import from_jax
 from ..ops import registry as _reg
+from .window_pipeline import WindowPipeline, host_wrap, window_size
+from .window_pipeline import plan_metric as _metric_plan
 
 __all__ = ['FusedFitLoop']
 
 
 def _window_size():
-    from ..config import flags
-    flags.reload('MXTPU_FIT_STEPS_PER_CALL')
-    n = flags.get('MXTPU_FIT_STEPS_PER_CALL')
-    if n > 0:
-        return n
-    return 32 if jax.default_backend() == 'tpu' else 4
+    return window_size('MXTPU_FIT_STEPS_PER_CALL')
 
 
 def _shard_update_enabled():
@@ -234,61 +231,8 @@ def _opt_plan(opt):
     return cls(opt) if cls is not None else None
 
 
-# ---------------------------------------------------------------------------
-# metric plans: in-graph sufficient statistics + host-side apply
-# ---------------------------------------------------------------------------
-
-def _plan_one(m):
-    """(stats_fn(outs, labels) -> (sum, count), apply) for one metric,
-    or None if unsupported. Statistics mirror metric.py's numpy math."""
-    if type(m) is metric_mod.Accuracy:
-        if getattr(m, 'axis', 1) != 1:
-            return None     # stats below assume 2-D preds, class axis 1
-        def stats(outs, labels):
-            pred = outs[0]
-            hit = jnp.argmax(pred, axis=-1).astype(jnp.int32) == \
-                labels[0].astype(jnp.int32)
-            return jnp.sum(hit).astype(jnp.float32), \
-                jnp.float32(hit.size)
-        return stats
-    if type(m) is metric_mod.TopKAccuracy:
-        k = m.top_k
-
-        def stats(outs, labels, k=k):
-            pred = outs[0]
-            _, idx = jax.lax.top_k(pred, k)
-            hit = jnp.any(idx.astype(jnp.int32) ==
-                          labels[0].astype(jnp.int32)[..., None], axis=-1)
-            return jnp.sum(hit).astype(jnp.float32), \
-                jnp.float32(hit.size)
-        return stats
-    if type(m) is metric_mod.CrossEntropy:
-        eps = getattr(m, 'eps', 1e-12)
-
-        def stats(outs, labels, eps=eps):
-            pred = outs[0]
-            lab = labels[0].astype(jnp.int32)
-            p = jnp.take_along_axis(pred, lab[:, None], axis=-1)[:, 0]
-            return jnp.sum(-jnp.log(p + eps)).astype(jnp.float32), \
-                jnp.float32(lab.size)
-        return stats
-    return None
-
-
-def _metric_plan(eval_metric):
-    """Returns (children, [stats_fn]) where children are the leaf
-    EvalMetric objects to update, or None if any leaf is unsupported."""
-    if isinstance(eval_metric, metric_mod.CompositeEvalMetric):
-        children = list(eval_metric.metrics)
-    else:
-        children = [eval_metric]
-    fns = []
-    for m in children:
-        fn = _plan_one(m)
-        if fn is None:
-            return None
-        fns.append(fn)
-    return children, fns
+# metric plans (in-graph sufficient statistics) live in
+# window_pipeline.plan_metric — shared with the fused eval loop.
 
 
 class FusedFitLoop:
@@ -300,8 +244,6 @@ class FusedFitLoop:
         self.stat_fns = stat_fns
         self.window = window
         self._programs = {}
-        self._dev_cache_key = None
-        self._dev_cache = None
         import weakref
         self._defer_fns = weakref.WeakKeyDictionary()
 
@@ -323,6 +265,11 @@ class FusedFitLoop:
         from .executor_group import SPMDExecutorGroup
         self._mesh = module._exec_group.mesh \
             if isinstance(module._exec_group, SPMDExecutorGroup) else None
+        # the shared draw/stack/upload machinery (module/window_pipeline)
+        self._pipe = WindowPipeline(window,
+                                    device_fn=lambda: e._ctx.jax_device(),
+                                    mesh=self._mesh,
+                                    span_prefix='fused_fit')
         # the key each param updates under must match the unfused path:
         # update_on_kvstore pushes by NAME (kvstore._updater keys);
         # the local updater uses integer position (model._update_params)
@@ -351,15 +298,8 @@ class FusedFitLoop:
             return None
 
     def _rebind_metric(self, eval_metric):
-        """Point the loop's stat writeback at the CURRENT fit() call's
-        metric objects (each call may construct fresh instances from
-        the same config — which is exactly what the reuse signature
-        guarantees, so the stat fns, which capture only config values
-        like top_k/eps, stay valid)."""
-        if isinstance(eval_metric, metric_mod.CompositeEvalMetric):
-            self.children = list(eval_metric.metrics)
-        elif self.children is not None:
-            self.children = [eval_metric]
+        from .window_pipeline import rebind_children
+        self.children = rebind_children(eval_metric, self.children)
 
     @classmethod
     def build_cached(cls, module, eval_metric, logger=logging):
@@ -447,13 +387,9 @@ class FusedFitLoop:
         if out_shapes is None:
             return None
         window = _window_size()
-        plan = _metric_plan(eval_metric)
-        # the metric stat fns assume ONE 2-D (batch, classes) output and
-        # one label — other geometries use the host-fallback mode below
-        if plan is not None and (len(out_shapes) != 1
-                                 or len(out_shapes[0]) != 2
-                                 or len(module._label_names) != 1):
-            plan = None
+        # plan_metric also enforces the stat fns' output/label geometry;
+        # other geometries use the host-fallback mode below
+        plan = _metric_plan(eval_metric, out_shapes, module._label_names)
         if plan is not None:
             children, fns = plan
         else:
@@ -659,13 +595,9 @@ class FusedFitLoop:
         gaccs = tuple(e.grad_dict[n]._data for n in self._grad_names) \
             if self._accum else ()
         if self._mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            rep = NamedSharding(self._mesh, P())
-            place = lambda t: jax.tree_util.tree_map(  # noqa: E731
-                lambda a: a if getattr(a, 'sharding', None) == rep
-                else jax.device_put(a, rep), t)
-            params, states, aux, gaccs = (place(params), place(states),
-                                          place(aux), place(gaccs))
+            from .window_pipeline import place_replicated
+            params, states, aux, gaccs = place_replicated(
+                self._mesh, params, states, aux, gaccs)
         return params, states, aux, gaccs
 
     def _writeback(self, params, states, aux, gaccs):
@@ -687,70 +619,6 @@ class FusedFitLoop:
                 e.grad_dict[n]._data = v
         m._params_dirty = True
 
-    def _device_batches(self, snaps):
-        """Stack W draw-time array snapshots into device (W, ...)
-        arrays. `snaps` holds the jax arrays captured as each batch was
-        drawn (jax arrays are immutable, so the references stay valid
-        even if the iterator reuses its NDArray buffers). Identity-
-        cached: synthetic/benchmark iterators yield the same arrays
-        every batch, so the transfer happens once. The cache key holds
-        STRONG references to the source arrays — identity is compared
-        against live objects, so a freed array's id can never produce
-        a false hit."""
-        arrays = [a for ds, ls in snaps for a in ds + ls]
-        if self._dev_cache_key is not None and \
-                len(arrays) == len(self._dev_cache_key) and \
-                all(a is c for a, c in zip(arrays, self._dev_cache_key)):
-            return self._dev_cache
-        key = arrays
-        def shard(stack):
-            if self._mesh is None:
-                # source arrays may be committed to the host device
-                # (cpu_pinned iterators); the window runs where the
-                # executor's params live
-                return jax.device_put(stack, self._exec._ctx.jax_device())
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            spec = P(*((None, 'dp') + (None,) * (stack.ndim - 2)))
-            return jax.device_put(stack, NamedSharding(self._mesh, spec))
-
-        def _on_host(a):
-            if isinstance(a, np.ndarray):
-                return True
-            try:
-                return all(d.platform == 'cpu' for d in a.devices())
-            except Exception:  # noqa: BLE001 — tracer/abstract array
-                return False
-
-        def stack(parts):
-            # host-resident parts (defer-mode uint8 batches and their
-            # labels) stack on the host so the whole window crosses to
-            # the device in shard()'s ONE device_put — W per-batch
-            # transfers each cost a full dispatch RTT on a tunneled
-            # runtime
-            if all(_on_host(p) for p in parts):
-                return np.stack([np.asarray(p) for p in parts])
-            return jnp.stack([jnp.asarray(p) for p in parts])
-
-        data_stack = [shard(stack([ds[i] for ds, _ in snaps]))
-                      for i in range(len(snaps[0][0]))]
-        label_stack = [shard(stack([ls[i] for _, ls in snaps]))
-                       for i in range(len(snaps[0][1]))]
-        self._dev_cache_key = key
-        self._dev_cache = (tuple(data_stack), tuple(label_stack))
-        return self._dev_cache
-
-    def _put_pool(self):
-        """One-thread executor for the pipelined window upload. A
-        single worker keeps transfers ordered; the loop object (cached
-        on the module across fit() calls) owns it for its lifetime."""
-        pool = getattr(self, '_put_pool_obj', None)
-        if pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-            pool = ThreadPoolExecutor(max_workers=1,
-                                      thread_name_prefix='mxtpu-fused-put')
-            self._put_pool_obj = pool
-        return pool
-
     def run_epoch(self, train_data, eval_metric, epoch,
                   batch_end_callback, monitor=None):
         """Run one epoch; returns the number of batches consumed.
@@ -761,17 +629,9 @@ class FusedFitLoop:
         from .base_module import _as_list
 
         _tele.gauge('fused_fit.steps_per_call').set(self.window)
-        try:
-            _host_dev = jax.local_devices(backend='cpu')[0]
-        except RuntimeError:
-            _host_dev = None
-
-        def host_nd(a):
-            """cpu-backed NDArray wrapper for already-host data, so the
-            metric's .asnumpy() calls cost no device round-trip."""
-            arr = jax.device_put(np.asarray(a), _host_dev) \
-                if _host_dev is not None else jnp.asarray(a)
-            return from_jax(arr, self._exec._ctx)
+        # cpu-backed NDArray wrapper for already-host data, so the
+        # metric's .asnumpy() calls cost no device round-trip
+        host_nd = host_wrap(self._exec._ctx)
 
         def apply_stats(pieces, labels_w, nbatch):
             """One host fetch for the window's results, then exact
@@ -852,8 +712,7 @@ class FusedFitLoop:
             # the loop now outlives fit() (build_cached): drop the last
             # window's device stack + its strong host refs — the
             # identity cache only ever hits while an epoch is running
-            self._dev_cache_key = None
-            self._dev_cache = None
+            self._pipe.drop_cache()
 
     def _run_epoch_inner(self, train_data, eval_metric, epoch,
                          batch_end_callback, _DataBatch, apply_stats,
@@ -874,42 +733,27 @@ class FusedFitLoop:
         _tm = {'draw': 0.0, 'put': 0.0, 'dispatch': 0.0, 'fetch': 0.0}
         _clk = time.perf_counter
         _ep_t0 = _clk() if _timing else 0.0
-        pool = self._put_pool() \
+        pipe = self._pipe
+        pool = pipe.pool() \
             if _flags.get('MXTPU_FUSED_FIT_PREFETCH') else None
 
         def collect():
-            # snapshot each batch's underlying jax arrays AT DRAW TIME:
+            # draw-time snapshotting lives in the shared pipeline:
             # iterators may legally reuse their DataBatch/NDArray
-            # buffers for the next batch (the reference loop consumes
-            # each batch before drawing the next); jax arrays are
-            # immutable, so the draw-time references stay valid while
-            # the window is collected and the apply is deferred.
-            batches, snaps = [], []
+            # buffers for the next batch; the draw-time jax-array
+            # references stay valid while the window is collected and
+            # the apply is deferred.
             _t = _clk() if _timing else 0.0
-            with _tele.span('fused_fit.draw', 'fused_fit'):
-                while len(batches) < self.window:
-                    try:
-                        b = next(it)
-                    except StopIteration:
-                        break
-                    batches.append(b)
-                    snaps.append((tuple(a._data for a in b.data),
-                                  tuple(l._data for l in b.label)))
+            batches, snaps = pipe.collect(it)
             if _timing:
                 _tm['draw'] += _clk() - _t
             return batches, snaps
 
         def start_put(win_snaps):
-            """Begin the window's host-stack + device transfer; returns
-            a no-arg resolver. On the prefetch pool the stack + put for
-            window k+1 run on the side thread while window k computes on
-            device and k-1's stats fetch waits — np.stack's memcpy and
-            the transfer both release the GIL, so the overlap is real
-            even on a one-core host."""
-            if pool is None:
-                res = self._device_batches(win_snaps)
-                return lambda: res
-            return pool.submit(self._device_batches, win_snaps).result
+            # with the prefetch pool, window k+1's stack + put run on
+            # the side thread while window k computes on device and
+            # k-1's stats fetch waits
+            return pipe.start_put(win_snaps, pool)
 
         batches, snaps = collect()
         if not batches:
@@ -951,7 +795,7 @@ class FusedFitLoop:
                 labels_snap = None
                 if self.stat_fns is None:
                     labels_snap = [[from_jax(l, self._exec._ctx)
-                                    for l in ls] for _, ls in snaps]
+                                    for l in ls] for _, ls, _, _ in snaps]
                 params, states, aux, gaccs = self._snapshot()
                 _t = _clk() if _timing else 0.0
                 with _tele.span('fused_fit.put', 'fused_fit'):
@@ -990,30 +834,27 @@ class FusedFitLoop:
         finally:
             # drain an in-flight prefetch before run_epoch's cache
             # teardown (or an exception unwind) can race the side thread
-            if fut is not None and pool is not None:
-                try:
-                    fut()
-                except Exception:  # noqa: BLE001 — primary error wins
-                    pass
+            if pool is not None:
+                WindowPipeline.drain(fut)
         _t = _clk() if _timing else 0.0
         if pending is not None:
             nbatch = apply_stats(pending[0], pending[1], nbatch)
         if _timing:
             _tm['fetch'] += _clk() - _t
-        for b, (ds, ls) in zip(batches, snaps):
+        for ds, ls, pad, idx in snaps:
             # tail (< window): reference per-batch path, on a rebuilt
             # batch (the original's buffers may have been overwritten
-            # by later draws). Deferred uint8 batches are materialized
-            # eagerly here — one aug dispatch per tail batch, exactly
-            # the eager mode's cost
+            # by later draws — pad/index come from the draw-time
+            # snapshot for the same reason). Deferred uint8 batches are
+            # materialized eagerly here — one aug dispatch per tail
+            # batch, exactly the eager mode's cost
             if self._defer_eager is not None:
                 ds = (self._defer_eager(ds[0], _random.next_key()),
                       ) + tuple(ds[1:])
             sb = _DataBatch(
                 data=[from_jax(d, self._exec._ctx) for d in ds],
                 label=[from_jax(l, self._exec._ctx) for l in ls],
-                pad=getattr(b, 'pad', None),
-                index=getattr(b, 'index', None))
+                pad=pad, index=idx)
             m.forward_backward(sb)
             m.update()
             _tele.counter('fit.steps').inc()

@@ -104,6 +104,11 @@ class Module(BaseModule):
         self._exec_group = None
         self._data_shapes = None
         self._label_shapes = None
+        # cached fused loops close over the executor being torn down;
+        # drop them (and the device buffers their programs pin) rather
+        # than waiting for the reuse signature to miss
+        self.__dict__.pop('_fused_fit_cache', None)
+        self.__dict__.pop('_fused_eval_cache', None)
 
     @property
     def data_names(self):

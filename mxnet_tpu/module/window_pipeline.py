@@ -1,0 +1,311 @@
+"""Shared window machinery for the fused fit/eval fast paths.
+
+module/fused_fit.py compiles W training steps into one XLA call;
+module/fused_eval.py does the same for the read-only half of the API
+(score / predict / iter_predict). Both loops need identical host-side
+input machinery, extracted here so it is ONE subsystem with two
+consumers instead of two private copies:
+
+- draw-time batch snapshotting (:meth:`WindowPipeline.collect`):
+  iterators may legally reuse their DataBatch/NDArray buffers for the
+  next batch — the reference per-batch loop consumes each batch before
+  drawing the next. jax arrays are immutable, so references captured
+  as each batch is drawn stay valid while a whole window is in flight,
+  along with the batch's draw-time ``pad``/``index``;
+- window stacking (:meth:`WindowPipeline.device_batches`): W batches
+  become (W, ...) device arrays with ONE host->device transfer per
+  input. Host-resident parts stack on the host first so the whole
+  window crosses in a single ``device_put`` (W per-batch transfers
+  each cost a full dispatch RTT on a tunneled runtime); on an SPMD
+  mesh the stacks land dp-sharded over the batch axis
+  (:meth:`executor_group.SPMDExecutorGroup.window_sharding`). An
+  identity cache short-circuits synthetic/benchmark iterators that
+  yield the same arrays every batch;
+- a one-thread upload pool (:meth:`WindowPipeline.start_put`): window
+  k+1's stack + transfer run on a side thread while window k computes
+  on device — np.stack's memcpy and the transfer both release the
+  GIL, so the overlap is real even on a one-core host;
+- the in-graph metric plans (:func:`plan_metric`): sufficient
+  statistics for Accuracy / TopKAccuracy / CrossEntropy (and
+  composites of them) that both loops compile into their scan bodies,
+  packed so the host needs a single fetch per window.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .. import metric as metric_mod
+from .. import telemetry as _tele
+from ..ndarray.ndarray import from_jax
+
+__all__ = ['WindowPipeline', 'window_size', 'plan_metric', 'host_wrap']
+
+
+def window_size(flag='MXTPU_FIT_STEPS_PER_CALL'):
+    """Window size W from the given env flag; 0 = auto (32 on TPU —
+    where each dispatch crosses a tunnel RTT — and 4 elsewhere, enough
+    to exercise the windowed path in CPU tests)."""
+    from ..config import flags
+    flags.reload(flag)
+    n = flags.get(flag)
+    if n > 0:
+        return n
+    return 32 if jax.default_backend() == 'tpu' else 4
+
+
+def host_device():
+    """The host (cpu-backend) jax device, or None when unavailable."""
+    try:
+        return jax.local_devices(backend='cpu')[0]
+    except RuntimeError:
+        return None
+
+
+def host_wrap(ctx):
+    """Returns ``host_nd(a)``: a cpu-backed NDArray wrapper for
+    already-host data, so downstream ``.asnumpy()`` calls (metric math,
+    user code) cost no device round-trip."""
+    dev = host_device()
+
+    def host_nd(a):
+        arr = jax.device_put(np.asarray(a), dev) if dev is not None \
+            else jnp.asarray(a)
+        return from_jax(arr, ctx)
+
+    return host_nd
+
+
+# ---------------------------------------------------------------------------
+# metric plans: in-graph sufficient statistics + host-side apply
+# ---------------------------------------------------------------------------
+
+def _plan_one(m):
+    """(stats_fn(outs, labels) -> (sum, count)) for one metric, or None
+    if unsupported. Statistics mirror metric.py's numpy math — in
+    particular every reference metric RAVELS the label, so an (N, 1)
+    column label (CSVIter and friends) compares elementwise against the
+    (N,) argmax instead of broadcasting into an (N, N) matrix."""
+    if type(m) is metric_mod.Accuracy:
+        if getattr(m, 'axis', 1) != 1:
+            return None     # stats below assume 2-D preds, class axis 1
+        def stats(outs, labels):
+            pred = outs[0]
+            lab = labels[0].reshape(-1).astype(jnp.int32)
+            hit = jnp.argmax(pred, axis=-1).astype(jnp.int32) == lab
+            return jnp.sum(hit).astype(jnp.float32), \
+                jnp.float32(hit.size)
+        return stats
+    if type(m) is metric_mod.TopKAccuracy:
+        k = m.top_k
+
+        def stats(outs, labels, k=k):
+            pred = outs[0]
+            lab = labels[0].reshape(-1).astype(jnp.int32)
+            # reference TopKAccuracy clamps: top_k = min(classes, k)
+            # (lax.top_k would raise past the minor dim, where the
+            # per-batch loop computes a valid result)
+            _, idx = jax.lax.top_k(pred, min(k, pred.shape[-1]))
+            hit = jnp.any(idx.astype(jnp.int32) == lab[:, None], axis=-1)
+            return jnp.sum(hit).astype(jnp.float32), \
+                jnp.float32(hit.size)
+        return stats
+    if type(m) is metric_mod.CrossEntropy:
+        eps = getattr(m, 'eps', 1e-12)
+
+        def stats(outs, labels, eps=eps):
+            pred = outs[0]
+            lab = labels[0].reshape(-1).astype(jnp.int32)
+            p = jnp.take_along_axis(pred, lab[:, None], axis=-1)[:, 0]
+            return jnp.sum(-jnp.log(p + eps)).astype(jnp.float32), \
+                jnp.float32(lab.size)
+        return stats
+    return None
+
+
+def plan_metric(eval_metric, out_shapes=None, label_names=None):
+    """Returns (children, [stats_fn]) where children are the leaf
+    EvalMetric objects to update, or None if any leaf is unsupported.
+    When ``out_shapes``/``label_names`` are given, also enforces the
+    geometry every stat fn assumes — ONE 2-D (batch, classes) output
+    with classes >= 2 (reference Accuracy SKIPS the argmax on a
+    width-1 class dim and compares raw values) and one label — so the
+    fit and eval loops cannot drift on the eligibility condition."""
+    if out_shapes is not None and (
+            len(out_shapes) != 1 or len(out_shapes[0]) != 2
+            or out_shapes[0][1] < 2
+            or (label_names is not None and len(label_names) != 1)):
+        return None
+    if isinstance(eval_metric, metric_mod.CompositeEvalMetric):
+        children = list(eval_metric.metrics)
+    else:
+        children = [eval_metric]
+    fns = []
+    for m in children:
+        fn = _plan_one(m)
+        if fn is None:
+            return None
+        fns.append(fn)
+    return children, fns
+
+
+def place_replicated(mesh, *trees):
+    """device_put every array in the given pytrees onto the mesh's
+    fully-replicated sharding (no-op for arrays already there): on an
+    SPMD group every array a compiled window closes over must live
+    replicated on the mesh, or jit rejects the mixed-device argument
+    set. Returns the trees in call order."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    rep = NamedSharding(mesh, P())
+    put = lambda a: a if getattr(a, 'sharding', None) == rep \
+        else jax.device_put(a, rep)  # noqa: E731
+    return tuple(jax.tree_util.tree_map(put, t) for t in trees)
+
+
+def rebind_children(eval_metric, current_children):
+    """Point a cached loop's stat writeback at the CURRENT call's
+    metric objects (each call may construct fresh instances from the
+    same config — exactly what the loops' reuse signatures guarantee,
+    so the stat fns, which capture only config values like top_k/eps,
+    stay valid). Returns the new children list (or the old one for a
+    loop without in-graph stats)."""
+    if isinstance(eval_metric, metric_mod.CompositeEvalMetric):
+        return list(eval_metric.metrics)
+    if current_children is not None:
+        return [eval_metric]
+    return current_children
+
+
+# ---------------------------------------------------------------------------
+# the pipeline
+# ---------------------------------------------------------------------------
+
+class WindowPipeline:
+    """Draw/stack/upload machinery for one compiled-window loop.
+
+    ``device_fn`` resolves the target jax device lazily (the bound
+    executor's context); ``mesh`` switches placement to dp-sharded
+    window stacks. ``span_prefix`` names the telemetry spans
+    ('fused_fit' / 'fused_eval'). The owning loop object lives across
+    fit()/score() calls, so the upload pool it carries does too.
+    """
+
+    def __init__(self, window, device_fn, mesh=None, span_prefix='window'):
+        self.window = window
+        self.mesh = mesh
+        self._device_fn = device_fn
+        self._span = span_prefix
+        self._dev_cache_key = None
+        self._dev_cache = None
+        self._pool_obj = None
+
+    # -- draw --------------------------------------------------------------
+    def collect(self, it, limit=None):
+        """Draw up to ``window`` batches (further bounded by ``limit``,
+        the eval loops' num_batch remainder), snapshotting each batch's
+        underlying jax arrays, pad, and index AT DRAW TIME. Returns
+        (batches, snaps) with snaps a list of (data_arrays,
+        label_arrays, pad, index) tuples."""
+        n = self.window if limit is None else min(self.window, limit)
+        batches, snaps = [], []
+        with _tele.span(self._span + '.draw', self._span):
+            while len(batches) < n:
+                try:
+                    b = next(it)
+                except StopIteration:
+                    break
+                batches.append(b)
+                snaps.append((tuple(a._data for a in b.data),
+                              tuple(l._data for l in (b.label or ())),
+                              getattr(b, 'pad', None),
+                              getattr(b, 'index', None)))
+        return batches, snaps
+
+    # -- stack + upload ----------------------------------------------------
+    def device_batches(self, snaps):
+        """Stack W draw-time snapshots into device (W, ...) arrays.
+        Identity-cached: synthetic/benchmark iterators yield the same
+        arrays every batch, so the transfer happens once. The cache key
+        holds STRONG references to the source arrays — identity is
+        compared against live objects, so a freed array's id can never
+        produce a false hit."""
+        arrays = [a for ds, ls, _, _ in snaps for a in ds + ls]
+        if self._dev_cache_key is not None and \
+                len(arrays) == len(self._dev_cache_key) and \
+                all(a is c for a, c in zip(arrays, self._dev_cache_key)):
+            return self._dev_cache
+        key = arrays
+
+        def shard(stack):
+            if self.mesh is None:
+                # source arrays may be committed to the host device
+                # (cpu_pinned iterators); the window runs where the
+                # executor's params live
+                return jax.device_put(stack, self._device_fn())
+            from .executor_group import SPMDExecutorGroup
+            return jax.device_put(
+                stack, SPMDExecutorGroup.window_sharding(self.mesh,
+                                                         stack.ndim))
+
+        def _on_host(a):
+            if isinstance(a, np.ndarray):
+                return True
+            try:
+                return all(d.platform == 'cpu' for d in a.devices())
+            except Exception:  # noqa: BLE001 — tracer/abstract array
+                return False
+
+        def stack(parts):
+            # host-resident parts (defer-mode uint8 batches and their
+            # labels) stack on the host so the whole window crosses to
+            # the device in shard()'s ONE device_put — W per-batch
+            # transfers each cost a full dispatch RTT on a tunneled
+            # runtime
+            if all(_on_host(p) for p in parts):
+                return np.stack([np.asarray(p) for p in parts])
+            return jnp.stack([jnp.asarray(p) for p in parts])
+
+        data_stack = [shard(stack([ds[i] for ds, _, _, _ in snaps]))
+                      for i in range(len(snaps[0][0]))]
+        label_stack = [shard(stack([ls[i] for _, ls, _, _ in snaps]))
+                       for i in range(len(snaps[0][1]))]
+        self._dev_cache_key = key
+        self._dev_cache = (tuple(data_stack), tuple(label_stack))
+        return self._dev_cache
+
+    def pool(self):
+        """One-thread executor for the pipelined window upload. A
+        single worker keeps transfers ordered; the owning loop (cached
+        on the module across calls) keeps it for its lifetime."""
+        if self._pool_obj is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool_obj = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix='mxtpu-window-put')
+        return self._pool_obj
+
+    def start_put(self, snaps, pool):
+        """Begin the window's host-stack + device transfer; returns a
+        no-arg resolver. With a pool, the stack + put for window k+1
+        run on the side thread while window k computes on device and
+        k-1's fetch waits."""
+        if pool is None:
+            res = self.device_batches(snaps)
+            return lambda: res
+        return pool.submit(self.device_batches, snaps).result
+
+    @staticmethod
+    def drain(fut):
+        """Resolve an in-flight prefetch before teardown (or an
+        exception unwind) can race the side thread."""
+        if fut is not None:
+            try:
+                fut()
+            except Exception:  # noqa: BLE001 — primary error wins
+                pass
+
+    def drop_cache(self):
+        """Release the last window's device stack + its strong host
+        refs — the identity cache only ever hits while an epoch/pass
+        is running."""
+        self._dev_cache_key = None
+        self._dev_cache = None

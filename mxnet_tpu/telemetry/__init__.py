@@ -25,11 +25,18 @@ Instrumented sites (the names to grep for in the log):
 ``fit.batch`` / ``fit.dispatch`` / ``fit.metric`` / ``fit.callback``
 (reference per-batch loop), ``fused_fit.draw|put|dispatch|fetch|build``
 + gauge ``fused_fit.steps_per_call`` (compiled window loop),
-``executor.forward|backward``, ``exec_group.forward|backward``,
-``module.update``, histogram ``io.prefetch_wait`` + counter
-``io.batches``, ``kvstore.push|pull`` spans + ``kvstore.push_bytes`` /
-``kvstore.pull_bytes`` counters, gauge ``speedometer.samples_per_sec``,
-and the ``xla.*`` compile/memory metrics.
+``eval.dispatch|metric|fetch`` + counter ``eval.batches`` + gauge
+``eval_samples_per_sec`` (per-batch score/predict loops),
+``fused_eval.draw|put|dispatch|fetch|build`` + counter
+``fused_eval.windows`` + gauge ``fused_eval.steps_per_call`` (compiled
+eval window loop), ``executor.forward|backward``,
+``exec_group.forward|backward``, ``module.update``, histogram
+``io.prefetch_wait`` + counter ``io.batches``, ``kvstore.push|pull``
+spans + ``kvstore.push_bytes`` / ``kvstore.pull_bytes`` counters,
+gauge ``speedometer.samples_per_sec``, the ``xla.*`` compile/memory
+metrics, and — with MXTPU_COMPILE_CACHE set — ``xla.cache_hits`` /
+``xla.cache_saved_secs`` for compiles served from the persistent
+cache.
 """
 import atexit
 import logging

@@ -3,9 +3,13 @@
 Compile observability comes from jax.monitoring: XLA emits
 ``/jax/core/compile/backend_compile_duration`` once per backend
 compile, which feeds the ``xla.compiles`` counter, the accumulated
-``xla.compile_secs``, and a per-compile JSONL record. The listener is
-registered once per process and is a no-op while telemetry is off, so
-it can stay installed across test resets.
+``xla.compile_secs``, and a per-compile JSONL record. With the
+persistent compilation cache on (MXTPU_COMPILE_CACHE), the cache's
+``cache_hits`` / ``compile_time_saved_sec`` events feed
+``xla.cache_hits`` and ``xla.cache_saved_secs`` — how many compiles a
+warm start was served from disk, and the seconds it refunded. The
+listeners are registered once per process and are no-ops while
+telemetry is off, so they can stay installed across test resets.
 
 Retrace detection is framework-side: the sites that BUILD compiled
 programs (Executor construction, the fused-fit window builder) call
@@ -29,6 +33,10 @@ __all__ = ['install', 'note_retrace', 'note_step_flops', 'sample_memory',
            'device_peak_flops', 'mfu_estimate']
 
 _COMPILE_EVENT_SUFFIX = 'backend_compile_duration'
+# persistent-compilation-cache events (MXTPU_COMPILE_CACHE): a hit
+# means a compile request was served from disk instead of XLA
+_CACHE_HIT_EVENT = '/jax/compilation_cache/cache_hits'
+_CACHE_SAVED_SUFFIX = 'compile_time_saved_sec'
 
 # Peak dense bf16 FLOP/s per chip, by device_kind substring (bench.py's
 # table; CPU/unknown kinds yield 0.0 = "no MFU estimate").
@@ -57,6 +65,7 @@ def install():
         try:
             import jax.monitoring as _mon
             _mon.register_event_duration_secs_listener(_on_duration)
+            _mon.register_event_listener(_on_event)
             _installed = True
         except Exception as e:  # noqa: BLE001 — observability must not kill
             logging.debug('telemetry: jax.monitoring unavailable: %s', e)
@@ -72,6 +81,19 @@ def _on_duration(event, duration, **kwargs):
         if st.sink is not None:
             st.sink.emit({'type': 'compile', 't': time.time(),
                           'dur_s': round(float(duration), 4)})
+    elif event.endswith(_CACHE_SAVED_SUFFIX):
+        # compile seconds the persistent cache refunded this process
+        st.registry.counter('xla.cache_saved_secs').inc(float(duration))
+
+
+def _on_event(event, **kwargs):
+    st = _state()
+    if not st.active:
+        return
+    if event == _CACHE_HIT_EVENT:
+        st.registry.counter('xla.cache_hits').inc()
+        if st.sink is not None:
+            st.sink.emit({'type': 'cache_hit', 't': time.time()})
 
 
 def _retrace_threshold():

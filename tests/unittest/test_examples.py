@@ -86,6 +86,17 @@ CASES = [
 @pytest.mark.parametrize('script,args', CASES,
                          ids=[c[0].replace('/', '_') for c in CASES])
 def test_example_runs(script, args):
+    if script == 'parallel/train_5d_transformer.py':
+        from test_five_d import OLD_SHARD_MAP
+        if OLD_SHARD_MAP:
+            # known jax 0.4.x failure, not a regression: old shard_map's
+            # check_rep=False transpose mis-specs scalar cotangents
+            # through the GPipe pipeline gradient (see test_five_d's
+            # version-gated mark and CHANGES.md). xfail without paying
+            # the subprocess run; an upgraded jax runs it normally.
+            pytest.xfail('jax 0.4.x shard_map check_rep=False transpose '
+                         'bug in the 5-D pipeline gradient (needs newer '
+                         'jax)')
     env = dict(os.environ)
     env['XLA_FLAGS'] = '--xla_force_host_platform_device_count=8'
     env['JAX_PLATFORMS'] = 'cpu'
