@@ -151,6 +151,17 @@ flags.declare('MXTPU_TELEMETRY_RETRACE_WARN', int, 5,
               'Warn (once, loudly) when the same graph is compiled more '
               'than this many times — the retrace-storm detector',
               min_value=1)
+flags.declare('MXTPU_XPROF', str, '',
+              "One-shot step-windowed device-trace capture: 'start:stop' "
+              "(training-step counts) arms jax.profiler to start once "
+              "`start` steps have completed and stop at `stop`, writing "
+              'a TensorBoard/Perfetto trace to MXTPU_XPROF_DIR. The '
+              'fused fit path advances a whole window of steps per '
+              'device call, so boundaries quantize to window multiples '
+              'there. Honors the MXTPU_PROFILER_XLA_TRACE backend guard '
+              '(no capture against the tunneled axon chip). Empty = off')
+flags.declare('MXTPU_XPROF_DIR', str, 'xprof_trace',
+              'Output directory for the MXTPU_XPROF device trace')
 flags.declare('MXTPU_PROFILER_XLA_TRACE', str, 'auto',
               "Attach jax.profiler alongside the host-span trace when the "
               "profiler runs: '1' always, '0' never, 'auto' = only on "

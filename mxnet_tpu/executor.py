@@ -88,6 +88,11 @@ class _GraphProgram:
                                 for n in self.topo)
         self.op_nodes = [n for n in self.topo if not n.is_variable()]
         self.topo_index = {n: i for i, n in enumerate(self.topo)}
+        # per-node jax.named_scope names: device traces, HLO dumps and
+        # profiler output attribute ops to the SYMBOL's layer names
+        # instead of anonymous fusion.123 clusters
+        from .telemetry.programs import scope_name
+        self.scope_names = [scope_name(n.name) for n in self.topo]
 
     def make_runner(self):
         """Build run(arg_arrays, aux_arrays, key, is_train) ->
@@ -96,6 +101,8 @@ class _GraphProgram:
         arg_index = {n: i for i, n in enumerate(self.arg_names)}
         aux_index = {n: i for i, n in enumerate(self.aux_names)}
         outputs = self.outputs
+
+        scope_names = self.scope_names
 
         def run(arg_arrays, aux_arrays, key, is_train):
             env = {}
@@ -115,12 +122,17 @@ class _GraphProgram:
                 ins = [env[_entry_key(p, i)] for p, i in node.inputs]
                 if op.needs_rng:
                     ins.append(jax.random.fold_in(key, ni))
-                if op.host:
-                    # pure_callback bridge: host python at execution time,
-                    # traceable (and differentiable via legacy backward)
-                    outs = _reg.host_bridge(op, attrs)(*ins)
-                else:
-                    outs = op.fn(attrs, *ins)
+                # named_scope threads the symbol's layer name into the
+                # HLO metadata of everything this node lowers to —
+                # trace-time only, zero cost in the compiled program
+                with jax.named_scope(scope_names[ni]):
+                    if op.host:
+                        # pure_callback bridge: host python at execution
+                        # time, traceable (and differentiable via legacy
+                        # backward)
+                        outs = _reg.host_bridge(op, attrs)(*ins)
+                    else:
+                        outs = op.fn(attrs, *ins)
                 if not isinstance(outs, (tuple, list)):
                     outs = (outs,)
                 for i, o in enumerate(outs):
@@ -203,6 +215,18 @@ class Executor:
 
         from . import telemetry as _tele
         if _tele.enabled():
+            # cost attribution: route both compiles through the program
+            # registrar — an explicit lower().compile() whose executable
+            # yields XLA's cost/memory analysis (program.* gauges, the
+            # per-program summary table). fwd_bwd is THE train step of
+            # the per-batch loop, so its FLOPs feed the MFU estimate.
+            gname = _tele.programs.scope_name(
+                getattr(symbol, 'name', None) or 'graph')
+            self._fwd = _tele.programs.register(
+                'executor.fwd[%s]' % gname, self._fwd, static_argnums=(3,))
+            self._fwd_bwd = _tele.programs.register(
+                'executor.fwd_bwd[%s]' % gname, self._fwd_bwd,
+                step_flops=True)
             # retrace-storm detector: binding the same graph signature
             # repeatedly (rebind-per-batch, reshape loops) recompiles
             # the same XLA program each time
@@ -234,7 +258,13 @@ class Executor:
         """Reference executor.py:89 / GraphExecutor::Forward."""
         from . import telemetry as _tele
         with _tele.span('executor.forward', 'executor'):
-            return self._forward_impl(is_train, **kwargs)
+            try:
+                return self._forward_impl(is_train, **kwargs)
+            except Exception as e:
+                # RESOURCE_EXHAUSTED: dump the per-program memory
+                # breakdown before the crash surfaces (no-op otherwise)
+                _tele.programs.maybe_oom_report(e)
+                raise
 
     def _forward_impl(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -355,7 +385,11 @@ class Executor:
         """Reference GraphExecutor::Backward (graph_executor.cc:93)."""
         from . import telemetry as _tele
         with _tele.span('executor.backward', 'executor'):
-            return self._backward_impl(out_grads, is_train)
+            try:
+                return self._backward_impl(out_grads, is_train)
+            except Exception as e:
+                _tele.programs.maybe_oom_report(e)
+                raise
 
     def _backward_impl(self, out_grads=None, is_train=True):
         if self._use_staged():
@@ -472,7 +506,11 @@ class Executor:
                for p, i in node.inputs]
         if op.needs_rng:
             ins.append(rng_key())
-        outs = op.fn(attrs, *ins)
+        # same layer-name attribution as the jitted runner: profiler
+        # spans and any per-op jit cache entries carry the node name
+        with jax.named_scope(self._prog.scope_names[
+                self._prog.topo_index[node]]):
+            outs = op.fn(attrs, *ins)
         if not isinstance(outs, (tuple, list)):
             outs = (outs,)
         for i, o in enumerate(outs):

@@ -12,6 +12,7 @@ import numpy as np
 
 from .. import metric as metric_mod
 from .. import ndarray as nd
+from .. import profiler as _profiler
 from .. import telemetry as _tele
 from ..io import DataDesc
 from ..model import BatchEndParam
@@ -280,6 +281,8 @@ class BaseModule:
                         self.forward_backward(data_batch)
                         self.update()
                     _tele.counter('fit.steps').inc()
+                    # MXTPU_XPROF step-windowed device-trace capture
+                    _profiler.note_step()
                     try:
                         with _tele.span('fit.draw', 'fit'):
                             next_data_batch = next(data_iter)

@@ -54,7 +54,7 @@ from .. import random as _random
 from .. import telemetry as _tele
 from ..ndarray.ndarray import from_jax
 from .window_pipeline import (WindowPipeline, host_wrap, plan_metric,
-                              window_size)
+                              registered_jit, window_size)
 
 __all__ = ['FusedEvalLoop']
 
@@ -70,7 +70,7 @@ def _eval_window():
 class FusedEvalLoop:
     """One compiled W-step forward window driving score/predict."""
 
-    def __init__(self, module, children, stat_fns, window):
+    def __init__(self, module, children, stat_fns, window, kind='eval'):
         self.module = module
         self.children = children   # leaf metrics fed by in-graph stats
         self.stat_fns = stat_fns   # None => stacked-output mode
@@ -79,6 +79,12 @@ class FusedEvalLoop:
         e = module._exec_group.execs[0]
         self._exec = e
         self._run = e._run_eager
+        from ..telemetry.programs import scope_name
+        # score and predict build separate loop instances (separate
+        # cache slots) compiling different programs — give each its own
+        # registrar row so neither masks the other's cost/memory record
+        self._prog_name = 'fused_eval.%s[%s]' % (kind, scope_name(
+            getattr(module._symbol, 'name', None) or 'graph'))
         self._arg_names = list(e._prog.arg_names)
         self._aux_names = list(e._prog.aux_names)
         from .executor_group import SPMDExecutorGroup
@@ -188,7 +194,9 @@ class FusedEvalLoop:
                 int(np.prod(s)) for s in out_shapes if s)
             if est > _OUT_STACK_CAP:
                 return None
-        loop = FusedEvalLoop(module, children, fns, window)
+        loop = FusedEvalLoop(module, children, fns, window,
+                             kind='score' if eval_metric is not None
+                             else 'predict')
         logger.info('fused eval fast path active: %d steps/device-call%s',
                     window,
                     '' if fns is not None else ' (stacked-output mode)')
@@ -269,8 +277,10 @@ class FusedEvalLoop:
             return ys
 
         # no donation: eval mutates nothing — params/aux stay live for
-        # the next window and for the module's own per-batch paths
-        return jax.jit(window_fn), fixed_names
+        # the next window and for the module's own per-batch paths.
+        # registered_jit routes the compile through the telemetry
+        # program registrar (cost/memory analysis per program)
+        return registered_jit(self._prog_name, window_fn), fixed_names
 
     def _snapshot(self, fixed_names):
         """Current parameter/aux arrays in program order, mesh-
@@ -354,6 +364,11 @@ class FusedEvalLoop:
                 if pending is not None:
                     yield ('window',) + pending
                 pending = (pieces, win_snaps, labels_snap)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED in the upload/dispatch drive: dump the
+            # per-program memory breakdown (no-op otherwise)
+            _tele.programs.maybe_oom_report(e)
+            raise
         finally:
             # drain an in-flight prefetch before the cache teardown (or
             # an exception/close unwind) can race the side thread

@@ -54,13 +54,15 @@ import jax.numpy as jnp
 
 from .. import metric as metric_mod
 from .. import optimizer as opt_mod
+from .. import profiler as _profiler
 from .. import telemetry as _tele
 from ..optimizer import _as_clip
 from ..executor import mirror_wrap
 from ..kvstore import _updater_key
 from ..ndarray.ndarray import from_jax
 from ..ops import registry as _reg
-from .window_pipeline import WindowPipeline, host_wrap, window_size
+from .window_pipeline import (WindowPipeline, host_wrap, registered_jit,
+                              window_size)
 from .window_pipeline import plan_metric as _metric_plan
 
 __all__ = ['FusedFitLoop']
@@ -250,6 +252,10 @@ class FusedFitLoop:
         e = module._exec_group.execs[0]
         self._exec = e
         self._run = e._run_eager
+        # program-registrar name for this module's compiled windows
+        from ..telemetry.programs import scope_name
+        self._prog_name = 'fused_fit.window[%s]' % scope_name(
+            getattr(module._symbol, 'name', None) or 'graph')
         self._arg_names = list(e._prog.arg_names)
         self._aux_names = list(e._prog.aux_names)
         self._grad_names = list(e._grad_names)
@@ -583,7 +589,11 @@ class FusedFitLoop:
                  jnp.asarray(lr_arr), jnp.asarray(wd_arr)))
             return p, s, a, g, ys
 
-        return jax.jit(window_fn, donate_argnums=(0, 1, 2, 3))
+        # the train-step program of the fused path: its XLA cost
+        # analysis (scan body counted once = per-step FLOPs) feeds the
+        # framework-computed MFU through the registrar
+        return registered_jit(self._prog_name, window_fn,
+                              step_flops=True, donate_argnums=(0, 1, 2, 3))
 
     # -- per-epoch drive ---------------------------------------------------
     def _snapshot(self):
@@ -704,6 +714,12 @@ class FusedFitLoop:
             return self._run_epoch_inner(
                 train_data, eval_metric, epoch, batch_end_callback,
                 _DataBatch, apply_stats, host_nd)
+        except Exception as e:
+            # RESOURCE_EXHAUSTED anywhere in the window drive (upload,
+            # dispatch, stats fetch): dump the per-program memory
+            # breakdown before the crash surfaces (no-op otherwise)
+            _tele.programs.maybe_oom_report(e)
+            raise
         finally:
             if defer_switch is not None:
                 defer_switch(False)
@@ -813,6 +829,8 @@ class FusedFitLoop:
                     self._writeback(params, states, aux, gaccs)
                 _tele.counter('fit.steps').inc(self.window)
                 _tele.counter('fused_fit.windows').inc()
+                # MXTPU_XPROF step window (quantized to whole windows)
+                _profiler.note_step(self.window)
                 if _timing:
                     _now = _clk()
                     _tm['dispatch'] += _now - _t
@@ -858,6 +876,7 @@ class FusedFitLoop:
             m.forward_backward(sb)
             m.update()
             _tele.counter('fit.steps').inc()
+            _profiler.note_step()
             m.update_metric(eval_metric, sb.label)
             if batch_end_callback is not None:
                 p = BatchEndParam(epoch=epoch, nbatch=nbatch,

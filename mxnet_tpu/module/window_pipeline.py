@@ -39,7 +39,8 @@ from .. import metric as metric_mod
 from .. import telemetry as _tele
 from ..ndarray.ndarray import from_jax
 
-__all__ = ['WindowPipeline', 'window_size', 'plan_metric', 'host_wrap']
+__all__ = ['WindowPipeline', 'window_size', 'plan_metric', 'host_wrap',
+           'registered_jit']
 
 
 def window_size(flag='MXTPU_FIT_STEPS_PER_CALL'):
@@ -52,6 +53,18 @@ def window_size(flag='MXTPU_FIT_STEPS_PER_CALL'):
     if n > 0:
         return n
     return 32 if jax.default_backend() == 'tpu' else 4
+
+
+def registered_jit(name, fn, step_flops=False, **jit_kwargs):
+    """``jax.jit`` + telemetry program registration in one step — the
+    compile-site idiom both fused loops use. With telemetry on, the
+    returned callable compiles via an explicit ``lower().compile()``
+    and the executable's XLA cost/memory analysis lands in the
+    per-program table (telemetry.programs); ``step_flops=True`` marks
+    the program whose FLOPs define a training step (feeds the MFU
+    estimate). With telemetry off this is exactly ``jax.jit(fn)``."""
+    return _tele.programs.register(name, jax.jit(fn, **jit_kwargs),
+                                   step_flops=step_flops)
 
 
 def host_device():

@@ -18,7 +18,7 @@ import time
 import jax
 
 __all__ = ['profiler_set_config', 'profiler_set_state', 'dump_profile',
-           'Profiler']
+           'Profiler', 'note_step']
 
 _state = {'mode': 'symbolic', 'filename': 'profile.json', 'running': False,
           'events': [], 'jax_dir': None, 'ran': False, 'dumped': False}
@@ -62,6 +62,119 @@ def _atexit_dump():
             dump_profile()
         except Exception:
             pass
+
+
+# -- MXTPU_XPROF: step-windowed jax.profiler capture -------------------------
+#
+# MXTPU_XPROF=start:stop arms a one-shot device-trace capture over a
+# window of TRAINING STEPS: the trace starts once `start` steps have
+# completed and stops once `stop` have, landing a TensorBoard/Perfetto
+# trace in MXTPU_XPROF_DIR without bracketing code by hand — steady-state
+# windows (past warmup/compile) are exactly what a perf investigation
+# wants. The fit loops report progress via note_step(); the fused paths
+# advance a whole window at a time, so boundaries quantize to window
+# multiples there. The capture honors the same axon-backend guard as the
+# chrome-trace profiler (_xla_trace_allowed): a killed trace against the
+# tunneled chip wedges the claim for hours.
+
+_xprof = 'unset'   # 'unset' -> parsed lazily on first note_step; None = off
+
+
+def _xprof_parse():
+    from .config import flags
+    try:
+        raw = flags.get('MXTPU_XPROF')
+    except Exception:  # noqa: BLE001 — undeclared in stripped builds
+        raw = ''
+    if not raw:
+        return None
+    try:
+        a, b = raw.split(':', 1)
+        start, stop = int(a), int(b)
+        if start < 0 or stop <= start:
+            raise ValueError
+    except ValueError:
+        import logging
+        logging.warning("MXTPU_XPROF=%r ignored — expected 'start:stop' "
+                        'with stop > start >= 0', raw)
+        return None
+    try:
+        trace_dir = flags.get('MXTPU_XPROF_DIR')
+    except Exception:  # noqa: BLE001
+        trace_dir = ''
+    return {'start': start, 'stop': stop,
+            'dir': os.path.expanduser(trace_dir or 'xprof_trace'),
+            'steps': 0, 'on': False}
+
+
+def _xprof_atexit():
+    """Never leave a device trace running past interpreter teardown."""
+    w = _xprof
+    if isinstance(w, dict) and w['on']:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            pass
+        w['on'] = False
+
+
+def note_step(n=1):
+    """Advance the training-step count for the MXTPU_XPROF capture
+    window (the fit loops call this; n = steps completed by the call).
+    Free when the flag is unset: one global load + None check."""
+    global _xprof
+    w = _xprof
+    if w is None:
+        return
+    if w == 'unset':
+        w = _xprof = _xprof_parse()
+        if w is None:
+            return
+    w['steps'] += n
+    was_on = w['on']
+    if not w['on'] and w['steps'] >= w['start']:
+        import logging
+        if not _xla_trace_allowed():
+            logging.warning(
+                'MXTPU_XPROF: device trace suppressed on this backend '
+                '(MXTPU_PROFILER_XLA_TRACE guard) — no capture')
+            _xprof = None
+            return
+        try:
+            jax.profiler.start_trace(w['dir'])
+            w['on'] = True
+            atexit.register(_xprof_atexit)
+            logging.info('MXTPU_XPROF: device trace started at step %d '
+                         '-> %s', w['steps'], w['dir'])
+        except Exception as e:  # noqa: BLE001 — a capture failure must
+            logging.warning('MXTPU_XPROF: start_trace failed: %s', e)
+            _xprof = None       # not kill training
+            return
+    # stop only on a call AFTER the one that started the trace: when a
+    # fused window jumps past both boundaries at once, the capture
+    # still spans one full window instead of closing empty
+    if was_on and w['steps'] >= w['stop']:
+        import logging
+        try:
+            jax.profiler.stop_trace()
+            logging.info('MXTPU_XPROF: device trace stopped at step %d '
+                         '(window %d:%d) — open %s in TensorBoard/'
+                         'Perfetto', w['steps'], w['start'], w['stop'],
+                         w['dir'])
+        except Exception as e:  # noqa: BLE001
+            logging.warning('MXTPU_XPROF: stop_trace failed: %s', e)
+        w['on'] = False
+        _xprof = None           # one-shot: further steps cost one check
+
+
+def _xprof_reset_for_tests():
+    global _xprof
+    if isinstance(_xprof, dict) and _xprof['on']:
+        try:
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            pass
+    _xprof = 'unset'
 
 
 def profiler_set_config(mode='symbolic', filename='profile.json'):

@@ -12,8 +12,14 @@ instrumentation the hot paths report through:
   spans and engine op spans land on one timeline;
 - XLA gauges (:mod:`.xla`): compile count/seconds via jax.monitoring,
   retrace-storm detection, live/peak device bytes, an MFU estimate;
+- per-program cost attribution (:mod:`.programs`): every compile site
+  routes through a registrar that captures XLA's cost/memory analysis
+  per compiled program (``program.*`` gauges, a per-program summary
+  table, the automatic step-FLOPs feed behind the MFU gauge, and an
+  on-RESOURCE_EXHAUSTED memory-breakdown report);
 - exporters (:mod:`.export`): an append-only JSONL log plus an
-  end-of-run human-readable summary table.
+  end-of-run human-readable summary table
+  (``tools/telemetry_report.py`` renders the log offline).
 
 Everything is OFF by default. ``MXTPU_TELEMETRY=1`` turns it on;
 ``MXTPU_TELEMETRY_PATH`` points the JSONL log (default
@@ -47,10 +53,11 @@ import time
 from .registry import (Registry, NULL_COUNTER, NULL_GAUGE, NULL_HISTOGRAM)
 from . import export as _export
 from . import xla  # noqa: F401  (public submodule: telemetry.xla.*)
+from . import programs  # noqa: F401  (public submodule: telemetry.programs.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
-           'get_registry']
+           'programs', 'get_registry']
 
 
 class _State:
@@ -239,7 +246,9 @@ def snapshot():
 def summary():
     """The human-readable end-of-run table, as a string."""
     elapsed = (time.time() - _state.t_start) if _state.t_start else None
-    return _export.summary_table(_state.registry.snapshot(), elapsed)
+    return _export.summary_table(_state.registry.snapshot(), elapsed,
+                                 programs=programs.snapshot_programs()
+                                 or None)
 
 
 def write_summary(log=True):
@@ -253,13 +262,16 @@ def write_summary(log=True):
     if mfu is not None:
         _state.registry.gauge('xla.mfu').set(round(mfu, 4))
     snap = _state.registry.snapshot()
+    progs = programs.snapshot_programs()
     elapsed = time.time() - _state.t_start
     if _state.sink is not None:
-        _state.sink.emit({'type': 'summary',
-                          'elapsed_s': round(elapsed, 3),
-                          'snapshot': snap})
+        rec = {'type': 'summary', 'elapsed_s': round(elapsed, 3),
+               'snapshot': snap}
+        if progs:
+            rec['programs'] = progs
+        _state.sink.emit(rec)
         _state.sink.flush()
-    table = _export.summary_table(snap, elapsed)
+    table = _export.summary_table(snap, elapsed, programs=progs or None)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -298,3 +310,4 @@ def _reset_for_tests():
         except Exception:  # noqa: BLE001
             pass
     _state = _State()
+    programs._reset_for_tests()

@@ -2,8 +2,8 @@
 
 The JSONL sink is the machine-readable record a perf investigation
 greps after the fact: one JSON object per line, each with a ``type``
-('start', 'span', 'compile', 'retrace_storm', 'event', 'summary') and
-a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
+('start', 'span', 'compile', 'retrace_storm', 'event', 'program',
+'oom', 'summary') and a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
 ``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
 on a per-batch fsync.
 
@@ -77,13 +77,24 @@ def _fmt(v):
     return str(v)
 
 
-def summary_table(snapshot, elapsed_s=None):
-    """Registry snapshot -> aligned text table (one block per kind)."""
+def _mib(n):
+    return '%.1f' % (n / 2.0**20)
+
+
+def summary_table(snapshot, elapsed_s=None, programs=None):
+    """Registry snapshot -> aligned text table (one block per kind).
+    ``programs`` is telemetry.programs.snapshot_programs()'s {name:
+    record} — rendered as a per-program cost table (and the redundant
+    ``program.<name>.*`` gauges are elided from the gauges block)."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
     gauges = snapshot.get('gauges', {})
     hists = snapshot.get('histograms', {})
+    if programs:
+        # one row per compiled program already carries these values
+        gauges = {n: v for n, v in gauges.items()
+                  if not n.startswith('program.')}
     if counters:
         lines.append('-- counters --')
         w = max(len(n) for n in counters)
@@ -94,6 +105,22 @@ def summary_table(snapshot, elapsed_s=None):
         w = max(len(n) for n in gauges)
         for name in sorted(gauges):
             lines.append('  %-*s  %s' % (w, name, _fmt(gauges[name])))
+    if programs:
+        lines.append('-- programs --')
+        w = max(max(len(n) for n in programs), len('name'))
+        lines.append('  %-*s  %8s %10s %10s %10s %9s %9s %9s' %
+                     (w, 'name', 'compiles', 'calls', 'flops',
+                      'bytes_acc', 'temp_MiB', 'arg_MiB', 'out_MiB'))
+        for name in sorted(programs):
+            r = programs[name]
+            lines.append('  %-*s  %8s %10s %10s %10s %9s %9s %9s' %
+                         (w, name, _fmt(r.get('compiles', 0)),
+                          _fmt(r.get('dispatches', 0)),
+                          _fmt(float(r.get('flops', 0.0))),
+                          _fmt(float(r.get('bytes_accessed', 0.0))),
+                          _mib(r.get('temp_bytes', 0)),
+                          _mib(r.get('argument_bytes', 0)),
+                          _mib(r.get('output_bytes', 0))))
     if hists:
         lines.append('-- histograms (ms) --')
         w = max(len(n) for n in hists)

@@ -20,10 +20,13 @@ batch — the 49.8 img/s pathology of docs/perf.md) and logs one loud
 warning plus a ``retrace_storm`` JSONL record.
 
 Memory gauges read ``device.memory_stats()`` (live/peak bytes on TPU;
-None on CPU — sampled best-effort). The MFU estimate needs the step
-FLOPs, which only the caller knows (bench.py computes it from XLA cost
-analysis): :func:`note_step_flops` feeds it, and the summary divides
-observed step rate * FLOPs by the device's peak.
+None on CPU — sampled best-effort, with ONE process-wide warning the
+first time no device reports stats so empty gauges are explained). The
+MFU estimate needs the step FLOPs: the program registrar
+(:mod:`.programs`) feeds :func:`note_step_flops` automatically from
+whichever train-step program the fit loop compiles (bench.py feeds the
+same way through ``note_program``), and the summary divides observed
+step rate * FLOPs by the device's peak.
 """
 import logging
 import threading
@@ -134,15 +137,37 @@ def _short(key, limit=200):
 
 def note_step_flops(flops):
     """Record the per-training-step model FLOPs (enables the MFU
-    estimate; bench.py feeds XLA's own cost analysis here)."""
+    estimate). Fed automatically by telemetry.programs when a
+    step-marked program (executor fwd+bwd, fused fit window) compiles;
+    bench.py feeds XLA's own cost analysis the same way."""
     st = _state()
     if st.active and flops:
         st.registry.gauge('xla.step_flops').set(float(flops))
 
 
+_memory_stats_warned = False
+
+
+def _warn_memory_unavailable(reason):
+    """Once per process at WARNING (debug thereafter): a user on an
+    unsupported backend must learn WHY the memory gauges stay empty —
+    a forever-debug message buries the explanation."""
+    global _memory_stats_warned
+    if _memory_stats_warned:
+        logging.debug('telemetry: memory_stats still unavailable: %s',
+                      reason)
+        return
+    _memory_stats_warned = True
+    logging.warning(
+        'telemetry: device memory_stats() unavailable (%s) — the '
+        'xla.bytes_in_use / xla.peak_bytes_in_use gauges and the OOM '
+        'device totals stay empty on this backend', reason)
+
+
 def sample_memory(device=None):
     """Update live/peak device-byte gauges from ``memory_stats()``.
-    Best-effort: CPU backends return None and are skipped."""
+    Best-effort: CPU backends return None and are skipped (warned once
+    per process so empty gauges are explained)."""
     st = _state()
     if not st.active:
         return None
@@ -163,8 +188,11 @@ def sample_memory(device=None):
             if peak is not None:
                 st.registry.gauge('xla.peak_bytes_in_use').set(int(peak))
             return stats
+        _warn_memory_unavailable(
+            'no local device reports memory statistics — platform %r'
+            % (getattr(devices[0], 'platform', '?') if devices else '?'))
     except Exception as e:  # noqa: BLE001 — observability must not kill
-        logging.debug('telemetry: memory_stats unavailable: %s', e)
+        _warn_memory_unavailable(e)
     return None
 
 

@@ -127,3 +127,69 @@ def test_no_dump_without_run(tmp_path):
     the shared no-op."""
     from mxnet_tpu.profiler import maybe_span, _NULL_SPAN
     assert maybe_span('x') is _NULL_SPAN
+
+
+# ---------------------------------------------------------------------------
+# MXTPU_XPROF: step-windowed device-trace capture (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def xprof_env(tmp_path, monkeypatch):
+    """Arm an MXTPU_XPROF window into a tmp dir; disarmed afterwards."""
+    trace_dir = tmp_path / 'xprof'
+    monkeypatch.setenv('MXTPU_XPROF', '2:4')
+    monkeypatch.setenv('MXTPU_XPROF_DIR', str(trace_dir))
+    monkeypatch.setenv('MXTPU_PROFILER_XLA_TRACE', '1')
+    for f in ('MXTPU_XPROF', 'MXTPU_XPROF_DIR', 'MXTPU_PROFILER_XLA_TRACE'):
+        flags.reload(f)
+    profiler._xprof_reset_for_tests()
+    yield trace_dir
+    profiler._xprof_reset_for_tests()
+    for v in ('MXTPU_XPROF', 'MXTPU_XPROF_DIR', 'MXTPU_PROFILER_XLA_TRACE'):
+        monkeypatch.delenv(v, raising=False)
+        flags.reload(v)
+    profiler._xprof_reset_for_tests()
+
+
+def test_xprof_window_starts_and_stops(xprof_env):
+    """note_step crossings drive the one-shot jax.profiler window:
+    start once `start` steps complete, stop at `stop`, then disarm."""
+    import os
+    profiler.note_step()                       # 1 < start: idle
+    assert isinstance(profiler._xprof, dict)
+    assert not profiler._xprof['on']
+    profiler.note_step()                       # 2 >= start: tracing
+    assert profiler._xprof['on']
+    profiler.note_step(2)                      # 4 >= stop: done, disarmed
+    assert profiler._xprof is None
+    assert os.path.isdir(str(xprof_env))       # trace landed on disk
+    profiler.note_step()                       # disarmed: a cheap no-op
+
+
+def test_xprof_bad_spec_is_ignored(xprof_env, monkeypatch, caplog):
+    import logging
+    monkeypatch.setenv('MXTPU_XPROF', 'nonsense')
+    flags.reload('MXTPU_XPROF')
+    profiler._xprof_reset_for_tests()
+    with caplog.at_level(logging.WARNING):
+        profiler.note_step()
+    assert profiler._xprof is None             # parsed once, disarmed
+    assert any('MXTPU_XPROF' in r.getMessage() for r in caplog.records)
+
+
+def test_xprof_unset_is_free(monkeypatch):
+    monkeypatch.delenv('MXTPU_XPROF', raising=False)
+    flags.reload('MXTPU_XPROF')
+    profiler._xprof_reset_for_tests()
+    profiler.note_step()
+    assert profiler._xprof is None
+
+
+def test_xprof_window_spans_one_interval_when_jumped(xprof_env):
+    """A fused window advancing past BOTH boundaries in one note_step
+    must still capture one full inter-call interval, not start+stop
+    back-to-back into an empty trace."""
+    profiler.note_step(32)                     # crosses 2 AND 4 at once
+    assert isinstance(profiler._xprof, dict) and profiler._xprof['on']
+    profiler.note_step(32)                     # the NEXT call closes it
+    assert profiler._xprof is None

@@ -403,3 +403,225 @@ def test_summary_table_renders_empty():
     from mxnet_tpu.telemetry.export import summary_table
     out = summary_table({'counters': {}, 'gauges': {}, 'histograms': {}})
     assert 'no metrics recorded' in out
+
+
+# ---------------------------------------------------------------------------
+# per-program cost attribution (ISSUE 3)
+# ---------------------------------------------------------------------------
+
+def test_layer_names_in_compiled_hlo(tele_off):
+    """jax.named_scope threads symbol layer names into the compiled
+    program: HLO metadata attributes ops to fc1/fc2, not fusion.123.
+    Independent of MXTPU_TELEMETRY (scopes are trace-time metadata)."""
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.bind(data_shapes=[('data', (8, 10))],
+             label_shapes=[('softmax_label', (8,))])
+    mod.init_params()
+    ex = mod._exec_group.execs[0]
+    from mxnet_tpu import random as _random
+    arg_data = tuple(a._data for a in ex.arg_arrays)
+    aux_data = tuple(a._data for a in ex.aux_arrays)
+    compiled = ex._fwd.lower(arg_data, aux_data, _random.next_key(),
+                             False).compile()
+    txt = compiled.as_text()
+    for name in ('fc1', 'relu1', 'fc2'):
+        assert name in txt, '%s missing from compiled HLO' % name
+
+
+def test_fit_program_gauges_and_framework_mfu(tele_path, monkeypatch):
+    """Acceptance: a plain Module.fit (no bench.py) yields program.*
+    gauges, per-program FLOPs/bytes in the summary table, and a
+    framework-computed MFU (peak FLOPs faked — the CPU table has no
+    entry)."""
+    monkeypatch.setattr(telemetry.xla, 'device_peak_flops',
+                        lambda device=None: (1.0, 'faketpu'))
+    _mlp_fit(num_epoch=1)
+    snap = telemetry.snapshot()
+    prog_gauges = [n for n in snap['gauges'] if n.startswith('program.')]
+    assert prog_gauges, 'no program.* gauges after fit'
+    assert snap['gauges']['xla.step_flops'] > 0   # framework-fed, not bench
+    assert snap['counters']['program.compiles'] >= 1
+    progs = telemetry.programs.snapshot_programs()
+    assert any(n.startswith('fused_fit.window') for n in progs), progs
+    rec = next(r for n, r in progs.items()
+               if n.startswith('fused_fit.window'))
+    assert rec['flops'] > 0 and rec['bytes_accessed'] > 0
+    assert rec['compiles'] >= 1 and rec['dispatches'] >= 1
+    table = telemetry.write_summary(log=False)
+    assert '-- programs --' in table
+    assert 'fused_fit.window' in table
+    assert telemetry.get_registry().gauge('xla.mfu').value > 0
+    telemetry.shutdown()
+    recs = _records(tele_path)
+    assert any(r['type'] == 'program' and r.get('flops', 0) > 0
+               for r in recs)
+    summ = [r for r in recs if r['type'] == 'summary'][-1]
+    assert summ.get('programs'), 'summary record carries no programs'
+
+
+def test_fit_per_batch_loop_registers_executor_programs(tele_path,
+                                                        monkeypatch):
+    """The reference per-batch loop's executor programs (fwd_bwd) go
+    through the registrar too, and fwd_bwd feeds the step FLOPs."""
+    monkeypatch.setenv('MXTPU_FUSED_FIT', '0')
+    _mlp_fit(num_epoch=1)
+    progs = telemetry.programs.snapshot_programs()
+    assert any(n.startswith('executor.fwd_bwd[') for n in progs), progs
+    assert telemetry.snapshot()['gauges']['xla.step_flops'] > 0
+
+
+@pytest.mark.parametrize('tele_on', ['0', '1'])
+def test_fit_acceptance_on_off(tele_on, tmp_path, monkeypatch):
+    """The off-by-default contract, guarded in the SAME suite as the
+    on-path acceptance: with MXTPU_TELEMETRY=0 the new compile-site
+    hooks add no telemetry I/O and leave the registry empty; with =1
+    the per-program records and summary appear."""
+    path = tmp_path / 'onoff.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', tele_on)
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    _reload_tele_flags()
+    telemetry._reset_for_tests()
+    try:
+        io_before = tele_export._io_calls
+        _mlp_fit(num_epoch=1)
+        if tele_on == '0':
+            assert tele_export._io_calls == io_before
+            assert telemetry.get_registry().names() == []
+            assert telemetry.programs.snapshot_programs() == {}
+            assert not path.exists()
+        else:
+            telemetry.write_summary(log=False)
+            telemetry.shutdown()
+            recs = _records(path)
+            assert any(r['type'] == 'program' for r in recs)
+            summ = [r for r in recs if r['type'] == 'summary'][-1]
+            assert summ['snapshot']['counters']['fit.steps'] == 4
+            assert summ.get('programs')
+    finally:
+        telemetry._reset_for_tests()
+        monkeypatch.delenv('MXTPU_TELEMETRY', raising=False)
+        monkeypatch.delenv('MXTPU_TELEMETRY_PATH', raising=False)
+        _reload_tele_flags()
+
+
+def test_registered_program_numerics_match_lazy_jit(tele_path):
+    """The AOT interceptor dispatches the SAME computation the lazy jit
+    would have run (and falls back cleanly on a signature change)."""
+    import jax.numpy as jnp
+    import jax
+
+    def f(x, y):
+        return x * 2.0 + y
+
+    wrapped = telemetry.programs.register('test.prog', jax.jit(f))
+    a = jnp.arange(4.0)
+    out = wrapped(a, 1.0)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(a) * 2.0 + 1.0)
+    out2 = wrapped(a + 1, 1.0)        # same signature: cached executable
+    np.testing.assert_allclose(np.asarray(out2),
+                               (np.asarray(a) + 1) * 2.0 + 1.0)
+    # a varying traced python scalar must NOT key a fresh compile —
+    # jit specializes on its type, not its value
+    out_s = wrapped(a, 0.25)
+    np.testing.assert_allclose(np.asarray(out_s),
+                               np.asarray(a) * 2.0 + 0.25)
+    out3 = wrapped(jnp.arange(7.0), 2.0)   # new shape: second program
+    assert out3.shape == (7,)
+    progs = telemetry.programs.snapshot_programs()
+    assert progs['test.prog']['compiles'] == 2
+    assert progs['test.prog']['dispatches'] == 4
+
+
+def test_step_flops_keeps_max_across_recompiles(tele_path):
+    """A tail-batch shape variant compiling LAST must not shrink the
+    per-step FLOPs the whole run's MFU is computed from."""
+    full = {'flops': 1e9, 'bytes_accessed': 0.0, 'temp_bytes': 0,
+            'argument_bytes': 0, 'output_bytes': 0,
+            'generated_code_bytes': 0}
+    tail = dict(full, flops=1e8)
+    telemetry.programs.note_program('step_prog', analysis=full,
+                                    step_flops=True)
+    telemetry.programs.note_program('step_prog', analysis=tail,
+                                    step_flops=True)
+    assert telemetry.get_registry().gauge('xla.step_flops').value == 1e9
+    # ... and the guard is GLOBAL: the tail's executor.fwd_bwd (a
+    # different, smaller step program compiling after the fused window)
+    # must not shrink it either
+    telemetry.programs.note_program('other_step_prog', analysis=tail,
+                                    step_flops=True)
+    assert telemetry.get_registry().gauge('xla.step_flops').value == 1e9
+    # per-name records keep the largest variant per field, not the last
+    rec = telemetry.programs.snapshot_programs()['step_prog']
+    assert rec['flops'] == 1e9 and rec['compiles'] == 2
+
+
+def test_memory_stats_unavailable_warns_once(tele_path, caplog,
+                                             monkeypatch):
+    """An unsupported backend must WARN (once per process), not bury
+    the explanation at debug forever."""
+    monkeypatch.setattr(telemetry.xla, '_memory_stats_warned', False)
+
+    class _Dev:
+        platform = 'fake'
+
+        def memory_stats(self):
+            raise RuntimeError('memory_stats unimplemented')
+
+    with caplog.at_level(logging.WARNING):
+        assert telemetry.xla.sample_memory(_Dev()) is None
+        assert telemetry.xla.sample_memory(_Dev()) is None
+    warns = [r for r in caplog.records
+             if 'memory_stats() unavailable' in r.getMessage()]
+    assert len(warns) == 1
+
+
+def test_oom_report(tele_path, caplog):
+    """RESOURCE_EXHAUSTED yields a per-program memory breakdown (log +
+    JSONL 'oom' record), once per process; other errors don't."""
+    analysis = {'flops': 1e9, 'bytes_accessed': 2e9, 'temp_bytes': 1 << 30,
+                'argument_bytes': 1 << 28, 'output_bytes': 1 << 20,
+                'generated_code_bytes': 0}
+    telemetry.programs.note_program('p1', analysis=analysis)
+    assert not telemetry.programs.maybe_oom_report(
+        RuntimeError('some unrelated failure'))
+    with caplog.at_level(logging.ERROR):
+        assert telemetry.programs.maybe_oom_report(RuntimeError(
+            'RESOURCE_EXHAUSTED: Out of memory while trying to allocate '
+            '1073741824 bytes'))
+    msgs = [r.getMessage() for r in caplog.records
+            if 'per-program memory breakdown' in r.getMessage()]
+    assert len(msgs) == 1 and 'p1' in msgs[0]
+    # second report is suppressed (crash-loops must not spam)
+    with caplog.at_level(logging.ERROR):
+        assert telemetry.programs.maybe_oom_report(
+            RuntimeError('RESOURCE_EXHAUSTED: again'))
+    assert len([r for r in caplog.records
+                if 'per-program memory breakdown' in r.getMessage()]) == 1
+    telemetry.shutdown()
+    recs = _records(tele_path)
+    ooms = [r for r in recs if r['type'] == 'oom']
+    assert len(ooms) == 1 and 'p1' in ooms[0]['programs']
+
+
+def test_report_cli_matches_live_summary(tele_path):
+    """tools/telemetry_report renders the JSONL into the same table the
+    live run logged (same renderer — offline traces read identically)."""
+    import sys
+    _mlp_fit(num_epoch=1)
+    table = telemetry.write_summary(log=False)
+    telemetry.shutdown()
+    tools_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), 'tools')
+    if tools_dir not in sys.path:
+        sys.path.insert(0, tools_dir)
+    import telemetry_report
+    out = telemetry_report.render(telemetry_report.load(str(tele_path)))
+    # identical modulo the header's elapsed (rounded for the JSONL)
+    assert out.splitlines()[1:] == table.splitlines()[1:]
+    assert '-- programs --' in out

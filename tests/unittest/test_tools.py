@@ -16,6 +16,83 @@ REPO = os.path.dirname(os.path.dirname(
 sys.path.insert(0, os.path.join(REPO, 'tools'))
 
 
+def test_telemetry_report_golden(tmp_path, capsys):
+    """tools/telemetry_report renders a fixed JSONL byte-for-byte (the
+    offline twin of the live end-of-run summary table)."""
+    import json
+    import telemetry_report
+    recs = [
+        {'type': 'start', 'pid': 1, 't': 100.0},
+        {'type': 'span', 'name': 'fit.batch', 'path': 'fit.batch',
+         't': 100.1, 'dur_ms': 2.0},
+        {'type': 'summary', 't': 101.5, 'elapsed_s': 1.5,
+         'snapshot': {
+             'counters': {'fit.steps': 8},
+             'gauges': {'xla.mfu': 0.125, 'program.p.flops': 1000.0},
+             'histograms': {'fit.batch': {
+                 'count': 1, 'sum': 2.0, 'mean': 2.0, 'min': 2.0,
+                 'max': 2.0, 'p50': 2.0, 'p95': 2.0}}},
+         'programs': {'p': {
+             'name': 'p', 'compiles': 1, 'dispatches': 2,
+             'flops': 1000.0, 'bytes_accessed': 2048.0,
+             'temp_bytes': 1048576, 'argument_bytes': 2097152,
+             'output_bytes': 524288, 'generated_code_bytes': 0}}},
+    ]
+    path = tmp_path / 'tele.jsonl'
+    with open(path, 'w') as f:
+        for r in recs:
+            f.write(json.dumps(r) + '\n')
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    golden = (
+        '== telemetry summary (1.5s) ==\n'
+        '-- counters --\n'
+        '  fit.steps  8\n'
+        '-- gauges --\n'
+        '  xla.mfu  0.125\n'
+        '-- programs --\n'
+        '  name  compiles      calls      flops  bytes_acc  temp_MiB'
+        '   arg_MiB   out_MiB\n'
+        '  p            1          2   1000.000   2048.000       1.0'
+        '       2.0       0.5\n'
+        '-- histograms (ms) --\n'
+        '  name          count       mean        p50        p95'
+        '        max\n'
+        '  fit.batch         1      2.000      2.000      2.000'
+        '      2.000\n')
+    assert out == golden
+    # the program.p.* gauge is folded into the table, not repeated
+    assert 'program.p.flops' not in out
+
+
+def test_telemetry_report_reconstructs_without_summary(tmp_path, capsys):
+    """A crashed run's log (no summary record) still renders: spans,
+    compiles, and program records are reconstructed best-effort."""
+    import json
+    import telemetry_report
+    recs = [
+        {'type': 'start', 'pid': 1, 't': 10.0},
+        {'type': 'span', 'name': 'fit.dispatch', 't': 10.1,
+         'dur_ms': 5.0},
+        {'type': 'span', 'name': 'fit.dispatch', 't': 10.2,
+         'dur_ms': 7.0},
+        {'type': 'compile', 't': 10.3, 'dur_s': 1.25},
+        {'type': 'program', 'name': 'executor.fwd_bwd[softmax]',
+         't': 10.4, 'flops': 5e6, 'bytes_accessed': 1e6,
+         'temp_bytes': 4096, 'argument_bytes': 8192, 'output_bytes': 16,
+         'generated_code_bytes': 0},
+    ]
+    path = tmp_path / 'crashed.jsonl'
+    with open(path, 'w') as f:
+        for r in recs:
+            f.write(json.dumps(r) + '\n')
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert 'xla.compiles' in out and 'fit.dispatch' in out
+    assert 'executor.fwd_bwd[softmax]' in out
+    assert 'no summary record found' in out
+
+
 def test_bandwidth_collectives_tiny():
     import bandwidth
     res = bandwidth.measure_collectives(sizes=[1024], iters=2)

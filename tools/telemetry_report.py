@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Render a telemetry JSONL log into the end-of-run summary table,
+offline.
+
+A trace captured on a remote/CI machine (MXTPU_TELEMETRY=1 writes
+MXTPU_TELEMETRY_PATH) can be read without re-running anything::
+
+    python tools/telemetry_report.py telemetry.jsonl
+
+Uses the SAME renderer as the live end-of-run summary
+(mxnet_tpu/telemetry/export.py::summary_table), so the offline table
+is byte-identical to what the run would have logged. When the log has
+a ``summary`` record (written by telemetry.write_summary / the atexit
+hook) its registry snapshot and per-program table render directly; a
+log from a crashed run (no summary record) is reconstructed
+best-effort from the individual span / compile / program records —
+counters that only live in the registry (fit.steps etc.) cannot be
+recovered that way and the table says so.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from mxnet_tpu.telemetry.export import summary_table  # noqa: E402
+
+
+def load(path):
+    """Parse a JSONL telemetry log (bad lines are skipped, counted)."""
+    records, bad = [], 0
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    if bad:
+        sys.stderr.write('telemetry_report: skipped %d unparseable '
+                         'line(s)\n' % bad)
+    return records
+
+
+def _percentile(sorted_vals, p):
+    """Nearest-rank, mirroring registry.Histogram.percentile."""
+    if not sorted_vals:
+        return None
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def _reconstruct(records):
+    """(snapshot, elapsed_s, programs) rebuilt from individual records
+    — the crashed-run path (no summary record was ever written)."""
+    spans = {}
+    counters = {}
+    programs = {}
+    times = [r['t'] for r in records if isinstance(r.get('t'), (int, float))]
+    for r in records:
+        typ = r.get('type')
+        if typ == 'span' and isinstance(r.get('dur_ms'), (int, float)):
+            spans.setdefault(r.get('name', '?'), []).append(r['dur_ms'])
+        elif typ == 'compile':
+            counters['xla.compiles'] = counters.get('xla.compiles', 0) + 1
+            counters['xla.compile_secs'] = round(
+                counters.get('xla.compile_secs', 0.0)
+                + float(r.get('dur_s', 0.0)), 4)
+        elif typ == 'cache_hit':
+            counters['xla.cache_hits'] = \
+                counters.get('xla.cache_hits', 0) + 1
+        elif typ == 'program':
+            name = r.get('name', '?')
+            rec = programs.setdefault(
+                name, {'name': name, 'compiles': 0, 'dispatches': 0})
+            rec['compiles'] += 1
+            for f in ('flops', 'bytes_accessed', 'temp_bytes',
+                      'argument_bytes', 'output_bytes',
+                      'generated_code_bytes'):
+                # largest variant per field — the live registrar's
+                # merge semantics (telemetry.programs.note_program)
+                rec[f] = max(rec.get(f, 0), r.get(f, 0))
+    hists = {}
+    for name, vals in spans.items():
+        vs = sorted(vals)
+        hists[name] = {'count': len(vs), 'sum': sum(vs),
+                       'mean': sum(vs) / len(vs), 'min': vs[0],
+                       'max': vs[-1], 'p50': _percentile(vs, 50),
+                       'p95': _percentile(vs, 95)}
+    snapshot = {'counters': counters, 'gauges': {}, 'histograms': hists}
+    elapsed = (max(times) - min(times)) if len(times) > 1 else None
+    return snapshot, elapsed, programs or None
+
+
+def render(records):
+    """The summary table for a parsed record list, as a string."""
+    summaries = [r for r in records if r.get('type') == 'summary']
+    if summaries:
+        s = summaries[-1]
+        return summary_table(s.get('snapshot') or {}, s.get('elapsed_s'),
+                             programs=s.get('programs'))
+    snapshot, elapsed, programs = _reconstruct(records)
+    table = summary_table(snapshot, elapsed, programs=programs)
+    return table + ('\n(no summary record found — reconstructed from '
+                    '%d individual records; registry-only counters and '
+                    'gauges are not recoverable)' % len(records))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Render a telemetry JSONL log (MXTPU_TELEMETRY_PATH) '
+                    'into the end-of-run summary table, offline.')
+    ap.add_argument('path', help='telemetry JSONL file to render')
+    args = ap.parse_args(argv)
+    records = load(args.path)
+    if not records:
+        sys.stderr.write('telemetry_report: %s holds no records\n'
+                         % args.path)
+        return 1
+    print(render(records))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
