@@ -151,6 +151,29 @@ flags.declare('MXTPU_TELEMETRY_RETRACE_WARN', int, 5,
               'Warn (once, loudly) when the same graph is compiled more '
               'than this many times — the retrace-storm detector',
               min_value=1)
+flags.declare('MXTPU_HEALTH', bool, False,
+              'Training-health sentinels (telemetry/health, requires '
+              'MXTPU_TELEMETRY=1): in-graph NaN/Inf detection with '
+              'exact-step attribution through the fused windows, a '
+              'first-bad-layer bisect, rolling-baseline spike detectors '
+              'over step time / loss / grad-norm, and a "Run health" '
+              'block in the telemetry summary. Off (or telemetry off) = '
+              'true no-op: the compiled programs are byte-identical')
+flags.declare('MXTPU_HEALTH_ACTION', str, 'warn',
+              "What a non-finite incident does: 'warn' logs it (rate-"
+              "limited), 'record' only appends the health JSONL record, "
+              "'raise' raises telemetry.health.TrainingHealthError with "
+              'the diagnostic (step, window step, first bad layer) '
+              'attached. Spike anomalies never raise',
+              choices={'warn', 'record', 'raise'})
+flags.declare('MXTPU_HEALTH_K', float, 8.0,
+              'Spike threshold for the health anomaly detectors: an '
+              'observation more than K robust deviations (MAD) from '
+              'the rolling median is an anomaly', min_value=1.0)
+flags.declare('MXTPU_HEALTH_WINDOW', int, 64,
+              'Trailing-window length (observations) backing the health '
+              "anomaly detectors' rolling median/MAD baseline",
+              min_value=4)
 flags.declare('MXTPU_XPROF', str, '',
               "One-shot step-windowed device-trace capture: 'start:stop' "
               "(training-step counts) arms jax.profiler to start once "

@@ -192,6 +192,16 @@ class Executor:
         self._fwd = jax.jit(functools.partial(run), static_argnums=(3,))
         grad_idx = tuple(self._prog.arg_names.index(n) for n in self._grad_names)
 
+        # training-health sentinels (telemetry/health): with
+        # MXTPU_HEALTH=1 (and telemetry on) the fused fwd+bwd program
+        # ALSO returns one packed stats vector — grad/param norms,
+        # update ratio, per-output finite flags — computed on device
+        # inside the same compiled step. Off: the trace is byte-
+        # identical to the plain form (asserted by test_health.py).
+        from .telemetry import health as _health
+        self._health_on = _health.enabled() and bool(self._grad_names)
+        health_on = self._health_on
+
         def fwd_bwd(arg_arrays, aux_arrays, key, head_grads):
             def f(wrt):
                 full = list(arg_arrays)
@@ -204,6 +214,9 @@ class Executor:
             (outs, new_aux), vjp = jax.vjp(mirror_wrap(f), wrt)
             zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
             (grads,) = vjp((head_grads, zero_aux))
+            if health_on:
+                hv = _health.step_stats(outs, grads=grads, params=wrt)
+                return outs, new_aux, grads, hv
             return outs, new_aux, grads
 
         self._fwd_bwd = jax.jit(fwd_bwd)
@@ -401,7 +414,13 @@ class Executor:
             aux_data = tuple(a._data for a in self.aux_arrays)
             key = _random.next_key()
         heads = self._head_grads(out_grads, arg_data, aux_data)
-        outs, new_aux, grads = self._fwd_bwd(arg_data, aux_data, key, heads)
+        hv = None
+        if self._health_on:
+            outs, new_aux, grads, hv = self._fwd_bwd(arg_data, aux_data,
+                                                     key, heads)
+        else:
+            outs, new_aux, grads = self._fwd_bwd(arg_data, aux_data, key,
+                                                 heads)
         self._write_aux(new_aux)
         if self._pending is not None:
             for h, o in zip(self._out_handles, outs):
@@ -411,6 +430,15 @@ class Executor:
         else:
             self.outputs_cached = [from_jax(o, self._ctx) for o in outs]
         self._assign_grads(grads)
+        if hv is not None:
+            # the sentinel check fetches the small stats vector — the
+            # per-batch loop's one added sync (it already synchronizes
+            # per batch for its metric). On a non-finite flag the
+            # offending batch is STILL loaded in arg_dict, so the
+            # first-bad-layer bisect replays it directly.
+            from .telemetry import health as _health
+            _health.note_step(hv, source='executor',
+                              bisect=self.first_nonfinite_node)
 
     def _head_grads(self, out_grads, arg_data, aux_data):
         if out_grads is None:
@@ -583,6 +611,46 @@ class Executor:
         (grads,) = vjp(heads)
         self.outputs_cached = [from_jax(o, self._ctx) for o in outs]
         self._assign_grads(grads)
+
+    def first_nonfinite_node(self, overrides=None, is_train=True):
+        """First-bad-layer bisect (telemetry/health): replay the graph
+        through the staged per-node path and return the first symbol
+        whose VALUE is non-finite, as ``(name, output_index)`` — or
+        None when everything is finite. Variables are checked too, so a
+        poisoned weight (or a NaN input batch) is named directly rather
+        than through the first op that touches it.
+
+        ``overrides`` maps variable names to jax arrays replacing the
+        executor's current values (the fused window loops pass the
+        offending batch's draw-time snapshot). Parameters are whatever
+        the executor holds NOW — for a window incident that is the
+        post-window state, which a mid-window NaN has usually poisoned;
+        the poisoned weight then IS the attribution. Once-per-incident
+        cost: one eager dispatch + host check per node."""
+        from .telemetry.health import has_nonfinite
+        prog = self._prog
+        env = {}
+        key = _random.next_key()
+        mon, self._monitor = self._monitor, None   # no monitor callbacks
+        try:                                       # during the replay
+            for node in prog.topo:
+                if node.is_variable():
+                    if overrides and node.name in overrides:
+                        env[_entry_key(node, 0)] = jax.device_put(
+                            overrides[node.name], self._node_device(node))
+                    else:
+                        self._env_put_variable(node, env)
+                    vals = (env[_entry_key(node, 0)],)
+                else:
+                    rng_key = functools.partial(jax.random.fold_in, key,
+                                                prog.topo_index[node])
+                    vals = self._exec_node(node, env, is_train, rng_key)
+                for i, v in enumerate(vals):
+                    if has_nonfinite(np.asarray(v)):
+                        return node.name, i
+        finally:
+            self._monitor = mon
+        return None
 
     # -- misc API ---------------------------------------------------------
     def set_monitor_callback(self, callback, monitor_all=False):

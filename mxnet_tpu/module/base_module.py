@@ -252,6 +252,11 @@ class BaseModule:
             from .fused_fit import FusedFitLoop
             fused = FusedFitLoop.build_cached(self, eval_metric,
                                               logger=self.logger)
+        # training-health sentinels (telemetry/health): the per-batch
+        # loop feeds the step-time spike detector; the in-graph
+        # finite/norm sentinels ride the executor's fwd+bwd program.
+        # One cached-bool check — zero overhead while off.
+        health_on = _tele.health.enabled()
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -273,6 +278,7 @@ class BaseModule:
                 data_batch = next_data_batch
                 if monitor is not None:
                     monitor.tic()
+                t_step = time.time() if health_on else 0.0
                 # per-batch telemetry: host-dispatch vs draw vs metric vs
                 # callback time (all no-ops unless MXTPU_TELEMETRY=1 or
                 # the chrome-trace profiler is running)
@@ -301,6 +307,8 @@ class BaseModule:
                         with _tele.span('fit.callback', 'fit'):
                             for callback in _as_list(batch_end_callback):
                                 callback(batch_end_params)
+                if health_on:
+                    _tele.health.note_step_time(time.time() - t_step)
                 nbatch += 1
 
             self._fit_epoch_end(epoch, eval_metric, tic, epoch_end_callback,

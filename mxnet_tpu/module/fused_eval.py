@@ -53,8 +53,9 @@ import jax.numpy as jnp
 from .. import random as _random
 from .. import telemetry as _tele
 from ..ndarray.ndarray import from_jax
-from .window_pipeline import (WindowPipeline, host_wrap, plan_metric,
-                              registered_jit, window_size)
+from .window_pipeline import (WindowPipeline, health_sentinel, host_wrap,
+                              plan_metric, registered_jit, window_bisect,
+                              window_size)
 
 __all__ = ['FusedEvalLoop']
 
@@ -94,6 +95,10 @@ class FusedEvalLoop:
                                     device_fn=lambda: e._ctx.jax_device(),
                                     mesh=self._mesh,
                                     span_prefix='fused_eval')
+        # training-health sentinels (per-output finite flags only — a
+        # forward window has no grads/updates); None = window traced
+        # byte-identical to the plain form
+        self._health_fn = health_sentinel()
 
     # -- reuse across score()/predict() calls ------------------------------
     def _rebind_metric(self, eval_metric):
@@ -128,7 +133,12 @@ class FusedEvalLoop:
                 from .fused_fit import FusedFitLoop
                 msig = FusedFitLoop._metric_sig(eval_metric)
             if msig is not None:
-                sig = (id(execs[0]), _eval_window(), msig)
+                # the health sentinels are traced INTO the window
+                # program — flipping MXTPU_HEALTH between calls must
+                # rebuild the loop
+                from ..telemetry import health as _health
+                sig = (id(execs[0]), _eval_window(), msig,
+                       bool(_health.enabled()))
         cache = module.__dict__.get('_fused_eval_cache')
         if sig is None:
             # unsignable (monitor/staged/multi-exec, or a metric whose
@@ -238,6 +248,7 @@ class FusedEvalLoop:
         fixed_names = [n for i, n in enumerate(self._arg_names)
                        if i not in io_pos]
         stat_fns = self.stat_fns
+        health_fn = self._health_fn
         W = self.window
 
         def window_fn(fixed, aux, data_stack, label_stack, key):
@@ -261,6 +272,10 @@ class FusedEvalLoop:
                     # stacked-output mode: scan stacks the per-step
                     # outputs into (W, ...) per output
                     ys = outs
+                if health_fn is not None:
+                    # per-step finite flags ride the scan ys — home in
+                    # the window's existing single fetch
+                    ys = (ys, health_fn(outs))
                 return carry, ys
 
             # XLA:CPU parallelizes poorly inside while-loop bodies: the
@@ -382,6 +397,20 @@ class FusedEvalLoop:
             # per-batch path on snapshot-rebuilt batches
             yield ('tail', self._rebuild_batch(snap), snap, None)
 
+    def _note_window_health(self, hrows, win_snaps, nbatch):
+        """Check a fetched (W, k) sentinel matrix (no-op when the
+        sentinels are off): exact-step attribution + the staged-path
+        bisect on the offending batch's snapshot, is_train=False."""
+        if hrows is None:
+            return
+        _tele.health.note_window(
+            hrows, source='fused_eval',
+            nbatch_base=nbatch, has_grads=False,
+            bisect=window_bisect(self._exec,
+                                 list(self.module._data_names),
+                                 list(self.module._label_names),
+                                 win_snaps, False))
+
     # -- score -------------------------------------------------------------
     def run_score(self, eval_data, eval_metric, num_batch,
                   batch_end_callback, epoch):
@@ -418,6 +447,9 @@ class FusedEvalLoop:
             # per-batch metric application + callbacks (the fit loop's
             # deferred-apply shape)
             pieces = a
+            hmat = None
+            if self._health_fn is not None:
+                pieces, hrows = pieces
             with _tele.span('fused_eval.fetch', 'fused_eval'):
                 if self.stat_fns is not None:
                     host = np.asarray(pieces)      # (W, 2 * n_metrics)
@@ -425,6 +457,9 @@ class FusedEvalLoop:
                 else:
                     outs_host = [np.asarray(o) for o in pieces]  # (W, ...)
                     steps = outs_host[0].shape[0]
+                if self._health_fn is not None:
+                    hmat = np.asarray(hrows)
+            self._note_window_health(hmat, b, nbatch)
             for i in range(steps):
                 if self.stat_fns is not None:
                     for j, child in enumerate(self.children):
@@ -469,10 +504,16 @@ class FusedEvalLoop:
                 nbatch += 1
                 continue
             pieces, win_snaps = a, b
+            hmat = None
+            if self._health_fn is not None:
+                pieces, hrows = pieces
             # one host fetch for the window's stacked outputs, then
             # per-batch pad trim + wrap
             with _tele.span('fused_eval.fetch', 'fused_eval'):
                 outs_host = [np.asarray(o) for o in pieces]   # (W, ...)
+                if self._health_fn is not None:
+                    hmat = np.asarray(hrows)
+            self._note_window_health(hmat, win_snaps, nbatch)
             for i, snap in enumerate(win_snaps):
                 pad = snap[2] or 0
                 outputs = [host_nd(o[i][0:o[i].shape[0] - pad])

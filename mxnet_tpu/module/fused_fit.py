@@ -61,8 +61,8 @@ from ..executor import mirror_wrap
 from ..kvstore import _updater_key
 from ..ndarray.ndarray import from_jax
 from ..ops import registry as _reg
-from .window_pipeline import (WindowPipeline, host_wrap, registered_jit,
-                              window_size)
+from .window_pipeline import (WindowPipeline, health_sentinel, host_wrap,
+                              registered_jit, window_bisect, window_size)
 from .window_pipeline import plan_metric as _metric_plan
 
 __all__ = ['FusedFitLoop']
@@ -276,6 +276,10 @@ class FusedFitLoop:
                                     device_fn=lambda: e._ctx.jax_device(),
                                     mesh=self._mesh,
                                     span_prefix='fused_fit')
+        # training-health sentinels: captured at loop build (build_cached
+        # keys reuse on the flag) — None keeps the traced window
+        # byte-identical to the plain form
+        self._health_fn = health_sentinel()
         # the key each param updates under must match the unfused path:
         # update_on_kvstore pushes by NAME (kvstore._updater keys);
         # the local updater uses integer position (model._update_params)
@@ -342,7 +346,11 @@ class FusedFitLoop:
                        bool(module._update_on_kvstore),
                        getattr(module._kvstore, 'type', None),
                        _window_size(), bool(_shard_update_enabled()),
-                       str(_mirror_flag()), msig)
+                       str(_mirror_flag()), msig,
+                       # the health sentinels are traced INTO the window
+                       # program — flipping MXTPU_HEALTH between fit()
+                       # calls must rebuild the loop
+                       bool(_tele.health.enabled()))
         cached = module.__dict__.get('_fused_fit_cache')
         if cached is not None and sig is not None and cached[0] == sig:
             loop = cached[1]
@@ -481,6 +489,7 @@ class FusedFitLoop:
         modes = {n: self._mode(n) for n in grad_names}
         ops = {mode: _reg.get(mode) for mode in set(modes.values())}
         stat_fns = self.stat_fns
+        health_fn = self._health_fn
         accum = self._accum
         W = self.window
         mesh = self._mesh
@@ -580,6 +589,16 @@ class FusedFitLoop:
                     # host-fallback metric: ship the raw outputs; scan
                     # stacks them into (W, ...) per output
                     ys = outs
+                if health_fn is not None:
+                    # per-step sentinel vector rides the scan ys — the
+                    # (W, k) stack comes home in the window's existing
+                    # fetch, so a mid-window NaN keeps its step index
+                    hv = health_fn(
+                        outs, grads=grads,
+                        params=tuple(params[i] for i in grad_carry_idx),
+                        new_params=tuple(new_params[i]
+                                         for i in grad_carry_idx))
+                    ys = (ys, hv)
                 return (tuple(new_params), tuple(new_states), new_aux,
                         gaccs), ys
 
@@ -643,7 +662,15 @@ class FusedFitLoop:
         # metric's .asnumpy() calls cost no device round-trip
         host_nd = host_wrap(self._exec._ctx)
 
-        def apply_stats(pieces, labels_w, nbatch):
+        # health sentinels: which metric children carry a per-batch
+        # loss (CrossEntropy sufficient statistics feed the rolling
+        # loss-spike detector for free)
+        ce_idx = [j for j, c in enumerate(self.children or ())
+                  if type(c) is metric_mod.CrossEntropy] \
+            if self._health_fn is not None and self.stat_fns is not None \
+            else []
+
+        def apply_stats(pieces, labels_w, nbatch, win_snaps=None):
             """One host fetch for the window's results, then exact
             per-batch metric application + callbacks. Stats mode feeds
             the packed sufficient-statistic sums into the metric
@@ -651,20 +678,41 @@ class FusedFitLoop:
             each step's outputs against the window's own labels
             (snapshotted at collection time — see below), the way the
             reference loop's update_metric would."""
+            hrows = None
+            if self._health_fn is not None:
+                pieces, hrows = pieces
             with _tele.span('fused_fit.fetch', 'fused_fit'):
                 # the window's one device->host fetch (full RTT on a
-                # tunneled runtime; everything after is host math)
+                # tunneled runtime; everything after is host math) —
+                # the (W, k) sentinel matrix rides the same fetch
                 if self.stat_fns is not None:
                     host = np.asarray(pieces)      # (W, 2 * n_metrics)
                     steps = host.shape[0]
                 else:
                     outs_host = [np.asarray(o) for o in pieces]  # (W, ...)
                     steps = outs_host[0].shape[0]
+                if hrows is not None:
+                    hmat = np.asarray(hrows)
+            if hrows is not None:
+                # mid-window NaN -> exact step attribution + (first
+                # incident) staged-path first-bad-layer bisect on the
+                # offending batch's draw-time snapshot. raise action
+                # surfaces here, before the metric sees garbage.
+                _tele.health.note_window(
+                    hmat, source='fused_fit', nbatch_base=nbatch,
+                    bisect=window_bisect(
+                        self._exec, list(self.module._data_names),
+                        list(self.module._label_names), win_snaps, True,
+                        defer_fn=self._defer_eager)
+                    if win_snaps is not None else None)
             for i in range(steps):
                 if self.stat_fns is not None:
                     for j, child in enumerate(self.children):
                         child.sum_metric += float(host[i, 2 * j])
                         child.num_inst += int(host[i, 2 * j + 1])
+                    for j in ce_idx:
+                        _tele.health.note_loss(
+                            host[i, 2 * j] / max(host[i, 2 * j + 1], 1.0))
                 else:
                     preds = [host_nd(o[i]) for o in outs_host]
                     eval_metric.update(labels_w[i], preds)
@@ -771,6 +819,8 @@ class FusedFitLoop:
             # k-1's stats fetch waits
             return pipe.start_put(win_snaps, pool)
 
+        health_on = self._health_fn is not None
+        _t_win = _clk()   # wall clock per dispatched window (health)
         batches, snaps = collect()
         if not batches:
             # exhausted before the FIRST batch: the reference loop's
@@ -841,12 +891,22 @@ class FusedFitLoop:
                 # both the transfer and the fetch RTT disappear behind
                 # device time (callbacks run one window late; values
                 # and cadence are unchanged)
+                win_snaps = snaps if health_on else None
                 batches, snaps = collect()
                 fut = start_put(snaps) \
                     if len(batches) == self.window else None
                 if pending is not None:
-                    nbatch = apply_stats(pending[0], pending[1], nbatch)
-                pending = (pieces, labels_snap)
+                    nbatch = apply_stats(pending[0], pending[1], nbatch,
+                                         pending[2])
+                pending = (pieces, labels_snap, win_snaps)
+                if health_on:
+                    # one step-time observation per window (wall / W):
+                    # in steady state the loop is device-bound, so the
+                    # iteration wall IS the per-step time
+                    _now = _clk()
+                    _tele.health.note_step_time(_now - _t_win,
+                                                steps=self.window)
+                    _t_win = _now
                 if _timing:
                     _tm['fetch'] += _clk() - _t
         finally:
@@ -856,7 +916,8 @@ class FusedFitLoop:
                 WindowPipeline.drain(fut)
         _t = _clk() if _timing else 0.0
         if pending is not None:
-            nbatch = apply_stats(pending[0], pending[1], nbatch)
+            nbatch = apply_stats(pending[0], pending[1], nbatch,
+                                 pending[2])
         if _timing:
             _tm['fetch'] += _clk() - _t
         for ds, ls, pad, idx in snaps:

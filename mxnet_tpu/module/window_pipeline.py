@@ -40,7 +40,7 @@ from .. import telemetry as _tele
 from ..ndarray.ndarray import from_jax
 
 __all__ = ['WindowPipeline', 'window_size', 'plan_metric', 'host_wrap',
-           'registered_jit']
+           'registered_jit', 'health_sentinel', 'window_bisect']
 
 
 def window_size(flag='MXTPU_FIT_STEPS_PER_CALL'):
@@ -65,6 +65,36 @@ def registered_jit(name, fn, step_flops=False, **jit_kwargs):
     estimate). With telemetry off this is exactly ``jax.jit(fn)``."""
     return _tele.programs.register(name, jax.jit(fn, **jit_kwargs),
                                    step_flops=step_flops)
+
+
+def health_sentinel():
+    """The in-graph training-health stats fn for a compiled window body
+    (telemetry/health: grad/param norms, update ratio, per-output
+    finite flags packed into one f32 vector per step, stacked by the
+    scan so a mid-window NaN carries its exact step index through the
+    window's single host fetch) — or None while the sentinels are off,
+    leaving the traced window byte-identical to today's program."""
+    from ..telemetry import health as _health
+    return _health.step_stats if _health.enabled() else None
+
+
+def window_bisect(executor, data_names, label_names, snaps, is_train,
+                  defer_fn=None):
+    """First-bad-layer driver for a fused-window incident: returns
+    ``bisect(i)`` replaying window step ``i``'s draw-time snapshot
+    through the staged per-node executor path
+    (:meth:`~mxnet_tpu.executor.Executor.first_nonfinite_node`).
+    ``defer_fn`` materializes a deferred uint8 batch (fused fit's
+    device-augment mode) so the replay sees the graph's real input."""
+    def bisect(i):
+        ds, ls, _, _ = snaps[i]
+        if defer_fn is not None:
+            from .. import random as _random
+            ds = (defer_fn(ds[0], _random.next_key()),) + tuple(ds[1:])
+        overrides = dict(zip(data_names, ds))
+        overrides.update(zip(label_names, ls))
+        return executor.first_nonfinite_node(overrides, is_train=is_train)
+    return bisect
 
 
 def host_device():

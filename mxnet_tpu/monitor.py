@@ -3,6 +3,14 @@
 Reference: python/mxnet/monitor.py:143 (regex-selected per-op stats via
 the executor monitor callback; tic arms a window every ``interval``
 steps, toc drains it plus the matching weight arrays).
+
+``stat_func`` may be ONE callable or a LIST of callables. With a list,
+every matched array is fetched from the device ONCE per callback —
+``stat_helper`` pulls the value to the host and hands all stat funcs
+the same host-resident NDArray, so N stat funcs cost one fetch instead
+of N device syncs. A single stat func keeps the legacy device-resident
+form (the default RMS is a device reduction + scalar fetch — cheaper
+than shipping a large tensor to the host for one scalar).
 """
 import logging
 import re
@@ -23,8 +31,19 @@ def _rms_stat(x):
     return str((x.norm() / sqrt(x.size)).asscalar())
 
 
+def _host_fetch(array):
+    """One device->host fetch, rewrapped as a host-resident NDArray so
+    stat funcs keep the NDArray API (norm/asscalar/asnumpy) without
+    touching the accelerator again."""
+    from .ndarray.ndarray import array as _nd_array
+    try:
+        return _nd_array(array.asnumpy())
+    except Exception:  # noqa: BLE001 — exotic dtype: stat on the original
+        return array
+
+
 class Monitor:
-    """Collects a statistic for every executor output whose name matches
+    """Collects statistics for every executor output whose name matches
     ``pattern``, on every ``interval``-th step between tic() and toc().
 
     install() hooks an Executor's monitor callback; Module.fit calls
@@ -33,7 +52,14 @@ class Monitor:
 
     def __init__(self, interval, stat_func=None, pattern='.*', sort=False):
         self.interval = interval
-        self.stat_func = stat_func or _rms_stat
+        if stat_func is None:
+            stat_funcs = [_rms_stat]
+        elif callable(stat_func):
+            stat_funcs = [stat_func]
+        else:
+            stat_funcs = list(stat_func)
+        self.stat_func = stat_funcs[0]       # back-compat attribute
+        self.stat_funcs = stat_funcs
         self.sort = sort
         self.re_prog = re.compile(pattern)
         self.step = 0
@@ -44,11 +70,46 @@ class Monitor:
         monitor = self
 
         def stat_helper(name, array):
-            # invoked by the executor for every op output while armed
+            # invoked by the executor for every op output while armed;
+            # ONE host fetch per array, shared by every stat func
             if monitor.activated and monitor.re_prog.match(name):
-                monitor.queue.append(
-                    _Record(monitor.step, name, monitor.stat_func(array)))
+                monitor._collect(name, array)
         self.stat_helper = stat_helper
+
+    def _funcs(self):
+        # reference-MXNet pattern: `mon.stat_func = my_fn` AFTER
+        # construction must keep working — a mutated stat_func wins
+        # over the list frozen at __init__
+        if callable(self.stat_func) and self.stat_func \
+                is not self.stat_funcs[0]:
+            return [self.stat_func]
+        return self.stat_funcs
+
+    def _collect(self, name, array):
+        funcs = self._funcs()
+        # the shared host fetch only pays for itself when SEVERAL stat
+        # funcs would otherwise each sync the device; a single func
+        # (the default RMS: one device reduction + a scalar fetch)
+        # keeps the device-side form — shipping a monitored 100MB
+        # embedding to the host to compute one scalar would regress it
+        host = _host_fetch(array) if len(funcs) > 1 else array
+        for fn in funcs:
+            self.queue.append(_Record(self.step, name, fn(host)))
+
+    @classmethod
+    def nan_watch(cls, interval=1, pattern='.*'):
+        """Preset: flag NaN/Inf in every matched tensor — the staged-
+        path (per-op, monitor-callback) twin of the in-graph finite
+        sentinels, built on the same host finite check
+        (telemetry.health.finite_report). Rows read 'ok' or
+        'nan=<n> inf=<n> of <size>'; weights are checked at toc() too.
+
+        Use when the compiled-path sentinels flagged an incident and
+        you want per-op visibility without a full bisect, or on a
+        module the fused paths cannot take."""
+        from .telemetry.health import finite_report
+        return cls(interval, stat_func=lambda x: finite_report(x.asnumpy()),
+                   pattern=pattern)
 
     def install(self, exe):
         """Register with an executor; may be called for many executors."""
@@ -69,16 +130,16 @@ class Monitor:
         self.step += 1
 
     def toc(self):
-        """Close the window: also sample matching weight arrays, then
-        return [(step, name, tab-joined stat string), ...]."""
+        """Close the window: also sample matching weight arrays (one
+        fetch each, shared across stat funcs), then return
+        [(step, name, tab-joined stat string), ...]."""
         if not self.activated:
             return []
         self._barrier()
         for exe in self.exes:
             for name, array in exe.arg_dict.items():
                 if self.re_prog.match(name):
-                    self.queue.append(
-                        _Record(self.step, name, self.stat_func(array)))
+                    self._collect(name, array)
         self.activated = False
         pending = sorted(self.queue, key=lambda r: r.name) if self.sort \
             else self.queue

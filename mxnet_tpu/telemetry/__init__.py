@@ -17,6 +17,12 @@ instrumentation the hot paths report through:
   per compiled program (``program.*`` gauges, a per-program summary
   table, the automatic step-FLOPs feed behind the MFU gauge, and an
   on-RESOURCE_EXHAUSTED memory-breakdown report);
+- training-health sentinels (:mod:`.health`, MXTPU_HEALTH=1): in-graph
+  NaN/Inf detection with exact-step attribution through the fused
+  windows, a first-bad-layer bisect, rolling-baseline anomaly detectors
+  over step time / loss / grad-norm, an input-bound classifier, and a
+  "Run health" block in the end-of-run summary (``health`` /
+  ``anomaly`` JSONL records, ``MXTPU_HEALTH_ACTION={warn,record,raise}``);
 - exporters (:mod:`.export`): an append-only JSONL log plus an
   end-of-run human-readable summary table
   (``tools/telemetry_report.py`` renders the log offline).
@@ -54,10 +60,11 @@ from .registry import (Registry, NULL_COUNTER, NULL_GAUGE, NULL_HISTOGRAM)
 from . import export as _export
 from . import xla  # noqa: F401  (public submodule: telemetry.xla.*)
 from . import programs  # noqa: F401  (public submodule: telemetry.programs.*)
+from . import health  # noqa: F401  (public submodule: telemetry.health.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
-           'programs', 'get_registry']
+           'programs', 'health', 'get_registry']
 
 
 class _State:
@@ -244,11 +251,16 @@ def snapshot():
 
 
 def summary():
-    """The human-readable end-of-run table, as a string."""
+    """The human-readable end-of-run table, as a string. Renders the
+    same Run health block write_summary() does — including the
+    input-bound share — but read-only: no gauges are written, no
+    classifier record is emitted."""
     elapsed = (time.time() - _state.t_start) if _state.t_start else None
     return _export.summary_table(_state.registry.snapshot(), elapsed,
                                  programs=programs.snapshot_programs()
-                                 or None)
+                                 or None,
+                                 health=health.snapshot_health(
+                                     input_bound=health.input_bound_pct()))
 
 
 def write_summary(log=True):
@@ -261,6 +273,10 @@ def write_summary(log=True):
     mfu = xla.mfu_estimate()
     if mfu is not None:
         _state.registry.gauge('xla.mfu').set(round(mfu, 4))
+    # run-health roll-up: publishes the derived fit.input_bound_pct
+    # gauge and (with MXTPU_HEALTH=1) returns the "Run health" block's
+    # input + the summary record's 'health' key
+    hsnap = health.summarize()
     snap = _state.registry.snapshot()
     progs = programs.snapshot_programs()
     elapsed = time.time() - _state.t_start
@@ -269,9 +285,12 @@ def write_summary(log=True):
                'snapshot': snap}
         if progs:
             rec['programs'] = progs
+        if hsnap:
+            rec['health'] = hsnap
         _state.sink.emit(rec)
         _state.sink.flush()
-    table = _export.summary_table(snap, elapsed, programs=progs or None)
+    table = _export.summary_table(snap, elapsed, programs=progs or None,
+                                  health=hsnap)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -311,3 +330,4 @@ def _reset_for_tests():
             pass
     _state = _State()
     programs._reset_for_tests()
+    health._reset_for_tests()

@@ -3,7 +3,7 @@
 The JSONL sink is the machine-readable record a perf investigation
 greps after the fact: one JSON object per line, each with a ``type``
 ('start', 'span', 'compile', 'retrace_storm', 'event', 'program',
-'oom', 'summary') and a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
+'oom', 'health', 'anomaly', 'summary') and a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
 ``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
 on a per-batch fsync.
 
@@ -81,11 +81,51 @@ def _mib(n):
     return '%.1f' % (n / 2.0**20)
 
 
-def summary_table(snapshot, elapsed_s=None, programs=None):
+def _health_lines(health):
+    """The "Run health" block (telemetry.health.snapshot_health's
+    dict): non-finite incidents, anomaly counts, the last anomaly and
+    the input-bound share, rendered deterministically so the offline
+    CLI reproduces the live table byte-for-byte."""
+    lines = ['-- run health --']
+    n_bad = int(health.get('nonfinite_steps') or 0)
+    lines.append('  status            %s'
+                 % ('DEGRADED (%d non-finite step%s)'
+                    % (n_bad, 's' if n_bad != 1 else '')
+                    if n_bad else 'ok'))
+    incidents = health.get('incidents') or []
+    if incidents:
+        first = incidents[0]
+        desc = '%s' % first.get('source', '?')
+        if first.get('step') is not None:
+            desc += ' step %s' % first['step']
+        if first.get('window_step') is not None:
+            desc += ' (window step %d)' % first['window_step']
+        if first.get('first_bad_layer'):
+            desc += ': first non-finite symbol %s' % first['first_bad_layer']
+        lines.append('  first_incident    %s' % desc)
+    counts = health.get('anomaly_counts') or {}
+    if counts:
+        lines.append('  anomalies         %s'
+                     % ', '.join('%s=%d' % (k, counts[k])
+                                 for k in sorted(counts)))
+    last = health.get('last_anomaly')
+    if last:
+        lines.append('  last_anomaly      %s=%s (baseline %s)'
+                     % (last.get('detector', '?'), _fmt(last.get('value')),
+                        _fmt(last.get('baseline'))))
+    if health.get('input_bound_pct') is not None:
+        lines.append('  input_bound_pct   %s'
+                     % _fmt(float(health['input_bound_pct'])))
+    return lines
+
+
+def summary_table(snapshot, elapsed_s=None, programs=None, health=None):
     """Registry snapshot -> aligned text table (one block per kind).
     ``programs`` is telemetry.programs.snapshot_programs()'s {name:
     record} — rendered as a per-program cost table (and the redundant
-    ``program.<name>.*`` gauges are elided from the gauges block)."""
+    ``program.<name>.*`` gauges are elided from the gauges block);
+    ``health`` is telemetry.health.snapshot_health()'s dict — rendered
+    as the "Run health" block."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
@@ -121,6 +161,8 @@ def summary_table(snapshot, elapsed_s=None, programs=None):
                           _mib(r.get('temp_bytes', 0)),
                           _mib(r.get('argument_bytes', 0)),
                           _mib(r.get('output_bytes', 0))))
+    if health:
+        lines.extend(_health_lines(health))
     if hists:
         lines.append('-- histograms (ms) --')
         w = max(len(n) for n in hists)

@@ -1,0 +1,519 @@
+"""Training-health sentinels (mxnet_tpu/telemetry/health).
+
+Contracts under test:
+- gating: MXTPU_HEALTH needs MXTPU_TELEMETRY; either off = true no-op
+  (zero telemetry I/O, empty registry, byte-identical compiled
+  programs — no is_finite in the lowered fwd+bwd);
+- in-graph sentinels: an injected NaN is detected on BOTH the
+  per-batch executor path and a mid-window fused-fit step, the latter
+  with the exact window step index, and the first-bad-layer bisect
+  names the offending symbol;
+- MXTPU_HEALTH_ACTION: 'record' keeps training, 'raise' raises
+  TrainingHealthError with the diagnostic attached;
+- anomaly detectors: rolling median/MAD spike detection over loss /
+  step-time streams, JSONL anomaly records, summary integration;
+- satellites: Monitor.nan_watch preset + single-fetch stat_helper,
+  the derived fit.input_bound_pct gauge, the "Run health" block.
+"""
+import json
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.telemetry import export as tele_export
+from mxnet_tpu.telemetry import health
+from mxnet_tpu.telemetry.health import SpikeDetector, TrainingHealthError
+
+_HEALTH_FLAGS = ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_PATH', 'MXTPU_HEALTH',
+                 'MXTPU_HEALTH_ACTION', 'MXTPU_HEALTH_K',
+                 'MXTPU_HEALTH_WINDOW')
+
+
+def _reload_flags():
+    for f in _HEALTH_FLAGS:
+        flags.reload(f)
+
+
+@pytest.fixture
+def health_path(tmp_path, monkeypatch):
+    """Telemetry + health ON (action=record so injected NaNs don't
+    raise), logging to a tmp JSONL; fully restored afterwards."""
+    path = tmp_path / 'telemetry.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_HEALTH', '1')
+    monkeypatch.setenv('MXTPU_HEALTH_ACTION', 'record')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    yield path
+    telemetry._reset_for_tests()
+    for f in _HEALTH_FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload_flags()
+
+
+@pytest.fixture
+def all_off(monkeypatch):
+    """Telemetry AND health decisively off."""
+    for f in _HEALTH_FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload_flags()
+    telemetry._reset_for_tests()
+    yield
+    telemetry._reset_for_tests()
+    _reload_flags()
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _mlp_sym():
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    return mx.sym.SoftmaxOutput(fc2, name='softmax')
+
+
+def _fit(X=None, y=None, arg_params=None, num_epoch=1, batch=8, n=32):
+    np.random.seed(0)
+    mx.random.seed(0)
+    if X is None:
+        X = np.random.randn(n, 10).astype(np.float32)
+    if y is None:
+        y = (np.random.rand(n) * 4).astype(int).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name='softmax_label')
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer='sgd',
+            arg_params=arg_params, allow_missing=arg_params is not None,
+            optimizer_params=(('learning_rate', 0.1),))
+    return mod
+
+
+def _nan_weight():
+    np.random.seed(1)
+    w = (np.random.randn(16, 10) * 0.1).astype(np.float32)
+    w[0, 0] = np.nan
+    return {'fc1_weight': mx.nd.array(w)}
+
+
+# ---------------------------------------------------------------------------
+# gating / zero-overhead no-op
+# ---------------------------------------------------------------------------
+
+def test_true_noop_without_telemetry(all_off, monkeypatch):
+    """MXTPU_HEALTH=1 with telemetry OFF is a true no-op: no I/O, no
+    registry writes, sentinels off."""
+    monkeypatch.setenv('MXTPU_HEALTH', '1')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    io_before = tele_export._io_calls
+    mod = _fit()
+    assert not health.enabled()
+    assert tele_export._io_calls == io_before
+    assert telemetry.get_registry().names() == []
+    assert mod._exec_group.execs[0]._health_on is False
+
+
+def test_health_off_leaves_programs_byte_identical(tmp_path, monkeypatch):
+    """With telemetry ON but MXTPU_HEALTH=0 the executor's fused
+    fwd+bwd lowers WITHOUT any finite-check (the no-op contract is in
+    the traced program, not just skipped host work); =1 adds it."""
+    import jax.numpy as jnp
+    from mxnet_tpu import random as _random
+
+    def _lowered_text(health_on):
+        telemetry._reset_for_tests()
+        monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+        monkeypatch.setenv('MXTPU_TELEMETRY_PATH',
+                           str(tmp_path / ('h%d.jsonl' % health_on)))
+        monkeypatch.setenv('MXTPU_HEALTH', '1' if health_on else '0')
+        _reload_flags()
+        telemetry._reset_for_tests()
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.bind(data_shapes=[('data', (8, 10))],
+                 label_shapes=[('softmax_label', (8,))])
+        mod.init_params()
+        ex = mod._exec_group.execs[0]
+        assert ex._health_on is bool(health_on)
+        arg_data = tuple(a._data for a in ex.arg_arrays)
+        aux_data = tuple(a._data for a in ex.aux_arrays)
+        heads = (jnp.ones((8, 4), jnp.float32),)
+        return ex._fwd_bwd.lower(arg_data, aux_data, _random.next_key(),
+                                 heads).as_text()
+
+    try:
+        assert 'is_finite' not in _lowered_text(False)
+        assert 'is_finite' in _lowered_text(True)
+    finally:
+        telemetry._reset_for_tests()
+        for f in _HEALTH_FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+@pytest.mark.parametrize('health_on', ['0', '1'])
+def test_fit_acceptance_on_off(health_on, tmp_path, monkeypatch):
+    """Parametrized fit acceptance: =0 leaves no health trace anywhere;
+    =1 counts every step through the sentinels and lands the Run
+    health block in the summary."""
+    path = tmp_path / 'onoff.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_HEALTH', health_on)
+    _reload_flags()
+    telemetry._reset_for_tests()
+    try:
+        _fit()
+        snap = telemetry.snapshot()
+        health_names = [n for n in telemetry.get_registry().names()
+                        if n.startswith('health.')]
+        if health_on == '0':
+            assert health_names == []
+            assert health.snapshot_health() is None
+            table = telemetry.write_summary(log=False)
+            assert '-- run health --' not in table
+        else:
+            assert snap['counters']['health.steps'] == 4
+            assert snap['counters'].get('health.nonfinite_steps', 0) == 0
+            table = telemetry.write_summary(log=False)
+            assert '-- run health --' in table
+            assert 'status            ok' in table
+            telemetry.shutdown()
+            summ = [r for r in _records(path) if r['type'] == 'summary'][-1]
+            assert summ['health']['nonfinite_steps'] == 0
+    finally:
+        telemetry._reset_for_tests()
+        for f in _HEALTH_FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+# ---------------------------------------------------------------------------
+# injected-NaN detection + first-bad-layer bisect
+# ---------------------------------------------------------------------------
+
+def test_nan_detected_per_batch_executor_path(health_path, monkeypatch):
+    """Reference per-batch loop: a poisoned weight trips the in-graph
+    sentinel on the first step and the bisect names the weight."""
+    monkeypatch.setenv('MXTPU_FUSED_FIT', '0')
+    _fit(arg_params=_nan_weight())
+    reg = telemetry.get_registry()
+    assert reg.counter('health.nonfinite_steps').value >= 1
+    hs = health.snapshot_health()
+    inc = hs['incidents'][0]
+    assert inc['source'] == 'executor'
+    assert inc['first_bad_layer'] == 'fc1_weight'
+    assert inc['outputs_nonfinite'] == [0]
+    telemetry.shutdown()
+    recs = _records(health_path)
+    assert any(r['type'] == 'health' and r.get('event') == 'nonfinite'
+               for r in recs)
+
+
+def test_nan_detected_mid_window_fused_fit(health_path):
+    """A NaN batch in the middle of a fused-fit window is attributed to
+    its exact window step through the window's single fetch, and the
+    bisect (replaying the snapshotted batch) names the bad input."""
+    np.random.seed(0)
+    X = np.random.randn(32, 10).astype(np.float32)
+    X[16:24] = np.nan        # batch index 2 of the W=4 window
+    _fit(X=X)
+    reg = telemetry.get_registry()
+    assert reg.counter('fused_fit.windows').value >= 1   # fused path ran
+    # steps 2 AND 3 are bad (params carry the NaN forward): the counter
+    # is per STEP — same semantics as the per-batch path — while the
+    # window reports ONE incident
+    assert reg.counter('health.nonfinite_steps').value == 2
+    hs = health.snapshot_health()
+    inc = hs['incidents'][0]
+    assert inc['source'] == 'fused_fit'
+    assert inc['window_step'] == 2
+    assert inc['step'] == 2
+    assert inc['first_bad_layer'] == 'data'
+    # steps 2 and 3 are both poisoned (params carry the NaN forward);
+    # ONE incident, counting the window's bad steps
+    assert inc['nonfinite_steps_in_window'] == 2
+    telemetry.shutdown()
+    recs = _records(health_path)
+    hrec = next(r for r in recs if r['type'] == 'health')
+    assert hrec['window_step'] == 2
+
+
+def test_nan_detected_fused_eval(health_path):
+    """The fused eval window carries per-step finite flags too."""
+    np.random.seed(0)
+    X = np.random.randn(32, 10).astype(np.float32)
+    X[9] = np.inf            # batch index 1
+    y = np.zeros((32,), np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, label_name='softmax_label')
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    mod.score(it, 'acc')
+    hs = health.snapshot_health()
+    inc = hs['incidents'][0]
+    assert inc['source'] == 'fused_eval'
+    assert inc['window_step'] == 1
+    assert inc['first_bad_layer'] == 'data'
+
+
+def test_eval_window_does_not_feed_grad_detector(health_path):
+    """A fused eval pass (forward only: the norm slots are
+    structurally zero) must not flush the TRAINING grad-norm baseline
+    or zero the norm gauges."""
+    mod = _fit()                     # trains: gauges set, detector fed
+    reg = telemetry.get_registry()
+    g = reg.gauge('health.grad_norm').value
+    assert g > 0
+    n_vals = len(health.detector('grad_norm')._vals)
+    assert n_vals > 0
+    np.random.seed(0)
+    X = np.random.randn(32, 10).astype(np.float32)
+    y = np.zeros((32,), np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8, label_name='softmax_label')
+    mod.score(it, 'acc')
+    assert reg.counter('fused_eval.windows').value >= 1   # fused path ran
+    assert reg.gauge('health.grad_norm').value == g
+    assert len(health.detector('grad_norm')._vals) == n_vals
+
+
+def test_raise_action_attaches_diagnostic(health_path, monkeypatch):
+    """MXTPU_HEALTH_ACTION=raise fails fast with the structured
+    diagnostic attached to the exception."""
+    monkeypatch.setenv('MXTPU_HEALTH_ACTION', 'raise')
+    monkeypatch.setenv('MXTPU_FUSED_FIT', '0')
+    flags.reload('MXTPU_HEALTH_ACTION')
+    telemetry._reset_for_tests()
+    with pytest.raises(TrainingHealthError) as ei:
+        _fit(arg_params=_nan_weight())
+    d = ei.value.diagnostic
+    assert d['source'] == 'executor'
+    assert d['first_bad_layer'] == 'fc1_weight'
+    assert 'fc1_weight' in str(ei.value)
+
+
+def test_first_nonfinite_node_clean_graph(health_path):
+    """The bisect returns None on a healthy graph and respects
+    overrides (a NaN override is attributed to its variable)."""
+    import jax.numpy as jnp
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[('data', (8, 10))],
+             label_shapes=[('softmax_label', (8,))])
+    mod.init_params()
+    ex = mod._exec_group.execs[0]
+    assert ex.first_nonfinite_node() is None
+    bad = jnp.full((8, 10), jnp.nan, jnp.float32)
+    assert ex.first_nonfinite_node({'data': bad}) == ('data', 0)
+
+
+# ---------------------------------------------------------------------------
+# anomaly detectors
+# ---------------------------------------------------------------------------
+
+def test_spike_detector_flags_spike():
+    d = SpikeDetector('t', window=16, k=5.0, min_count=8)
+    rng = np.random.RandomState(0)
+    for _ in range(12):
+        assert d.observe(100.0 + rng.randn()) is None
+    a = d.observe(500.0)
+    assert a is not None
+    assert a['detector'] == 't'
+    assert a['value'] == 500.0
+    assert 95 < a['baseline'] < 105
+    assert a['k'] == 5.0
+
+
+def test_spike_detector_constant_baseline_needs_real_spike():
+    """A near-constant stream (MAD ~ 0) must not alarm on noise — the
+    MAD floor (1% of the median) absorbs it."""
+    d = SpikeDetector('t', window=16, k=5.0, min_count=8)
+    for _ in range(12):
+        d.observe(100.0)
+    assert d.observe(100.5) is None          # within the floor
+    assert d.observe(200.0) is not None      # a real spike
+
+
+def test_spike_detector_level_shift_becomes_new_baseline():
+    d = SpikeDetector('t', window=8, k=5.0, min_count=4)
+    for _ in range(8):
+        d.observe(10.0)
+    assert d.observe(100.0) is not None      # the shift alarms once
+    for _ in range(8):
+        d.observe(100.0)                     # ...then becomes normal
+    assert d.observe(101.0) is None
+
+
+def test_spike_detector_ignores_nonfinite():
+    d = SpikeDetector('t', window=8, k=5.0, min_count=4)
+    for _ in range(6):
+        d.observe(10.0)
+    assert d.observe(float('nan')) is None
+    assert d.observe(float('inf')) is None
+
+
+def test_loss_and_step_time_detectors_emit_anomalies(health_path, caplog):
+    """note_loss / note_step_time feed the registry detectors; a spike
+    lands a JSONL anomaly record, counters, and the last-anomaly slot."""
+    assert health.enabled()
+    for _ in range(12):
+        health.note_loss(2.0)
+        health.note_step_time(0.1)
+    with caplog.at_level(logging.WARNING):
+        health.note_loss(2.0)            # steady: no anomaly
+        health.note_loss(50.0)           # spike
+        health.note_step_time(5.0)       # spike (5000 ms vs 100 ms)
+    reg = telemetry.get_registry()
+    assert reg.counter('health.anomalies').value == 2
+    assert reg.counter('health.anomalies.loss').value == 1
+    assert reg.counter('health.anomalies.step_time').value == 1
+    hs = health.snapshot_health()
+    assert hs['anomaly_counts'] == {'loss': 1, 'step_time': 1}
+    assert hs['last_anomaly']['detector'] == 'step_time'
+    telemetry.shutdown()
+    recs = _records(health_path)
+    anomalies = [r for r in recs if r['type'] == 'anomaly']
+    assert {a['detector'] for a in anomalies} == {'loss', 'step_time'}
+    # record action (the fixture's): spikes stay out of the warnings
+    assert not [r for r in caplog.records if 'spike' in r.getMessage()]
+
+
+def test_grad_norm_gauges_and_detector_fed_from_fit(health_path):
+    """A clean fit publishes the sentinel gauges."""
+    _fit()
+    snap = telemetry.snapshot()
+    assert snap['gauges']['health.grad_norm'] > 0
+    assert snap['gauges']['health.param_norm'] > 0
+    assert snap['gauges']['health.update_ratio'] > 0
+    assert snap['gauges']['health.step_time_ms'] > 0
+
+
+# ---------------------------------------------------------------------------
+# input-bound classifier + summary integration
+# ---------------------------------------------------------------------------
+
+def test_input_bound_pct_gauge_and_classifier(health_path, caplog):
+    reg = telemetry.get_registry()
+    for _ in range(4):
+        reg.histogram('io.prefetch_wait').observe(50.0)
+        reg.histogram('fit.batch').observe(100.0)
+    with caplog.at_level(logging.WARNING):
+        hs = health.summarize()
+    assert reg.gauge('fit.input_bound_pct').value == 50.0
+    assert hs['input_bound_pct'] == 50.0
+    assert [r for r in caplog.records
+            if 'input-bound' in r.getMessage()]
+    telemetry.shutdown()
+    recs = _records(health_path)
+    assert any(r['type'] == 'health' and r.get('event') == 'input_bound'
+               for r in recs)
+
+
+def test_input_bound_pct_without_health(tmp_path, monkeypatch):
+    """The derived gauge is telemetry-tier: published even when
+    MXTPU_HEALTH is off (no classifier record then)."""
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(tmp_path / 'o.jsonl'))
+    _reload_flags()
+    telemetry._reset_for_tests()
+    try:
+        reg = telemetry.get_registry()
+        assert telemetry.enabled()
+        reg.histogram('io.prefetch_wait').observe(10.0)
+        reg.histogram('fit.batch').observe(100.0)
+        assert health.summarize() is None     # health off: no snapshot
+        assert reg.gauge('fit.input_bound_pct').value == 10.0
+    finally:
+        telemetry._reset_for_tests()
+        for f in _HEALTH_FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+def test_run_health_block_renders_incident(health_path):
+    np.random.seed(0)
+    X = np.random.randn(32, 10).astype(np.float32)
+    X[16:24] = np.nan
+    _fit(X=X)
+    table = telemetry.write_summary(log=False)
+    assert '-- run health --' in table
+    assert 'DEGRADED (2 non-finite steps)' in table
+    assert 'first non-finite symbol data' in table
+    assert 'window step 2' in table
+
+
+# ---------------------------------------------------------------------------
+# Monitor satellites
+# ---------------------------------------------------------------------------
+
+def test_monitor_nan_watch_flags_bad_tensor(all_off):
+    """The nan_watch preset (staged executor path) reports per-op
+    finite status built on the same host finite check."""
+    mon = mx.mon.Monitor.nan_watch(interval=1)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[('data', (4, 10))],
+             label_shapes=[('softmax_label', (4,))])
+    mod.init_params()
+    mod.install_monitor(mon)
+    X = np.ones((4, 10), np.float32)
+    X[0, 0] = np.nan
+    batch = mx.io.DataBatch(data=[mx.nd.array(X)],
+                            label=[mx.nd.zeros((4,))])
+    mon.tic()
+    mod.forward(batch, is_train=False)
+    rows = mon.toc()
+    stats = {name: stat for _, name, stat in rows}
+    assert stats['fc1_output'].startswith('nan=')
+    assert any(v.startswith('ok') for v in stats.values())
+
+
+def test_monitor_single_fetch_shared_across_stat_funcs(all_off):
+    """stat_helper fetches each matched array once; every stat func
+    reads the same host-resident copy."""
+    seen = []
+
+    def f1(x):
+        seen.append(x)
+        return 'a'
+
+    def f2(x):
+        seen.append(x)
+        return 'b'
+
+    mon = mx.mon.Monitor(1, stat_func=[f1, f2])
+    mon.activated = True
+    mon.stat_helper('x_output', mx.nd.ones((2, 2)))
+    assert len(seen) == 2
+    assert seen[0] is seen[1]                 # one fetch, shared
+    assert [r.stat for r in mon.queue] == ['a', 'b']
+    # the shared copy is host-resident but keeps the NDArray API
+    assert float(seen[0].norm().asscalar()) == pytest.approx(2.0)
+
+
+def test_monitor_legacy_single_stat_func_unchanged(all_off):
+    mon = mx.mon.Monitor(1)
+    mon.activated = True
+    mon.stat_helper('w_output', mx.nd.ones((2, 2)))
+    assert len(mon.queue) == 1
+    assert float(mon.queue[0].stat) == pytest.approx(1.0)
+
+
+def test_finite_report_strings():
+    from mxnet_tpu.telemetry.health import finite_report, has_nonfinite
+    assert finite_report(np.ones((4,))) == 'ok'
+    assert finite_report(np.zeros((0,))) == 'ok'
+    assert finite_report(np.arange(5)) == 'ok'         # ints always ok
+    a = np.ones((8,), np.float32)
+    a[1] = np.nan
+    a[2] = np.inf
+    assert finite_report(a) == 'nan=1 inf=1 of 8'
+    assert has_nonfinite(a)
+    assert not has_nonfinite(np.ones((3,)))

@@ -67,7 +67,9 @@ def test_telemetry_report_golden(tmp_path, capsys):
 
 def test_telemetry_report_reconstructs_without_summary(tmp_path, capsys):
     """A crashed run's log (no summary record) still renders: spans,
-    compiles, and program records are reconstructed best-effort."""
+    compiles, program records AND the run-health story — the incidents
+    plus the LAST anomaly before the crash — are reconstructed
+    best-effort."""
     import json
     import telemetry_report
     recs = [
@@ -81,6 +83,15 @@ def test_telemetry_report_reconstructs_without_summary(tmp_path, capsys):
          't': 10.4, 'flops': 5e6, 'bytes_accessed': 1e6,
          'temp_bytes': 4096, 'argument_bytes': 8192, 'output_bytes': 16,
          'generated_code_bytes': 0},
+        {'type': 'anomaly', 'detector': 'step_time', 't': 10.5,
+         'value': 912.4, 'baseline': 310.2, 'mad': 4.1, 'k': 8.0},
+        {'type': 'anomaly', 'detector': 'loss', 't': 10.6,
+         'value': 50.0, 'baseline': 2.0, 'mad': 0.1, 'k': 8.0},
+        {'type': 'health', 'event': 'nonfinite', 't': 10.7,
+         'source': 'fused_fit', 'step': 34, 'window_step': 2,
+         'first_bad_layer': 'fc1_weight', 'outputs_nonfinite': [0]},
+        {'type': 'health', 'event': 'input_bound', 't': 10.8,
+         'input_bound_pct': 37.5},
     ]
     path = tmp_path / 'crashed.jsonl'
     with open(path, 'w') as f:
@@ -91,6 +102,38 @@ def test_telemetry_report_reconstructs_without_summary(tmp_path, capsys):
     assert 'xla.compiles' in out and 'fit.dispatch' in out
     assert 'executor.fwd_bwd[softmax]' in out
     assert 'no summary record found' in out
+    # crashed-run health reconstruction: the incident with its step
+    # attribution and the LAST anomaly (loss, 10.6 > 10.5) survive
+    assert '-- run health --' in out
+    assert 'DEGRADED (1 non-finite step)' in out
+    assert ('fused_fit step 34 (window step 2): '
+            'first non-finite symbol fc1_weight') in out
+    assert 'loss=1, step_time=1' in out
+    assert 'last_anomaly      loss=50.000 (baseline 2.000)' in out
+    assert 'input_bound_pct   37.500' in out
+
+
+def test_telemetry_report_health_block_from_summary(tmp_path, capsys):
+    """A summary record's 'health' key renders the same Run health
+    block the live table logged."""
+    import json
+    import telemetry_report
+    rec = {'type': 'summary', 't': 20.0, 'elapsed_s': 2.0,
+           'snapshot': {'counters': {'health.steps': 8},
+                        'gauges': {}, 'histograms': {}},
+           'health': {'nonfinite_steps': 0, 'incidents': [],
+                      'anomaly_counts': {'step_time': 2},
+                      'last_anomaly': {'detector': 'step_time',
+                                       'value': 912.4, 'baseline': 310.2},
+                      'input_bound_pct': 41.5}}
+    path = tmp_path / 'ok.jsonl'
+    with open(path, 'w') as f:
+        f.write(json.dumps(rec) + '\n')
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert 'status            ok' in out
+    assert 'anomalies         step_time=2' in out
+    assert 'input_bound_pct   41.500' in out
 
 
 def test_bandwidth_collectives_tiny():

@@ -56,9 +56,40 @@ def _percentile(sorted_vals, p):
     return sorted_vals[idx]
 
 
+def _reconstruct_health(records):
+    """Run-health dict rebuilt from individual ``health`` / ``anomaly``
+    records — exactly what a crashed run wants visible: the incidents
+    and the LAST anomaly before the crash. None when the run recorded
+    neither (health off, or a clean run)."""
+    incidents = []
+    anomaly_counts = {}
+    last_anomaly = None
+    input_bound = None
+    for r in records:
+        typ = r.get('type')
+        if typ == 'health' and r.get('event') == 'nonfinite':
+            incidents.append({k: v for k, v in r.items()
+                              if k not in ('type', 't')})
+        elif typ == 'health' and r.get('event') == 'input_bound':
+            input_bound = r.get('input_bound_pct')
+        elif typ == 'anomaly':
+            name = r.get('detector', '?')
+            anomaly_counts[name] = anomaly_counts.get(name, 0) + 1
+            last_anomaly = {k: v for k, v in r.items()
+                            if k not in ('type', 't')}
+    if not incidents and not anomaly_counts and input_bound is None:
+        return None
+    out = {'nonfinite_steps': len(incidents), 'incidents': incidents[:8],
+           'anomaly_counts': anomaly_counts, 'last_anomaly': last_anomaly}
+    if input_bound is not None:
+        out['input_bound_pct'] = input_bound
+    return out
+
+
 def _reconstruct(records):
-    """(snapshot, elapsed_s, programs) rebuilt from individual records
-    — the crashed-run path (no summary record was ever written)."""
+    """(snapshot, elapsed_s, programs, health) rebuilt from individual
+    records — the crashed-run path (no summary record was ever
+    written)."""
     spans = {}
     counters = {}
     programs = {}
@@ -95,7 +126,7 @@ def _reconstruct(records):
                        'p95': _percentile(vs, 95)}
     snapshot = {'counters': counters, 'gauges': {}, 'histograms': hists}
     elapsed = (max(times) - min(times)) if len(times) > 1 else None
-    return snapshot, elapsed, programs or None
+    return snapshot, elapsed, programs or None, _reconstruct_health(records)
 
 
 def render(records):
@@ -104,9 +135,11 @@ def render(records):
     if summaries:
         s = summaries[-1]
         return summary_table(s.get('snapshot') or {}, s.get('elapsed_s'),
-                             programs=s.get('programs'))
-    snapshot, elapsed, programs = _reconstruct(records)
-    table = summary_table(snapshot, elapsed, programs=programs)
+                             programs=s.get('programs'),
+                             health=s.get('health'))
+    snapshot, elapsed, programs, health = _reconstruct(records)
+    table = summary_table(snapshot, elapsed, programs=programs,
+                          health=health)
     return table + ('\n(no summary record found — reconstructed from '
                     '%d individual records; registry-only counters and '
                     'gauges are not recoverable)' % len(records))
