@@ -656,6 +656,15 @@ def _telemetry_breakdown(device):
               if n.startswith('health.')}
         if hc:
             tel['health'] = hc
+        # cluster aggregation (ISSUE 5): the last sync round's per-host
+        # gauges + straggler attribution, when MXTPU_TELEMETRY_SYNC_EVERY
+        # ran; plus the live endpoint's port when one is serving
+        clus = _tele.cluster.snapshot_cluster()
+        if clus:
+            tel['cluster'] = clus
+        live_port = _tele.serve.port()
+        if live_port is not None:
+            tel['live_endpoint_port'] = live_port
         # per-program cost attribution (ISSUE 3): FLOPs/bytes per
         # compiled program — bench.train_step plus whatever the Module
         # paths compiled — alongside the top-line numbers

@@ -151,6 +151,29 @@ flags.declare('MXTPU_TELEMETRY_RETRACE_WARN', int, 5,
               'Warn (once, loudly) when the same graph is compiled more '
               'than this many times — the retrace-storm detector',
               min_value=1)
+flags.declare('MXTPU_TELEMETRY_PORT', int, -1,
+              'Live telemetry endpoint (telemetry/serve.py, requires '
+              'MXTPU_TELEMETRY=1): serve /metrics (Prometheus text), '
+              '/healthz (200/503 from the health incident state) and '
+              '/summary (registry snapshot as JSON) from a stdlib HTTP '
+              'server on a daemon thread. 0 binds an OS-assigned '
+              'ephemeral port; -1 (default) = off: no thread, no socket',
+              min_value=-1, max_value=65535)
+flags.declare('MXTPU_TELEMETRY_SYNC_EVERY', int, 0,
+              'Cluster telemetry sync cadence (telemetry/cluster.py, '
+              'requires MXTPU_TELEMETRY=1): every N training steps run '
+              'one small off-graph allgather carrying each host\'s key '
+              'gauges (step-time p50, io-wait share, dispatch span, '
+              'live bytes); process 0 publishes cluster.* per-host '
+              'gauges, spread, slowest-host id and the straggler '
+              'classification. 0 (default) = off: the fit loops never '
+              'touch the hook', min_value=0)
+flags.declare('MXTPU_TELEMETRY_MAX_MB', float, 0.0,
+              'Size cap (MB) for the JSONL telemetry log: once the file '
+              'would exceed it, records are dropped (counted under '
+              'telemetry.dropped_records, warned once) instead of '
+              'filling the disk on week-long runs. 0 = unlimited',
+              min_value=0.0)
 flags.declare('MXTPU_HEALTH', bool, False,
               'Training-health sentinels (telemetry/health, requires '
               'MXTPU_TELEMETRY=1): in-graph NaN/Inf detection with '

@@ -29,7 +29,9 @@ __all__ = ['KVStore', 'create']
 
 def _tele_bytes(counter_name, values):
     """Account logical payload bytes for a push/pull value list (flat
-    list or list-of-lists of NDArrays) into a telemetry counter."""
+    list or list-of-lists of NDArrays) into a telemetry counter.
+    Returns the byte total (the dist tier derives its host-side
+    throughput gauges from it)."""
     total = 0
     for v in values:
         for a in (v if isinstance(v, (list, tuple)) else [v]):
@@ -38,6 +40,7 @@ def _tele_bytes(counter_name, values):
             except Exception:  # noqa: BLE001 — exotic sparse/host types
                 pass
     _tele.counter(counter_name).inc(total)
+    return total
 
 
 def _ctx_group_key(arrs):

@@ -255,8 +255,10 @@ class KVStoreDist(KVStore):
         from .ndarray.sparse import RowSparseNDArray
         with _tele.span('kvstore.push', 'kvstore'):
             keys, values = _key_value(key, value)
+            nbytes, t0 = 0, 0.0
             if _tele.enabled():
-                _tele_bytes('kvstore.push_bytes', values)
+                nbytes = _tele_bytes('kvstore.push_bytes', values)
+                t0 = time.time()
             for k, vlist in zip(keys, values):
                 if not isinstance(vlist, (list, tuple)):
                     vlist = [vlist]
@@ -271,6 +273,15 @@ class KVStoreDist(KVStore):
                                                   merged.dtype):
                     self._conns[sid].submit(
                         ('push', skey, pack_array(flat[sl])))
+            if nbytes:
+                # host-observed push rate (reduce + serialize + submit;
+                # the server ack is async). /metrics labels it with
+                # this process's host id, so a slow DCN link shows up
+                # attributed to its machine
+                dt = time.time() - t0
+                if dt > 0:
+                    _tele.gauge('kvstore.push_mb_s').set(
+                        round(nbytes / 2.0**20 / dt, 2))
 
     def _push_row_sparse(self, k, vlist):
         """Row-sparse grads go whole to the key's home server (the
@@ -292,8 +303,10 @@ class KVStoreDist(KVStore):
         assert out is not None
         with _tele.span('kvstore.pull', 'kvstore'):
             keys, outs = _key_value(key, out)
+            nbytes, t0 = 0, 0.0
             if _tele.enabled():
-                _tele_bytes('kvstore.pull_bytes', outs)
+                nbytes = _tele_bytes('kvstore.pull_bytes', outs)
+                t0 = time.time()
             for k, olist in zip(keys, outs):
                 if not isinstance(olist, (list, tuple)):
                     olist = [olist]
@@ -311,6 +324,13 @@ class KVStoreDist(KVStore):
                 for o in olist:
                     o._data = jax.device_put(
                         arr.astype(o.dtype), o.context.jax_device())
+            if nbytes:
+                # pull waits for every shard, so this is real end-to-end
+                # server->host throughput for this host
+                dt = time.time() - t0
+                if dt > 0:
+                    _tele.gauge('kvstore.pull_mb_s').set(
+                        round(nbytes / 2.0**20 / dt, 2))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         from .ndarray.sparse import RowSparseNDArray, row_sparse_array

@@ -255,8 +255,10 @@ class BaseModule:
         # training-health sentinels (telemetry/health): the per-batch
         # loop feeds the step-time spike detector; the in-graph
         # finite/norm sentinels ride the executor's fwd+bwd program.
-        # One cached-bool check — zero overhead while off.
+        # One cached-bool check — zero overhead while off. The cluster
+        # sync hook (telemetry/cluster.py) is gated the same way.
         health_on = _tele.health.enabled()
+        cluster_on = _tele.cluster.enabled()
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -279,6 +281,9 @@ class BaseModule:
                 if monitor is not None:
                     monitor.tic()
                 t_step = time.time() if health_on else 0.0
+                if health_on:
+                    # executor-level incidents carry the real batch index
+                    _tele.health.note_batch(nbatch)
                 # per-batch telemetry: host-dispatch vs draw vs metric vs
                 # callback time (all no-ops unless MXTPU_TELEMETRY=1 or
                 # the chrome-trace profiler is running)
@@ -309,6 +314,10 @@ class BaseModule:
                                 callback(batch_end_params)
                 if health_on:
                     _tele.health.note_step_time(time.time() - t_step)
+                if cluster_on:
+                    # off-sync steps: one clock read + a deque append;
+                    # the allgather fires every SYNC_EVERY steps only
+                    _tele.cluster.note_step()
                 nbatch += 1
 
             self._fit_epoch_end(epoch, eval_metric, tic, epoch_end_callback,
@@ -321,6 +330,10 @@ class BaseModule:
                        eval_batch_end_callback):
         """Epoch-end bookkeeping shared by the reference per-batch loop
         and the fused fast path (reference base_module.py:528-553)."""
+        # the batch loop is over: clear the executor-incident step
+        # context so a later custom-loop incident cannot inherit a
+        # stale index (one attribute store — safe while health is off)
+        _tele.health.note_batch(None)
         _tele.counter('fit.epochs').inc()
         _tele.xla.sample_memory()   # live/peak device bytes, once per epoch
         for name, val in eval_metric.get_name_value():

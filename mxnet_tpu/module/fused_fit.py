@@ -820,6 +820,7 @@ class FusedFitLoop:
             return pipe.start_put(win_snaps, pool)
 
         health_on = self._health_fn is not None
+        cluster_on = _tele.cluster.enabled()
         _t_win = _clk()   # wall clock per dispatched window (health)
         batches, snaps = collect()
         if not batches:
@@ -879,6 +880,10 @@ class FusedFitLoop:
                     self._writeback(params, states, aux, gaccs)
                 _tele.counter('fit.steps').inc(self.window)
                 _tele.counter('fused_fit.windows').inc()
+                if cluster_on:
+                    # a whole window of steps advanced in one dispatch;
+                    # the sync (if due) piggybacks on the window edge
+                    _tele.cluster.note_step(self.window)
                 # MXTPU_XPROF step window (quantized to whole windows)
                 _profiler.note_step(self.window)
                 if _timing:
@@ -934,9 +939,15 @@ class FusedFitLoop:
                 data=[from_jax(d, self._exec._ctx) for d in ds],
                 label=[from_jax(l, self._exec._ctx) for l in ls],
                 pad=pad, index=idx)
+            if health_on:
+                # the tail runs the executor path: incidents carry the
+                # real batch index through the note_batch context
+                _tele.health.note_batch(nbatch)
             m.forward_backward(sb)
             m.update()
             _tele.counter('fit.steps').inc()
+            if cluster_on:
+                _tele.cluster.note_step()
             _profiler.note_step()
             m.update_metric(eval_metric, sb.label)
             if batch_end_callback is not None:

@@ -55,6 +55,19 @@ def init_multihost(coordinator_address=None, num_processes=None,
                                num_processes=num_processes,
                                process_id=process_id)
     _initialized = True
+    # telemetry plane: from here jax.process_index() is authoritative —
+    # pin the host stamp (JSONL records, /metrics labels) and announce
+    # the job size so cluster aggregation can name every host
+    try:
+        from .. import telemetry as _tele
+        if _tele.enabled():
+            _tele.cluster.set_host(jax.process_index())
+            _tele.gauge('cluster.process_count').set(int(num_processes))
+            _tele.event('multihost.init', host=int(jax.process_index()),
+                        num_hosts=int(num_processes),
+                        coordinator=coordinator_address)
+    except Exception:  # noqa: BLE001 — observability must not block init
+        pass
     return True
 
 

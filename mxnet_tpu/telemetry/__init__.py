@@ -23,9 +23,20 @@ instrumentation the hot paths report through:
   over step time / loss / grad-norm, an input-bound classifier, and a
   "Run health" block in the end-of-run summary (``health`` /
   ``anomaly`` JSONL records, ``MXTPU_HEALTH_ACTION={warn,record,raise}``);
-- exporters (:mod:`.export`): an append-only JSONL log plus an
-  end-of-run human-readable summary table
-  (``tools/telemetry_report.py`` renders the log offline).
+- exporters (:mod:`.export`): an append-only JSONL log (host-stamped,
+  size-capped via ``MXTPU_TELEMETRY_MAX_MB``) plus an end-of-run
+  human-readable summary table (``tools/telemetry_report.py`` renders
+  one or many per-host logs offline);
+- the live plane (:mod:`.serve`, ``MXTPU_TELEMETRY_PORT``): a
+  background HTTP endpoint exposing ``/metrics`` (Prometheus text),
+  ``/healthz`` (200/503 from the health incident state) and
+  ``/summary`` (snapshot JSON) — ``tools/telemetry_watch.py`` renders
+  it as a refreshing dashboard;
+- cluster aggregation (:mod:`.cluster`, ``MXTPU_TELEMETRY_SYNC_EVERY``):
+  every N steps one small off-graph allgather carries each host's key
+  gauges; process 0 publishes ``cluster.*`` per-host gauges, the
+  step-time spread, the slowest-host id and a straggler classification
+  (input-bound vs compute-bound).
 
 Everything is OFF by default. ``MXTPU_TELEMETRY=1`` turns it on;
 ``MXTPU_TELEMETRY_PATH`` points the JSONL log (default
@@ -61,10 +72,12 @@ from . import export as _export
 from . import xla  # noqa: F401  (public submodule: telemetry.xla.*)
 from . import programs  # noqa: F401  (public submodule: telemetry.programs.*)
 from . import health  # noqa: F401  (public submodule: telemetry.health.*)
+from . import cluster  # noqa: F401  (public submodule: telemetry.cluster.*)
+from . import serve  # noqa: F401  (public submodule: telemetry.serve.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
-           'programs', 'health', 'get_registry']
+           'programs', 'health', 'cluster', 'serve', 'get_registry']
 
 
 class _State:
@@ -108,7 +121,17 @@ def _decide():
                 path = ''
             path = os.path.expanduser(path or 'telemetry.jsonl')
             try:
-                _state.sink = _export.JsonlSink(path)
+                _flags.reload('MXTPU_TELEMETRY_MAX_MB')
+                max_mb = float(_flags.get('MXTPU_TELEMETRY_MAX_MB'))
+            except Exception:  # noqa: BLE001
+                max_mb = 0.0
+            try:
+                _state.sink = _export.JsonlSink(
+                    path,
+                    max_bytes=int(max_mb * 2**20) if max_mb else None)
+                # every record carries this process's host index so
+                # multi-host logs merge on it (telemetry/cluster.py)
+                _state.sink.host = cluster.host_index()
                 _state.sink.emit({'type': 'start', 'pid': os.getpid(),
                                   'path': path})
             except OSError as e:
@@ -116,6 +139,9 @@ def _decide():
                                 'stay in-process, no JSONL log', path, e)
                 _state.sink = None
             xla.install()
+            # live endpoint (telemetry/serve.py): only with
+            # MXTPU_TELEMETRY_PORT set — port unset = no thread/socket
+            serve.maybe_start()
             if not _atexit_registered:
                 _atexit_registered = True
                 atexit.register(shutdown)
@@ -260,7 +286,8 @@ def summary():
                                  programs=programs.snapshot_programs()
                                  or None,
                                  health=health.snapshot_health(
-                                     input_bound=health.input_bound_pct()))
+                                     input_bound=health.input_bound_pct()),
+                                 cluster=cluster.snapshot_cluster())
 
 
 def write_summary(log=True):
@@ -277,6 +304,7 @@ def write_summary(log=True):
     # gauge and (with MXTPU_HEALTH=1) returns the "Run health" block's
     # input + the summary record's 'health' key
     hsnap = health.summarize()
+    csnap = cluster.snapshot_cluster()
     snap = _state.registry.snapshot()
     progs = programs.snapshot_programs()
     elapsed = time.time() - _state.t_start
@@ -287,10 +315,12 @@ def write_summary(log=True):
             rec['programs'] = progs
         if hsnap:
             rec['health'] = hsnap
+        if csnap:
+            rec['cluster'] = csnap
         _state.sink.emit(rec)
         _state.sink.flush()
     table = _export.summary_table(snap, elapsed, programs=progs or None,
-                                  health=hsnap)
+                                  health=hsnap, cluster=csnap)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -315,6 +345,7 @@ def shutdown():
         except Exception:  # noqa: BLE001
             pass
         st.sink = None
+    serve.stop()
     st.active = False
 
 
@@ -328,6 +359,8 @@ def _reset_for_tests():
             _state.sink.close()
         except Exception:  # noqa: BLE001
             pass
+    serve.stop()
     _state = _State()
     programs._reset_for_tests()
     health._reset_for_tests()
+    cluster._reset_for_tests()

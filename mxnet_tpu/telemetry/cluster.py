@@ -1,0 +1,289 @@
+"""Multi-host telemetry aggregation: host stamping + straggler naming.
+
+``parallel/multihost.py`` runs lockstep SPMD data-parallel jobs where
+every collective is gated by the slowest host (cf. "Automatic
+Cross-Replica Sharding of Weight Update in Data-Parallel Training" for
+why the update is lockstep, and EQuARX's motivation that allreduce
+time dominates at scale) — yet per-process telemetry cannot NAME that
+host. This module is the cluster half of the telemetry plane:
+
+- **host stamping**: every JSONL record (telemetry/export.py) and every
+  ``/metrics`` sample (telemetry/serve.py) carries ``host=<process
+  index>``, so merged logs and scraped series attribute to a machine;
+- **sync rounds**: every ``MXTPU_TELEMETRY_SYNC_EVERY`` training steps
+  (fed by the per-batch fit loop and the fused-fit window — OFF the hot
+  path by default, and off-sync steps cost one clock read + a deque
+  append, no device work) each host contributes a small vector of key
+  gauges — step-time p50, io-wait share, dispatch-span p50, live device
+  bytes — to ONE off-graph allgather (jax multihost_utils over the
+  global mesh);
+- **publication**: process 0 turns the gathered matrix into
+  ``cluster.*`` gauges (per-host rows, step-time spread, slowest-host
+  id, straggler classification — input-bound vs compute-bound via the
+  health module's io-wait classifier), a ``cluster`` JSONL record, the
+  "Cluster" block of the summary table, and the ``/metrics`` scrape.
+
+Gating: ``MXTPU_TELEMETRY=1`` *and* ``MXTPU_TELEMETRY_SYNC_EVERY>0``.
+While off, :func:`note_step` is one cached-bool check and the fit
+loops never branch further (asserted by tests/unittest/test_serve.py).
+
+LOCKSTEP REQUIREMENT: the sync is a collective, and the fire decision
+is each host's local step count crossing the cadence — correct for
+the SPMD jobs this framework runs multi-host (one global program,
+every process advances the same global step). A driver that steps
+hosts UNEQUALLY (per-host iterators of different lengths) would
+diverge the collective schedule and hang at the next allgather; keep
+the cadence off (the default) for such topologies.
+"""
+import collections
+import logging
+import threading
+import time
+
+import numpy as np
+
+__all__ = ['enabled', 'host_index', 'set_host', 'note_step', 'sync_now',
+           'snapshot_cluster', 'classify', 'SYNC_KEYS']
+
+# slots of the per-host sync vector, in order
+SYNC_KEYS = ('step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes')
+
+_SPREAD_BALANCED_PCT = 5.0   # step-time spread below this = no straggler
+_RING = 128                  # recent per-step wall samples backing the p50
+
+
+class _CState:
+    __slots__ = ('decided', 'active', 'every', 'since', 'steps', 'last_t',
+                 'ring', 'snapshot', 'lock')
+
+    def __init__(self):
+        self.decided = False
+        self.active = False
+        self.every = 0
+        self.since = 0
+        self.steps = 0
+        self.last_t = None
+        self.ring = collections.deque(maxlen=_RING)
+        self.snapshot = None
+        self.lock = threading.Lock()
+
+
+_state = _CState()
+_decide_lock = threading.Lock()
+_host = None
+
+
+def _tele():
+    """The telemetry package state (deciding it from the flag first)."""
+    from . import enabled as _tele_enabled, _state as st
+    _tele_enabled()
+    return st
+
+
+def host_index():
+    """This process's host id. Read from the launcher env
+    (MXTPU_HOST_ID) — NOT jax.process_index() — so stamping the JSONL
+    sink at telemetry-decide time can never initialize the jax backend
+    before jax.distributed is up. ``init_multihost`` pins the
+    authoritative index via :func:`set_host` once the job is joined."""
+    global _host
+    if _host is None:
+        try:
+            from ..config import flags
+            _host = int(flags.get('MXTPU_HOST_ID'))
+        except Exception:  # noqa: BLE001 — stripped builds without the flag
+            _host = 0
+    return _host
+
+
+def set_host(idx):
+    """Pin the host id (called by parallel/multihost.py after
+    jax.distributed init) and restamp the open JSONL sink."""
+    global _host
+    _host = int(idx)
+    st = _tele()
+    if st.sink is not None:
+        st.sink.host = _host
+
+
+def _decide():
+    with _decide_lock:
+        if _state.decided:
+            return _state.active
+        on = False
+        every = 0
+        if _tele().active:
+            from ..config import flags
+            try:
+                flags.reload('MXTPU_TELEMETRY_SYNC_EVERY')
+                every = int(flags.get('MXTPU_TELEMETRY_SYNC_EVERY'))
+            except Exception:  # noqa: BLE001
+                every = 0
+            on = every > 0
+        _state.active = on
+        _state.every = every
+        _state.decided = True
+    return _state.active
+
+
+def enabled():
+    """Whether cluster sync rounds are on: MXTPU_TELEMETRY=1 *and*
+    MXTPU_TELEMETRY_SYNC_EVERY>0, decided once. After the first call it
+    is one attribute check — the fit loops' gate."""
+    if _state.decided:
+        return _state.active
+    return _decide()
+
+
+def note_step(steps=1):
+    """Hot-path hook: one call per trained batch (per-batch loop) or
+    per dispatched window (fused loop, ``steps=W``). Off-sync steps do
+    host bookkeeping only — a clock read and a deque append; the
+    allgather (the only collective, and the only device-touching work)
+    fires every MXTPU_TELEMETRY_SYNC_EVERY steps."""
+    if not enabled():
+        return
+    st = _state
+    now = time.time()
+    fire = False
+    with st.lock:
+        if st.last_t is not None and steps > 0:
+            st.ring.append((now - st.last_t) * 1e3 / steps)
+        st.last_t = now
+        st.steps += steps
+        st.since += steps
+        if st.since >= st.every:
+            st.since = 0
+            fire = True
+    if fire:
+        sync_now()
+
+
+def _local_stats():
+    """This host's sync vector (SYNC_KEYS order)."""
+    reg = _tele().registry
+    with _state.lock:
+        ring = list(_state.ring)
+    step_ms = float(np.median(ring)) if ring else 0.0
+    from . import health
+    io_pct = health.input_bound_pct() or 0.0
+    disp = 0.0
+    h = reg.get('fit.dispatch')
+    if h is not None and h.count:
+        disp = h.percentile(50) or 0.0
+    else:
+        h = reg.get('fused_fit.dispatch')
+        if h is not None and h.count:
+            disp = h.percentile(50) or 0.0
+            w = reg.get('fused_fit.steps_per_call')
+            if w is not None and w.value:
+                # the fused histogram records one observation per
+                # W-step window; normalize so dispatch_ms is per-step,
+                # commensurate with step_time_ms in the same row
+                disp /= float(w.value)
+    live_g = reg.get('xla.bytes_in_use')
+    live = float(live_g.value) if live_g is not None and live_g.value else 0.0
+    return [step_ms, float(io_pct), float(disp), live]
+
+
+def _allgather(vals):
+    """One small off-graph allgather over the global mesh; returns an
+    (n_hosts, len(SYNC_KEYS)) float array. Single-process jobs come
+    back as one row (older jax returns the input unchanged there)."""
+    import jax
+    from jax.experimental import multihost_utils
+    arr = np.asarray(vals, np.float32)
+    out = np.asarray(multihost_utils.process_allgather(arr))
+    if out.ndim == arr.ndim:
+        out = out[None, :]
+    return out.reshape(max(1, jax.process_count()), -1)
+
+
+def classify(io_wait_pct):
+    """The straggler classification for one host: where its time goes.
+    Reuses the health module's input-bound threshold so the live
+    cluster view and the end-of-run classifier agree."""
+    from .health import _INPUT_BOUND_PCT
+    return ('input_bound' if (io_wait_pct or 0.0) >= _INPUT_BOUND_PCT
+            else 'compute_bound')
+
+
+def sync_now():
+    """Run one aggregation round now (the every-N hook's body; callable
+    directly from tests/tools). All hosts contribute; process 0
+    publishes. Returns the published snapshot on process 0, else
+    None."""
+    if not enabled():
+        return None
+    st = _tele()
+    st.registry.counter('cluster.syncs').inc()
+    try:
+        mat = _allgather(_local_stats())
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('telemetry.cluster: sync failed: %s', e)
+        return None
+    try:
+        import jax
+        me = jax.process_index()
+    except Exception:  # noqa: BLE001
+        me = host_index()
+    if me != 0:
+        return None
+    with _state.lock:
+        steps = _state.steps
+    return _publish(mat, steps)
+
+
+def _publish(mat, steps):
+    """Turn one gathered (n_hosts, k) matrix into cluster.* gauges, a
+    JSONL record and the snapshot the summary table / endpoints read."""
+    st = _tele()
+    reg = st.registry
+    mat = np.asarray(mat, np.float64)
+    n = mat.shape[0]
+    per_host = []
+    for i in range(n):
+        row = {'host': i}
+        for j, key in enumerate(SYNC_KEYS):
+            row[key] = round(float(mat[i, j]), 3)
+        per_host.append(row)
+        reg.gauge('cluster.h%d.step_time_ms' % i).set(row['step_time_ms'])
+        reg.gauge('cluster.h%d.io_wait_pct' % i).set(row['io_wait_pct'])
+        reg.gauge('cluster.h%d.dispatch_ms' % i).set(row['dispatch_ms'])
+        reg.gauge('cluster.h%d.live_mb' % i).set(
+            round(row['live_bytes'] / 2.0**20, 1))
+    times = mat[:, 0]
+    slowest = int(np.argmax(times))
+    med = float(np.median(times))
+    spread = (float(times.max() - times.min()) / med * 100.0) if med > 0 \
+        else 0.0
+    straggler = 'balanced' if (n == 1 or spread < _SPREAD_BALANCED_PCT) \
+        else classify(float(mat[slowest, 1]))
+    reg.gauge('cluster.hosts').set(n)
+    reg.gauge('cluster.slowest_host').set(slowest)
+    reg.gauge('cluster.step_time_spread_pct').set(round(spread, 1))
+    reg.gauge('cluster.straggler_class').set(straggler)
+    snap = {'hosts': n, 'step': int(steps), 'per_host': per_host,
+            'slowest_host': slowest, 'spread_pct': round(spread, 1),
+            'straggler': straggler}
+    with _state.lock:
+        _state.snapshot = snap
+    if st.sink is not None:
+        rec = {'type': 'cluster'}
+        rec.update(snap)
+        st.sink.emit(rec)
+    return snap
+
+
+def snapshot_cluster():
+    """The last published aggregation round (process 0 only; None
+    before the first sync or on other hosts) — the summary table's
+    "Cluster" block and the /healthz digest's input."""
+    with _state.lock:
+        return dict(_state.snapshot) if _state.snapshot else None
+
+
+def _reset_for_tests():
+    global _state, _host
+    _state = _CState()
+    _host = None

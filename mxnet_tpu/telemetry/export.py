@@ -11,6 +11,8 @@ on a per-batch fsync.
 docs/perf.md documents ("Reading the telemetry summary").
 """
 import json
+import logging
+import os
 import threading
 import time
 
@@ -24,26 +26,74 @@ _io_calls = 0
 
 
 class JsonlSink:
-    """Append-only JSONL writer; thread-safe, buffered."""
+    """Append-only JSONL writer; thread-safe, buffered.
 
-    def __init__(self, path):
+    ``host`` (stamped by telemetry.cluster when the sink opens) labels
+    every record with this process's host index so multi-host logs
+    merge on it. ``max_bytes`` (MXTPU_TELEMETRY_MAX_MB) caps the file:
+    once the NEXT record would push the file past the cap, writing
+    stops for good — metrics stay live in-process and the
+    ``telemetry.dropped_records`` counter keeps the true drop count —
+    so a week-long run cannot fill a disk."""
+
+    def __init__(self, path, max_bytes=None):
         global _io_calls
         self.path = path
+        self.host = None
         self._lock = threading.Lock()
         self._buf = []
         self._closed = False
+        self._max_bytes = max_bytes
+        self._capped = False
+        try:
+            # append mode: what is already on disk counts against the cap
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
         _io_calls += 1
         self._f = open(path, 'a')
+
+    def _count_dropped(self):
+        from . import _state
+        if _state.active:
+            _state.registry.counter('telemetry.dropped_records').inc()
 
     def emit(self, record):
         if self._closed:
             return
+        if self._capped:
+            self._count_dropped()
+            return
         record.setdefault('t', time.time())
+        if self.host is not None:
+            record.setdefault('host', self.host)
         line = json.dumps(record)
+        tripped = False
+        raced = False
         with self._lock:
-            self._buf.append(line)
-            if len(self._buf) >= _FLUSH_EVERY:
-                self._flush_locked()
+            if self._capped:
+                # a concurrent emit tripped the cap between the
+                # unlocked check and here — it owns the one warning,
+                # this record is just another drop
+                raced = True
+            elif self._max_bytes is not None and \
+                    self._bytes + len(line) + 1 > self._max_bytes:
+                self._capped = True
+                tripped = True
+            else:
+                self._bytes += len(line) + 1
+                self._buf.append(line)
+                if len(self._buf) >= _FLUSH_EVERY:
+                    self._flush_locked()
+        if tripped:
+            logging.warning(
+                'telemetry: %s reached MXTPU_TELEMETRY_MAX_MB '
+                '(%.1f MB) — no further JSONL records will be written; '
+                'metrics stay live in-process and '
+                'telemetry.dropped_records counts the drops',
+                self.path, self._max_bytes / 2.0**20)
+        if tripped or raced:
+            self._count_dropped()
 
     def _flush_locked(self):
         global _io_calls
@@ -119,13 +169,48 @@ def _health_lines(health):
     return lines
 
 
-def summary_table(snapshot, elapsed_s=None, programs=None, health=None):
+def _cluster_lines(cluster):
+    """The "Cluster" block (telemetry.cluster.snapshot_cluster's dict):
+    one row per host from the last aggregation round, the spread, and
+    the straggler classification — rendered deterministically so the
+    offline CLI reproduces the live table byte-for-byte."""
+    lines = ['-- cluster --']
+    lines.append('  hosts             %s' % cluster.get('hosts'))
+    per = cluster.get('per_host') or []
+    if per:
+        lines.append('  host   step_ms    io_wait%   dispatch_ms  live_MiB')
+        slow = cluster.get('slowest_host')
+        for r in per:
+            mark = '*' if (r.get('host') == slow and len(per) > 1) else ''
+            lines.append('  %-5s  %-9s  %-9s  %-11s  %s'
+                         % ('%s%s' % (r.get('host'), mark),
+                            _fmt(r.get('step_time_ms')),
+                            _fmt(r.get('io_wait_pct')),
+                            _fmt(r.get('dispatch_ms')),
+                            _mib(r.get('live_bytes') or 0)))
+    if cluster.get('spread_pct') is not None:
+        lines.append('  step_time_spread  %s%%'
+                     % _fmt(float(cluster['spread_pct'])))
+    if cluster.get('straggler'):
+        extra = ''
+        if cluster.get('slowest_host') is not None and len(per) > 1:
+            extra = ' (slowest host %s)' % cluster['slowest_host']
+        lines.append('  straggler         %s%s'
+                     % (cluster['straggler'], extra))
+    return lines
+
+
+def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
+                  cluster=None):
     """Registry snapshot -> aligned text table (one block per kind).
     ``programs`` is telemetry.programs.snapshot_programs()'s {name:
     record} — rendered as a per-program cost table (and the redundant
     ``program.<name>.*`` gauges are elided from the gauges block);
     ``health`` is telemetry.health.snapshot_health()'s dict — rendered
-    as the "Run health" block."""
+    as the "Run health" block; ``cluster`` is
+    telemetry.cluster.snapshot_cluster()'s dict — rendered as the
+    "Cluster" block (its per-host ``cluster.*`` gauges are elided the
+    same way)."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
@@ -135,6 +220,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None):
         # one row per compiled program already carries these values
         gauges = {n: v for n, v in gauges.items()
                   if not n.startswith('program.')}
+    if cluster:
+        # the Cluster block already carries these values
+        gauges = {n: v for n, v in gauges.items()
+                  if not n.startswith('cluster.')}
     if counters:
         lines.append('-- counters --')
         w = max(len(n) for n in counters)
@@ -161,6 +250,8 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None):
                           _mib(r.get('temp_bytes', 0)),
                           _mib(r.get('argument_bytes', 0)),
                           _mib(r.get('output_bytes', 0))))
+    if cluster:
+        lines.extend(_cluster_lines(cluster))
     if health:
         lines.extend(_health_lines(health))
     if hists:

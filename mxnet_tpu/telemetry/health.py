@@ -44,9 +44,9 @@ import threading
 import numpy as np
 
 __all__ = ['TrainingHealthError', 'enabled', 'step_stats', 'decode',
-           'note_step', 'note_window', 'note_step_time', 'note_loss',
-           'detector', 'SpikeDetector', 'finite_report', 'has_nonfinite',
-           'summarize', 'snapshot_health']
+           'note_batch', 'note_step', 'note_window', 'note_step_time',
+           'note_loss', 'detector', 'SpikeDetector', 'finite_report',
+           'has_nonfinite', 'summarize', 'snapshot_health']
 
 # fixed head of the sentinel vector; per-output finite flags follow
 N_FIXED = 4
@@ -62,6 +62,15 @@ _MAX_INCIDENTS_KEPT = 16    # incident DICTS retained in memory; the
 _INPUT_BOUND_PCT = 30.0   # io-wait share of step time that classifies a
                           # run as input-bound
 
+# span families whose summed time is the input-bound denominator —
+# shared with tools/telemetry_report.py's offline twin so the live and
+# offline classifications can never drift apart
+FUSED_FIT_LOOP_SPANS = ('fused_fit.draw', 'fused_fit.put',
+                        'fused_fit.dispatch', 'fused_fit.fetch')
+EVAL_LOOP_SPANS = ('eval.dispatch', 'eval.metric', 'eval.fetch',
+                   'fused_eval.draw', 'fused_eval.put',
+                   'fused_eval.dispatch', 'fused_eval.fetch')
+
 
 class TrainingHealthError(RuntimeError):
     """Raised by MXTPU_HEALTH_ACTION=raise on a non-finite incident.
@@ -76,7 +85,8 @@ class TrainingHealthError(RuntimeError):
 class _HState:
     __slots__ = ('decided', 'active', 'action', 'incidents', 'anomaly_counts',
                  'last_anomaly', 'bisect_done', 'incident_warnings',
-                 'anomaly_warnings', 'detectors', 'input_bound_noted', 'lock')
+                 'anomaly_warnings', 'detectors', 'input_bound_noted',
+                 'cur_step', 'lock')
 
     def __init__(self):
         self.decided = False
@@ -90,6 +100,7 @@ class _HState:
         self.anomaly_warnings = {}
         self.detectors = {}
         self.input_bound_noted = False
+        self.cur_step = None
         self.lock = threading.Lock()
 
 
@@ -287,10 +298,23 @@ def _incident(info, bisect=None):
             logging.debug('%s', msg)
 
 
+def note_batch(step):
+    """Publish the fit loop's CURRENT batch index (None clears it).
+    The executor has no loop context, so its incidents used to carry
+    ``step=None``; the per-batch fit loop (and the fused tail path)
+    call this right before dispatch — only while the sentinels are on —
+    and :func:`note_step` falls back to it, so executor incidents name
+    the real step. fit() clears the context on exit so a later
+    custom-loop incident cannot inherit a stale index."""
+    _state.cur_step = None if step is None else int(step)
+
+
 def note_step(hv, source='executor', step=None, bisect=None):
     """Check one step's sentinel vector (per-batch executor path). The
     fetch of ``hv`` is this path's only added device sync — the
-    per-batch loop already synchronizes per batch for its metric."""
+    per-batch loop already synchronizes per batch for its metric.
+    ``step=None`` falls back to the fit loop's :func:`note_batch`
+    context (still None for drivers outside a fit loop)."""
     if not enabled():
         return None
     row = np.asarray(hv)
@@ -302,6 +326,8 @@ def note_step(hv, source='executor', step=None, bisect=None):
         _observe('grad_norm', info['grad_norm'])
     if not info['all_finite']:
         info['source'] = source
+        if step is None:
+            step = _state.cur_step
         if step is not None:
             info['step'] = step
         _incident(info, bisect=bisect)
@@ -507,14 +533,11 @@ def input_bound_pct():
     batch_h = reg.get('fit.batch')
     denom = batch_h.sum if batch_h is not None else 0.0
     if not denom:
-        for name in ('fused_fit.draw', 'fused_fit.put',
-                     'fused_fit.dispatch', 'fused_fit.fetch'):
+        for name in FUSED_FIT_LOOP_SPANS:
             h = reg.get(name)
             if h is not None:
                 denom += h.sum
-    for name in ('eval.dispatch', 'eval.metric', 'eval.fetch',
-                 'fused_eval.draw', 'fused_eval.put',
-                 'fused_eval.dispatch', 'fused_eval.fetch'):
+    for name in EVAL_LOOP_SPANS:
         h = reg.get(name)
         if h is not None:
             denom += h.sum

@@ -169,3 +169,30 @@ def test_parse_log_tool(tmp_path):
     assert lines[0] == 'epoch,train-accuracy,time,val-accuracy'
     assert lines[1].startswith('0,0.61,12.5,0.58')
     assert lines[2].startswith('1,0.82,11.9,0.79')
+
+
+def test_env_vars_doc_in_sync_with_flag_catalog():
+    """CI gate: every MXTPU_* flag declared in config.py has a
+    docs/env_vars.md entry and vice versa — flag docs cannot drift
+    (entries are lines of the form 'MXTPU_NAME [type, default ...]';
+    prose mentions like MXTPU_SEED or the bench-local variables are
+    intentionally outside the validated catalog and don't match)."""
+    import os
+    import re
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    with open(os.path.join(repo, 'docs', 'env_vars.md')) as f:
+        doc = f.read()
+    documented = set(re.findall(r'^(MXTPU_[A-Z0-9_]+) \[', doc, re.M))
+    declared = {f.name for f in flags}
+    undocumented = sorted(declared - documented)
+    assert not undocumented, (
+        'flags declared in config.py but missing from docs/env_vars.md: '
+        '%s' % undocumented)
+    stale = sorted(documented - declared)
+    assert not stale, (
+        'docs/env_vars.md entries with no config.py declaration: %s'
+        % stale)
+    # the catalog stays alphabetized (the doc's stated convention)
+    entries = re.findall(r'^(MXTPU_[A-Z0-9_]+) \[', doc, re.M)
+    assert entries == sorted(entries), 'env_vars.md entries not sorted'

@@ -136,6 +136,105 @@ def test_telemetry_report_health_block_from_summary(tmp_path, capsys):
     assert 'input_bound_pct   41.500' in out
 
 
+def _host_jsonl(tmp_path, host, step_ms, io_ms, steps=64, nonfinite=0):
+    """One host's telemetry log: a summary record whose histograms put
+    the host at ``step_ms`` per step with ``io_ms`` of prefetch wait."""
+    import json
+    snap = {'counters': {'fit.steps': steps},
+            'gauges': {'health.step_time_ms': step_ms},
+            'histograms': {
+                'fit.batch': {'count': steps, 'sum': step_ms * steps,
+                              'mean': step_ms, 'min': step_ms,
+                              'max': step_ms, 'p50': step_ms,
+                              'p95': step_ms},
+                'io.prefetch_wait': {'count': steps, 'sum': io_ms * steps,
+                                     'mean': io_ms, 'min': io_ms,
+                                     'max': io_ms, 'p50': io_ms,
+                                     'p95': io_ms}}}
+    rec = {'type': 'summary', 't': 50.0, 'host': host, 'elapsed_s': 5.0,
+           'snapshot': snap}
+    if nonfinite:
+        rec['health'] = {'nonfinite_steps': nonfinite, 'incidents': [],
+                         'anomaly_counts': {}, 'last_anomaly': None}
+    path = tmp_path / ('host%d.jsonl' % host)
+    with open(path, 'w') as f:
+        f.write(json.dumps({'type': 'start', 'pid': 1, 't': 45.0,
+                            'host': host}) + '\n')
+        f.write(json.dumps(rec) + '\n')
+    return str(path)
+
+
+def test_telemetry_report_multi_host(tmp_path, capsys):
+    """Multiple JSONL paths (one per host) merge on the host field and
+    render the per-host comparison plus the straggler classification:
+    the slow host with a dominant io-wait share reads input_bound."""
+    import telemetry_report
+    p0 = _host_jsonl(tmp_path, 0, step_ms=10.0, io_ms=0.5)
+    p1 = _host_jsonl(tmp_path, 1, step_ms=20.0, io_ms=9.0, nonfinite=2)
+    assert telemetry_report.main([p0, p1]) == 0
+    out = capsys.readouterr().out
+    assert '== per-host comparison (2 hosts) ==' in out
+    assert '1*' in out                       # slowest host marked
+    assert 'input_bound' in out              # 9/20 = 45% io-wait share
+    assert 'host 1 straggles — input_bound' in out
+    # both hosts' full tables follow the comparison
+    assert '== host 0 ==' in out and '== host 1 ==' in out
+    # a single path keeps the original single-run rendering
+    assert telemetry_report.main([p0]) == 0
+    out = capsys.readouterr().out
+    assert 'per-host comparison' not in out
+    assert 'telemetry summary' in out
+
+
+def test_telemetry_watch_render():
+    """The watch CLI's frame renderer (pure function): throughput, MFU,
+    health and per-host spread all land in the frame."""
+    import telemetry_watch
+    summary = {
+        'elapsed_s': 120.0, 'host': 0,
+        'snapshot': {
+            'counters': {'fit.steps': 640},
+            'gauges': {'xla.mfu': 0.42,
+                       'speedometer.samples_per_sec': 1234.5,
+                       'fit.input_bound_pct': 12.5},
+            'histograms': {'fit.batch': {
+                'count': 640, 'sum': 6400.0, 'mean': 10.0, 'min': 9.0,
+                'max': 30.0, 'p50': 10.0, 'p95': 12.0}}},
+        'health': {'nonfinite_steps': 1, 'incidents': [],
+                   'anomaly_counts': {'loss': 2},
+                   'last_anomaly': {'detector': 'loss', 'value': 9.0,
+                                    'baseline': 2.0}},
+        'cluster': {'hosts': 2, 'spread_pct': 40.0,
+                    'straggler': 'input_bound', 'slowest_host': 1,
+                    'per_host': [
+                        {'host': 0, 'step_time_ms': 10.0,
+                         'io_wait_pct': 2.0, 'dispatch_ms': 8.0},
+                        {'host': 1, 'step_time_ms': 20.0,
+                         'io_wait_pct': 45.0, 'dispatch_ms': 18.0}]},
+    }
+    frame = '\n'.join(telemetry_watch.render(summary, steps_per_s=5.25))
+    assert 'host 0' in frame and 'up 120s' in frame
+    assert 'steps 640' in frame and '5.25 steps/s' in frame
+    assert 'mfu          42.0%' in frame
+    assert 'p50 10 ms' in frame
+    assert 'DEGRADED (1 non-finite steps)' in frame
+    assert 'last_anomaly loss=9 (baseline 2)' in frame
+    assert 'straggler: input_bound' in frame
+    assert '1*' in frame
+
+
+def test_telemetry_watch_fetch_jsonl(tmp_path):
+    """File mode builds the same dashboard input the /summary endpoint
+    serves, from the last summary record."""
+    import telemetry_watch
+    path = _host_jsonl(tmp_path, 0, step_ms=10.0, io_ms=0.5)
+    summary = telemetry_watch.fetch(path)
+    assert summary['snapshot']['counters']['fit.steps'] == 64
+    assert summary['elapsed_s'] == 5.0
+    lines = telemetry_watch.render(summary)
+    assert any('throughput' in ln for ln in lines)
+
+
 def test_bandwidth_collectives_tiny():
     import bandwidth
     res = bandwidth.measure_collectives(sizes=[1024], iters=2)

@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""Render a telemetry JSONL log into the end-of-run summary table,
+"""Render telemetry JSONL logs into the end-of-run summary table,
 offline.
 
 A trace captured on a remote/CI machine (MXTPU_TELEMETRY=1 writes
@@ -16,6 +16,14 @@ log from a crashed run (no summary record) is reconstructed
 best-effort from the individual span / compile / program records —
 counters that only live in the registry (fit.steps etc.) cannot be
 recovered that way and the table says so.
+
+Multi-host jobs write one log per host (each record carries the
+``host`` field telemetry.cluster stamps). Handing every log to this
+tool merges them on that field and renders a per-host comparison —
+steps, step-time p50, io-wait share, non-finite steps — plus the same
+straggler classification the live cluster aggregation publishes::
+
+    python tools/telemetry_report.py host0.jsonl host1.jsonl ...
 """
 import argparse
 import json
@@ -129,34 +137,193 @@ def _reconstruct(records):
     return snapshot, elapsed, programs or None, _reconstruct_health(records)
 
 
-def render(records):
-    """The summary table for a parsed record list, as a string."""
+def _summary_parts(records):
+    """(snapshot, elapsed, programs, health, cluster, reconstructed)
+    for one host's record list — the last summary record when present,
+    else the crashed-run reconstruction."""
     summaries = [r for r in records if r.get('type') == 'summary']
+    clus_recs = [r for r in records if r.get('type') == 'cluster']
+    cluster = clus_recs[-1] if clus_recs else None
+    if cluster is not None:
+        cluster = {k: v for k, v in cluster.items()
+                   if k not in ('type', 't', 'host')}
     if summaries:
         s = summaries[-1]
-        return summary_table(s.get('snapshot') or {}, s.get('elapsed_s'),
-                             programs=s.get('programs'),
-                             health=s.get('health'))
+        return (s.get('snapshot') or {}, s.get('elapsed_s'),
+                s.get('programs'), s.get('health'),
+                s.get('cluster') or cluster, False)
     snapshot, elapsed, programs, health = _reconstruct(records)
+    return snapshot, elapsed, programs, health, cluster, True
+
+
+def render(records):
+    """The summary table for a parsed record list, as a string."""
+    snapshot, elapsed, programs, health, cluster, reco = \
+        _summary_parts(records)
     table = summary_table(snapshot, elapsed, programs=programs,
-                          health=health)
-    return table + ('\n(no summary record found — reconstructed from '
-                    '%d individual records; registry-only counters and '
-                    'gauges are not recoverable)' % len(records))
+                          health=health, cluster=cluster)
+    if reco:
+        table += ('\n(no summary record found — reconstructed from '
+                  '%d individual records; registry-only counters and '
+                  'gauges are not recoverable)' % len(records))
+    return table
+
+
+# ---------------------------------------------------------------------------
+# multi-host merge (one JSONL per host, records stamped with 'host')
+# ---------------------------------------------------------------------------
+
+def split_hosts(record_lists):
+    """Merge per-file record lists on the ``host`` field (records from
+    a pre-cluster log without the stamp fall back to the file index).
+    Files whose records share one host stamp (two processes both left
+    MXTPU_HOST_ID at 0) collapse into one key — warn, so the silent
+    keep-last-summary merge is visible."""
+    by_host = {}
+    hosts_per_file = []
+    for i, recs in enumerate(record_lists):
+        seen = set()
+        for r in recs:
+            host = r.get('host', i)
+            seen.add(host)
+            by_host.setdefault(host, []).append(r)
+        hosts_per_file.append(seen)
+    nonempty = sum(1 for s in hosts_per_file if s)
+    if len(by_host) < nonempty:
+        sys.stderr.write(
+            'telemetry_report: %d files merged into %d host(s) — '
+            'multiple logs carry the same host stamp (set distinct '
+            'MXTPU_HOST_ID per process); only the last summary record '
+            'per host renders\n' % (nonempty, len(by_host)))
+    return by_host
+
+
+def _io_share(snapshot):
+    """io.prefetch_wait share (%) of the driven loop time — the offline
+    twin of telemetry.health.input_bound_pct, over a snapshot dict
+    (same span families, shared constants: the two cannot drift)."""
+    from mxnet_tpu.telemetry.health import (FUSED_FIT_LOOP_SPANS,
+                                            EVAL_LOOP_SPANS)
+    hists = snapshot.get('histograms', {})
+    io = hists.get('io.prefetch_wait')
+    if not io or not io.get('count'):
+        return None
+    denom = (hists.get('fit.batch') or {}).get('sum') or 0.0
+    if not denom:
+        for name in FUSED_FIT_LOOP_SPANS:
+            denom += (hists.get(name) or {}).get('sum') or 0.0
+    for name in EVAL_LOOP_SPANS:
+        denom += (hists.get(name) or {}).get('sum') or 0.0
+    if denom <= 0.0:
+        return None
+    return min(100.0, 100.0 * io['sum'] / denom)
+
+
+def _step_ms(snapshot):
+    """Best available per-step milliseconds for one host, normalized so
+    hosts are commensurate: the fit.batch p50 (per-step median) when
+    the per-batch loop ran, else the fused window's dispatch p50
+    divided by its steps-per-call (one observation covers W steps),
+    else the last health.step_time_ms sample (per-step, but
+    last-write-wins — noisier)."""
+    hists = snapshot.get('histograms', {})
+    g = snapshot.get('gauges', {})
+    h = hists.get('fit.batch')
+    if h and h.get('count') and h.get('p50') is not None:
+        return float(h['p50'])
+    h = hists.get('fused_fit.dispatch')
+    w = g.get('fused_fit.steps_per_call')
+    if h and h.get('count') and h.get('p50') is not None and w:
+        return float(h['p50']) / float(w)
+    if g.get('health.step_time_ms') is not None:
+        return float(g['health.step_time_ms'])
+    return None
+
+
+def render_hosts(by_host):
+    """The per-host comparison table + straggler classification, then
+    each host's full summary table — the offline twin of the live
+    cluster aggregation (telemetry/cluster.py)."""
+    from mxnet_tpu.telemetry.cluster import classify, _SPREAD_BALANCED_PCT
+    rows = []
+    for host in sorted(by_host):
+        snapshot, elapsed, programs, health, cluster, reco = \
+            _summary_parts(by_host[host])
+        steps = snapshot.get('counters', {}).get('fit.steps')
+        if steps is None:
+            steps = (snapshot.get('histograms', {})
+                     .get('fit.batch') or {}).get('count')
+        if steps is not None and float(steps).is_integer():
+            steps = int(steps)   # registry counters are floats
+        rows.append({'host': host, 'steps': steps,
+                     'step_ms': _step_ms(snapshot),
+                     'io_wait_pct': _io_share(snapshot),
+                     'nonfinite': int((health or {})
+                                      .get('nonfinite_steps') or 0),
+                     'records': by_host[host]})
+    times = [r['step_ms'] for r in rows if r['step_ms'] is not None]
+    slowest = None
+    spread = None
+    if times:
+        import statistics
+        slowest = max((r for r in rows if r['step_ms'] is not None),
+                      key=lambda r: r['step_ms'])['host']
+        # true median, matching cluster._publish's np.median — the
+        # offline verdict must agree with the live one at the threshold
+        med = statistics.median(times)
+        spread = ((max(times) - min(times)) / med * 100.0) if med else 0.0
+    lines = ['== per-host comparison (%d hosts) ==' % len(rows)]
+    lines.append('  host    steps   step_ms   io_wait%  nonfinite  class')
+    for r in rows:
+        mark = '*' if (r['host'] == slowest and len(rows) > 1) else ''
+        # no io-wait data = no classification; a confident
+        # 'compute_bound' with a '-' io column would be fabricated
+        cls = '-' if r['io_wait_pct'] is None else classify(r['io_wait_pct'])
+        lines.append('  %-6s  %-6s  %-8s  %-8s  %-9s  %s'
+                     % ('%s%s' % (r['host'], mark),
+                        '-' if r['steps'] is None else r['steps'],
+                        '-' if r['step_ms'] is None
+                        else '%.3f' % r['step_ms'],
+                        '-' if r['io_wait_pct'] is None
+                        else '%.1f' % r['io_wait_pct'],
+                        r['nonfinite'], cls))
+    if spread is not None and len(rows) > 1:
+        if spread < _SPREAD_BALANCED_PCT:
+            verdict = 'balanced (step-time spread %.1f%%)' % spread
+        else:
+            slow_row = next(r for r in rows if r['host'] == slowest)
+            cls = 'unclassified (no io-wait data)' \
+                if slow_row['io_wait_pct'] is None \
+                else classify(slow_row['io_wait_pct'])
+            verdict = ('host %s straggles — %s (step-time spread %.1f%%)'
+                       % (slowest, cls, spread))
+        lines.append('  straggler: %s' % verdict)
+    out = ['\n'.join(lines)]
+    for r in rows:
+        out.append('')
+        out.append('== host %s ==' % r['host'])
+        out.append(render(r['records']))
+    return '\n'.join(out)
 
 
 def main(argv=None):
     ap = argparse.ArgumentParser(
-        description='Render a telemetry JSONL log (MXTPU_TELEMETRY_PATH) '
-                    'into the end-of-run summary table, offline.')
-    ap.add_argument('path', help='telemetry JSONL file to render')
+        description='Render telemetry JSONL logs (MXTPU_TELEMETRY_PATH) '
+                    'into the end-of-run summary table, offline. Multiple '
+                    'paths (one per host) merge on the host field and add '
+                    'a per-host comparison + straggler classification.')
+    ap.add_argument('paths', nargs='+',
+                    help='telemetry JSONL file(s) to render')
     args = ap.parse_args(argv)
-    records = load(args.path)
-    if not records:
-        sys.stderr.write('telemetry_report: %s holds no records\n'
-                         % args.path)
+    record_lists = [load(p) for p in args.paths]
+    if not any(record_lists):
+        sys.stderr.write('telemetry_report: %s hold(s) no records\n'
+                         % ', '.join(args.paths))
         return 1
-    print(render(records))
+    if len(record_lists) == 1:
+        print(render(record_lists[0]))
+        return 0
+    print(render_hosts(split_hosts(record_lists)))
     return 0
 
 

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Watch a live run: a top-style dashboard over the telemetry plane.
+
+Polls the live endpoint a run exposes with ``MXTPU_TELEMETRY=1
+MXTPU_TELEMETRY_PORT=<p>`` (telemetry/serve.py) — or tails a JSONL log
+when given a file path — and renders throughput, MFU, run health and
+the per-host cluster spread, refreshing in place::
+
+    python tools/telemetry_watch.py http://tpu-host:9100
+    python tools/telemetry_watch.py telemetry.jsonl
+    python tools/telemetry_watch.py http://tpu-host:9100 --interval 5
+    python tools/telemetry_watch.py http://tpu-host:9100 --once   # one frame
+
+The HTTP mode reads ``/summary`` (the registry snapshot + health +
+cluster as JSON); the file mode reuses tools/telemetry_report.py's
+loader, so a crashed run's partial log renders too.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+_TOOLS = os.path.dirname(os.path.abspath(__file__))
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+_CLEAR = '\x1b[2J\x1b[H'   # clear screen + home (refresh in place)
+
+
+def fetch(source):
+    """One dashboard input dict (the /summary JSON shape) from an HTTP
+    base URL or a JSONL path."""
+    if source.startswith(('http://', 'https://')):
+        url = source.rstrip('/') + '/summary'
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return json.loads(r.read().decode('utf-8'))
+    import telemetry_report
+    records = telemetry_report.load(source)
+    summaries = [r for r in records if r.get('type') == 'summary']
+    clus = [r for r in records if r.get('type') == 'cluster']
+    if summaries:
+        s = summaries[-1]
+        return {'elapsed_s': s.get('elapsed_s'),
+                'host': s.get('host'),
+                'snapshot': s.get('snapshot') or {},
+                'programs': s.get('programs'),
+                'health': s.get('health'),
+                'cluster': s.get('cluster')
+                or (clus[-1] if clus else None)}
+    snapshot, elapsed, programs, health = telemetry_report._reconstruct(
+        records)
+    return {'elapsed_s': elapsed, 'host': None, 'snapshot': snapshot,
+            'programs': programs, 'health': health,
+            'cluster': clus[-1] if clus else None}
+
+
+def _fmt(v, suffix=''):
+    if v is None:
+        return '-'
+    if isinstance(v, float):
+        return ('%.3g' % v) + suffix
+    return str(v) + suffix
+
+
+def render(summary, steps_per_s=None):
+    """The dashboard frame for one /summary dict, as a list of lines
+    (pure — tested offline). ``steps_per_s`` is the poll-to-poll step
+    rate the caller measured."""
+    snap = summary.get('snapshot') or {}
+    c = snap.get('counters', {})
+    g = snap.get('gauges', {})
+    h = snap.get('histograms', {})
+    lines = []
+    head = 'mxnet_tpu live telemetry'
+    if summary.get('host') is not None:
+        head += ' — host %s' % summary['host']
+    if summary.get('elapsed_s'):
+        head += ' — up %.0fs' % summary['elapsed_s']
+    lines.append(head)
+    lines.append('')
+    steps = c.get('fit.steps')
+    rate_bits = []
+    if steps is not None:
+        rate_bits.append('steps %d' % steps)
+    if steps_per_s is not None:
+        rate_bits.append('%.2f steps/s' % steps_per_s)
+    sps = g.get('speedometer.samples_per_sec') or g.get('eval_samples_per_sec')
+    if sps is not None:
+        rate_bits.append('%s samples/s' % _fmt(float(sps)))
+    lines.append('  throughput   %s' % (', '.join(rate_bits) or '-'))
+    if g.get('xla.mfu') is not None:
+        lines.append('  mfu          %.1f%%' % (100.0 * float(g['xla.mfu'])))
+    fb = h.get('fit.batch')
+    if fb and fb.get('count'):
+        lines.append('  step_time    p50 %s ms  p95 %s ms'
+                     % (_fmt(fb.get('p50')), _fmt(fb.get('p95'))))
+    else:
+        # fused loop: the dispatch histogram is per-WINDOW (W steps);
+        # normalize so the line reads per-step like the cluster rows
+        fd = h.get('fused_fit.dispatch')
+        w = g.get('fused_fit.steps_per_call')
+        if fd and fd.get('count') and fd.get('p50') is not None and w:
+            lines.append('  step_time    ~%s ms/step '
+                         '(window dispatch p50 / %d)'
+                         % (_fmt(float(fd['p50']) / float(w)), int(w)))
+    if g.get('fit.input_bound_pct') is not None:
+        lines.append('  io_wait      %s%% of loop time'
+                     % _fmt(float(g['fit.input_bound_pct'])))
+    if g.get('xla.bytes_in_use') is not None:
+        lines.append('  device_mem   %.1f MiB live, %.1f MiB peak'
+                     % (g['xla.bytes_in_use'] / 2.0**20,
+                        (g.get('xla.peak_bytes_in_use')
+                         or g['xla.bytes_in_use']) / 2.0**20))
+    hs = summary.get('health')
+    if hs is not None:
+        bad = int(hs.get('nonfinite_steps') or 0)
+        status = 'ok' if not bad else 'DEGRADED (%d non-finite steps)' % bad
+        lines.append('  health       %s' % status)
+        last = hs.get('last_anomaly')
+        if last:
+            lines.append('  last_anomaly %s=%s (baseline %s)'
+                         % (last.get('detector', '?'),
+                            _fmt(last.get('value')),
+                            _fmt(last.get('baseline'))))
+    clus = summary.get('cluster')
+    if clus:
+        lines.append('')
+        lines.append('  cluster (%s hosts, spread %s%%, straggler: %s)'
+                     % (clus.get('hosts'), _fmt(clus.get('spread_pct')),
+                        clus.get('straggler', '-')))
+        lines.append('    host   step_ms    io_wait%   dispatch_ms')
+        slow = clus.get('slowest_host')
+        per = clus.get('per_host') or []
+        for r in per:
+            mark = '*' if (r.get('host') == slow and len(per) > 1) else ''
+            lines.append('    %-5s  %-9s  %-9s  %s'
+                         % ('%s%s' % (r.get('host'), mark),
+                            _fmt(r.get('step_time_ms')),
+                            _fmt(r.get('io_wait_pct')),
+                            _fmt(r.get('dispatch_ms'))))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Live top-style view of a telemetry endpoint '
+                    '(http://host:MXTPU_TELEMETRY_PORT) or JSONL log.')
+    ap.add_argument('source', help='endpoint base URL or JSONL path')
+    ap.add_argument('--interval', type=float, default=2.0,
+                    help='poll interval in seconds (default 2)')
+    ap.add_argument('--once', action='store_true',
+                    help='render one frame and exit (no screen clear)')
+    args = ap.parse_args(argv)
+    prev_steps = prev_t = None
+    while True:
+        try:
+            summary = fetch(args.source)
+        except Exception as e:  # noqa: BLE001 — endpoint racing startup
+            sys.stderr.write('telemetry_watch: %s\n' % e)
+            if args.once:
+                return 1
+            time.sleep(args.interval)
+            continue
+        now = time.time()
+        steps = (summary.get('snapshot') or {}).get('counters', {}) \
+            .get('fit.steps')
+        rate = None
+        if None not in (steps, prev_steps, prev_t) and now > prev_t:
+            rate = max(0.0, (steps - prev_steps) / (now - prev_t))
+        prev_steps, prev_t = steps, now
+        frame = '\n'.join(render(summary, steps_per_s=rate))
+        if args.once:
+            print(frame)
+            return 0
+        sys.stdout.write(_CLEAR + frame + '\n')
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
