@@ -174,6 +174,64 @@ flags.declare('MXTPU_TELEMETRY_MAX_MB', float, 0.0,
               'telemetry.dropped_records, warned once) instead of '
               'filling the disk on week-long runs. 0 = unlimited',
               min_value=0.0)
+flags.declare('MXTPU_TELEMETRY_BIND', str, '127.0.0.1',
+              'Bind address for the live telemetry endpoint '
+              '(telemetry/serve.py). Default 127.0.0.1 = loopback only; '
+              "set to '0.0.0.0' (or empty) to expose /metrics /healthz "
+              '/summary on all interfaces — do that only behind scrape-'
+              'infra access control (docs/observability.md)')
+flags.declare('MXTPU_CKPT_DIR', str, '',
+              'Root directory for periodic sharded training checkpoints '
+              '(module/checkpointing.py over parallel/checkpoint.py\'s '
+              'orbax tier): each host writes only its own shards, so '
+              'save/restore cost scales with per-host bytes, not model '
+              'size. Must be a path every host of a multi-host job can '
+              'reach. Empty (default) = checkpointing off')
+flags.declare('MXTPU_CKPT_EVERY', int, 0,
+              'Save a training checkpoint every N trained steps '
+              '(quantized to window boundaries on the fused-fit path). '
+              'Captures params, optimizer state, RNG streams, epoch/'
+              'step cursor and eval-metric state; saves are '
+              'asynchronous — the step loop is never blocked on the '
+              'write. 0 (default) = off (MXTPU_CKPT_DIR must also be '
+              'set)', min_value=0)
+flags.declare('MXTPU_CKPT_KEEP', int, 3,
+              'How many checkpoint steps to retain (orbax max_to_keep '
+              'pruning); older steps are deleted as new ones commit',
+              min_value=1)
+flags.declare('MXTPU_CKPT_ASYNC', bool, True,
+              'Write checkpoints on a background thread (the step loop '
+              'only captures array references and moves on). If the '
+              'async writer dies, checkpointing falls back to '
+              'synchronous saves — and if those fail too, training '
+              'continues without checkpoints (warn, never crash). 0 '
+              'forces synchronous saves from the start')
+flags.declare('MXTPU_CKPT_RESUME', bool, True,
+              'At fit() start, restore from the newest health-certified '
+              'checkpoint (the last-good pointer) when MXTPU_CKPT_DIR '
+              'holds one: parameters, optimizer state, RNG streams and '
+              'the epoch/step cursor come back bit-exactly and the '
+              'data iterator is skipped to the restored step. 0 always '
+              'starts fresh (existing checkpoints are left alone)')
+flags.declare('MXTPU_RESTART_MAX', int, 3,
+              'Restart budget for the supervised training driver '
+              '(module/resilient_fit.py, tools/train_supervisor.py): '
+              'how many times a failed run is restored from last-good '
+              'and resumed before the failure is re-raised', min_value=0)
+flags.declare('MXTPU_RESTART_BACKOFF', float, 2.0,
+              'Base backoff (seconds) between supervised restarts; '
+              'attempt k waits backoff * 2^(k-1), capped at 60s',
+              min_value=0.0)
+flags.declare('MXTPU_FAULT_INJECT', str, '',
+              'Deterministic fault injection (mxnet_tpu/faults.py): '
+              "'<kind>:<step>[:<arg>]' with kind one of nan-grad, "
+              'checkpoint-corrupt, dispatch-exception, '
+              'backend-probe-timeout, slow-host — fires one real fault '
+              'at a deterministic training step so every recovery path '
+              '(health raise, restore-from-last-good, restart backoff, '
+              'bench reprobe) is exercised by real tests, not mocks. '
+              'Empty (default) = off: every seam is one cached-bool '
+              'check and the compiled programs are untouched')
 flags.declare('MXTPU_HEALTH', bool, False,
               'Training-health sentinels (telemetry/health, requires '
               'MXTPU_TELEMETRY=1): in-graph NaN/Inf detection with '

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from .base import MXNetError, np_dtype
 from .context import Context
+from . import faults as _faults
 from . import random as _random
 from .ndarray.ndarray import NDArray, zeros as nd_zeros, from_jax
 from .ops import registry as _reg
@@ -414,6 +415,10 @@ class Executor:
             aux_data = tuple(a._data for a in self.aux_arrays)
             key = _random.next_key()
         heads = self._head_grads(out_grads, arg_data, aux_data)
+        if _faults.enabled():
+            # dispatch-exception seam: the per-batch loop's fused
+            # fwd+bwd is about to train one step
+            _faults.maybe_raise('executor')
         hv = None
         if self._health_on:
             outs, new_aux, grads, hv = self._fwd_bwd(arg_data, aux_data,

@@ -1,0 +1,292 @@
+"""Deterministic fault injection for the resilience test matrix.
+
+``MXTPU_FAULT_INJECT=<kind>:<step>[:<arg>]`` arms ONE fault that fires
+at a deterministic training step, so every recovery path in the
+resilient training stack (module/checkpointing.py restore-from-last-
+good, module/resilient_fit.py restart loop, tools/train_supervisor.py)
+is exercised by real failures instead of mocks. Kinds:
+
+- ``nan-grad:<k>``       — poison the k-th drawn training batch with a
+  NaN (host-side, before upload), so step k computes non-finite
+  gradients: the health sentinels detect it at the exact step, the
+  bisect names the input, and MXTPU_HEALTH_ACTION=raise turns it into
+  the TrainingHealthError the restart driver recovers from. Fires once.
+- ``checkpoint-corrupt:<k>`` — scribble over the data files of the
+  first checkpoint saved at step >= k AFTER it commits, so a later
+  restore of that step fails and the restore path must fall back to an
+  older checkpoint. Fires once.
+- ``dispatch-exception:<k>[:<seam>]`` — raise :class:`FaultInjected`
+  from a dispatch seam (the fused-fit window dispatch, the executor's
+  fused fwd+bwd, or the kvstore push) when the training-step counter
+  reaches k. ``seam`` restricts which seam fires ('dispatch',
+  'executor', 'kvstore'; default: whichever reaches the step first).
+  Fires once.
+- ``backend-probe-timeout:<n>`` — bench.py's device-backend probe
+  reports a timeout for its first n attempts (the r02/r04 flaky-tunnel
+  shape), exercising the exponential-backoff reprobe path. bench.py
+  parses this flag itself (it must not import the framework before its
+  backend decision).
+- ``slow-host:<k>[:<ms>]`` — sleep ``ms`` (default 50) per training
+  step from step k on, persistently: this host becomes the straggler
+  the cluster telemetry names. Never disarms.
+
+Off (the default, flag empty) every seam is one cached-bool check —
+the same zero-overhead contract the telemetry stack keeps. Nothing
+here is ever traced into a compiled program: injection happens at
+host-side seams (batch draw, dispatch call, checkpoint commit), so the
+lowered XLA programs are byte-identical with the harness armed or not.
+"""
+import logging
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = ['FaultInjected', 'enabled', 'spec', 'note_steps',
+           'maybe_poison_snap', 'maybe_poison_batch', 'maybe_raise',
+           'maybe_corrupt_checkpoint']
+
+KINDS = ('nan-grad', 'checkpoint-corrupt', 'dispatch-exception',
+         'backend-probe-timeout', 'slow-host')
+
+_SLOW_DEFAULT_MS = 50.0
+
+
+class FaultInjected(RuntimeError):
+    """Raised by an armed ``dispatch-exception`` fault; carries the
+    seam and step for the restart driver's restart record."""
+
+    def __init__(self, message, seam=None, step=None):
+        super().__init__(message)
+        self.seam = seam
+        self.step = step
+
+
+class _FState:
+    __slots__ = ('decided', 'active', 'kind', 'step', 'arg', 'drawn',
+                 'steps', 'fired', 'lock')
+
+    def __init__(self):
+        self.decided = False
+        self.active = False
+        self.kind = None
+        self.step = 0
+        self.arg = None
+        self.drawn = 0      # training batches drawn so far (draw order
+        self.steps = 0      # == step order in every fit loop)
+        self.fired = False
+        self.lock = threading.Lock()
+
+
+_state = _FState()
+_decide_lock = threading.Lock()
+
+
+def _parse(raw):
+    """'<kind>:<step>[:<arg>]' -> (kind, step, arg) or None."""
+    parts = raw.split(':')
+    if len(parts) < 2 or parts[0] not in KINDS:
+        raise ValueError(
+            'MXTPU_FAULT_INJECT=%r: expected <kind>:<step>[:<arg>] with '
+            'kind one of %s' % (raw, list(KINDS)))
+    return parts[0], int(parts[1]), (parts[2] if len(parts) > 2 else None)
+
+
+def _decide():
+    with _decide_lock:
+        if _state.decided:
+            return _state.active
+        raw = ''
+        try:
+            from .config import flags
+            flags.reload('MXTPU_FAULT_INJECT')
+            raw = flags.get('MXTPU_FAULT_INJECT') or ''
+        except Exception:  # noqa: BLE001 — stripped builds without the flag
+            raw = os.environ.get('MXTPU_FAULT_INJECT', '')
+        raw = raw.strip()
+        if raw:
+            try:
+                _state.kind, _state.step, _state.arg = _parse(raw)
+                _state.active = True
+                logging.warning('fault injection armed: %s at step %d%s',
+                                _state.kind, _state.step,
+                                ' (%s)' % _state.arg if _state.arg else '')
+            except ValueError as e:
+                logging.warning('%s — fault injection disabled', e)
+        _state.decided = True
+    return _state.active
+
+
+def enabled():
+    """Whether a fault is armed (decided once from MXTPU_FAULT_INJECT).
+    One attribute check after the first call — the seams' gate."""
+    if _state.decided:
+        return _state.active
+    return _decide()
+
+
+def spec():
+    """(kind, step, arg) of the armed fault, or None."""
+    if not enabled():
+        return None
+    return _state.kind, _state.step, _state.arg
+
+
+def note_steps(n=1):
+    """Advance the trained-step counter (fed by the fit loops at the
+    same sites that count fit.steps). An armed ``slow-host`` fault
+    sleeps here once the counter passes its step."""
+    if not enabled():
+        return
+    with _state.lock:
+        _state.steps += n
+        slow = (_state.kind == 'slow-host' and _state.steps > _state.step)
+    if slow:
+        try:
+            ms = float(_state.arg) if _state.arg else _SLOW_DEFAULT_MS
+        except ValueError:
+            ms = _SLOW_DEFAULT_MS
+        time.sleep(n * ms / 1e3)
+
+
+def _poison(arr):
+    """One NaN planted at the origin of a float array (jax or numpy);
+    non-float arrays come back unchanged."""
+    import jax.numpy as jnp
+    idx = tuple(0 for _ in arr.shape)
+    if isinstance(arr, np.ndarray):
+        if arr.dtype.kind != 'f':
+            return arr, False
+        out = arr.copy()
+        out[idx] = np.nan
+        return out, True
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        return arr.at[idx].set(jnp.nan), True
+    return arr, False
+
+
+def _poison_arrays(datas, labels):
+    """Poison the first float array among datas then labels (defer-mode
+    uint8 batches fall through to the label). Returns (datas, labels,
+    poisoned_any)."""
+    datas = list(datas)
+    for i, a in enumerate(datas):
+        out, ok = _poison(a)
+        if ok:
+            datas[i] = out
+            return tuple(datas), tuple(labels), True
+    labels = list(labels)
+    for i, a in enumerate(labels):
+        out, ok = _poison(a)
+        if ok:
+            labels[i] = out
+            return tuple(datas), tuple(labels), True
+    return tuple(datas), tuple(labels), False
+
+
+def _armed_draw():
+    """True when THIS draw is the poisoned one (advances the counter)."""
+    with _state.lock:
+        hit = (_state.kind == 'nan-grad' and not _state.fired
+               and _state.drawn == _state.step)
+        _state.drawn += 1
+        if hit:
+            _state.fired = True
+    return hit
+
+
+def _note_poison(hit):
+    if hit:
+        logging.warning('fault injection: nan-grad fired on batch %d',
+                        _state.step)
+    else:
+        # the armed draw is consumed either way (firing at a LATER step
+        # than requested would be worse) — but dropping the fault
+        # silently would make a hung chaos test undebuggable
+        logging.warning(
+            'fault injection: nan-grad armed for batch %d but the batch '
+            'holds no float array (defer-mode uint8 data, int labels?) '
+            '— fault NOT injected', _state.step)
+
+
+def maybe_poison_snap(snap):
+    """Fused-loop draw seam: one (data_arrays, label_arrays, pad, index)
+    draw-time snapshot in, possibly NaN-poisoned out. Counts every
+    drawn training batch so the armed step is a global batch index."""
+    if not _armed_draw():
+        return snap
+    ds, ls, pad, idx = snap
+    ds, ls, hit = _poison_arrays(ds, ls)
+    _note_poison(hit)
+    return ds, ls, pad, idx
+
+
+def maybe_poison_batch(batch):
+    """Per-batch-loop draw seam: poison a DataBatch's NDArrays in place
+    (same counter as :func:`maybe_poison_snap`)."""
+    if not _armed_draw():
+        return batch
+    ds = tuple(a._data for a in batch.data)
+    ls = tuple(a._data for a in (batch.label or ()))
+    ds, ls, hit = _poison_arrays(ds, ls)
+    if hit:
+        for a, v in zip(batch.data, ds):
+            a._data = v
+        for a, v in zip(batch.label or (), ls):
+            a._data = v
+    _note_poison(hit)
+    return batch
+
+
+def maybe_raise(seam, upcoming=1):
+    """Dispatch seam: raise :class:`FaultInjected` when an armed
+    ``dispatch-exception`` fault's step falls inside the ``upcoming``
+    steps this dispatch is about to advance (the fused window passes
+    its window size). ``arg`` (when set) restricts the firing seam."""
+    if not enabled():
+        return
+    with _state.lock:
+        if (_state.kind != 'dispatch-exception' or _state.fired
+                or _state.steps + upcoming <= _state.step):
+            return
+        if _state.arg and _state.arg != seam:
+            return
+        _state.fired = True
+        step = _state.step
+    raise FaultInjected(
+        'injected dispatch failure at the %s seam (step %d)'
+        % (seam, step), seam=seam, step=step)
+
+
+def maybe_corrupt_checkpoint(directory, step):
+    """Checkpoint seam (called after a save commits): truncate the
+    committed step's data files so a later restore of it fails. Fires
+    on the first save at step >= the armed step."""
+    if not enabled():
+        return False
+    with _state.lock:
+        hit = (_state.kind == 'checkpoint-corrupt' and not _state.fired
+               and int(step) >= _state.step)
+        if hit:
+            _state.fired = True
+    if not hit:
+        return False
+    n = 0
+    for root, _, files in os.walk(os.path.join(str(directory), str(step))):
+        for name in files:
+            try:
+                with open(os.path.join(root, name), 'r+b') as f:
+                    f.truncate(2)
+                n += 1
+            except OSError:
+                pass
+    logging.warning('fault injection: checkpoint-corrupt fired — '
+                    'truncated %d file(s) of step %s in %s',
+                    n, step, directory)
+    return True
+
+
+def _reset_for_tests():
+    global _state
+    _state = _FState()

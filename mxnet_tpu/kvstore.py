@@ -19,6 +19,7 @@ import numpy as np
 
 import jax
 
+from . import faults as _faults
 from . import optimizer as opt
 from . import telemetry as _tele
 from .ndarray import NDArray, zeros
@@ -70,6 +71,10 @@ class KVStore:
         """Reduce value(s) per key; run updater or store the merged grad
         (reference kvstore_local.h:149 PushImpl)."""
         with _tele.span('kvstore.push', 'kvstore'):
+            if _faults.enabled():
+                # dispatch-exception seam: the grad push that would
+                # train the current step
+                _faults.maybe_raise('kvstore')
             keys, values = _key_value(key, value)
             if _tele.enabled():
                 _tele_bytes('kvstore.push_bytes', values)

@@ -10,6 +10,7 @@ import warnings
 
 import numpy as np
 
+from .. import faults as _faults
 from .. import metric as metric_mod
 from .. import ndarray as nd
 from .. import profiler as _profiler
@@ -243,6 +244,18 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
 
+        # resilience tier (module/checkpointing.py): periodic async
+        # sharded checkpoints + restore-from-last-good, built from the
+        # MXTPU_CKPT_* flags. Restore happens HERE — before the fused
+        # window programs are built — so a resumed run binds the same
+        # programs a fresh one would. Flags off = None, nothing runs.
+        from .checkpointing import TrainCheckpointer
+        ckpt = TrainCheckpointer.for_fit(self, eval_metric,
+                                         logger=self.logger)
+        # fault-injection harness (mxnet_tpu/faults.py): one cached
+        # bool; every seam below is dead code while the flag is unset
+        faults_on = _faults.enabled()
+
         # TPU fast path: compile a window of N steps into one XLA call
         # (lax.scan) when the module/optimizer/metric combination allows
         # it — same numerics, one dispatch per window instead of four
@@ -260,70 +273,119 @@ class BaseModule:
         health_on = _tele.health.enabled()
         cluster_on = _tele.cluster.enabled()
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            if fused is not None:
-                nbatch = fused.run_epoch(train_data, eval_metric, epoch,
-                                         batch_end_callback)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                if ckpt is not None and not ckpt.begin_epoch(
+                        epoch, eval_metric, train_data):
+                    # resume fast-forward: this epoch was fully trained
+                    # before the restore point — skip it without touching
+                    # the data or running its eval
+                    continue
+                if fused is not None:
+                    nbatch = fused.run_epoch(train_data, eval_metric, epoch,
+                                             batch_end_callback, ckpt=ckpt)
+                    self._fit_epoch_end(epoch, eval_metric, tic,
+                                        epoch_end_callback, eval_data,
+                                        validation_metric, eval_end_callback,
+                                        eval_batch_end_callback)
+                    train_data.reset()
+                    continue
+                # a resumed epoch's first batch IS batch r_step: true
+                # batch-in-epoch indices for callbacks and incidents
+                nbatch = ckpt.epoch_nbatch_base if ckpt is not None else 0
+                data_iter = iter(train_data)
+                end_of_batch = False
+                next_data_batch = None
+                try:
+                    next_data_batch = next(data_iter)
+                except StopIteration:
+                    if ckpt is None or not ckpt.allow_empty_epoch(epoch):
+                        raise
+                    # the restore point was exactly this epoch's boundary:
+                    # the resume skip consumed every batch, so the epoch
+                    # is already trained — fall through to its epoch end
+                    end_of_batch = True
+                while not end_of_batch:
+                    data_batch = next_data_batch
+                    if faults_on:
+                        # nan-grad draw seam (batches counted in step order)
+                        data_batch = _faults.maybe_poison_batch(data_batch)
+                    if monitor is not None:
+                        monitor.tic()
+                    t_step = time.time() if health_on else 0.0
+                    if health_on:
+                        # executor-level incidents carry the real batch index
+                        _tele.health.note_batch(nbatch)
+                    # per-batch telemetry: host-dispatch vs draw vs metric vs
+                    # callback time (all no-ops unless MXTPU_TELEMETRY=1 or
+                    # the chrome-trace profiler is running)
+                    with _tele.span('fit.batch', 'fit'):
+                        with _tele.span('fit.dispatch', 'fit'):
+                            self.forward_backward(data_batch)
+                            self.update()
+                        _tele.counter('fit.steps').inc()
+                        # MXTPU_XPROF step-windowed device-trace capture
+                        _profiler.note_step()
+                        try:
+                            with _tele.span('fit.draw', 'fit'):
+                                next_data_batch = next(data_iter)
+                            self.prepare(next_data_batch)
+                        except StopIteration:
+                            end_of_batch = True
+                        with _tele.span('fit.metric', 'fit'):
+                            self.update_metric(eval_metric, data_batch.label)
+                        if monitor is not None:
+                            monitor.toc_print()
+                        if batch_end_callback is not None:
+                            batch_end_params = BatchEndParam(
+                                epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals())
+                            with _tele.span('fit.callback', 'fit'):
+                                for callback in _as_list(batch_end_callback):
+                                    callback(batch_end_params)
+                    if health_on:
+                        _tele.health.note_step_time(time.time() - t_step)
+                    if cluster_on:
+                        # off-sync steps: one clock read + a deque append;
+                        # the allgather fires every SYNC_EVERY steps only
+                        _tele.cluster.note_step()
+                    if ckpt is not None:
+                        # per-batch path: the sentinel check already ran in
+                        # backward, so health trails by nothing (lag=0)
+                        ckpt.note_steps(1)
+                    if faults_on:
+                        _faults.note_steps(1)
+                    nbatch += 1
+
                 self._fit_epoch_end(epoch, eval_metric, tic,
                                     epoch_end_callback, eval_data,
                                     validation_metric, eval_end_callback,
                                     eval_batch_end_callback)
                 train_data.reset()
-                continue
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                t_step = time.time() if health_on else 0.0
-                if health_on:
-                    # executor-level incidents carry the real batch index
-                    _tele.health.note_batch(nbatch)
-                # per-batch telemetry: host-dispatch vs draw vs metric vs
-                # callback time (all no-ops unless MXTPU_TELEMETRY=1 or
-                # the chrome-trace profiler is running)
-                with _tele.span('fit.batch', 'fit'):
-                    with _tele.span('fit.dispatch', 'fit'):
-                        self.forward_backward(data_batch)
-                        self.update()
-                    _tele.counter('fit.steps').inc()
-                    # MXTPU_XPROF step-windowed device-trace capture
-                    _profiler.note_step()
-                    try:
-                        with _tele.span('fit.draw', 'fit'):
-                            next_data_batch = next(data_iter)
-                        self.prepare(next_data_batch)
-                    except StopIteration:
-                        end_of_batch = True
-                    with _tele.span('fit.metric', 'fit'):
-                        self.update_metric(eval_metric, data_batch.label)
-                    if monitor is not None:
-                        monitor.toc_print()
-                    if batch_end_callback is not None:
-                        batch_end_params = BatchEndParam(epoch=epoch,
-                                                         nbatch=nbatch,
-                                                         eval_metric=eval_metric,
-                                                         locals=locals())
-                        with _tele.span('fit.callback', 'fit'):
-                            for callback in _as_list(batch_end_callback):
-                                callback(batch_end_params)
-                if health_on:
-                    _tele.health.note_step_time(time.time() - t_step)
-                if cluster_on:
-                    # off-sync steps: one clock read + a deque append;
-                    # the allgather fires every SYNC_EVERY steps only
-                    _tele.cluster.note_step()
-                nbatch += 1
+        except BaseException as e:  # noqa: BLE001 — incl. Ctrl-C/exit
+            if ckpt is not None:
+                # the run is dying with a save possibly in flight: drain
+                # and certify NOW, while the interpreter is whole — at
+                # teardown orbax's commit thread loses its executors
+                # ("cannot schedule new futures after shutdown") and the
+                # save would never commit, leaving a supervised relaunch
+                # (tools/train_supervisor.py) nothing to restore. A
+                # KeyboardInterrupt drains too: preserving the last save
+                # is exactly what an interrupted operator wants.
+                # Idempotent: resilient_fit's handle_failure call after
+                # this re-raise finds nothing pending.
+                diag = getattr(e, 'diagnostic', None)
+                try:
+                    ckpt.handle_failure(dict(diag) if diag else None)
+                except Exception:  # noqa: BLE001 — never mask the failure
+                    pass
+            raise
 
-            self._fit_epoch_end(epoch, eval_metric, tic, epoch_end_callback,
-                                eval_data, validation_metric,
-                                eval_end_callback, eval_batch_end_callback)
-            train_data.reset()
+        if ckpt is not None:
+            # final save + writer drain + last-good certification
+            ckpt.finish()
 
     def _fit_epoch_end(self, epoch, eval_metric, tic, epoch_end_callback,
                        eval_data, validation_metric, eval_end_callback,

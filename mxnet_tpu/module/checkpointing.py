@@ -1,0 +1,728 @@
+"""Periodic async sharded training checkpoints + restart-from-last-good.
+
+The observability arc can *detect* a dying run (telemetry/health raises
+``TrainingHealthError`` with a first-bad-layer diagnostic); this module
+is the half that *acts* on it. ``BaseModule.fit`` builds a
+:class:`TrainCheckpointer` from the env flags and drives it from both
+train loops (the per-batch reference loop and the fused window loop):
+
+- every ``MXTPU_CKPT_EVERY`` trained steps the FULL training state —
+  parameters, aux (BatchNorm) state, optimizer state + update counts,
+  every framework RNG stream, the epoch/step cursor and the eval-metric
+  partial sums — is captured as immutable array references (plus one
+  device-side copy per array, so the fused window's buffer donation can
+  never invalidate an in-flight write) and handed to a background
+  writer. The write itself goes through ``parallel/checkpoint.py``'s
+  orbax tier: each host writes only its own shards (arXiv:2004.13336's
+  state-lives-sharded principle), so save cost scales with per-host
+  bytes, not model size. The step loop never blocks on it.
+- ``max_to_keep`` pruning rides orbax (``MXTPU_CKPT_KEEP``).
+- a **last-good pointer** (``last_good.step`` in the checkpoint dir)
+  only advances past a saved step once the write has committed AND the
+  health plane has seen every step it covers finite. A checkpoint
+  captured after a NaN trained into the parameters is never certified.
+- ``MXTPU_CKPT_RESUME`` (default on): a fresh ``fit()`` against a
+  directory holding a certified checkpoint restores it bit-exactly —
+  restore targets the live arrays' dtypes/shardings (orbax
+  restore-into-template), the optimizer update counts and RNG streams
+  come back, epochs already trained are skipped, and the data iterator
+  is rewound + skipped to the restored step (``seed_epoch(epoch)`` is
+  called on iterators that support deterministic per-epoch reseeding).
+
+Degradation ladder (a checkpointing failure must never kill training):
+async writer dies -> synchronous saves; those fail repeatedly ->
+checkpointing disabled with a warning; restore of a corrupt step ->
+fall back to the next older committed step; nothing restorable ->
+start fresh. ``module/resilient_fit.py`` and
+``tools/train_supervisor.py`` build the restart loop on top.
+
+All flags off = nothing here runs: ``for_fit`` returns None before
+touching orbax, no thread exists, and no op is ever traced (the whole
+subsystem is host-side).
+"""
+import logging
+import os
+
+import numpy as np
+
+from .. import faults as _faults
+from .. import random as _random
+from .. import telemetry as _tele
+
+__all__ = ['TrainCheckpointer', 'enabled']
+
+_POINTER = 'last_good.step'
+_MAX_SAVE_FAILURES = 3
+_FORMAT = 1
+
+
+def _flags():
+    from ..config import flags
+    for name in ('MXTPU_CKPT_DIR', 'MXTPU_CKPT_EVERY', 'MXTPU_CKPT_KEEP',
+                 'MXTPU_CKPT_ASYNC', 'MXTPU_CKPT_RESUME'):
+        flags.reload(name)
+    return (flags.get('MXTPU_CKPT_DIR'), flags.get('MXTPU_CKPT_EVERY'),
+            flags.get('MXTPU_CKPT_KEEP'), flags.get('MXTPU_CKPT_ASYNC'),
+            flags.get('MXTPU_CKPT_RESUME'))
+
+
+def enabled():
+    """Whether the checkpoint flags ask for periodic saves."""
+    try:
+        d, every, _, _, _ = _flags()
+    except Exception:  # noqa: BLE001 — stripped builds without the flags
+        return False
+    return bool(d) and every > 0
+
+
+def _metric_children(eval_metric):
+    from .. import metric as metric_mod
+    if isinstance(eval_metric, metric_mod.CompositeEvalMetric):
+        return list(eval_metric.metrics)
+    return [eval_metric]
+
+
+class TrainCheckpointer:
+    """One fit() call's checkpoint/resume driver (built by
+    :meth:`for_fit`, driven by the fit loops)."""
+
+    def __init__(self, module, eval_metric, directory, every, keep,
+                 async_, logger=logging):
+        from ..parallel import checkpoint as ckpt
+        self._ckpt = ckpt
+        self.module = module
+        self.eval_metric = eval_metric
+        self.directory = os.path.abspath(str(directory))
+        self.every = int(every)
+        self.logger = logger
+        self._async = bool(async_)
+        self._mngr = ckpt.manager(self.directory, max_to_keep=keep)
+        self._param_names = list(module._exec_group.param_names)
+        self._aux_names = list(module._exec_group.aux_names)
+        self._grad_names = list(self._exec._grad_names)
+        from .fused_fit import updater_keys
+        self._upd_keys = updater_keys(module, self._grad_names)
+        self._accum = (module._grad_req == 'add')
+
+        self.global_step = 0
+        self.epoch = 0
+        self.step_in_epoch = 0
+        self.epoch_nbatch_base = 0  # resumed epoch: first nbatch value
+        self.resumed_epoch = None  # epoch whose batches were skipped
+        self._checked = 0          # steps the health plane has verified
+        self._last_save = 0
+        self._initiated = 0        # newest step a save actually started
+        self._pending = []   # [step, nonfinite_at_capture, future, cleared]
+        self._pool = None
+        self._failures = 0
+        self._disabled = False
+        self._resume = None        # (epoch, step_in_epoch, metric_state)
+        self.last_good = None
+        self.restored_step = None
+        # incident count at fit start: any NEW incident this attempt
+        # marks every later capture uncertifiable (see _promote) —
+        # while counts from a PREVIOUS attempt of the same process
+        # (resilient_fit retry) don't freeze the restored run
+        self._nf_base = self._nonfinite_count() or 0
+
+    @property
+    def _exec(self):
+        # read fresh every time: a mid-fit reshape rebuilds the
+        # executor list, and a capture against the orphaned old
+        # executor would silently checkpoint stale parameters
+        return self.module._exec_group.execs[0]
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def for_fit(cls, module, eval_metric, logger=logging):
+        """Build (and maybe resume) the fit loop's checkpointer, or None
+        when the flags are off / the module shape is unsupported. Any
+        failure here warns and disables checkpointing — it never stops
+        the fit."""
+        module.__dict__.pop('_mxtpu_ckpt', None)
+        try:
+            directory, every, keep, async_, resume = _flags()
+        except Exception:  # noqa: BLE001
+            return None
+        if not directory or every <= 0:
+            return None
+        group = getattr(module, '_exec_group', None)
+        if group is None:
+            logger.warning(
+                'checkpointing: MXTPU_CKPT_DIR is set but %s does not '
+                'expose an executor group — periodic checkpoints need '
+                'the standard Module; continuing without checkpoints',
+                type(module).__name__)
+            return None
+        execs = getattr(group, 'execs', None) or []
+        if len(execs) != 1:
+            logger.warning(
+                'checkpointing: MXTPU_CKPT_DIR is set but the module '
+                'binds %d executors — periodic checkpoints support the '
+                'single-program (SPMD or single-context) path only; '
+                'continuing without checkpoints', len(execs))
+            return None
+        try:
+            self = cls(module, eval_metric, directory, every, keep,
+                       async_, logger=logger)
+        except Exception as e:  # noqa: BLE001 — bad dir/missing orbax
+            logger.warning('checkpointing: cannot open %s (%s) — '
+                           'continuing without checkpoints', directory, e)
+            return None
+        if resume:
+            try:
+                self._try_resume()
+            except Exception as e:  # noqa: BLE001
+                logger.warning('checkpointing: resume failed (%s) — '
+                               'starting fresh', e)
+                self._resume = None
+        module.__dict__['_mxtpu_ckpt'] = self
+        return self
+
+    # -- state capture -----------------------------------------------------
+    def _updater(self):
+        from .fused_fit import updater_obj
+        return updater_obj(self.module)
+
+    def _ensure_opt_states(self):
+        from .fused_fit import ensure_opt_states
+        ensure_opt_states(self.module, self._grad_names, self._upd_keys,
+                          self._exec.arg_dict)
+
+    def _walk_opt(self, copy):
+        """(structure, arrays): the optimizer-state tree flattened into
+        deterministically-named array leaves. ``copy`` guards against
+        the fused window's buffer donation; the template pass (restore)
+        walks the same order with copy=False."""
+        import jax.numpy as jnp
+        self._ensure_opt_states()
+        upd = self._updater()
+        arrays = {}
+        counter = [0]
+
+        def enc(v):
+            if v is None:
+                return None
+            if isinstance(v, tuple):
+                return [enc(x) for x in v]
+            k = 'opt.%d' % counter[0]
+            counter[0] += 1
+            arrays[k] = jnp.copy(v._data) if copy else v._data
+            return k
+
+        structure = [[n, enc(upd.states[self._upd_keys[n]])]
+                     for n in self._grad_names]
+        return structure, arrays
+
+    def _opt_bookkeeping(self):
+        o = self.module._optimizer
+        return {'num_update': int(o.num_update),
+                'index_update_count': [[k, int(v)] for k, v in
+                                       sorted(o._index_update_count.items(),
+                                              key=str)]}
+
+    def _capture(self):
+        """The checkpoint pytree + its JSON metadata, captured on the
+        MAIN thread so it names a consistent step. Arrays are device
+        copies (async dispatches — cheap): the originals may be donated
+        to the very next compiled window while the write is in flight.
+        The RNG key is tiny, so it rides the JSON meta item — the
+        array tree stays fully restorable from the live template."""
+        import jax.numpy as jnp
+        e = self._exec
+        tree = {
+            'params': {n: jnp.copy(e.arg_dict[n]._data)
+                       for n in self._param_names},
+            'aux': {n: jnp.copy(e.aux_dict[n]._data)
+                    for n in self._aux_names},
+        }
+        structure, opt_arrays = self._walk_opt(copy=True)
+        if opt_arrays:
+            tree['opt'] = opt_arrays
+        if self._accum:
+            tree['gacc'] = {n: jnp.copy(e.grad_dict[n]._data)
+                            for n in self._grad_names}
+        rng = _random.get_state()
+        key = rng.pop('key')
+        if key is not None:
+            key = np.asarray(key)
+            rng['key_values'] = key.tolist()
+            rng['key_dtype'] = str(key.dtype)
+        metric_state = [[type(c).__name__, float(c.sum_metric),
+                         int(c.num_inst)]
+                        for c in _metric_children(self.eval_metric)]
+        meta = {'format': _FORMAT, 'epoch': int(self.epoch),
+                'step_in_epoch': int(self.step_in_epoch),
+                'global_step': int(self.global_step),
+                'opt_structure': structure,
+                'opt_bookkeeping': self._opt_bookkeeping(),
+                'metric': metric_state, 'rng_host': rng,
+                'grad_req': self.module._grad_req}
+        return tree, meta
+
+    # -- save --------------------------------------------------------------
+    def _nonfinite_count(self):
+        """health.nonfinite_steps right now, or None while the health
+        plane is off (no gate to wait for)."""
+        if not _tele.health.enabled():
+            return None
+        return int(_tele.get_registry()
+                   .counter('health.nonfinite_steps').value)
+
+    def _do_save(self, step, tree, meta):
+        """The actual write (worker thread in async mode): one orbax
+        save + barrier, then the fault-injection corrupt seam."""
+        with _tele.span('ckpt.save', 'ckpt'):
+            self._ckpt.save(self._mngr, step, tree, wait=True, meta=meta)
+        _faults.maybe_corrupt_checkpoint(self.directory, step)
+        _tele.counter('ckpt.saves').inc()
+
+    def _initiate_save(self):
+        step = self.global_step
+        if self._disabled or not step:
+            return
+        busy = [p for p in self._pending if p[2] is not None
+                and not p[2].done()]
+        if busy:
+            # the writer is still on a previous step: drop this save
+            # rather than queue unboundedly behind slow storage
+            # (finish() re-initiates after draining, so the run's
+            # final state is never lost to a slow writer)
+            _tele.counter('ckpt.skipped').inc()
+            return
+        try:
+            with _tele.span('ckpt.capture', 'ckpt'):
+                tree, meta = self._capture()
+        except Exception as e:  # noqa: BLE001 — never kill training
+            self._note_failure('state capture failed: %s' % e)
+            return
+        nf0 = self._nonfinite_count()
+        # health-cleared at birth when the sentinels already checked
+        # through this step (lag=0 paths): later incidents then belong
+        # to LATER steps and must not taint this capture
+        cleared = nf0 is None or self._checked >= step
+        if self._async:
+            if self._pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+                self._pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix='mxtpu-ckpt')
+            try:
+                fut = self._pool.submit(self._do_save, step, tree, meta)
+            except Exception as e:  # noqa: BLE001 — pool torn down
+                self.logger.warning(
+                    'checkpointing: async writer unavailable (%s) — '
+                    'falling back to synchronous saves', e)
+                self._async = False
+                fut = None
+            if fut is not None:
+                self._initiated = step
+                self._pending.append([step, nf0, fut, cleared])
+                return
+        try:
+            self._do_save(step, tree, meta)
+        except Exception as e:  # noqa: BLE001
+            self._note_failure('save of step %d failed: %s' % (step, e))
+            return
+        self._initiated = step
+        self._pending.append([step, nf0, None, cleared])
+
+    def _note_failure(self, msg):
+        self._failures += 1
+        _tele.counter('ckpt.save_failures').inc()
+        if self._failures >= _MAX_SAVE_FAILURES:
+            self._disabled = True
+            self.logger.warning(
+                'checkpointing: %s — %d consecutive failures, disabling '
+                'checkpoints for this run (training continues)', msg,
+                self._failures)
+        else:
+            self.logger.warning(
+                'checkpointing: %s — training continues', msg)
+
+    # -- last-good promotion -----------------------------------------------
+    def _write_pointer(self, step):
+        tmp = os.path.join(self.directory, _POINTER + '.tmp')
+        with open(tmp, 'w') as f:
+            f.write('%d\n' % step)
+        os.replace(tmp, os.path.join(self.directory, _POINTER))
+        self.last_good = int(step)
+        _tele.gauge('ckpt.last_good').set(int(step))
+
+    @staticmethod
+    def read_pointer(directory):
+        try:
+            with open(os.path.join(str(directory), _POINTER)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
+    def _promote(self, bound=None, final=False):
+        """Advance the last-good pointer over committed saves the
+        health plane has certified. A pending save at step k promotes
+        once its write landed AND the sentinels checked through step k
+        with no non-finite incident on record this attempt (an incident
+        under action=warn trains into the parameters, so every capture
+        after it is tainted, not just the nearest one); ``bound``
+        (the known first-bad step of an unwinding incident) promotes
+        everything strictly before it instead. With the health plane
+        off, commit alone promotes. ``final`` (the run is over — no
+        more sentinel rows are coming) certifies on an unchanged
+        incident count alone: an infra failure mid-window leaves the
+        last window's rows unexamined forever, and a NaN hiding in
+        them would re-raise through the sentinels on the very first
+        resumed step, falling back to an older checkpoint then."""
+        nf_now = self._nonfinite_count()
+        keep = []
+        for entry in self._pending:
+            step, nf0, fut, cleared = entry
+            if not cleared and nf_now is not None \
+                    and nf_now == nf0 and self._checked >= step:
+                # the sentinels caught up to this step with the count
+                # unchanged: the capture is clean for good — incidents
+                # appearing AFTER this moment belong to later steps
+                entry[3] = cleared = True
+            if fut is not None:
+                if not fut.done():
+                    keep.append(entry)
+                    continue
+                err = fut.exception()
+                if err is not None:
+                    if self._async:
+                        self.logger.warning(
+                            'checkpointing: async writer died (%s) — '
+                            'falling back to synchronous saves', err)
+                        self._async = False
+                    self._note_failure('async save of step %d failed: %s'
+                                       % (step, err))
+                    continue
+            if bound is not None:
+                ok = step < bound
+            elif nf_now is None:
+                ok = True
+            elif (nf0 or 0) > self._nf_base:
+                # an incident precedes this capture within THIS attempt
+                # (counts from a previous attempt of the same process
+                # are baselined out): with action=warn the NaN trained
+                # into the parameters and every later capture carries
+                # it — never certify; the pointer freezes at the last
+                # clean step
+                _tele.counter('ckpt.uncertified').inc()
+                continue
+            elif cleared or (final and nf_now == nf0):
+                ok = True
+            elif nf_now != nf0:
+                # an incident landed before health could check through
+                # this step: it may belong to a step the capture covers
+                # — never certify (conservative)
+                _tele.counter('ckpt.uncertified').inc()
+                continue
+            else:
+                keep.append(entry)   # health hasn't caught up yet
+                continue
+            if ok:
+                try:
+                    self._write_pointer(step)
+                except OSError as e:
+                    self.logger.warning(
+                        'checkpointing: cannot write last-good pointer '
+                        '(%s)', e)
+            else:
+                _tele.counter('ckpt.uncertified').inc()
+        self._pending = keep
+
+    # -- fit-loop hooks ----------------------------------------------------
+    def begin_epoch(self, epoch, eval_metric, train_data):
+        """Epoch-start hook (after the metric reset). Returns False when
+        this epoch precedes the resume target (fit skips it without
+        touching the data). At the resume epoch itself the eval-metric
+        partial sums are re-applied and the iterator is skipped to the
+        restored step."""
+        self.eval_metric = eval_metric
+        if self._resume is not None:
+            r_epoch, r_step, metric_state = self._resume
+            if epoch < r_epoch:
+                return False
+            if epoch == r_epoch:
+                self._resume = None
+                self.epoch = epoch
+                self.step_in_epoch = r_step
+                # the fit loops start their batch counter here, so
+                # callbacks, health incidents and the failure bound all
+                # see TRUE batch-in-epoch indices on a resumed epoch
+                self.epoch_nbatch_base = r_step
+                self.resumed_epoch = epoch if r_step else None
+                seed_fn = getattr(train_data, 'seed_epoch', None)
+                if callable(seed_fn):
+                    # reseeded skip-to-step: iterators with
+                    # deterministic per-epoch order regenerate it
+                    seed_fn(epoch)
+                if r_step:
+                    it = iter(train_data)
+                    skipped = 0
+                    while skipped < r_step:
+                        try:
+                            next(it)
+                        except StopIteration:
+                            break
+                        skipped += 1
+                    self.logger.info(
+                        'checkpointing: resumed epoch %d at step %d '
+                        '(skipped %d already-trained batches)',
+                        epoch, r_step, skipped)
+                if metric_state:
+                    try:
+                        children = _metric_children(eval_metric)
+                        live = [type(c).__name__ for c in children]
+                        saved = [s[0] for s in metric_state]
+                        if live != saved:
+                            # a changed metric list would zip-truncate
+                            # silently and mis-assign partial sums
+                            raise ValueError(
+                                'saved %s vs live %s' % (saved, live))
+                        for child, (_, s, n) in zip(children,
+                                                    metric_state):
+                            child.sum_metric = s
+                            child.num_inst = n
+                    except Exception as err:  # noqa: BLE001 — drifted
+                        self.logger.warning(
+                            'checkpointing: eval-metric state did not '
+                            'match the checkpoint (%s); metric restarts '
+                            'at 0', err)
+                _tele.event('ckpt.resume', epoch=epoch, step=r_step,
+                            restored_step=self.restored_step)
+                return True
+            self._resume = None   # target epoch already passed
+        self.epoch = epoch
+        self.step_in_epoch = 0
+        self.epoch_nbatch_base = 0
+        return True
+
+    def allow_empty_epoch(self, epoch):
+        """Whether the fit loops should tolerate drawing ZERO batches
+        at this epoch's start: true only for a resumed epoch whose
+        checkpoint landed exactly on the epoch boundary (the skip
+        consumed every batch; there is nothing left to train). Any
+        other empty iterator keeps the loud reference failure."""
+        return self.resumed_epoch == epoch
+
+    def save_due(self, n):
+        """Whether :meth:`note_steps`\\ (n) will initiate a save — the
+        fused loop asks BEFORE noting a window so it can flush its
+        pipelined metric/health stats first: the capture must see the
+        eval-metric state through the steps it claims to cover."""
+        return (not self._disabled
+                and self.global_step + n - self._last_save >= self.every)
+
+    def note_steps(self, n, lag=0):
+        """Step hook, called by both train loops after ``n`` more steps
+        are trained. ``lag`` is how many trained steps the loop's health
+        processing trails by (the fused loop fetches a window's sentinel
+        rows one window late)."""
+        self.global_step += n
+        self.step_in_epoch += n
+        self._checked = max(self._checked, self.global_step - lag)
+        if self._pending:
+            self._promote()
+        if not self._disabled \
+                and self.global_step - self._last_save >= self.every:
+            self._last_save = self.global_step
+            self._initiate_save()
+
+    def finish(self):
+        """fit() completed: take a final save, drain the writer and
+        certify what the health plane has cleared. Draining FIRST means
+        the final save is never dropped on the busy-writer guard — the
+        run's end state always lands."""
+        self._checked = self.global_step
+        self._drain()
+        if not self._disabled and self.global_step > self._initiated:
+            self._last_save = self.global_step
+            self._initiate_save()
+            self._drain()
+        self._promote()
+        self._shutdown_pool()
+
+    def handle_failure(self, diagnostic=None):
+        """fit() died (resilient_fit's except path): drain the writer,
+        then certify pending saves. When the diagnostic names the first
+        bad step (TrainingHealthError), every committed save strictly
+        before it is known-good regardless of detector lag; otherwise
+        commit + an unchanged incident count certifies (``final`` —
+        see :meth:`_promote`): an infra failure is not a numeric one,
+        and a NaN the crash hid from the sentinels re-raises on the
+        first resumed step."""
+        self._drain()
+        bound = None
+        if diagnostic and diagnostic.get('step') is not None:
+            epoch_base = self.global_step - self.step_in_epoch
+            bound = epoch_base + int(diagnostic['step'])
+        self._promote(bound=bound, final=bound is None)
+        self._shutdown_pool()
+
+    def _drain(self):
+        for entry in self._pending:
+            fut = entry[2]
+            if fut is not None and not fut.done():
+                try:
+                    fut.exception(timeout=600)
+                except Exception:  # noqa: BLE001
+                    pass
+        try:
+            self._ckpt.wait(self._mngr)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _shutdown_pool(self):
+        if self._pool is not None:
+            self._pool.shutdown(wait=True)
+            self._pool = None
+
+    # -- restore -----------------------------------------------------------
+    def _template(self):
+        """Abstract tree mirroring the LIVE state's dtypes/shardings
+        (orbax restore-into-template: every shard lands back where it
+        belongs without materializing the full state anywhere)."""
+        e = self._exec
+        tree = {
+            'params': {n: e.arg_dict[n]._data for n in self._param_names},
+            'aux': {n: e.aux_dict[n]._data for n in self._aux_names},
+        }
+        _, opt_arrays = self._walk_opt(copy=False)
+        if opt_arrays:
+            tree['opt'] = opt_arrays
+        if self._accum:
+            tree['gacc'] = {n: e.grad_dict[n]._data
+                            for n in self._grad_names}
+        return tree
+
+    def _restore_step(self, step):
+        """Restore one committed step into the module, bit-exactly.
+        Restore-into-template: the live arrays' dtypes/shardings tell
+        orbax where every shard belongs, so nothing materializes off
+        its mesh placement. Raises on a corrupt/mismatched checkpoint
+        (grad_req or the optimizer changed between runs) — the caller
+        falls back to an older step."""
+        restored, meta = self._ckpt.restore_with_meta(
+            self._mngr, self._template(), step)
+        if meta.get('format') != _FORMAT:
+            raise ValueError('unsupported checkpoint format %r'
+                             % meta.get('format'))
+        self._apply(restored, meta)
+        return meta
+
+    def _apply(self, tree, meta):
+        e = self._exec
+        m = self.module
+
+        # optimizer state: walk the SAVED structure against the live
+        # NDArray state objects (created via the optimizer's own
+        # create_state path, so the shapes/wrapping match). The walk
+        # runs FIRST and only stages assignments: a mismatch (renamed
+        # param, changed optimizer, corrupt meta) must raise while the
+        # live module is still untouched, so the caller's fallback —
+        # an older step, or a genuine fresh start — never inherits a
+        # half-restored run
+        self._ensure_opt_states()
+        upd = self._updater()
+        opt_arrays = tree.get('opt', {})
+        staged = []   # (live state NDArray, restored array)
+
+        def stage(struct, live):
+            if struct is None:
+                if live is not None:
+                    raise ValueError('optimizer state shape drifted')
+                return
+            if isinstance(struct, list):
+                if not isinstance(live, tuple) or len(live) != len(struct):
+                    raise ValueError('optimizer state shape drifted')
+                for s, l in zip(struct, live):
+                    stage(s, l)
+                return
+            if live is None or isinstance(live, tuple):
+                raise ValueError('optimizer state shape drifted')
+            staged.append((live, opt_arrays[struct]))
+
+        for name, struct in meta['opt_structure']:
+            if name not in self._upd_keys:
+                raise ValueError('checkpoint names unknown param %r' % name)
+            stage(struct, upd.states[self._upd_keys[name]])
+
+        for n in self._param_names:
+            e.arg_dict[n]._data = tree['params'][n]
+            if m._update_on_kvstore:
+                store = m._kvstore._store.get(n)
+                if store is not None:
+                    store._data = tree['params'][n]
+        for n in self._aux_names:
+            e.aux_dict[n]._data = tree['aux'][n]
+        if self._accum and 'gacc' in tree:
+            for n in self._grad_names:
+                e.grad_dict[n]._data = tree['gacc'][n]
+        m._params_dirty = True
+        for live, arr in staged:
+            live._data = arr
+
+        book = meta.get('opt_bookkeeping') or {}
+        o = m._optimizer
+        o.num_update = int(book.get('num_update', o.num_update))
+        o._index_update_count = {k: int(v) for k, v in
+                                 book.get('index_update_count', [])}
+
+        rng = dict(meta.get('rng_host') or {})
+        values = rng.pop('key_values', None)
+        dtype = rng.pop('key_dtype', 'uint32')
+        rng['key'] = None if values is None \
+            else np.asarray(values, dtype=np.dtype(dtype))
+        _random.set_state(rng)
+
+    def _try_resume(self):
+        steps = self._ckpt.all_steps(self._mngr)
+        if not steps:
+            return
+        ptr = self.read_pointer(self.directory)
+        if ptr is None:
+            self.logger.warning(
+                'checkpointing: %s holds %d checkpoint(s) but no '
+                'last-good pointer — none was health-certified; '
+                'starting fresh', self.directory, len(steps))
+            return
+        candidates = [s for s in sorted(steps, reverse=True) if s <= ptr]
+        for step in candidates:
+            try:
+                meta = self._restore_step(step)
+            except Exception as e:  # noqa: BLE001 — corrupt step
+                self.logger.warning(
+                    'checkpointing: restore of step %d failed (%s) — '
+                    'trying an older checkpoint', step, e)
+                continue
+            # steps newer than the restore point are stale (and, after
+            # an incident, possibly poisoned): clear them so pruning
+            # and replay renumbering stay sane
+            for s in steps:
+                if s > step:
+                    try:
+                        self._ckpt.delete_step(self._mngr, s)
+                    except Exception:  # noqa: BLE001
+                        pass
+            self.global_step = int(meta['global_step'])
+            self._last_save = self.global_step
+            self._initiated = self.global_step
+            self._checked = self.global_step
+            self.last_good = step
+            self.restored_step = step
+            if step != ptr:
+                try:
+                    self._write_pointer(step)
+                except OSError:
+                    pass
+            self._resume = (int(meta['epoch']),
+                            int(meta['step_in_epoch']),
+                            meta.get('metric') or [])
+            self.logger.info(
+                'checkpointing: restored step %d (epoch %d, step %d) '
+                'from %s', step, meta['epoch'], meta['step_in_epoch'],
+                self.directory)
+            return
+        self.logger.warning(
+            'checkpointing: no checkpoint in %s was restorable — '
+            'starting fresh', self.directory)

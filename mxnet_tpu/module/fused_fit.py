@@ -52,6 +52,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .. import faults as _faults
 from .. import metric as metric_mod
 from .. import optimizer as opt_mod
 from .. import profiler as _profiler
@@ -86,6 +87,41 @@ def _mirror_flag():
 
 def _is_half(dt):
     return str(dt) in ('float16', 'bfloat16')
+
+
+def updater_obj(module):
+    """The updater that holds this module's optimizer state (the
+    kvstore's when update_on_kvstore, the module's local one
+    otherwise)."""
+    return module._kvstore._updater if module._update_on_kvstore \
+        else module._updater
+
+
+def updater_keys(module, grad_names):
+    """The key each param updates under, matching the unfused path:
+    update_on_kvstore pushes by NAME (kvstore._updater keys); the
+    local updater uses integer position (model._update_params)."""
+    if module._update_on_kvstore:
+        return {n: _updater_key(n) for n in grad_names}
+    pnames = module._exec_group.param_names
+    return {n: pnames.index(n) for n in grad_names}
+
+
+def ensure_opt_states(module, grad_names, upd_keys, arg_dict):
+    """Pre-create optimizer states through the optimizer's own
+    create_state path (the lazy per-batch loop only builds them at the
+    first update) so every caller — the fused window, checkpointing,
+    save/load_optimizer_states — sees the same structure. Returns the
+    updater."""
+    upd = updater_obj(module)
+    for n in grad_names:
+        key = upd_keys[n]
+        if key not in upd.states:
+            upd.states[key] = \
+                module._optimizer.create_state_multi_precision(
+                    key, arg_dict[n])
+            upd.states_synced[key] = True
+    return upd
 
 
 # ---------------------------------------------------------------------------
@@ -280,14 +316,7 @@ class FusedFitLoop:
         # keys reuse on the flag) — None keeps the traced window
         # byte-identical to the plain form
         self._health_fn = health_sentinel()
-        # the key each param updates under must match the unfused path:
-        # update_on_kvstore pushes by NAME (kvstore._updater keys);
-        # the local updater uses integer position (model._update_params)
-        if module._update_on_kvstore:
-            self._upd_keys = {n: _updater_key(n) for n in self._grad_names}
-        else:
-            pnames = module._exec_group.param_names
-            self._upd_keys = {n: pnames.index(n) for n in self._grad_names}
+        self._upd_keys = updater_keys(module, self._grad_names)
         self._ensure_states()
 
     # -- reuse across fit() calls ------------------------------------------
@@ -424,22 +453,11 @@ class FusedFitLoop:
 
     # -- optimizer state ---------------------------------------------------
     def _updater_obj(self):
-        m = self.module
-        return m._kvstore._updater if m._update_on_kvstore else m._updater
+        return updater_obj(self.module)
 
     def _ensure_states(self):
-        """Pre-create optimizer states through the optimizer's own
-        create_state path so save/load_optimizer_states see the same
-        structure the unfused loop would build lazily."""
-        upd = self._updater_obj()
-        e = self._exec
-        for n in self._grad_names:
-            key = self._upd_keys[n]
-            if key not in upd.states:
-                upd.states[key] = \
-                    self._optimizer.create_state_multi_precision(
-                        key, e.arg_dict[n])
-                upd.states_synced[key] = True
+        ensure_opt_states(self.module, self._grad_names, self._upd_keys,
+                          self._exec.arg_dict)
 
     def _state_arrays(self, n):
         st = self._updater_obj().states[self._upd_keys[n]]
@@ -649,11 +667,12 @@ class FusedFitLoop:
         m._params_dirty = True
 
     def run_epoch(self, train_data, eval_metric, epoch,
-                  batch_end_callback, monitor=None):
+                  batch_end_callback, monitor=None, ckpt=None):
         """Run one epoch; returns the number of batches consumed.
         Tail batches (< window) run through the reference per-batch
         path — state is written back after every window, so the two
-        paths interleave safely."""
+        paths interleave safely. ``ckpt`` is fit's TrainCheckpointer
+        (module/checkpointing.py), fed once per dispatched window."""
         from ..model import BatchEndParam
         from .base_module import _as_list
 
@@ -761,7 +780,7 @@ class FusedFitLoop:
         try:
             return self._run_epoch_inner(
                 train_data, eval_metric, epoch, batch_end_callback,
-                _DataBatch, apply_stats, host_nd)
+                _DataBatch, apply_stats, host_nd, ckpt)
         except Exception as e:
             # RESOURCE_EXHAUSTED anywhere in the window drive (upload,
             # dispatch, stats fetch): dump the per-program memory
@@ -780,12 +799,15 @@ class FusedFitLoop:
 
     def _run_epoch_inner(self, train_data, eval_metric, epoch,
                          batch_end_callback, _DataBatch, apply_stats,
-                         host_nd):
+                         host_nd, ckpt=None):
         from ..model import BatchEndParam
         from .base_module import _as_list
         from .. import random as _random
         m = self.module
-        nbatch = 0
+        # a resumed epoch's first fused batch IS batch r_step of the
+        # epoch: counting from the checkpointer's base keeps callback/
+        # incident batch indices true (and the failure bound correct)
+        nbatch = ckpt.epoch_nbatch_base if ckpt is not None else 0
         pending = None
         it = iter(train_data)
         # MXTPU_FUSED_FIT_TIMING=1: per-epoch host-stage breakdown
@@ -801,6 +823,8 @@ class FusedFitLoop:
         pool = pipe.pool() \
             if _flags.get('MXTPU_FUSED_FIT_PREFETCH') else None
 
+        faults_on = _faults.enabled()
+
         def collect():
             # draw-time snapshotting lives in the shared pipeline:
             # iterators may legally reuse their DataBatch/NDArray
@@ -809,6 +833,10 @@ class FusedFitLoop:
             # the apply is deferred.
             _t = _clk() if _timing else 0.0
             batches, snaps = pipe.collect(it)
+            if faults_on:
+                # nan-grad draw seam: training batches counted in step
+                # order, the armed one poisoned before stack/upload
+                snaps = [_faults.maybe_poison_snap(s) for s in snaps]
             if _timing:
                 _tm['draw'] += _clk() - _t
             return batches, snaps
@@ -824,6 +852,11 @@ class FusedFitLoop:
         _t_win = _clk()   # wall clock per dispatched window (health)
         batches, snaps = collect()
         if not batches:
+            if ckpt is not None and ckpt.allow_empty_epoch(epoch):
+                # checkpoint-resume landed exactly on this epoch's
+                # boundary: the skip consumed every batch — the epoch
+                # is already trained
+                return 0
             # exhausted before the FIRST batch: the reference loop's
             # unguarded first next() (base_module.py:482) raises here —
             # fail just as loudly instead of silently training a
@@ -863,6 +896,10 @@ class FusedFitLoop:
                 if self.stat_fns is None:
                     labels_snap = [[from_jax(l, self._exec._ctx)
                                     for l in ls] for _, ls, _, _ in snaps]
+                if faults_on:
+                    # dispatch-exception seam: fire before the window
+                    # containing the armed step is dispatched
+                    _faults.maybe_raise('dispatch', upcoming=self.window)
                 params, states, aux, gaccs = self._snapshot()
                 _t = _clk() if _timing else 0.0
                 with _tele.span('fused_fit.put', 'fused_fit'):
@@ -912,6 +949,24 @@ class FusedFitLoop:
                     _tele.health.note_step_time(_now - _t_win,
                                                 steps=self.window)
                     _t_win = _now
+                if ckpt is not None:
+                    lag = self.window
+                    if pending is not None and ckpt.save_due(self.window):
+                        # a save will initiate for THIS window: flush
+                        # the pipelined stats/health rows first so the
+                        # capture's eval-metric state covers every step
+                        # the checkpoint claims (and a NaN in this
+                        # window raises BEFORE a poisoned capture)
+                        nbatch = apply_stats(pending[0], pending[1],
+                                             nbatch, pending[2])
+                        pending = None
+                        lag = 0   # health checked through this window
+                    # otherwise the health plane has only processed the
+                    # PREVIOUS window's rows (the fetch is pipelined one
+                    # window late): certification trails by lag=W
+                    ckpt.note_steps(self.window, lag=lag)
+                if faults_on:
+                    _faults.note_steps(self.window)
                 if _timing:
                     _tm['fetch'] += _clk() - _t
         finally:
@@ -948,8 +1003,15 @@ class FusedFitLoop:
             _tele.counter('fit.steps').inc()
             if cluster_on:
                 _tele.cluster.note_step()
+            if faults_on:
+                _faults.note_steps(1)
             _profiler.note_step()
             m.update_metric(eval_metric, sb.label)
+            if ckpt is not None:
+                # after update_metric, so a save initiated on a tail
+                # step captures the metric including this batch; the
+                # sentinel check already ran inside backward (lag=0)
+                ckpt.note_steps(1)
             if batch_end_callback is not None:
                 p = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                   eval_metric=eval_metric,

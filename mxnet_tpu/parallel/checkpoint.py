@@ -19,7 +19,8 @@ tier is for large sharded SPMD state, the two are complementary.
 """
 import jax
 
-__all__ = ['manager', 'save', 'restore', 'latest_step']
+__all__ = ['manager', 'save', 'restore', 'restore_with_meta',
+           'latest_step', 'all_steps', 'delete_step', 'wait']
 
 
 def _ocp():
@@ -41,11 +42,18 @@ def manager(directory, max_to_keep=None, save_interval_steps=1):
                                  options=options)
 
 
-def save(mngr, step, state, wait=True):
+def save(mngr, step, state, wait=True, meta=None):
     """Write ``state`` (a pytree of jax.Arrays — sharded arrays are
-    written shard-parallel) at ``step``."""
+    written shard-parallel) at ``step``. ``meta`` (optional) is a
+    JSON-serializable dict saved as a sidecar item inside the same
+    atomic commit — restore it with :func:`restore_with_meta`."""
     ocp = _ocp()
-    saved = mngr.save(int(step), args=ocp.args.StandardSave(state))
+    if meta is None:
+        args = ocp.args.StandardSave(state)
+    else:
+        args = ocp.args.Composite(state=ocp.args.StandardSave(state),
+                                  meta=ocp.args.JsonSave(meta))
+    saved = mngr.save(int(step), args=args)
     if wait:
         mngr.wait_until_finished()
     return saved
@@ -70,5 +78,38 @@ def restore(mngr, template, step=None):
                         args=ocp.args.StandardRestore(abstract))
 
 
+def restore_with_meta(mngr, template, step):
+    """Restore a :func:`save`-with-``meta`` step: returns
+    ``(state, meta)`` with every array of ``state`` landed on its
+    template entry's sharding (the JSON item needs no template)."""
+    ocp = _ocp()
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, 'sharding',
+                                                        None)),
+        template)
+    r = mngr.restore(int(step), args=ocp.args.Composite(
+        state=ocp.args.StandardRestore(abstract),
+        meta=ocp.args.JsonRestore()))
+    return r['state'], r['meta']
+
+
 def latest_step(mngr):
     return mngr.latest_step()
+
+
+def all_steps(mngr):
+    """Committed step ids, ascending (a step dir appears only after the
+    atomic commit rename, so a crashed half-written save never lists)."""
+    return sorted(int(s) for s in mngr.all_steps())
+
+
+def delete_step(mngr, step):
+    """Remove one committed step (replay-overwrite and stale-future
+    cleanup in module/checkpointing.py)."""
+    mngr.delete(int(step))
+
+
+def wait(mngr):
+    """Block until every in-flight async save has committed."""
+    mngr.wait_until_finished()

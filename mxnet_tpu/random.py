@@ -12,7 +12,8 @@ import threading
 import jax
 import numpy as _np
 
-__all__ = ['seed', 'next_key', 'host_rng', 'host_pyrng',
+__all__ = ['seed', 'next_key', 'get_state', 'set_state',
+           'host_rng', 'host_pyrng',
            'uniform', 'normal', 'gamma', 'exponential', 'poisson',
            'negative_binomial', 'generalized_negative_binomial']
 
@@ -64,6 +65,54 @@ def seed(seed_state):
         _key = jax.random.PRNGKey(int(seed_state))
         _host_rng.seed(int(seed_state) % (2 ** 32))
         _host_pyrng.seed(int(seed_state))
+
+
+def get_state():
+    """Snapshot every framework RNG stream for checkpointing
+    (module/checkpointing.py): the device key (numpy uint32 array, or
+    None while the stream is still lazily uninitialized), the host
+    numpy stream and the host stdlib stream. The host states come back
+    as JSON-serializable nested lists so they can ride a checkpoint's
+    metadata record."""
+    with _lock:
+        key = None if _key is None else _np.asarray(_key).copy()
+    st = _host_rng.get_state()
+    np_state = [st[0], _np.asarray(st[1]).tolist(), int(st[2]),
+                int(st[3]), float(st[4])]
+
+    def _listify(obj):
+        if isinstance(obj, tuple):
+            return [_listify(x) for x in obj]
+        return obj
+
+    return {'key': key, 'numpy': np_state,
+            'python': _listify(_host_pyrng.getstate())}
+
+
+def set_state(state):
+    """Restore a :func:`get_state` snapshot — the checkpoint-resume
+    path: after this, the key/shuffle/augment streams continue exactly
+    where the saved run left them."""
+    global _key
+    import jax.numpy as jnp
+
+    def _tupleize(obj):
+        if isinstance(obj, list):
+            return tuple(_tupleize(x) for x in obj)
+        return obj
+
+    with _lock:
+        key = state.get('key')
+        _key = None if key is None else jnp.asarray(_np.asarray(key))
+    np_state = state.get('numpy')
+    if np_state is not None:
+        _host_rng.set_state((np_state[0],
+                             _np.asarray(np_state[1], _np.uint32),
+                             int(np_state[2]), int(np_state[3]),
+                             float(np_state[4])))
+    py_state = state.get('python')
+    if py_state is not None:
+        _host_pyrng.setstate(_tupleize(py_state))
 
 
 def next_key():

@@ -164,7 +164,10 @@ def _local_stats():
     reg = _tele().registry
     with _state.lock:
         ring = list(_state.ring)
-    step_ms = float(np.median(ring)) if ring else 0.0
+    # no completed step interval yet (a sync round can fire before the
+    # 2nd note_step): ship NaN so the aggregation marks the sample
+    # unavailable instead of publishing a fake 0ms step time
+    step_ms = float(np.median(ring)) if ring else float('nan')
     from . import health
     io_pct = health.input_bound_pct() or 0.0
     disp = 0.0
@@ -245,22 +248,37 @@ def _publish(mat, steps):
     for i in range(n):
         row = {'host': i}
         for j, key in enumerate(SYNC_KEYS):
-            row[key] = round(float(mat[i, j]), 3)
+            v = float(mat[i, j])
+            # a NaN sample means that host hasn't measured this yet
+            # (step ring still empty): omit it — JSON null, no gauge —
+            # rather than publish a fake zero
+            row[key] = None if np.isnan(v) else round(v, 3)
         per_host.append(row)
-        reg.gauge('cluster.h%d.step_time_ms' % i).set(row['step_time_ms'])
+        if row['step_time_ms'] is not None:
+            reg.gauge('cluster.h%d.step_time_ms' % i).set(
+                row['step_time_ms'])
         reg.gauge('cluster.h%d.io_wait_pct' % i).set(row['io_wait_pct'])
         reg.gauge('cluster.h%d.dispatch_ms' % i).set(row['dispatch_ms'])
         reg.gauge('cluster.h%d.live_mb' % i).set(
             round(row['live_bytes'] / 2.0**20, 1))
     times = mat[:, 0]
-    slowest = int(np.argmax(times))
-    med = float(np.median(times))
-    spread = (float(times.max() - times.min()) / med * 100.0) if med > 0 \
-        else 0.0
-    straggler = 'balanced' if (n == 1 or spread < _SPREAD_BALANCED_PCT) \
+    valid = ~np.isnan(times)
+    if valid.any():
+        times = np.where(valid, times, 0.0)
+        slowest = int(np.argmax(times))
+        med = float(np.median(times[valid]))
+        tmax = float(times[valid].max())
+        tmin = float(times[valid].min())
+        spread = ((tmax - tmin) / med * 100.0) if med > 0 else 0.0
+    else:
+        slowest = None
+        spread = 0.0
+    straggler = 'balanced' \
+        if (n == 1 or slowest is None or spread < _SPREAD_BALANCED_PCT) \
         else classify(float(mat[slowest, 1]))
     reg.gauge('cluster.hosts').set(n)
-    reg.gauge('cluster.slowest_host').set(slowest)
+    if slowest is not None:
+        reg.gauge('cluster.slowest_host').set(slowest)
     reg.gauge('cluster.step_time_spread_pct').set(round(spread, 1))
     reg.gauge('cluster.straggler_class').set(straggler)
     snap = {'hosts': n, 'step': int(steps), 'per_host': per_host,

@@ -163,6 +163,8 @@ def _health_lines(health):
         lines.append('  last_anomaly      %s=%s (baseline %s)'
                      % (last.get('detector', '?'), _fmt(last.get('value')),
                         _fmt(last.get('baseline'))))
+    if health.get('restarts'):
+        lines.append('  restarts          %d' % int(health['restarts']))
     if health.get('input_bound_pct') is not None:
         lines.append('  input_bound_pct   %s'
                      % _fmt(float(health['input_bound_pct'])))

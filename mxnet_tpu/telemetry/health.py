@@ -45,8 +45,9 @@ import numpy as np
 
 __all__ = ['TrainingHealthError', 'enabled', 'step_stats', 'decode',
            'note_batch', 'note_step', 'note_window', 'note_step_time',
-           'note_loss', 'detector', 'SpikeDetector', 'finite_report',
-           'has_nonfinite', 'summarize', 'snapshot_health']
+           'note_loss', 'note_restart', 'detector', 'SpikeDetector',
+           'finite_report', 'has_nonfinite', 'summarize',
+           'snapshot_health']
 
 # fixed head of the sentinel vector; per-output finite flags follow
 N_FIXED = 4
@@ -466,6 +467,29 @@ def note_step_time(seconds, steps=1):
     _observe('step_time', ms)
 
 
+def note_restart(attempt, reason=None, message=None, restore_step=None,
+                 diagnostic=None):
+    """Record one supervised-training restart (module/resilient_fit.py
+    / tools/train_supervisor.py): a ``restart`` JSONL record plus the
+    ``health.restarts`` counter the run-health block renders. Works
+    whenever telemetry is on — a restart is a run-level event, not a
+    sentinel, so it does not require MXTPU_HEALTH."""
+    st = _tele()
+    if not st.active:
+        return
+    st.registry.counter('health.restarts').inc()
+    rec = {'type': 'restart', 'attempt': int(attempt)}
+    if reason:
+        rec['reason'] = reason
+    if message:
+        rec['message'] = message
+    if restore_step is not None:
+        rec['restore_step'] = int(restore_step)
+    if diagnostic:
+        rec['diagnostic'] = dict(diagnostic)
+    _emit(rec)
+
+
 def note_loss(value):
     """Feed the loss detector (per-batch loss value — the fused stats
     mode feeds it from the in-graph CrossEntropy sufficient statistics;
@@ -580,9 +604,10 @@ def snapshot_health(input_bound=None):
     the sentinels are off."""
     if not _state.active:
         return None
+    reg = _tele().registry
     with _state.lock:
         out = {
-            'nonfinite_steps': int(_tele().registry.counter(
+            'nonfinite_steps': int(reg.counter(
                 'health.nonfinite_steps').value),
             'incidents': [dict(i) for i in _state.incidents[:8]],
             'anomaly_counts': dict(_state.anomaly_counts),
@@ -590,6 +615,9 @@ def snapshot_health(input_bound=None):
             if _state.last_anomaly else None,
             'action': _state.action,
         }
+    restarts = int(reg.counter('health.restarts').value)
+    if restarts:
+        out['restarts'] = restarts
     if input_bound is not None:
         out['input_bound_pct'] = round(input_bound, 1)
     return out

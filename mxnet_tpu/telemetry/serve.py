@@ -248,18 +248,36 @@ def maybe_start():
     return start(p)
 
 
+def _bind_address():
+    """MXTPU_TELEMETRY_BIND: loopback by default — exposing /metrics
+    to the network is an explicit opt-in ('0.0.0.0' or empty = all
+    interfaces, documented in docs/observability.md)."""
+    from ..config import flags
+    try:
+        flags.reload('MXTPU_TELEMETRY_BIND')
+        addr = flags.get('MXTPU_TELEMETRY_BIND')
+    except Exception:  # noqa: BLE001 — stripped builds without the flag
+        addr = '127.0.0.1'
+    if addr is None:
+        return '127.0.0.1'
+    addr = addr.strip()
+    return '' if addr == '0.0.0.0' else addr
+
+
 def start(port_):
     """Bind and serve on a daemon thread; idempotent (returns the
-    already-bound port). ``port_=0`` asks the OS for an ephemeral port.
-    A bind failure warns and returns None — observability must not
-    take the run down."""
+    already-bound port). ``port_=0`` asks the OS for an ephemeral port;
+    the bind address comes from MXTPU_TELEMETRY_BIND (loopback unless
+    opted out). A bind failure warns and returns None — observability
+    must not take the run down."""
     global _server, _thread
     with _lock:
         if _server is not None:
             return _server.server_address[1]
         from http.server import ThreadingHTTPServer
         try:
-            srv = ThreadingHTTPServer(('', int(port_)), _make_handler())
+            srv = ThreadingHTTPServer((_bind_address(), int(port_)),
+                                      _make_handler())
         except OSError as e:
             logging.warning('telemetry: cannot bind the live endpoint on '
                             'port %s (%s) — live scraping disabled for '

@@ -65,6 +65,10 @@ def pytest_configure(config):
         'markers', 'convergence: example/compat convergence run '
         '(minutes-scale subprocess); deselect with -m "not convergence" '
         'for the fast correctness tier')
+    config.addinivalue_line(
+        'markers', 'chaos: fault-injection / recovery test '
+        '(MXTPU_FAULT_INJECT harness; tier-1-safe, CPU-only, each '
+        'under 30s) — select with -m chaos to drill the restart paths')
 
 
 def pytest_sessionstart(session):

@@ -1,0 +1,502 @@
+"""Resilient training: checkpoints, restart-from-last-good, faults.
+
+The recovery-loop contracts (module/checkpointing.py,
+module/resilient_fit.py, mxnet_tpu/faults.py, tools/train_supervisor):
+
+- kill-and-resume parity: a supervised fit with an injected nan-grad
+  at step k restores from last-good, resumes, and reaches final params
+  identical (within tolerance) to an uninterrupted run of the same
+  seed — on BOTH the fused-window and per-batch loops;
+- the async save does not block the step loop (a slowed write overlaps
+  batches trained after it started) and a clean run's final state
+  always commits (the busy-writer skip never drops the end state);
+- flags off = zero new overhead: no checkpointer object, no writer
+  thread, no armed fault, empty registry;
+- every fault kind drills its recovery path: checkpoint-corrupt falls
+  back to an older step, dispatch-exception exercises restart backoff
+  without a health incident, slow-host delays the step counter,
+  backend-probe-timeout drives bench's reprobe;
+- restart budget/retryability in resilient_fit, restart records in the
+  JSONL stream, and the whole-process supervisor's relaunch loop.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.module.resilient_fit import resilient_fit, is_retryable
+from mxnet_tpu.telemetry.health import TrainingHealthError
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+_RES_FLAGS = ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_PATH', 'MXTPU_HEALTH',
+              'MXTPU_HEALTH_ACTION', 'MXTPU_CKPT_DIR', 'MXTPU_CKPT_EVERY',
+              'MXTPU_CKPT_KEEP', 'MXTPU_CKPT_ASYNC', 'MXTPU_CKPT_RESUME',
+              'MXTPU_RESTART_MAX', 'MXTPU_RESTART_BACKOFF',
+              'MXTPU_FAULT_INJECT', 'MXTPU_FUSED_FIT')
+
+
+def _reload():
+    for f in _RES_FLAGS:
+        flags.reload(f)
+
+
+def _reset():
+    telemetry._reset_for_tests()
+    faults._reset_for_tests()
+
+
+@pytest.fixture
+def res_env(tmp_path, monkeypatch):
+    """Telemetry + health(raise) + checkpointing into a tmp dir, zero
+    restart backoff; fully restored afterwards. Yields a dict the test
+    mutates (fault spec etc.) before calling its fit helpers."""
+    ckpt_dir = tmp_path / 'ckpts'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH',
+                       str(tmp_path / 'telemetry.jsonl'))
+    monkeypatch.setenv('MXTPU_HEALTH', '1')
+    monkeypatch.setenv('MXTPU_HEALTH_ACTION', 'raise')
+    monkeypatch.setenv('MXTPU_CKPT_DIR', str(ckpt_dir))
+    monkeypatch.setenv('MXTPU_CKPT_EVERY', '2')
+    monkeypatch.setenv('MXTPU_RESTART_BACKOFF', '0')
+    _reload()
+    _reset()
+    yield {'ckpt_dir': ckpt_dir,
+           'tele_path': tmp_path / 'telemetry.jsonl',
+           'monkeypatch': monkeypatch}
+    _reset()
+    for f in _RES_FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload()
+
+
+@pytest.fixture
+def all_off(monkeypatch):
+    for f in _RES_FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload()
+    _reset()
+    yield
+    _reset()
+    _reload()
+
+
+def _records(path):
+    # the JSONL sink buffers (_FLUSH_EVERY lines); drain it so records
+    # emitted between fit attempts are on disk before we read
+    sink = telemetry._state.sink
+    if sink is not None:
+        sink.flush()
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _mlp_sym():
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    return mx.sym.SoftmaxOutput(fc2, name='softmax')
+
+
+def _data(n=32):
+    np.random.seed(0)
+    X = np.random.randn(n, 10).astype(np.float32)
+    y = (np.random.rand(n) * 4).astype(int).astype(np.float32)
+    return X, y
+
+
+def _iter(X, y, batch=8):
+    return mx.io.NDArrayIter(X, y, batch_size=batch,
+                             label_name='softmax_label')
+
+
+def _run(X, y, num_epoch, resilient=False, batch=8, callback=None):
+    """One fit from mx seed 0; returns (module, restarts)."""
+    mx.random.seed(0)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    kw = dict(num_epoch=num_epoch, optimizer='sgd',
+              batch_end_callback=callback,
+              optimizer_params=(('learning_rate', 0.1),))
+    if resilient:
+        restarts = resilient_fit(mod, _iter(X, y, batch), **kw)
+    else:
+        restarts = 0
+        mod.fit(_iter(X, y, batch), **kw)
+    return mod, restarts
+
+
+def _reference(X, y, num_epoch):
+    """Uninterrupted same-seed run with checkpoint/fault flags off."""
+    os.environ.pop('MXTPU_FAULT_INJECT', None)
+    os.environ.pop('MXTPU_CKPT_DIR', None)
+    _reload()
+    faults._reset_for_tests()
+    mod, _ = _run(X, y, num_epoch)
+    return mod
+
+
+def _assert_params_match(a, b, tol=1e-6):
+    pa, _ = a.get_params()
+    pb, _ = b.get_params()
+    assert set(pa) == set(pb)
+    for k in pa:
+        np.testing.assert_allclose(pa[k].asnumpy(), pb[k].asnumpy(),
+                                   atol=tol, err_msg=k)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance pair: kill-and-resume parity + async non-blocking
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_kill_and_resume_parity_fused(res_env):
+    """nan-grad at batch 5 (mid-window on the fused path): health
+    raises, resilient_fit restores from the last-good checkpoint and
+    resumes — final params identical to the uninterrupted run."""
+    X, y = _data()
+    res_env['monkeypatch'].setenv('MXTPU_FAULT_INJECT', 'nan-grad:5')
+    _reload()
+    mod, restarts = _run(X, y, num_epoch=4, resilient=True)
+    assert restarts == 1
+    recs = [r for r in _records(res_env['tele_path'])
+            if r['type'] == 'restart']
+    assert len(recs) == 1
+    assert recs[0]['reason'] == 'TrainingHealthError'
+    assert recs[0]['restore_step'] == 4
+    assert recs[0]['diagnostic']['first_bad_layer'] == 'data'
+    ref = _reference(X, y, num_epoch=4)
+    _assert_params_match(mod, ref)
+
+
+@pytest.mark.chaos
+def test_kill_and_resume_parity_per_batch(res_env):
+    """Same parity on the per-batch reference loop (fused fit off):
+    the executor-path sentinel raises BEFORE the optimizer update, so
+    restore lands on a checkpoint the nan never touched."""
+    X, y = _data()
+    mp = res_env['monkeypatch']
+    mp.setenv('MXTPU_FUSED_FIT', '0')
+    mp.setenv('MXTPU_FAULT_INJECT', 'nan-grad:5')
+    mp.setenv('MXTPU_CKPT_EVERY', '3')
+    _reload()
+    mod, restarts = _run(X, y, num_epoch=4, resilient=True)
+    assert restarts == 1
+    os.environ['MXTPU_FUSED_FIT'] = '0'
+    ref = _reference(X, y, num_epoch=4)
+    _assert_params_match(mod, ref)
+
+
+def test_async_save_overlaps_step_loop(res_env, monkeypatch):
+    """The save must not block the next dispatch: with the write
+    artificially slowed, batches keep completing strictly inside the
+    save window, and the run's FINAL state still commits (the
+    busy-writer skip is repaired by finish())."""
+    from mxnet_tpu.parallel import checkpoint as pckpt
+    from mxnet_tpu.module import checkpointing as mckpt
+    saves = []
+    real_save = pckpt.save
+
+    def slow_save(mngr, step, state, wait=True, meta=None):
+        t0 = time.time()
+        time.sleep(0.4)
+        out = real_save(mngr, step, state, wait=wait, meta=meta)
+        saves.append((step, t0, time.time()))
+        return out
+
+    monkeypatch.setattr(pckpt, 'save', slow_save)
+    X, y = _data(64)
+    steps = []
+    mod, _ = _run(X, y, num_epoch=2,
+                  callback=lambda p: steps.append(time.time()))
+    assert saves, 'no checkpoint was written'
+    overlapped = [s for (_, t0, t1) in saves
+                  for s in steps if t0 < s < t1]
+    assert overlapped, 'no batch completed while a save was in flight'
+    # the end state committed even though mid-run saves were skipped
+    # while the slow writer was busy
+    ckpt = mod.__dict__['_mxtpu_ckpt']
+    assert ckpt.last_good == ckpt.global_step == 16
+    snap = telemetry.snapshot()
+    assert snap['counters']['ckpt.saves'] >= 1
+    assert 'mxtpu-ckpt' not in [t.name.split('_')[0]
+                                for t in threading.enumerate()
+                                if t.is_alive() and 'ckpt' in t.name], \
+        'writer thread must be torn down at fit end'
+
+
+def test_fused_capture_metric_covers_saved_steps(res_env, monkeypatch):
+    """A fused-path capture must flush the pipelined stats first: the
+    saved eval-metric state covers every step the checkpoint claims
+    (pre-fix it trailed one window — W samples were lost on resume)."""
+    from mxnet_tpu.module import checkpointing as mckpt
+    metas = []
+    real = mckpt.TrainCheckpointer._do_save
+
+    def spy(self, step, tree, meta):
+        metas.append((step, meta['metric']))
+        return real(self, step, tree, meta)
+
+    monkeypatch.setattr(mckpt.TrainCheckpointer, '_do_save', spy)
+    X, y = _data()                      # 4 batches of 8 per epoch
+    _run(X, y, num_epoch=2)
+    assert metas
+    for step, metric in metas:
+        covered = sum(n for _, _, n in metric)
+        in_epoch = step % 4 or 4
+        assert covered == in_epoch * 8, \
+            'step %d capture covers %d samples' % (step, covered)
+
+
+def test_flags_off_zero_overhead(all_off):
+    """All flags off: no checkpointer is built, no writer thread ever
+    exists, no fault is armed, and the registry stays empty — the same
+    no-op contract the telemetry stack asserts."""
+    X, y = _data()
+    mod, _ = _run(X, y, num_epoch=1)
+    assert '_mxtpu_ckpt' not in mod.__dict__
+    assert not faults.enabled()
+    assert telemetry.get_registry().names() == []
+    assert not [t for t in threading.enumerate() if 'mxtpu-ckpt' in t.name]
+
+
+# ---------------------------------------------------------------------------
+# resume mechanics
+# ---------------------------------------------------------------------------
+
+def test_fresh_fit_resumes_from_last_good(res_env):
+    """A NEW fit() against a directory holding certified checkpoints
+    restores and skips the already-trained epochs — and the resumed
+    run matches the uninterrupted one exactly."""
+    X, y = _data()
+    _run(X, y, num_epoch=2)
+    recs = _records(res_env['tele_path'])
+    assert any(r.get('name') == 'ckpt.save' for r in recs
+               if r['type'] == 'span')
+    # second process-equivalent: fresh module, same flags
+    telemetry._reset_for_tests()
+    mod2, _ = _run(X, y, num_epoch=4)
+    ref = _reference(X, y, num_epoch=4)
+    _assert_params_match(mod2, ref)
+
+
+def test_resume_off_starts_fresh(res_env):
+    """MXTPU_CKPT_RESUME=0 ignores existing checkpoints."""
+    X, y = _data()
+    _run(X, y, num_epoch=2)
+    res_env['monkeypatch'].setenv('MXTPU_CKPT_RESUME', '0')
+    _reload()
+    telemetry._reset_for_tests()
+    mod2, _ = _run(X, y, num_epoch=2)
+    ckpt = mod2.__dict__['_mxtpu_ckpt']
+    assert ckpt.restored_step is None
+
+
+@pytest.mark.chaos
+def test_warn_action_never_certifies_poisoned_capture(res_env):
+    """MXTPU_HEALTH_ACTION=warn keeps training after a NaN trains into
+    the params: every capture AFTER the incident is tainted and the
+    last-good pointer must freeze at the last clean step."""
+    X, y = _data()
+    mp = res_env['monkeypatch']
+    mp.setenv('MXTPU_HEALTH_ACTION', 'warn')
+    mp.setenv('MXTPU_FAULT_INJECT', 'nan-grad:5')
+    _reload()
+    mod, _ = _run(X, y, num_epoch=4)      # runs to completion, poisoned
+    ckpt = mod.__dict__['_mxtpu_ckpt']
+    # saves at 4, 8, 12, 16 — only the pre-incident step 4 certifies
+    assert ckpt.last_good == 4
+    snap = telemetry.snapshot()
+    assert snap['counters']['ckpt.uncertified'] >= 1
+
+
+@pytest.mark.chaos
+def test_corrupt_checkpoint_falls_back_to_older(res_env):
+    """checkpoint-corrupt:8 scribbles over the newest committed step:
+    the next resume falls back to step 4 and still completes."""
+    X, y = _data()
+    res_env['monkeypatch'].setenv('MXTPU_FAULT_INJECT',
+                                  'checkpoint-corrupt:8')
+    _reload()
+    _run(X, y, num_epoch=2)          # saves at 4 and 8; 8 corrupted
+    faults._reset_for_tests()
+    os.environ.pop('MXTPU_FAULT_INJECT', None)
+    _reload()
+    telemetry._reset_for_tests()
+    mod2, _ = _run(X, y, num_epoch=4)
+    ckpt = mod2.__dict__['_mxtpu_ckpt']
+    assert ckpt.restored_step == 4
+    ref = _reference(X, y, num_epoch=4)
+    _assert_params_match(mod2, ref)
+
+
+# ---------------------------------------------------------------------------
+# fault kinds / seams
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_dispatch_exception_restart(res_env):
+    """An injected dispatch failure (no health incident) restores and
+    retries through the restart budget."""
+    X, y = _data()
+    res_env['monkeypatch'].setenv('MXTPU_FAULT_INJECT',
+                                  'dispatch-exception:5:dispatch')
+    _reload()
+    mod, restarts = _run(X, y, num_epoch=4, resilient=True)
+    assert restarts == 1
+    recs = [r for r in _records(res_env['tele_path'])
+            if r['type'] == 'restart']
+    assert recs and recs[0]['reason'] == 'FaultInjected'
+    snap = telemetry.snapshot()
+    assert snap['counters']['health.restarts'] == 1
+    ref = _reference(X, y, num_epoch=4)
+    _assert_params_match(mod, ref)
+
+
+@pytest.mark.chaos
+def test_executor_seam_per_batch(res_env):
+    """The executor seam fires on the per-batch loop."""
+    X, y = _data()
+    mp = res_env['monkeypatch']
+    mp.setenv('MXTPU_FUSED_FIT', '0')
+    mp.setenv('MXTPU_FAULT_INJECT', 'dispatch-exception:3:executor')
+    _reload()
+    mod, restarts = _run(X, y, num_epoch=2, resilient=True)
+    assert restarts == 1
+
+
+@pytest.mark.chaos
+def test_slow_host_fault_delays_steps(all_off, monkeypatch):
+    """slow-host:0:40 sleeps ~40ms per counted step from step 0 on."""
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'slow-host:0:40')
+    _reload()
+    faults._reset_for_tests()
+    assert faults.enabled()
+    t0 = time.time()
+    faults.note_steps(1)
+    assert time.time() - t0 >= 0.03
+    assert faults.spec() == ('slow-host', 0, '40')
+
+
+def test_fault_parse_rejects_garbage(all_off, monkeypatch):
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'not-a-kind:3')
+    _reload()
+    faults._reset_for_tests()
+    assert not faults.enabled()   # warn + disabled, never raises
+
+
+def test_backend_probe_timeout_parse(all_off, monkeypatch):
+    """bench.py parses backend-probe-timeout without importing the
+    framework (its backend decision precedes any mxnet_tpu import)."""
+    import importlib
+    import bench
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'backend-probe-timeout:2')
+    assert bench._fault_probe_timeouts() == 2
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'nan-grad:5')
+    assert bench._fault_probe_timeouts() == 0
+    monkeypatch.delenv('MXTPU_FAULT_INJECT')
+    assert bench._fault_probe_timeouts() == 0
+
+
+# ---------------------------------------------------------------------------
+# resilient_fit budget / retryability
+# ---------------------------------------------------------------------------
+
+class _FakeIter:
+    def reset(self):
+        pass
+
+
+class _FakeModule:
+    def __init__(self, fail_times, exc=RuntimeError):
+        self.calls = 0
+        self.fail_times = fail_times
+        self.exc = exc
+
+    def fit(self, train_data, **kw):
+        self.calls += 1
+        if self.calls <= self.fail_times:
+            raise self.exc('boom %d' % self.calls)
+
+
+def test_restart_budget_exhausted(all_off):
+    m = _FakeModule(fail_times=99)
+    with pytest.raises(RuntimeError):
+        resilient_fit(m, _FakeIter(), restart_max=2, restart_backoff=0)
+    assert m.calls == 3               # initial + 2 restarts
+
+
+def test_restart_recovers_within_budget(all_off):
+    m = _FakeModule(fail_times=2)
+    restarts = resilient_fit(m, _FakeIter(), restart_max=3,
+                             restart_backoff=0)
+    assert restarts == 2 and m.calls == 3
+
+
+def test_non_retryable_raises_immediately(all_off):
+    m = _FakeModule(fail_times=99, exc=ValueError)
+    with pytest.raises(ValueError):
+        resilient_fit(m, _FakeIter(), restart_max=3, restart_backoff=0)
+    assert m.calls == 1
+    assert is_retryable(TrainingHealthError('x'))
+    assert is_retryable(faults.FaultInjected('x'))
+    assert not is_retryable(AssertionError('x'))
+    assert not is_retryable(KeyboardInterrupt())
+
+
+# ---------------------------------------------------------------------------
+# restart records in tooling
+# ---------------------------------------------------------------------------
+
+def test_report_reconstructs_restart_counts(all_off):
+    import telemetry_report
+    recs = [{'type': 'restart', 'attempt': 1, 'reason': 'X'},
+            {'type': 'restart', 'attempt': 2, 'reason': 'X'},
+            {'type': 'restart', 'attempt': 2, 'final': True,
+             'reason': 'clean_exit'}]
+    health = telemetry_report._reconstruct_health(recs)
+    assert health['restarts'] == 2
+    from mxnet_tpu.telemetry import export
+    lines = export._health_lines({'nonfinite_steps': 0, 'incidents': [],
+                                  'anomaly_counts': {}, 'restarts': 2})
+    assert any('restarts' in ln and '2' in ln for ln in lines)
+
+
+@pytest.mark.chaos
+def test_train_supervisor_relaunches(tmp_path):
+    """The whole-process supervisor relaunches an unclean exit and
+    stops on the first clean one, logging each restart."""
+    state = tmp_path / 'attempts'
+    log = tmp_path / 'sup.jsonl'
+    child = tmp_path / 'child.py'
+    child.write_text(
+        "import os, sys\n"
+        "p = %r\n"
+        "n = int(open(p).read()) if os.path.exists(p) else 0\n"
+        "open(p, 'w').write(str(n + 1))\n"
+        "sys.exit(0 if n >= 2 else 1)\n" % str(state))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, 'tools',
+                                      'train_supervisor.py'),
+         '--backoff', '0', '--log', str(log), '--',
+         sys.executable, str(child)],
+        capture_output=True, text=True, timeout=60)
+    assert proc.returncode == 0, proc.stderr
+    recs = _records(log)
+    mid = [r for r in recs if not r.get('final')]
+    assert len(mid) == 2 and all(r['reason'] == 'process_exit'
+                                 for r in mid)
+    assert recs[-1]['final'] and recs[-1]['reason'] == 'clean_exit'
+    assert 'MXTPU_CKPT_DIR is not set' in proc.stderr

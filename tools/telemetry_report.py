@@ -73,6 +73,7 @@ def _reconstruct_health(records):
     anomaly_counts = {}
     last_anomaly = None
     input_bound = None
+    restarts = 0
     for r in records:
         typ = r.get('type')
         if typ == 'health' and r.get('event') == 'nonfinite':
@@ -85,10 +86,18 @@ def _reconstruct_health(records):
             anomaly_counts[name] = anomaly_counts.get(name, 0) + 1
             last_anomaly = {k: v for k, v in r.items()
                             if k not in ('type', 't')}
-    if not incidents and not anomaly_counts and input_bound is None:
+        elif typ == 'restart' and not r.get('final'):
+            # one record per supervised restart (resilient_fit /
+            # train_supervisor); the supervisor's final summary record
+            # repeats the attempt count, so it does not count again
+            restarts += 1
+    if not incidents and not anomaly_counts and input_bound is None \
+            and not restarts:
         return None
     out = {'nonfinite_steps': len(incidents), 'incidents': incidents[:8],
            'anomaly_counts': anomaly_counts, 'last_anomaly': last_anomaly}
+    if restarts:
+        out['restarts'] = restarts
     if input_bound is not None:
         out['input_bound_pct'] = input_bound
     return out
@@ -149,8 +158,20 @@ def _summary_parts(records):
                    if k not in ('type', 't', 'host')}
     if summaries:
         s = summaries[-1]
+        health = s.get('health')
+        restarts = sum(1 for r in records if r.get('type') == 'restart'
+                       and not r.get('final'))
+        if restarts:
+            # supervisor relaunches append restart records from OUTSIDE
+            # the process that wrote this summary, so its health.restarts
+            # counter never saw them; in-process (resilient_fit) restarts
+            # land in both, so max() never double-counts
+            health = dict(health or {'nonfinite_steps': 0, 'incidents': [],
+                                     'anomaly_counts': {}})
+            health['restarts'] = max(int(health.get('restarts') or 0),
+                                     restarts)
         return (s.get('snapshot') or {}, s.get('elapsed_s'),
-                s.get('programs'), s.get('health'),
+                s.get('programs'), health,
                 s.get('cluster') or cluster, False)
     snapshot, elapsed, programs, health = _reconstruct(records)
     return snapshot, elapsed, programs, health, cluster, True

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Whole-process training supervision: restart a dying run from the
+outside.
+
+``module/resilient_fit.py`` restarts a run that fails *inside* the
+process (a TrainingHealthError, a dispatch exception). This wrapper
+covers the failures it cannot: host loss, a wedged backend that takes
+the interpreter down, an OOM kill, a segfaulting runtime. It launches
+any training command as a child process and, while the restart budget
+lasts, relaunches it after an unclean exit::
+
+    python tools/train_supervisor.py -- python train.py --epochs 90
+    MXTPU_RESTART_MAX=5 MXTPU_RESTART_BACKOFF=10 \
+        python tools/train_supervisor.py --log sup.jsonl -- python train.py
+
+Restart-from-last-good comes for free: the child is expected to run
+with ``MXTPU_CKPT_DIR``/``MXTPU_CKPT_EVERY`` set (the supervisor warns
+when they are not), so each relaunch resumes from the newest
+health-certified checkpoint via the module's own MXTPU_CKPT_RESUME
+path — the supervisor never parses or rewrites training state itself.
+
+Every restart is recorded as a ``restart`` JSONL record (appended to
+``--log``, or to the child's MXTPU_TELEMETRY_PATH so the run's own
+telemetry log carries its restart history) and the final record
+summarizes the outcome. Exit code: the child's last exit code.
+
+Budget/backoff share the in-process driver's flags: MXTPU_RESTART_MAX
+attempts, MXTPU_RESTART_BACKOFF * 2^(k-1) seconds between them (capped
+at 60s). A clean exit (code 0) or SIGINT stops the loop immediately.
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+_BACKOFF_CAP_S = 60.0
+
+# exit codes that restarting cannot help: misuse of the CLI itself
+_NO_RETRY_CODES = (2,)
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, '') or default)
+    except ValueError:
+        return default
+
+
+def _record(path, rec):
+    if not path:
+        return
+    try:
+        with open(path, 'a') as f:
+            f.write(json.dumps(rec) + '\n')
+    except OSError as e:
+        print('train_supervisor: cannot append to %s (%s)' % (path, e),
+              file=sys.stderr)
+
+
+def _describe(code):
+    if code is None:
+        return 'running'
+    if code < 0:
+        try:
+            return 'killed by signal %s' % signal.Signals(-code).name
+        except ValueError:
+            return 'killed by signal %d' % -code
+    return 'exit code %d' % code
+
+
+def run(cmd, restart_max, backoff, log_path, quiet=False):
+    """Supervise one training command; returns its final exit code."""
+    attempts = 0
+    while True:
+        t0 = time.time()
+        try:
+            proc = subprocess.Popen(cmd)
+        except OSError as e:
+            print('train_supervisor: cannot launch %r (%s)'
+                  % (cmd[0], e), file=sys.stderr)
+            return 127
+        try:
+            code = proc.wait()
+        except KeyboardInterrupt:
+            # the operator wants the run down: forward and stop —
+            # an interactive stop is never a fault to retry
+            proc.send_signal(signal.SIGINT)
+            try:
+                code = proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                code = proc.wait()
+            _record(log_path, {'type': 'restart', 'attempt': attempts,
+                               'final': True, 'reason': 'KeyboardInterrupt',
+                               'exit_code': code})
+            return code
+        elapsed = time.time() - t0
+        if code == 0:
+            if attempts and not quiet:
+                print('train_supervisor: run completed after %d restart(s)'
+                      % attempts, file=sys.stderr)
+            _record(log_path, {'type': 'restart', 'attempt': attempts,
+                               'final': True, 'reason': 'clean_exit',
+                               'exit_code': 0})
+            return 0
+        if code in _NO_RETRY_CODES or attempts >= restart_max:
+            _record(log_path, {'type': 'restart', 'attempt': attempts,
+                               'final': True, 'reason': 'budget_exhausted'
+                               if code not in _NO_RETRY_CODES else 'usage',
+                               'exit_code': code})
+            if not quiet:
+                print('train_supervisor: giving up after %d attempt(s) '
+                      '(%s)' % (attempts + 1, _describe(code)),
+                      file=sys.stderr)
+            return code
+        attempts += 1
+        delay = min(_BACKOFF_CAP_S, backoff * (2.0 ** (attempts - 1)))
+        _record(log_path, {'type': 'restart', 'attempt': attempts,
+                           'reason': 'process_exit',
+                           'message': _describe(code), 'exit_code': code,
+                           'elapsed_s': round(elapsed, 1),
+                           'backoff_s': delay})
+        if not quiet:
+            print('train_supervisor: attempt %d/%d died (%s after %.0fs) '
+                  '— relaunching in %.1fs'
+                  % (attempts, restart_max, _describe(code), elapsed,
+                     delay), file=sys.stderr)
+        if delay:
+            try:
+                time.sleep(delay)
+            except KeyboardInterrupt:
+                # operator stop between attempts: no child to forward
+                # to — close the record stream with the same terminal
+                # record the mid-run Ctrl-C path writes
+                _record(log_path, {'type': 'restart', 'attempt': attempts,
+                                   'final': True,
+                                   'reason': 'KeyboardInterrupt',
+                                   'exit_code': code})
+                return code
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description='Run a training command under restart supervision '
+                    '(relaunch after unclean exits, restart budget + '
+                    'exponential backoff from MXTPU_RESTART_*).')
+    p.add_argument('--restart-max', type=int, default=None,
+                   help='restart budget (default: MXTPU_RESTART_MAX or 3)')
+    p.add_argument('--backoff', type=float, default=None,
+                   help='base backoff seconds '
+                        '(default: MXTPU_RESTART_BACKOFF or 2)')
+    p.add_argument('--log', default=None,
+                   help='JSONL file for restart records (default: the '
+                        "child's MXTPU_TELEMETRY_PATH when set)")
+    p.add_argument('--quiet', action='store_true',
+                   help='suppress supervisor stderr chatter')
+    p.add_argument('cmd', nargs=argparse.REMAINDER,
+                   help='training command (prefix with -- )')
+    args = p.parse_args(argv)
+    cmd = list(args.cmd)
+    if cmd and cmd[0] == '--':
+        cmd = cmd[1:]
+    if not cmd:
+        p.error('no training command given (append: -- python train.py ...)')
+    restart_max = args.restart_max if args.restart_max is not None \
+        else _env_int('MXTPU_RESTART_MAX', 3)
+    backoff = args.backoff if args.backoff is not None \
+        else _env_float('MXTPU_RESTART_BACKOFF', 2.0)
+    log_path = args.log or os.environ.get('MXTPU_TELEMETRY_PATH')
+    if not args.quiet and not os.environ.get('MXTPU_CKPT_DIR'):
+        print('train_supervisor: MXTPU_CKPT_DIR is not set — restarts '
+              'will rerun from epoch 0 (set MXTPU_CKPT_DIR and '
+              'MXTPU_CKPT_EVERY so relaunches resume from the last-good '
+              'checkpoint)', file=sys.stderr)
+    return run(cmd, restart_max, backoff, log_path, quiet=args.quiet)
+
+
+if __name__ == '__main__':
+    sys.exit(main())
