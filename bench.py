@@ -653,10 +653,13 @@ def run_infer_bench(platform, kind):
     return out
 
 
-def _telemetry_breakdown(device):
+def _telemetry_breakdown(device, step_ms=None):
     """The dispatch/compile breakdown + peak device bytes from the
     telemetry registry, as a JSON-ready dict (None when telemetry is
-    off or empty) — BENCH_*.json carries this from this round on."""
+    off or empty) — BENCH_*.json carries this from this round on.
+    ``step_ms`` is the measured per-step wall time — the roofline's
+    denominator (the registry can only see per-DISPATCH spans here,
+    which cover STEPS_PER_CALL steps each)."""
     try:
         from mxnet_tpu import telemetry as _tele
         if not _tele.enabled():
@@ -709,6 +712,15 @@ def _telemetry_breakdown(device):
                     'compiles': r['compiles'],
                     'dispatches': r['dispatches']}
                 for n, r in sorted(progs.items())}
+        # roofline attribution (ISSUE 7): per-layer class + achieved/
+        # peak placement and the collective accounting, published to
+        # gauges/JSONL by summarize() and folded here (layers truncated
+        # to the summary block's TOP_N — the JSONL record keeps all)
+        roof = _tele.roofline.summarize(step_time_ms=step_ms)
+        if roof:
+            top_n = _tele.roofline.TOP_N
+            tel['roofline'] = dict(roof, layers=roof['layers'][:top_n],
+                                   n_layers=len(roof['layers']))
         return tel or None
     except Exception as e:  # noqa: BLE001 — the bench number must survive
         _log('telemetry fold-in failed: %s' % e)
@@ -726,6 +738,10 @@ def main():
     os.environ.setdefault('MXTPU_TELEMETRY_PATH',
                           os.path.join(tempfile.gettempdir(),
                                        'bench_telemetry.jsonl'))
+    # roofline attribution rides every bench run (ISSUE 7): per-layer
+    # achieved-vs-peak classification + collective accounting fold into
+    # the emitted JSON below. setdefault: an explicit =0 still wins.
+    os.environ.setdefault('MXTPU_ROOFLINE', '1')
     if os.environ.get('MXTPU_BENCH_DIRECT'):
         # child of a successful late reprobe: init the default backend
         # straight away (the parent just verified it is healthy)
@@ -856,6 +872,10 @@ def main():
     for _ in range(WARMUP_STEPS):
         masters, aux, vel, loss = compiled(
             masters, aux, vel, images, labels, key)
+        # bench drives the raw compiled object, so the registrar's
+        # wrapper never sees these dispatches — count them explicitly
+        # or the bench.train_step program record reports dispatches=0
+        _tele.programs.note_dispatch('bench.train_step')
     # sync via host fetch: on tunneled runtimes block_until_ready can
     # return before the chain drains; a device->host copy cannot
     loss_val = float(np.asarray(loss))
@@ -876,6 +896,7 @@ def main():
         with _tele.span('bench.dispatch', 'bench'):
             masters, aux, vel, loss = compiled(
                 masters, aux, vel, images, labels, key)
+        _tele.programs.note_dispatch('bench.train_step')  # see warmup
         # feeds the xla.mfu estimate together with note_step_flops above
         _tele.counter('fit.steps').inc(STEPS_PER_CALL)
     float(np.asarray(loss))  # host fetch = true barrier (see warmup)
@@ -959,7 +980,8 @@ def main():
     if platform.startswith('cpu'):
         out['note'] = ('cpu run at reduced batch; not config-comparable '
                        'to the batch-32 GPU baseline')
-    tel = _telemetry_breakdown(devices[0])
+    tel = _telemetry_breakdown(
+        devices[0], step_ms=dt / (bench_steps * STEPS_PER_CALL) * 1e3)
     if tel:
         out['telemetry'] = tel
     # inference tier (ISSUE 2): fused Module.predict vs the per-batch

@@ -266,6 +266,33 @@ flags.declare('MXTPU_XPROF', str, '',
               '(no capture against the tunneled axon chip). Empty = off')
 flags.declare('MXTPU_XPROF_DIR', str, 'xprof_trace',
               'Output directory for the MXTPU_XPROF device trace')
+flags.declare('MXTPU_ROOFLINE', bool, False,
+              'Roofline attribution (mxnet_tpu/telemetry/roofline.py, '
+              'requires MXTPU_TELEMETRY=1): parse every registered '
+              "program's HLO into per-layer FLOPs/bytes, join measured "
+              'per-fusion device timings from the MXTPU_XPROF capture '
+              'by jax.named_scope layer name, classify each layer '
+              'compute-/memory-/overhead-bound against the chip peak '
+              'table, and account collective bytes/time/overlap per '
+              'step. Off = no HLO text is ever rendered or parsed (one '
+              'cached-bool check at the program registrar)')
+flags.declare('MXTPU_ROOFLINE_TRACE', str, '',
+              'Path to a jax.profiler capture (directory, or a '
+              '*.trace.json[.gz] file) supplying the roofline\'s '
+              'measured per-layer timings. Empty = use MXTPU_XPROF_DIR '
+              'when a capture exists there, else distribute the '
+              'registry-measured step time across layers by their '
+              'roofline-minimum times (source: modeled)')
+flags.declare('MXTPU_PEAK_TFLOPS', float, 0.0,
+              'Override the device peak dense bf16 TFLOP/s used by the '
+              'MFU estimate and the roofline denominators (for chips '
+              'missing from the telemetry/xla.py table — the '
+              'warn-once path names this flag). 0 = use the table',
+              min_value=0.0)
+flags.declare('MXTPU_PEAK_HBM_GBS', float, 0.0,
+              'Override the device peak HBM GB/s used by the roofline '
+              'denominators (pairs with MXTPU_PEAK_TFLOPS). 0 = use '
+              'the table', min_value=0.0)
 flags.declare('MXTPU_PROFILER_XLA_TRACE', str, 'auto',
               "Attach jax.profiler alongside the host-span trace when the "
               "profiler runs: '1' always, '0' never, 'auto' = only on "

@@ -74,10 +74,12 @@ from . import programs  # noqa: F401  (public submodule: telemetry.programs.*)
 from . import health  # noqa: F401  (public submodule: telemetry.health.*)
 from . import cluster  # noqa: F401  (public submodule: telemetry.cluster.*)
 from . import serve  # noqa: F401  (public submodule: telemetry.serve.*)
+from . import roofline  # noqa: F401  (public submodule: telemetry.roofline.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
-           'programs', 'health', 'cluster', 'serve', 'get_registry']
+           'programs', 'health', 'cluster', 'serve', 'roofline',
+           'get_registry']
 
 
 class _State:
@@ -287,7 +289,8 @@ def summary():
                                  or None,
                                  health=health.snapshot_health(
                                      input_bound=health.input_bound_pct()),
-                                 cluster=cluster.snapshot_cluster())
+                                 cluster=cluster.snapshot_cluster(),
+                                 roofline=roofline.snapshot_roofline())
 
 
 def write_summary(log=True):
@@ -304,6 +307,10 @@ def write_summary(log=True):
     # gauge and (with MXTPU_HEALTH=1) returns the "Run health" block's
     # input + the summary record's 'health' key
     hsnap = health.summarize()
+    # roofline attribution (MXTPU_ROOFLINE): publishes roofline.*
+    # gauges + the roofline JSONL record; must run before the snapshot
+    # below so the gauges land in the summary record too
+    rsnap = roofline.summarize()
     csnap = cluster.snapshot_cluster()
     snap = _state.registry.snapshot()
     progs = programs.snapshot_programs()
@@ -317,10 +324,13 @@ def write_summary(log=True):
             rec['health'] = hsnap
         if csnap:
             rec['cluster'] = csnap
+        if rsnap:
+            rec['roofline'] = rsnap
         _state.sink.emit(rec)
         _state.sink.flush()
     table = _export.summary_table(snap, elapsed, programs=progs or None,
-                                  health=hsnap, cluster=csnap)
+                                  health=hsnap, cluster=csnap,
+                                  roofline=rsnap)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -364,3 +374,4 @@ def _reset_for_tests():
     programs._reset_for_tests()
     health._reset_for_tests()
     cluster._reset_for_tests()
+    roofline._reset_for_tests()

@@ -15,12 +15,15 @@ host. This module is the cluster half of the telemetry plane:
   path by default, and off-sync steps cost one clock read + a deque
   append, no device work) each host contributes a small vector of key
   gauges — step-time p50, io-wait share, dispatch-span p50, live device
-  bytes — to ONE off-graph allgather (jax multihost_utils over the
+  bytes, and (with MXTPU_ROOFLINE) the roofline's collective share of
+  the step — to ONE off-graph allgather (jax multihost_utils over the
   global mesh);
 - **publication**: process 0 turns the gathered matrix into
   ``cluster.*`` gauges (per-host rows, step-time spread, slowest-host
-  id, straggler classification — input-bound vs compute-bound via the
-  health module's io-wait classifier), a ``cluster`` JSONL record, the
+  id, straggler classification — input-bound via the health module's
+  io-wait classifier, communication-bound via the roofline's measured
+  per-collective step share, compute-bound otherwise), a ``cluster``
+  JSONL record, the
   "Cluster" block of the summary table, and the ``/metrics`` scrape.
 
 Gating: ``MXTPU_TELEMETRY=1`` *and* ``MXTPU_TELEMETRY_SYNC_EVERY>0``.
@@ -45,10 +48,16 @@ import numpy as np
 __all__ = ['enabled', 'host_index', 'set_host', 'note_step', 'sync_now',
            'snapshot_cluster', 'classify', 'SYNC_KEYS']
 
-# slots of the per-host sync vector, in order
-SYNC_KEYS = ('step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes')
+# slots of the per-host sync vector, in order ('comm_pct' — the
+# roofline's collective share of the step — is NaN/omitted unless
+# MXTPU_ROOFLINE runs; rows from an older sender with fewer slots are
+# padded with NaN at publish)
+SYNC_KEYS = ('step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes',
+             'comm_pct')
 
 _SPREAD_BALANCED_PCT = 5.0   # step-time spread below this = no straggler
+_COMM_BOUND_PCT = 30.0       # collective share of the step above which a
+                             # straggling host reads communication_bound
 _RING = 128                  # recent per-step wall samples backing the p50
 
 
@@ -186,7 +195,14 @@ def _local_stats():
                 disp /= float(w.value)
     live_g = reg.get('xla.bytes_in_use')
     live = float(live_g.value) if live_g is not None and live_g.value else 0.0
-    return [step_ms, float(io_pct), float(disp), live]
+    # the roofline's per-collective accounting (MXTPU_ROOFLINE): the
+    # share of the step spent in all-reduce/all-gather/… — what grounds
+    # a communication_bound straggler verdict in numbers instead of
+    # inference. NaN = unavailable (flag off / nothing ingested yet)
+    from . import roofline
+    comm = roofline.comm_pct_of_step()
+    return [step_ms, float(io_pct), float(disp), live,
+            float(comm) if comm is not None else float('nan')]
 
 
 def _allgather(vals):
@@ -202,13 +218,20 @@ def _allgather(vals):
     return out.reshape(max(1, jax.process_count()), -1)
 
 
-def classify(io_wait_pct):
+def classify(io_wait_pct, comm_pct=None):
     """The straggler classification for one host: where its time goes.
     Reuses the health module's input-bound threshold so the live
-    cluster view and the end-of-run classifier agree."""
+    cluster view and the end-of-run classifier agree; a host that is
+    not input-bound but spends >= ``_COMM_BOUND_PCT`` of its step in
+    collectives (the roofline's per-collective accounting, when
+    MXTPU_ROOFLINE measured one) reads ``communication_bound`` — the
+    verdict the quantized-collectives work keys off."""
     from .health import _INPUT_BOUND_PCT
-    return ('input_bound' if (io_wait_pct or 0.0) >= _INPUT_BOUND_PCT
-            else 'compute_bound')
+    if (io_wait_pct or 0.0) >= _INPUT_BOUND_PCT:
+        return 'input_bound'
+    if comm_pct is not None and comm_pct >= _COMM_BOUND_PCT:
+        return 'communication_bound'
+    return 'compute_bound'
 
 
 def sync_now():
@@ -248,7 +271,9 @@ def _publish(mat, steps):
     for i in range(n):
         row = {'host': i}
         for j, key in enumerate(SYNC_KEYS):
-            v = float(mat[i, j])
+            # rows shorter than SYNC_KEYS (a crafted test matrix, or a
+            # sender predating a slot) pad with NaN = unavailable
+            v = float(mat[i, j]) if j < mat.shape[1] else float('nan')
             # a NaN sample means that host hasn't measured this yet
             # (step ring still empty): omit it — JSON null, no gauge —
             # rather than publish a fake zero
@@ -261,6 +286,8 @@ def _publish(mat, steps):
         reg.gauge('cluster.h%d.dispatch_ms' % i).set(row['dispatch_ms'])
         reg.gauge('cluster.h%d.live_mb' % i).set(
             round(row['live_bytes'] / 2.0**20, 1))
+        if row['comm_pct'] is not None:
+            reg.gauge('cluster.h%d.comm_pct' % i).set(row['comm_pct'])
     times = mat[:, 0]
     valid = ~np.isnan(times)
     if valid.any():
@@ -273,9 +300,12 @@ def _publish(mat, steps):
     else:
         slowest = None
         spread = 0.0
-    straggler = 'balanced' \
-        if (n == 1 or slowest is None or spread < _SPREAD_BALANCED_PCT) \
-        else classify(float(mat[slowest, 1]))
+    if n == 1 or slowest is None or spread < _SPREAD_BALANCED_PCT:
+        straggler = 'balanced'
+    else:
+        comm_v = float(mat[slowest, 4]) if mat.shape[1] > 4 else float('nan')
+        straggler = classify(float(mat[slowest, 1]),
+                             None if np.isnan(comm_v) else comm_v)
     reg.gauge('cluster.hosts').set(n)
     if slowest is not None:
         reg.gauge('cluster.slowest_host').set(slowest)

@@ -3,7 +3,8 @@
 The JSONL sink is the machine-readable record a perf investigation
 greps after the fact: one JSON object per line, each with a ``type``
 ('start', 'span', 'compile', 'retrace_storm', 'event', 'program',
-'oom', 'health', 'anomaly', 'summary') and a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
+'oom', 'health', 'anomaly', 'roofline', 'summary') and a ``t``
+epoch-seconds stamp. Records buffer in memory and flush every
 ``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
 on a per-batch fsync.
 
@@ -171,6 +172,62 @@ def _health_lines(health):
     return lines
 
 
+def _roofline_lines(roof):
+    """The "roofline" block (telemetry.roofline.analyze()'s dict): the
+    ranked top-N bottleneck layers — class, achieved/peak %, estimated
+    headroom — plus the whole-step communication accounting. Rendered
+    deterministically from the dict alone so the offline CLI
+    (tools/roofline_report.py) reproduces the live block byte-for-byte
+    from the JSONL record."""
+    from .roofline import TOP_N
+    lines = ['-- roofline: %s (%s) --'
+             % (roof.get('program', '?'), roof.get('source', '?'))]
+    if roof.get('peak_tflops') is not None:
+        lines.append('  device            %s (%s peaks: %s TFLOP/s, %s GB/s)'
+                     % (roof.get('device') or '?', roof.get('peaks'),
+                        _fmt(float(roof['peak_tflops'])),
+                        _fmt(float(roof['peak_hbm_gbs']))
+                        if roof.get('peak_hbm_gbs') is not None else '-'))
+    else:
+        lines.append('  device            %s (no peak table entry — set '
+                     'MXTPU_PEAK_TFLOPS/MXTPU_PEAK_HBM_GBS)'
+                     % (roof.get('device') or '?'))
+    if roof.get('step_time_ms') is not None:
+        lines.append('  step_time_ms      %s'
+                     % _fmt(float(roof['step_time_ms'])))
+    layers = roof.get('layers') or []
+    if layers:
+        w = max(max(len(str(r.get('layer', '?'))) for r in layers[:TOP_N]),
+                len('layer'))
+        lines.append('  %-*s  %-14s %8s %10s %12s'
+                     % (w, 'layer', 'class', 'roof%', 'time_ms',
+                        'headroom_ms'))
+        for r in layers[:TOP_N]:
+            lines.append('  %-*s  %-14s %8s %10s %12s'
+                         % (w, r.get('layer', '?'), r.get('class', '?'),
+                            _fmt(r.get('roof_pct')), _fmt(r.get('time_ms')),
+                            _fmt(r.get('headroom_ms'))))
+        if len(layers) > TOP_N:
+            lines.append('  (+%d more layers)' % (len(layers) - TOP_N))
+    comm = roof.get('comm')
+    if comm:
+        line = '  comm              %s MiB/step' % _mib(comm.get('bytes')
+                                                        or 0)
+        if comm.get('time_ms') is not None:
+            line += ', %s ms' % _fmt(float(comm['time_ms']))
+        if comm.get('pct_of_step') is not None:
+            line += ' = %s%% of step' % _fmt(float(comm['pct_of_step']))
+        if comm.get('overlap_pct') is not None:
+            line += ', overlap %s%%' % _fmt(float(comm['overlap_pct']))
+        ops = comm.get('ops') or {}
+        opstr = ', '.join('%s %s MiB' % (k, _mib(ops[k]))
+                          for k in sorted(ops))
+        line += ' (%s%s)' % (comm.get('source', '?'),
+                             ('; ' + opstr) if opstr else '')
+        lines.append(line)
+    return lines
+
+
 def _cluster_lines(cluster):
     """The "Cluster" block (telemetry.cluster.snapshot_cluster's dict):
     one row per host from the last aggregation round, the spread, and
@@ -203,7 +260,7 @@ def _cluster_lines(cluster):
 
 
 def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
-                  cluster=None):
+                  cluster=None, roofline=None):
     """Registry snapshot -> aligned text table (one block per kind).
     ``programs`` is telemetry.programs.snapshot_programs()'s {name:
     record} — rendered as a per-program cost table (and the redundant
@@ -212,7 +269,9 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
     as the "Run health" block; ``cluster`` is
     telemetry.cluster.snapshot_cluster()'s dict — rendered as the
     "Cluster" block (its per-host ``cluster.*`` gauges are elided the
-    same way)."""
+    same way); ``roofline`` is telemetry.roofline.analyze()'s dict —
+    rendered as the ranked-bottleneck "roofline" block (the
+    ``roofline.*`` gauges are elided the same way)."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
@@ -226,6 +285,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
         # the Cluster block already carries these values
         gauges = {n: v for n, v in gauges.items()
                   if not n.startswith('cluster.')}
+    if roofline:
+        # the roofline block already carries these values
+        gauges = {n: v for n, v in gauges.items()
+                  if not n.startswith('roofline.')}
     if counters:
         lines.append('-- counters --')
         w = max(len(n) for n in counters)
@@ -252,6 +315,8 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
                           _mib(r.get('temp_bytes', 0)),
                           _mib(r.get('argument_bytes', 0)),
                           _mib(r.get('output_bytes', 0))))
+    if roofline:
+        lines.extend(_roofline_lines(roofline))
     if cluster:
         lines.extend(_cluster_lines(cluster))
     if health:

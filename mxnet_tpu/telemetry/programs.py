@@ -105,6 +105,14 @@ def note_program(name, compiled=None, analysis=None, step_flops=False,
     st = _state()
     if not st.active:
         return analysis
+    if compiled is not None:
+        # roofline attribution (MXTPU_ROOFLINE): parse the program's
+        # HLO into per-layer costs while the executable is in hand —
+        # one cached-bool check when the flag is off
+        from . import roofline
+        if roofline.enabled():
+            roofline.note_compiled(name, compiled, analysis=analysis,
+                                   step_flops=step_flops)
     with _lock:
         rec = _programs.get(name)
         if rec is None:

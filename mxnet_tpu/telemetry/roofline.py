@@ -1,0 +1,787 @@
+"""Roofline attribution: per-layer achieved-vs-peak diagnosis.
+
+The registrar (:mod:`.programs`) knows each compiled program's total
+FLOPs and bytes; the MXTPU_XPROF capture knows where device time went;
+neither alone says *which layer to fix*. This module joins them the way
+cost-model-driven compiler stacks do (TVM, arXiv:1802.04799): place
+every layer on the device's roofline (Williams et al., the
+operational-intensity model) and classify what bounds it.
+
+Data flow, all host-side (the compiled programs are untouched — the
+lowered HLO is byte-identical with the flag on or off):
+
+1. **per-layer costs** — when a compile site registers a program,
+   :func:`note_compiled` parses its HLO text. Every instruction carries
+   ``metadata={op_name="..."}`` with the ``jax.named_scope`` layer name
+   PR 3 planted (executor nodes, fused-window bodies); shapes give
+   bytes, and dot/convolution contraction dims give FLOPs. The parsed
+   totals are calibrated against XLA's own ``cost_analysis()`` /
+   ``memory_analysis()`` numbers so the per-layer split always sums to
+   what XLA reports for the whole program.
+2. **measured timings** — a ``jax.profiler`` capture (the MXTPU_XPROF
+   trace, or MXTPU_ROOFLINE_TRACE) is parsed as chrome-trace JSON;
+   events are keyed back to layers through the HLO instruction names.
+   Without a capture the measured step time is *distributed* across
+   layers in proportion to each layer's roofline-minimum time
+   (``source: modeled`` — the CPU/best-effort fallback).
+3. **classification** — per layer: achieved FLOP/s, achieved bytes/s,
+   arithmetic intensity, and the placement against the peak table
+   (:func:`.xla.device_peaks`): the roofline-minimum time is
+   ``max(flops/peak_flops, bytes/peak_hbm)``; a layer whose FLOPs term
+   dominates is **compute-bound**, one whose bytes term dominates is
+   **memory-bound**, and one running far below both ceilings
+   (< ``OVERHEAD_UTIL_PCT`` of its roofline) — or carrying no cost at
+   all — is **overhead-bound**.
+4. **communication accounting** — all-reduce / all-gather /
+   collective-permute / reduce-scatter / all-to-all instructions are
+   summed separately: bytes on the wire per step, measured (or
+   modeled) collective time, the comm share of the step, and the
+   fraction of collective time overlapped with compute — the
+   per-collective numbers the cluster straggler classifier's
+   ``communication_bound`` verdict is grounded in.
+
+Surfacing: a ranked bottleneck block in the end-of-run summary table
+("layer, class, achieved/peak %, est. headroom ms"), a ``roofline``
+JSONL record carrying the full analysis, ``roofline.*`` gauges on
+/metrics and /summary, a ``roofline`` section in BENCH json, and
+``tools/roofline_report.py`` offline (byte-identical block).
+
+Gating: ``MXTPU_ROOFLINE=1`` *and* ``MXTPU_TELEMETRY=1``. Off = the
+zero-overhead no-op contract of the rest of the plane: no HLO text is
+ever rendered or parsed, no registry writes, one cached-bool check at
+the registrar hook.
+"""
+import gzip
+import json
+import logging
+import os
+import re
+import threading
+
+__all__ = ['enabled', 'note_compiled', 'note_hlo', 'hlo_layer_costs',
+           'load_trace_events', 'analyze', 'summarize',
+           'snapshot_roofline', 'TOP_N', 'OVERHEAD_UTIL_PCT',
+           'CLASS_COMPUTE', 'CLASS_MEMORY', 'CLASS_OVERHEAD']
+
+TOP_N = 8                  # bottleneck rows rendered in the summary block
+OVERHEAD_UTIL_PCT = 10.0   # below this % of its roofline ceiling a
+                           # measured layer classifies overhead-bound
+CLASS_COMPUTE = 'compute-bound'
+CLASS_MEMORY = 'memory-bound'
+CLASS_OVERHEAD = 'overhead-bound'
+CLASS_UNKNOWN = 'unknown'  # no peak table entry for this device
+
+# HLO opcode prefixes that move bytes between chips instead of running
+# math — the communication-accounting family ('-start' variants match
+# by prefix; '-done' halves are skipped so nothing counts twice)
+COMM_OPS = ('all-reduce', 'all-gather', 'collective-permute',
+            'reduce-scatter', 'all-to-all', 'collective-broadcast')
+
+_lock = threading.Lock()
+_decided = None
+_programs = {}   # name -> parsed per-layer cost store (see _ingest)
+_last = None     # last published analysis dict (snapshot_roofline)
+_explicit_step_ms = None   # measured per-step ms a caller fed summarize()
+
+
+def _tele():
+    from . import enabled as tele_enabled
+    tele_enabled()
+    from . import _state as st
+    return st
+
+
+def enabled():
+    """MXTPU_ROOFLINE=1 and telemetry on (decided once; off = one
+    cached-bool check at the registrar hook)."""
+    global _decided
+    if _decided is None:
+        from . import enabled as tele_enabled
+        on = tele_enabled()
+        if on:
+            from ..config import flags
+            try:
+                on = bool(flags.get('MXTPU_ROOFLINE'))
+            except Exception:  # noqa: BLE001 — stripped builds
+                on = False
+        _decided = on
+    return _decided
+
+
+# ---------------------------------------------------------------------------
+# HLO text -> per-layer cost parse
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    'pred': 1, 's2': 1, 'u2': 1, 's4': 1, 'u4': 1, 's8': 1, 'u8': 1,
+    'f8e5m2': 1, 'f8e4m3': 1, 'f8e4m3fn': 1, 'f8e4m3b11fnuz': 1,
+    'f8e5m2fnuz': 1, 'f8e4m3fnuz': 1,
+    's16': 2, 'u16': 2, 'f16': 2, 'bf16': 2,
+    's32': 4, 'u32': 4, 'f32': 4,
+    's64': 8, 'u64': 8, 'f64': 8, 'c64': 8, 'c128': 16,
+}
+
+_INSTR_RE = re.compile(
+    r'^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\([^)]*\)|\S+)\s+([\w\-]+)\(')
+_SHAPE_RE = re.compile(r'\b([a-z]+[0-9]+[a-z0-9]*|pred)\[([0-9,]*)\]')
+_OP_NAME_RE = re.compile(r'op_name="([^"]*)"')
+_CONTRACT_RE = re.compile(r'lhs_contracting_dims=\{([0-9,]*)\}')
+_DIM_LABELS_RE = re.compile(r'dim_labels=([\w?]+)_([\w?]+)->([\w?]+)')
+
+# scope segments that are tracing machinery, not layer names. jit()
+# segments are FUNCTION boundaries (jit(main), jit(relu)) — dropped
+# whole; AD/transform wrappers carry the layer name INSIDE
+# (jvp(fc1), transpose(jvp(fc1))) — peeled until the bare name appears
+_JIT_RE = re.compile(r'^(jit|pjit)\(')
+_XFORM_RE = re.compile(
+    r'^(jvp|vjp|transpose|vmap|pmap|xmap|shard_map|remat|'
+    r'checkpoint|custom_jvp|custom_vjp|named)\((.*)\)$')
+_WRAP_WORDS = frozenset(('while', 'body', 'cond', 'branch', 'scan',
+                         'closed_call', 'core_call'))
+
+# opcodes that are pure data movement / bookkeeping: no FLOPs, and no
+# bytes either (a reshape/bitcast costs nothing at run time; counting
+# its shapes would double every real operand)
+_FREE_OPS = frozenset((
+    'parameter', 'constant', 'tuple', 'get-tuple-element', 'bitcast',
+    'reshape', 'transpose', 'broadcast', 'iota', 'copy', 'copy-start',
+    'copy-done', 'after-all', 'partition-id', 'replica-id', 'domain',
+    'opt-barrier', 'custom-call', 'rng-get-and-update-state',
+    'get-dimension-size',
+))
+
+# wrapper instructions whose cost lives in a separately-parsed called
+# computation: contribute nothing here (their bodies' instructions are
+# parsed on their own lines), but their NAMES are what device-trace
+# events carry, so they are indexed for the trace join
+_CALL_OPS = frozenset(('fusion', 'while', 'call', 'conditional',
+                       'async-start', 'async-done'))
+
+
+def _unwrap_seg(seg):
+    """One scope segment -> the layer name it carries, or None.
+    ``transpose(jvp(fc1))`` -> ``fc1``; ``jit(relu)`` -> None (a
+    function boundary, not a layer); ``while``/``body`` -> None."""
+    while True:
+        if _JIT_RE.match(seg):
+            return None
+        m = _XFORM_RE.match(seg)
+        if not m:
+            break
+        seg = m.group(2)
+    if not seg or seg in _WRAP_WORDS:
+        return None
+    return seg
+
+
+def _layer_from_op_name(op_name):
+    """The ``jax.named_scope`` layer in an HLO ``op_name`` path, or
+    None. ``jit(f)/jit(main)/fc1/dot_general`` -> ``fc1`` and
+    ``jit(f)/while/body/transpose(jvp(fc1))/dot_general`` -> ``fc1``:
+    function/loop wrappers are dropped, transform wrappers are peeled,
+    the last remaining segment is the primitive, the first before it
+    is the layer the framework planted."""
+    segs = []
+    for s in str(op_name).split('/'):
+        u = _unwrap_seg(s)
+        if u is not None:
+            segs.append(u)
+    if len(segs) >= 2:
+        return segs[0]
+    return None
+
+
+def _shape_bytes(dtype, dims):
+    n = 1
+    for d in dims.split(','):
+        if d:
+            n *= int(d)
+    return n * _DTYPE_BYTES.get(dtype, 4), n
+
+
+def _instr_flops(opcode, line, out_elems, operands):
+    """Estimated FLOPs for one instruction. Exact-ish for the terms
+    that matter (dot: 2*out*K from the contracting dims; convolution:
+    2*out*kernel/out_features from dim_labels); one-flop-per-output for
+    the elementwise/reduce rest; zero for data movement."""
+    if opcode == 'dot':
+        k = 1
+        m = _CONTRACT_RE.search(line)
+        if m and operands:
+            lhs_dims = operands[0][1]
+            for idx in m.group(1).split(','):
+                if idx and int(idx) < len(lhs_dims):
+                    k *= lhs_dims[int(idx)]
+        return 2.0 * out_elems * k
+    if opcode == 'convolution':
+        if len(operands) >= 2:
+            kern = operands[1][1]
+            kern_elems = 1
+            for d in kern:
+                kern_elems *= d
+            out_feat = 1
+            m = _DIM_LABELS_RE.search(line)
+            if m:
+                o_idx = m.group(2).find('o')
+                if 0 <= o_idx < len(kern):
+                    out_feat = kern[o_idx]
+            elif kern:
+                out_feat = kern[0]
+            return 2.0 * out_elems * kern_elems / max(1, out_feat)
+        return 0.0
+    if opcode in ('reduce', 'reduce-window'):
+        # one op per INPUT element, not per output
+        if operands:
+            n = 1
+            for d in operands[0][1]:
+                n *= d
+            return float(n)
+        return float(out_elems)
+    if opcode in _FREE_OPS:
+        return 0.0
+    return float(out_elems)
+
+
+def hlo_layer_costs(hlo_text):
+    """Parse an HLO module's text into the per-layer cost store::
+
+        {'layers':      {layer: {'flops': f, 'bytes': b}},
+         'instr_layer': {instruction_name: layer},
+         'comm_instrs': set(instruction names of collective ops),
+         'comm_bytes':  total bytes written by collectives (per step),
+         'comm_ops':    {opcode: bytes},
+         'flops_total': parsed-FLOPs sum, 'bytes_total': parsed-bytes sum}
+
+    Best-effort by construction: unparsed lines cost nothing, ops
+    without a named scope pool under ``_unattributed``. A scan/while
+    body is parsed once — the same per-step convention XLA's own
+    cost_analysis uses."""
+    layers = {}
+    instr_layer = {}
+    comm_instrs = set()
+    comm_ops = {}
+    comm_bytes = 0.0
+    flops_total = bytes_total = 0.0
+    for line in hlo_text.splitlines():
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, out_sig, opcode = m.groups()
+        out_bytes = out_elems = 0
+        for dt, dims in _SHAPE_RE.findall(out_sig):
+            b, n = _shape_bytes(dt, dims)
+            out_bytes += b
+            out_elems += n
+        rest = line[m.end():]
+        # operand shapes live between the opcode '(' and the attrs; the
+        # attr tail (window/dim_labels/metadata) carries no shapes, so
+        # scanning the rest of the line is safe
+        operands = []
+        for dt, dims in _SHAPE_RE.findall(rest):
+            b, _n = _shape_bytes(dt, dims)
+            dims_t = tuple(int(d) for d in dims.split(',') if d)
+            operands.append((b, dims_t))
+        is_comm = any(opcode.startswith(c) for c in COMM_OPS)
+        if is_comm:
+            comm_instrs.add(name)
+            if not opcode.endswith('-done'):
+                comm_bytes += out_bytes
+                comm_ops[opcode] = comm_ops.get(opcode, 0.0) + out_bytes
+            continue
+        if opcode in _FREE_OPS:
+            continue
+        mo = _OP_NAME_RE.search(line)
+        layer_hint = _layer_from_op_name(mo.group(1)) if mo else None
+        if opcode in _CALL_OPS:
+            # zero cost (the called computation's lines carry it), but
+            # the name->layer index is what the trace join keys on —
+            # device events are fusion-granular
+            if layer_hint is not None:
+                instr_layer[name] = layer_hint
+            continue
+        flops = _instr_flops(opcode, line, out_elems, operands)
+        nbytes = float(out_bytes + sum(b for b, _d in operands))
+        layer = layer_hint or '_unattributed'
+        rec = layers.setdefault(layer, {'flops': 0.0, 'bytes': 0.0})
+        rec['flops'] += flops
+        rec['bytes'] += nbytes
+        instr_layer[name] = layer
+        flops_total += flops
+        bytes_total += nbytes
+    return {'layers': layers, 'instr_layer': instr_layer,
+            'comm_instrs': comm_instrs, 'comm_bytes': comm_bytes,
+            'comm_ops': comm_ops, 'flops_total': flops_total,
+            'bytes_total': bytes_total}
+
+
+# ---------------------------------------------------------------------------
+# registrar hook (telemetry.programs.note_program calls this)
+# ---------------------------------------------------------------------------
+
+def note_hlo(name, hlo_text, analysis=None, step_flops=False):
+    """Ingest one program's HLO text (tests feed synthetic modules
+    here; live compiles arrive via :func:`note_compiled`). ``analysis``
+    is the registrar's cost/memory dict — its ``flops`` /
+    ``bytes_accessed`` calibrate the parsed per-layer split."""
+    if not enabled():
+        return
+    costs = hlo_layer_costs(hlo_text)
+    costs['analysis'] = dict(analysis or {})
+    costs['step'] = bool(step_flops)
+    costs['name'] = name
+    with _lock:
+        prev = _programs.get(name)
+        if prev is not None and \
+                prev['flops_total'] > costs['flops_total']:
+            # keep the largest variant per name — the registrar's own
+            # merge rule (a tail-batch recompile must not shrink the
+            # roofline the run is judged by)
+            return
+        _programs[name] = costs
+
+
+def note_compiled(name, compiled, analysis=None, step_flops=False):
+    """The live hook: render ``compiled.as_text()`` and ingest it.
+    Never raises — attribution is best-effort, execution is not."""
+    if not enabled():
+        return
+    try:
+        note_hlo(name, compiled.as_text(), analysis=analysis,
+                 step_flops=step_flops)
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('roofline: HLO ingest of %s failed: %s', name, e)
+
+
+def _pick_step_program():
+    """The program the roofline diagnoses: the step-marked one with the
+    most FLOPs (the registrar's MFU-feed rule), else the largest
+    program seen at all."""
+    with _lock:
+        progs = list(_programs.values())
+    if not progs:
+        return None
+    step = [p for p in progs if p['step']]
+    pool = step or progs
+    return max(pool, key=lambda p: (p.get('analysis', {}).get('flops')
+                                    or p['flops_total']))
+
+
+# ---------------------------------------------------------------------------
+# profiler trace -> measured per-layer timings
+# ---------------------------------------------------------------------------
+
+def load_trace_events(path):
+    """Chrome-trace events from a ``jax.profiler`` capture. ``path`` is
+    the capture directory (``plugins/profile/<run>/*.trace.json.gz`` is
+    searched recursively) or a ``.trace.json``/``.json.gz`` file.
+    Returns the raw event dicts (empty list when nothing parses)."""
+    files = []
+    if os.path.isdir(path):
+        for root, _dirs, names in os.walk(path):
+            for n in sorted(names):
+                if n.endswith(('.trace.json', '.trace.json.gz')) or \
+                        n in ('trace.json', 'trace.json.gz'):
+                    files.append(os.path.join(root, n))
+    elif os.path.isfile(path):
+        files = [path]
+    events = []
+    for f in files:
+        opener = gzip.open if f.endswith('.gz') else open
+        try:
+            with opener(f, 'rt') as fh:
+                data = json.load(fh)
+        except Exception as e:  # noqa: BLE001 — a bad capture is skipped
+            logging.debug('roofline: cannot parse trace %s: %s', f, e)
+            continue
+        evs = data.get('traceEvents', data) if isinstance(data, dict) \
+            else data
+        if isinstance(evs, list):
+            events.extend(e for e in evs if isinstance(e, dict))
+    return events
+
+
+def _union(ivals):
+    """Merge (start, end) intervals; returns the disjoint sorted list."""
+    out = []
+    for s, e in sorted(ivals):
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _intersection_us(a, b):
+    """Total overlap between two disjoint sorted interval lists."""
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        s = max(a[i][0], b[j][0])
+        e = min(a[i][1], b[j][1])
+        if e > s:
+            total += e - s
+        if a[i][1] < b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
+def _join_trace(prog, events):
+    """Key trace events back to layers through the HLO instruction
+    names (fall back to op_name scope extraction from the event args).
+    Returns None when nothing matched — the caller then models instead
+    of pretending to have measured."""
+    per_layer_us = {}
+    per_instr_count = {}
+    comm_us = 0.0
+    comm_ivals, compute_ivals = [], []
+    instr_layer = prog['instr_layer']
+    comm_instrs = prog['comm_instrs']
+    for ev in events:
+        if ev.get('ph') != 'X':
+            continue
+        try:
+            dur = float(ev.get('dur') or 0.0)
+            ts = float(ev.get('ts') or 0.0)
+        except (TypeError, ValueError):
+            continue
+        if dur <= 0:
+            continue
+        nm = str(ev.get('name', '')).lstrip('%')
+        args = ev.get('args') or {}
+        layer = instr_layer.get(nm)
+        is_comm = nm in comm_instrs or \
+            any(nm.startswith(c) for c in COMM_OPS)
+        if layer is None and not is_comm:
+            for key in ('name', 'long_name', 'tf_op', 'op_name'):
+                v = args.get(key)
+                if not v:
+                    continue
+                cand = str(v).lstrip('%').split(' ', 1)[0]
+                layer = instr_layer.get(cand) \
+                    or _layer_from_op_name(str(v))
+                if layer is not None:
+                    break
+        if is_comm:
+            comm_us += dur
+            comm_ivals.append((ts, ts + dur))
+        elif layer is not None:
+            per_layer_us[layer] = per_layer_us.get(layer, 0.0) + dur
+            per_instr_count[nm] = per_instr_count.get(nm, 0) + 1
+            compute_ivals.append((ts, ts + dur))
+    if not per_layer_us and not comm_us:
+        return None
+    # the capture usually spans several steps: every instruction fires
+    # once per dispatch, so the modal per-instruction event count IS
+    # the number of steps captured
+    counts = sorted(per_instr_count.values())
+    steps = counts[len(counts) // 2] if counts else 1
+    overlap_us = _intersection_us(_union(comm_ivals),
+                                  _union(compute_ivals))
+    return {'per_layer_us': per_layer_us, 'comm_us': comm_us,
+            'overlap_us': overlap_us, 'steps': max(1, steps)}
+
+
+def _default_trace_path():
+    from ..config import flags
+    try:
+        p = flags.get('MXTPU_ROOFLINE_TRACE')
+    except Exception:  # noqa: BLE001
+        p = ''
+    if p:
+        return os.path.expanduser(p)
+    try:
+        d = flags.get('MXTPU_XPROF_DIR')
+    except Exception:  # noqa: BLE001
+        d = ''
+    d = os.path.expanduser(d or 'xprof_trace')
+    return d if os.path.isdir(d) else None
+
+
+# ---------------------------------------------------------------------------
+# the join: classification + communication accounting
+# ---------------------------------------------------------------------------
+
+def _registry_step_ms(reg):
+    """Best per-step milliseconds from the registry (the modeled path's
+    denominator): fused window dispatch p50 / W, else the per-batch
+    dispatch p50, else the bench dispatch p50 normalized by bench's
+    steps-per-dispatch (one bench.dispatch span covers STEPS_PER_CALL
+    steps — fit.steps counts them per dispatch)."""
+    h = reg.get('fused_fit.dispatch')
+    if h is not None and h.count:
+        p50 = h.percentile(50)
+        w = reg.get('fused_fit.steps_per_call')
+        if p50 and w is not None and w.value:
+            return float(p50) / float(w.value)
+    h = reg.get('fit.dispatch')
+    if h is not None and h.count:
+        p50 = h.percentile(50)
+        if p50:
+            return float(p50)
+    h = reg.get('bench.dispatch')
+    if h is not None and h.count:
+        p50 = h.percentile(50)
+        if p50:
+            steps_c = reg.get('fit.steps')
+            if steps_c is not None and steps_c.value:
+                per_dispatch = float(steps_c.value) / h.count
+                if per_dispatch >= 1.0:
+                    return float(p50) / per_dispatch
+            return float(p50)
+    return None
+
+
+def _classify(flops, nbytes, time_ms, peaks, measured):
+    """(class, roof_ms, roof_pct) for one layer against the peaks."""
+    if peaks['flops'] <= 0 or peaks['hbm_bytes_s'] <= 0:
+        return CLASS_UNKNOWN, None, None
+    if flops <= 0 and nbytes <= 0:
+        return CLASS_OVERHEAD, 0.0, 0.0
+    ft = flops / peaks['flops']
+    bt = nbytes / peaks['hbm_bytes_s']
+    roof_ms = max(ft, bt) * 1e3
+    cls = CLASS_COMPUTE if ft >= bt else CLASS_MEMORY
+    roof_pct = None
+    if time_ms and time_ms > 0:
+        roof_pct = min(100.0, 100.0 * roof_ms / time_ms)
+        if measured and roof_pct < OVERHEAD_UTIL_PCT:
+            # far below BOTH ceilings: the time went to something the
+            # roofline cannot see (launch gaps, transposes, small-op
+            # scheduling) — overhead, not math
+            cls = CLASS_OVERHEAD
+    return cls, roof_ms, roof_pct
+
+
+def analyze(step_time_ms=None, events=None, trace_path=None,
+            device=None, warn_unknown=True):
+    """Compute the roofline analysis dict (no publication — see
+    :func:`summarize`). Returns None when roofline is off or no
+    program has been ingested.
+
+    ``step_time_ms`` overrides the registry-derived per-step time;
+    ``events`` injects pre-parsed trace events (tests), else
+    ``trace_path`` / the MXTPU_ROOFLINE_TRACE / MXTPU_XPROF_DIR capture
+    is loaded when one exists. ``warn_unknown=False`` makes the call
+    truly read-only (the unknown-device peak lookup neither warns nor
+    writes the ``roofline.peaks_unknown`` gauge — the scrape path)."""
+    if not enabled():
+        return None
+    prog = _pick_step_program()
+    if prog is None:
+        return None
+    from . import xla
+    peaks = xla.device_peaks(device, warn=warn_unknown)
+    if events is None:
+        path = trace_path or _default_trace_path()
+        events = load_trace_events(path) if path else []
+    joined = _join_trace(prog, events) if events else None
+    measured = joined is not None and bool(joined['per_layer_us'])
+
+    analysis = prog.get('analysis') or {}
+    # calibrate the parsed split against XLA's own whole-program totals
+    # so per-layer numbers sum to what cost_analysis reported
+    scale_f = scale_b = 1.0
+    if analysis.get('flops') and prog['flops_total'] > 0:
+        scale_f = float(analysis['flops']) / prog['flops_total']
+    if analysis.get('bytes_accessed') and prog['bytes_total'] > 0:
+        scale_b = float(analysis['bytes_accessed']) / prog['bytes_total']
+
+    reg = _tele().registry
+    if step_time_ms is None:
+        step_time_ms = _registry_step_ms(reg)
+
+    trace_steps = joined['steps'] if joined else None
+    rows = []
+    roof_total_ms = 0.0
+    layer_items = sorted(prog['layers'].items())
+    for layer, c in layer_items:
+        flops = c['flops'] * scale_f
+        nbytes = c['bytes'] * scale_b
+        if peaks['flops'] > 0 and peaks['hbm_bytes_s'] > 0:
+            roof_total_ms += max(flops / peaks['flops'],
+                                 nbytes / peaks['hbm_bytes_s']) * 1e3
+        rows.append([layer, flops, nbytes])
+
+    if measured:
+        source = 'measured'
+        layer_ms = {l: joined['per_layer_us'][l] / joined['steps'] / 1e3
+                    for l in joined['per_layer_us']}
+    else:
+        source = 'modeled'
+        # distribute the measured step time across layers in proportion
+        # to each one's roofline-minimum time (perfect execution would
+        # land exactly there); with no step time either, assume the
+        # roofline itself
+        layer_ms = {}
+        for layer, flops, nbytes in rows:
+            if peaks['flops'] > 0 and peaks['hbm_bytes_s'] > 0:
+                roof = max(flops / peaks['flops'],
+                           nbytes / peaks['hbm_bytes_s']) * 1e3
+            else:
+                roof = 0.0
+            if step_time_ms and roof_total_ms > 0:
+                layer_ms[layer] = step_time_ms * roof / roof_total_ms
+            else:
+                layer_ms[layer] = roof
+
+    out_rows = []
+    for layer, flops, nbytes in rows:
+        t_ms = layer_ms.get(layer, 0.0)
+        cls, roof_ms, roof_pct = _classify(flops, nbytes, t_ms, peaks,
+                                           measured)
+        row = {'layer': layer, 'class': cls,
+               'flops': round(flops, 1), 'bytes': round(nbytes, 1),
+               'time_ms': round(t_ms, 4),
+               'ai': round(flops / nbytes, 3) if nbytes > 0 else None,
+               'achieved_flops_s': round(flops / (t_ms / 1e3), 1)
+               if t_ms > 0 else None,
+               'achieved_bytes_s': round(nbytes / (t_ms / 1e3), 1)
+               if t_ms > 0 else None,
+               'roof_pct': round(roof_pct, 1)
+               if roof_pct is not None else None,
+               'headroom_ms': round(max(0.0, t_ms - roof_ms), 4)
+               if roof_ms is not None else None}
+        out_rows.append(row)
+    out_rows.sort(key=lambda r: (-(r['headroom_ms'] or 0.0),
+                                 -r['time_ms'], r['layer']))
+
+    # communication accounting (bytes are per step by the scan-body
+    # convention; time measured from the capture, else modeled at the
+    # HBM ceiling — a deliberate lower bound, labeled as such)
+    comm_bytes = prog['comm_bytes']
+    comm = None
+    if comm_bytes > 0 or (joined and joined['comm_us'] > 0):
+        if joined and joined['comm_us'] > 0:
+            comm_ms = joined['comm_us'] / joined['steps'] / 1e3
+            overlap_pct = round(100.0 * joined['overlap_us']
+                                / joined['comm_us'], 1)
+            comm_src = 'measured'
+        else:
+            comm_ms = (comm_bytes / peaks['hbm_bytes_s'] * 1e3) \
+                if peaks['hbm_bytes_s'] > 0 else None
+            overlap_pct = None
+            comm_src = 'modeled'
+        comm = {'bytes': round(comm_bytes, 1),
+                'time_ms': round(comm_ms, 4)
+                if comm_ms is not None else None,
+                'overlap_pct': overlap_pct,
+                'pct_of_step': round(100.0 * comm_ms / step_time_ms, 1)
+                if comm_ms and step_time_ms else None,
+                'ops': {k: round(v, 1)
+                        for k, v in sorted(prog['comm_ops'].items())},
+                'source': comm_src}
+
+    return {
+        'program': prog['name'],
+        'source': source,
+        'device': peaks['kind'],
+        'peaks': peaks['source'],
+        'peak_tflops': round(peaks['flops'] / 1e12, 3)
+        if peaks['flops'] else None,
+        'peak_hbm_gbs': round(peaks['hbm_bytes_s'] / 1e9, 3)
+        if peaks['hbm_bytes_s'] else None,
+        'step_time_ms': round(step_time_ms, 4)
+        if step_time_ms is not None else None,
+        'trace_steps': trace_steps,
+        'layers': out_rows,
+        'comm': comm,
+    }
+
+
+def comm_pct_of_step():
+    """The collective share of the step (%), or None — the
+    per-collective number the cluster straggler classifier grounds its
+    communication_bound verdict in. Uses the last published analysis
+    when one carries comm numbers; otherwise a live sync round computes
+    the MODELED share directly from the program's collective bytes and
+    the HBM ceiling — the same arithmetic as analyze()'s modeled comm
+    path, without rebuilding the per-layer analysis every sync round
+    (the common no-collective program exits on the bytes check)."""
+    with _lock:
+        last = _last
+    if last is not None and last.get('comm'):
+        return last['comm'].get('pct_of_step')
+    if not enabled():
+        return None
+    prog = _pick_step_program()
+    if prog is None or prog['comm_bytes'] <= 0:
+        return None
+    from . import xla
+    peaks = xla.device_peaks()
+    if peaks['hbm_bytes_s'] <= 0:
+        return None
+    step_ms = _registry_step_ms(_tele().registry)
+    if not step_ms:
+        return None
+    comm_ms = prog['comm_bytes'] / peaks['hbm_bytes_s'] * 1e3
+    return round(100.0 * comm_ms / step_ms, 1)
+
+
+def summarize(step_time_ms=None):
+    """Run :func:`analyze`, publish ``roofline.*`` gauges + the
+    ``roofline`` JSONL record, and return the analysis dict (None when
+    off/empty). Called from telemetry.write_summary.
+
+    A measured ``step_time_ms`` (bench feeds its wall-clock mean) is
+    remembered: a later summarize() with none — the atexit
+    write_summary after a bench run — reuses it instead of falling
+    back to the registry-derived time, so the run's roofline records
+    never disagree about the step-time denominator."""
+    global _last, _explicit_step_ms
+    if step_time_ms is not None:
+        _explicit_step_ms = step_time_ms
+    elif _explicit_step_ms is not None:
+        step_time_ms = _explicit_step_ms
+    d = analyze(step_time_ms=step_time_ms)
+    if d is None:
+        return None
+    st = _tele()
+    reg = st.registry
+    reg.gauge('roofline.layers').set(len(d['layers']))
+    if d['layers']:
+        worst = d['layers'][0]
+        reg.gauge('roofline.worst_layer').set(worst['layer'])
+        reg.gauge('roofline.worst_class').set(worst['class'])
+        if worst['roof_pct'] is not None:
+            reg.gauge('roofline.worst_roof_pct').set(worst['roof_pct'])
+        if worst['headroom_ms'] is not None:
+            reg.gauge('roofline.worst_headroom_ms').set(
+                worst['headroom_ms'])
+    comm = d.get('comm')
+    if comm:
+        reg.gauge('roofline.comm_bytes').set(comm['bytes'])
+        if comm['time_ms'] is not None:
+            reg.gauge('roofline.comm_time_ms').set(comm['time_ms'])
+        if comm['overlap_pct'] is not None:
+            reg.gauge('roofline.comm_overlap_pct').set(
+                comm['overlap_pct'])
+        if comm['pct_of_step'] is not None:
+            reg.gauge('roofline.comm_pct_of_step').set(
+                comm['pct_of_step'])
+    if st.sink is not None:
+        rec = {'type': 'roofline'}
+        rec.update(d)
+        st.sink.emit(rec)
+    with _lock:
+        _last = d
+    return d
+
+
+def snapshot_roofline():
+    """The last published analysis dict (the /summary payload's and
+    read-only summary()'s input), or None."""
+    with _lock:
+        return _last
+
+
+def _reset_for_tests():
+    global _decided, _last, _explicit_step_ms
+    with _lock:
+        _programs.clear()
+        _last = None
+    _decided = None
+    _explicit_step_ms = None
+    from . import xla
+    xla._reset_peaks_warned_for_tests()

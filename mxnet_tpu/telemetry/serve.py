@@ -12,8 +12,8 @@ production run serving heavy traffic needs metrics that can be scraped
   once one is (telemetry/health.py's incident state), with the
   incident/anomaly digest as the JSON body — a probe's view of PR 4;
 - ``/summary`` — the ``export.summary_table`` inputs (registry
-  snapshot, programs, health, cluster) plus the rendered table, as
-  JSON — what ``tools/telemetry_watch.py`` polls.
+  snapshot, programs, health, cluster, roofline) plus the rendered
+  table, as JSON — what ``tools/telemetry_watch.py`` polls.
 
 Transport is stdlib ``http.server`` (ThreadingHTTPServer) on a daemon
 thread — no new dependencies, dies with the process. Gating:
@@ -157,7 +157,7 @@ def summary_payload():
     renders from, read-only (no gauges written, no records emitted),
     plus the rendered table itself."""
     import time
-    from . import programs, health, cluster
+    from . import programs, health, cluster, roofline
     from .export import summary_table
     st = _tele()
     snap = st.registry.snapshot()
@@ -165,6 +165,14 @@ def summary_payload():
     progs = programs.snapshot_programs() or None
     hs = health.snapshot_health(input_bound=health.input_bound_pct())
     clus = cluster.snapshot_cluster()
+    # roofline (MXTPU_ROOFLINE): the last published analysis, else a
+    # fresh read-only one (warn_unknown=False: analyze writes no
+    # gauges — not even peaks_unknown — and emits no records; the
+    # scrape convention holds). events=[] forces the MODELED path: a
+    # scrape must never re-load and re-parse a multi-MB profiler
+    # capture from disk
+    roof = roofline.snapshot_roofline() \
+        or roofline.analyze(events=[], warn_unknown=False)
     return {
         'elapsed_s': round(elapsed, 3) if elapsed is not None else None,
         'host': cluster.host_index(),
@@ -172,8 +180,9 @@ def summary_payload():
         'programs': progs,
         'health': hs,
         'cluster': clus,
+        'roofline': roof,
         'table': summary_table(snap, elapsed, programs=progs, health=hs,
-                               cluster=clus),
+                               cluster=clus, roofline=roof),
     }
 
 

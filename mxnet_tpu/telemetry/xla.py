@@ -33,7 +33,7 @@ import threading
 import time
 
 __all__ = ['install', 'note_retrace', 'note_step_flops', 'sample_memory',
-           'device_peak_flops', 'mfu_estimate']
+           'device_peak_flops', 'device_peaks', 'mfu_estimate']
 
 _COMPILE_EVENT_SUFFIX = 'backend_compile_duration'
 # persistent-compilation-cache events (MXTPU_COMPILE_CACHE): a hit
@@ -41,12 +41,21 @@ _COMPILE_EVENT_SUFFIX = 'backend_compile_duration'
 _CACHE_HIT_EVENT = '/jax/compilation_cache/cache_hits'
 _CACHE_SAVED_SUFFIX = 'compile_time_saved_sec'
 
-# Peak dense bf16 FLOP/s per chip, by device_kind substring (bench.py's
-# table; CPU/unknown kinds yield 0.0 = "no MFU estimate").
-_PEAK_FLOPS = [
-    ('v6', 918e12), ('v5p', 459e12), ('v5', 197e12),
-    ('v4', 275e12), ('v3', 123e12), ('v2', 45e12),
+# Per-chip hardware ceilings, by device_kind substring (order matters:
+# 'v5p' must match before 'v5'). Columns: peak dense bf16 FLOP/s and
+# peak HBM bytes/s — the two roofline denominators (telemetry/roofline
+# classifies each layer by which ceiling bounds it). The MFU estimate
+# uses only the FLOP/s column.
+_PEAK_TABLE = [
+    ('v6', 918e12, 1640e9), ('v5p', 459e12, 2765e9), ('v5', 197e12, 819e9),
+    ('v4', 275e12, 1228e9), ('v3', 123e12, 900e9), ('v2', 45e12, 700e9),
 ]
+# CPU fallback: NOMINAL host ceilings (order-of-magnitude: one modern
+# core's FMA throughput and stream bandwidth) so a CPU run still gets a
+# best-effort roofline classification. Marked nominal — the MFU
+# estimate ignores nominal peaks (a "29% MFU" against a guessed CPU
+# peak would be noise presented as signal).
+_NOMINAL_CPU_PEAKS = (1e11, 5e10)
 
 _installed = False
 _install_lock = threading.Lock()
@@ -196,19 +205,104 @@ def sample_memory(device=None):
     return None
 
 
-def device_peak_flops(device=None):
-    """(peak_bf16_flops, device_kind) for the MFU denominator."""
+_peaks_unknown_warned = False
+
+
+def _peak_overrides():
+    """(flops, hbm_bytes_s) from MXTPU_PEAK_TFLOPS / MXTPU_PEAK_HBM_GBS
+    (human units: TFLOP/s, GB/s); 0.0 = no override."""
+    from ..config import flags
+    try:
+        f = float(flags.get('MXTPU_PEAK_TFLOPS')) * 1e12
+        b = float(flags.get('MXTPU_PEAK_HBM_GBS')) * 1e9
+        return f, b
+    except Exception:  # noqa: BLE001 — undeclared in stripped builds
+        return 0.0, 0.0
+
+
+def _warn_peaks_unknown(kind):
+    """An unknown device kind must not SILENTLY lose MFU and the
+    roofline: warn once per process and publish roofline.peaks_unknown
+    so the gap is visible in /metrics and the summary."""
+    global _peaks_unknown_warned
+    st = _state()
+    if st.active:
+        st.registry.gauge('roofline.peaks_unknown').set(1)
+    if _peaks_unknown_warned:
+        logging.debug('telemetry: no peak table entry for device kind %r',
+                      kind)
+        return
+    _peaks_unknown_warned = True
+    logging.warning(
+        'telemetry: device kind %r has no peak table entry — the MFU '
+        'estimate and the roofline achieved-vs-peak placement are '
+        'skipped for this run (roofline.peaks_unknown=1). Set '
+        'MXTPU_PEAK_TFLOPS / MXTPU_PEAK_HBM_GBS to this chip\'s peak '
+        'dense bf16 TFLOP/s and HBM GB/s to restore them.', kind)
+
+
+def device_peaks(device=None, warn=True):
+    """The roofline denominators for ``device`` (default: devices()[0])
+    as a dict: ``flops`` (peak dense bf16 FLOP/s), ``hbm_bytes_s``
+    (peak HBM bytes/s), ``kind``, and per-component
+    ``flops_source``/``hbm_source`` — 'table' (a known chip),
+    'override' (MXTPU_PEAK_TFLOPS/MXTPU_PEAK_HBM_GBS), 'nominal' (the
+    best-effort CPU guess), or 'unknown' (no entry: zero, warned once,
+    ``roofline.peaks_unknown`` published). ``source`` is the combined
+    label ('a+b' when the components disagree). ``warn=False``
+    suppresses the unknown-kind warn + gauge write — the read-only
+    scrape path's contract (a /summary request must not write the
+    registry)."""
     try:
         if device is None:
             import jax
             device = jax.devices()[0]
         kind = (getattr(device, 'device_kind', '') or '').lower()
-        for sub, peak in _PEAK_FLOPS:
-            if sub in kind:
-                return peak, kind
-        return 0.0, kind
     except Exception:  # noqa: BLE001
-        return 0.0, ''
+        kind = ''
+    flops = hbm = 0.0
+    flops_src = hbm_src = 'unknown'
+    for sub, f, b in _PEAK_TABLE:
+        if sub in kind:
+            flops, hbm = f, b
+            flops_src = hbm_src = 'table'
+            break
+    if flops_src == 'unknown' and (not kind or 'cpu' in kind):
+        flops, hbm = _NOMINAL_CPU_PEAKS
+        flops_src = hbm_src = 'nominal'
+    # Overrides replace only the component they set — a lone
+    # MXTPU_PEAK_HBM_GBS must not promote a nominal/unknown FLOP/s
+    # value to trusted-for-MFU status (device_peak_flops keys on the
+    # FLOP/s component's source alone).
+    ov_f, ov_b = _peak_overrides()
+    if ov_f:
+        flops, flops_src = ov_f, 'override'
+    if ov_b:
+        hbm, hbm_src = ov_b, 'override'
+    if warn and 'unknown' in (flops_src, hbm_src):
+        _warn_peaks_unknown(kind)
+    source = (flops_src if flops_src == hbm_src
+              else flops_src + '+' + hbm_src)
+    return {'flops': flops, 'hbm_bytes_s': hbm, 'kind': kind,
+            'source': source, 'flops_source': flops_src,
+            'hbm_source': hbm_src}
+
+
+def device_peak_flops(device=None):
+    """(peak_bf16_flops, device_kind) for the MFU denominator. Nominal
+    (guessed-CPU) peaks report 0.0 here — MFU against a guessed peak
+    would be noise — while the roofline keeps them via
+    :func:`device_peaks`. Unknown kinds also report 0.0, after the
+    warn-once + ``roofline.peaks_unknown`` publication."""
+    p = device_peaks(device)
+    if p['flops_source'] in ('table', 'override'):
+        return p['flops'], p['kind']
+    return 0.0, p['kind']
+
+
+def _reset_peaks_warned_for_tests():
+    global _peaks_unknown_warned
+    _peaks_unknown_warned = False
 
 
 def mfu_estimate():

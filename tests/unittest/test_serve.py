@@ -303,6 +303,44 @@ def test_cluster_straggler_classification(tele_live):
     assert 'straggler         compute_bound (slowest host 1)' in table
 
 
+def test_cluster_straggler_communication_bound(tele_live):
+    """A slow host that is NOT input-starved but spends >30% of its
+    step in collectives (the roofline's comm_pct sync slot) classifies
+    communication_bound — the verdict grounded in per-collective
+    numbers, not inference. A 4-column matrix (no roofline slot) keeps
+    the old two-way classification."""
+    telemetry.enabled()
+    mat = np.array([[10.0, 2.0, 8.0, 1 << 20, 40.0],
+                    [20.0, 3.0, 18.0, 2 << 20, 45.0]], np.float32)
+    snap = cluster._publish(mat, steps=128)
+    assert snap['slowest_host'] == 1
+    assert snap['straggler'] == 'communication_bound'
+    assert snap['per_host'][1]['comm_pct'] == 45.0
+    g = telemetry.snapshot()['gauges']
+    assert g['cluster.h1.comm_pct'] == 45.0
+    assert g['cluster.straggler_class'] == 'communication_bound'
+    # io-wait still wins: an input-starved host reads input_bound even
+    # with a high comm share (it is waiting on the host, not the wire)
+    mat[1, 1] = 55.0
+    assert cluster._publish(mat, steps=256)['straggler'] == 'input_bound'
+    # no comm slot (pre-roofline sender / crafted 4-col matrix): the
+    # comm_pct row entry is omitted and the comm verdict is unreachable
+    mat4 = np.array([[10.0, 2.0, 8.0, 1 << 20],
+                     [20.0, 3.0, 18.0, 2 << 20]], np.float32)
+    snap4 = cluster._publish(mat4, steps=384)
+    assert snap4['straggler'] == 'compute_bound'
+    assert snap4['per_host'][1]['comm_pct'] is None
+
+
+def test_summary_payload_carries_roofline(tele_live):
+    """/summary exposes the roofline analysis key (None while the flag
+    is off — the payload shape is stable either way)."""
+    telemetry.enabled()
+    payload = serve.summary_payload()
+    assert 'roofline' in payload
+    assert payload['roofline'] is None     # MXTPU_ROOFLINE unset here
+
+
 # ---------------------------------------------------------------------------
 # the no-op contract extends to serve/cluster
 # ---------------------------------------------------------------------------

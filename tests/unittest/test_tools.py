@@ -186,6 +186,31 @@ def test_telemetry_report_multi_host(tmp_path, capsys):
     assert 'telemetry summary' in out
 
 
+def test_telemetry_report_multi_host_communication_bound(tmp_path,
+                                                         capsys):
+    """The offline classifier sees the same roofline comm share the
+    live sync vector carried: a slow host that is not input-starved
+    but spends >30%% of its step in collectives reads
+    communication_bound offline too."""
+    import json
+    import telemetry_report
+    p0 = _host_jsonl(tmp_path, 0, step_ms=10.0, io_ms=0.2)
+    p1 = _host_jsonl(tmp_path, 1, step_ms=20.0, io_ms=0.4)
+    roof = {'type': 'roofline', 't': 60.0, 'host': 1, 'program': 'p',
+            'source': 'measured', 'device': 'tpu v5 lite',
+            'peaks': 'table', 'peak_tflops': 197.0,
+            'peak_hbm_gbs': 819.0, 'step_time_ms': 20.0,
+            'layers': [],
+            'comm': {'bytes': 1e6, 'time_ms': 9.0, 'overlap_pct': 10.0,
+                     'pct_of_step': 45.0, 'ops': {}, 'source':
+                     'measured'}}
+    with open(p1, 'a') as f:
+        f.write(json.dumps(roof) + '\n')
+    assert telemetry_report.main([p0, p1]) == 0
+    out = capsys.readouterr().out
+    assert 'host 1 straggles — communication_bound' in out
+
+
 def test_telemetry_watch_render():
     """The watch CLI's frame renderer (pure function): throughput, MFU,
     health and per-host spread all land in the frame."""
@@ -233,6 +258,161 @@ def test_telemetry_watch_fetch_jsonl(tmp_path):
     assert summary['elapsed_s'] == 5.0
     lines = telemetry_watch.render(summary)
     assert any('throughput' in ln for ln in lines)
+
+
+def _bench_rec(**kw):
+    rec = {'metric': 'resnet50_train_throughput_bf16', 'value': 2561.42,
+           'unit': 'images/sec', 'batch': 32, 'device': 'TPU v5 lite',
+           'platform': 'tpu', 'steps_per_call': 32, 'mfu': 0.2908,
+           'xla_temp_bytes': 1412014080,
+           'compile_cache': {'cold_s': 26.3, 'warm_s': 5.4}}
+    rec.update(kw)
+    return rec
+
+
+def test_bench_diff_ok_and_regression(tmp_path, capsys):
+    """tools/bench_diff compares two BENCH artifacts: within tolerance
+    exits 0; a throughput/MFU drop or a temp-bytes rise past tolerance
+    prints REGRESSION and exits 1 — the post-bench gate."""
+    import json
+    import bench_diff
+    a = tmp_path / 'a.json'
+    b = tmp_path / 'b.json'
+    a.write_text(json.dumps(_bench_rec()))
+    # 1% slide: inside the 5% default tolerance
+    b.write_text(json.dumps(_bench_rec(value=2536.44, mfu=0.288)))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert 'ok' in out and 'REGRESSION' not in out
+    # 10% throughput drop + temp-bytes growth: both named, exit 1
+    b.write_text(json.dumps(_bench_rec(value=2300.0,
+                                       xla_temp_bytes=1700000000)))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    out = capsys.readouterr().out
+    assert 'REGRESSION: throughput, xla_temp_bytes' in out
+    # the same slide passes with a loosened per-metric tolerance
+    assert bench_diff.main([str(a), str(b), '--tol', 'throughput=15',
+                            '--tol', 'xla_temp_bytes=25']) == 0
+    capsys.readouterr()
+    # improvements never fail, whatever the tolerance
+    b.write_text(json.dumps(_bench_rec(value=9999.0, mfu=0.9,
+                                       xla_temp_bytes=1)))
+    assert bench_diff.main([str(a), str(b), '--tol-pct', '0.1']) == 0
+    capsys.readouterr()
+
+
+def test_bench_diff_formats_and_comparability(tmp_path, capsys):
+    """Accepts the harness wrapper ({'parsed': ...}) AND raw bench
+    stdout (JSON lines, last line authoritative); a CPU-fallback round
+    is 'not config-comparable' — reported, exit 0 (3 under --strict),
+    never a fake regression verdict."""
+    import json
+    import bench_diff
+    wrapped = tmp_path / 'wrapped.json'
+    wrapped.write_text(json.dumps({'n': 5, 'rc': 0,
+                                   'parsed': _bench_rec()}))
+    lines = tmp_path / 'lines.json'
+    lines.write_text('not json\n'
+                     + json.dumps({'metric': 'other'}) + '\n'
+                     + json.dumps(_bench_rec(value=2600.0)) + '\n')
+    assert bench_diff.main([str(wrapped), str(lines)]) == 0
+    capsys.readouterr()
+    cpu = tmp_path / 'cpu.json'
+    cpu.write_text(json.dumps(_bench_rec(
+        value=12.0, platform='cpu(fallback)', batch=8, steps_per_call=1)))
+    assert bench_diff.main([str(wrapped), str(cpu)]) == 0
+    assert 'not config-comparable' in capsys.readouterr().out
+    assert bench_diff.main([str(wrapped), str(cpu), '--strict']) == 3
+    capsys.readouterr()
+
+
+def test_every_report_and_diff_cli_smokes(tmp_path):
+    """CI floor: every tools/*_report.py and tools/*_diff.py answers
+    --help (argparse wiring + imports) — a new CLI cannot land without
+    at least this."""
+    import glob
+    import subprocess
+    patterns = [os.path.join(REPO, 'tools', '*_report.py'),
+                os.path.join(REPO, 'tools', '*_diff.py')]
+    clis = sorted(p for pat in patterns for p in glob.glob(pat))
+    assert clis, 'no report/diff CLIs found'
+    names = {os.path.basename(p) for p in clis}
+    assert {'telemetry_report.py', 'roofline_report.py',
+            'bench_diff.py'} <= names
+    for cli in clis:
+        out = subprocess.run([sys.executable, cli, '--help'],
+                             capture_output=True, text=True, timeout=120)
+        assert out.returncode == 0, (cli, out.stderr)
+        assert 'usage' in out.stdout.lower(), cli
+
+
+def test_roofline_report_golden(tmp_path, capsys):
+    """tools/roofline_report renders a fixed roofline JSONL record
+    byte-for-byte through the live renderer (the offline twin; the
+    live-vs-CLI identity is pinned end-to-end in test_roofline.py)."""
+    import json
+    import roofline_report
+    roof = {'program': 'bench.train_step', 'source': 'measured',
+            'device': 'tpu v5 lite', 'peaks': 'table',
+            'peak_tflops': 197.0, 'peak_hbm_gbs': 819.0,
+            'step_time_ms': 12.5, 'trace_steps': 10,
+            'layers': [
+                {'layer': 'stage1_unit1_conv1', 'class': 'memory-bound',
+                 'flops': 1e9, 'bytes': 5e8, 'time_ms': 3.0, 'ai': 2.0,
+                 'achieved_flops_s': 3.3e11, 'achieved_bytes_s': 1.6e11,
+                 'roof_pct': 20.3, 'headroom_ms': 2.39}],
+            'comm': {'bytes': 1048576.0, 'time_ms': 0.84,
+                     'overlap_pct': 40.0, 'pct_of_step': 6.7,
+                     'ops': {'all-reduce': 1048576.0},
+                     'source': 'measured'}}
+    path = tmp_path / 'roof.jsonl'
+    with open(path, 'w') as f:
+        f.write(json.dumps({'type': 'start', 'pid': 1, 't': 1.0}) + '\n')
+        f.write(json.dumps(dict(roof, type='roofline', t=2.0)) + '\n')
+    assert roofline_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    golden = (
+        '-- roofline: bench.train_step (measured) --\n'
+        '  device            tpu v5 lite (table peaks: 197.000 TFLOP/s,'
+        ' 819.000 GB/s)\n'
+        '  step_time_ms      12.500\n'
+        '  layer               class             roof%    time_ms'
+        '  headroom_ms\n'
+        '  stage1_unit1_conv1  memory-bound     20.300      3.000'
+        '        2.390\n'
+        '  comm              1.0 MiB/step, 0.840 ms = 6.700% of step,'
+        ' overlap 40.000% (measured; all-reduce 1.0 MiB)\n')
+    assert out == golden
+
+
+def test_telemetry_report_renders_roofline_block(tmp_path, capsys):
+    """A summary record's 'roofline' key lands in telemetry_report's
+    table, same renderer as the live one."""
+    import json
+    import telemetry_report
+    rec = {'type': 'summary', 't': 20.0, 'elapsed_s': 2.0,
+           'snapshot': {'counters': {'fit.steps': 8}, 'gauges': {},
+                        'histograms': {}},
+           'roofline': {'program': 'p', 'source': 'modeled',
+                        'device': 'cpu', 'peaks': 'nominal',
+                        'peak_tflops': 0.1, 'peak_hbm_gbs': 50.0,
+                        'step_time_ms': 5.0,
+                        'layers': [{'layer': 'fc1',
+                                    'class': 'compute-bound',
+                                    'flops': 1.0, 'bytes': 1.0,
+                                    'time_ms': 5.0, 'ai': 1.0,
+                                    'achieved_flops_s': 1.0,
+                                    'achieved_bytes_s': 1.0,
+                                    'roof_pct': 1.0,
+                                    'headroom_ms': 4.9}],
+                        'comm': None}}
+    path = tmp_path / 'roof_sum.jsonl'
+    with open(path, 'w') as f:
+        f.write(json.dumps(rec) + '\n')
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert '-- roofline: p (modeled) --' in out
+    assert 'fc1' in out and 'compute-bound' in out
 
 
 def test_bandwidth_collectives_tiny():

@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""Compare two bench artifacts (BENCH_r*.json) and gate on regression.
+
+The bench history is the repo's perf ledger; nothing so far CHECKED it
+— a throughput or MFU slide between rounds only surfaced when a human
+re-read the numbers. This is the post-bench gate ("Benchmarking as a
+gate", docs/perf.md)::
+
+    python tools/bench_diff.py BENCH_r05.json BENCH_r06.json
+
+Accepts either the harness wrapper format (the ``parsed`` key holds
+the authoritative metric dict) or raw bench stdout (JSON lines — the
+LAST parseable line is authoritative, bench.py's own convention).
+
+Compared metrics, with direction and default tolerance:
+
+- ``throughput`` (the headline ``value``)  — lower is a regression (5%)
+- ``mfu``                                  — lower is a regression (5%)
+- ``xla_temp_bytes``                       — higher is a regression (5%)
+- ``compile_s`` (cold compile)             — higher is a regression (25%,
+  compile time is the noisiest of the four)
+
+A delta past tolerance in the bad direction prints REGRESSION and the
+exit code is 1 — wire it straight into CI after a bench round.
+Improvements never fail. Runs that are not config-comparable (metric
+name, platform, batch or steps_per_call differ — e.g. one round banked
+the CPU fallback) are reported and exit 0, because a fallback round is
+not evidence of a perf regression; ``--strict`` turns that into exit 3.
+"""
+import argparse
+import json
+import sys
+
+# metric -> (extractor, bad_direction, default_tol_pct)
+# bad_direction: -1 = a DROP is a regression, +1 = a RISE is one
+_DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 5.0,
+            'compile_s': 25.0}
+_DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
+              'compile_s': +1}
+
+
+def load_bench(path):
+    """The authoritative metric dict out of one bench artifact."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        data = json.loads(text)
+        if isinstance(data, dict):
+            # harness wrapper: {'n':…, 'rc':…, 'parsed': {...}} — or a
+            # bare metric dict already. A failed round has parsed=None;
+            # its banked JSON line may still be in the log tail
+            if 'parsed' in data:
+                if isinstance(data['parsed'], dict):
+                    return data['parsed']
+                for line in reversed(str(data.get('tail') or '')
+                                     .strip().splitlines()):
+                    try:
+                        d = json.loads(line)
+                        if isinstance(d, dict) and 'metric' in d:
+                            return d
+                    except ValueError:
+                        continue
+                raise SystemExit(
+                    'bench_diff: %s is a failed bench round (no parsed '
+                    'metric dict, none recoverable from its log tail)'
+                    % path)
+            return data
+    except ValueError:
+        pass
+    # raw bench stdout: JSON lines, last parseable METRIC line wins —
+    # a trailing auxiliary JSON object must not silently replace the
+    # bench record and defuse the gate as 'not comparable'
+    for line in reversed(text.strip().splitlines()):
+        try:
+            d = json.loads(line)
+            if isinstance(d, dict) and 'metric' in d:
+                return d
+        except ValueError:
+            continue
+    raise SystemExit('bench_diff: %s holds no parseable bench JSON'
+                     % path)
+
+
+def _compile_s(rec):
+    cc = rec.get('compile_cache') or {}
+    for k in ('cold_s', 'compile_s'):
+        if cc.get(k) is not None:
+            return float(cc[k])
+    return None
+
+
+def extract(rec):
+    """{metric: value} for the compared metrics (absent ones omitted)."""
+    out = {}
+    if rec.get('value') is not None:
+        out['throughput'] = float(rec['value'])
+    if rec.get('mfu') is not None:
+        out['mfu'] = float(rec['mfu'])
+    if rec.get('xla_temp_bytes'):
+        out['xla_temp_bytes'] = float(rec['xla_temp_bytes'])
+    c = _compile_s(rec)
+    if c is not None:
+        out['compile_s'] = c
+    return out
+
+
+def comparability(a, b):
+    """Reasons the two runs are not config-comparable ([] = they are).
+    A CPU-fallback round (r02/r04 in the bench history) must not read
+    as a 'regression' against a device round."""
+    reasons = []
+    for key in ('metric', 'platform', 'batch', 'steps_per_call'):
+        va, vb = a.get(key), b.get(key)
+        if va != vb:
+            reasons.append('%s: %r vs %r' % (key, va, vb))
+    return reasons
+
+
+def diff(old, new, tols):
+    """Rows [(metric, old, new, delta_pct, tol_pct, verdict)] — verdict
+    'REGRESSION' when past tolerance in the bad direction."""
+    mo, mn = extract(old), extract(new)
+    rows = []
+    for metric in ('throughput', 'mfu', 'xla_temp_bytes', 'compile_s'):
+        vo, vn = mo.get(metric), mn.get(metric)
+        if vo is None or vn is None:
+            if vo is not None or vn is not None:
+                rows.append((metric, vo, vn, None, tols[metric],
+                             'skipped (missing on one side)'))
+            continue
+        delta = (vn - vo) / vo * 100.0 if vo else 0.0
+        bad = delta * _DIRECTION[metric] > tols[metric]
+        rows.append((metric, vo, vn, delta, tols[metric],
+                     'REGRESSION' if bad else 'ok'))
+    return rows
+
+
+def _fmt_v(v):
+    if v is None:
+        return '-'
+    if abs(v) >= 1e6:
+        return '%.3e' % v
+    return ('%.4f' % v).rstrip('0').rstrip('.')
+
+
+def render(rows, old_path, new_path):
+    lines = ['bench diff: %s -> %s' % (old_path, new_path),
+             '  %-15s %14s %14s %9s %7s  %s'
+             % ('metric', 'old', 'new', 'delta%', 'tol%', 'verdict')]
+    for metric, vo, vn, delta, tol, verdict in rows:
+        lines.append('  %-15s %14s %14s %9s %7s  %s'
+                     % (metric, _fmt_v(vo), _fmt_v(vn),
+                        '-' if delta is None else '%+.1f' % delta,
+                        '%.1f' % tol, verdict))
+    return '\n'.join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Compare two BENCH_r*.json artifacts (throughput, '
+                    'MFU, XLA temp bytes, cold compile time) with '
+                    'per-metric tolerance; non-zero exit on regression '
+                    '— the post-bench CI gate (docs/perf.md).')
+    ap.add_argument('old', help='baseline bench artifact')
+    ap.add_argument('new', help='candidate bench artifact')
+    ap.add_argument('--tol-pct', type=float, default=None,
+                    help='one tolerance (%%) for every metric '
+                         '(default: per-metric — throughput/mfu/temp '
+                         '5%%, compile 25%%)')
+    ap.add_argument('--tol', action='append', default=[],
+                    metavar='METRIC=PCT',
+                    help='per-metric tolerance override, e.g. '
+                         '--tol mfu=2 (repeatable)')
+    ap.add_argument('--strict', action='store_true',
+                    help='exit 3 when the runs are not '
+                         'config-comparable instead of 0')
+    args = ap.parse_args(argv)
+    tols = dict(_DEF_TOL)
+    if args.tol_pct is not None:
+        tols = {k: args.tol_pct for k in tols}
+    for spec in args.tol:
+        name, _, pct = spec.partition('=')
+        if name not in tols or not pct:
+            ap.error('unknown --tol %r (metrics: %s)'
+                     % (spec, ', '.join(sorted(tols))))
+        tols[name] = float(pct)
+    old, new = load_bench(args.old), load_bench(args.new)
+    reasons = comparability(old, new)
+    if reasons:
+        print('bench_diff: runs are not config-comparable — %s'
+              % '; '.join(reasons))
+        print('(a CPU-fallback or re-configured round; no regression '
+              'verdict is claimable)')
+        return 3 if args.strict else 0
+    rows = diff(old, new, tols)
+    print(render(rows, args.old, args.new))
+    bad = [r for r in rows if r[5] == 'REGRESSION']
+    if bad:
+        print('REGRESSION: %s' % ', '.join(r[0] for r in bad))
+        return 1
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

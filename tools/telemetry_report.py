@@ -147,15 +147,22 @@ def _reconstruct(records):
 
 
 def _summary_parts(records):
-    """(snapshot, elapsed, programs, health, cluster, reconstructed)
-    for one host's record list — the last summary record when present,
-    else the crashed-run reconstruction."""
+    """(snapshot, elapsed, programs, health, cluster, roofline,
+    reconstructed) for one host's record list — the last summary record
+    when present, else the crashed-run reconstruction."""
     summaries = [r for r in records if r.get('type') == 'summary']
     clus_recs = [r for r in records if r.get('type') == 'cluster']
     cluster = clus_recs[-1] if clus_recs else None
     if cluster is not None:
         cluster = {k: v for k, v in cluster.items()
                    if k not in ('type', 't', 'host')}
+    # the roofline analysis survives a crash as its own record; a clean
+    # run also folds it into the summary record (preferred below)
+    roof_recs = [r for r in records if r.get('type') == 'roofline']
+    roofline = roof_recs[-1] if roof_recs else None
+    if roofline is not None:
+        roofline = {k: v for k, v in roofline.items()
+                    if k not in ('type', 't', 'host')}
     if summaries:
         s = summaries[-1]
         health = s.get('health')
@@ -172,17 +179,19 @@ def _summary_parts(records):
                                      restarts)
         return (s.get('snapshot') or {}, s.get('elapsed_s'),
                 s.get('programs'), health,
-                s.get('cluster') or cluster, False)
+                s.get('cluster') or cluster,
+                s.get('roofline') or roofline, False)
     snapshot, elapsed, programs, health = _reconstruct(records)
-    return snapshot, elapsed, programs, health, cluster, True
+    return snapshot, elapsed, programs, health, cluster, roofline, True
 
 
 def render(records):
     """The summary table for a parsed record list, as a string."""
-    snapshot, elapsed, programs, health, cluster, reco = \
+    snapshot, elapsed, programs, health, cluster, roofline, reco = \
         _summary_parts(records)
     table = summary_table(snapshot, elapsed, programs=programs,
-                          health=health, cluster=cluster)
+                          health=health, cluster=cluster,
+                          roofline=roofline)
     if reco:
         table += ('\n(no summary record found — reconstructed from '
                   '%d individual records; registry-only counters and '
@@ -268,7 +277,7 @@ def render_hosts(by_host):
     from mxnet_tpu.telemetry.cluster import classify, _SPREAD_BALANCED_PCT
     rows = []
     for host in sorted(by_host):
-        snapshot, elapsed, programs, health, cluster, reco = \
+        snapshot, elapsed, programs, health, cluster, roof, reco = \
             _summary_parts(by_host[host])
         steps = snapshot.get('counters', {}).get('fit.steps')
         if steps is None:
@@ -279,6 +288,12 @@ def render_hosts(by_host):
         rows.append({'host': host, 'steps': steps,
                      'step_ms': _step_ms(snapshot),
                      'io_wait_pct': _io_share(snapshot),
+                     # this host's roofline collective share — the
+                     # offline classifier must see the same number the
+                     # live sync vector carried, or the two verdicts
+                     # diverge on communication_bound hosts
+                     'comm_pct': ((roof or {}).get('comm') or {})
+                     .get('pct_of_step'),
                      'nonfinite': int((health or {})
                                       .get('nonfinite_steps') or 0),
                      'records': by_host[host]})
@@ -299,7 +314,8 @@ def render_hosts(by_host):
         mark = '*' if (r['host'] == slowest and len(rows) > 1) else ''
         # no io-wait data = no classification; a confident
         # 'compute_bound' with a '-' io column would be fabricated
-        cls = '-' if r['io_wait_pct'] is None else classify(r['io_wait_pct'])
+        cls = '-' if r['io_wait_pct'] is None \
+            else classify(r['io_wait_pct'], comm_pct=r['comm_pct'])
         lines.append('  %-6s  %-6s  %-8s  %-8s  %-9s  %s'
                      % ('%s%s' % (r['host'], mark),
                         '-' if r['steps'] is None else r['steps'],
@@ -315,7 +331,8 @@ def render_hosts(by_host):
             slow_row = next(r for r in rows if r['host'] == slowest)
             cls = 'unclassified (no io-wait data)' \
                 if slow_row['io_wait_pct'] is None \
-                else classify(slow_row['io_wait_pct'])
+                else classify(slow_row['io_wait_pct'],
+                              comm_pct=slow_row['comm_pct'])
             verdict = ('host %s straggles — %s (step-time spread %.1f%%)'
                        % (slowest, cls, spread))
         lines.append('  straggler: %s' % verdict)
