@@ -226,7 +226,8 @@ flags.declare('MXTPU_FAULT_INJECT', str, '',
               'Deterministic fault injection (mxnet_tpu/faults.py): '
               "'<kind>:<step>[:<arg>]' with kind one of nan-grad, "
               'checkpoint-corrupt, dispatch-exception, '
-              'backend-probe-timeout, slow-host — fires one real fault '
+              'backend-probe-timeout, slow-host, hang, host-loss — '
+              'fires one real fault '
               'at a deterministic training step so every recovery path '
               '(health raise, restore-from-last-good, restart backoff, '
               'bench reprobe) is exercised by real tests, not mocks. '
@@ -255,6 +256,63 @@ flags.declare('MXTPU_HEALTH_WINDOW', int, 64,
               'Trailing-window length (observations) backing the health '
               "anomaly detectors' rolling median/MAD baseline",
               min_value=4)
+flags.declare('MXTPU_WATCHDOG_SECS', float, 0.0,
+              'Hang watchdog (telemetry/watchdog.py): once the training '
+              'loop has made its first progress mark, a daemon thread '
+              'checks that marks (per-batch/per-window dispatch, eval '
+              'windows, cluster sync rounds, kvstore push/pull, '
+              'checkpoint commits) keep arriving at least this often. '
+              'On a stall it dumps all-thread stacks + the last '
+              'telemetry state as a hang JSONL incident, flips /healthz '
+              'to 503 with a hung digest, and applies '
+              'MXTPU_WATCHDOG_ACTION. Set it above the worst legitimate '
+              'gap (an XLA recompile can take 20-40s). 0 (default) = '
+              'off: no thread is ever created', min_value=0.0)
+flags.declare('MXTPU_WATCHDOG_ACTION', str, 'warn',
+              "What the hang watchdog does on a stall: 'warn' records "
+              "the incident and keeps waiting (clears when progress "
+              "resumes), 'abort' additionally exits the process with "
+              'the distinct code 85 so tools/train_supervisor.py '
+              'relaunches from the last-good checkpoint',
+              choices={'warn', 'abort'})
+flags.declare('MXTPU_SUPERVISOR_LIVENESS', float, 0.0,
+              'Supervisor-side liveness tier (tools/train_supervisor.py, '
+              'read from the environment — the supervisor never imports '
+              'the framework): if the child process appends no new '
+              'bytes to its MXTPU_TELEMETRY_PATH JSONL for this many '
+              'seconds, the supervisor SIGTERMs (then SIGKILLs) and '
+              'relaunches it against the same restart budget — the '
+              'tier for a child too wedged to run its own in-process '
+              'watchdog. Needs the child run with MXTPU_TELEMETRY=1; '
+              'set it well above MXTPU_WATCHDOG_SECS so the in-process '
+              'watchdog acts first. 0 (default) = off', min_value=0.0)
+flags.declare('MXTPU_ELASTIC_INPUT', bool, False,
+              'Straggler-aware input re-balancing (telemetry/cluster.py, '
+              'requires MXTPU_TELEMETRY=1 and '
+              'MXTPU_TELEMETRY_SYNC_EVERY>0): when a cluster sync round '
+              'classifies the slowest host as input-bound, every host '
+              'deterministically computes the same shifted shard '
+              'assignment from the same gathered round and applies it '
+              'at the next epoch boundary via the iterator '
+              'shard_info()/set_shard() protocol (ImageRecordIter, '
+              'MNISTIter). Off (default) = the fit loops never touch '
+              'the hook')
+flags.declare('MXTPU_KVSTORE_TIMEOUT', float, 0.0,
+              'Bound (seconds) on each kvstore_dist push/pull server '
+              'reply. A shard request that exceeds it counts as a '
+              'transient connection error and enters the '
+              'MXTPU_KVSTORE_RETRIES reconnect-and-retry path instead '
+              'of hanging into the watchdog. 0 (default) = unbounded '
+              '(the pre-retry behavior)', min_value=0.0)
+flags.declare('MXTPU_KVSTORE_RETRIES', int, 2,
+              'How many times a kvstore_dist push/pull shard request is '
+              'retried after a transient connection error (socket '
+              'error, or an MXTPU_KVSTORE_TIMEOUT expiry): each retry '
+              'reconnects to the server and backs off exponentially '
+              '(0.05s * 2^k, capped at 2s). Past the budget the error '
+              're-raises as ConnectionError — retryable by '
+              'resilient_fit/the supervisor. 0 = a single attempt',
+              min_value=0)
 flags.declare('MXTPU_XPROF', str, '',
               "One-shot step-windowed device-trace capture: 'start:stop' "
               "(training-step counts) arms jax.profiler to start once "

@@ -29,6 +29,17 @@ is exercised by real failures instead of mocks. Kinds:
 - ``slow-host:<k>[:<ms>]`` — sleep ``ms`` (default 50) per training
   step from step k on, persistently: this host becomes the straggler
   the cluster telemetry names. Never disarms.
+- ``hang:<k>[:<secs>]`` — wedge the first dispatch seam that reaches
+  step k by sleeping ``secs`` (default 3600) in place: the shape of a
+  collective waiting on a dead peer or a tunneled dispatch that never
+  returns. The hang watchdog (telemetry/watchdog.py) is what should
+  notice; with MXTPU_WATCHDOG_ACTION=abort the process dies with the
+  distinct exit code and the supervisor relaunches. Fires once.
+- ``host-loss:<k>`` — ``os._exit`` (exit code 113) from the first
+  dispatch seam that reaches step k: the process vanishes mid-window
+  with no unwind, no atexit, no final checkpoint — exactly what losing
+  a host looks like to the supervisor. Fires once (per process; a
+  relaunch re-arms unless the driver disarms the env).
 
 Off (the default, flag empty) every seam is one cached-bool check —
 the same zero-overhead contract the telemetry stack keeps. Nothing
@@ -43,14 +54,16 @@ import time
 
 import numpy as np
 
-__all__ = ['FaultInjected', 'enabled', 'spec', 'note_steps',
-           'maybe_poison_snap', 'maybe_poison_batch', 'maybe_raise',
-           'maybe_corrupt_checkpoint']
+__all__ = ['FaultInjected', 'HOST_LOSS_EXIT_CODE', 'enabled', 'spec',
+           'note_steps', 'maybe_poison_snap', 'maybe_poison_batch',
+           'maybe_raise', 'maybe_corrupt_checkpoint']
 
 KINDS = ('nan-grad', 'checkpoint-corrupt', 'dispatch-exception',
-         'backend-probe-timeout', 'slow-host')
+         'backend-probe-timeout', 'slow-host', 'hang', 'host-loss')
 
 _SLOW_DEFAULT_MS = 50.0
+_HANG_DEFAULT_SECS = 3600.0
+HOST_LOSS_EXIT_CODE = 113   # distinct from the watchdog's 85
 
 
 class FaultInjected(RuntimeError):
@@ -240,20 +253,41 @@ def maybe_poison_batch(batch):
 
 
 def maybe_raise(seam, upcoming=1):
-    """Dispatch seam: raise :class:`FaultInjected` when an armed
-    ``dispatch-exception`` fault's step falls inside the ``upcoming``
-    steps this dispatch is about to advance (the fused window passes
-    its window size). ``arg`` (when set) restricts the firing seam."""
+    """Dispatch seam: fire an armed ``dispatch-exception`` (raise
+    :class:`FaultInjected`), ``hang`` (sleep in place — the wedged-
+    collective shape the watchdog must catch) or ``host-loss``
+    (``os._exit``, no unwind) fault when its step falls inside the
+    ``upcoming`` steps this dispatch is about to advance (the fused
+    window passes its window size). For ``dispatch-exception``,
+    ``arg`` (when set) restricts the firing seam."""
     if not enabled():
         return
     with _state.lock:
-        if (_state.kind != 'dispatch-exception' or _state.fired
+        kind = _state.kind
+        if (kind not in ('dispatch-exception', 'hang', 'host-loss')
+                or _state.fired
                 or _state.steps + upcoming <= _state.step):
             return
-        if _state.arg and _state.arg != seam:
+        if kind == 'dispatch-exception' and _state.arg \
+                and _state.arg != seam:
             return
         _state.fired = True
         step = _state.step
+        arg = _state.arg
+    if kind == 'hang':
+        try:
+            secs = float(arg) if arg else _HANG_DEFAULT_SECS
+        except ValueError:
+            secs = _HANG_DEFAULT_SECS
+        logging.warning('fault injection: hang fired at the %s seam '
+                        '(step %d) — sleeping %.1fs', seam, step, secs)
+        time.sleep(secs)
+        return
+    if kind == 'host-loss':
+        logging.warning('fault injection: host-loss fired at the %s seam '
+                        '(step %d) — os._exit(%d)', seam, step,
+                        HOST_LOSS_EXIT_CODE)
+        os._exit(HOST_LOSS_EXIT_CODE)
     raise FaultInjected(
         'injected dispatch failure at the %s seam (step %d)'
         % (seam, step), seam=seam, step=step)

@@ -27,7 +27,38 @@ from ..base import MXNetError
 
 __all__ = ['DataDesc', 'DataBatch', 'DataIter', 'NDArrayIter', 'CSVIter',
            'MNISTIter', 'ResizeIter', 'PrefetchingIter', 'ImageRecordIter',
-           'ImageDetRecordIter', 'LibSVMIter', 'MXDataIter']
+           'ImageDetRecordIter', 'LibSVMIter', 'MXDataIter', 'auto_shard']
+
+
+def auto_shard():
+    """``{'num_parts': P, 'part_index': i}`` derived from the LIVE
+    process set — construct data iterators with ``**mx.io.auto_shard()``
+    and an elastic job keeps every example covered exactly once however
+    many hosts survive: a supervisor relaunch onto fewer hosts
+    re-derives the shard ranges from the smaller set instead of leaving
+    the dead host's shard orphaned (module/checkpointing.py remaps the
+    resumed iterator cursor to match). Prefers the launcher env
+    (MXTPU_NUM_HOSTS / MXTPU_HOST_ID — tools/launch.py exports both);
+    falls back to jax's process set when the env is silent but
+    jax.distributed is up."""
+    n, i = 1, 0
+    try:
+        from ..config import flags
+        flags.reload('MXTPU_NUM_HOSTS')
+        flags.reload('MXTPU_HOST_ID')
+        n = int(flags.get('MXTPU_NUM_HOSTS'))
+        i = int(flags.get('MXTPU_HOST_ID'))
+    except Exception:  # noqa: BLE001 — stripped builds without the flags
+        pass
+    if n <= 1:
+        try:
+            import jax
+            n = int(jax.process_count())
+            i = int(jax.process_index())
+        except Exception:  # noqa: BLE001 — backend not up yet
+            pass
+    n = max(1, n)
+    return {'num_parts': n, 'part_index': i % n}
 
 
 class DataDesc(namedtuple('DataDesc', ['name', 'shape'])):
@@ -415,23 +446,54 @@ class MNISTIter(NDArrayIter):
         else:
             images, labels = synthetic_mnist(12000 if 'train' in image else 2000,
                                              seed=seed)
-        if num_parts > 1:
-            images = images[part_index::num_parts]
-            labels = labels[part_index::num_parts]
-        if flat:
+        # the full (pre-shard) set is kept ONLY for genuinely sharded
+        # construction, so an elastic re-balance (telemetry/cluster.py
+        # apply_shard_shift) can re-slice it: set_shard(j) rebuilds
+        # this iterator on shard j of num_parts. Unsharded iterators
+        # (num_parts=1 — elastic has nothing to rotate and disables
+        # itself) don't pay the extra retention
+        self._shard_full = (images, labels) if num_parts > 1 else None
+        self._shard_args = dict(batch_size=batch_size, shuffle=shuffle,
+                                flat=flat, seed=seed)
+        self._num_parts = int(num_parts)
+        self._part_index = int(part_index)
+        self._shard_init(images, labels)
+
+    def _shard_init(self, images, labels):
+        a = self._shard_args
+        if self._num_parts > 1:
+            images = images[self._part_index::self._num_parts]
+            labels = labels[self._part_index::self._num_parts]
+        if a['flat']:
             images = images.reshape(images.shape[0], -1)
         else:
             images = images.reshape(images.shape[0], 1, 28, 28)
-        if shuffle:
+        if a['shuffle']:
             # reference iter_mnist.cc shuffles ONCE at init with `seed`;
             # reset() rewinds to the SAME order. Scripts rely on this:
             # e.g. module/mnist_mlp.py aligns predict(merge_batches=False)
             # outputs against a second pass of the iterator by index.
-            perm = np.random.RandomState(seed).permutation(len(labels))
+            perm = np.random.RandomState(a['seed']).permutation(len(labels))
             images, labels = images[perm], labels[perm]
-        super().__init__(images, labels, batch_size=batch_size,
+        super().__init__(images, labels, batch_size=a['batch_size'],
                          shuffle=False, last_batch_handle='discard',
                          label_name='softmax_label')
+
+    def shard_info(self):
+        """(num_parts, part_index) — the elastic-input shard protocol."""
+        return self._num_parts, self._part_index
+
+    def set_shard(self, part_index):
+        """Re-slice this iterator onto shard ``part_index`` of the same
+        ``num_parts`` partition (elastic input re-balancing; the rebuilt
+        order is deterministic from the original seed). Takes effect
+        immediately — callers apply it at an epoch boundary. A no-op on
+        unsharded iterators (num_parts=1: there is only shard 0)."""
+        if self._shard_full is None:
+            return
+        self._part_index = int(part_index) % max(1, self._num_parts)
+        images, labels = self._shard_full
+        self._shard_init(images, labels)
 
 
 def synthetic_mnist(n, seed=0):
@@ -662,6 +724,16 @@ class ImageRecordIter(DataIter):
         self._stream.start_epoch()
         self._pending = None
         self._exhausted = False
+
+    def shard_info(self):
+        """(num_parts, part_index) — the elastic-input shard protocol
+        (telemetry/cluster.py apply_shard_shift)."""
+        return self._stream.num_parts, self._stream.part_index
+
+    def set_shard(self, part_index):
+        """Move this iterator onto another shard of the same partition;
+        applies at the next reset() (epoch boundary)."""
+        self._stream.set_shard(part_index)
 
     def next(self):
         if self._pending is not None:

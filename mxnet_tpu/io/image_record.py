@@ -191,6 +191,12 @@ class StreamingImageRecordIter:
         offsets = scan_record_offsets(path_imgrec)
         if not offsets:
             raise ValueError('empty record file %s' % path_imgrec)
+        # full offset list retained: set_shard (elastic input
+        # re-balancing, telemetry/cluster.py) re-slices it without a
+        # re-scan; the slice applies at the next start_epoch
+        self._all_offsets = offsets
+        self.num_parts = int(num_parts)
+        self.part_index = int(part_index)
         self._offsets = offsets[part_index::num_parts]
         logging.getLogger(__name__).debug(
             'ImageRecordIter: %d records (%d after sharding %d/%d)',
@@ -198,6 +204,13 @@ class StreamingImageRecordIter:
         self._producer = None
         self._stop = None
         self._q = None
+
+    def set_shard(self, part_index):
+        """Move this reader onto shard ``part_index`` of the same
+        ``num_parts`` partition. The live producer (if any) keeps its
+        epoch; the new slice applies at the next start_epoch."""
+        self.part_index = int(part_index) % max(1, self.num_parts)
+        self._offsets = self._all_offsets[self.part_index::self.num_parts]
 
     # -- epoch lifecycle ---------------------------------------------------
     def start_epoch(self):

@@ -14,6 +14,16 @@ connection gets a dedicated comm thread with a FIFO queue, so `push` returns
 immediately and `pull` rides the same queue (per-server ordering ≙ the
 engine's per-var ordering). `priority` is accepted for API compatibility.
 
+Transient-fault tier: with ``MXTPU_KVSTORE_TIMEOUT`` set, each pull
+shard reply is bounded and a socket error or expiry enters a
+reconnect-and-retry path (``MXTPU_KVSTORE_RETRIES`` attempts,
+exponential backoff) before surfacing as ConnectionError — the
+retryable family the resilient-training drivers restart on. Push stays
+fire-and-forget; a connection that dies with un-applied pushes in
+flight is NOT silently retried past (the server is missing a
+gradient): the next op raises ConnectionError so the restart drivers
+restore from the last-good checkpoint instead.
+
 Standalone mode: without the DMLC_* cluster env (no launcher), a scheduler
 and one server are spun up as in-process threads so `mx.kv.create
 ('dist_sync')` works as a 1-worker cluster — handy for tests and parity with
@@ -44,7 +54,16 @@ from .ndarray import NDArray
 from ._dist_proto import (send_msg, recv_msg, pack_array, unpack_array,
                           connect)
 
-__all__ = ['KVStoreDist']
+__all__ = ['KVStoreDist', 'LostPushError']
+
+
+class LostPushError(ConnectionError):
+    """A connection died with un-applied fire-and-forget push(es) in
+    flight: the server is missing a gradient, so the retry tier must
+    NOT silently reconnect past it. A dedicated subclass because
+    socket-level ConnectionResetError/ConnectionRefusedError are ALSO
+    ConnectionErrors and those are exactly the transients the retry
+    path exists for — only this one must escape it."""
 
 from .config import flags as _flags
 _BIGARRAY_BOUND = _flags.get('MXTPU_KVSTORE_BIGARRAY_BOUND')
@@ -59,8 +78,13 @@ class _Future:
         self.value = value
         self._ev.set()
 
-    def wait(self):
-        self._ev.wait()
+    def wait(self, timeout=None):
+        """Reply, or raise. ``timeout`` (MXTPU_KVSTORE_TIMEOUT) bounds
+        the wait: an expiry raises TimeoutError so the retry path can
+        reconnect instead of hanging into the watchdog."""
+        if not self._ev.wait(timeout):
+            raise TimeoutError(
+                'kvstore reply not received within %.1fs' % timeout)
         if isinstance(self.value, Exception):
             raise self.value
         return self.value
@@ -73,11 +97,25 @@ class _ServerConn:
         self.sock = connect(*addr)
         self._q = []
         self._err = None
+        # a fire-and-forget push that died with the socket (send/recv
+        # failed, or still queued when the conn was torn down) was
+        # never applied by the server: the retry tier must NOT silently
+        # reconnect past it — sync training would continue on weights
+        # missing one worker's gradient
+        self.lost_push = False
+        self._closed = False
         self._cv = threading.Condition()
         self._th = threading.Thread(target=self._loop, daemon=True)
         self._th.start()
 
     def submit(self, msg):
+        if self._closed:
+            # the comm thread exited at the close sentinel: a message
+            # queued now would never be processed and its future never
+            # set — under an unbounded wait that is a silent hang, the
+            # exact failure this tier exists to prevent. Fail fast so
+            # the retry path reconnects (or surfaces the error).
+            raise OSError('kvstore connection to this server is closed')
         if self._err is not None:
             raise RuntimeError('kvstore server error: %s' % self._err)
         fut = _Future()
@@ -94,6 +132,8 @@ class _ServerConn:
                 msg, fut = self._q.pop(0)
             if msg is None:
                 return
+            is_push = isinstance(msg, tuple) and msg \
+                and str(msg[0]).startswith('push')
             try:
                 send_msg(self.sock, msg)
                 reply = recv_msg(self.sock)
@@ -102,12 +142,26 @@ class _ServerConn:
                 if (isinstance(reply, tuple) and reply
                         and reply[0] == 'error'):
                     self._err = reply[1]
+                    if is_push:
+                        # the server REFUSED this gradient: as lost as
+                        # a dead socket — the reconnect gate must not
+                        # silently retry past it either
+                        self.lost_push = True
                 fut.set(reply)
             except OSError as e:
+                if is_push:
+                    self.lost_push = True
                 fut.set(e)
 
     def close(self):
         with self._cv:
+            # anything still queued will never be sent: queued pushes
+            # count as lost for the reconnect-retry gate
+            if any(isinstance(m, tuple) and m
+                   and str(m[0]).startswith('push')
+                   for m, _ in self._q):
+                self.lost_push = True
+            self._closed = True
             self._q.append((None, _Future()))
             self._cv.notify()
         try:
@@ -137,6 +191,7 @@ class KVStoreDist(KVStore):
         topo = recv_msg(self._sched)
         assert topo and topo[0] == 'topology', topo
         self._rank = topo[1]
+        self._server_addrs = list(topo[2])   # kept for reconnect-retry
         self._conns = [_ServerConn(a) for a in topo[2]]
         self._sync = '_async' not in kv_type
         self._key_meta = {}  # key -> (shape, dtype)
@@ -217,6 +272,99 @@ class KVStoreDist(KVStore):
         for c in self._conns:
             c.close()
 
+    # -- transient-error retry (timeout + reconnect + backoff) -----------
+    def _retry_cfg(self):
+        """(timeout_or_None, retries) from MXTPU_KVSTORE_TIMEOUT /
+        MXTPU_KVSTORE_RETRIES, read ONCE per store (the hot pull loop
+        calls this per key — no env parsing there, matching the
+        decide-once contract every other flag gate keeps). timeout 0 =
+        unbounded (the pre-retry behavior: a dead server hangs into
+        the watchdog instead)."""
+        cfg = getattr(self, '_retry_cfg_cached', None)
+        if cfg is None:
+            _flags.reload('MXTPU_KVSTORE_TIMEOUT')
+            _flags.reload('MXTPU_KVSTORE_RETRIES')
+            t = float(_flags.get('MXTPU_KVSTORE_TIMEOUT'))
+            cfg = self._retry_cfg_cached = (
+                (t if t > 0 else None),
+                int(_flags.get('MXTPU_KVSTORE_RETRIES')))
+        return cfg
+
+    def _reconnect(self, sid):
+        old = self._conns[sid]
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001 — the socket is already dead
+            pass
+        # the comm thread may be mid-failure on an in-flight push
+        # (blocked in recv when the socket died): close() above unblocks
+        # it, but its lost_push store lands ASYNCHRONOUSLY — join before
+        # reading the flag, or the race silently retries past a lost
+        # gradient. The thread exits via the close sentinel right after.
+        old._th.join(timeout=10)
+        # the fresh connection is installed EITHER way: the lost-push
+        # gate below fires once for the event, and the in-process
+        # restore-and-retry it triggers (resilient_fit restores from
+        # checkpoint and re-enters fit with the SAME store) must find a
+        # clean slot — a raise over the dead conn would poison every
+        # retry into the same error until the budget burned
+        self._conns[sid] = _ServerConn(self._server_addrs[sid])
+        if old.lost_push:
+            # a gradient push died with this connection and was never
+            # applied: silently retrying the PULL would hand back
+            # weights missing one worker's contribution. Surface it as
+            # the retryable family instead — resilient_fit/the
+            # supervisor restore from the last-good checkpoint, which
+            # is the only state known to include every push
+            raise LostPushError(
+                'kvstore server %d connection died with un-applied '
+                'push(es) in flight — state on the server may be '
+                'stale; restore from checkpoint instead of retrying'
+                % sid)
+
+    def _request(self, sid, msg):
+        """Submit ``msg`` to server ``sid`` and wait for the reply,
+        retrying transient connection errors (socket error, bounded-
+        timeout expiry) with an exponential-backoff reconnect. NOT
+        transient: a server-side 'error' reply to a push marks the
+        gradient lost (the reconnect gate raises
+        :class:`LostPushError`), an 'error' reply to THIS request comes
+        back as the reply tuple for the caller's assert to surface.
+        Past the retry budget the failure surfaces as ConnectionError —
+        the retryable family resilient_fit / the supervisor act on."""
+        timeout, retries = self._retry_cfg()
+        delay = 0.05
+        last = None
+        for attempt in range(retries + 1):
+            try:
+                if self._conns[sid]._err is not None:
+                    # poisoned by an earlier failure: a fresh socket or
+                    # nothing — submit() on it only re-raises the past
+                    self._reconnect(sid)
+                return self._conns[sid].submit(msg).wait(timeout)
+            except LostPushError:
+                raise           # never burned as a transient retry
+            except (OSError, TimeoutError) as e:
+                last = e
+                if attempt >= retries:
+                    break
+                import logging
+                logging.warning(
+                    'kvstore: server %d request failed (%s: %s) — '
+                    'reconnecting and retrying in %.2fs (%d/%d)',
+                    sid, type(e).__name__, e, delay, attempt + 1, retries)
+                time.sleep(delay)
+                delay = min(2.0, delay * 2.0)
+                try:
+                    self._reconnect(sid)
+                except LostPushError:
+                    raise
+                except OSError as re_err:
+                    last = re_err   # server still down: burn the retry
+        raise ConnectionError(
+            'kvstore server %d unreachable after %d attempt(s): %s'
+            % (sid, retries + 1, last)) from last
+
     # -- key sharding (EncodeKey, kvstore_dist.h:430-468) ----------------
     def _shards(self, key, shape, dtype):
         nbytes = int(np.prod(shape)) * np.dtype(dtype).itemsize
@@ -282,6 +430,7 @@ class KVStoreDist(KVStore):
                 if dt > 0:
                     _tele.gauge('kvstore.push_mb_s').set(
                         round(nbytes / 2.0**20 / dt, 2))
+            _tele.watchdog.note_progress('kvstore.push')
 
     def _push_row_sparse(self, k, vlist):
         """Row-sparse grads go whole to the key's home server (the
@@ -313,11 +462,25 @@ class KVStoreDist(KVStore):
                 shape, dtype = self._key_meta.get(
                     k, (olist[0].shape, olist[0].dtype))
                 shards = self._shards(k, shape, dtype)
-                futs = [(sl, self._conns[sid].submit(('pull', skey)))
-                        for sid, skey, sl in shards]
+                timeout, _ = self._retry_cfg()
+                # first attempt stays parallel across servers; a shard
+                # whose reply errors or times out drops into the
+                # serial reconnect-retry path (_request)
+                futs = []
+                for sid, skey, sl in shards:
+                    try:
+                        fut = self._conns[sid].submit(('pull', skey))
+                    except (RuntimeError, OSError):
+                        fut = None   # conn poisoned/closed: retry path
+                    futs.append((sid, skey, sl, fut))
                 flat = np.empty(int(np.prod(shape)), dtype)
-                for sl, f in futs:
-                    reply = f.wait()
+                for sid, skey, sl, f in futs:
+                    try:
+                        if f is None:
+                            raise OSError('connection already failed')
+                        reply = f.wait(timeout)
+                    except (OSError, TimeoutError):
+                        reply = self._request(sid, ('pull', skey))
                     assert reply and reply[0] == 'arr', reply
                     flat[sl] = unpack_array(reply[1]).reshape(-1)
                 arr = flat.reshape(shape)
@@ -331,6 +494,7 @@ class KVStoreDist(KVStore):
                 if dt > 0:
                     _tele.gauge('kvstore.pull_mb_s').set(
                         round(nbytes / 2.0**20 / dt, 2))
+            _tele.watchdog.note_progress('kvstore.pull')
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         from .ndarray.sparse import RowSparseNDArray, row_sparse_array

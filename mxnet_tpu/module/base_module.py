@@ -131,6 +131,7 @@ class BaseModule:
                 with _tele.span('eval.metric', 'eval'):
                     self.update_metric(eval_metric, eval_batch.label)
                 _tele.counter('eval.batches').inc()
+                _tele.watchdog.note_progress('eval.step')
                 if batch_end_callback is not None:
                     params = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                            eval_metric=eval_metric,
@@ -145,30 +146,41 @@ class BaseModule:
                                    eval_metric=eval_metric, locals=locals())
             for callback in _as_list(score_end_callback):
                 callback(params)
+        # the eval loop's progress marks armed the hang watchdog; this
+        # driven region is over — disarm so a standalone score followed
+        # by long host work cannot false-trip (inside fit the next
+        # epoch's first step mark re-arms immediately)
+        _tele.watchdog.suspend()
         return eval_metric.get_name_value()
 
     def iter_predict(self, eval_data, num_batch=None, reset=True):
         assert self.binded and self.params_initialized
         if reset:
             eval_data.reset()
-        # fused window path (one dispatch + one fetch per N batches);
-        # silent fallback to the reference per-batch loop
-        from .fused_eval import FusedEvalLoop
-        fused = FusedEvalLoop.build_cached(self, None, logger=self.logger)
-        if fused is not None:
-            yield from fused.iter_windows(eval_data, num_batch)
-            return
-        for nbatch, eval_batch in enumerate(eval_data):
-            if num_batch is not None and nbatch == num_batch:
-                break
-            with _tele.span('eval.dispatch', 'eval'):
-                self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            with _tele.span('eval.fetch', 'eval'):
-                outputs = [out[0:out.shape[0] - pad]
-                           for out in self.get_outputs()]
-            _tele.counter('eval.batches').inc()
-            yield (outputs, nbatch, eval_batch)
+        try:
+            # fused window path (one dispatch + one fetch per N
+            # batches); silent fallback to the per-batch loop
+            from .fused_eval import FusedEvalLoop
+            fused = FusedEvalLoop.build_cached(self, None,
+                                               logger=self.logger)
+            if fused is not None:
+                yield from fused.iter_windows(eval_data, num_batch)
+                return
+            for nbatch, eval_batch in enumerate(eval_data):
+                if num_batch is not None and nbatch == num_batch:
+                    break
+                with _tele.span('eval.dispatch', 'eval'):
+                    self.forward(eval_batch, is_train=False)
+                pad = eval_batch.pad
+                with _tele.span('eval.fetch', 'eval'):
+                    outputs = [out[0:out.shape[0] - pad]
+                               for out in self.get_outputs()]
+                _tele.counter('eval.batches').inc()
+                yield (outputs, nbatch, eval_batch)
+        finally:
+            # fused windows marked the hang watchdog: disarm when the
+            # consumer stops (exhaustion OR early generator close)
+            _tele.watchdog.suspend()
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False):
@@ -199,6 +211,8 @@ class BaseModule:
                 output_list.append(outputs)
         self._set_eval_rate(len(output_list),
                             getattr(eval_data, 'batch_size', 0), tic)
+        # same disarm as score(): predict's windows marked the watchdog
+        _tele.watchdog.suspend()
         if len(output_list) == 0:
             return output_list
         if merge_batches:
@@ -272,6 +286,10 @@ class BaseModule:
         # sync hook (telemetry/cluster.py) is gated the same way.
         health_on = _tele.health.enabled()
         cluster_on = _tele.cluster.enabled()
+        # hang watchdog (telemetry/watchdog.py): per-step progress marks
+        # feed the stall monitor; off = one cached-bool check here and
+        # no call in the loop
+        wd_on = _tele.watchdog.enabled()
 
         try:
             for epoch in range(begin_epoch, num_epoch):
@@ -290,6 +308,11 @@ class BaseModule:
                                         epoch_end_callback, eval_data,
                                         validation_metric, eval_end_callback,
                                         eval_batch_end_callback)
+                    if cluster_on:
+                        # elastic input re-balancing: a pending shard
+                        # shift applies here, before the reset re-draws
+                        _tele.cluster.apply_shard_shift(train_data,
+                                                        logger=self.logger)
                     train_data.reset()
                     continue
                 # a resumed epoch's first batch IS batch r_step: true
@@ -326,6 +349,8 @@ class BaseModule:
                             self.forward_backward(data_batch)
                             self.update()
                         _tele.counter('fit.steps').inc()
+                        if wd_on:
+                            _tele.watchdog.note_progress('fit.step')
                         # MXTPU_XPROF step-windowed device-trace capture
                         _profiler.note_step()
                         try:
@@ -363,6 +388,9 @@ class BaseModule:
                                     epoch_end_callback, eval_data,
                                     validation_metric, eval_end_callback,
                                     eval_batch_end_callback)
+                if cluster_on:
+                    _tele.cluster.apply_shard_shift(train_data,
+                                                    logger=self.logger)
                 train_data.reset()
         except BaseException as e:  # noqa: BLE001 — incl. Ctrl-C/exit
             if ckpt is not None:
@@ -381,11 +409,18 @@ class BaseModule:
                     ckpt.handle_failure(dict(diag) if diag else None)
                 except Exception:  # noqa: BLE001 — never mask the failure
                     pass
+            if wd_on:
+                # fit is over (however it ended): stop expecting marks
+                # so post-training host work cannot false-trip
+                _tele.watchdog.suspend()
             raise
 
         if ckpt is not None:
             # final save + writer drain + last-good certification
+            # (its commit emits one more progress mark — suspend after)
             ckpt.finish()
+        if wd_on:
+            _tele.watchdog.suspend()
 
     def _fit_epoch_end(self, epoch, eval_metric, tic, epoch_end_callback,
                        eval_data, validation_metric, eval_end_callback,
@@ -417,6 +452,11 @@ class BaseModule:
             for name, val in res:
                 self.logger.info('Epoch[%d] Validation-%s=%f',
                                  epoch, name, val)
+        # score() suspends the hang watchdog on exit (standalone-eval
+        # semantics); mid-fit the NEXT epoch is coming, so re-arm here
+        # — a host lost during eval wedges exactly the next epoch's
+        # first collective, and that window must stay covered
+        _tele.watchdog.note_progress('fit.epoch_end')
 
     # -- parameter contract (implemented by subclasses) --------------------
     @property

@@ -117,8 +117,10 @@ class TrainCheckpointer:
         self._failures = 0
         self._disabled = False
         self._resume = None        # (epoch, step_in_epoch, metric_state)
+        self._io_shard = None      # live iterator's shard assignment
         self.last_good = None
         self.restored_step = None
+        self.resharded_from = None  # saving mesh of an N->M restore
         # incident count at fit start: any NEW incident this attempt
         # marks every later capture uncertifiable (see _promote) —
         # while counts from a PREVIOUS attempt of the same process
@@ -177,6 +179,10 @@ class TrainCheckpointer:
                                'starting fresh', e)
                 self._resume = None
         module.__dict__['_mxtpu_ckpt'] = self
+        # watchdog-abort drain: a hang abort (os._exit from the monitor
+        # thread) must still commit + certify the in-flight save — the
+        # wedged main thread never reaches finish()/handle_failure()
+        _tele.watchdog.add_abort_hook(self._abort_drain)
         return self
 
     # -- state capture -----------------------------------------------------
@@ -258,6 +264,20 @@ class TrainCheckpointer:
                 'opt_bookkeeping': self._opt_bookkeeping(),
                 'metric': metric_state, 'rng_host': rng,
                 'grad_req': self.module._grad_req}
+        # reshard-on-restore sidecar: the SAVING mesh and every leaf's
+        # GLOBAL shape. Global shapes are mesh-independent, so a later
+        # restore onto fewer (or more) devices/hosts validates against
+        # these and lets orbax re-lay the shards out to the new mesh;
+        # the io record lets the resume remap its iterator cursor when
+        # the process set changed (every example still covered once)
+        try:
+            from ..parallel import multihost as _mh
+            meta['mesh'] = _mh.mesh_descriptor()
+        except Exception:  # noqa: BLE001 — never block a save on this
+            pass
+        meta['shapes'] = self._ckpt.template_shapes(tree)
+        if self._io_shard is not None:
+            meta['io'] = dict(self._io_shard)
         return tree, meta
 
     # -- save --------------------------------------------------------------
@@ -276,6 +296,9 @@ class TrainCheckpointer:
             self._ckpt.save(self._mngr, step, tree, wait=True, meta=meta)
         _faults.maybe_corrupt_checkpoint(self.directory, step)
         _tele.counter('ckpt.saves').inc()
+        # a committed save is forward progress even when the step loop
+        # is briefly quiet (sync fallback mode)
+        _tele.watchdog.note_progress('ckpt.save')
 
     def _initiate_save(self):
         step = self.global_step
@@ -438,6 +461,16 @@ class TrainCheckpointer:
         partial sums are re-applied and the iterator is skipped to the
         restored step."""
         self.eval_metric = eval_metric
+        # live iterator shard assignment, captured into every meta
+        # sidecar (reshard-on-restore reads it to re-derive coverage)
+        info_fn = getattr(train_data, 'shard_info', None)
+        if callable(info_fn):
+            try:
+                num_parts, part_index = info_fn()
+                self._io_shard = {'num_parts': int(num_parts),
+                                  'part_index': int(part_index)}
+            except Exception:  # noqa: BLE001
+                self._io_shard = None
         if self._resume is not None:
             r_epoch, r_step, metric_state = self._resume
             if epoch < r_epoch:
@@ -528,6 +561,14 @@ class TrainCheckpointer:
             self._last_save = self.global_step
             self._initiate_save()
 
+    def _abort_drain(self):
+        """Watchdog abort hook (monitor thread, bounded by the
+        watchdog's hook cap): drain the async writer and certify what
+        committed, so the relaunch has a last-good pointer. No new
+        capture is taken — the wedged main thread owns the live arrays."""
+        self._drain()
+        self._promote(final=True)
+
     def finish(self):
         """fit() completed: take a final save, drain the writer and
         certify what the health plane has cleared. Draining FIRST means
@@ -573,6 +614,7 @@ class TrainCheckpointer:
             pass
 
     def _shutdown_pool(self):
+        _tele.watchdog.remove_abort_hook(self._abort_drain)
         if self._pool is not None:
             self._pool.shutdown(wait=True)
             self._pool = None
@@ -595,18 +637,74 @@ class TrainCheckpointer:
                             for n in self._grad_names}
         return tree
 
+    @staticmethod
+    def _annotate_opt_leaves(msg, meta):
+        """Map the anonymous ``opt/opt.N`` leaf paths in a shape-
+        mismatch message back to the parameter each state leaf belongs
+        to, so the warning names 'opt/opt.3 (fc1_weight)' instead of a
+        bare counter."""
+        owners = {}
+
+        def walk(enc, name):
+            if enc is None:
+                return
+            if isinstance(enc, list):
+                for e in enc:
+                    walk(e, name)
+                return
+            owners[enc] = name
+
+        for name, enc in meta.get('opt_structure') or []:
+            walk(enc, name)
+        import re
+        return re.sub(
+            r'opt/(opt\.\d+)',
+            lambda m: 'opt/%s (%s)' % (m.group(1),
+                                       owners.get(m.group(1), '?')), msg)
+
     def _restore_step(self, step):
         """Restore one committed step into the module, bit-exactly.
-        Restore-into-template: the live arrays' dtypes/shardings tell
-        orbax where every shard belongs, so nothing materializes off
-        its mesh placement. Raises on a corrupt/mismatched checkpoint
-        (grad_req or the optimizer changed between runs) — the caller
-        falls back to an older step."""
-        restored, meta = self._ckpt.restore_with_meta(
-            self._mngr, self._template(), step)
+        Restore-into-template: the CURRENT mesh's live arrays supply
+        the dtypes/shardings orbax restores onto, and validation runs
+        against GLOBAL shapes (recorded in the meta sidecar at save) —
+        never per-host ones — so a checkpoint saved on N devices/hosts
+        restores onto M as long as the model itself is unchanged, with
+        orbax re-laying the shards out to the new mesh. A genuine
+        model/optimizer change raises naming the exact offending leaf;
+        the caller falls back to an older step."""
+        meta = self._ckpt.read_meta(self._mngr, step)
         if meta.get('format') != _FORMAT:
             raise ValueError('unsupported checkpoint format %r'
                              % meta.get('format'))
+        template = self._template()
+        saved_shapes = meta.get('shapes')
+        if saved_shapes:
+            try:
+                self._ckpt.validate_shapes(saved_shapes, template)
+            except ValueError as e:
+                raise ValueError(self._annotate_opt_leaves(str(e), meta)) \
+                    from None
+        saved_mesh = meta.get('mesh')
+        if saved_mesh:
+            try:
+                from ..parallel import multihost as _mh
+                now = _mh.mesh_descriptor()
+            except Exception:  # noqa: BLE001
+                now = None
+            if now is not None and (
+                    saved_mesh.get('devices') != now['devices']
+                    or saved_mesh.get('processes') != now['processes']):
+                self.resharded_from = dict(saved_mesh)
+                self.logger.info(
+                    'checkpointing: resharding step %d saved on %s '
+                    'device(s) / %s process(es) onto %d / %d — global '
+                    'shapes validated, orbax re-lays the shards out to '
+                    'the current mesh', step,
+                    saved_mesh.get('devices'), saved_mesh.get('processes'),
+                    now['devices'], now['processes'])
+        # state-only restore: the meta sidecar was already read (and
+        # validated) above — no second JSON round-trip
+        restored = self._ckpt.restore_state(self._mngr, template, step)
         self._apply(restored, meta)
         return meta
 
@@ -627,25 +725,45 @@ class TrainCheckpointer:
         opt_arrays = tree.get('opt', {})
         staged = []   # (live state NDArray, restored array)
 
-        def stage(struct, live):
+        def stage(struct, live, name):
+            # every mismatch names the owning parameter — a restore
+            # that cannot proceed must say WHICH leaf drifted, not just
+            # that one did (the caller's older-step fallback warning
+            # carries this text)
             if struct is None:
                 if live is not None:
-                    raise ValueError('optimizer state shape drifted')
+                    raise ValueError(
+                        'optimizer state for %s drifted: checkpoint has '
+                        'no state leaf, live optimizer has one' % name)
                 return
             if isinstance(struct, list):
                 if not isinstance(live, tuple) or len(live) != len(struct):
-                    raise ValueError('optimizer state shape drifted')
+                    raise ValueError(
+                        'optimizer state for %s drifted: checkpoint '
+                        'holds %d state leaf(s), live optimizer %s'
+                        % (name, len(struct),
+                           len(live) if isinstance(live, tuple)
+                           else 'a single leaf'))
                 for s, l in zip(struct, live):
-                    stage(s, l)
+                    stage(s, l, name)
                 return
             if live is None or isinstance(live, tuple):
-                raise ValueError('optimizer state shape drifted')
-            staged.append((live, opt_arrays[struct]))
+                raise ValueError(
+                    'optimizer state for %s drifted: checkpoint leaf %s '
+                    'has no matching live state array' % (name, struct))
+            arr = opt_arrays[struct]
+            if tuple(arr.shape) != tuple(live._data.shape):
+                raise ValueError(
+                    'optimizer state for %s drifted: leaf %s saved '
+                    'shape %s vs live %s'
+                    % (name, struct, tuple(arr.shape),
+                       tuple(live._data.shape)))
+            staged.append((live, arr))
 
         for name, struct in meta['opt_structure']:
             if name not in self._upd_keys:
                 raise ValueError('checkpoint names unknown param %r' % name)
-            stage(struct, upd.states[self._upd_keys[name]])
+            stage(struct, upd.states[self._upd_keys[name]], name)
 
         for n in self._param_names:
             e.arg_dict[n]._data = tree['params'][n]
@@ -674,6 +792,40 @@ class TrainCheckpointer:
         rng['key'] = None if values is None \
             else np.asarray(values, dtype=np.dtype(dtype))
         _random.set_state(rng)
+
+    def _remap_resume_cursor(self, r_step, meta):
+        """Translate the saved step-in-epoch iterator cursor into the
+        CURRENT process set's units after an N->M host restore. Each
+        host draws per-host batches from its own 1/P shard, so one
+        global "step" covers batch_size * P samples: the same trained
+        sample count lands at step * P_old / P_new in the new layout.
+        Inexact divisions round DOWN (a few batches retrain from the
+        restored — finite — parameters rather than skipping unseen
+        data); the io shard ranges themselves come from the relaunched
+        processes' own iterator construction (io.auto_shard), so every
+        example is covered exactly once by the new set."""
+        saved_mesh = meta.get('mesh') or {}
+        old_p = int(saved_mesh.get('processes') or 0)
+        try:
+            from ..parallel import multihost as _mh
+            new_p = int(_mh.process_count())
+        except Exception:  # noqa: BLE001
+            new_p = old_p
+        if not old_p or old_p == new_p or not r_step:
+            return r_step
+        scaled, rem = divmod(r_step * old_p, new_p)
+        io_meta = meta.get('io') or {}
+        self.logger.warning(
+            'checkpointing: restore crosses a process-set change '
+            '(%d -> %d host(s)): iterator cursor remapped step %d -> '
+            '%d%s; io shard ranges re-derived from the new process set'
+            '%s', old_p, new_p, r_step, scaled,
+            '' if not rem else ' (inexact — %d sample-steps retrain)'
+            % rem,
+            ' (was shard %s/%s)' % (io_meta.get('part_index'),
+                                    io_meta.get('num_parts'))
+            if io_meta else '')
+        return scaled
 
     def _try_resume(self):
         steps = self._ckpt.all_steps(self._mngr)
@@ -715,8 +867,9 @@ class TrainCheckpointer:
                     self._write_pointer(step)
                 except OSError:
                     pass
-            self._resume = (int(meta['epoch']),
-                            int(meta['step_in_epoch']),
+            r_step = int(meta['step_in_epoch'])
+            r_step = self._remap_resume_cursor(r_step, meta)
+            self._resume = (int(meta['epoch']), r_step,
                             meta.get('metric') or [])
             self.logger.info(
                 'checkpointing: restored step %d (epoch %d, step %d) '

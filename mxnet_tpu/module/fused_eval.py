@@ -368,6 +368,9 @@ class FusedEvalLoop:
                                        _random.next_key())
                 _tele.counter('fused_eval.windows').inc()
                 _tele.counter('eval.batches').inc(self.window)
+                # hang-watchdog progress mark: eval windows count too,
+                # or a long between-epoch score() would false-trip it
+                _tele.watchdog.note_progress('fused_eval.window')
                 # dispatch is async: draw the NEXT window (its stack +
                 # transfer start on the side thread), then hand the
                 # PREVIOUS window to the consumer while this one

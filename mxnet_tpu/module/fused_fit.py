@@ -917,6 +917,11 @@ class FusedFitLoop:
                     self._writeback(params, states, aux, gaccs)
                 _tele.counter('fit.steps').inc(self.window)
                 _tele.counter('fused_fit.windows').inc()
+                # hang-watchdog progress mark: one whole window
+                # dispatched (the dispatch is async, but an enqueued
+                # window IS host-side progress; a wedged device shows
+                # up at the next put/fetch, which then stops marking)
+                _tele.watchdog.note_progress('fused_fit.window')
                 if cluster_on:
                     # a whole window of steps advanced in one dispatch;
                     # the sync (if due) piggybacks on the window edge
@@ -1001,6 +1006,7 @@ class FusedFitLoop:
             m.forward_backward(sb)
             m.update()
             _tele.counter('fit.steps').inc()
+            _tele.watchdog.note_progress('fit.step')
             if cluster_on:
                 _tele.cluster.note_step()
             if faults_on:

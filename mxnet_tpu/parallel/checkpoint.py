@@ -19,13 +19,68 @@ tier is for large sharded SPMD state, the two are complementary.
 """
 import jax
 
-__all__ = ['manager', 'save', 'restore', 'restore_with_meta',
-           'latest_step', 'all_steps', 'delete_step', 'wait']
+__all__ = ['manager', 'save', 'restore', 'restore_with_meta', 'read_meta',
+           'restore_state', 'latest_step', 'all_steps', 'delete_step',
+           'wait', 'template_shapes', 'validate_shapes']
 
 
 def _ocp():
     import orbax.checkpoint as ocp
     return ocp
+
+
+def _abstract(template):
+    """ShapeDtypeStruct tree mirroring ``template``'s GLOBAL shapes,
+    dtypes and shardings — what StandardRestore targets. The shapes are
+    global by construction (jax.Array.shape is the global shape
+    whatever the mesh), which is what makes a checkpoint saved on N
+    devices restorable onto M: only the sharding differs, and orbax
+    re-lays the shards out to the template's mesh."""
+    return jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                       sharding=getattr(x, 'sharding',
+                                                        None)),
+        template)
+
+
+def template_shapes(template):
+    """{'/'-joined leaf path: list(global shape)} for a template tree —
+    recorded into the checkpoint meta at save so a later restore can
+    validate GLOBAL shapes (never per-host/per-device ones) against the
+    live state and name the exact offending leaf."""
+    flat = jax.tree_util.tree_flatten_with_path(template)[0]
+    out = {}
+    for path, leaf in flat:
+        key = '/'.join(str(getattr(p, 'key', getattr(p, 'idx', p)))
+                       for p in path)
+        out[key] = list(getattr(leaf, 'shape', ()))
+    return out
+
+
+def validate_shapes(saved_shapes, template):
+    """Raise ValueError naming every leaf whose GLOBAL shape differs
+    between the checkpoint meta (``saved_shapes``, from
+    :func:`template_shapes` at save time) and the live ``template`` —
+    BEFORE orbax touches anything, with a message that says which leaf
+    and both shapes instead of an opaque restore failure. Leaves added
+    or removed count as mismatches too."""
+    live = template_shapes(template)
+    bad = []
+    for key in sorted(set(saved_shapes) | set(live)):
+        s = saved_shapes.get(key)
+        l = live.get(key)
+        if s is None:
+            bad.append('%s: not in the checkpoint (live %s)'
+                       % (key, tuple(l)))
+        elif l is None:
+            bad.append('%s: not in the live state (saved %s)'
+                       % (key, tuple(s)))
+        elif list(s) != list(l):
+            bad.append('%s: saved global shape %s vs live %s'
+                       % (key, tuple(s), tuple(l)))
+    if bad:
+        raise ValueError('checkpoint/live global-shape mismatch — '
+                         + '; '.join(bad))
 
 
 def manager(directory, max_to_keep=None, save_interval_steps=1):
@@ -69,13 +124,8 @@ def restore(mngr, template, step=None):
     if step is None:
         raise FileNotFoundError('no checkpoint found in %s'
                                 % mngr.directory)
-    abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                       sharding=getattr(x, 'sharding',
-                                                        None)),
-        template)
     return mngr.restore(int(step),
-                        args=ocp.args.StandardRestore(abstract))
+                        args=ocp.args.StandardRestore(_abstract(template)))
 
 
 def restore_with_meta(mngr, template, step):
@@ -83,15 +133,32 @@ def restore_with_meta(mngr, template, step):
     ``(state, meta)`` with every array of ``state`` landed on its
     template entry's sharding (the JSON item needs no template)."""
     ocp = _ocp()
-    abstract = jax.tree_util.tree_map(
-        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
-                                       sharding=getattr(x, 'sharding',
-                                                        None)),
-        template)
     r = mngr.restore(int(step), args=ocp.args.Composite(
-        state=ocp.args.StandardRestore(abstract),
+        state=ocp.args.StandardRestore(_abstract(template)),
         meta=ocp.args.JsonRestore()))
     return r['state'], r['meta']
+
+
+def restore_state(mngr, template, step):
+    """Restore ONLY the array state of a save-with-``meta`` step onto
+    ``template``'s shardings — the companion of :func:`read_meta` for
+    callers that already validated the sidecar (one restore round-trip
+    each instead of re-reading the JSON with the arrays)."""
+    ocp = _ocp()
+    r = mngr.restore(int(step), args=ocp.args.Composite(
+        state=ocp.args.StandardRestore(_abstract(template))))
+    return r['state']
+
+
+def read_meta(mngr, step):
+    """The JSON meta sidecar of one committed step, WITHOUT restoring
+    any array state — the reshard-on-restore path reads the saving
+    mesh + recorded global shapes first, validates them against the
+    live template, and only then pays for the array restore."""
+    ocp = _ocp()
+    r = mngr.restore(int(step),
+                     args=ocp.args.Composite(meta=ocp.args.JsonRestore()))
+    return r['meta']
 
 
 def latest_step(mngr):

@@ -21,7 +21,8 @@ tier and this one.
 import numpy as np
 
 __all__ = ['init_multihost', 'global_mesh', 'process_index',
-           'process_count', 'local_devices', 'is_multihost']
+           'process_count', 'local_devices', 'is_multihost',
+           'mesh_descriptor']
 
 _initialized = False
 
@@ -89,6 +90,20 @@ def local_devices():
 def is_multihost():
     import jax
     return jax.process_count() > 1
+
+
+def mesh_descriptor():
+    """The live process/device set as a plain JSON-able dict —
+    recorded into every checkpoint's meta sidecar
+    (module/checkpointing.py) so a restore can tell "same mesh, plain
+    resume" from "smaller/larger mesh, reshard-on-restore" and remap
+    the io shard cursor accordingly. Requires the backend to be up
+    (checkpointing only runs after bind, so it always is)."""
+    import jax
+    return {'devices': int(jax.device_count()),
+            'local_devices': int(jax.local_device_count()),
+            'processes': int(jax.process_count()),
+            'process_index': int(jax.process_index())}
 
 
 def global_mesh(axes):

@@ -36,7 +36,16 @@ instrumentation the hot paths report through:
   every N steps one small off-graph allgather carries each host's key
   gauges; process 0 publishes ``cluster.*`` per-host gauges, the
   step-time spread, the slowest-host id and a straggler classification
-  (input-bound vs compute-bound).
+  (input-bound vs compute-bound). With ``MXTPU_ELASTIC_INPUT`` every
+  host additionally derives the same shard-shift decision from the
+  same gathered round and re-balances input shards away from an
+  input-bound host at the next epoch boundary;
+- the hang watchdog (:mod:`.watchdog`, ``MXTPU_WATCHDOG_SECS``):
+  a daemon-thread progress monitor fed by the hot loops' dispatch /
+  sync / kvstore / checkpoint sites; a stall dumps all-thread stacks
+  as a ``hang`` JSONL incident, flips ``/healthz`` to a 503 ``hung``
+  digest, and (``MXTPU_WATCHDOG_ACTION=abort``) exits with the
+  distinct code 85 so the supervisor relaunches from last-good.
 
 Everything is OFF by default. ``MXTPU_TELEMETRY=1`` turns it on;
 ``MXTPU_TELEMETRY_PATH`` points the JSONL log (default
@@ -75,11 +84,12 @@ from . import health  # noqa: F401  (public submodule: telemetry.health.*)
 from . import cluster  # noqa: F401  (public submodule: telemetry.cluster.*)
 from . import serve  # noqa: F401  (public submodule: telemetry.serve.*)
 from . import roofline  # noqa: F401  (public submodule: telemetry.roofline.*)
+from . import watchdog  # noqa: F401  (public submodule: telemetry.watchdog.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
            'programs', 'health', 'cluster', 'serve', 'roofline',
-           'get_registry']
+           'watchdog', 'get_registry']
 
 
 class _State:
@@ -375,3 +385,4 @@ def _reset_for_tests():
     health._reset_for_tests()
     cluster._reset_for_tests()
     roofline._reset_for_tests()
+    watchdog._reset_for_tests()

@@ -46,7 +46,8 @@ import time
 import numpy as np
 
 __all__ = ['enabled', 'host_index', 'set_host', 'note_step', 'sync_now',
-           'snapshot_cluster', 'classify', 'SYNC_KEYS']
+           'snapshot_cluster', 'classify', 'round_verdict', 'SYNC_KEYS',
+           'elastic_enabled', 'shard_shift', 'apply_shard_shift']
 
 # slots of the per-host sync vector, in order ('comm_pct' — the
 # roofline's collective share of the step — is NaN/omitted unless
@@ -63,7 +64,8 @@ _RING = 128                  # recent per-step wall samples backing the p50
 
 class _CState:
     __slots__ = ('decided', 'active', 'every', 'since', 'steps', 'last_t',
-                 'ring', 'snapshot', 'lock')
+                 'ring', 'snapshot', 'lock', 'elastic', 'shift', 'applied',
+                 'last_shift', 'shift_warned')
 
     def __init__(self):
         self.decided = False
@@ -75,6 +77,14 @@ class _CState:
         self.ring = collections.deque(maxlen=_RING)
         self.snapshot = None
         self.lock = threading.Lock()
+        # MXTPU_ELASTIC_INPUT: the global shard-shift counter every host
+        # derives identically from the same gathered sync rounds, and
+        # how much of it this host has applied to its iterator
+        self.elastic = False
+        self.shift = 0
+        self.applied = 0
+        self.last_shift = None   # {'step', 'input_bound_host', 'shift'}
+        self.shift_warned = False
 
 
 _state = _CState()
@@ -121,6 +131,7 @@ def _decide():
             return _state.active
         on = False
         every = 0
+        elastic = False
         if _tele().active:
             from ..config import flags
             try:
@@ -129,8 +140,15 @@ def _decide():
             except Exception:  # noqa: BLE001
                 every = 0
             on = every > 0
+            if on:
+                try:
+                    flags.reload('MXTPU_ELASTIC_INPUT')
+                    elastic = bool(flags.get('MXTPU_ELASTIC_INPUT'))
+                except Exception:  # noqa: BLE001
+                    elastic = False
         _state.active = on
         _state.every = every
+        _state.elastic = elastic
         _state.decided = True
     return _state.active
 
@@ -218,6 +236,32 @@ def _allgather(vals):
     return out.reshape(max(1, jax.process_count()), -1)
 
 
+def round_verdict(mat):
+    """(slowest_host, spread_pct, verdict) for one gathered matrix —
+    the ONE implementation of the per-round straggler math, shared by
+    the publication path (:func:`_publish`) and the elastic-input
+    decision (:func:`_elastic_decide`) so the published verdict and the
+    re-balance decision can never disagree on the same round.
+    ``slowest_host`` is None when no host has a valid step time."""
+    mat = np.asarray(mat, np.float64)
+    times = mat[:, 0]
+    valid = ~np.isnan(times)
+    if not valid.any():
+        return None, 0.0, 'balanced'
+    t = np.where(valid, times, 0.0)
+    slowest = int(np.argmax(t))
+    med = float(np.median(t[valid]))
+    tmax = float(t[valid].max())
+    tmin = float(t[valid].min())
+    spread = ((tmax - tmin) / med * 100.0) if med > 0 else 0.0
+    if mat.shape[0] == 1 or spread < _SPREAD_BALANCED_PCT:
+        return slowest, spread, 'balanced'
+    comm_v = float(mat[slowest, 4]) if mat.shape[1] > 4 else float('nan')
+    verdict = classify(float(mat[slowest, 1]),
+                       None if np.isnan(comm_v) else comm_v)
+    return slowest, spread, verdict
+
+
 def classify(io_wait_pct, comm_pct=None):
     """The straggler classification for one host: where its time goes.
     Reuses the health module's input-bound threshold so the live
@@ -248,6 +292,14 @@ def sync_now():
     except Exception as e:  # noqa: BLE001 — observability must not kill
         logging.debug('telemetry.cluster: sync failed: %s', e)
         return None
+    from . import watchdog as _watchdog
+    _watchdog.note_progress('cluster.sync')
+    with _state.lock:
+        steps = _state.steps
+    # elastic input re-balancing decides on EVERY host (the gathered
+    # matrix is identical everywhere, so every host derives the same
+    # shift) — the process-0 gate below only guards publication
+    _elastic_decide(mat, steps)
     try:
         import jax
         me = jax.process_index()
@@ -255,8 +307,6 @@ def sync_now():
         me = host_index()
     if me != 0:
         return None
-    with _state.lock:
-        steps = _state.steps
     return _publish(mat, steps)
 
 
@@ -288,24 +338,7 @@ def _publish(mat, steps):
             round(row['live_bytes'] / 2.0**20, 1))
         if row['comm_pct'] is not None:
             reg.gauge('cluster.h%d.comm_pct' % i).set(row['comm_pct'])
-    times = mat[:, 0]
-    valid = ~np.isnan(times)
-    if valid.any():
-        times = np.where(valid, times, 0.0)
-        slowest = int(np.argmax(times))
-        med = float(np.median(times[valid]))
-        tmax = float(times[valid].max())
-        tmin = float(times[valid].min())
-        spread = ((tmax - tmin) / med * 100.0) if med > 0 else 0.0
-    else:
-        slowest = None
-        spread = 0.0
-    if n == 1 or slowest is None or spread < _SPREAD_BALANCED_PCT:
-        straggler = 'balanced'
-    else:
-        comm_v = float(mat[slowest, 4]) if mat.shape[1] > 4 else float('nan')
-        straggler = classify(float(mat[slowest, 1]),
-                             None if np.isnan(comm_v) else comm_v)
+    slowest, spread, straggler = round_verdict(mat)
     reg.gauge('cluster.hosts').set(n)
     if slowest is not None:
         reg.gauge('cluster.slowest_host').set(slowest)
@@ -321,6 +354,125 @@ def _publish(mat, steps):
         rec.update(snap)
         st.sink.emit(rec)
     return snap
+
+
+# ---------------------------------------------------------------------------
+# straggler-aware input re-balancing (MXTPU_ELASTIC_INPUT)
+# ---------------------------------------------------------------------------
+
+def elastic_enabled():
+    """Whether straggler-aware input re-balancing is on: the cluster
+    sync cadence (which carries the decisions) AND MXTPU_ELASTIC_INPUT.
+    One attribute check after the first call."""
+    return enabled() and _state.elastic
+
+
+def _elastic_decide(mat, steps):
+    """One sync round's re-balance decision, computed identically on
+    every host from the identical gathered matrix: when the round names
+    an input-bound straggler, advance the global shard-shift counter by
+    one. The shift is APPLIED at the next epoch boundary
+    (:func:`apply_shard_shift`) so mid-epoch batches are never
+    re-drawn. Deterministic by construction — no second collective, no
+    coordinator: every host sees the same matrix, runs the same math,
+    lands on the same shift."""
+    if not elastic_enabled():
+        return None
+    mat = np.asarray(mat, np.float64)
+    if mat.shape[0] < 2:
+        return None
+    slowest, spread, verdict = round_verdict(mat)
+    if verdict != 'input_bound':
+        return None
+    with _state.lock:
+        if _state.shift != _state.applied:
+            # a rotation is already pending: an input-bound host keeps
+            # reading input-bound every round until the boundary, and
+            # accumulating one shift per ROUND would turn the applied
+            # delta into an arbitrary rotation (0 mod num_parts = a
+            # silent no-op). At most ONE step pends at a time; every
+            # host gates identically (applied advances at the same
+            # lockstep epoch boundary everywhere)
+            return None
+        _state.shift += 1
+        info = {'step': int(steps), 'input_bound_host': slowest,
+                'shift': _state.shift, 'spread_pct': round(spread, 1)}
+        _state.last_shift = dict(info)
+    st = _tele()
+    st.registry.gauge('cluster.elastic_shift').set(info['shift'])
+    if st.sink is not None:
+        rec = {'type': 'elastic', 'event': 'shift'}
+        rec.update(info)
+        st.sink.emit(rec)
+    logging.warning(
+        'telemetry.cluster: host %d is input-bound (spread %.1f%%) — '
+        'shard assignments rotate by one at the next epoch boundary '
+        '(shift %d)', slowest, spread, info['shift'])
+    return info
+
+
+def _elastic_give_up(reason, logger):
+    """This iterator cannot be re-balanced: warn ONCE and disable the
+    elastic tier for the rest of the run, so sync rounds stop deciding
+    (and logging, and gauging) shifts that can never be applied — a
+    climbing cluster.elastic_shift over a never-moving assignment would
+    be operator-misleading noise."""
+    _state.elastic = False
+    if not _state.shift_warned:
+        _state.shift_warned = True
+        logger.warning(
+            'telemetry.cluster: MXTPU_ELASTIC_INPUT is on but %s; '
+            'input re-balancing is disabled for this run', reason)
+
+
+def shard_shift():
+    """The current global shard-shift counter (0 = original
+    assignment). Identical on every host of the job by construction."""
+    with _state.lock:
+        return _state.shift
+
+
+def apply_shard_shift(train_data, logger=logging):
+    """Epoch-boundary hook (both fit loops): apply any un-applied shard
+    shift to ``train_data`` via the iterator shard protocol —
+    ``shard_info() -> (num_parts, part_index)`` plus
+    ``set_shard(part_index)`` (ImageRecordIter, MNISTIter; takes effect
+    at the iterator's next reset). Every host applies the same delta to
+    its own part index, so the rotated assignment still covers every
+    shard exactly once. Returns the new part index, or None when
+    nothing changed. Off (or no pending shift) = one cached check."""
+    if not elastic_enabled():
+        return None
+    with _state.lock:
+        delta = _state.shift - _state.applied
+        if delta == 0:
+            return None
+        _state.applied = _state.shift
+    info_fn = getattr(train_data, 'shard_info', None)
+    set_fn = getattr(train_data, 'set_shard', None)
+    if not callable(info_fn) or not callable(set_fn):
+        _elastic_give_up(
+            '%s exposes no shard_info()/set_shard()'
+            % type(train_data).__name__, logger)
+        return None
+    num_parts, part = info_fn()
+    if num_parts <= 1:
+        _elastic_give_up(
+            '%s holds a single shard (num_parts=%d) — nothing to '
+            'rotate' % (type(train_data).__name__, num_parts), logger)
+        return None
+    new_part = (int(part) + delta) % int(num_parts)
+    set_fn(new_part)
+    st = _tele()
+    if st.sink is not None:
+        st.sink.emit({'type': 'elastic', 'event': 'reshard',
+                      'num_parts': int(num_parts), 'part_index': new_part,
+                      'was': int(part), 'shift': _state.shift})
+    logger.info(
+        'telemetry.cluster: elastic input re-balance — this host now '
+        'reads shard %d/%d (was %d, shift %d); applies at the next '
+        'epoch', new_part, num_parts, part, _state.shift)
+    return new_part
 
 
 def snapshot_cluster():

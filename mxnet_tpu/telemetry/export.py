@@ -2,9 +2,9 @@
 
 The JSONL sink is the machine-readable record a perf investigation
 greps after the fact: one JSON object per line, each with a ``type``
-('start', 'span', 'compile', 'retrace_storm', 'event', 'program',
-'oom', 'health', 'anomaly', 'roofline', 'summary') and a ``t``
-epoch-seconds stamp. Records buffer in memory and flush every
+('start', 'span', 'compile', 'cache_hit', 'retrace_storm', 'event',
+'program', 'oom', 'health', 'anomaly', 'cluster', 'restart', 'hang',
+'elastic', 'roofline', 'summary') and a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
 ``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
 on a per-batch fsync.
 
@@ -20,6 +20,11 @@ import time
 __all__ = ['JsonlSink', 'summary_table']
 
 _FLUSH_EVERY = 64
+# ...and at least this often in wall time: the supervisor's liveness
+# tier (tools/train_supervisor.py, MXTPU_SUPERVISOR_LIVENESS) watches
+# the FILE for growth, so a slow loop whose records sit in the buffer
+# must not read as a hang
+_FLUSH_SECS = 5.0
 
 # Module-wide count of actual file I/O calls (open/write/flush) — the
 # zero-overhead tests assert this stays put while telemetry is off.
@@ -46,6 +51,7 @@ class JsonlSink:
         self._closed = False
         self._max_bytes = max_bytes
         self._capped = False
+        self._last_flush = time.time()
         try:
             # append mode: what is already on disk counts against the cap
             self._bytes = os.path.getsize(path)
@@ -64,6 +70,7 @@ class JsonlSink:
             return
         if self._capped:
             self._count_dropped()
+            self._heartbeat()
             return
         record.setdefault('t', time.time())
         if self.host is not None:
@@ -84,7 +91,8 @@ class JsonlSink:
             else:
                 self._bytes += len(line) + 1
                 self._buf.append(line)
-                if len(self._buf) >= _FLUSH_EVERY:
+                if len(self._buf) >= _FLUSH_EVERY or \
+                        record['t'] - self._last_flush >= _FLUSH_SECS:
                     self._flush_locked()
         if tripped:
             logging.warning(
@@ -96,8 +104,24 @@ class JsonlSink:
         if tripped or raced:
             self._count_dropped()
 
+    def _heartbeat(self):
+        """A capped sink appends nothing ever again, but the supervisor
+        liveness tier (tools/train_supervisor.py) reads 'file stopped
+        changing' as 'child is wedged' — touch the mtime (no growth, so
+        the size cap's contract holds) at the flush cadence so a
+        healthy-but-capped child is never liveness-killed in a loop."""
+        now = time.time()
+        if now - self._last_flush < _FLUSH_SECS:
+            return
+        self._last_flush = now
+        try:
+            os.utime(self.path)
+        except OSError:
+            pass
+
     def _flush_locked(self):
         global _io_calls
+        self._last_flush = time.time()
         if self._buf and not self._closed:
             _io_calls += 1
             self._f.write('\n'.join(self._buf) + '\n')
@@ -166,6 +190,8 @@ def _health_lines(health):
                         _fmt(last.get('baseline'))))
     if health.get('restarts'):
         lines.append('  restarts          %d' % int(health['restarts']))
+    if health.get('hangs'):
+        lines.append('  hangs             %d' % int(health['hangs']))
     if health.get('input_bound_pct') is not None:
         lines.append('  input_bound_pct   %s'
                      % _fmt(float(health['input_bound_pct'])))

@@ -618,6 +618,9 @@ def snapshot_health(input_bound=None):
     restarts = int(reg.counter('health.restarts').value)
     if restarts:
         out['restarts'] = restarts
+    hangs = int(reg.counter('watchdog.hangs').value)
+    if hangs:
+        out['hangs'] = hangs
     if input_bound is not None:
         out['input_bound_pct'] = round(input_bound, 1)
     return out

@@ -130,26 +130,33 @@ def render_prometheus(snapshot, host=None):
 
 def healthz_payload():
     """(ok, digest) for /healthz. ``ok`` flips False — the endpoint
-    answers 503 — once a non-finite incident is on record; the digest
-    carries the health snapshot (incidents, anomaly counts, last
-    anomaly, input-bound share) and the last cluster round."""
-    from . import health, cluster
+    answers 503 — once a non-finite incident is on record OR the hang
+    watchdog says the loop is stalled right now; the digest carries the
+    health snapshot (incidents, anomaly counts, last anomaly,
+    input-bound share), the active hang digest (stall age, last
+    progress mark, thread stacks) and the last cluster round. A hang
+    clears back to 200 when progress resumes."""
+    from . import health, cluster, watchdog
     st = _tele()
     hs = health.snapshot_health(input_bound=health.input_bound_pct()) \
         if st.active else None
     bad = int(hs.get('nonfinite_steps') or 0) if hs else 0
+    hang = watchdog.hang_info()
     body = {
-        'status': 'ok' if not bad else 'degraded',
+        'status': 'hung' if hang is not None
+        else ('ok' if not bad else 'degraded'),
         'telemetry': bool(st.active),
         'health_sentinels': bool(health.enabled()),
         'host': cluster.host_index(),
     }
+    if hang is not None:
+        body['hang'] = hang
     if hs is not None:
         body['health'] = hs
     clus = cluster.snapshot_cluster()
     if clus:
         body['cluster'] = clus
-    return bad == 0, body
+    return bad == 0 and hang is None, body
 
 
 def summary_payload():

@@ -491,3 +491,149 @@ def test_executor_incident_carries_step(tmp_path, monkeypatch):
             monkeypatch.delenv(f, raising=False)
         _reload_flags()
         flags.reload('MXTPU_FUSED_FIT')
+
+
+# ---------------------------------------------------------------------------
+# straggler-aware input re-balancing (MXTPU_ELASTIC_INPUT)
+# ---------------------------------------------------------------------------
+
+class _ShardIter:
+    def __init__(self, num_parts=4, part_index=1):
+        self.num_parts, self.part_index = num_parts, part_index
+
+    def shard_info(self):
+        return self.num_parts, self.part_index
+
+    def set_shard(self, part_index):
+        self.part_index = part_index
+
+
+@pytest.fixture
+def elastic_on(tele_live, monkeypatch):
+    monkeypatch.setenv('MXTPU_ELASTIC_INPUT', '1')
+    flags.reload('MXTPU_ELASTIC_INPUT')
+    telemetry._reset_for_tests()
+    yield tele_live
+    telemetry._reset_for_tests()
+    monkeypatch.delenv('MXTPU_ELASTIC_INPUT', raising=False)
+    flags.reload('MXTPU_ELASTIC_INPUT')
+
+
+def test_elastic_decides_on_input_bound_round(elastic_on):
+    """An input-bound slowest host in a gathered round advances the
+    shard shift (identically on every host — the decision is pure math
+    over the identical matrix); a compute-bound or balanced round does
+    not. The shift applies at the next epoch boundary via the iterator
+    shard protocol and is consumed exactly once."""
+    assert cluster.elastic_enabled()
+    nanv = float('nan')
+    # balanced spread: no decision
+    assert cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [10.2, 2.0, 0.0, 0.0, nanv]]),
+        steps=4) is None
+    # slow + compute-bound: no decision
+    assert cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [20.0, 4.0, 0.0, 0.0, nanv]]),
+        steps=6) is None
+    # slow + input-bound: shift
+    info = cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [20.0, 60.0, 0.0, 0.0, nanv]]),
+        steps=8)
+    assert info == {'step': 8, 'input_bound_host': 1, 'shift': 1,
+                    'spread_pct': info['spread_pct']}
+    assert cluster.shard_shift() == 1
+    reg = telemetry.get_registry()
+    assert reg.gauge('cluster.elastic_shift').value == 1
+    it = _ShardIter(num_parts=4, part_index=1)
+    assert cluster.apply_shard_shift(it) == 2 and it.part_index == 2
+    assert cluster.apply_shard_shift(it) is None     # consumed
+    # a second round shifts again, applied as a delta on the CURRENT part
+    cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [20.0, 60.0, 0.0, 0.0, nanv]]),
+        steps=16)
+    assert cluster.apply_shard_shift(it) == 3
+    telemetry._state.sink.flush()
+    recs = [r for r in _records(elastic_on) if r['type'] == 'elastic']
+    assert [r['event'] for r in recs] == ['shift', 'reshard', 'shift',
+                                          'reshard']
+
+
+def test_elastic_iterator_without_protocol_warns_once(elastic_on, caplog):
+    nanv = float('nan')
+    cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [20.0, 60.0, 0.0, 0.0, nanv]]),
+        steps=4)
+
+    class Plain:
+        pass
+
+    with caplog.at_level(logging.WARNING):
+        assert cluster.apply_shard_shift(Plain()) is None
+    assert 'shard_info' in caplog.text
+    # the shift is consumed (no warning storm every epoch)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        assert cluster.apply_shard_shift(Plain()) is None
+    assert 'shard_info' not in caplog.text
+
+
+def test_elastic_off_is_inert(tele_live):
+    """Cluster sync on but MXTPU_ELASTIC_INPUT off: no decision, no
+    shift, apply_shard_shift is one cached check."""
+    assert cluster.enabled() and not cluster.elastic_enabled()
+    nanv = float('nan')
+    assert cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [20.0, 60.0, 0.0, 0.0, nanv]]),
+        steps=4) is None
+    it = _ShardIter()
+    assert cluster.apply_shard_shift(it) is None and it.part_index == 1
+    assert cluster.shard_shift() == 0
+    assert not [r for r in _records(tele_live)
+                if r.get('type') == 'elastic']
+
+
+def test_elastic_single_host_never_shifts(elastic_on):
+    nanv = float('nan')
+    assert cluster._elastic_decide(
+        np.array([[10.0, 90.0, 0.0, 0.0, nanv]]), steps=4) is None
+    assert cluster.shard_shift() == 0
+
+
+def test_capped_sink_keeps_mtime_heartbeat(tmp_path):
+    """A sink that hit MXTPU_TELEMETRY_MAX_MB appends nothing ever
+    again, but keeps touching the file's mtime at the flush cadence —
+    the supervisor liveness tier watches (size, mtime), so a
+    healthy-but-capped child is never liveness-killed in a loop."""
+    import time as _time
+    p = tmp_path / 'capped.jsonl'
+    sink = tele_export.JsonlSink(str(p), max_bytes=1)
+    sink.emit({'type': 'x'})            # trips the cap
+    assert sink._capped
+    size0 = os.path.getsize(p)
+    os.utime(p, (1.0, 1.0))             # pretend the file is ancient
+    sink._last_flush = _time.time() - 60
+    sink.emit({'type': 'y'})            # dropped, but heartbeats
+    st = os.stat(p)
+    assert st.st_mtime > 1.0, 'capped sink must keep the mtime fresh'
+    assert st.st_size == size0, 'the cap contract (no growth) holds'
+    sink.close()
+
+
+def test_elastic_disables_on_unshardable_iterator(elastic_on, caplog):
+    """A single-shard iterator can never be re-balanced: the first
+    apply warns once and DISABLES the elastic tier, so sync rounds stop
+    deciding (and logging/gauging) shifts that can never move data."""
+    nanv = float('nan')
+    cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [20.0, 60.0, 0.0, 0.0, nanv]]),
+        steps=4)
+    it = _ShardIter(num_parts=1, part_index=0)
+    with caplog.at_level(logging.WARNING):
+        assert cluster.apply_shard_shift(it) is None
+    assert 'single shard' in caplog.text
+    assert not cluster.elastic_enabled()
+    # no further decisions, ever
+    assert cluster._elastic_decide(np.array(
+        [[10.0, 2.0, 0.0, 0.0, nanv], [20.0, 60.0, 0.0, 0.0, nanv]]),
+        steps=8) is None
+    assert cluster.shard_shift() == 1   # frozen where it was

@@ -74,6 +74,8 @@ def _reconstruct_health(records):
     last_anomaly = None
     input_bound = None
     restarts = 0
+    hangs = 0
+    last_hang = None
     for r in records:
         typ = r.get('type')
         if typ == 'health' and r.get('event') == 'nonfinite':
@@ -91,13 +93,24 @@ def _reconstruct_health(records):
             # train_supervisor); the supervisor's final summary record
             # repeats the attempt count, so it does not count again
             restarts += 1
+        elif typ == 'hang':
+            # the watchdog's stall incident (a crashed/aborted run's
+            # most important record): count them all, keep the last
+            # digest minus the stack dump (the table is a summary —
+            # the full stacks stay greppable in the raw log)
+            hangs += 1
+            last_hang = {k: v for k, v in r.items()
+                         if k not in ('type', 't', 'stacks')}
     if not incidents and not anomaly_counts and input_bound is None \
-            and not restarts:
+            and not restarts and not hangs:
         return None
     out = {'nonfinite_steps': len(incidents), 'incidents': incidents[:8],
            'anomaly_counts': anomaly_counts, 'last_anomaly': last_anomaly}
     if restarts:
         out['restarts'] = restarts
+    if hangs:
+        out['hangs'] = hangs
+        out['last_hang'] = last_hang
     if input_bound is not None:
         out['input_bound_pct'] = input_bound
     return out
@@ -177,6 +190,13 @@ def _summary_parts(records):
                                      'anomaly_counts': {}})
             health['restarts'] = max(int(health.get('restarts') or 0),
                                      restarts)
+        hangs = sum(1 for r in records if r.get('type') == 'hang')
+        if hangs:
+            # same shape for hang incidents: a watchdog-aborted child's
+            # hang record precedes the RELAUNCHED child's clean summary
+            health = dict(health or {'nonfinite_steps': 0, 'incidents': [],
+                                     'anomaly_counts': {}})
+            health['hangs'] = max(int(health.get('hangs') or 0), hangs)
         return (s.get('snapshot') or {}, s.get('elapsed_s'),
                 s.get('programs'), health,
                 s.get('cluster') or cluster,

@@ -117,11 +117,26 @@ def render(summary, steps_per_s=None):
                         (g.get('xla.peak_bytes_in_use')
                          or g['xla.bytes_in_use']) / 2.0**20))
     hs = summary.get('health')
-    if hs is not None:
-        bad = int(hs.get('nonfinite_steps') or 0)
+    # hang / restart / elastic events render on the health line even
+    # when the sentinel plane (MXTPU_HEALTH) is off — they live in
+    # plain counters/gauges, so both the HTTP and JSONL modes see them
+    restarts = int(c.get('health.restarts')
+                   or (hs or {}).get('restarts') or 0)
+    hangs = int(c.get('watchdog.hangs') or (hs or {}).get('hangs') or 0)
+    shift = g.get('cluster.elastic_shift')
+    if hs is not None or restarts or hangs or shift:
+        bad = int((hs or {}).get('nonfinite_steps') or 0)
         status = 'ok' if not bad else 'DEGRADED (%d non-finite steps)' % bad
-        lines.append('  health       %s' % status)
-        last = hs.get('last_anomaly')
+        bits = [status]
+        if hangs:
+            bits.append('%d hang%s' % (hangs, 's' if hangs != 1 else ''))
+        if restarts:
+            bits.append('%d restart%s' % (restarts,
+                                          's' if restarts != 1 else ''))
+        if shift:
+            bits.append('shard shift %d' % int(shift))
+        lines.append('  health       %s' % ', '.join(bits))
+        last = (hs or {}).get('last_anomaly')
         if last:
             lines.append('  last_anomaly %s=%s (baseline %s)'
                          % (last.get('detector', '?'),

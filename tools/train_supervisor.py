@@ -13,6 +13,15 @@ lasts, relaunches it after an unclean exit::
     MXTPU_RESTART_MAX=5 MXTPU_RESTART_BACKOFF=10 \
         python tools/train_supervisor.py --log sup.jsonl -- python train.py
 
+Liveness tier (``--liveness`` / MXTPU_SUPERVISOR_LIVENESS): a child can
+hang without dying — a collective waiting on a lost peer wedges every
+thread, including the one that would notice. The in-process watchdog
+(MXTPU_WATCHDOG_SECS, telemetry/watchdog.py) aborts most of those with
+the distinct exit code 85; for a child too wedged even for that, the
+supervisor watches the child's telemetry JSONL for growth and
+SIGTERM/SIGKILLs + relaunches when it stalls past the threshold, against
+the same restart budget.
+
 Restart-from-last-good comes for free: the child is expected to run
 with ``MXTPU_CKPT_DIR``/``MXTPU_CKPT_EVERY`` set (the supervisor warns
 when they are not), so each relaunch resumes from the newest
@@ -40,6 +49,14 @@ _BACKOFF_CAP_S = 60.0
 
 # exit codes that restarting cannot help: misuse of the CLI itself
 _NO_RETRY_CODES = (2,)
+
+# the in-process hang watchdog's distinct abort code
+# (mxnet_tpu/telemetry/watchdog.py HANG_EXIT_CODE — mirrored here
+# because the supervisor never imports the framework)
+_HANG_EXIT = 85
+
+_LIVENESS_POLL_S = 2.0
+_TERM_GRACE_S = 15.0
 
 
 def _env_int(name, default):
@@ -75,14 +92,80 @@ def _describe(code):
             return 'killed by signal %s' % signal.Signals(-code).name
         except ValueError:
             return 'killed by signal %d' % -code
+    if code == _HANG_EXIT:
+        return 'exit code %d (hang watchdog abort)' % code
     return 'exit code %d' % code
 
 
-def run(cmd, restart_max, backoff, log_path, quiet=False):
-    """Supervise one training command; returns its final exit code."""
+def _kill_child(proc):
+    """SIGTERM, a grace period, then SIGKILL; returns the exit code."""
+    proc.terminate()
+    try:
+        return proc.wait(timeout=_TERM_GRACE_S)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return proc.wait()
+
+
+def _wait_with_liveness(proc, path, secs, quiet=False):
+    """Wait for the child, additionally requiring its telemetry JSONL
+    at ``path`` to GROW at least every ``secs`` seconds — the
+    supervisor-side liveness tier for a child too wedged to run its own
+    in-process watchdog (a stuck collective blocks every thread that
+    could observe a timer; file growth stops, and only an outside
+    process can act). Returns (exit_code, timed_out). The child's sink
+    flushes at least every few seconds (telemetry/export.py
+    _FLUSH_SECS), so buffering cannot masquerade as a hang — and a sink
+    that hit its MXTPU_TELEMETRY_MAX_MB cap stops GROWING for good but
+    keeps touching the file's mtime at the same cadence, so the stat
+    here watches (size, mtime), not size alone: a healthy-but-capped
+    child is never liveness-killed."""
+    def _stat():
+        try:
+            st = os.stat(path)
+            return st.st_size, st.st_mtime
+        except OSError:
+            return None   # not created yet
+
+    last_stat = _stat()
+    last_change = time.time()
+    # arm at the FIRST observed change (the in-process watchdog's
+    # arm-at-first-mark rule): a child that never writes the file at
+    # all — telemetry accidentally off, path misconfigured — degrades
+    # to plain restart-on-exit supervision instead of a kill-and-
+    # relaunch loop of healthy children. The long quiet stretch AFTER
+    # the start record (first XLA compile) is still on the operator:
+    # the threshold must exceed it (docs/reliability.md).
+    armed = False
+    while True:
+        try:
+            return proc.wait(timeout=_LIVENESS_POLL_S), False
+        except subprocess.TimeoutExpired:
+            pass
+        stat = _stat()
+        if stat != last_stat:
+            last_stat = stat
+            last_change = time.time()
+            armed = True
+        elif armed and time.time() - last_change > secs:
+            if not quiet:
+                print('train_supervisor: child wrote no telemetry '
+                      'records for %.0fs (liveness %.0fs) — killing the '
+                      'wedged child' % (time.time() - last_change, secs),
+                      file=sys.stderr)
+            return _kill_child(proc), True
+
+
+def run(cmd, restart_max, backoff, log_path, quiet=False,
+        liveness=0.0, liveness_path=None):
+    """Supervise one training command; returns its final exit code.
+    ``liveness`` > 0 additionally kills + relaunches a child whose
+    telemetry JSONL (``liveness_path``) stops growing for that many
+    seconds — the tier for a child too wedged to self-abort."""
     attempts = 0
     while True:
         t0 = time.time()
+        timed_out = False
         try:
             proc = subprocess.Popen(cmd)
         except OSError as e:
@@ -90,7 +173,11 @@ def run(cmd, restart_max, backoff, log_path, quiet=False):
                   % (cmd[0], e), file=sys.stderr)
             return 127
         try:
-            code = proc.wait()
+            if liveness > 0 and liveness_path:
+                code, timed_out = _wait_with_liveness(
+                    proc, liveness_path, liveness, quiet=quiet)
+            else:
+                code = proc.wait()
         except KeyboardInterrupt:
             # the operator wants the run down: forward and stop —
             # an interactive stop is never a fault to retry
@@ -105,7 +192,7 @@ def run(cmd, restart_max, backoff, log_path, quiet=False):
                                'exit_code': code})
             return code
         elapsed = time.time() - t0
-        if code == 0:
+        if code == 0 and not timed_out:
             if attempts and not quiet:
                 print('train_supervisor: run completed after %d restart(s)'
                       % attempts, file=sys.stderr)
@@ -113,7 +200,11 @@ def run(cmd, restart_max, backoff, log_path, quiet=False):
                                'final': True, 'reason': 'clean_exit',
                                'exit_code': 0})
             return 0
-        if code in _NO_RETRY_CODES or attempts >= restart_max:
+        # a liveness kill is NEVER a clean exit, whatever code the
+        # child's SIGTERM handler chose (save-and-exit-0 is common):
+        # the run was wedged mid-training and must relaunch
+        if (code in _NO_RETRY_CODES and not timed_out) \
+                or attempts >= restart_max:
             _record(log_path, {'type': 'restart', 'attempt': attempts,
                                'final': True, 'reason': 'budget_exhausted'
                                if code not in _NO_RETRY_CODES else 'usage',
@@ -122,11 +213,13 @@ def run(cmd, restart_max, backoff, log_path, quiet=False):
                 print('train_supervisor: giving up after %d attempt(s) '
                       '(%s)' % (attempts + 1, _describe(code)),
                       file=sys.stderr)
-            return code
+            # never report success for a run abandoned mid-training
+            return code if not (timed_out and code == 0) else 1
         attempts += 1
         delay = min(_BACKOFF_CAP_S, backoff * (2.0 ** (attempts - 1)))
         _record(log_path, {'type': 'restart', 'attempt': attempts,
-                           'reason': 'process_exit',
+                           'reason': 'liveness_timeout' if timed_out
+                           else 'process_exit',
                            'message': _describe(code), 'exit_code': code,
                            'elapsed_s': round(elapsed, 1),
                            'backoff_s': delay})
@@ -162,6 +255,12 @@ def main(argv=None):
     p.add_argument('--log', default=None,
                    help='JSONL file for restart records (default: the '
                         "child's MXTPU_TELEMETRY_PATH when set)")
+    p.add_argument('--liveness', type=float, default=None,
+                   help='kill + relaunch the child when its telemetry '
+                        'JSONL stops growing for this many seconds — '
+                        'the tier for a child too wedged to self-abort '
+                        '(default: MXTPU_SUPERVISOR_LIVENESS or 0 = off; '
+                        'needs the child run with MXTPU_TELEMETRY=1)')
     p.add_argument('--quiet', action='store_true',
                    help='suppress supervisor stderr chatter')
     p.add_argument('cmd', nargs=argparse.REMAINDER,
@@ -177,12 +276,22 @@ def main(argv=None):
     backoff = args.backoff if args.backoff is not None \
         else _env_float('MXTPU_RESTART_BACKOFF', 2.0)
     log_path = args.log or os.environ.get('MXTPU_TELEMETRY_PATH')
+    liveness = args.liveness if args.liveness is not None \
+        else _env_float('MXTPU_SUPERVISOR_LIVENESS', 0.0)
+    liveness_path = os.environ.get('MXTPU_TELEMETRY_PATH')
+    if liveness > 0 and not liveness_path:
+        print('train_supervisor: --liveness needs the child run with '
+              'MXTPU_TELEMETRY=1 and MXTPU_TELEMETRY_PATH set (the '
+              'liveness signal is that file growing) — liveness '
+              'disabled', file=sys.stderr)
+        liveness = 0.0
     if not args.quiet and not os.environ.get('MXTPU_CKPT_DIR'):
         print('train_supervisor: MXTPU_CKPT_DIR is not set — restarts '
               'will rerun from epoch 0 (set MXTPU_CKPT_DIR and '
               'MXTPU_CKPT_EVERY so relaunches resume from the last-good '
               'checkpoint)', file=sys.stderr)
-    return run(cmd, restart_max, backoff, log_path, quiet=args.quiet)
+    return run(cmd, restart_max, backoff, log_path, quiet=args.quiet,
+               liveness=liveness, liveness_path=liveness_path)
 
 
 if __name__ == '__main__':
