@@ -653,6 +653,112 @@ def run_infer_bench(platform, kind):
     return out
 
 
+def run_sharded_update_ab(platform):
+    """Sharded-vs-replicated weight-update A/B (MXTPU_SHARDED_UPDATE,
+    arXiv:2004.13336) through the REAL Module.fit fused window over a
+    dp mesh of all local devices. Only meaningful at dp > 1 (returns
+    None otherwise — the ZeRO layout is a documented no-op at dp=1).
+    Per arm: one warm fit (compiles the window), then two timed
+    epochs; the per-device optimizer-state footprint comes off the
+    update.opt_state_bytes_per_device gauge the loop publishes, and
+    the update collectives' traffic off the roofline's per-opcode
+    accounting for the sharded arm's window program. MXTPU_BENCH_AB_*
+    env knobs size the probe model."""
+    import jax
+    ndev = len(jax.devices())
+    if ndev < 2:
+        _log('sharded-update A/B skipped: dp=1 (single device)')
+        return None
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as _tele
+    from mxnet_tpu.config import flags as _flags
+
+    hidden = int(os.environ.get('MXTPU_BENCH_AB_HIDDEN', '512'))
+    feat = int(os.environ.get('MXTPU_BENCH_AB_FEATURES', '64'))
+    batch = 8 * ndev
+    windows_per_epoch = 4
+    saved = {v: os.environ.get(v) for v in
+             ('MXTPU_SHARDED_UPDATE', 'MXTPU_FIT_STEPS_PER_CALL')}
+    os.environ['MXTPU_FIT_STEPS_PER_CALL'] = '4'
+    _flags.reload('MXTPU_FIT_STEPS_PER_CALL')
+    n = batch * 4 * windows_per_epoch
+    ctx_fn = mx.tpu if platform.startswith('tpu') else mx.cpu
+    ctxs = [ctx_fn(i) for i in range(ndev)]
+    res = {}
+    try:
+        for arm, flag in (('replicated', '0'), ('sharded', '1')):
+            os.environ['MXTPU_SHARDED_UPDATE'] = flag
+            _flags.reload('MXTPU_SHARDED_UPDATE')
+            mx.random.seed(11)
+            rng = np.random.RandomState(11)
+            # distinct symbol names per arm -> distinct program records
+            # in the registrar/roofline (the merge rule would otherwise
+            # keep whichever variant parsed larger)
+            name = 'ab_%s' % arm
+            data = mx.sym.Variable('data')
+            h = mx.sym.Activation(mx.sym.FullyConnected(
+                data, num_hidden=hidden, name='%s_fc1' % name),
+                act_type='relu')
+            h = mx.sym.Activation(mx.sym.FullyConnected(
+                h, num_hidden=hidden, name='%s_fc2' % name),
+                act_type='relu')
+            sym = mx.sym.SoftmaxOutput(mx.sym.FullyConnected(
+                h, num_hidden=16, name='%s_fc3' % name), name=name)
+            X = rng.standard_normal((n, feat)).astype(np.float32)
+            y = (rng.rand(n) * 16).astype(int).astype(np.float32)
+            it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
+                                   label_name='%s_label' % name)
+            mod = mx.mod.Module(sym, context=ctxs,
+                                label_names=('%s_label' % name,))
+            okw = dict(optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.01),
+                                         ('momentum', 0.9)),
+                       kvstore='device', eval_metric='acc')
+            t = time.perf_counter()
+            mod.fit(it, num_epoch=1, **okw)      # compile + warm
+            _log('sharded-update A/B %s warmup: %.1fs'
+                 % (arm, time.perf_counter() - t))
+            t0 = time.perf_counter()
+            mod.fit(it, begin_epoch=1, num_epoch=3, **okw)
+            dt = time.perf_counter() - t0
+            g = _tele.snapshot()['gauges'] if _tele.enabled() else {}
+            loop = mod.__dict__.get('_fused_fit_cache')
+            res[arm] = {
+                'img_s': round(2 * n / dt, 2),
+                'opt_state_bytes_per_device':
+                    int(g['update.opt_state_bytes_per_device'])
+                    if 'update.opt_state_bytes_per_device' in g else None,
+                'engaged': bool(loop is not None
+                                and loop[1]._zero is not None)}
+            _log('sharded-update A/B %s: %.2f img/s, opt state '
+                 '%s B/device' % (arm, res[arm]['img_s'],
+                                  res[arm]['opt_state_bytes_per_device']))
+        comm = _tele.roofline.comm_bytes_by_op('fused_fit.window[ab_sharded')
+        upd_comm = sum(v for k, v in comm.items()
+                       if k.startswith(('reduce-scatter', 'all-gather')))
+    finally:
+        for var, old in saved.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+            _flags.reload(var)
+    r0, r1 = res['replicated'], res['sharded']
+    ab = {'dp': ndev, 'batch': batch, 'hidden': hidden,
+          'replicated_img_s': r0['img_s'], 'sharded_img_s': r1['img_s'],
+          'sharded_speedup': round(r1['img_s'] / max(r0['img_s'], 1e-9), 3),
+          'sharded_engaged': r1['engaged'],
+          'opt_state_bytes_per_device': r1['opt_state_bytes_per_device'],
+          'opt_state_bytes_per_device_replicated':
+              r0['opt_state_bytes_per_device']}
+    if upd_comm:
+        # per-step bytes the sharded update moves between chips
+        # (reduce-scatter'd grads + all-gather'd params; CPU lowerings
+        # without the reduce-scatter pass show the all-gather half)
+        ab['update_comm_bytes'] = round(upd_comm, 1)
+    return ab
+
+
 def _telemetry_breakdown(device, step_ms=None):
     """The dispatch/compile breakdown + peak device bytes from the
     telemetry registry, as a JSON-ready dict (None when telemetry is
@@ -685,6 +791,14 @@ def _telemetry_breakdown(device, step_ms=None):
             tel['peak_device_bytes'] = int(g['xla.peak_bytes_in_use'])
         if 'xla.bytes_in_use' in g:
             tel['live_device_bytes'] = int(g['xla.bytes_in_use'])
+        # sharded weight update (ISSUE 9): the per-device optimizer-
+        # state footprint the fused loop published, when a Module fit
+        # ran in this process BEFORE this fold (the A/B probe runs
+        # after it, so its gauges land only in out['sharded_update_ab'])
+        if 'update.opt_state_bytes_per_device' in g:
+            tel['opt_state_bytes_per_device'] = \
+                int(g['update.opt_state_bytes_per_device'])
+            tel['sharded_update'] = bool(g.get('update.sharded'))
         # training-health counts (ISSUE 4): anomalies / non-finite
         # steps seen by the sentinels, when MXTPU_HEALTH ran
         hc = {n[len('health.'):]: int(v) for n, v in c.items()
@@ -984,6 +1098,28 @@ def main():
         devices[0], step_ms=dt / (bench_steps * STEPS_PER_CALL) * 1e3)
     if tel:
         out['telemetry'] = tel
+    # sharded-vs-replicated weight-update A/B (MXTPU_SHARDED_UPDATE):
+    # only runs at dp > 1, and AFTER the telemetry fold above so the
+    # probe model's compiles/programs/roofline never contaminate the
+    # headline's telemetry block (the infer probe follows the same
+    # rule); a failure must never cost the headline number
+    sharded_ab = None
+    if os.environ.get('MXTPU_BENCH_SHARDED_AB', '1') != '0':
+        try:
+            sharded_ab = run_sharded_update_ab(platform)
+        except Exception as e:  # noqa: BLE001
+            _log('sharded-update A/B failed (headline unaffected): %s' % e)
+    if sharded_ab:
+        out['sharded_update_ab'] = sharded_ab
+        # top-level copies of the gated/ledger metrics: per-device
+        # optimizer-state bytes with the sharded update ON (the
+        # tools/bench_diff.py gate reads this) and the update
+        # collectives' per-step traffic
+        if sharded_ab.get('opt_state_bytes_per_device') is not None:
+            out['opt_state_bytes_per_device'] = \
+                sharded_ab['opt_state_bytes_per_device']
+        if sharded_ab.get('update_comm_bytes') is not None:
+            out['update_comm_bytes'] = sharded_ab['update_comm_bytes']
     # inference tier (ISSUE 2): fused Module.predict vs the per-batch
     # path, printed BEFORE the training line — the LAST line stays the
     # authoritative training number, and a failure here can never lose

@@ -400,12 +400,18 @@ flags.declare('MXTPU_COMPILE_CACHE', str, '',
               'the 20-40s XLA compile; telemetry counts served '
               'compiles under xla.cache_hits')
 flags.declare('MXTPU_SHARDED_UPDATE', bool, True,
-              'Cross-replica weight-update sharding in the SPMD fused '
-              'fit window (arXiv:2004.13336): grads reduce-scatter, '
-              'each replica updates 1/dp of every dividing param, '
-              'weights all-gather — update HBM traffic and optimizer '
-              'math scale down by the dp factor; 0 keeps the '
-              'replicated update')
+              'ZeRO-style sharded weight update in the SPMD fused-fit '
+              'window (arXiv:2004.13336): grads reduce-scatter, each '
+              'replica updates 1/dp of EVERY param (leaves flattened '
+              'and zero-padded to a multiple of dp), weights '
+              'all-gather — optimizer state + master params live '
+              'dp-sharded between windows, so their per-device bytes '
+              'drop ~dp x (update.opt_state_bytes_per_device gauge). '
+              'Engages only with an SPMD dp mesh (dp > 1) and the '
+              'module not opted out (module.sharded_update = False); '
+              'anywhere else the update runs replicated (warn-once '
+              'when the flag was set explicitly). 0 keeps the '
+              'replicated update everywhere')
 flags.declare('MXTPU_BN_ONEPASS', bool, False,
               'BatchNorm training stats via one-pass moments '
               '(sum/sum-of-squares in one fused HBM read of the '

@@ -279,6 +279,16 @@ class BaseModule:
             from .fused_fit import FusedFitLoop
             fused = FusedFitLoop.build_cached(self, eval_metric,
                                               logger=self.logger)
+        if fused is None:
+            # flag honesty: an explicitly-requested MXTPU_SHARDED_UPDATE
+            # can only engage inside the fused SPMD window — the
+            # per-batch reference loop below updates replicated
+            from .fused_fit import (_shard_update_requested,
+                                    note_replicated_update)
+            if _shard_update_requested():
+                note_replicated_update(
+                    'the per-batch reference loop is running '
+                    '(no fused window built)', site='fit')
         # training-health sentinels (telemetry/health): the per-batch
         # loop feeds the step-time spike detector; the in-graph
         # finite/norm sentinels ride the executor's fwd+bwd program.

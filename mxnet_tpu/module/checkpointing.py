@@ -199,10 +199,21 @@ class TrainCheckpointer:
         """(structure, arrays): the optimizer-state tree flattened into
         deterministically-named array leaves. ``copy`` guards against
         the fused window's buffer donation; the template pass (restore)
-        walks the same order with copy=False."""
+        walks the same order with copy=False.
+
+        A leaf the fused loop holds in the ZeRO update-phase form
+        (flat, zero-padded, dp-sharded — fused_fit's sharded weight
+        update) is captured AS STORED — each host writes only its own
+        shards — and its structure entry becomes
+        ``{'k': 'opt.N', 'shape': [canonical...]}`` so a restore,
+        possibly onto a different dp, can reshape it back. Plain string
+        entries stay the format for canonical leaves (and are what old
+        checkpoints hold)."""
         import jax.numpy as jnp
         self._ensure_opt_states()
         upd = self._updater()
+        from .fused_fit import zero_shape_probe
+        probe = zero_shape_probe(self.module)
         arrays = {}
         counter = [0]
 
@@ -214,6 +225,15 @@ class TrainCheckpointer:
             k = 'opt.%d' % counter[0]
             counter[0] += 1
             arrays[k] = jnp.copy(v._data) if copy else v._data
+            zshape = probe(v) if probe is not None else None
+            if zshape is not None:
+                if getattr(probe, 'row', None) is not None:
+                    # relabel the (equivalent) jit-output GSPMDSharding
+                    # onto the canonical NamedSharding: same shards,
+                    # but orbax can serialize it without warning
+                    import jax
+                    arrays[k] = jax.device_put(arrays[k], probe.row)
+                return {'k': k, 'shape': list(zshape)}
             return k
 
         structure = [[n, enc(upd.states[self._upd_keys[n]])]
@@ -638,6 +658,43 @@ class TrainCheckpointer:
         return tree
 
     @staticmethod
+    def _iter_zero_encs(structure):
+        """Every ZeRO-layout leaf annotation dict in an opt_structure."""
+        def walk(enc):
+            if isinstance(enc, dict):
+                yield enc
+            elif isinstance(enc, list):
+                for e in enc:
+                    yield from walk(e)
+        for _name, enc in structure or []:
+            yield from walk(enc)
+
+    def _override_zero_template(self, template, meta):
+        """Leaves saved in the ZeRO update-phase form carry their
+        canonical shape in the structure enc; the restore template must
+        target the SAVED flat global shape (mesh-independent — a dp
+        change between save and restore only changes the SHARDING and
+        possibly the pad length, and the canonical shape check in
+        :meth:`_apply` is the real drift gate). This is what makes a
+        dp-resharding of an opt leaf a valid reshard instead of the
+        shape-drift older/fresh fallback."""
+        import jax
+        opt = template.get('opt')
+        if not opt:
+            return
+        saved_shapes = meta.get('shapes') or {}
+        for enc in self._iter_zero_encs(meta.get('opt_structure')):
+            k = enc.get('k')
+            saved = saved_shapes.get('opt/%s' % k)
+            live = opt.get(k)
+            if saved is None or live is None:
+                continue
+            if tuple(saved) != tuple(live.shape):
+                opt[k] = jax.ShapeDtypeStruct(
+                    tuple(saved), live.dtype,
+                    sharding=getattr(live, 'sharding', None))
+
+    @staticmethod
     def _annotate_opt_leaves(msg, meta):
         """Map the anonymous ``opt/opt.N`` leaf paths in a shape-
         mismatch message back to the parameter each state leaf belongs
@@ -651,6 +708,9 @@ class TrainCheckpointer:
             if isinstance(enc, list):
                 for e in enc:
                     walk(e, name)
+                return
+            if isinstance(enc, dict):   # ZeRO-layout leaf annotation
+                owners[enc['k']] = name
                 return
             owners[enc] = name
 
@@ -677,6 +737,7 @@ class TrainCheckpointer:
             raise ValueError('unsupported checkpoint format %r'
                              % meta.get('format'))
         template = self._template()
+        self._override_zero_template(template, meta)
         saved_shapes = meta.get('shapes')
         if saved_shapes:
             try:
@@ -724,6 +785,8 @@ class TrainCheckpointer:
         upd = self._updater()
         opt_arrays = tree.get('opt', {})
         staged = []   # (live state NDArray, restored array)
+        from .fused_fit import zero_shape_probe
+        probe = zero_shape_probe(m)
 
         def stage(struct, live, name):
             # every mismatch names the owning parameter — a restore
@@ -751,6 +814,35 @@ class TrainCheckpointer:
                 raise ValueError(
                     'optimizer state for %s drifted: checkpoint leaf %s '
                     'has no matching live state array' % (name, struct))
+            if isinstance(struct, dict):
+                # ZeRO-layout leaf: the saved array is flat (padded to
+                # the SAVING dp's multiple, dp-sharded at save); the
+                # canonical shape recorded next to it is the drift
+                # gate, and a differing pad length / sharding is a
+                # valid dp-reshard, not drift
+                from ..parallel.sharding import zero_unflatten
+                arr = opt_arrays[struct['k']]
+                shape = tuple(struct['shape'])
+                live_shape = tuple(live._data.shape)
+                z = probe(live) if probe is not None else None
+                if z is not None:
+                    live_shape = tuple(z)
+                if live_shape != shape:
+                    raise ValueError(
+                        'optimizer state for %s drifted: leaf %s saved '
+                        'canonical shape %s vs live %s'
+                        % (name, struct['k'], shape, live_shape))
+                n_elem = 1
+                for d in shape:
+                    n_elem *= int(d)
+                if getattr(arr, 'ndim', 0) != 1 or int(arr.shape[0]) < n_elem:
+                    raise ValueError(
+                        'optimizer state for %s drifted: leaf %s holds '
+                        '%s elements, canonical shape %s needs %d'
+                        % (name, struct['k'], tuple(arr.shape), shape,
+                           n_elem))
+                staged.append((live, zero_unflatten(arr, shape)))
+                return
             arr = opt_arrays[struct]
             if tuple(arr.shape) != tuple(live._data.shape):
                 raise ValueError(
@@ -828,6 +920,10 @@ class TrainCheckpointer:
         return scaled
 
     def _try_resume(self):
+        # a fused loop cached from a previous fit() may hold ZeRO-layout
+        # state: restore validates/applies against the canonical layout
+        from .fused_fit import flush_sharded_states
+        flush_sharded_states(self.module)
         steps = self._ckpt.all_steps(self._mngr)
         if not steps:
             return

@@ -370,6 +370,16 @@ class SPMDExecutorGroup:
         return NamedSharding(mesh, P(*((None, 'dp') + (None,) * (ndim - 2))))
 
     @staticmethod
+    def update_sharding(mesh):
+        """NamedSharding for an update-phase leaf (the ZeRO layout of
+        arXiv:2004.13336): optimizer-state tensors flattened to 1-D and
+        padded to a multiple of dp (parallel/sharding.zero_flatten) are
+        row-sharded over the dp axis, so each device owns — and
+        updates — exactly 1/dp of every leaf. The companion of
+        :meth:`window_sharding` for the fused window's carried state."""
+        return NamedSharding(mesh, P('dp'))
+
+    @staticmethod
     def eligible(contexts, workload, batch_size, symbol):
         from ..config import flags as _flags
         _flags.reload('MXTPU_NO_SPMD_MODULE')  # tests toggle it per-case

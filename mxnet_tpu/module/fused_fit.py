@@ -79,6 +79,79 @@ def _shard_update_enabled():
     return flags.get('MXTPU_SHARDED_UPDATE')
 
 
+def _shard_update_requested():
+    """True only when MXTPU_SHARDED_UPDATE is EXPLICITLY set truthy in
+    the environment. The flag defaults on, so the flag-honesty warning
+    below must not fire on every unconfigured single-device run — only
+    when someone asked for the sharded update and is not getting it."""
+    import os
+    return os.environ.get('MXTPU_SHARDED_UPDATE') is not None \
+        and _shard_update_enabled()
+
+
+_replicated_warned = set()
+
+
+def note_replicated_update(reason, site='fused_fit'):
+    """Flag-honesty warning, once per (site, reason) per process:
+    MXTPU_SHARDED_UPDATE was explicitly requested but the update about
+    to run is REPLICATED — full optimizer state on every device. The
+    sharded path engages only on the SPMD fused-fit window with dp > 1
+    and the module not opted out (docs/env_vars.md)."""
+    key = (site, reason)
+    if key in _replicated_warned:
+        return
+    _replicated_warned.add(key)
+    logging.warning(
+        'MXTPU_SHARDED_UPDATE is set but the %s update runs REPLICATED '
+        '(%s): every device materializes the full optimizer state. The '
+        'sharded update (arXiv:2004.13336) engages only inside the SPMD '
+        'fused-fit window with dp > 1 — see MXTPU_SHARDED_UPDATE in '
+        'docs/env_vars.md', site, reason)
+
+
+def flush_sharded_states(module):
+    """Materialize any optimizer-state leaves the module's cached fused
+    loop holds in the ZeRO update-phase layout (flat, padded,
+    dp-sharded) back to their canonical shapes. Safe no-op when there
+    is no cached loop or the sharded update never engaged — callers
+    (save/load_optimizer_states, checkpoint restore, the tail path)
+    need the canonical layout without caring how training ran."""
+    cached = module.__dict__.get('_fused_fit_cache')
+    if cached is not None:
+        cached[1].flush_zero_states()
+
+
+def zero_shape_probe(module):
+    """``probe(state_wrapper) -> canonical shape | None`` for the
+    module's cached fused loop, or None when no loop holds ZeRO-layout
+    state. module/checkpointing.py calls the probe on every state
+    wrapper it walks: a non-None answer means the wrapper's array is
+    currently in the update-phase form (flat, padded, dp-sharded) and
+    the checkpoint must record the canonical shape next to it so a
+    restore — possibly onto a different dp — can reshape it back."""
+    cached = module.__dict__.get('_fused_fit_cache')
+    if cached is None:
+        return None
+    loop = cached[1]
+    if loop._zero is None:
+        return None
+    # snapshot the wrapper->shape map NOW, from the live wrappers the
+    # caller is about to walk (id() keys are only valid against these
+    # exact objects — see zero_wrapper_shapes)
+    shapes = loop.zero_wrapper_shapes()
+    if not shapes:
+        return None
+
+    def probe(wrapper):
+        return shapes.get(id(wrapper))
+    # the canonical NamedSharding of the layout: jit outputs carry an
+    # equivalent GSPMDSharding that orbax cannot serialize (it warns
+    # per leaf per save) — the checkpoint walk relabels onto this
+    probe.row = loop._zero['row']
+    return probe
+
+
 def _mirror_flag():
     from ..config import flags
     flags.reload('MXTPU_BACKWARD_DO_MIRROR')
@@ -105,6 +178,19 @@ def updater_keys(module, grad_names):
         return {n: _updater_key(n) for n in grad_names}
     pnames = module._exec_group.param_names
     return {n: pnames.index(n) for n in grad_names}
+
+
+def _walk_state_wrappers(st):
+    """The NDArray state wrappers inside one optimizer-state entry, in
+    the same traversal order module/checkpointing._walk_opt uses."""
+    if st is None:
+        return []
+    if isinstance(st, tuple):
+        out = []
+        for s in st:
+            out.extend(_walk_state_wrappers(s))
+        return out
+    return [st]
 
 
 def ensure_opt_states(module, grad_names, upd_keys, arg_dict):
@@ -318,6 +404,50 @@ class FusedFitLoop:
         self._health_fn = health_sentinel()
         self._upd_keys = updater_keys(module, self._grad_names)
         self._ensure_states()
+        # ZeRO-style sharded weight update (arXiv:2004.13336): on an
+        # SPMD group with dp > 1, optimizer state lives in the
+        # update-phase form — every leaf flat, zero-padded to a
+        # multiple of dp, row-sharded over the dp axis — persistently
+        # across windows (donated in place through the scan carry), so
+        # per-device optimizer/master-param memory drops by ~dp x.
+        # Inside the window body: reduce-scatter(grads) -> shard-local
+        # update -> all-gather(params). self._zero is None on the
+        # documented fallback (flag off, dp == 1, no mesh, or the
+        # module opted out via `module.sharded_update = False`) — the
+        # replicated update then lowers byte-identically to the
+        # pre-sharding program.
+        self._zero = None
+        self._update_gauged = False
+        dp = int(self._mesh.shape['dp']) if self._mesh is not None else 1
+        if _shard_update_enabled() and getattr(module, 'sharded_update',
+                                               True) and dp > 1:
+            from .executor_group import SPMDExecutorGroup
+            self._zero = {'dp': dp,
+                          'row': SPMDExecutorGroup.update_sharding(
+                              self._mesh)}
+            # canonical (pre-flatten) shape/dtype per state leaf, in
+            # state_arrays (op-input) order — the snapshot/flush paths
+            # and the per-device-bytes gauge key on it
+            self._zero_shapes = {
+                n: [(tuple(a.shape), a.dtype)
+                    for a in self._state_arrays(n)]
+                for n in self._grad_names}
+            # ...and in raw-tuple WALK order (differs from the op-input
+            # order for multi-precision plans): the checkpoint walk
+            # traverses the raw state tuples and maps canonical shapes
+            # per wrapper (zero_wrapper_shapes) — keyed name+position
+            # so it survives wrapper replacement (set_states /
+            # load_optimizer_states)
+            upd = self._updater_obj()
+            self._zero_walk_shapes = {
+                n: [tuple(w._data.shape) for w in _walk_state_wrappers(
+                    upd.states[self._upd_keys[n]])]
+                for n in self._grad_names}
+        elif _shard_update_requested():
+            note_replicated_update(
+                'module opted out (sharded_update=False)'
+                if self._mesh is not None and dp > 1
+                else 'no SPMD mesh / dp axis is 1')
 
     # -- reuse across fit() calls ------------------------------------------
     @staticmethod
@@ -357,6 +487,9 @@ class FusedFitLoop:
         from ..config import flags
         flags.reload('MXTPU_FUSED_FIT')
         if not flags.get('MXTPU_FUSED_FIT'):
+            # a discarded loop may hold ZeRO-layout optimizer state —
+            # materialize it before the reference loop reads it
+            flush_sharded_states(module)
             module.__dict__.pop('_fused_fit_cache', None)
             return None
         eg = getattr(module, '_exec_group', None)
@@ -375,6 +508,7 @@ class FusedFitLoop:
                        bool(module._update_on_kvstore),
                        getattr(module._kvstore, 'type', None),
                        _window_size(), bool(_shard_update_enabled()),
+                       bool(getattr(module, 'sharded_update', True)),
                        str(_mirror_flag()), msig,
                        # the health sentinels are traced INTO the window
                        # program — flipping MXTPU_HEALTH between fit()
@@ -386,6 +520,10 @@ class FusedFitLoop:
             loop._rebind_metric(eval_metric)
             return loop
         loop = cls.build(module, eval_metric, logger=logger)
+        if loop is None:
+            # falling back to the reference per-batch loop: it updates
+            # against the canonical state layout
+            flush_sharded_states(module)
         if loop is not None and sig is not None:
             module.__dict__['_fused_fit_cache'] = (sig, loop)
         else:
@@ -445,6 +583,10 @@ class FusedFitLoop:
             if est > 256 * 1024 * 1024:
                 return None
             children, fns = None, None
+        # a previously-cached loop (about to be replaced) may hold the
+        # optimizer state in the ZeRO layout: the new loop must read
+        # CANONICAL shapes at construction
+        flush_sharded_states(module)
         loop = FusedFitLoop(module, children, fns, window, oplan)
         logger.info('fused fit fast path active: %d steps/device-call%s',
                     loop.window,
@@ -512,26 +654,37 @@ class FusedFitLoop:
         W = self.window
         mesh = self._mesh
         defer_fn = self._defer_fn   # traced INTO the program (or None)
-        shard_update = _shard_update_enabled() and mesh is not None
+        shard_update = self._zero is not None
         if shard_update:
             from jax.sharding import NamedSharding, PartitionSpec as P
-            dp = mesh.shape['dp']
-            row = NamedSharding(mesh, P('dp'))
+            from ..parallel.sharding import zero_flatten, zero_unflatten
+            dp = self._zero['dp']
+            row = self._zero['row']
             rep = NamedSharding(mesh, P())
 
-            def to_shards(t):
-                """Constrain a tensor to row-sharding over dp for the
-                weight update when its leading dim divides dp (the
-                cross-replica weight-update sharding of
-                arXiv:2004.13336: the grad's all-reduce becomes a
-                reduce-scatter, each replica updates 1/dp of the
-                param, and the write-back all-gathers)."""
-                if t.ndim >= 1 and t.shape[0] % dp == 0:
-                    return jax.lax.with_sharding_constraint(t, row)
-                return t
+            def to_update_form(t):
+                """Weight/grad -> the update-phase form: flat, zero-
+                padded to a multiple of dp, row-sharded (every leaf
+                divides, whatever its shape — the per-leaf padding of
+                arXiv:2004.13336). Constraining the GRADIENT here turns
+                its all-reduce into a reduce-scatter: each replica
+                receives — and updates — only its 1/dp slice."""
+                return jax.lax.with_sharding_constraint(
+                    zero_flatten(t, dp), row)
 
-            def to_replicated(t):
-                return jax.lax.with_sharding_constraint(t, rep)
+            def from_update_form(t, shape):
+                """Fresh weight -> canonical shape, replicated: the
+                all-gather that hands the next forward a whole param."""
+                return jax.lax.with_sharding_constraint(
+                    zero_unflatten(t, shape), rep)
+
+            def pin_state(t):
+                # optimizer states arrive AND leave in the update-phase
+                # form: pinning both body entry and exit keeps the scan
+                # carry's sharding in equilibrium (no per-iteration
+                # reshard) and the jit outputs dp-sharded — the ZeRO
+                # layout the loop holds between windows
+                return jax.lax.with_sharding_constraint(t, row)
 
         def window_fn(params, states, aux, gaccs, data_stack, label_stack,
                       key, lr_arr, wd_arr):
@@ -580,8 +733,9 @@ class FusedFitLoop:
                     w, g = params[ci], grads[j]
                     st = states[j]
                     if shard_update:
-                        w, g = to_shards(w), to_shards(g)
-                        st = tuple(to_shards(s) for s in st)
+                        w_shape = w.shape
+                        w, g = to_update_form(w), to_update_form(g)
+                        st = tuple(pin_state(s) for s in st)
                     # every fused update op returns (w, *states) with
                     # states in input order — application is generic
                     res = ops[modes[n]].fn(attrs, w, g, *st)
@@ -589,11 +743,11 @@ class FusedFitLoop:
                         res = (res,)
                     if shard_update:
                         # only the WEIGHT re-gathers (the next forward
-                        # needs it whole); optimizer states stay
-                        # dp-sharded through the scan carry — the ZeRO
-                        # layout — and the body's to_shards on entry
-                        # keeps the carry's sharding equilibrium
-                        res = (to_replicated(res[0]),) + res[1:]
+                        # needs it whole); optimizer states stay flat +
+                        # dp-sharded through the scan carry and out of
+                        # the program — the ZeRO layout
+                        res = (from_update_form(res[0], w_shape),) + \
+                            tuple(pin_state(s) for s in res[1:])
                     new_params[ci] = res[0]
                     if len(res) > 1:
                         new_states[j] = tuple(res[1:])
@@ -632,6 +786,114 @@ class FusedFitLoop:
         return registered_jit(self._prog_name, window_fn,
                               step_flops=True, donate_argnums=(0, 1, 2, 3))
 
+    # -- ZeRO state layout -------------------------------------------------
+    def zero_wrapper_shapes(self):
+        """{id(state wrapper): canonical shape} for the leaves CURRENTLY
+        in the update-phase form, built FRESH from the live updater
+        walk on every call: wrapper objects can be replaced under the
+        loop (set_states / load_optimizer_states) and CPython recycles
+        id() values, so this map must never be cached across calls —
+        the checkpoint walk builds it immediately before traversing
+        the very same wrappers."""
+        if self._zero is None:
+            return {}
+        from .window_pipeline import is_update_sharded
+        row = self._zero['row']
+        out = {}
+        upd = self._updater_obj()
+        for n in self._grad_names:
+            ws = _walk_state_wrappers(upd.states[self._upd_keys[n]])
+            for w, shape in zip(ws, self._zero_walk_shapes[n]):
+                if is_update_sharded(getattr(w, '_data', None), row):
+                    out[id(w)] = shape
+        return out
+
+    def flush_zero_states(self):
+        """Materialize every state leaf held in the ZeRO update-phase
+        form back to its canonical shape, replicated on the mesh.
+        Runs before anything OUTSIDE the compiled window consumes the
+        states — the per-batch tail path, save/load_optimizer_states,
+        a checkpoint restore. The next window re-shards lazily
+        (place_update_sharded passes converted leaves through), so the
+        cost is one gather per excursion, not per window."""
+        if self._zero is None:
+            return
+        from .window_pipeline import is_update_sharded
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.sharding import zero_unflatten
+        row = self._zero['row']
+        rep = NamedSharding(self._mesh, P())
+        for n in self._grad_names:
+            arrays = self._state_arrays(n)
+            out, changed = [], False
+            for a, (shape, _d) in zip(arrays, self._zero_shapes[n]):
+                if is_update_sharded(a, row):
+                    a = jax.device_put(zero_unflatten(a, shape), rep)
+                    changed = True
+                out.append(a)
+            if changed:
+                self._writeback_state(n, out)
+        # the gauges must flip AS A PAIR: a flush back to the
+        # replicated layout also restores the replicated footprint
+        # (a 'replicated' bit next to the 1/dp byte count would be a
+        # self-contradictory record)
+        _tele.gauge('update.sharded').set(0)
+        _tele.gauge('update.opt_state_bytes_per_device').set(int(sum(
+            int(np.prod(shape)) * np.dtype(dt).itemsize
+            for n in self._grad_names
+            for shape, dt in self._zero_shapes[n])))
+
+    def _prepare_tail(self):
+        """Restore the per-batch update invariant before tail batches
+        run the imperative path: the kvstore machinery keeps its
+        update-side arrays (store weights, updater states) on the
+        CONTEXT device — its reduce lands merged grads there — while
+        the window writeback leaves everything mesh-placed. Only the
+        SPMD path needs this; everywhere else the context device IS the
+        placement. The next epoch's first window re-shards lazily."""
+        if self._mesh is None:
+            return
+        self.flush_zero_states()
+        m = self.module
+        if not m._update_on_kvstore:
+            # local-updater tail: weights/grads/states all live mesh-
+            # replicated (arg_dict pinned at forward, grads from the
+            # SPMD backward, states from the window writeback or the
+            # flush above) — already co-located
+            return
+        dev = self._exec._ctx.jax_device()
+        upd = self._updater_obj()
+        for n in self._grad_names:
+            store = m._kvstore._store.get(n)
+            if store is not None:
+                store._data = jax.device_put(store._data, dev)
+            for w in _walk_state_wrappers(upd.states[self._upd_keys[n]]):
+                w._data = jax.device_put(w._data, dev)
+
+    def _note_update_gauges(self):
+        """Publish the per-device optimizer-state footprint: with the
+        sharded update on, the ZeRO layout's exact ceil(n/dp)/device
+        bytes; otherwise the full replicated bytes — so a sharded-vs-
+        replicated A/B reads the win off one gauge. Published at every
+        snapshot (pure shape arithmetic, no device access) so the pair
+        of gauges tracks every layout transition — a tail flush zeroes
+        them and the next window's re-shard must flip them back."""
+        if self._zero is not None:
+            from ..parallel.sharding import zero_sharded_bytes
+            total = sum(zero_sharded_bytes(shape, dt, self._zero['dp'])
+                        for n in self._grad_names
+                        for shape, dt in self._zero_shapes[n])
+            _tele.gauge('update.sharded').set(1)
+            _tele.gauge('update.dp').set(self._zero['dp'])
+        elif self._update_gauged:
+            return   # replicated layout never transitions
+        else:
+            total = sum(int(a.nbytes) for n in self._grad_names
+                        for a in self._state_arrays(n))
+            _tele.gauge('update.sharded').set(0)
+        self._update_gauged = True
+        _tele.gauge('update.opt_state_bytes_per_device').set(int(total))
+
     # -- per-epoch drive ---------------------------------------------------
     def _snapshot(self):
         e = self._exec
@@ -643,8 +905,27 @@ class FusedFitLoop:
             if self._accum else ()
         if self._mesh is not None:
             from .window_pipeline import place_replicated
-            params, states, aux, gaccs = place_replicated(
-                self._mesh, params, states, aux, gaccs)
+            if self._zero is not None:
+                # optimizer state enters (and stays) in the ZeRO
+                # update-phase form; already-converted leaves pass
+                # through untouched, so this is free in steady state
+                from .window_pipeline import place_update_sharded
+                flat = place_update_sharded(self._mesh, [
+                    (a, shape)
+                    for n, st in zip(self._grad_names, states)
+                    for a, (shape, _d) in zip(st, self._zero_shapes[n])])
+                regrouped, i = [], 0
+                for n in self._grad_names:
+                    k = len(self._zero_shapes[n])
+                    regrouped.append(tuple(flat[i:i + k]))
+                    i += k
+                states = tuple(regrouped)
+                params, aux, gaccs = place_replicated(
+                    self._mesh, params, aux, gaccs)
+            else:
+                params, states, aux, gaccs = place_replicated(
+                    self._mesh, params, states, aux, gaccs)
+        self._note_update_gauges()
         return params, states, aux, gaccs
 
     def _writeback(self, params, states, aux, gaccs):
@@ -985,6 +1266,12 @@ class FusedFitLoop:
                                  pending[2])
         if _timing:
             _tm['fetch'] += _clk() - _t
+        if snaps:
+            # tail batches run the imperative per-batch update: ZeRO
+            # leaves materialize to canonical shapes and the kvstore-
+            # side arrays return to the context device (the per-batch
+            # machinery's placement invariant)
+            self._prepare_tail()
         for ds, ls, pad, idx in snaps:
             # tail (< window): reference per-batch path, on a rebuilt
             # batch (the original's buffers may have been overwritten

@@ -280,12 +280,19 @@ class Module(BaseModule):
         rescale_grad = 1.0 / batch_size
 
         if isinstance(optimizer, str):
+            # the updater keys run i * n_exec + k over the EXECUTOR
+            # list: the SPMD group is ONE logical executor whatever
+            # len(context) says — keying by context count there made
+            # the local-updater keys (and this idx2name map) disagree
+            # with everything keyed per-executor (the fused window's
+            # updater_keys, ensure_opt_states, checkpoint capture)
+            n_exec = len(self._exec_group.execs)
             idx2name = {}
             if update_on_kvstore:
                 idx2name.update(enumerate(self._exec_group.param_names))
             else:
-                for k in range(len(self._context)):
-                    idx2name.update({i * len(self._context) + k: n
+                for k in range(n_exec):
+                    idx2name.update({i * n_exec + k: n
                                      for i, n in enumerate(self._exec_group.param_names)})
             optimizer_params = dict(optimizer_params)
             if 'rescale_grad' not in optimizer_params:
@@ -378,7 +385,9 @@ class Module(BaseModule):
                 _update_params(self._exec_group.param_arrays,
                                self._exec_group.grad_arrays,
                                updater=self._updater,
-                               num_device=len(self._context),
+                               # per-EXECUTOR stride (see init_optimizer):
+                               # the SPMD group updates once per param
+                               num_device=len(self._exec_group.execs),
                                kvstore=self._kvstore,
                                param_names=self._exec_group.param_names)
 
@@ -402,6 +411,10 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        # the fused window may hold state leaves in the ZeRO layout
+        # (flat, dp-sharded) — serialize the canonical shapes
+        from .fused_fit import flush_sharded_states
+        flush_sharded_states(self)
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -410,6 +423,10 @@ class Module(BaseModule):
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
+        # flush first so the load replaces the CANONICAL layout; the
+        # next fused window re-shards the fresh states lazily
+        from .fused_fit import flush_sharded_states
+        flush_sharded_states(self)
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:

@@ -205,6 +205,48 @@ def place_replicated(mesh, *trees):
     return tuple(jax.tree_util.tree_map(put, t) for t in trees)
 
 
+def is_update_sharded(a, row):
+    """Whether ``a`` is already in the ZeRO update-phase form for row
+    sharding ``row`` (1-D and equivalently sharded) — jit outputs may
+    come back under an equivalent-but-distinct sharding object, so
+    plain equality is not enough."""
+    if getattr(a, 'ndim', 0) != 1:
+        return False
+    sh = getattr(a, 'sharding', None)
+    if sh is None:
+        return False
+    if sh == row:
+        return True
+    try:
+        return sh.is_equivalent_to(row, 1)
+    except Exception:  # noqa: BLE001 — sharding impl without the probe
+        return False
+
+
+def place_update_sharded(mesh, arrays_with_shapes):
+    """Place optimizer-state leaves in the ZeRO update-phase layout
+    (arXiv:2004.13336): each ``(array, canonical_shape)`` pair comes
+    back as a 1-D leaf zero-padded to a multiple of dp and row-sharded
+    over the mesh's dp axis (executor_group.SPMDExecutorGroup.
+    update_sharding) — 1/dp of every leaf per device. Arrays already in
+    that form pass through untouched, so the per-window snapshot is a
+    no-op in steady state and the conversion runs only on entry to the
+    fused path (first window, after a restore, after a flush)."""
+    import jax
+    from .executor_group import SPMDExecutorGroup
+    from ..parallel.sharding import zero_flatten, zero_pad_len
+    row = SPMDExecutorGroup.update_sharding(mesh)
+    dp = int(mesh.shape['dp'])
+    out = []
+    for a, shape in arrays_with_shapes:
+        padded = zero_pad_len(int(np.prod(shape)) if shape else 1, dp)
+        if is_update_sharded(a, row) and int(a.shape[0]) == padded:
+            out.append(a)
+            continue
+        out.append(jax.device_put(zero_flatten(a, dp), row))
+    return out
+
+
 def rebind_children(eval_metric, current_children):
     """Point a cached loop's stat writeback at the CURRENT call's
     metric objects (each call may construct fresh instances from the

@@ -64,7 +64,7 @@ def adam_rule(lr=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0):
 
 
 def make_train_step(loss_fn, mesh, optimizer=None, plan=None,
-                    batch_spec=('dp',), donate=True, shard_updates=False):
+                    batch_spec=('dp',), donate=True, shard_updates=None):
     """Compile ``loss_fn`` into a sharded step over the mesh.
 
     loss_fn(params, batch, key) -> scalar loss (mean over the batch), or
@@ -78,7 +78,16 @@ def make_train_step(loss_fn, mesh, optimizer=None, plan=None,
     style): GSPMD turns the gradient psum into a reduce-scatter, each
     replica updates only its 1/dp slice, and the fresh params
     all-gather back. Optimizer memory per device drops by ~dp×.
+    Default (None) follows MXTPU_SHARDED_UPDATE — the same switch that
+    governs the production fused-fit window (module/fused_fit.py),
+    which additionally flat-pads every leaf so non-dividing shapes
+    shard too; this functional prototype shards only leaves with a
+    dp-divisible free dimension.
     """
+    if shard_updates is None:
+        from ..config import flags
+        flags.reload('MXTPU_SHARDED_UPDATE')
+        shard_updates = bool(flags.get('MXTPU_SHARDED_UPDATE'))
     plan = plan or data_parallel_plan()
     opt_init, opt_update = optimizer if optimizer is not None else sgd_rule()
 

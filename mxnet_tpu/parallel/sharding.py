@@ -17,7 +17,8 @@ import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
 __all__ = ['ShardingPlan', 'data_parallel_plan', 'constrain',
-           'shard_params', 'replicate_params']
+           'shard_params', 'replicate_params', 'zero_pad_len',
+           'zero_flatten', 'zero_unflatten', 'zero_sharded_bytes']
 
 P = PartitionSpec
 
@@ -93,3 +94,55 @@ def shard_params(params, mesh, plan=None):
 
 def replicate_params(params, mesh):
     return shard_params(params, mesh, ShardingPlan())
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-style update-phase leaf form (arXiv:2004.13336)
+# ---------------------------------------------------------------------------
+# The cross-replica weight-update sharding works on ONE canonical leaf
+# layout: every tensor entering the sharded update is flattened to 1-D
+# and zero-padded to a multiple of the dp axis, so EVERY leaf divides
+# evenly — a (10, 7) head weight shards as cleanly as a (64, 3, 7, 7)
+# conv kernel. Zero padding is an invariant of the framework's fused
+# update ops (sgd/nag/adam/rmsprop/ftrl are all elementwise with
+# update(0, grad=0, state=0) == (0, 0)), so the pad region never
+# contaminates real elements and never drifts from zero.
+
+def zero_pad_len(n, dp):
+    """Smallest multiple of ``dp`` >= ``n`` (the padded flat length)."""
+    return -(-int(n) // int(dp)) * int(dp)
+
+
+def zero_flatten(x, dp):
+    """A leaf in the update-phase form: 1-D, zero-padded to a multiple
+    of ``dp``. Traceable (used inside the compiled window body) and
+    valid eagerly (the host-side placement path)."""
+    import jax.numpy as jnp
+    flat = jnp.reshape(x, (-1,))
+    pad = zero_pad_len(flat.shape[0], dp) - flat.shape[0]
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat
+
+
+def zero_unflatten(flat, shape):
+    """Invert :func:`zero_flatten`: drop the pad tail, restore the
+    original shape."""
+    import jax.numpy as jnp
+    n = 1
+    for d in shape:
+        n *= int(d)
+    if int(flat.shape[0]) != n:
+        flat = flat[:n]
+    return jnp.reshape(flat, tuple(shape))
+
+
+def zero_sharded_bytes(shape, dtype, dp):
+    """Per-DEVICE bytes of one leaf held in the update-phase form
+    (flat, padded, 1/dp per device) — the honest number behind the
+    ``update.opt_state_bytes_per_device`` gauge."""
+    import numpy as np
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return zero_pad_len(n, dp) // int(dp) * np.dtype(dtype).itemsize

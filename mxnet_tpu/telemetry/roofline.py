@@ -60,8 +60,9 @@ import threading
 
 __all__ = ['enabled', 'note_compiled', 'note_hlo', 'hlo_layer_costs',
            'load_trace_events', 'analyze', 'summarize',
-           'snapshot_roofline', 'TOP_N', 'OVERHEAD_UTIL_PCT',
-           'CLASS_COMPUTE', 'CLASS_MEMORY', 'CLASS_OVERHEAD']
+           'snapshot_roofline', 'comm_bytes_by_op', 'TOP_N',
+           'OVERHEAD_UTIL_PCT', 'CLASS_COMPUTE', 'CLASS_MEMORY',
+           'CLASS_OVERHEAD']
 
 TOP_N = 8                  # bottleneck rows rendered in the summary block
 OVERHEAD_UTIL_PCT = 10.0   # below this % of its roofline ceiling a
@@ -688,6 +689,25 @@ def analyze(step_time_ms=None, events=None, trace_path=None,
         'layers': out_rows,
         'comm': comm,
     }
+
+
+def comm_bytes_by_op(name_prefix=None):
+    """{collective opcode: per-step bytes} summed over every ingested
+    program (optionally filtered to names starting with
+    ``name_prefix``), or {} when roofline is off / nothing matched.
+    The per-opcode view of the communication accounting: the sharded
+    weight update's reduce-scatter + all-gather traffic reads straight
+    off it (bench.py's ``update_comm_bytes``)."""
+    if not enabled():
+        return {}
+    with _lock:
+        progs = [p for n, p in _programs.items()
+                 if name_prefix is None or str(n).startswith(name_prefix)]
+    out = {}
+    for p in progs:
+        for op, b in (p.get('comm_ops') or {}).items():
+            out[op] = out.get(op, 0.0) + float(b)
+    return out
 
 
 def comm_pct_of_step():

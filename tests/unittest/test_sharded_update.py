@@ -1,0 +1,458 @@
+"""ZeRO-style sharded weight update (MXTPU_SHARDED_UPDATE, ISSUE 9).
+
+The contract under test (arXiv:2004.13336 on the fused-fit window):
+with the flag on and an SPMD dp mesh, optimizer state lives flat,
+zero-padded to a multiple of dp and row-sharded — 1/dp per device,
+donated in place through the scan carry — while numerics stay within
+test tolerance of the replicated update (the cross-mesh 1e-6
+precedent, test_resilience's host-loss case: dp reduction order
+changes with layout). Flag off (or dp == 1, or the module opted out)
+must lower byte-identically to the replicated program, and sharded
+opt-state leaves must checkpoint/restore — including onto a different
+dp (the 8->4 chaos case).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.module.fused_fit import FusedFitLoop
+
+_FLAGS = ('MXTPU_SHARDED_UPDATE', 'MXTPU_FUSED_FIT', 'MXTPU_TELEMETRY',
+          'MXTPU_TELEMETRY_PATH', 'MXTPU_CKPT_DIR', 'MXTPU_CKPT_EVERY',
+          'MXTPU_CKPT_ASYNC', 'MXTPU_CKPT_RESUME')
+
+
+def _reload():
+    for f in _FLAGS:
+        flags.reload(f)
+
+
+@pytest.fixture
+def clean_flags(monkeypatch):
+    monkeypatch.setenv('MXTPU_FUSED_FIT', '1')
+    _reload()
+    telemetry._reset_for_tests()
+    yield monkeypatch
+    telemetry._reset_for_tests()
+    for f in _FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload()
+
+
+def _spmd_mod(hidden=10, n=64, batch=16, seed=7):
+    """An 8-device SPMD module whose fc1 dims (10) do NOT divide dp=8 —
+    the per-leaf padding path must engage for every such leaf. Every
+    op is explicitly named so repeated builds lower byte-identically
+    (auto names carry a process-global counter)."""
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=hidden, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    X = np.random.RandomState(3).randn(n, 10).astype(np.float32)
+    y = (np.random.RandomState(4).rand(n) * 4).astype(int) \
+        .astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
+                           label_name='softmax_label')
+    mod = mx.mod.Module(out, context=[mx.cpu(i) for i in range(8)])
+    return mod, it
+
+
+def _fit(mod, it, num_epoch=2, **kw):
+    kw.setdefault('optimizer', 'sgd')
+    kw.setdefault('optimizer_params', (('learning_rate', 0.1),
+                                       ('momentum', 0.9)))
+    kw.setdefault('kvstore', 'device')
+    kw.setdefault('eval_metric', 'acc')
+    mod.fit(it, num_epoch=num_epoch, **kw)
+    return {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+
+
+def _loop(mod):
+    return mod.__dict__['_fused_fit_cache'][1]
+
+
+# ---------------------------------------------------------------------------
+# leaf-form helpers
+# ---------------------------------------------------------------------------
+
+def test_zero_leaf_helpers():
+    import jax.numpy as jnp
+    from mxnet_tpu.parallel.sharding import (zero_flatten, zero_pad_len,
+                                             zero_sharded_bytes,
+                                             zero_unflatten)
+    assert zero_pad_len(100, 8) == 104
+    assert zero_pad_len(64, 8) == 64
+    assert zero_pad_len(1, 8) == 8
+    for shape in ((10, 10), (64,), (3, 5, 7)):
+        x = np.arange(int(np.prod(shape)), dtype=np.float32).reshape(shape)
+        flat = zero_flatten(jnp.asarray(x), 8)
+        assert flat.ndim == 1 and flat.shape[0] % 8 == 0
+        # the pad region is zero (the elementwise-update fixed point)
+        assert float(jnp.abs(flat[x.size:]).sum()) == 0.0
+        back = np.asarray(zero_unflatten(flat, shape))
+        np.testing.assert_array_equal(back, x)
+    # per-device bytes: exact ceil(n/dp) elements
+    assert zero_sharded_bytes((10, 10), np.float32, 8) == 104 // 8 * 4
+    assert zero_sharded_bytes((64,), np.float32, 8) == 8 * 4
+
+
+# ---------------------------------------------------------------------------
+# parity + engagement on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+def test_sharded_matches_replicated_nondivisible_leaves(clean_flags):
+    """Final params within the documented tolerance (rtol 1e-5 /
+    atol 1e-6 — the cross-mesh precedent) of the replicated update,
+    with the padding path engaged: every fc1 leaf (10 rows, 10 % 8 != 0)
+    shards via flat zero-padding."""
+    from mxnet_tpu.module.window_pipeline import is_update_sharded
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '1')
+    _reload()
+    mod1, it1 = _spmd_mod()
+    a1 = _fit(mod1, it1)
+    loop = _loop(mod1)
+    assert loop._zero is not None, 'sharded update did not engage'
+    row = loop._zero['row']
+    # 64/16 = 4 batches = exactly one window of 4: no tail, so the
+    # states are still live in the ZeRO layout
+    for n in loop._grad_names:
+        for a, (shape, _d) in zip(loop._state_arrays(n),
+                                  loop._zero_shapes[n]):
+            assert is_update_sharded(a, row), (n, a.shape, a.sharding)
+            padded = -(-int(np.prod(shape)) // 8) * 8
+            assert tuple(a.shape) == (padded,), (n, a.shape, shape)
+    # fc1_weight (10, 10): 100 -> 104 — the non-divisible pad case
+    assert loop._zero_shapes['fc1_weight'][0][0] == (10, 10)
+
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '0')
+    _reload()
+    mod0, it0 = _spmd_mod()
+    a0 = _fit(mod0, it0)
+    assert _loop(mod0)._zero is None
+    assert a1.keys() == a0.keys()
+    for k in a1:
+        np.testing.assert_allclose(a1[k], a0[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_tail_batches_flush_then_match(clean_flags):
+    """A tail (< window) forces the imperative per-batch update: the
+    loop must flush the ZeRO leaves to canonical form first, and the
+    combined trajectory still matches the replicated run."""
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '1')
+    _reload()
+    # 72/8 = 9 batches: window 4 -> 2 windows + 1 tail batch
+    mod1, it1 = _spmd_mod(n=72, batch=8)
+    a1 = _fit(mod1, it1)
+    loop = _loop(mod1)
+    assert loop._zero is not None
+    # tail ran -> states are back in canonical shapes
+    for n in loop._grad_names:
+        for a, (shape, _d) in zip(loop._state_arrays(n),
+                                  loop._zero_shapes[n]):
+            assert tuple(a.shape) == shape, (n, a.shape, shape)
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '0')
+    _reload()
+    mod0, it0 = _spmd_mod(n=72, batch=8)
+    a0 = _fit(mod0, it0)
+    for k in a1:
+        np.testing.assert_allclose(a1[k], a0[k], rtol=1e-5, atol=1e-6,
+                                   err_msg=k)
+
+
+def test_module_opt_out(clean_flags):
+    """`module.sharded_update = False` is the documented per-module
+    opt-out: the window builds, but the update stays replicated."""
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '1')
+    _reload()
+    mod, it = _spmd_mod()
+    mod.sharded_update = False
+    _fit(mod, it)
+    assert _loop(mod)._zero is None
+
+
+# ---------------------------------------------------------------------------
+# the memory gauge: ~dp x drop on the 8-device mesh
+# ---------------------------------------------------------------------------
+
+def test_opt_state_bytes_gauge_drop(clean_flags, tmp_path):
+    """update.opt_state_bytes_per_device drops >= 4x (dp = 8, padding
+    slack allowed) between the replicated and sharded layouts — the
+    framework-native proof the ISSUE acceptance names."""
+    clean_flags.setenv('MXTPU_TELEMETRY', '1')
+    clean_flags.setenv('MXTPU_TELEMETRY_PATH',
+                       str(tmp_path / 't.jsonl'))
+    _reload()
+    telemetry._reset_for_tests()
+    vals = {}
+    for flag in ('0', '1'):
+        clean_flags.setenv('MXTPU_SHARDED_UPDATE', flag)
+        _reload()
+        mod, it = _spmd_mod()
+        _fit(mod, it)
+        g = telemetry.snapshot()['gauges']
+        vals[flag] = g['update.opt_state_bytes_per_device']
+        assert bool(g['update.sharded']) == (flag == '1')
+    assert vals['1'] > 0
+    assert vals['0'] / vals['1'] >= 4.0, vals
+    # exact accounting: momentum state = one leaf per param, padded
+    from mxnet_tpu.parallel.sharding import zero_sharded_bytes
+    expect = sum(zero_sharded_bytes(s, np.float32, 8)
+                 for s in ((10, 10), (10,), (4, 10), (4,)))
+    assert int(vals['1']) == expect
+    # the gauges flip AS A PAIR on a layout transition: a tail flush
+    # must restore the replicated footprint next to sharded=0, never
+    # report the 1/dp bytes under a 'replicated' label
+    mod, it = _spmd_mod(n=72, batch=8)   # 9 batches: 2 windows + tail
+    _fit(mod, it)
+    g = telemetry.snapshot()['gauges']
+    assert not bool(g['update.sharded'])
+    assert int(g['update.opt_state_bytes_per_device']) == int(vals['0'])
+
+
+# ---------------------------------------------------------------------------
+# flag honesty + byte-identical replicated lowering
+# ---------------------------------------------------------------------------
+
+def _window_text(mod, loop):
+    """Lowered+compiled HLO text of the module's (single) window
+    program, rebuilt deterministically from the loop's own pieces."""
+    import jax
+    import jax.numpy as jnp
+    fn = loop._build_program(loop._static_attrs(), None)
+    jitted = getattr(fn, 'jitted', fn)
+    params, states, aux, gaccs = loop._snapshot()
+    W = loop.window
+    data_stack = (jnp.zeros((W, 16, 10), jnp.float32),)
+    label_stack = (jnp.zeros((W, 16), jnp.float32),)
+    lr = np.ones((W, len(loop._grad_names)), np.float32)
+    return jitted.lower(params, states, aux, gaccs, data_stack,
+                        label_stack, jax.random.PRNGKey(0), lr,
+                        lr).compile().as_text()
+
+
+def test_flag_off_lowering_byte_identical(clean_flags):
+    """With MXTPU_SHARDED_UPDATE=0 the lowered window program carries
+    no update collectives and is byte-identical across fresh builds —
+    the replicated path is untouched by the sharding machinery."""
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '0')
+    _reload()
+    texts = []
+    for _ in range(2):
+        mod, it = _spmd_mod()
+        _fit(mod, it, num_epoch=1)
+        texts.append(_window_text(mod, _loop(mod)))
+    assert texts[0] == texts[1]
+    assert 'reduce-scatter' not in texts[0]
+    assert 'all-gather' not in texts[0]
+
+    # flag on: the same build DOES carry the update collectives (on
+    # XLA:CPU — no reduce-scatter-creation pass — the grad sync stays
+    # an all-reduce and the param re-gather shows as all-gather; the
+    # TPU pass rewrites the pair into one reduce-scatter)
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '1')
+    _reload()
+    mod, it = _spmd_mod()
+    _fit(mod, it, num_epoch=1)
+    sharded = _window_text(mod, _loop(mod))
+    assert 'all-gather' in sharded or 'reduce-scatter' in sharded
+    assert sharded != texts[0]
+
+
+def test_warn_once_when_replicated_path_runs(clean_flags, caplog):
+    """Flag honesty: an EXPLICIT MXTPU_SHARDED_UPDATE=1 that lands on
+    the replicated path (single device here) warns once per process —
+    and an unconfigured run (flag unset, defaulting on) never warns."""
+    import logging
+    from mxnet_tpu.module import fused_fit as ff
+
+    def one_fit():
+        mx.random.seed(7)
+        np.random.seed(7)
+        data = mx.sym.Variable('data')
+        out = mx.sym.SoftmaxOutput(
+            mx.sym.FullyConnected(data, num_hidden=4, name='fc1'),
+            name='softmax')
+        X = np.random.randn(32, 10).astype(np.float32)
+        y = (np.random.rand(32) * 4).astype(int).astype(np.float32)
+        it = mx.io.NDArrayIter(X, y, batch_size=8, shuffle=False,
+                               label_name='softmax_label')
+        mod = mx.mod.Module(out, context=mx.cpu())
+        mod.fit(it, num_epoch=1, optimizer='sgd', kvstore='local',
+                eval_metric='acc')
+
+    ff._replicated_warned.clear()
+    try:
+        # unset flag: no warning even though the default is on
+        clean_flags.delenv('MXTPU_SHARDED_UPDATE', raising=False)
+        _reload()
+        with caplog.at_level(logging.WARNING):
+            one_fit()
+        assert 'REPLICATED' not in caplog.text
+        # explicit flag: exactly one warning across two fresh fits
+        clean_flags.setenv('MXTPU_SHARDED_UPDATE', '1')
+        _reload()
+        with caplog.at_level(logging.WARNING):
+            one_fit()
+            one_fit()
+        assert caplog.text.count('runs REPLICATED') == 1
+    finally:
+        ff._replicated_warned.clear()
+
+
+# ---------------------------------------------------------------------------
+# serialization: save_optimizer_states + checkpoint round-trip
+# ---------------------------------------------------------------------------
+
+def test_save_optimizer_states_flushes(clean_flags, tmp_path):
+    """save_optimizer_states mid-ZeRO-layout serializes CANONICAL
+    shapes (the flush hook), and a load round-trips."""
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '1')
+    _reload()
+    mod, it = _spmd_mod()
+    _fit(mod, it)
+    loop = _loop(mod)
+    from mxnet_tpu.module.window_pipeline import is_update_sharded
+    row = loop._zero['row']
+    assert any(is_update_sharded(a, row) for n in loop._grad_names
+               for a in loop._state_arrays(n))
+    path = str(tmp_path / 'opt.states')
+    mod.save_optimizer_states(path)
+    # flush happened: live leaves are canonical again
+    for n in loop._grad_names:
+        for a, (shape, _d) in zip(loop._state_arrays(n),
+                                  loop._zero_shapes[n]):
+            assert tuple(a.shape) == shape
+    before = {n: [np.asarray(a) for a in loop._state_arrays(n)]
+              for n in loop._grad_names}
+    mod.load_optimizer_states(path)
+    for n in loop._grad_names:
+        for a, b in zip(loop._state_arrays(n), before[n]):
+            np.testing.assert_allclose(np.asarray(a), b, atol=0)
+
+
+def test_checkpoint_roundtrip_sharded_opt_state(clean_flags, tmp_path):
+    """Mid-training checkpoints capture the opt state AS SHARDED (flat
+    leaves + canonical-shape annotation in the meta structure), and a
+    fresh fit resumes BIT-exactly — same mesh, so no reduction-order
+    slack applies."""
+    ckpt_dir = tmp_path / 'ckpts'
+    clean_flags.setenv('MXTPU_SHARDED_UPDATE', '1')
+    clean_flags.setenv('MXTPU_CKPT_DIR', str(ckpt_dir))
+    clean_flags.setenv('MXTPU_CKPT_EVERY', '4')
+    clean_flags.setenv('MXTPU_CKPT_ASYNC', '0')
+    clean_flags.setenv('MXTPU_CKPT_RESUME', '0')
+    _reload()
+    # uninterrupted 3 epochs (no resume, fresh dir per arm)
+    import shutil
+    mod, it = _spmd_mod()
+    ref = _fit(mod, it, num_epoch=3)
+    shutil.rmtree(ckpt_dir)
+
+    mod1, it1 = _spmd_mod()
+    _fit(mod1, it1, num_epoch=2)
+    # the captured structure annotates ZeRO leaves with canonical shapes
+    from mxnet_tpu.parallel import checkpoint as pckpt
+    ck = mod1.__dict__['_mxtpu_ckpt']
+    meta = pckpt.read_meta(ck._mngr, ck.last_good)
+    encs = list(ck._iter_zero_encs(meta['opt_structure']))
+    assert encs, 'no ZeRO-annotated leaves in the checkpoint structure'
+    assert all('k' in e and 'shape' in e for e in encs)
+    saved_shape = meta['shapes']['opt/%s' % encs[0]['k']]
+    assert len(saved_shape) == 1 and saved_shape[0] % 8 == 0
+
+    clean_flags.setenv('MXTPU_CKPT_RESUME', '1')
+    _reload()
+    mod2, it2 = _spmd_mod()
+    got = _fit(mod2, it2, num_epoch=3)
+    assert mod2.__dict__['_mxtpu_ckpt'].restored_step == 8
+    for k in ref:
+        np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+
+_RESHARD_CHILD = r'''
+import os, sys, json
+os.environ['XLA_FLAGS'] = \
+    '--xla_force_host_platform_device_count=%(ndev)s'
+import jax
+jax.config.update('jax_platforms', 'cpu')
+import numpy as np
+sys.path.insert(0, %(repo)r)
+import mxnet_tpu as mx
+
+mx.random.seed(7); np.random.seed(7)
+data = mx.sym.Variable('data')
+fc1 = mx.sym.FullyConnected(data, num_hidden=10, name='fc1')
+act = mx.sym.Activation(fc1, act_type='relu')
+out = mx.sym.SoftmaxOutput(
+    mx.sym.FullyConnected(act, num_hidden=4, name='fc2'), name='softmax')
+X = np.random.RandomState(3).randn(64, 10).astype(np.float32)
+y = (np.random.RandomState(4).rand(64) * 4).astype(int).astype(np.float32)
+it = mx.io.NDArrayIter(X, y, batch_size=16, shuffle=False,
+                       label_name='softmax_label')
+mod = mx.mod.Module(out, context=[mx.cpu(i)
+                                  for i in range(%(ndev)s)])
+mod.fit(it, num_epoch=%(epochs)s, optimizer='sgd',
+        optimizer_params=(('learning_rate', 0.1), ('momentum', 0.9)),
+        kvstore='device', eval_metric='acc')
+ck = mod.__dict__.get('_mxtpu_ckpt')
+args, _ = mod.get_params()
+print(json.dumps({
+    'restored': getattr(ck, 'restored_step', None),
+    'resharded_from': getattr(ck, 'resharded_from', None),
+    'params': {k: v.asnumpy().tolist() for k, v in args.items()}}))
+'''
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_checkpoint_reshard_8_to_4_chaos(tmp_path):
+    """The 8->4 chaos case: train on 8 devices with sharded opt state
+    (leaves saved flat, padded to 8's multiple), lose half the mesh,
+    resume on 4 — the dp-resharding must restore (global shapes
+    validated through the canonical annotation, orbax re-lays the
+    shards) and the continued run must match an uninterrupted 8-device
+    run within the cross-mesh tolerance (atol 1e-6: dp reduction order
+    changes with mesh size — the PR 8 precedent)."""
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    base = {'MXTPU_FUSED_FIT': '1', 'MXTPU_SHARDED_UPDATE': '1',
+            'MXTPU_CKPT_DIR': str(tmp_path / 'ck'),
+            'MXTPU_CKPT_EVERY': '4', 'MXTPU_CKPT_ASYNC': '0',
+            'JAX_PLATFORMS': 'cpu'}
+
+    def child(ndev, epochs, resume, extra=()):
+        env = {k: v for k, v in os.environ.items()
+               if not k.startswith(('MXTPU_', 'XLA_'))}
+        env.update(base)
+        env['MXTPU_CKPT_RESUME'] = '1' if resume else '0'
+        env.update(extra)
+        code = _RESHARD_CHILD % {'ndev': ndev, 'epochs': epochs,
+                                 'repo': repo}
+        r = subprocess.run([sys.executable, '-c', code], env=env,
+                           capture_output=True, text=True, timeout=420)
+        assert r.returncode == 0, r.stderr[-3000:]
+        return json.loads(r.stdout.strip().splitlines()[-1])
+
+    # uninterrupted 3-epoch 8-device reference (fresh dir)
+    ref = child(8, 3, resume=False,
+                extra={'MXTPU_CKPT_DIR': str(tmp_path / 'ref')})
+    # 8-device run trains 2 epochs (last-good at step 8)...
+    child(8, 2, resume=False)
+    # ...then 4 devices resume and finish epoch 3
+    got = child(4, 3, resume=True)
+    assert got['restored'] == 8, got['restored']
+    assert (got['resharded_from'] or {}).get('devices') == 8
+    for k, v in ref['params'].items():
+        np.testing.assert_allclose(np.array(got['params'][k]),
+                                   np.array(v), atol=1e-6, err_msg=k)

@@ -301,6 +301,60 @@ def test_bench_diff_ok_and_regression(tmp_path, capsys):
     capsys.readouterr()
 
 
+def test_bench_diff_gates_opt_state_bytes(tmp_path, capsys):
+    """opt_state_bytes_per_device (the sharded weight update's
+    per-device footprint) is in the gated set at a 10% tolerance:
+    a regrowth past it — e.g. the ZeRO layout silently disengaging —
+    fails the gate; a drop (more sharding) never does."""
+    import json
+    import bench_diff
+    a = tmp_path / 'a.json'
+    b = tmp_path / 'b.json'
+    a.write_text(json.dumps(_bench_rec(opt_state_bytes_per_device=12800)))
+    # +8%: inside the 10% tolerance
+    b.write_text(json.dumps(_bench_rec(opt_state_bytes_per_device=13824)))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    capsys.readouterr()
+    # 8x regrowth (the replicated footprint coming back): exit 1
+    b.write_text(json.dumps(_bench_rec(
+        opt_state_bytes_per_device=102400)))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    assert 'REGRESSION: opt_state_bytes_per_device' \
+        in capsys.readouterr().out
+    # a drop is an improvement, never a failure
+    b.write_text(json.dumps(_bench_rec(opt_state_bytes_per_device=1600)))
+    assert bench_diff.main([str(a), str(b), '--tol-pct', '0.1']) == 0
+    capsys.readouterr()
+    # absent on one side: skipped, not a verdict
+    b.write_text(json.dumps(_bench_rec()))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    assert 'skipped (missing on one side)' in capsys.readouterr().out
+
+
+def test_telemetry_watch_renders_opt_state_line():
+    """The watch frame shows the sharded-update engagement: per-device
+    opt-state MiB, layout, dp, and the step's whole collective share
+    (labeled as such — the update-only split is bench's
+    update_comm_bytes)."""
+    import telemetry_watch
+    summary = {
+        'elapsed_s': 10.0, 'host': 0,
+        'snapshot': {
+            'counters': {'fit.steps': 64},
+            'gauges': {'update.opt_state_bytes_per_device': 13448.0,
+                       'update.sharded': 1.0, 'update.dp': 8.0,
+                       'roofline.comm_pct_of_step': 7.5},
+            'histograms': {}}}
+    frame = '\n'.join(telemetry_watch.render(summary))
+    assert 'opt_state' in frame
+    assert 'sharded dp=8' in frame
+    assert 'step collectives 7.5%' in frame
+    # replicated layout renders too (and says so)
+    summary['snapshot']['gauges'].update({'update.sharded': 0.0})
+    frame = '\n'.join(telemetry_watch.render(summary))
+    assert 'replicated' in frame
+
+
 def test_bench_diff_formats_and_comparability(tmp_path, capsys):
     """Accepts the harness wrapper ({'parsed': ...}) AND raw bench
     stdout (JSON lines, last line authoritative); a CPU-fallback round

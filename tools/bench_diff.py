@@ -17,8 +17,10 @@ Compared metrics, with direction and default tolerance:
 - ``throughput`` (the headline ``value``)  — lower is a regression (5%)
 - ``mfu``                                  — lower is a regression (5%)
 - ``xla_temp_bytes``                       — higher is a regression (5%)
+- ``opt_state_bytes_per_device`` (the sharded weight update's
+  per-device optimizer-state footprint)   — higher is a regression (10%)
 - ``compile_s`` (cold compile)             — higher is a regression (25%,
-  compile time is the noisiest of the four)
+  compile time is the noisiest of the set)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -34,9 +36,11 @@ import sys
 # metric -> (extractor, bad_direction, default_tol_pct)
 # bad_direction: -1 = a DROP is a regression, +1 = a RISE is one
 _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 5.0,
-            'compile_s': 25.0}
+            'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
-              'compile_s': +1}
+              'opt_state_bytes_per_device': +1, 'compile_s': +1}
+_ORDER = ('throughput', 'mfu', 'xla_temp_bytes',
+          'opt_state_bytes_per_device', 'compile_s')
 
 
 def load_bench(path):
@@ -98,6 +102,11 @@ def extract(rec):
         out['mfu'] = float(rec['mfu'])
     if rec.get('xla_temp_bytes'):
         out['xla_temp_bytes'] = float(rec['xla_temp_bytes'])
+    # `is not None`, not truthiness: a stateless optimizer's honest 0
+    # must stay gated (a regrowth from 0 is exactly a regression)
+    if rec.get('opt_state_bytes_per_device') is not None:
+        out['opt_state_bytes_per_device'] = \
+            float(rec['opt_state_bytes_per_device'])
     c = _compile_s(rec)
     if c is not None:
         out['compile_s'] = c
@@ -121,14 +130,20 @@ def diff(old, new, tols):
     'REGRESSION' when past tolerance in the bad direction."""
     mo, mn = extract(old), extract(new)
     rows = []
-    for metric in ('throughput', 'mfu', 'xla_temp_bytes', 'compile_s'):
+    for metric in _ORDER:
         vo, vn = mo.get(metric), mn.get(metric)
         if vo is None or vn is None:
             if vo is not None or vn is not None:
                 rows.append((metric, vo, vn, None, tols[metric],
                              'skipped (missing on one side)'))
             continue
-        delta = (vn - vo) / vo * 100.0 if vo else 0.0
+        if vo:
+            delta = (vn - vo) / vo * 100.0
+        else:
+            # a 0 baseline (e.g. a stateless optimizer's opt-state
+            # bytes): any nonzero appearance is an infinite rise, not
+            # a silent 0% delta
+            delta = float('inf') if vn > 0 else 0.0
         bad = delta * _DIRECTION[metric] > tols[metric]
         rows.append((metric, vo, vn, delta, tols[metric],
                      'REGRESSION' if bad else 'ok'))
@@ -145,10 +160,10 @@ def _fmt_v(v):
 
 def render(rows, old_path, new_path):
     lines = ['bench diff: %s -> %s' % (old_path, new_path),
-             '  %-15s %14s %14s %9s %7s  %s'
+             '  %-26s %14s %14s %9s %7s  %s'
              % ('metric', 'old', 'new', 'delta%', 'tol%', 'verdict')]
     for metric, vo, vn, delta, tol, verdict in rows:
-        lines.append('  %-15s %14s %14s %9s %7s  %s'
+        lines.append('  %-26s %14s %14s %9s %7s  %s'
                      % (metric, _fmt_v(vo), _fmt_v(vn),
                         '-' if delta is None else '%+.1f' % delta,
                         '%.1f' % tol, verdict))
@@ -158,15 +173,16 @@ def render(rows, old_path, new_path):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description='Compare two BENCH_r*.json artifacts (throughput, '
-                    'MFU, XLA temp bytes, cold compile time) with '
-                    'per-metric tolerance; non-zero exit on regression '
-                    '— the post-bench CI gate (docs/perf.md).')
+                    'MFU, XLA temp bytes, per-device opt-state bytes, '
+                    'cold compile time) with per-metric tolerance; '
+                    'non-zero exit on regression — the post-bench CI '
+                    'gate (docs/perf.md).')
     ap.add_argument('old', help='baseline bench artifact')
     ap.add_argument('new', help='candidate bench artifact')
     ap.add_argument('--tol-pct', type=float, default=None,
                     help='one tolerance (%%) for every metric '
                          '(default: per-metric — throughput/mfu/temp '
-                         '5%%, compile 25%%)')
+                         '5%%, opt-state bytes 10%%, compile 25%%)')
     ap.add_argument('--tol', action='append', default=[],
                     metavar='METRIC=PCT',
                     help='per-metric tolerance override, e.g. '

@@ -116,6 +116,24 @@ def render(summary, steps_per_s=None):
                      % (g['xla.bytes_in_use'] / 2.0**20,
                         (g.get('xla.peak_bytes_in_use')
                          or g['xla.bytes_in_use']) / 2.0**20))
+    if g.get('update.opt_state_bytes_per_device') is not None:
+        # sharded weight update (MXTPU_SHARDED_UPDATE): whether the
+        # ZeRO layout is engaged and what the optimizer state costs
+        # per device. The comm share is the STEP's whole collective
+        # share (roofline accounting — grad sync + the update's
+        # reduce-scatter/all-gather + any tp/pp traffic), labeled as
+        # such: the update-only split lives in bench's
+        # update_comm_bytes
+        bits = ['%.1f MiB/device'
+                % (g['update.opt_state_bytes_per_device'] / 2.0**20),
+                'sharded' if g.get('update.sharded')
+                else 'replicated']
+        if g.get('update.sharded') and g.get('update.dp'):
+            bits[-1] += ' dp=%d' % int(g['update.dp'])
+        if g.get('roofline.comm_pct_of_step') is not None:
+            bits.append('step collectives %s%%'
+                        % _fmt(float(g['roofline.comm_pct_of_step'])))
+        lines.append('  opt_state    %s' % ', '.join(bits))
     hs = summary.get('health')
     # hang / restart / elastic events render on the health line even
     # when the sentinel plane (MXTPU_HEALTH) is off — they live in
