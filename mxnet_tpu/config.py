@@ -466,6 +466,33 @@ flags.declare('MXTPU_NUM_HOSTS', int, 1,
 flags.declare('MXTPU_HOST_ID', int, 0,
               'This process\'s rank in the multi-host SPMD job',
               min_value=0)
+flags.declare('MXTPU_COORD_TIMEOUT', float, 0.0,
+              'Bound (seconds) on each attempt to join the '
+              'jax.distributed job in parallel/multihost.init_multihost '
+              '(passed as initialization_timeout). 0 (default) = jax\'s '
+              'own default (5 minutes). tools/gang_supervisor.py '
+              'defaults its workers to 60 (an explicit setting wins) '
+              'so workers orphaned by a dead coordinator fail fast and '
+              'the gang can be torn down and relaunched on a fresh '
+              'port', min_value=0.0)
+flags.declare('MXTPU_FAULT_HOST', int, -1,
+              'Restrict the MXTPU_FAULT_INJECT fault to ONE host of a '
+              'multi-process job: the fault arms only in the process '
+              'whose MXTPU_HOST_ID matches (the launcher env reaches '
+              'every worker of a gang, and a chaos test usually wants '
+              'to lose exactly one). -1 (default) = arm wherever the '
+              'env reaches', min_value=-1)
+flags.declare('MXTPU_GANG_MIN_HOSTS', int, 0,
+              'Elastic floor for tools/gang_supervisor.py (read from '
+              'the environment — the supervisor never imports the '
+              'framework; --elastic-min-hosts overrides): when a gang '
+              'relaunch is triggered by a host-loss exit (code 113) '
+              'and more than this many workers remain, the gang '
+              'relaunches with one fewer worker instead of the full '
+              'set — reshard-on-restore + io.auto_shard re-derive '
+              'shard coverage from the smaller process set. 0 '
+              '(default) = never shrink: relaunches always use the '
+              'full worker count', min_value=0)
 
 
 _compile_cache_enabled_here = False

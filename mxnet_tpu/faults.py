@@ -41,6 +41,13 @@ is exercised by real failures instead of mocks. Kinds:
   a host looks like to the supervisor. Fires once (per process; a
   relaunch re-arms unless the driver disarms the env).
 
+Gang scoping: ``MXTPU_FAULT_HOST=<i>`` restricts an armed fault to ONE
+host of a multi-process job (matched against this process's
+``MXTPU_HOST_ID``). The launcher env rides into every worker of a gang,
+so without the guard a ``host-loss:<k>`` would kill EVERY worker at
+step k — the chaos tests need to lose exactly one. Unset (default) =
+the fault arms wherever the env reaches.
+
 Off (the default, flag empty) every seam is one cached-bool check —
 the same zero-overhead contract the telemetry stack keeps. Nothing
 here is ever traced into a compiled program: injection happens at
@@ -106,6 +113,25 @@ def _parse(raw):
     return parts[0], int(parts[1]), (parts[2] if len(parts) > 2 else None)
 
 
+def _host_guard():
+    """(fault_host, my_host): the MXTPU_FAULT_HOST restriction and this
+    process's MXTPU_HOST_ID rank. fault_host None = unrestricted."""
+    try:
+        from .config import flags
+        flags.reload('MXTPU_FAULT_HOST')
+        flags.reload('MXTPU_HOST_ID')
+        fault_host = flags.get('MXTPU_FAULT_HOST')
+        my_host = flags.get('MXTPU_HOST_ID')
+    except Exception:  # noqa: BLE001 — stripped builds without the flags
+        try:
+            fault_host = int(os.environ.get('MXTPU_FAULT_HOST', '-1'))
+            my_host = int(os.environ.get('MXTPU_HOST_ID', '0'))
+        except ValueError:
+            return None, 0
+    return (None if fault_host is None or fault_host < 0 else
+            int(fault_host)), int(my_host)
+
+
 def _decide():
     with _decide_lock:
         if _state.decided:
@@ -120,11 +146,25 @@ def _decide():
         raw = raw.strip()
         if raw:
             try:
-                _state.kind, _state.step, _state.arg = _parse(raw)
-                _state.active = True
-                logging.warning('fault injection armed: %s at step %d%s',
-                                _state.kind, _state.step,
-                                ' (%s)' % _state.arg if _state.arg else '')
+                kind, step, arg = _parse(raw)
+                fault_host, my_host = _host_guard()
+                if fault_host is not None and fault_host != my_host:
+                    # another gang member's fault: the launcher env
+                    # reaches every worker, but only host <fault_host>
+                    # arms — this process runs clean (and says so once,
+                    # or a one-worker kill would look like magic)
+                    logging.info(
+                        'fault injection: %s armed for host %d only — '
+                        'this is host %d, fault inert', kind, fault_host,
+                        my_host)
+                else:
+                    _state.kind, _state.step, _state.arg = kind, step, arg
+                    _state.active = True
+                    logging.warning(
+                        'fault injection armed: %s at step %d%s%s',
+                        kind, step, ' (%s)' % arg if arg else '',
+                        ' [host %d]' % my_host
+                        if fault_host is not None else '')
             except ValueError as e:
                 logging.warning('%s — fault injection disabled', e)
         _state.decided = True

@@ -49,11 +49,93 @@ from .. import faults as _faults
 from .. import random as _random
 from .. import telemetry as _tele
 
-__all__ = ['TrainCheckpointer', 'enabled']
+__all__ = ['TrainCheckpointer', 'enabled', 'read_pointer', 'write_pointer',
+           'agree_pointer', 'remap_cursor']
 
 _POINTER = 'last_good.step'
 _MAX_SAVE_FAILURES = 3
 _FORMAT = 1
+
+
+# ---------------------------------------------------------------------------
+# pointer + cursor primitives (module-level: shared by TrainCheckpointer
+# and multi-process drivers that checkpoint outside Module.fit, e.g. the
+# gang workers tests/dist/gang_fit.py supervises)
+# ---------------------------------------------------------------------------
+
+def write_pointer(directory, step):
+    """Atomically write the ``last_good.step`` pointer. The raw file
+    op: multi-process callers must agree first (:func:`agree_pointer`)
+    — in a gang only process 0 writes, and only a step every host has
+    committed and health-cleared."""
+    tmp = os.path.join(str(directory), _POINTER + '.tmp')
+    with open(tmp, 'w') as f:
+        f.write('%d\n' % int(step))
+    os.replace(tmp, os.path.join(str(directory), _POINTER))
+
+
+def read_pointer(directory):
+    """The certified last-good step recorded in ``directory``, or None
+    when no pointer exists (nothing was ever certified)."""
+    try:
+        with open(os.path.join(str(directory), _POINTER)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def agree_pointer(directory, local_step, round_id, logger=logging):
+    """Advance the last-good pointer by CROSS-HOST agreement: every
+    host contributes the newest step it has locally committed and
+    health-cleared (``local_step``; <= 0 = none yet), the agreed step
+    is the minimum over hosts, and process 0 alone writes the pointer.
+    A relaunched gang can therefore never restore a step some host
+    never finished writing — the divergent-restore failure the
+    per-host pointer write had. Single-process this degenerates to the
+    local write. ``round_id`` must advance identically on every host
+    (agreement rounds run at lockstep points of the training schedule).
+    Returns the agreed step, or None when no step is agreed (nothing
+    certified anywhere, or a host died mid-agreement — the bounded
+    timeout turns that into "pointer unchanged", never a wedge)."""
+    from ..parallel import multihost as _mh
+    local = int(local_step) if local_step and int(local_step) > 0 else -1
+    agreed = _mh.agree_min('ckpt.ptr.%s' % round_id, local)
+    if agreed is None:
+        logger.warning(
+            'checkpointing: cross-host last-good agreement round %s '
+            'failed — pointer unchanged', round_id)
+        return None
+    if agreed <= 0:
+        return None
+    if _mh.is_primary():
+        try:
+            write_pointer(directory, agreed)
+        except OSError as e:
+            logger.warning(
+                'checkpointing: cannot write last-good pointer (%s)', e)
+            return None
+    return int(agreed)
+
+
+def remap_cursor(r_step, old_p, new_p):
+    """Translate a per-host step-in-epoch cursor saved by ``old_p``
+    processes into ``new_p``-process units: the same trained SAMPLE
+    count lands at ``step * old_p / new_p``. Returns ``(scaled,
+    remainder)`` — a nonzero remainder means the division was inexact
+    and the caller should round DOWN (retrain a few batches from the
+    restored, finite, parameters rather than skip unseen data)."""
+    return divmod(int(r_step) * int(old_p), int(new_p))
+
+
+def _gang_processes():
+    """Process count of the live multi-process job, or 1. Checked via
+    the coordination client FIRST so a single-host run never touches
+    the jax backend just to learn it is alone."""
+    from ..parallel import multihost as _mh
+    if _mh._client() is None:
+        return 1
+    import jax
+    return int(jax.process_count())
 
 
 def _flags():
@@ -121,6 +203,19 @@ class TrainCheckpointer:
         self.last_good = None
         self.restored_step = None
         self.resharded_from = None  # saving mesh of an N->M restore
+        # gang mode (a real multi-process jax.distributed job): saves
+        # are collectives, so the busy-writer skip must be agreed
+        # globally, and the last-good pointer advances only by
+        # cross-host agreement with process 0 writing the file
+        self._gang = _gang_processes() > 1
+        self._certified = 0        # newest LOCALLY committed+cleared step
+        # agreement-round naming: derived from (global_step, per-step
+        # sequence) — both lockstep quantities — NEVER from counters
+        # that advance on per-host success paths (a lone host's
+        # disk-full/capture failure must not shear the gang's round
+        # names and wedge every later agreement into timeouts)
+        self._round_step = -1
+        self._round_k = 0
         # incident count at fit start: any NEW incident this attempt
         # marks every later capture uncertifiable (see _promote) —
         # while counts from a PREVIOUS attempt of the same process
@@ -313,32 +408,83 @@ class TrainCheckpointer:
         """The actual write (worker thread in async mode): one orbax
         save + barrier, then the fault-injection corrupt seam."""
         with _tele.span('ckpt.save', 'ckpt'):
-            self._ckpt.save(self._mngr, step, tree, wait=True, meta=meta)
+            ok = self._ckpt.save(self._mngr, step, tree, wait=True,
+                                 meta=meta)
+        if ok is False and self._gang:
+            # the cross-host commit confirmation timed out: some host
+            # may still be mid-write, so THIS host must not certify the
+            # step (the raise routes it through the failure path; the
+            # min-agreement means the pointer cannot advance past it
+            # until every host eventually certifies)
+            raise RuntimeError(
+                'commit confirmation barrier failed for step %d' % step)
         _faults.maybe_corrupt_checkpoint(self.directory, step)
         _tele.counter('ckpt.saves').inc()
         # a committed save is forward progress even when the step loop
         # is briefly quiet (sync fallback mode)
         _tele.watchdog.note_progress('ckpt.save')
 
+    def _round_id(self, tag):
+        """A gang agreement-round name every host derives identically:
+        (tag, global step, per-step call sequence). The call SITES are
+        lockstep by construction (save cadence crossings, fit end) and
+        the ids carry no per-host state, so one host's local failure
+        can never desynchronize later rounds' names."""
+        if self._round_step != self.global_step:
+            self._round_step = self.global_step
+            self._round_k = 0
+        self._round_k += 1
+        return 'ckpt.%s.%d.%d' % (tag, self.global_step, self._round_k)
+
     def _initiate_save(self):
         step = self.global_step
-        if self._disabled or not step:
+        if not step or (self._disabled and not self._gang):
             return
-        busy = [p for p in self._pending if p[2] is not None
-                and not p[2].done()]
-        if busy:
-            # the writer is still on a previous step: drop this save
-            # rather than queue unboundedly behind slow storage
-            # (finish() re-initiates after draining, so the run's
-            # final state is never lost to a slow writer)
-            _tele.counter('ckpt.skipped').inc()
-            return
-        try:
-            with _tele.span('ckpt.capture', 'ckpt'):
-                tree, meta = self._capture()
-        except Exception as e:  # noqa: BLE001 — never kill training
-            self._note_failure('state capture failed: %s' % e)
-            return
+        busy = bool([p for p in self._pending if p[2] is not None
+                     and not p[2].done()])
+        if self._gang:
+            # the save is a collective (each host writes its shards
+            # into ONE orbax commit): either every host initiates it or
+            # none does. Each host votes with its FULL local readiness
+            # — writer busy, checkpointing disabled, or the capture
+            # itself failing (taken BEFORE the vote: a host that
+            # discovers a capture failure after the others committed to
+            # a collective save would wedge them in orbax's barrier) —
+            # and any not-ready vote skips the save for the whole gang
+            tree = meta = None
+            if not busy and not self._disabled:
+                try:
+                    with _tele.span('ckpt.capture', 'ckpt'):
+                        tree, meta = self._capture()
+                except Exception as e:  # noqa: BLE001 — never kill
+                    self._note_failure('state capture failed: %s' % e)
+            from ..parallel import multihost as _mh
+            any_skip = _mh.agree_any(self._round_id('busy'),
+                                     tree is None)
+            # a failed agreement (a host died mid-exchange) must skip:
+            # initiating a collective save with a dead peer wedges
+            if any_skip is None or any_skip or tree is None:
+                _tele.counter('ckpt.skipped').inc()
+                return
+            # the GANG committed to this save: record the initiation
+            # now, lockstep, so finish()'s re-initiate decision stays
+            # identical on every host even if a local submit/sync
+            # failure below keeps this host's write from landing
+            self._initiated = step
+        else:
+            if busy:
+                # the writer is still on a previous step: drop this
+                # save rather than queue unboundedly behind slow
+                # storage (finish() re-initiates after draining, so the
+                # run's final state is never lost to a slow writer)
+                _tele.counter('ckpt.skipped').inc()
+                return
+            try:
+                with _tele.span('ckpt.capture', 'ckpt'):
+                    tree, meta = self._capture()
+            except Exception as e:  # noqa: BLE001 — never kill training
+                self._note_failure('state capture failed: %s' % e)
+                return
         nf0 = self._nonfinite_count()
         # health-cleared at birth when the sentinels already checked
         # through this step (lag=0 paths): later incidents then belong
@@ -384,20 +530,21 @@ class TrainCheckpointer:
 
     # -- last-good promotion -----------------------------------------------
     def _write_pointer(self, step):
-        tmp = os.path.join(self.directory, _POINTER + '.tmp')
-        with open(tmp, 'w') as f:
-            f.write('%d\n' % step)
-        os.replace(tmp, os.path.join(self.directory, _POINTER))
+        if not self._gang:
+            write_pointer(self.directory, step)
+        else:
+            # gang rollback sites (a failed restore falling back to an
+            # older committed step) write a value every host derived
+            # from the same shared files — process 0 alone touches it
+            from ..parallel import multihost as _mh
+            if _mh.is_primary():
+                write_pointer(self.directory, step)
         self.last_good = int(step)
         _tele.gauge('ckpt.last_good').set(int(step))
 
     @staticmethod
     def read_pointer(directory):
-        try:
-            with open(os.path.join(str(directory), _POINTER)) as f:
-                return int(f.read().strip())
-        except (OSError, ValueError):
-            return None
+        return read_pointer(directory)
 
     def _promote(self, bound=None, final=False):
         """Advance the last-good pointer over committed saves the
@@ -463,15 +610,33 @@ class TrainCheckpointer:
                 keep.append(entry)   # health hasn't caught up yet
                 continue
             if ok:
-                try:
-                    self._write_pointer(step)
-                except OSError as e:
-                    self.logger.warning(
-                        'checkpointing: cannot write last-good pointer '
-                        '(%s)', e)
+                if self._gang:
+                    # gang mode: certification is only LOCAL knowledge —
+                    # the pointer itself moves at the next agreement
+                    # round (process 0 writes the agreed minimum)
+                    self._certified = max(self._certified, int(step))
+                else:
+                    try:
+                        self._write_pointer(step)
+                    except OSError as e:
+                        self.logger.warning(
+                            'checkpointing: cannot write last-good pointer '
+                            '(%s)', e)
             else:
                 _tele.counter('ckpt.uncertified').inc()
         self._pending = keep
+
+    def _agree_pointer(self):
+        """One cross-host pointer-agreement round (gang mode only;
+        called at lockstep points of the schedule: every save cadence
+        crossing and fit end). Process 0 writes the agreed step; every
+        host mirrors it into ``last_good``/the gauge so telemetry and
+        restart records name the same step everywhere."""
+        agreed = agree_pointer(self.directory, self._certified,
+                               self._round_id('ptr'), logger=self.logger)
+        if agreed is not None and agreed != self.last_good:
+            self.last_good = int(agreed)
+            _tele.gauge('ckpt.last_good').set(int(agreed))
 
     # -- fit-loop hooks ----------------------------------------------------
     def begin_epoch(self, epoch, eval_metric, train_data):
@@ -576,10 +741,23 @@ class TrainCheckpointer:
         self._checked = max(self._checked, self.global_step - lag)
         if self._pending:
             self._promote()
-        if not self._disabled \
+        if (not self._disabled or self._gang) \
                 and self.global_step - self._last_save >= self.every:
+            # a gang host that locally DISABLED checkpointing still
+            # crosses every cadence point: it votes not-ready in the
+            # save agreement (stopping the gang's collective saves)
+            # and keeps contributing to pointer rounds — dropping out
+            # would desynchronize every later round's name instead
             self._last_save = self.global_step
             self._initiate_save()
+            if self._gang:
+                # lockstep point (every host crosses the cadence at the
+                # same global step): agree on the newest step every
+                # host has committed + cleared, process 0 writes it. On
+                # the async path the agreement naturally lags one
+                # cadence (the in-flight save hasn't committed yet);
+                # finish() runs the closing round after the drain
+                self._agree_pointer()
 
     def _abort_drain(self):
         """Watchdog abort hook (monitor thread, bounded by the
@@ -596,11 +774,21 @@ class TrainCheckpointer:
         run's end state always lands."""
         self._checked = self.global_step
         self._drain()
-        if not self._disabled and self.global_step > self._initiated:
+        if (not self._disabled or self._gang) \
+                and self.global_step > self._initiated:
+            # lockstep in gang mode: _initiated advances at the agreed
+            # initiation point, and a locally-disabled host still
+            # participates (voting not-ready) — see note_steps
             self._last_save = self.global_step
             self._initiate_save()
             self._drain()
         self._promote()
+        if self._gang:
+            # fit ends at the same global step on every host — the
+            # closing agreement round lands the run's end state in the
+            # pointer (the cadence rounds lag one save on the async
+            # path)
+            self._agree_pointer()
         self._shutdown_pool()
 
     def handle_failure(self, diagnostic=None):
@@ -618,6 +806,10 @@ class TrainCheckpointer:
             epoch_base = self.global_step - self.step_in_epoch
             bound = epoch_base + int(diagnostic['step'])
         self._promote(bound=bound, final=bound is None)
+        # gang mode: a failure path is NOT a lockstep point (one host
+        # raised while the others are wedged or dead), so no agreement
+        # round runs — the pointer stays at the last agreed step and
+        # the relaunched gang restores from there
         self._shutdown_pool()
 
     def _drain(self):
@@ -723,7 +915,18 @@ class TrainCheckpointer:
                                        owners.get(m.group(1), '?')), msg)
 
     def _restore_step(self, step):
-        """Restore one committed step into the module, bit-exactly.
+        """Restore one committed step into the module, bit-exactly:
+        the read/validate/fetch phase (:meth:`_fetch_step`) followed by
+        the apply. The two are separate so the gang resume path can
+        reject a candidate BETWEEN them (cross-host agreement) with the
+        live module still untouched on every host."""
+        meta, restored = self._fetch_step(step)
+        self._apply(restored, meta)
+        return meta
+
+    def _fetch_step(self, step):
+        """Read + validate + fetch one committed step WITHOUT touching
+        the live module; returns ``(meta, restored_tree)``.
         Restore-into-template: the CURRENT mesh's live arrays supply
         the dtypes/shardings orbax restores onto, and validation runs
         against GLOBAL shapes (recorded in the meta sidecar at save) —
@@ -766,8 +969,7 @@ class TrainCheckpointer:
         # state-only restore: the meta sidecar was already read (and
         # validated) above — no second JSON round-trip
         restored = self._ckpt.restore_state(self._mngr, template, step)
-        self._apply(restored, meta)
-        return meta
+        return meta, restored
 
     def _apply(self, tree, meta):
         e = self._exec
@@ -905,7 +1107,7 @@ class TrainCheckpointer:
             new_p = old_p
         if not old_p or old_p == new_p or not r_step:
             return r_step
-        scaled, rem = divmod(r_step * old_p, new_p)
+        scaled, rem = remap_cursor(r_step, old_p, new_p)
         io_meta = meta.get('io') or {}
         self.logger.warning(
             'checkpointing: restore crosses a process-set change '
@@ -936,28 +1138,77 @@ class TrainCheckpointer:
             return
         candidates = [s for s in sorted(steps, reverse=True) if s <= ptr]
         for step in candidates:
+            self.resharded_from = None   # per-candidate bookkeeping
+            failed = False
+            fetched = None
             try:
-                meta = self._restore_step(step)
+                # fetch/validate WITHOUT touching the live module: the
+                # gang agreement below can still reject this candidate
+                fetched = self._fetch_step(step)
             except Exception as e:  # noqa: BLE001 — corrupt step
+                self.logger.warning(
+                    'checkpointing: restore of step %d failed (%s) — '
+                    'trying an older checkpoint', step, e)
+                failed = True
+            if self._gang:
+                # the fallback decision must be COLLECTIVE: fetch
+                # failures can be asymmetric (one host's transient read
+                # error), and a gang whose hosts restore different
+                # steps diverges every later agreement round — the
+                # exact failure the agreed pointer exists to prevent.
+                # Any host failing sends the WHOLE gang to the older
+                # candidate; because nothing was applied yet, a
+                # rejected candidate leaves every host's live module
+                # untouched — even when every candidate ends up
+                # rejected and the gang starts fresh together. No
+                # agreement (a dead peer) reads as failure,
+                # conservatively
+                from ..parallel import multihost as _mh
+                any_failed = _mh.agree_any('ckpt.resume.%d' % step,
+                                           failed)
+                if any_failed is None or any_failed:
+                    if not failed:
+                        self.logger.warning(
+                            'checkpointing: a peer host failed to '
+                            'restore step %d — falling back together',
+                            step)
+                    continue
+            elif failed:
+                continue
+            try:
+                meta, restored = fetched
+                self._apply(restored, meta)
+            except Exception as e:  # noqa: BLE001 — drifted state
+                # _apply stages everything before mutating, so a
+                # failure here leaves the module untouched; staging is
+                # deterministic on identical checkpoint + live
+                # structure, hence symmetric across a gang
                 self.logger.warning(
                     'checkpointing: restore of step %d failed (%s) — '
                     'trying an older checkpoint', step, e)
                 continue
             # steps newer than the restore point are stale (and, after
             # an incident, possibly poisoned): clear them so pruning
-            # and replay renumbering stay sane
-            for s in steps:
-                if s > step:
-                    try:
-                        self._ckpt.delete_step(self._mngr, s)
-                    except Exception:  # noqa: BLE001
-                        pass
+            # and replay renumbering stay sane (one deleter in a gang —
+            # every host would race the same shared step dirs)
+            from ..parallel import multihost as _mh
+            if not self._gang or _mh.is_primary():
+                for s in steps:
+                    if s > step:
+                        try:
+                            self._ckpt.delete_step(self._mngr, s)
+                        except Exception:  # noqa: BLE001
+                            pass
             self.global_step = int(meta['global_step'])
             self._last_save = self.global_step
             self._initiated = self.global_step
             self._checked = self.global_step
             self.last_good = step
             self.restored_step = step
+            # the restored step is certified by construction (the
+            # pointer named it): agreement rounds start from it instead
+            # of re-earning a step the whole gang already trusts
+            self._certified = int(step)
             if step != ptr:
                 try:
                     self._write_pointer(step)

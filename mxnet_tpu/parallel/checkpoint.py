@@ -101,7 +101,16 @@ def save(mngr, step, state, wait=True, meta=None):
     """Write ``state`` (a pytree of jax.Arrays — sharded arrays are
     written shard-parallel) at ``step``. ``meta`` (optional) is a
     JSON-serializable dict saved as a sidecar item inside the same
-    atomic commit — restore it with :func:`restore_with_meta`."""
+    atomic commit — restore it with :func:`restore_with_meta`.
+
+    In a multi-process job every process calls this with the same step
+    (the save IS a collective: each host writes only its own shards,
+    orbax's primary writes the commit marker). With ``wait`` the return
+    is additionally barriered across hosts: a truthy return means the
+    commit is visible to EVERY host and the caller may certify the step
+    (advance a last-good pointer, fire the corrupt-injection seam);
+    ``False`` means the confirmation barrier timed out — some host may
+    still be mid-write, and the caller must NOT certify this step."""
     ocp = _ocp()
     if meta is None:
         args = ocp.args.StandardSave(state)
@@ -111,7 +120,22 @@ def save(mngr, step, state, wait=True, meta=None):
     saved = mngr.save(int(step), args=args)
     if wait:
         mngr.wait_until_finished()
+        from . import multihost as _mh
+        # the PER-STEP attempt counter keeps the barrier name unique
+        # when the same step is re-saved (coordination barriers are
+        # one-shot) while self-healing across failures: a host whose
+        # save raised never increments, but the next save is a
+        # DIFFERENT step whose counter starts equal on every host — a
+        # lifetime counter would stay sheared forever and turn every
+        # later commit barrier into a timeout
+        n = _save_attempts.get(int(step), 0) + 1
+        _save_attempts[int(step)] = n
+        if not _mh.barrier('ckpt.commit.%d.%d' % (int(step), n)):
+            return False
     return saved
+
+
+_save_attempts = {}
 
 
 def restore(mngr, template, step=None):

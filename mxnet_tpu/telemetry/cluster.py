@@ -52,9 +52,14 @@ __all__ = ['enabled', 'host_index', 'set_host', 'note_step', 'sync_now',
 # slots of the per-host sync vector, in order ('comm_pct' — the
 # roofline's collective share of the step — is NaN/omitted unless
 # MXTPU_ROOFLINE runs; rows from an older sender with fewer slots are
-# padded with NaN at publish)
+# padded with NaN at publish). 'proc_index' carries each sender's TRUE
+# jax.process_index(), proven on a real 2-process DCN job
+# (tests/dist/gang_fit.py): the per-host gauges and /metrics series on
+# process 0 are keyed off it instead of assuming the gathered row
+# order is process order; rows without the slot (older senders,
+# crafted test matrices) fall back to the positional index
 SYNC_KEYS = ('step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes',
-             'comm_pct')
+             'comm_pct', 'proc_index')
 
 _SPREAD_BALANCED_PCT = 5.0   # step-time spread below this = no straggler
 _COMM_BOUND_PCT = 30.0       # collective share of the step above which a
@@ -219,8 +224,13 @@ def _local_stats():
     # inference. NaN = unavailable (flag off / nothing ingested yet)
     from . import roofline
     comm = roofline.comm_pct_of_step()
+    try:
+        import jax
+        proc = float(jax.process_index())
+    except Exception:  # noqa: BLE001 — backend not up
+        proc = float(host_index())
     return [step_ms, float(io_pct), float(disp), live,
-            float(comm) if comm is not None else float('nan')]
+            float(comm) if comm is not None else float('nan'), proc]
 
 
 def _allgather(vals):
@@ -236,13 +246,26 @@ def _allgather(vals):
     return out.reshape(max(1, jax.process_count()), -1)
 
 
+def _host_ids(mat):
+    """Row index -> host id for one gathered matrix: the proc_index
+    slot when the sender carried it, else the positional fallback."""
+    mat = np.asarray(mat, np.float64)
+    idx = SYNC_KEYS.index('proc_index')
+    ids = []
+    for i in range(mat.shape[0]):
+        v = float(mat[i, idx]) if idx < mat.shape[1] else float('nan')
+        ids.append(int(v) if np.isfinite(v) else i)
+    return ids
+
+
 def round_verdict(mat):
-    """(slowest_host, spread_pct, verdict) for one gathered matrix —
+    """(slowest_row, spread_pct, verdict) for one gathered matrix —
     the ONE implementation of the per-round straggler math, shared by
     the publication path (:func:`_publish`) and the elastic-input
     decision (:func:`_elastic_decide`) so the published verdict and the
     re-balance decision can never disagree on the same round.
-    ``slowest_host`` is None when no host has a valid step time."""
+    ``slowest_row`` is a ROW index (callers map to a host id via
+    :func:`_host_ids`), or None when no host has a valid step time."""
     mat = np.asarray(mat, np.float64)
     times = mat[:, 0]
     valid = ~np.isnan(times)
@@ -317,10 +340,18 @@ def _publish(mat, steps):
     reg = st.registry
     mat = np.asarray(mat, np.float64)
     n = mat.shape[0]
+    host_ids = _host_ids(mat)
     per_host = []
     for i in range(n):
-        row = {'host': i}
+        # gauges/rows keyed by the row's OWN process index (carried in
+        # the proc_index slot), not its gathered position — the real
+        # 2-process drive pins the two agree, and a transport that ever
+        # reordered rows could not silently swap two hosts' series
+        hid = host_ids[i]
+        row = {'host': hid}
         for j, key in enumerate(SYNC_KEYS):
+            if key == 'proc_index':
+                continue        # identity, already the 'host' field
             # rows shorter than SYNC_KEYS (a crafted test matrix, or a
             # sender predating a slot) pad with NaN = unavailable
             v = float(mat[i, j]) if j < mat.shape[1] else float('nan')
@@ -330,15 +361,16 @@ def _publish(mat, steps):
             row[key] = None if np.isnan(v) else round(v, 3)
         per_host.append(row)
         if row['step_time_ms'] is not None:
-            reg.gauge('cluster.h%d.step_time_ms' % i).set(
+            reg.gauge('cluster.h%d.step_time_ms' % hid).set(
                 row['step_time_ms'])
-        reg.gauge('cluster.h%d.io_wait_pct' % i).set(row['io_wait_pct'])
-        reg.gauge('cluster.h%d.dispatch_ms' % i).set(row['dispatch_ms'])
-        reg.gauge('cluster.h%d.live_mb' % i).set(
+        reg.gauge('cluster.h%d.io_wait_pct' % hid).set(row['io_wait_pct'])
+        reg.gauge('cluster.h%d.dispatch_ms' % hid).set(row['dispatch_ms'])
+        reg.gauge('cluster.h%d.live_mb' % hid).set(
             round(row['live_bytes'] / 2.0**20, 1))
         if row['comm_pct'] is not None:
-            reg.gauge('cluster.h%d.comm_pct' % i).set(row['comm_pct'])
-    slowest, spread, straggler = round_verdict(mat)
+            reg.gauge('cluster.h%d.comm_pct' % hid).set(row['comm_pct'])
+    slowest_row, spread, straggler = round_verdict(mat)
+    slowest = host_ids[slowest_row] if slowest_row is not None else None
     reg.gauge('cluster.hosts').set(n)
     if slowest is not None:
         reg.gauge('cluster.slowest_host').set(slowest)
@@ -381,7 +413,9 @@ def _elastic_decide(mat, steps):
     mat = np.asarray(mat, np.float64)
     if mat.shape[0] < 2:
         return None
-    slowest, spread, verdict = round_verdict(mat)
+    slowest_row, spread, verdict = round_verdict(mat)
+    slowest = _host_ids(mat)[slowest_row] if slowest_row is not None \
+        else None
     if verdict != 'input_bound':
         return None
     with _state.lock:

@@ -1,0 +1,246 @@
+"""Gang worker: a REAL multi-process jax.distributed training run that
+drives the whole elastic arc with the framework's own machinery.
+
+Launched as N processes by tools/gang_supervisor.py (or raw, with the
+MXTPU_COORDINATOR / MXTPU_NUM_HOSTS / MXTPU_HOST_ID env protocol).
+Everything the simulated chaos tests fake runs for real here:
+
+- ``parallel.init_multihost`` joins the gang (gloo CPU collectives,
+  bounded join retry);
+- the training state is GLOBAL: weights replicated over the dp mesh,
+  momentum held ZeRO-style (flat, zero-padded, dp-sharded via
+  ``parallel.sharding.zero_flatten``) — so orbax writes each host's
+  own shard files and a relaunch onto fewer hosts is a genuine
+  reshard-on-restore;
+- each host draws only its ``io.auto_shard()`` slice of every global
+  batch (the global batch is P-independent, so an elastic 2->1 shrink
+  retraces the same trajectory to reduction-order tolerance);
+- checkpoints go through ``parallel.checkpoint`` (commit barriered
+  across hosts) and the last-good pointer advances ONLY by the
+  cross-host agreement in ``module.checkpointing.agree_pointer``;
+- resume reads the agreed pointer, validates global shapes, remaps the
+  cursor (``module.checkpointing.remap_cursor``), and re-derives its
+  data shard from the live process set;
+- cluster telemetry sync rounds ride a real DCN allgather
+  (MXTPU_TELEMETRY_SYNC_EVERY), and the fault harness seams
+  (host-loss/hang, MXTPU_FAULT_HOST-scoped) fire exactly as in a
+  supervised production run.
+
+Prints ``GANG_FIT_OK rank=<i> ...`` on success; GANG_ASSERT_CLUSTER=1
+additionally asserts the real-DCN cluster aggregation (per-host rows
+under true process indices on process 0, host-labeled /metrics).
+"""
+import argparse
+import json
+import os
+import sys
+
+import jax
+jax.config.update('jax_platforms', 'cpu')
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+from mxnet_tpu import parallel as par            # noqa: E402
+from mxnet_tpu import faults                     # noqa: E402
+from mxnet_tpu import io as mio                  # noqa: E402
+from mxnet_tpu import telemetry                  # noqa: E402
+from mxnet_tpu.module import checkpointing as mckpt   # noqa: E402
+from mxnet_tpu.parallel import checkpoint as ckpt     # noqa: E402
+from mxnet_tpu.parallel import multihost as mh        # noqa: E402
+from mxnet_tpu.parallel.sharding import (             # noqa: E402
+    zero_flatten, zero_pad_len, zero_unflatten)
+
+FEATURES = 4096     # big enough that per-host shard files dominate
+                    # checkpoint bytes on disk (the disk-layout assert)
+MOMENTUM = 0.9
+LR = 1e-4
+
+
+def _global_batch(step, batch):
+    """The step's GLOBAL batch — identical math for ANY process count,
+    so an elastic shrink retraces the same trajectory."""
+    rng = np.random.RandomState(1000 + step)
+    X = rng.randn(batch, FEATURES).astype(np.float32)
+    w_true = np.linspace(-1.0, 1.0, FEATURES).astype(np.float32)
+    Y = (X @ w_true).astype(np.float32)
+    return X, Y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument('--steps', type=int, default=12,
+                    help='total global steps (resume continues the count)')
+    ap.add_argument('--batch', type=int, default=8,
+                    help='GLOBAL batch rows per step (divisible by P)')
+    ap.add_argument('--ckpt-every', type=int, default=4)
+    ap.add_argument('--ckpt-dir', default=os.environ.get('MXTPU_CKPT_DIR'))
+    ap.add_argument('--out', default=None,
+                    help='np.save final weights to <out>.h<rank>.npy')
+    args = ap.parse_args()
+
+    joined = par.init_multihost()
+    rank = par.process_index() if joined else 0
+    nproc = par.process_count() if joined else 1
+    mesh = par.global_mesh({'dp': -1})
+    assert mesh.devices.size == nproc, (mesh.devices.size, nproc)
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.experimental import multihost_utils
+
+    dp = nproc
+    rep = NamedSharding(mesh, P())
+    row = NamedSharding(mesh, P('dp'))
+    data_sh = NamedSharding(mesh, P('dp', None))
+
+    # io.auto_shard: this host's slice of every global batch — the
+    # elastic contract (a relaunch onto fewer hosts re-derives coverage
+    # from the live process set, every example covered exactly once)
+    shard = mio.auto_shard()
+    assert shard['num_parts'] == nproc, shard
+    per_host = args.batch // shard['num_parts']
+    lo = shard['part_index'] * per_host
+
+    L = zero_pad_len(FEATURES, dp)
+    w = jax.device_put(jnp.zeros((FEATURES,), jnp.float32), rep)
+    m = jax.device_put(jnp.zeros((L,), jnp.float32), row)
+
+    def step_fn(w, m, x, y):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        m2 = MOMENTUM * m + zero_flatten(g, dp)
+        w2 = w - LR * zero_unflatten(m2, (FEATURES,))
+        return w2, m2, loss
+
+    jstep = jax.jit(step_fn,
+                    in_shardings=(rep, row, data_sh, row),
+                    out_shardings=(rep, row, rep),
+                    donate_argnums=(1,))
+
+    start_step = 0
+    mngr = None
+    agree_round = 0
+    certified = 0           # newest cross-host-agreed step
+    loss = jnp.zeros((), jnp.float32)
+    if args.ckpt_dir:
+        mngr = ckpt.manager(args.ckpt_dir, max_to_keep=3)
+        ptr = mckpt.read_pointer(args.ckpt_dir)
+        if ptr is not None:
+            template = {'w': w, 'm': m}
+            meta = ckpt.read_meta(mngr, ptr)
+            # global shapes are mesh-independent: a P_old != P_new
+            # restore must validate clean and reshard, not drift
+            ckpt.validate_shapes(meta['shapes'], template)
+            state = ckpt.restore_state(mngr, template, ptr)
+            w, m = state['w'], state['m']
+            # steps newer than the agreed pointer are stale (some host
+            # may never have finished them): one deleter, then a
+            # barrier so nobody re-saves a step mid-delete
+            stale = [s_ for s_ in ckpt.all_steps(mngr) if s_ > ptr]
+            if stale and mh.is_primary():
+                for s_ in stale:
+                    ckpt.delete_step(mngr, s_)
+            mh.barrier('gang_fit.stale_cleanup')
+            old_p = int(meta['mesh']['processes'])
+            # this driver's cursor is the GLOBAL step (already
+            # P-independent); the per-host remap is exercised and
+            # logged so an epoch-cursor driver would resume the same way
+            scaled, rem = mckpt.remap_cursor(meta['global_step'],
+                                             old_p, nproc)
+            start_step = int(meta['global_step'])
+            certified = int(ptr)
+            print('GANG_FIT_RESUME rank=%d step=%d saved_procs=%d '
+                  'live_procs=%d cursor_remap=%d rem=%d shard=%d/%d'
+                  % (rank, start_step, old_p, nproc, scaled, rem,
+                     shard['part_index'], shard['num_parts']),
+                  flush=True)
+
+    with mesh:
+        for s in range(start_step, args.steps):
+            X, Y = _global_batch(s, args.batch)
+            gx = multihost_utils.host_local_array_to_global_array(
+                X[lo:lo + per_host], mesh, P('dp', None))
+            gy = multihost_utils.host_local_array_to_global_array(
+                Y[lo:lo + per_host], mesh, P('dp'))
+            # the fault seams a supervised production step crosses
+            faults.maybe_raise('dispatch')
+            w, m, loss = jstep(w, m, gx, gy)
+            faults.note_steps(1)
+            telemetry.watchdog.note_progress('gang_fit.step')
+            telemetry.cluster.note_step(1)
+            done = s + 1
+            if mngr is not None and done % args.ckpt_every == 0 \
+                    and done < args.steps:
+                tree = {'w': w, 'm': m}
+                meta = {'global_step': done,
+                        'mesh': mh.mesh_descriptor(),
+                        'shapes': ckpt.template_shapes(tree),
+                        'io': dict(shard)}
+                # a False return = the cross-host commit confirmation
+                # timed out: this step must NOT be certified (vote the
+                # previous certified step instead — the round still
+                # runs, or the gang's round names would shear)
+                committed = ckpt.save(mngr, done, tree, wait=True,
+                                      meta=meta)
+                agree_round += 1
+                agreed = mckpt.agree_pointer(
+                    args.ckpt_dir, done if committed else certified,
+                    agree_round)
+                if agreed is not None:
+                    certified = agreed
+                if committed and agreed is not None:
+                    # every host's commit confirmed -> every host voted
+                    # this step: the agreed minimum IS the step
+                    assert agreed == done, (agreed, done)
+
+    loss_f = float(np.asarray(loss))
+    if os.environ.get('GANG_ASSERT_CLUSTER') == '1':
+        _assert_cluster(rank, nproc)
+    if args.out:
+        np.save('%s.h%d.npy' % (args.out, rank), np.asarray(w))
+    print('GANG_FIT_OK rank=%d procs=%d steps=%d loss=%.6f'
+          % (rank, nproc, args.steps, loss_f), flush=True)
+
+
+def _assert_cluster(rank, nproc):
+    """The real-DCN cluster-plane contract: sync rounds crossed
+    processes, process 0 aggregates per-host rows under TRUE process
+    indices, and its /metrics exposition carries every host's gauges."""
+    from mxnet_tpu.telemetry import cluster, serve
+    assert cluster.enabled(), 'cluster sync rounds were off'
+    snap = telemetry.snapshot()
+    assert snap['counters'].get('cluster.syncs', 0) >= 1, \
+        'no sync round fired'
+    if rank != 0:
+        assert cluster.snapshot_cluster() is None, \
+            'non-zero process published a cluster snapshot'
+        print('GANG_CLUSTER_OK rank=%d' % rank, flush=True)
+        return
+    cs = cluster.snapshot_cluster()
+    assert cs is not None, 'process 0 published no cluster snapshot'
+    assert cs['hosts'] == nproc, cs
+    hosts = [r['host'] for r in cs['per_host']]
+    assert hosts == list(range(nproc)), hosts
+    for r in cs['per_host']:
+        assert r['step_time_ms'] is None or r['step_time_ms'] >= 0.0
+    gauges = snap['gauges']
+    for i in range(nproc):
+        assert 'cluster.h%d.io_wait_pct' % i in gauges, \
+            ('missing per-host gauge for process', i, sorted(gauges))
+    assert int(gauges.get('cluster.process_count', 0)) == nproc
+    prom = serve.render_prometheus(snap, host=cluster.host_index())
+    for i in range(nproc):
+        assert 'cluster_h%d_io_wait_pct' % i in prom, \
+            'aggregated /metrics misses process %d' % i
+    assert 'host="0"' in prom
+    print('GANG_CLUSTER_OK rank=0 hosts=%d snapshot=%s'
+          % (nproc, json.dumps(cs['per_host'])), flush=True)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,679 @@
+"""Gang tier: real multi-host supervision over jax.distributed.
+
+The contracts (tools/gang_supervisor.py, tools/launch.py,
+parallel/multihost.py agreement primitives, module/checkpointing.py's
+gang mode, MXTPU_FAULT_HOST):
+
+- gang semantics: ANY worker exiting unclean tears the rest down and
+  relaunches the whole gang on a FRESH coordinator port against the
+  shared restart budget; worker 0 (the coordinator) is just the i=0
+  case; --elastic-min-hosts lets a host-loss (113) relaunch shrink;
+- the launcher prefixes worker output [h<i>] and propagates the FIRST
+  failing worker's exit code in completion order;
+- checkpointing is multi-process-correct: the busy-writer skip is
+  agreed globally (a collective save needs every host), and the
+  last_good pointer advances only by cross-host agreement with
+  process 0 writing the file;
+- MXTPU_FAULT_HOST scopes an armed fault to one worker of a gang;
+- the slow e2e trio drives all of it on a REAL 2-process CPU
+  jax.distributed job: per-host shard-only checkpoint writes verified
+  on disk, a single-worker host-loss surviving via gang relaunch +
+  agreed-restore with final-params parity, and a 2->1 elastic shrink.
+"""
+import io
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults, telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.module import checkpointing as mckpt
+from mxnet_tpu.parallel import multihost as mh
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+GANG = os.path.join(REPO, 'tools', 'gang_supervisor.py')
+GANG_FIT = os.path.join(REPO, 'tests', 'dist', 'gang_fit.py')
+
+# per-HOST disarm shim: each worker of a RELAUNCHED gang pops the
+# one-shot env fault (its own second launch), never racing attempt-1
+# peers (tests/unittest/test_resilience.py's marker pattern, per host)
+_SHIM = '''
+import os, runpy, sys
+marker = '%s.h%s' % (os.environ['GANG_MARKER'], os.environ['MXTPU_HOST_ID'])
+if os.path.exists(marker):
+    os.environ.pop('MXTPU_FAULT_INJECT', None)
+    os.environ.pop('MXTPU_FAULT_HOST', None)
+else:
+    open(marker, 'a').write('x\\n')
+sys.argv = [sys.argv[1]] + sys.argv[2:]
+runpy.run_path(sys.argv[0], run_name='__main__')
+'''
+
+
+def _reset():
+    telemetry._reset_for_tests()
+    faults._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# MXTPU_FAULT_HOST: arm a fault on exactly one worker of a gang
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def fault_env(monkeypatch):
+    yield monkeypatch
+    for f in ('MXTPU_FAULT_INJECT', 'MXTPU_FAULT_HOST', 'MXTPU_HOST_ID'):
+        monkeypatch.delenv(f, raising=False)
+        flags.reload(f)
+    faults._reset_for_tests()
+
+
+def test_fault_host_guard_inert_on_other_hosts(fault_env):
+    fault_env.setenv('MXTPU_FAULT_INJECT', 'host-loss:3')
+    fault_env.setenv('MXTPU_FAULT_HOST', '1')
+    fault_env.setenv('MXTPU_HOST_ID', '0')
+    for f in ('MXTPU_FAULT_INJECT', 'MXTPU_FAULT_HOST', 'MXTPU_HOST_ID'):
+        flags.reload(f)
+    faults._reset_for_tests()
+    assert not faults.enabled()
+    assert faults.spec() is None
+
+
+def test_fault_host_guard_arms_on_match(fault_env):
+    fault_env.setenv('MXTPU_FAULT_INJECT', 'slow-host:2:5')
+    fault_env.setenv('MXTPU_FAULT_HOST', '1')
+    fault_env.setenv('MXTPU_HOST_ID', '1')
+    for f in ('MXTPU_FAULT_INJECT', 'MXTPU_FAULT_HOST', 'MXTPU_HOST_ID'):
+        flags.reload(f)
+    faults._reset_for_tests()
+    assert faults.enabled()
+    assert faults.spec() == ('slow-host', 2, '5')
+
+
+def test_fault_host_unset_arms_everywhere(fault_env):
+    fault_env.setenv('MXTPU_FAULT_INJECT', 'slow-host:2')
+    fault_env.setenv('MXTPU_HOST_ID', '3')
+    for f in ('MXTPU_FAULT_INJECT', 'MXTPU_FAULT_HOST', 'MXTPU_HOST_ID'):
+        flags.reload(f)
+    faults._reset_for_tests()
+    assert faults.enabled()
+
+
+# ---------------------------------------------------------------------------
+# agreement primitives (single-process degenerate forms; the real
+# 2-process exchange is driven by the slow e2e via gang_fit.py)
+# ---------------------------------------------------------------------------
+
+def test_agreement_primitives_single_process():
+    assert mh.is_primary()
+    assert mh.barrier('t.b') is True
+    assert mh.agree_min('t.min', 7) == 7
+    assert mh.agree_any('t.any', False) is False
+    assert mh.agree_any('t.any2', True) is True
+
+
+def test_pointer_helpers_roundtrip(tmp_path):
+    assert mckpt.read_pointer(tmp_path) is None
+    mckpt.write_pointer(tmp_path, 12)
+    assert mckpt.read_pointer(tmp_path) == 12
+    # single-process agree_pointer degenerates to the local write
+    assert mckpt.agree_pointer(tmp_path, 20, round_id=1) == 20
+    assert mckpt.read_pointer(tmp_path) == 20
+    # nothing certified anywhere -> no advance
+    assert mckpt.agree_pointer(tmp_path, 0, round_id=2) is None
+    assert mckpt.read_pointer(tmp_path) == 20
+
+
+def test_remap_cursor_math():
+    assert mckpt.remap_cursor(6, 2, 1) == (12, 0)
+    assert mckpt.remap_cursor(6, 2, 4) == (3, 0)
+    scaled, rem = mckpt.remap_cursor(5, 2, 4)
+    assert (scaled, rem) == (2, 2)     # inexact: round DOWN, retrain
+
+
+def test_init_multihost_retries_transient_join_failure(monkeypatch):
+    import jax
+    calls = []
+
+    def flaky_init(**kw):
+        calls.append(kw)
+        if len(calls) == 1:
+            raise RuntimeError('DEADLINE_EXCEEDED: coordinator not up')
+
+    monkeypatch.setattr(mh, '_initialized', False)
+    monkeypatch.setattr(mh, '_enable_cpu_collectives', lambda: None)
+    monkeypatch.setattr(jax.distributed, 'initialize', flaky_init)
+    monkeypatch.setattr(jax.distributed, 'shutdown', lambda: None)
+    monkeypatch.setenv('MXTPU_COORDINATOR', '127.0.0.1:1')
+    monkeypatch.setenv('MXTPU_NUM_HOSTS', '2')
+    monkeypatch.setenv('MXTPU_HOST_ID', '1')
+    monkeypatch.setenv('MXTPU_COORD_TIMEOUT', '7')
+    try:
+        assert mh.init_multihost() is True
+    finally:
+        monkeypatch.setattr(mh, '_initialized', False)
+        for f in ('MXTPU_COORDINATOR', 'MXTPU_NUM_HOSTS', 'MXTPU_HOST_ID',
+                  'MXTPU_COORD_TIMEOUT'):
+            monkeypatch.delenv(f, raising=False)
+            flags.reload(f)
+    assert len(calls) == 2
+    assert calls[1]['initialization_timeout'] == 7
+
+
+# ---------------------------------------------------------------------------
+# launcher: [h<i>] prefix + first-failure-in-completion-order
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    """poll() returns None until the scripted completion time."""
+
+    def __init__(self, done_at, code, clock):
+        self.done_at = done_at
+        self.code = code
+        self.clock = clock
+
+    def poll(self):
+        return self.code if self.clock[0] >= self.done_at else None
+
+
+def test_wait_first_failure_completion_order(monkeypatch):
+    import launch
+    clock = [0]
+    # worker 2 fails FIRST in time (tick 1); worker 0 fails later
+    # (tick 3) — the old list-order scan would have reported worker 0
+    procs = [_FakeProc(3, 77, clock), _FakeProc(2, 0, clock),
+             _FakeProc(1, 113, clock)]
+    monkeypatch.setattr(time, 'sleep', lambda _s: clock.__setitem__(
+        0, clock[0] + 1))
+    assert launch.wait_first_failure(procs, poll_s=0) == 113
+    clock[0] = 0
+    procs = [_FakeProc(1, 0, clock), _FakeProc(2, 0, clock)]
+    assert launch.wait_first_failure(procs, poll_s=0) == 0
+
+
+def test_start_worker_prefixes_output():
+    import launch
+    out, err = io.BytesIO(), io.BytesIO()
+    p = launch.start_worker(
+        [sys.executable, '-c',
+         'import sys; print("to out"); print("to err", file=sys.stderr)'],
+        dict(os.environ), 3, out=out, err=err)
+    assert p.wait() == 0
+    deadline = time.time() + 10
+    while time.time() < deadline and (b'out' not in out.getvalue()
+                                      or b'err' not in err.getvalue()):
+        time.sleep(0.02)
+    assert out.getvalue() == b'[h3] to out\n'
+    assert err.getvalue() == b'[h3] to err\n'
+
+
+# ---------------------------------------------------------------------------
+# gang supervisor semantics (fast fake children — no jax)
+# ---------------------------------------------------------------------------
+
+def _write_gang_child(tmp_path, body):
+    child = tmp_path / 'child.py'
+    child.write_text('import os, sys, time\n'
+                     'hid = os.environ["MXTPU_HOST_ID"]\n'
+                     'hosts = os.environ["MXTPU_NUM_HOSTS"]\n'
+                     'coord = os.environ["MXTPU_COORDINATOR"]\n' + body)
+    return child
+
+
+def _run_gang(args, timeout=90, env=None):
+    e = dict(os.environ)
+    for k in ('MXTPU_FAULT_INJECT', 'MXTPU_FAULT_HOST',
+              'MXTPU_TELEMETRY_PATH', 'MXTPU_CKPT_DIR'):
+        e.pop(k, None)
+    e.update(env or {})
+    return subprocess.run(
+        [sys.executable, GANG] + args, env=e,
+        capture_output=True, text=True, timeout=timeout)
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+@pytest.mark.chaos
+def test_gang_teardown_relaunch_and_shrink(tmp_path):
+    """Worker 1 dies 113 on attempt 1: the survivor is torn down, the
+    gang relaunches with one fewer worker (elastic-min-hosts) on a
+    FRESH coordinator port, and completes clean."""
+    child = _write_gang_child(tmp_path, '''
+print('alive', hid, 'of', hosts, flush=True)
+marker = %r + '.h' + hid
+n = len(open(marker).read()) if os.path.exists(marker) else 0
+open(marker, 'a').write('x')
+ports = %r
+open(ports, 'a').write(coord + chr(10))
+if hid == '1' and n == 0:
+    sys.exit(113)
+time.sleep(2.0)        # the survivor "wedges" until torn down
+''' % (str(tmp_path / 'm'), str(tmp_path / 'ports')))
+    log = tmp_path / 'gang.jsonl'
+    proc = _run_gang(['-n', '2', '--backoff', '0', '--elastic-min-hosts',
+                      '1', '--log', str(log), '--',
+                      sys.executable, str(child)])
+    assert proc.returncode == 0, proc.stderr
+    recs = _records(log)
+    mid = [r for r in recs if not r.get('final')]
+    assert len(mid) == 1
+    assert mid[0]['reason'] == 'worker_exit'
+    assert mid[0]['worker'] == 1 and mid[0]['exit_code'] == 113
+    assert mid[0]['hosts'] == 2 and mid[0]['next_hosts'] == 1
+    assert recs[-1]['final'] and recs[-1]['reason'] == 'clean_exit'
+    assert recs[-1]['hosts'] == 1
+    # attempt 1 (2 workers) and attempt 2 (1 worker) used DIFFERENT
+    # coordinator ports
+    ports = set(open(tmp_path / 'ports').read().split())
+    assert len(ports) == 2
+    # the host-0 marker shows two launches (full gang, then shrunk)
+    assert len(open(str(tmp_path / 'm') + '.h0').read()) == 2
+    # worker output reached the supervisor's streams [h<i>]-prefixed,
+    # and the relaunched gang announced the shrunken width
+    assert '[h0] alive 0 of 2' in proc.stdout
+    assert '[h1] alive 1 of 2' in proc.stdout
+    assert '[h0] alive 0 of 1' in proc.stdout
+
+
+@pytest.mark.chaos
+def test_gang_budget_exhausted_propagates_first_failure(tmp_path):
+    child = _write_gang_child(tmp_path, '''
+if hid == '0':
+    time.sleep(1.5)    # worker 1 fails FIRST in completion order
+    sys.exit(9)
+sys.exit(7)
+''')
+    log = tmp_path / 'gang.jsonl'
+    proc = _run_gang(['-n', '2', '--backoff', '0', '--restart-max', '1',
+                      '--log', str(log), '--',
+                      sys.executable, str(child)])
+    assert proc.returncode == 7, (proc.returncode, proc.stderr)
+    recs = _records(log)
+    assert recs[-1]['final'] and recs[-1]['reason'] == 'budget_exhausted'
+    assert recs[-1]['worker'] == 1 and recs[-1]['exit_code'] == 7
+
+
+def test_liveness_exited_worker_never_shadows_later_stalls(tmp_path):
+    """A cleanly-exited worker's naturally-stale file must not shadow
+    the stall check of a still-wedged later worker (stalled() returns
+    the first LIVE stall, skipping the alive=False mask)."""
+    import gang_supervisor
+    p0, p1 = tmp_path / 'h0.jsonl', tmp_path / 'h1.jsonl'
+    p0.write_text('x\n')
+    p1.write_text('x\n')
+    watch = gang_supervisor._Liveness([str(p0), str(p1)], secs=0.2)
+    # both files change once: both arm
+    p0.write_text('xy\n')
+    p1.write_text('xy\n')
+    assert watch.stalled(alive=[True, True]) is None
+    time.sleep(0.35)
+    # worker 0 exited (alive=False): its stale file is not a stall;
+    # worker 1 is alive and wedged — IT must be named
+    assert watch.stalled(alive=[False, True]) == 1
+    # nobody live and stalled -> None
+    assert watch.stalled(alive=[False, False]) is None
+
+
+@pytest.mark.chaos
+def test_gang_liveness_kills_wedged_worker(tmp_path):
+    """One worker's h<i>.jsonl stops growing: the liveness tier fails
+    the GANG (teardown + relaunch), reason liveness_timeout."""
+    log_dir = tmp_path / 'logs'
+    child = _write_gang_child(tmp_path, '''
+import json
+marker = %r + '.h' + hid
+first = not os.path.exists(marker)
+open(marker, 'a').write('x')
+path = os.environ['MXTPU_TELEMETRY_PATH']
+with open(path, 'a') as f:
+    f.write(json.dumps({'type': 'span'}) + chr(10))
+    f.flush()
+    if first and hid == '1':
+        time.sleep(3600)     # wedged: no more records, ever
+    for _ in range(8):
+        time.sleep(0.25)
+        f.write(json.dumps({'type': 'span'}) + chr(10))
+        f.flush()
+''' % str(tmp_path / 'm'))
+    proc = _run_gang(['-n', '2', '--backoff', '0', '--liveness', '2',
+                      '--log-dir', str(log_dir), '--quiet', '--',
+                      sys.executable, str(child)], timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    recs = _records(log_dir / 'gang.jsonl')
+    mid = [r for r in recs if not r.get('final')]
+    assert len(mid) == 1 and mid[0]['reason'] == 'liveness_timeout'
+    assert mid[0]['worker'] == 1
+    assert recs[-1]['final'] and recs[-1]['reason'] == 'clean_exit'
+
+
+# ---------------------------------------------------------------------------
+# TrainCheckpointer gang mode (agreement emulated; the real 2-process
+# exchange runs in the slow e2e below)
+# ---------------------------------------------------------------------------
+
+_CKPT_FLAGS = ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_PATH', 'MXTPU_CKPT_DIR',
+               'MXTPU_CKPT_EVERY', 'MXTPU_CKPT_RESUME')
+
+
+@pytest.fixture
+def ckpt_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH',
+                       str(tmp_path / 'telemetry.jsonl'))
+    monkeypatch.setenv('MXTPU_CKPT_DIR', str(tmp_path / 'ckpts'))
+    monkeypatch.setenv('MXTPU_CKPT_EVERY', '2')
+    for f in _CKPT_FLAGS:
+        flags.reload(f)
+    _reset()
+    yield {'ckpt_dir': tmp_path / 'ckpts', 'monkeypatch': monkeypatch}
+    _reset()
+    for f in _CKPT_FLAGS:
+        monkeypatch.delenv(f, raising=False)
+        flags.reload(f)
+
+
+def _fit_once(num_epoch=2):
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=8, name='fc1')
+    sym = mx.sym.SoftmaxOutput(fc1, name='softmax')
+    np.random.seed(0)
+    X = np.random.randn(32, 10).astype(np.float32)
+    y = (np.random.rand(32) * 4).astype(int).astype(np.float32)
+    mx.random.seed(0)
+    mod = mx.mod.Module(sym, context=mx.cpu())
+    it = mx.io.NDArrayIter(X, y, batch_size=8, label_name='softmax_label')
+    mod.fit(it, num_epoch=num_epoch, optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.1),))
+    return mod
+
+
+def _emulate_gang(monkeypatch, any_busy=None, primary=True, log=None):
+    """Make TrainCheckpointer think it is one host of a 2-process gang,
+    with the agreement exchange scripted."""
+    rec = log if log is not None else []
+
+    def fake_any(name, flag, **kw):
+        rec.append(('any', name, flag))
+        return flag if any_busy is None else any_busy
+
+    def fake_min(name, v, **kw):
+        rec.append(('min', name, v))
+        return v
+
+    monkeypatch.setattr(mckpt, '_gang_processes', lambda: 2)
+    monkeypatch.setattr(mh, 'agree_any', fake_any)
+    monkeypatch.setattr(mh, 'agree_min', fake_min)
+    monkeypatch.setattr(mh, 'is_primary', lambda: primary)
+
+
+@pytest.mark.chaos
+def test_gang_checkpointer_agreed_pointer_primary(ckpt_env):
+    calls = []
+    _emulate_gang(ckpt_env['monkeypatch'], log=calls)
+    mod = _fit_once()
+    ckpt = mod.__dict__['_mxtpu_ckpt']
+    assert ckpt._gang
+    # pointer advanced to the final step through agreement rounds
+    assert mckpt.read_pointer(ckpt_env['ckpt_dir']) == 8
+    assert ckpt.last_good == 8
+    assert [c for c in calls if c[0] == 'any'], 'busy skip never agreed'
+    assert [c for c in calls if c[0] == 'min'], 'pointer never agreed'
+
+
+@pytest.mark.chaos
+def test_gang_checkpointer_nonprimary_never_writes_pointer(ckpt_env):
+    _emulate_gang(ckpt_env['monkeypatch'], primary=False)
+    mod = _fit_once()
+    ckpt = mod.__dict__['_mxtpu_ckpt']
+    # the agreed step is mirrored locally, but only process 0 touches
+    # the shared file
+    assert ckpt.last_good == 8
+    assert mckpt.read_pointer(ckpt_env['ckpt_dir']) is None
+
+
+@pytest.mark.chaos
+def test_gang_checkpointer_global_busy_skips_save(ckpt_env):
+    """ANY host busy = the whole gang skips the save (a collective
+    save with a missing participant wedges orbax's commit barrier)."""
+    _emulate_gang(ckpt_env['monkeypatch'], any_busy=True)
+    _fit_once()
+    snap = telemetry.snapshot()
+    assert snap['counters'].get('ckpt.saves', 0) == 0
+    assert snap['counters']['ckpt.skipped'] >= 1
+    assert mckpt.read_pointer(ckpt_env['ckpt_dir']) is None
+
+
+# ---------------------------------------------------------------------------
+# cluster plane: true process indices
+# ---------------------------------------------------------------------------
+
+def test_publish_keys_gauges_by_proc_index_slot(monkeypatch):
+    """A gathered matrix whose proc_index slots are REVERSED must key
+    the per-host gauges/rows by the carried index, not the row
+    position — the transport's row order is no longer load-bearing."""
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_SYNC_EVERY', '4')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', os.devnull)
+    for f in ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_SYNC_EVERY',
+              'MXTPU_TELEMETRY_PATH'):
+        flags.reload(f)
+    _reset()
+    try:
+        from mxnet_tpu.telemetry import cluster
+        assert cluster.enabled()
+        mat = np.array([[50.0, 0.0, 1.0, 0.0, np.nan, 1.0],
+                        [10.0, 0.0, 1.0, 0.0, np.nan, 0.0]])
+        snap = cluster._publish(mat, steps=4)
+        assert [r['host'] for r in snap['per_host']] == [1, 0]
+        assert snap['slowest_host'] == 1          # row 0 carries index 1
+        g = telemetry.snapshot()['gauges']
+        assert g['cluster.h1.step_time_ms'] == 50.0
+        assert g['cluster.h0.step_time_ms'] == 10.0
+        # rows without the slot keep the positional fallback
+        mat4 = np.array([[50.0, 0.0, 1.0, 0.0],
+                         [10.0, 0.0, 1.0, 0.0]])
+        snap = cluster._publish(mat4, steps=8)
+        assert [r['host'] for r in snap['per_host']] == [0, 1]
+        assert snap['slowest_host'] == 0
+    finally:
+        _reset()
+        for f in ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_SYNC_EVERY',
+                  'MXTPU_TELEMETRY_PATH'):
+            monkeypatch.delenv(f, raising=False)
+            flags.reload(f)
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report: gang log-dir globbing
+# ---------------------------------------------------------------------------
+
+def test_report_globs_gang_log_dir(tmp_path, capsys):
+    import telemetry_report
+    d = tmp_path / 'logs'
+    d.mkdir()
+    for i in range(2):
+        recs = [{'type': 'start', 'host': i, 't': 1.0},
+                {'type': 'span', 'name': 'fit.batch', 'dur_ms': 5.0 + i,
+                 'host': i, 't': 2.0}]
+        with open(d / ('h%d.jsonl' % i), 'w') as f:
+            for r in recs:
+                f.write(json.dumps(r) + '\n')
+    with open(d / 'gang.jsonl', 'w') as f:
+        f.write(json.dumps({'type': 'restart', 'attempt': 1, 'worker': 1,
+                            'host': 1, 'reason': 'worker_exit',
+                            'exit_code': 113}) + '\n')
+        f.write(json.dumps({'type': 'restart', 'attempt': 1, 'final': True,
+                            'host': 0, 'reason': 'clean_exit',
+                            'exit_code': 0}) + '\n')
+    assert telemetry_report.main([str(d)]) == 0
+    out = capsys.readouterr()
+    assert 'per-host comparison (2 hosts)' in out.out
+    # the supervisor's host-stamped restart record merged into worker
+    # 1's view (and the intentional stamp overlap raised no warning)
+    assert 'restarts' in out.out
+    assert 'merged into' not in out.err
+    paths = telemetry_report.expand_paths([str(d)])
+    assert [os.path.basename(p) for p in paths] == \
+        ['h0.jsonl', 'h1.jsonl', 'gang.jsonl']
+
+
+# ---------------------------------------------------------------------------
+# the real thing: 2-process jax.distributed chaos e2e
+# ---------------------------------------------------------------------------
+
+def _e2e_env(tmp_path, **extra):
+    env = dict(os.environ)
+    for k in ('MXTPU_FAULT_INJECT', 'MXTPU_FAULT_HOST', 'JAX_PLATFORMS',
+              'XLA_FLAGS', 'MXTPU_TELEMETRY_SYNC_EVERY'):
+        env.pop(k, None)   # workers force cpu + one device per process
+    env.update({'PYTHONPATH': REPO,
+                'MXTPU_TELEMETRY': '1',
+                'MXTPU_CKPT_DIR': str(tmp_path / 'ckpts'),
+                'MXTPU_COORD_TIMEOUT': '60'})
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _run_gang_fit(tmp_path, n, env, fit_args=(), gang_args=(),
+                  timeout=420, shim=False):
+    log_dir = tmp_path / 'logs'
+    log_dir.mkdir(exist_ok=True)
+    cmd = [sys.executable, GANG, '-n', str(n), '--backoff', '0',
+           '--log-dir', str(log_dir)] + list(gang_args) + ['--']
+    if shim:
+        shim_py = tmp_path / 'shim.py'
+        shim_py.write_text(_SHIM)
+        env = dict(env)
+        env['GANG_MARKER'] = str(tmp_path / 'marker')
+        cmd += [sys.executable, str(shim_py), GANG_FIT]
+    else:
+        cmd += [sys.executable, GANG_FIT]
+    cmd += ['--steps', '12', '--ckpt-every', '4',
+            '--out', str(tmp_path / 'w')] + list(fit_args)
+    return subprocess.run(cmd, env=env, capture_output=True, text=True,
+                          timeout=timeout)
+
+
+def _reference_w(tmp_path):
+    """Final h0 weights of an uninterrupted same-seed 2-process gang."""
+    ref = tmp_path / 'ref'
+    ref.mkdir()
+    proc = _run_gang_fit(ref, 2, _e2e_env(ref))
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    return np.load(str(ref / 'w') + '.h0.npy')
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_gang_2proc_fit_cluster_and_shard_only_writes(tmp_path):
+    """A REAL 2-process jax.distributed fit: the cluster plane
+    aggregates per-host rows under true process indices on process 0
+    (asserted in-worker), the last_good pointer lands by agreement,
+    and ON DISK each host's orbax files cover only its own shards."""
+    env = _e2e_env(tmp_path, MXTPU_TELEMETRY_SYNC_EVERY='4',
+                   GANG_ASSERT_CLUSTER='1')
+    proc = _run_gang_fit(tmp_path, 2, env)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert out.count('GANG_FIT_OK') == 2, out[-3000:]
+    # worker output arrived [h<i>]-prefixed and cluster asserts ran on
+    # both ranks
+    assert '[h0] GANG_CLUSTER_OK rank=0 hosts=2' in out
+    assert '[h1] GANG_CLUSTER_OK rank=1' in out
+    # the agreed pointer: saves at 4 and 8, both certified by every host
+    ckpts = tmp_path / 'ckpts'
+    assert mckpt.read_pointer(ckpts) == 8
+    # per-host shard-only writes: orbax lays each process's shard files
+    # under ocdbt.process_<i>. Process 0 holds the replicated weights
+    # (written once, by the primary replica) plus ITS half of the
+    # dp-sharded momentum; process 1 holds ONLY its momentum shard —
+    # far below the full state, well above metadata-only
+    state = ckpts / '8' / 'state'
+    p0, p1 = state / 'ocdbt.process_0', state / 'ocdbt.process_1'
+    assert p0.is_dir() and p1.is_dir()
+
+    def _bytes(d):
+        return sum(f.stat().st_size for f in d.rglob('*') if f.is_file())
+
+    leaf = 4096 * 4                       # one fp32 leaf (w or m)
+    full = 2 * leaf                       # w + m
+    b0, b1 = _bytes(p0), _bytes(p1)
+    # p1 holds ONLY its half-of-m shard: real data (not metadata-only),
+    # far below the full state, and strictly less than p0 (which adds
+    # the primary-written replicated weights to ITS half of m)
+    assert leaf // 4 < b1 < 0.75 * full, \
+        'process 1 must hold only its momentum shard (got %d, state %d)' \
+        % (b1, full)
+    assert b1 < b0 < 1.25 * full, (b0, b1)
+    # gang layout on disk: h<i>.jsonl + gang.jsonl, report-globbable
+    assert (tmp_path / 'logs' / 'h0.jsonl').exists()
+    assert (tmp_path / 'logs' / 'h1.jsonl').exists()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_gang_host_loss_relaunch_agreed_restore_parity(tmp_path):
+    """Kill worker 1 mid-run (host-loss:6, MXTPU_FAULT_HOST=1): the
+    gang tears down, relaunches on a fresh port, restores from the
+    cross-host-AGREED step 4, and reaches final params parity with an
+    uninterrupted same-seed gang."""
+    env = _e2e_env(tmp_path, MXTPU_FAULT_INJECT='host-loss:6',
+                   MXTPU_FAULT_HOST='1')
+    proc = _run_gang_fit(tmp_path, 2, env, shim=True)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    recs = _records(tmp_path / 'logs' / 'gang.jsonl')
+    mid = [r for r in recs if not r.get('final')]
+    assert len(mid) == 1
+    assert mid[0]['worker'] == 1 and mid[0]['exit_code'] == 113
+    assert mid[0]['hosts'] == 2 and mid[0]['next_hosts'] == 2
+    assert recs[-1]['reason'] == 'clean_exit'
+    # the relaunch restored the AGREED step (4 — the save at 8 never
+    # happened: worker 1 died at step 6)
+    assert 'GANG_FIT_RESUME rank=0 step=4' in out
+    assert 'GANG_FIT_RESUME rank=1 step=4' in out
+    got0 = np.load(str(tmp_path / 'w') + '.h0.npy')
+    got1 = np.load(str(tmp_path / 'w') + '.h1.npy')
+    np.testing.assert_array_equal(got0, got1)
+    ref = _reference_w(tmp_path)
+    np.testing.assert_allclose(got0, ref, atol=1e-6)
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_gang_elastic_shrink_2_to_1_parity(tmp_path):
+    """A host-loss relaunch under --elastic-min-hosts 1 proceeds with
+    ONE worker: the 2-process checkpoint reshards onto the 1-process
+    mesh, io.auto_shard re-derives full coverage, and the final params
+    match the uninterrupted 2-process run (reduction-order
+    tolerance)."""
+    env = _e2e_env(tmp_path, MXTPU_FAULT_INJECT='host-loss:6',
+                   MXTPU_FAULT_HOST='1')
+    proc = _run_gang_fit(tmp_path, 2, env, shim=True,
+                         gang_args=('--elastic-min-hosts', '1'))
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    recs = _records(tmp_path / 'logs' / 'gang.jsonl')
+    mid = [r for r in recs if not r.get('final')]
+    assert mid and mid[0]['next_hosts'] == 1
+    # the shrunk relaunch restored the 2-process checkpoint onto one
+    # process and re-derived the io shard from the live set
+    assert 'GANG_FIT_RESUME rank=0 step=4 saved_procs=2 live_procs=1' \
+        in out
+    assert 'shard=0/1' in out
+    assert 'GANG_FIT_OK rank=0 procs=1' in out
+    got = np.load(str(tmp_path / 'w') + '.h0.npy')
+    ref = _reference_w(tmp_path)
+    np.testing.assert_allclose(got, ref, atol=1e-5)

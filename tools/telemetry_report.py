@@ -24,8 +24,16 @@ steps, step-time p50, io-wait share, non-finite steps — plus the same
 straggler classification the live cluster aggregation publishes::
 
     python tools/telemetry_report.py host0.jsonl host1.jsonl ...
+
+A gang run (tools/gang_supervisor.py --log-dir) lays its logs out as
+``h<i>.jsonl`` per worker plus ``gang.jsonl`` of host-stamped restart
+records; handing the DIRECTORY to this tool globs exactly that layout
+— no flag gymnastics::
+
+    python tools/telemetry_report.py /mnt/run1/logs
 """
 import argparse
+import glob as _glob
 import json
 import os
 import sys
@@ -35,6 +43,31 @@ if REPO not in sys.path:
     sys.path.insert(0, REPO)
 
 from mxnet_tpu.telemetry.export import summary_table  # noqa: E402
+
+
+def expand_paths(paths):
+    """Expand directory arguments into the gang-run log layout: the
+    sorted ``h<i>.jsonl`` per-worker files plus ``gang.jsonl`` (the
+    supervisor's host-stamped restart records — they merge into each
+    worker's view through the same ``host`` field every in-process
+    record carries). A directory with neither falls back to every
+    ``*.jsonl`` it holds; plain file arguments pass through."""
+    out = []
+    for p in paths:
+        if not os.path.isdir(p):
+            out.append(p)
+            continue
+        hosts = sorted(_glob.glob(os.path.join(p, 'h[0-9]*.jsonl')))
+        gang = os.path.join(p, 'gang.jsonl')
+        found = hosts + ([gang] if os.path.exists(gang) else [])
+        if not found:
+            found = sorted(_glob.glob(os.path.join(p, '*.jsonl')))
+        if not found:
+            sys.stderr.write('telemetry_report: %s holds no .jsonl '
+                             'logs\n' % p)
+            continue
+        out.extend(found)
+    return out
 
 
 def load(path):
@@ -233,11 +266,18 @@ def split_hosts(record_lists):
     hosts_per_file = []
     for i, recs in enumerate(record_lists):
         seen = set()
+        # a supervisor log (gang.jsonl: restart/hang records only)
+        # SHARES host stamps with the worker logs by design — its
+        # records merge into each worker's view without tripping the
+        # duplicate-stamp warning below, which is about two WORKER logs
+        # left on the same MXTPU_HOST_ID
+        sup_only = bool(recs) and all(r.get('type') in ('restart', 'hang')
+                                      for r in recs)
         for r in recs:
             host = r.get('host', i)
             seen.add(host)
             by_host.setdefault(host, []).append(r)
-        hosts_per_file.append(seen)
+        hosts_per_file.append(set() if sup_only else seen)
     nonempty = sum(1 for s in hosts_per_file if s)
     if len(by_host) < nonempty:
         sys.stderr.write(
@@ -371,12 +411,17 @@ def main(argv=None):
                     'paths (one per host) merge on the host field and add '
                     'a per-host comparison + straggler classification.')
     ap.add_argument('paths', nargs='+',
-                    help='telemetry JSONL file(s) to render')
+                    help='telemetry JSONL file(s) to render, or a gang '
+                         'log directory holding h<i>.jsonl files')
     args = ap.parse_args(argv)
-    record_lists = [load(p) for p in args.paths]
+    paths = expand_paths(args.paths)
+    if not paths:
+        sys.stderr.write('telemetry_report: nothing to render\n')
+        return 1
+    record_lists = [load(p) for p in paths]
     if not any(record_lists):
         sys.stderr.write('telemetry_report: %s hold(s) no records\n'
-                         % ', '.join(args.paths))
+                         % ', '.join(paths))
         return 1
     if len(record_lists) == 1:
         print(render(record_lists[0]))

@@ -36,6 +36,12 @@ summarizes the outcome. Exit code: the child's last exit code.
 Budget/backoff share the in-process driver's flags: MXTPU_RESTART_MAX
 attempts, MXTPU_RESTART_BACKOFF * 2^(k-1) seconds between them (capped
 at 60s). A clean exit (code 0) or SIGINT stops the loop immediately.
+
+This tier supervises ONE process. A real multi-host job (W workers in
+one jax.distributed gang) dies as a unit — the survivors of a lost
+worker wedge in collectives that can never complete — so it needs
+``tools/gang_supervisor.py``, which launches and relaunches the W
+workers as a gang on this module's budget/backoff/liveness policy.
 """
 import argparse
 import json
@@ -49,6 +55,13 @@ _BACKOFF_CAP_S = 60.0
 
 # exit codes that restarting cannot help: misuse of the CLI itself
 _NO_RETRY_CODES = (2,)
+
+
+def backoff_delay(attempt, backoff):
+    """Delay before restart ``attempt`` (1-based): backoff * 2^(k-1),
+    capped. Shared with tools/gang_supervisor.py — one budget/backoff
+    policy for both supervision tiers."""
+    return min(_BACKOFF_CAP_S, backoff * (2.0 ** (attempt - 1)))
 
 # the in-process hang watchdog's distinct abort code
 # (mxnet_tpu/telemetry/watchdog.py HANG_EXIT_CODE — mirrored here
@@ -107,6 +120,53 @@ def _kill_child(proc):
         return proc.wait()
 
 
+class FileStallWatch:
+    """The liveness stall rule over ONE file — shared by the
+    single-child tier here and tools/gang_supervisor.py's per-worker
+    watches, so the two supervision tiers cannot drift on the policy:
+
+    - the stat is (size, mtime), not size alone: a sink that hit its
+      MXTPU_TELEMETRY_MAX_MB cap stops GROWING for good but keeps
+      touching the file's mtime at the flush cadence, so a
+      healthy-but-capped child never reads as a hang;
+    - arm at the FIRST observed change (the in-process watchdog's
+      arm-at-first-mark rule): a child that never writes the file at
+      all — telemetry accidentally off, path misconfigured — degrades
+      to plain restart-on-exit supervision instead of a
+      kill-and-relaunch loop of healthy children. The long quiet
+      stretch AFTER the start record (first XLA compile) is still on
+      the operator: the threshold must exceed it
+      (docs/reliability.md)."""
+
+    def __init__(self, path, secs):
+        self.path = path
+        self.secs = secs
+        self.last = self._stat()
+        self.changed = time.time()
+        self.armed = False
+
+    def _stat(self):
+        try:
+            st = os.stat(self.path)
+            return st.st_size, st.st_mtime
+        except OSError:
+            return None   # not created yet
+
+    def stalled(self):
+        """Seconds past the last change when armed + over threshold,
+        else None (also refreshes the watch)."""
+        now = time.time()
+        cur = self._stat()
+        if cur != self.last:
+            self.last = cur
+            self.changed = now
+            self.armed = True
+            return None
+        if self.armed and now - self.changed > self.secs:
+            return now - self.changed
+        return None
+
+
 def _wait_with_liveness(proc, path, secs, quiet=False):
     """Wait for the child, additionally requiring its telemetry JSONL
     at ``path`` to GROW at least every ``secs`` seconds — the
@@ -115,44 +175,20 @@ def _wait_with_liveness(proc, path, secs, quiet=False):
     could observe a timer; file growth stops, and only an outside
     process can act). Returns (exit_code, timed_out). The child's sink
     flushes at least every few seconds (telemetry/export.py
-    _FLUSH_SECS), so buffering cannot masquerade as a hang — and a sink
-    that hit its MXTPU_TELEMETRY_MAX_MB cap stops GROWING for good but
-    keeps touching the file's mtime at the same cadence, so the stat
-    here watches (size, mtime), not size alone: a healthy-but-capped
-    child is never liveness-killed."""
-    def _stat():
-        try:
-            st = os.stat(path)
-            return st.st_size, st.st_mtime
-        except OSError:
-            return None   # not created yet
-
-    last_stat = _stat()
-    last_change = time.time()
-    # arm at the FIRST observed change (the in-process watchdog's
-    # arm-at-first-mark rule): a child that never writes the file at
-    # all — telemetry accidentally off, path misconfigured — degrades
-    # to plain restart-on-exit supervision instead of a kill-and-
-    # relaunch loop of healthy children. The long quiet stretch AFTER
-    # the start record (first XLA compile) is still on the operator:
-    # the threshold must exceed it (docs/reliability.md).
-    armed = False
+    _FLUSH_SECS), so buffering cannot masquerade as a hang; the stall
+    rule itself lives in :class:`FileStallWatch`."""
+    watch = FileStallWatch(path, secs)
     while True:
         try:
             return proc.wait(timeout=_LIVENESS_POLL_S), False
         except subprocess.TimeoutExpired:
             pass
-        stat = _stat()
-        if stat != last_stat:
-            last_stat = stat
-            last_change = time.time()
-            armed = True
-        elif armed and time.time() - last_change > secs:
+        stalled = watch.stalled()
+        if stalled is not None:
             if not quiet:
                 print('train_supervisor: child wrote no telemetry '
                       'records for %.0fs (liveness %.0fs) — killing the '
-                      'wedged child' % (time.time() - last_change, secs),
-                      file=sys.stderr)
+                      'wedged child' % (stalled, secs), file=sys.stderr)
             return _kill_child(proc), True
 
 
@@ -216,7 +252,7 @@ def run(cmd, restart_max, backoff, log_path, quiet=False,
             # never report success for a run abandoned mid-training
             return code if not (timed_out and code == 0) else 1
         attempts += 1
-        delay = min(_BACKOFF_CAP_S, backoff * (2.0 ** (attempts - 1)))
+        delay = backoff_delay(attempts, backoff)
         _record(log_path, {'type': 'restart', 'attempt': attempts,
                            'reason': 'liveness_timeout' if timed_out
                            else 'process_exit',
