@@ -653,6 +653,110 @@ def run_infer_bench(platform, kind):
     return out
 
 
+def run_fused_window_ab(platform):
+    """Donation + BN-one-pass A/B (ISSUE 12) through the REAL
+    Module.fit fused window on a conv+BatchNorm net: the 'pre' arm
+    rebuilds the pre-PR program (MXTPU_FUSED_DONATE=0,
+    MXTPU_BN_ONEPASS=0 — undonated carry, two-pass stats), the 'tuned'
+    arm runs the shipped defaults. Per arm: one warm fit (compiles the
+    window), two timed epochs, then the window program's
+    temp/live/alias bytes off the registrar gauges and the
+    update/upload overlap off the fused_fit.overlap_ms histogram.
+    Banks gracefully on the CPU fallback (the bytes + overlap numbers
+    are real everywhere; the throughput delta only means something on
+    a device backend, noted)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry as _tele
+    from mxnet_tpu.config import flags as _flags
+
+    saved = {v: os.environ.get(v) for v in
+             ('MXTPU_FUSED_DONATE', 'MXTPU_BN_ONEPASS',
+              'MXTPU_FIT_STEPS_PER_CALL')}
+    os.environ['MXTPU_FIT_STEPS_PER_CALL'] = '4'
+    _flags.reload('MXTPU_FIT_STEPS_PER_CALL')
+    batch, windows_per_epoch = 8, 4
+    n = batch * 4 * windows_per_epoch
+    ctx = mx.tpu() if platform.startswith('tpu') else mx.cpu()
+    res = {}
+    try:
+        for arm, (don, bn) in (('pre', ('0', '0')),
+                               ('tuned', ('1', '1'))):
+            os.environ['MXTPU_FUSED_DONATE'] = don
+            os.environ['MXTPU_BN_ONEPASS'] = bn
+            _flags.reload('MXTPU_FUSED_DONATE')
+            _flags.reload('MXTPU_BN_ONEPASS')
+            mx.random.seed(13)
+            rng = np.random.RandomState(13)
+            # distinct symbol names per arm -> distinct program records
+            name = 'fwab_%s' % arm
+            d = mx.sym.Variable('data')
+            h = d
+            for i in range(3):
+                h = mx.sym.Convolution(h, num_filter=32, kernel=(3, 3),
+                                       pad=(1, 1),
+                                       name='%s_conv%d' % (name, i))
+                h = mx.sym.BatchNorm(h, name='%s_bn%d' % (name, i))
+                h = mx.sym.Activation(h, act_type='relu')
+            h = mx.sym.FullyConnected(mx.sym.Flatten(h), num_hidden=16,
+                                      name='%s_fc' % name)
+            sym = mx.sym.SoftmaxOutput(h, name=name)
+            X = rng.standard_normal((n, 3, 16, 16)).astype(np.float32)
+            y = (rng.rand(n) * 16).astype(int).astype(np.float32)
+            it = mx.io.NDArrayIter(X, y, batch_size=batch, shuffle=False,
+                                   label_name='%s_label' % name)
+            mod = mx.mod.Module(sym, context=ctx,
+                                label_names=('%s_label' % name,))
+            okw = dict(optimizer='sgd',
+                       optimizer_params=(('learning_rate', 0.01),
+                                         ('momentum', 0.9)),
+                       eval_metric='acc')
+            t = time.perf_counter()
+            mod.fit(it, num_epoch=1, **okw)      # compile + warm
+            _log('fused-window A/B %s warmup: %.1fs'
+                 % (arm, time.perf_counter() - t))
+            t0 = time.perf_counter()
+            mod.fit(it, begin_epoch=1, num_epoch=3, **okw)
+            dt = time.perf_counter() - t0
+            snap = _tele.snapshot() if _tele.enabled() else {}
+            g = snap.get('gauges', {})
+            pfx = 'program.fused_fit.window[%s].' % name
+            hist = snap.get('histograms', {}).get('fused_fit.overlap_ms')
+            res[arm] = {
+                'img_s': round(2 * n / dt, 2),
+                'temp_bytes': int(g.get(pfx + 'temp_bytes', 0)) or None,
+                'live_bytes': int(g.get(pfx + 'live_bytes', 0)) or None,
+                'alias_bytes': int(g.get(pfx + 'alias_bytes', 0)) or None,
+                'overlap_ms_p50': round(hist['p50'], 3)
+                if hist and hist.get('count') else None}
+            _log('fused-window A/B %s: %.2f img/s, temp=%s live=%s '
+                 'overlap_p50=%s ms'
+                 % (arm, res[arm]['img_s'], res[arm]['temp_bytes'],
+                    res[arm]['live_bytes'], res[arm]['overlap_ms_p50']))
+    finally:
+        for var, old in saved.items():
+            if old is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = old
+            _flags.reload(var)
+    pre, tuned = res['pre'], res['tuned']
+    ab = {'batch': batch, 'pre': pre, 'tuned': tuned,
+          'speedup': round(tuned['img_s'] / max(pre['img_s'], 1e-9), 3)}
+    if pre['live_bytes'] and tuned['live_bytes']:
+        ab['live_bytes_drop_pct'] = round(
+            100.0 * (pre['live_bytes'] - tuned['live_bytes'])
+            / pre['live_bytes'], 1)
+    if pre['temp_bytes'] and tuned['temp_bytes']:
+        ab['temp_bytes_drop_pct'] = round(
+            100.0 * (pre['temp_bytes'] - tuned['temp_bytes'])
+            / pre['temp_bytes'], 1)
+    if platform.startswith('cpu'):
+        ab['note'] = ('cpu arm: the bytes/overlap evidence is real; '
+                      'the img/s delta only means something on a '
+                      'device backend')
+    return ab
+
+
 def run_sharded_update_ab(platform):
     """Sharded-vs-replicated weight-update A/B (MXTPU_SHARDED_UPDATE,
     arXiv:2004.13336) through the REAL Module.fit fused window over a
@@ -1073,6 +1177,10 @@ def main():
         out['health'] = health_probe
     if temp_bytes:
         out['xla_temp_bytes'] = temp_bytes
+    if step_analysis.get('live_bytes'):
+        # steady-state per-dispatch footprint (args + temp + outputs
+        # minus donated-alias bytes): the donation ledger's gated metric
+        out['xla_live_bytes'] = step_analysis['live_bytes']
     if MIRROR:
         out['backward_mirror'] = MIRROR
     if compile_warm_s is not None:
@@ -1109,6 +1217,22 @@ def main():
             sharded_ab = run_sharded_update_ab(platform)
         except Exception as e:  # noqa: BLE001
             _log('sharded-update A/B failed (headline unaffected): %s' % e)
+    # donation + BN-one-pass A/B (ISSUE 12): real Module.fit fused
+    # window, pre-PR program vs shipped defaults — temp/live bytes,
+    # overlap evidence, throughput. Runs after the telemetry fold for
+    # the same contamination rule; banks gracefully on CPU fallback.
+    fused_ab = None
+    if os.environ.get('MXTPU_BENCH_FUSED_AB', '1') != '0':
+        try:
+            fused_ab = run_fused_window_ab(platform)
+        except Exception as e:  # noqa: BLE001
+            _log('fused-window A/B failed (headline unaffected): %s' % e)
+    if fused_ab:
+        out['fused_window_ab'] = fused_ab
+        if fused_ab['tuned'].get('overlap_ms_p50') is not None:
+            # update/upload overlap per window, the ledger's evidence
+            # that the optimizer host tail hides under the transfer
+            out['overlap_ms'] = fused_ab['tuned']['overlap_ms_p50']
     if sharded_ab:
         out['sharded_update_ab'] = sharded_ab
         # top-level copies of the gated/ledger metrics: per-device

@@ -412,16 +412,44 @@ flags.declare('MXTPU_SHARDED_UPDATE', bool, True,
               'anywhere else the update runs replicated (warn-once '
               'when the flag was set explicitly). 0 keeps the '
               'replicated update everywhere')
-flags.declare('MXTPU_BN_ONEPASS', bool, False,
+flags.declare('MXTPU_BN_ONEPASS', bool, True,
               'BatchNorm training stats via one-pass moments '
               '(sum/sum-of-squares in one fused HBM read of the '
               'activation) instead of jnp.var\'s two-pass mean-then-'
-              'centered-square. Default OFF: the on-chip A/B measured '
-              'the one-pass form 5% SLOWER end-to-end on ResNet-50 '
-              '(2406 vs 2535 img/s, bench_bn_*_20260802T061225Z) — '
-              'XLA already fuses the two-pass stats into the '
-              'surrounding graph better than the pivoted '
-              'sum/sum-of-squares form')
+              'centered-square. Default ON since the fused-window '
+              'donation round: with the window\'s buffer economics '
+              'fixed the one HBM read wins where the round-5 A/B '
+              '(2406 vs 2535 img/s, bench_bn_*_20260802T061225Z) '
+              'measured it 5% slower against the pre-donation '
+              'program. 0 is the escape hatch back to the two-pass '
+              'jnp.var form (byte-identical to the old default '
+              'lowering); numerics are parity-tested both ways '
+              '(tests/unittest/test_bn_onepass.py)')
+flags.declare('MXTPU_FUSED_DONATE', bool, True,
+              'Donate the fused-fit window\'s inputs to XLA: the '
+              'param/optimizer/aux carry (aliased onto the matching '
+              'outputs — the weight update runs in place) AND the '
+              'stacked input window + per-step label stacks (freed '
+              'by the runtime at their last in-program use instead '
+              'of surviving until the next window rebinds them, so '
+              'two windows\' stacks never need to be live at once '
+              'under the prefetch pipeline). program.<window>.'
+              'live_bytes / alias_bytes in the registrar carry the '
+              'before/after evidence. 0 disables ALL window '
+              'donation — the undonated reference program the '
+              'donation-safety parity tests compare against')
+flags.declare('MXTPU_REMAT_POLICY', str, '',
+              "Rematerialization policy for the fused-fit window "
+              "body, the roofline block's memory-bound lever: "
+              "'none' = save every forward residual (explicitly "
+              "overrides MXTPU_BACKWARD_DO_MIRROR for the window), "
+              "'dots' = keep matmul/conv results and recompute the "
+              "rest (jax checkpoint_dots policy), 'full' = "
+              "rematerialize the whole forward in backward (max "
+              "temp-memory saving, ~1/3 more FLOPs). Empty (default) "
+              "defers to MXTPU_BACKWARD_DO_MIRROR exactly as before. "
+              "Flipping it between fit() calls rebuilds the window",
+              choices={'', 'none', 'dots', 'full'})
 flags.declare('MXTPU_HOST_CROP', bool, True,
               'In ImageRecordIter device-augment mode, workers crop '
               '(rand or center) to the target HxW before handover, so '

@@ -370,6 +370,18 @@ class SPMDExecutorGroup:
         return NamedSharding(mesh, P(*((None, 'dp') + (None,) * (ndim - 2))))
 
     @staticmethod
+    def replicate_sharding(mesh):
+        """Fully-replicated NamedSharding on ``mesh``. The fused window
+        pins its tiny whole-mesh operands (the scan's s32 step-index
+        vector, the per-step lr/wd rows) with it: left unannotated,
+        GSPMD's partitioner re-derives their placement per use and
+        emits '[spmd] Involuntary full rematerialization' stderr
+        warnings for each one (the PR 9 known residue) — an explicit
+        replicated constraint makes the derivation trivial and the
+        warnings disappear."""
+        return NamedSharding(mesh, P())
+
+    @staticmethod
     def update_sharding(mesh):
         """NamedSharding for an update-phase leaf (the ZeRO layout of
         arXiv:2004.13336): optimizer-state tensors flattened to 1-D and

@@ -158,6 +158,61 @@ def _mirror_flag():
     return flags.get('MXTPU_BACKWARD_DO_MIRROR')
 
 
+def _donate_flag():
+    from ..config import flags
+    flags.reload('MXTPU_FUSED_DONATE')
+    return flags.get('MXTPU_FUSED_DONATE')
+
+
+def _remat_policy():
+    from ..config import flags
+    flags.reload('MXTPU_REMAT_POLICY')
+    return flags.get('MXTPU_REMAT_POLICY')
+
+
+def _bn_onepass_flag():
+    from ..ops.nn import _bn_onepass
+    return bool(_bn_onepass())
+
+
+def _remat_wrap(f):
+    """Per-step remat for the window body: MXTPU_REMAT_POLICY
+    (none/dots/full) is the roofline block's memory-bound lever,
+    scoped to the fused window; empty defers to the process-wide
+    MXTPU_BACKWARD_DO_MIRROR via executor.mirror_wrap exactly as
+    before (so existing mirror configurations lower unchanged)."""
+    policy = _remat_policy()
+    if policy == '':
+        return mirror_wrap(f)
+    if policy == 'none':
+        return f
+    if policy == 'dots':
+        return jax.checkpoint(
+            f,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    return jax.checkpoint(f)
+
+
+def _install_donate_filter():
+    """The window deliberately donates its input/label stacks for their
+    LIFETIME (freed at last in-program use, so window k's and k+1's
+    stacks are never both live under the prefetch pipeline) even though
+    no output aliases them — jax warns 'Some donated buffers were not
+    usable' for exactly that shape of donation, once per compile.
+    Filter that one message; every other donation diagnostic stays.
+    Installed at every donated window BUILD (not once per process):
+    test harnesses save/restore the warnings filter list around each
+    case, and a once-guard would leave later builds unfiltered. The
+    presence check keeps a long-lived process that rebuilds windows
+    many times from growing warnings.filters unboundedly."""
+    import warnings
+    msg = 'Some donated buffers were not usable'
+    for f in warnings.filters:
+        if f[0] == 'ignore' and getattr(f[1], 'pattern', None) == msg:
+            return
+    warnings.filterwarnings('ignore', message=msg)
+
+
 def _is_half(dt):
     return str(dt) in ('float16', 'bfloat16')
 
@@ -397,7 +452,8 @@ class FusedFitLoop:
         self._pipe = WindowPipeline(window,
                                     device_fn=lambda: e._ctx.jax_device(),
                                     mesh=self._mesh,
-                                    span_prefix='fused_fit')
+                                    span_prefix='fused_fit',
+                                    donate=bool(_donate_flag()))
         # training-health sentinels: captured at loop build (build_cached
         # keys reuse on the flag) — None keeps the traced window
         # byte-identical to the plain form
@@ -509,7 +565,13 @@ class FusedFitLoop:
                        getattr(module._kvstore, 'type', None),
                        _window_size(), bool(_shard_update_enabled()),
                        bool(getattr(module, 'sharded_update', True)),
-                       str(_mirror_flag()), msig,
+                       str(_mirror_flag()), str(_remat_policy()),
+                       bool(_donate_flag()),
+                       # BatchNorm's stats form is traced INTO the
+                       # window — flipping MXTPU_BN_ONEPASS between
+                       # fit() calls must rebuild the loop (a cached
+                       # program would silently keep the old math)
+                       _bn_onepass_flag(), msig,
                        # the health sentinels are traced INTO the window
                        # program — flipping MXTPU_HEALTH between fit()
                        # calls must rebuild the loop
@@ -654,6 +716,16 @@ class FusedFitLoop:
         W = self.window
         mesh = self._mesh
         defer_fn = self._defer_fn   # traced INTO the program (or None)
+        donate = _donate_flag()
+        rep_pin = None
+        if mesh is not None:
+            # tiny whole-mesh operands (the s32 step-index vector, the
+            # per-step lr/wd rows) get an explicit replicated pin: left
+            # unannotated, GSPMD re-derives their placement per use and
+            # prints an '[spmd] Involuntary full rematerialization'
+            # stderr warning for each (the PR 9 known residue)
+            from .executor_group import SPMDExecutorGroup
+            rep_pin = SPMDExecutorGroup.replicate_sharding(mesh)
         shard_update = self._zero is not None
         if shard_update:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -713,7 +785,7 @@ class FusedFitLoop:
                     return run(tuple(full), aux, k, True)
 
                 wrt = tuple(params[i] for i in grad_carry_idx)
-                (outs, new_aux), vjp = jax.vjp(mirror_wrap(f), wrt)
+                (outs, new_aux), vjp = jax.vjp(_remat_wrap(f), wrt)
                 heads = tuple(jnp.ones(o.shape, o.dtype) for o in outs)
                 zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
                 (grads,) = vjp((heads, zero_aux))
@@ -741,6 +813,15 @@ class FusedFitLoop:
                     res = ops[modes[n]].fn(attrs, w, g, *st)
                     if not isinstance(res, tuple):
                         res = (res,)
+                    # the traced lr/wd scalars are strong f32 where the
+                    # imperative path feeds weak python floats: without
+                    # this cast a bf16 weight/state promotes to f32 in
+                    # the update and the scan carry rejects the dtype
+                    # drift (found by the bf16 BN parity tests)
+                    ins = (w,) + tuple(st)
+                    res = tuple(r.astype(i.dtype)
+                                if r.dtype != i.dtype else r
+                                for r, i in zip(res, ins))
                     if shard_update:
                         # only the WEIGHT re-gathers (the next forward
                         # needs it whole); optimizer states stay flat +
@@ -774,17 +855,34 @@ class FusedFitLoop:
                 return (tuple(new_params), tuple(new_states), new_aux,
                         gaccs), ys
 
+            step_idx = jnp.arange(W)
+            lr_xs = jnp.asarray(lr_arr)
+            wd_xs = jnp.asarray(wd_arr)
+            if rep_pin is not None:
+                step_idx = jax.lax.with_sharding_constraint(step_idx,
+                                                            rep_pin)
+                lr_xs = jax.lax.with_sharding_constraint(lr_xs, rep_pin)
+                wd_xs = jax.lax.with_sharding_constraint(wd_xs, rep_pin)
             (p, s, a, g), ys = jax.lax.scan(
                 body, (params, states, aux, gaccs),
-                (jnp.arange(W), data_stack, label_stack,
-                 jnp.asarray(lr_arr), jnp.asarray(wd_arr)))
+                (step_idx, data_stack, label_stack, lr_xs, wd_xs))
             return p, s, a, g, ys
 
         # the train-step program of the fused path: its XLA cost
         # analysis (scan body counted once = per-step FLOPs) feeds the
-        # framework-computed MFU through the registrar
-        return registered_jit(self._prog_name, window_fn,
-                              step_flops=True, donate_argnums=(0, 1, 2, 3))
+        # framework-computed MFU through the registrar. Donation
+        # (MXTPU_FUSED_DONATE): the param/state/aux/gacc carry aliases
+        # in place onto the matching outputs, and the input/label
+        # stacks are donated for their lifetime — the runtime frees
+        # them at their last in-program use, so the prefetched next
+        # window's stacks never coexist with this window's. =0 builds
+        # the undonated reference program (bit-exact numerics, parity-
+        # tested) for A/B evidence.
+        if donate:
+            _install_donate_filter()
+        return registered_jit(
+            self._prog_name, window_fn, step_flops=True,
+            donate_argnums=(0, 1, 2, 3, 4, 5) if donate else ())
 
     # -- ZeRO state layout -------------------------------------------------
     def zero_wrapper_shapes(self):
@@ -1182,15 +1280,24 @@ class FusedFitLoop:
                     # containing the armed step is dispatched
                     _faults.maybe_raise('dispatch', upcoming=self.window)
                 params, states, aux, gaccs = self._snapshot()
+                # the optimizer's host tail — W x n_params update-count
+                # walks + lr/wd sampling, plus the snapshot above —
+                # runs BEFORE the put wait, so it hides under window
+                # k+1's side-thread transfer instead of serializing
+                # after it (the update/upload overlap; the resolver's
+                # hidden_ms below is the evidence)
+                lr_arr, wd_arr = self._sample_window_lr()
                 _t = _clk() if _timing else 0.0
                 with _tele.span('fused_fit.put', 'fused_fit'):
                     data_stack, label_stack = fut()
+                if pool is not None:
+                    _tele.histogram('fused_fit.overlap_ms').observe(
+                        fut.hidden_ms)
                 if _timing:
                     _now = _clk()
                     _tm['put'] += _now - _t
                     _t = _now
                 with _tele.span('fused_fit.dispatch', 'fused_fit'):
-                    lr_arr, wd_arr = self._sample_window_lr()
                     self._base_key = _random.next_key()
                     params, states, aux, gaccs, pieces = window_fn(
                         params, states, aux, gaccs, data_stack, label_stack,

@@ -275,7 +275,8 @@ class WindowPipeline:
     fit()/score() calls, so the upload pool it carries does too.
     """
 
-    def __init__(self, window, device_fn, mesh=None, span_prefix='window'):
+    def __init__(self, window, device_fn, mesh=None, span_prefix='window',
+                 donate=False):
         self.window = window
         self.mesh = mesh
         self._device_fn = device_fn
@@ -283,6 +284,14 @@ class WindowPipeline:
         self._dev_cache_key = None
         self._dev_cache = None
         self._pool_obj = None
+        # donate=True: the consuming program DONATES the window stacks
+        # to XLA, so a device stack handed out once is dead — the
+        # identity cache then holds the HOST-side stacked arrays (the
+        # np.stack memcpy is still saved) and the device transfer runs
+        # fresh per window. The owning loop sets it to match its
+        # program's donate_argnums (fused_fit honors MXTPU_FUSED_DONATE;
+        # fused_eval never donates its read-only stacks).
+        self.donate = donate
 
     # -- draw --------------------------------------------------------------
     def collect(self, it, limit=None):
@@ -313,24 +322,24 @@ class WindowPipeline:
         arrays every batch, so the transfer happens once. The cache key
         holds STRONG references to the source arrays — identity is
         compared against live objects, so a freed array's id can never
-        produce a false hit."""
+        produce a false hit.
+
+        With ``donate`` set the device stacks are consumed by the
+        dispatch, so the cache stores the HOST-side stacks (or, for
+        device-resident sources, the unstacked parts) instead and
+        re-runs the device transfer per window (the prefetch pool hides
+        it behind window k's compute) — returning a cached device array
+        would hand the program an already-deleted donated buffer."""
         arrays = [a for ds, ls, _, _ in snaps for a in ds + ls]
         if self._dev_cache_key is not None and \
                 len(arrays) == len(self._dev_cache_key) and \
                 all(a is c for a, c in zip(arrays, self._dev_cache_key)):
-            return self._dev_cache
+            if not self.donate:
+                return self._dev_cache
+            data_e, label_e = self._dev_cache
+            return (tuple(self._realize(e) for e in data_e),
+                    tuple(self._realize(e) for e in label_e))
         key = arrays
-
-        def shard(stack):
-            if self.mesh is None:
-                # source arrays may be committed to the host device
-                # (cpu_pinned iterators); the window runs where the
-                # executor's params live
-                return jax.device_put(stack, self._device_fn())
-            from .executor_group import SPMDExecutorGroup
-            return jax.device_put(
-                stack, SPMDExecutorGroup.window_sharding(self.mesh,
-                                                         stack.ndim))
 
         def _on_host(a):
             if isinstance(a, np.ndarray):
@@ -340,23 +349,46 @@ class WindowPipeline:
             except Exception:  # noqa: BLE001 — tracer/abstract array
                 return False
 
-        def stack(parts):
+        def build(parts):
             # host-resident parts (defer-mode uint8 batches and their
             # labels) stack on the host so the whole window crosses to
-            # the device in shard()'s ONE device_put — W per-batch
+            # the device in _realize()'s ONE device_put — W per-batch
             # transfers each cost a full dispatch RTT on a tunneled
-            # runtime
+            # runtime. Device-resident parts stay unstacked in the
+            # cache entry (the stacked device buffer is donate-consumed,
+            # but the sources remain valid to restack from).
             if all(_on_host(p) for p in parts):
-                return np.stack([np.asarray(p) for p in parts])
-            return jnp.stack([jnp.asarray(p) for p in parts])
+                return ('host', np.stack([np.asarray(p) for p in parts]))
+            return ('dev', tuple(parts))
 
-        data_stack = [shard(stack([ds[i] for ds, _, _, _ in snaps]))
-                      for i in range(len(snaps[0][0]))]
-        label_stack = [shard(stack([ls[i] for _, ls, _, _ in snaps]))
-                       for i in range(len(snaps[0][1]))]
+        data_e = [build([ds[i] for ds, _, _, _ in snaps])
+                  for i in range(len(snaps[0][0]))]
+        label_e = [build([ls[i] for _, ls, _, _ in snaps])
+                   for i in range(len(snaps[0][1]))]
+        data_stack = tuple(self._realize(e) for e in data_e)
+        label_stack = tuple(self._realize(e) for e in label_e)
         self._dev_cache_key = key
-        self._dev_cache = (tuple(data_stack), tuple(label_stack))
-        return self._dev_cache
+        self._dev_cache = (data_e, label_e) if self.donate \
+            else (data_stack, label_stack)
+        return data_stack, label_stack
+
+    def _realize(self, entry):
+        """One cache entry -> a fresh placed device stack."""
+        kind, v = entry
+        stack = v if kind == 'host' \
+            else jnp.stack([jnp.asarray(p) for p in v])
+        return self._shard(stack)
+
+    def _shard(self, stack):
+        if self.mesh is None:
+            # source arrays may be committed to the host device
+            # (cpu_pinned iterators); the window runs where the
+            # executor's params live
+            return jax.device_put(stack, self._device_fn())
+        from .executor_group import SPMDExecutorGroup
+        return jax.device_put(
+            stack, SPMDExecutorGroup.window_sharding(self.mesh,
+                                                     stack.ndim))
 
     def pool(self):
         """One-thread executor for the pipelined window upload. A
@@ -371,12 +403,43 @@ class WindowPipeline:
     def start_put(self, snaps, pool):
         """Begin the window's host-stack + device transfer; returns a
         no-arg resolver. With a pool, the stack + put for window k+1
-        run on the side thread while window k computes on device and
-        k-1's fetch waits."""
+        run on the side thread while window k computes on device, the
+        previous window's stats fetch waits, and the optimizer's
+        host-side window bookkeeping runs — the update/upload overlap.
+
+        The resolver carries ``hidden_ms`` after it is called: the
+        share of the side thread's stack+put wall time the main thread
+        did NOT wait for (put duration minus blocked time) — the
+        ``fused_fit.overlap_ms`` evidence that the transfer actually
+        hid under host work rather than serializing in front of the
+        dispatch."""
+        import time
         if pool is None:
             res = self.device_batches(snaps)
-            return lambda: res
-        return pool.submit(self.device_batches, snaps).result
+
+            def resolver():
+                return res
+            resolver.hidden_ms = 0.0   # serial mode hides nothing
+            return resolver
+        done = {}
+
+        def task():
+            t0 = time.perf_counter()
+            try:
+                return self.device_batches(snaps)
+            finally:
+                done['dur'] = time.perf_counter() - t0
+        fut = pool.submit(task)
+
+        def resolver():
+            t0 = time.perf_counter()
+            out = fut.result()
+            waited = time.perf_counter() - t0
+            resolver.hidden_ms = max(
+                0.0, done.get('dur', 0.0) - waited) * 1e3
+            return out
+        resolver.hidden_ms = 0.0
+        return resolver
 
     @staticmethod
     def drain(fut):

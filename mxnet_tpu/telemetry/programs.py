@@ -46,7 +46,7 @@ _oom_reported = False
 
 _ANALYSIS_FIELDS = ('flops', 'bytes_accessed', 'temp_bytes',
                     'argument_bytes', 'output_bytes',
-                    'generated_code_bytes')
+                    'generated_code_bytes', 'alias_bytes', 'live_bytes')
 
 
 def _state():
@@ -59,7 +59,7 @@ def _state():
 def _empty_analysis():
     return {'flops': 0.0, 'bytes_accessed': 0.0, 'temp_bytes': 0,
             'argument_bytes': 0, 'output_bytes': 0,
-            'generated_code_bytes': 0}
+            'generated_code_bytes': 0, 'alias_bytes': 0, 'live_bytes': 0}
 
 
 def analyze_compiled(compiled):
@@ -84,8 +84,17 @@ def analyze_compiled(compiled):
                             ('argument_bytes', 'argument_size_in_bytes'),
                             ('output_bytes', 'output_size_in_bytes'),
                             ('generated_code_bytes',
-                             'generated_code_size_in_bytes')):
+                             'generated_code_size_in_bytes'),
+                            ('alias_bytes', 'alias_size_in_bytes')):
             rec[field] = int(getattr(ma, attr, 0) or 0)
+        # steady-state footprint of one dispatch: args + temps + outputs
+        # minus the donated-input bytes the outputs alias in place. The
+        # donation ledger: aliasing a carry moves its output bytes into
+        # alias_bytes, so live_bytes is what a window actually makes
+        # XLA hold beyond the buffers the caller already owns.
+        rec['live_bytes'] = max(0, rec['argument_bytes']
+                                + rec['temp_bytes'] + rec['output_bytes']
+                                - rec['alias_bytes'])
     except Exception as e:  # noqa: BLE001
         logging.debug('telemetry: memory_analysis unavailable: %s', e)
     return rec
@@ -123,8 +132,10 @@ def note_program(name, compiled=None, analysis=None, step_flops=False,
             # a name can cover several compiled variants (shape
             # variants, train/eval forms): keep the LARGEST value per
             # field — the conservative bound the OOM report and MFU
-            # want, instead of whichever variant compiled last
-            rec[f] = max(rec[f], analysis[f])
+            # want, instead of whichever variant compiled last.
+            # .get(): hand-crafted analysis dicts (tests, older
+            # callers) may predate the alias/live fields
+            rec[f] = max(rec[f], analysis.get(f, 0))
         merged = {f: rec[f] for f in _ANALYSIS_FIELDS}
         rec['compiles'] += 1
     reg = st.registry
@@ -134,6 +145,8 @@ def note_program(name, compiled=None, analysis=None, step_flops=False,
     reg.gauge('program.%s.bytes_accessed' % name).set(
         merged['bytes_accessed'])
     reg.gauge('program.%s.temp_bytes' % name).set(merged['temp_bytes'])
+    reg.gauge('program.%s.alias_bytes' % name).set(merged['alias_bytes'])
+    reg.gauge('program.%s.live_bytes' % name).set(merged['live_bytes'])
     if step_flops and analysis['flops']:
         # the train-step program: its FLOPs feed the MFU estimate. XLA
         # counts a scan (while-loop) body ONCE regardless of trip
@@ -151,7 +164,7 @@ def note_program(name, compiled=None, analysis=None, step_flops=False,
         xla.note_step_flops(fed)
     if st.sink is not None:
         out = {'type': 'program', 'name': name}
-        out.update({f: analysis[f] for f in _ANALYSIS_FIELDS})
+        out.update({f: analysis.get(f, 0) for f in _ANALYSIS_FIELDS})
         if compile_s is not None:
             out['compile_s'] = round(float(compile_s), 3)
         st.sink.emit(out)

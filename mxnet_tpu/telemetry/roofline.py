@@ -60,7 +60,8 @@ import threading
 
 __all__ = ['enabled', 'note_compiled', 'note_hlo', 'hlo_layer_costs',
            'load_trace_events', 'analyze', 'summarize',
-           'snapshot_roofline', 'comm_bytes_by_op', 'TOP_N',
+           'snapshot_roofline', 'comm_bytes_by_op', 'suggest_action',
+           'RECLAIM_ACTIONS', 'TOP_N',
            'OVERHEAD_UTIL_PCT', 'CLASS_COMPUTE', 'CLASS_MEMORY',
            'CLASS_OVERHEAD']
 
@@ -71,6 +72,27 @@ CLASS_COMPUTE = 'compute-bound'
 CLASS_MEMORY = 'memory-bound'
 CLASS_OVERHEAD = 'overhead-bound'
 CLASS_UNKNOWN = 'unknown'  # no peak table entry for this device
+
+# class -> the concrete lever to pull (the docs/perf.md "Closing the
+# MFU gap" guide, kept next to the classifier so the two never drift):
+# which knob in THIS codebase reclaims a layer of that class
+RECLAIM_ACTIONS = {
+    CLASS_MEMORY: 'cut HBM traffic: MXTPU_BN_ONEPASS=1 one-pass stats, '
+                  'full window donation (MXTPU_FUSED_DONATE=1), '
+                  'layout work',
+    CLASS_COMPUTE: 'remove work: MXTPU_REMAT_POLICY=none keeps forward '
+                   'residuals (no backward recompute); shrink the math',
+    CLASS_OVERHEAD: 'fuse/batch: raise MXTPU_FIT_STEPS_PER_CALL, keep '
+                    'the upload overlapped (MXTPU_FUSED_FIT_PREFETCH=1); '
+                    'MXTPU_REMAT_POLICY=dots/full if temp-bound',
+}
+
+
+def suggest_action(cls):
+    """The lever string for a bottleneck class ('' for unknown): what
+    docs/perf.md's class->action guide says to pull, machine-readable
+    so the worst layer's record/gauge names its remedy directly."""
+    return RECLAIM_ACTIONS.get(cls, '')
 
 # HLO opcode prefixes that move bytes between chips instead of running
 # math — the communication-accounting family ('-start' variants match
@@ -687,6 +709,8 @@ def analyze(step_time_ms=None, events=None, trace_path=None,
         if step_time_ms is not None else None,
         'trace_steps': trace_steps,
         'layers': out_rows,
+        'worst_action': suggest_action(out_rows[0]['class'])
+        if out_rows else None,
         'comm': comm,
     }
 
@@ -764,6 +788,11 @@ def summarize(step_time_ms=None):
         worst = d['layers'][0]
         reg.gauge('roofline.worst_layer').set(worst['layer'])
         reg.gauge('roofline.worst_class').set(worst['class'])
+        # unconditionally, so an 'unknown'-class round ('' action)
+        # never leaves a previous round's lever string stale next to
+        # the updated worst_layer/worst_class pair
+        reg.gauge('roofline.worst_action').set(
+            d.get('worst_action') or '')
         if worst['roof_pct'] is not None:
             reg.gauge('roofline.worst_roof_pct').set(worst['roof_pct'])
         if worst['headroom_ms'] is not None:

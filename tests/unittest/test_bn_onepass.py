@@ -1,0 +1,160 @@
+"""MXTPU_BN_ONEPASS default flip (ISSUE 12): parity + escape hatch.
+
+The one-pass shifted-moments BatchNorm (one fused HBM read for
+sum/sum-of-squares) is now the DEFAULT; the flag stays as the escape
+hatch back to the two-pass jnp.var form. Contracts pinned here:
+
+- numerics: one-pass vs two-pass training agrees within float
+  tolerance across {fused window, per-batch} x {fp32, bf16}, for both
+  the training forward (batch stats) and the eval forward (moving
+  stats) — the accuracy ORACLE (one-pass at least as close to a
+  float64 reference as two-pass) is test_operator_extended.py's
+  test_batchnorm_onepass_matches_twopass;
+- the escape hatch is exact: MXTPU_BN_ONEPASS=0 lowers byte-
+  identically to the two-pass program (the pre-flip default);
+- the default really flipped: an unset environment means one-pass.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+
+_FLAGS = ('MXTPU_BN_ONEPASS', 'MXTPU_FUSED_FIT',
+          'MXTPU_FIT_STEPS_PER_CALL')
+
+
+def _reload():
+    for f in _FLAGS:
+        flags.reload(f)
+
+
+@pytest.fixture
+def clean_flags(monkeypatch):
+    monkeypatch.setenv('MXTPU_FIT_STEPS_PER_CALL', '4')
+    _reload()
+    telemetry._reset_for_tests()
+    yield monkeypatch
+    telemetry._reset_for_tests()
+    for f in _FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload()
+
+
+def _bn_net(dtype):
+    d = mx.sym.Variable('data')
+    if dtype == 'bfloat16':
+        d = mx.sym.Cast(d, dtype='bfloat16')
+    h = d
+    for i in range(2):
+        h = mx.sym.Convolution(h, num_filter=8, kernel=(3, 3),
+                               pad=(1, 1), name='conv%d' % i)
+        h = mx.sym.BatchNorm(h, name='bn%d' % i, fix_gamma=False)
+        h = mx.sym.Activation(h, act_type='relu', name='relu%d' % i)
+    h = mx.sym.FullyConnected(mx.sym.Flatten(h), num_hidden=10,
+                              name='fc')
+    return mx.sym.SoftmaxOutput(h, name='softmax')
+
+
+def _train(onepass, fused, dtype, seed=11):
+    """Fresh module, fixed seed; returns (arg params, aux params,
+    eval-forward outputs on held-out data)."""
+    import os
+    os.environ['MXTPU_BN_ONEPASS'] = onepass
+    os.environ['MXTPU_FUSED_FIT'] = fused
+    _reload()
+    mx.random.seed(seed)
+    rng = np.random.RandomState(seed)
+    n, bs = 32, 8
+    X = rng.standard_normal((n, 3, 8, 8)).astype(np.float32)
+    y = (rng.rand(n) * 10).astype(int).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=bs)
+    mod = mx.mod.Module(_bn_net(dtype), context=mx.cpu())
+    mod.fit(it, num_epoch=2, optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.05),
+                              ('momentum', 0.9)),
+            eval_metric='acc')
+    if fused == '1':
+        assert mod.__dict__.get('_fused_fit_cache'), \
+            'fused path did not engage'
+    args = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    aux = {k: v.asnumpy() for k, v in mod.get_params()[1].items()}
+    # eval forward (is_train=False -> moving stats): held-out batch
+    Xv = rng.standard_normal((bs, 3, 8, 8)).astype(np.float32)
+    vit = mx.io.NDArrayIter(Xv, None, batch_size=bs)
+    preds = mod.predict(vit).asnumpy()
+    return args, aux, preds
+
+
+@pytest.mark.parametrize('fused', ['1', '0'])
+@pytest.mark.parametrize('dtype', ['float32', 'bfloat16'])
+def test_onepass_parity(clean_flags, fused, dtype):
+    """Train + eval parity, one-pass vs two-pass, on the fused window
+    and the per-batch reference loop, fp32 and bf16. The two stats
+    forms differ at unit-roundoff of the normalized activation; after
+    two epochs the accumulated divergence stays within float tolerance
+    of the compute dtype."""
+    a1, x1, p1 = _train('1', fused, dtype)
+    a0, x0, p0 = _train('0', fused, dtype)
+    rtol, atol = (1e-3, 1e-4) if dtype == 'float32' else (5e-2, 5e-2)
+    assert set(a1) == set(a0) and set(x1) == set(x0)
+    for k in a1:
+        np.testing.assert_allclose(a1[k], a0[k], rtol=rtol, atol=atol,
+                                   err_msg=k)
+    for k in x1:   # moving mean/var: the training-stats accumulators
+        np.testing.assert_allclose(x1[k], x0[k], rtol=rtol, atol=atol,
+                                   err_msg=k)
+    np.testing.assert_allclose(p1, p0, rtol=rtol, atol=atol)
+
+
+def test_fused_and_per_batch_agree_under_onepass(clean_flags):
+    """The default config (one-pass, fused): fused window vs per-batch
+    reference loop stay in parity — the BN change must not open a gap
+    between the two fit paths."""
+    a_f, x_f, p_f = _train('1', '1', 'float32')
+    a_r, x_r, p_r = _train('1', '0', 'float32')
+    for k in a_f:
+        np.testing.assert_allclose(a_f[k], a_r[k], rtol=1e-4,
+                                   atol=1e-5, err_msg=k)
+    np.testing.assert_allclose(p_f, p_r, rtol=1e-4, atol=1e-5)
+
+
+def test_flag_off_lowers_byte_identical_two_pass(clean_flags):
+    """MXTPU_BN_ONEPASS=0 is an exact escape hatch: the traced BN
+    program equals (byte-for-byte, as StableHLO text) the two-pass
+    form — i.e. today's flag-off program IS the pre-flip default
+    program — while the one-pass default lowers differently."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ops import nn as nn_ops
+
+    attrs = {'eps': 1e-3, 'momentum': 0.9, 'fix_gamma': False,
+             'use_global_stats': False, 'axis': 1,
+             '__is_train__': True}
+    args = (jnp.ones((8, 4, 5, 5)), jnp.ones((4,)), jnp.zeros((4,)),
+            jnp.zeros((4,)), jnp.ones((4,)))
+
+    def lower():
+        return jax.jit(
+            lambda *a: nn_ops._batch_norm(attrs, *a)).lower(*args)\
+            .as_text()
+
+    clean_flags.setenv('MXTPU_BN_ONEPASS', '0')
+    _reload()
+    flag_off = lower()
+    clean_flags.setenv('MXTPU_BN_ONEPASS', '1')
+    _reload()
+    flag_on = lower()
+    assert flag_on != flag_off, 'flag must route the stats form'
+    # forced two-pass (the pre-flip branch, independent of the env)
+    clean_flags.setattr(nn_ops, '_bn_onepass', lambda: False)
+    forced = lower()
+    assert flag_off == forced
+
+
+def test_default_is_onepass(clean_flags):
+    """Unset environment -> one-pass (the flipped default)."""
+    clean_flags.delenv('MXTPU_BN_ONEPASS', raising=False)
+    flags.reload('MXTPU_BN_ONEPASS')
+    assert flags.get('MXTPU_BN_ONEPASS') is True

@@ -325,10 +325,19 @@ def test_bench_diff_gates_opt_state_bytes(tmp_path, capsys):
     b.write_text(json.dumps(_bench_rec(opt_state_bytes_per_device=1600)))
     assert bench_diff.main([str(a), str(b), '--tol-pct', '0.1']) == 0
     capsys.readouterr()
-    # absent on one side: skipped, not a verdict
+    # absent on one side: skipped, not a verdict — and recapped in the
+    # trailing ungated-metrics note (never a silent pass)
     b.write_text(json.dumps(_bench_rec()))
     assert bench_diff.main([str(a), str(b)]) == 0
-    assert 'skipped (missing on one side)' in capsys.readouterr().out
+    out = capsys.readouterr().out
+    assert 'skipped (missing in new run)' in out
+    assert 'note: ungated this round' in out
+    # the symmetric case: the baseline predates the metric entirely
+    a2 = tmp_path / 'a2.json'
+    a2.write_text(json.dumps(_bench_rec()))
+    b.write_text(json.dumps(_bench_rec(opt_state_bytes_per_device=12800)))
+    assert bench_diff.main([str(a2), str(b)]) == 0
+    assert 'skipped (no baseline)' in capsys.readouterr().out
 
 
 def test_telemetry_watch_renders_opt_state_line():
@@ -437,6 +446,86 @@ def test_roofline_report_golden(tmp_path, capsys):
         '  comm              1.0 MiB/step, 0.840 ms = 6.700% of step,'
         ' overlap 40.000% (measured; all-reduce 1.0 MiB)\n')
     assert out == golden
+
+
+def _roof_dict(step_ms, conv_ms, conv_head, fc_ms, fc_head,
+               extra_layer=None):
+    layers = [
+        {'layer': 'conv1', 'class': 'memory-bound', 'flops': 1e9,
+         'bytes': 5e8, 'time_ms': conv_ms, 'ai': 2.0,
+         'achieved_flops_s': 1.0, 'achieved_bytes_s': 1.0,
+         'roof_pct': 20.0, 'headroom_ms': conv_head},
+        {'layer': 'fc1', 'class': 'compute-bound', 'flops': 2e9,
+         'bytes': 1e8, 'time_ms': fc_ms, 'ai': 20.0,
+         'achieved_flops_s': 1.0, 'achieved_bytes_s': 1.0,
+         'roof_pct': 80.0, 'headroom_ms': fc_head}]
+    if extra_layer:
+        layers.append(dict(layers[0], layer=extra_layer))
+    return {'program': 'fused_fit.window[softmax]', 'source': 'modeled',
+            'device': 'cpu', 'peaks': 'nominal', 'peak_tflops': 0.1,
+            'peak_hbm_gbs': 50.0, 'step_time_ms': step_ms,
+            'layers': layers}
+
+
+def test_roofline_diff_headroom_reclaimed(tmp_path, capsys):
+    """tools/roofline_diff matches layers by name across two roofline
+    records and ranks headroom reclaimed — the re-measure step of the
+    MFU-gap workflow. Accepts a telemetry JSONL on one side and a
+    BENCH json (telemetry.roofline, harness wrapper form) on the
+    other; layers present on only one side are listed, never
+    silently dropped."""
+    import json
+    import roofline_diff
+    before = tmp_path / 'before.jsonl'
+    with open(before, 'w') as f:
+        f.write(json.dumps(dict(_roof_dict(10.0, 4.0, 3.0, 2.0, 0.5,
+                                           extra_layer='bn1'),
+                                type='roofline', t=1.0)) + '\n')
+    after = tmp_path / 'after.json'
+    after.write_text(json.dumps(
+        {'n': 1, 'rc': 0,
+         'parsed': {'metric': 'x', 'value': 1.0,
+                    'telemetry': {'roofline': _roof_dict(
+                        7.0, 1.5, 0.5, 2.0, 0.5)}}}))
+    assert roofline_diff.main([str(before), str(after)]) == 0
+    out = capsys.readouterr().out
+    assert 'step_time_ms      10 -> 7' in out
+    assert 'conv1' in out and '2.5' in out     # 3.0 - 0.5 reclaimed
+    assert 'gone in new: bn1' in out
+    assert 'total headroom reclaimed: 2.5 ms/step' in out
+    # --json round-trips the diff dict
+    assert roofline_diff.main([str(before), str(after), '--json']) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d['total_reclaimed_ms'] == 2.5
+    assert d['layers'][0]['layer'] == 'conv1'
+    assert d['layers'][0]['reclaimed_ms'] == 2.5
+    assert d['only_old'] == ['bn1']
+    # a record-less artifact is a loud error, not an empty diff
+    empty = tmp_path / 'empty.jsonl'
+    empty.write_text(json.dumps({'type': 'start', 'pid': 1}) + '\n')
+    with pytest.raises(SystemExit, match='no roofline record'):
+        roofline_diff.main([str(empty), str(after)])
+
+
+def test_bench_diff_gates_live_bytes(tmp_path, capsys):
+    """xla_live_bytes (steady-state per-dispatch footprint, the
+    donation ledger) is gated at 10%: a donation regression — the
+    aliased carry coming back as fresh outputs — fails the gate;
+    a drop never does."""
+    import json
+    import bench_diff
+    a = tmp_path / 'a.json'
+    b = tmp_path / 'b.json'
+    a.write_text(json.dumps(_bench_rec(xla_live_bytes=500000000)))
+    b.write_text(json.dumps(_bench_rec(xla_live_bytes=540000000)))
+    assert bench_diff.main([str(a), str(b)]) == 0   # +8% < 10%
+    capsys.readouterr()
+    b.write_text(json.dumps(_bench_rec(xla_live_bytes=900000000)))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    assert 'REGRESSION: xla_live_bytes' in capsys.readouterr().out
+    b.write_text(json.dumps(_bench_rec(xla_live_bytes=100000000)))
+    assert bench_diff.main([str(a), str(b), '--tol-pct', '0.1']) == 0
+    capsys.readouterr()
 
 
 def test_telemetry_report_renders_roofline_block(tmp_path, capsys):
