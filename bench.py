@@ -653,6 +653,98 @@ def run_infer_bench(platform, kind):
     return out
 
 
+def run_serving_bench(platform):
+    """Closed-loop load generator against the in-process serving plane
+    (mxnet_tpu/serving, ISSUE 13): a ServingEngine over a small MLP
+    with the bucket ladder pre-warmed, a DynamicBatcher in front, and
+    K client threads each running a closed request loop (send 1-4
+    rows, wait for the answer, repeat) — no HTTP, so the numbers
+    measure queue+coalesce+dispatch+split, not socket overhead.
+    Banks serving_p50_ms / serving_p99_ms / serving_throughput_rps /
+    pad_fraction (tools/bench_diff.py gates the p99 at 10%)."""
+    import threading as _threading
+    import mxnet_tpu as mx
+    from mxnet_tpu.serving import DynamicBatcher, ServingEngine
+
+    clients = int(os.environ.get('MXTPU_BENCH_SERVE_CLIENTS', '4'))
+    per_client = int(os.environ.get('MXTPU_BENCH_SERVE_REQS', '50'))
+    max_batch = int(os.environ.get('MXTPU_BENCH_SERVE_MAX_BATCH', '16'))
+    hidden = 64
+    _log('serving bench: %d clients x %d closed-loop requests, '
+         'bucket ladder up to %d...' % (clients, per_client, max_batch))
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=hidden, name='srv_fc1')
+    act = mx.sym.Activation(fc1, act_type='relu', name='srv_relu')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=8, name='srv_fc2')
+    sym = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    ctx = mx.tpu() if platform.startswith('tpu') else mx.cpu()
+    mod = mx.mod.Module(sym, context=ctx)
+    mod.bind(data_shapes=[('data', (max_batch, 16))], for_training=False)
+    mod.init_params()
+    engine = ServingEngine(mod, max_batch=max_batch)
+    t = time.perf_counter()
+    engine.warmup()
+    warm_s = time.perf_counter() - t
+    batcher = DynamicBatcher(engine, max_wait_ms=2.0).start()
+
+    lats, errors = [], [0]
+    lock = _threading.Lock()
+
+    def client(seed):
+        rng = np.random.RandomState(seed)
+        mine = []
+        for _ in range(per_client):
+            rows = int(rng.randint(1, 5))
+            x = rng.standard_normal((rows, 16)).astype(np.float32)
+            t0 = time.perf_counter()
+            try:
+                batcher.predict([x], timeout=60)
+            except Exception:  # noqa: BLE001 — counted, never fatal
+                with lock:
+                    errors[0] += 1
+                continue
+            mine.append((time.perf_counter() - t0) * 1e3)
+        with lock:
+            lats.extend(mine)
+
+    threads = [_threading.Thread(target=client, args=(1000 + i,))
+               for i in range(clients)]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    wall = time.perf_counter() - t0
+    log = list(batcher.dispatch_log)
+    batcher.close()
+    if not lats:
+        raise RuntimeError('serving bench produced no successful requests')
+    total_rows = sum(r for r, _, _ in log)
+    bucket_rows = sum(b for _, b, _ in log)
+    out = {
+        'serving_p50_ms': round(float(np.percentile(lats, 50)), 3),
+        'serving_p99_ms': round(float(np.percentile(lats, 99)), 3),
+        'serving_throughput_rps': round(len(lats) / wall, 2),
+        'pad_fraction': round((bucket_rows - total_rows)
+                              / float(max(bucket_rows, 1)), 4),
+        'requests': len(lats),
+        'errors': errors[0],
+        'clients': clients,
+        'dispatches': len(log),
+        'mean_batch': round(total_rows / float(max(len(log), 1)), 2),
+        'coalesced_dispatches': sum(1 for _, _, n in log if n > 1),
+        'max_batch': max_batch,
+        'warmup_s': round(warm_s, 2),
+    }
+    _log('serving: %.1f req/s, p50 %.2f ms, p99 %.2f ms, '
+         'mean batch %.1f over %d dispatches (%d coalesced), '
+         'pad %.1f%%'
+         % (out['serving_throughput_rps'], out['serving_p50_ms'],
+            out['serving_p99_ms'], out['mean_batch'], out['dispatches'],
+            out['coalesced_dispatches'], 100 * out['pad_fraction']))
+    return out
+
+
 def run_fused_window_ab(platform):
     """Donation + BN-one-pass A/B (ISSUE 12) through the REAL
     Module.fit fused window on a conv+BatchNorm net: the 'pre' arm
@@ -1233,6 +1325,23 @@ def main():
             # update/upload overlap per window, the ledger's evidence
             # that the optimizer host tail hides under the transfer
             out['overlap_ms'] = fused_ab['tuned']['overlap_ms_p50']
+    # serving bench (ISSUE 13): closed-loop load against the in-process
+    # continuous-batching plane; same contamination/failure rules as
+    # the A/Bs above — the headline number is never at risk
+    serving = None
+    if os.environ.get('MXTPU_BENCH_SERVING', '1') != '0':
+        try:
+            serving = run_serving_bench(platform)
+        except Exception as e:  # noqa: BLE001
+            _log('serving bench failed (headline unaffected): %s' % e)
+    if serving:
+        out['serving_bench'] = serving
+        # top-level copies of the gated/ledger metrics
+        # (tools/bench_diff.py gates serving_p99_ms at 10%)
+        for k in ('serving_p50_ms', 'serving_p99_ms',
+                  'serving_throughput_rps', 'pad_fraction'):
+            if serving.get(k) is not None:
+                out[k] = serving[k]
     if sharded_ab:
         out['sharded_update_ab'] = sharded_ab
         # top-level copies of the gated/ledger metrics: per-device

@@ -510,6 +510,39 @@ flags.declare('MXTPU_FAULT_HOST', int, -1,
               'every worker of a gang, and a chaos test usually wants '
               'to lose exactly one). -1 (default) = arm wherever the '
               'env reaches', min_value=-1)
+flags.declare('MXTPU_SERVE_BIND', str, '127.0.0.1',
+              'Bind address for the model-serving HTTP frontend '
+              '(mxnet_tpu/serving/http.py, tools/serve_model.py). '
+              'Default 127.0.0.1 = loopback only; set to \'0.0.0.0\' '
+              '(or empty) to serve on all interfaces — do that only '
+              'behind a load balancer / access control '
+              '(docs/serving.md)')
+flags.declare('MXTPU_SERVE_MAX_BATCH', int, 32,
+              'Largest serving batch bucket (mxnet_tpu/serving): the '
+              'engine pre-compiles one forward program per power-of-'
+              'two bucket up to this size, and the dynamic batcher '
+              'coalesces queued requests up to the largest bucket per '
+              'dispatch. Steady-state serving then never recompiles '
+              '(every request pads to a warm bucket)',
+              min_value=1, max_value=65536)
+flags.declare('MXTPU_SERVE_MAX_WAIT_MS', float, 5.0,
+              'Longest time (milliseconds) the serving batcher holds '
+              'the oldest queued request while coalescing more '
+              'arrivals into one padded dispatch. A dispatch fires as '
+              'soon as the largest warm bucket is full OR this '
+              'deadline expires, whichever comes first — the knob '
+              'trades tail latency for batch efficiency '
+              '(docs/serving.md). 0 dispatches each poll immediately',
+              min_value=0.0)
+flags.declare('MXTPU_SERVE_SESSIONS', int, 64,
+              'Session capacity of the autoregressive serving step '
+              'cache (mxnet_tpu/serving/step_cache.py): per-session '
+              'carried state (RNN/LSTM hidden state) lives in a '
+              'device-resident ring of this many slots, evicted LRU. '
+              'A decode step then dispatches ONE fixed-shape program '
+              'per token batch instead of re-running the prefix '
+              '(arXiv:2603.09555\'s O(1) autoregressive caching)',
+              min_value=1)
 flags.declare('MXTPU_GANG_MIN_HOSTS', int, 0,
               'Elastic floor for tools/gang_supervisor.py (read from '
               'the environment — the supervisor never imports the '

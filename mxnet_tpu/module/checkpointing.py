@@ -304,24 +304,30 @@ class TrainCheckpointer:
         possibly onto a different dp, can reshape it back. Plain string
         entries stay the format for canonical leaves (and are what old
         checkpoints hold)."""
-        import jax.numpy as jnp
         self._ensure_opt_states()
         upd = self._updater()
         from .fused_fit import zero_shape_probe
         probe = zero_shape_probe(self.module)
+        # canonical (non-ZeRO) leaves get the same GSPMD->NamedSharding
+        # relabel as params: window outputs leave them GSPMD-labeled too
+        ccopy = self._canon_copy() if copy else None
         arrays = {}
         counter = [0]
 
         def enc(v):
+            import jax.numpy as jnp
             if v is None:
                 return None
             if isinstance(v, tuple):
                 return [enc(x) for x in v]
             k = 'opt.%d' % counter[0]
             counter[0] += 1
-            arrays[k] = jnp.copy(v._data) if copy else v._data
             zshape = probe(v) if probe is not None else None
             if zshape is not None:
+                # ZeRO leaf: captured AS SHARDED (plain copy — ccopy
+                # would reshard it replicated and defeat the each-host-
+                # writes-its-shards property)
+                arrays[k] = jnp.copy(v._data) if copy else v._data
                 if getattr(probe, 'row', None) is not None:
                     # relabel the (equivalent) jit-output GSPMDSharding
                     # onto the canonical NamedSharding: same shards,
@@ -329,6 +335,7 @@ class TrainCheckpointer:
                     import jax
                     arrays[k] = jax.device_put(arrays[k], probe.row)
                 return {'k': k, 'shape': list(zshape)}
+            arrays[k] = ccopy(v._data) if copy else v._data
             return k
 
         structure = [[n, enc(upd.states[self._upd_keys[n]])]
@@ -342,6 +349,48 @@ class TrainCheckpointer:
                                        sorted(o._index_update_count.items(),
                                               key=str)]}
 
+    def _canon_copy(self):
+        """``jnp.copy`` with the PR-9 sharding relabel extended from
+        opt-state leaves to params/aux/grad-accum: a leaf captured from
+        a fused-window OUTPUT carries a jit-produced ``GSPMDSharding``
+        — orbax warns on (de)serializing it at every save AND every
+        later load. The canonical checkpoint form for these leaves is
+        the mesh-replicated ``NamedSharding``: when the window output
+        is replicated-equivalent the ``device_put`` is a pure relabel
+        (same shards), and when XLA's partitioner chose to emit a
+        param genuinely sharded (it does — e.g. a [4,2] layout on the
+        8-device mesh) the put is a real reshard onto the canonical
+        layout, paid once per checkpoint cadence, never per step.
+        ZeRO opt-state leaves never come through here — they stay
+        dp-sharded under their own canonical ``NamedSharding``
+        (``_walk_opt``'s probe.row), so each host still writes only
+        its own shards."""
+        import jax
+        import jax.numpy as jnp
+        mesh = getattr(self.module._exec_group, 'mesh', None)
+        if mesh is None:
+            return jnp.copy
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(mesh, P())
+
+        def copy(a):
+            sh = getattr(a, 'sharding', None)
+            if sh is not None and not isinstance(sh, NamedSharding):
+                try:
+                    if not sh.is_equivalent_to(rep, a.ndim):
+                        # genuinely sharded window output: the cross-
+                        # layout put materializes fresh replicated
+                        # buffers — the reshard IS the donation-proof
+                        # copy (probed: equivalent-sharding puts ALIAS
+                        # the source instead, hence the branch)
+                        return jax.device_put(a, rep)
+                    return jax.device_put(jnp.copy(a), rep)
+                except Exception:  # noqa: BLE001 — an unplaceable
+                    pass           # layout: fall through to the copy
+            return jnp.copy(a)
+
+        return copy
+
     def _capture(self):
         """The checkpoint pytree + its JSON metadata, captured on the
         MAIN thread so it names a consistent step. Arrays are device
@@ -349,19 +398,19 @@ class TrainCheckpointer:
         to the very next compiled window while the write is in flight.
         The RNG key is tiny, so it rides the JSON meta item — the
         array tree stays fully restorable from the live template."""
-        import jax.numpy as jnp
         e = self._exec
+        ccopy = self._canon_copy()
         tree = {
-            'params': {n: jnp.copy(e.arg_dict[n]._data)
+            'params': {n: ccopy(e.arg_dict[n]._data)
                        for n in self._param_names},
-            'aux': {n: jnp.copy(e.aux_dict[n]._data)
+            'aux': {n: ccopy(e.aux_dict[n]._data)
                     for n in self._aux_names},
         }
         structure, opt_arrays = self._walk_opt(copy=True)
         if opt_arrays:
             tree['opt'] = opt_arrays
         if self._accum:
-            tree['gacc'] = {n: jnp.copy(e.grad_dict[n]._data)
+            tree['gacc'] = {n: ccopy(e.grad_dict[n]._data)
                             for n in self._grad_names}
         rng = _random.get_state()
         key = rng.pop('key')

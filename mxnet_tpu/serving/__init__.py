@@ -1,0 +1,44 @@
+"""Inference serving plane: continuous batching over the fused-eval path.
+
+The north star says the framework "serves heavy traffic from millions
+of users"; everything before this package could train, observe and
+survive, but there was no path from a checkpoint to a request/response
+loop. This subsystem is that path, built almost entirely from pieces
+that already exist:
+
+- :mod:`.engine` — :class:`ServingEngine`: load a checkpoint via
+  ``Module.load``, bind for inference, and pre-compile a ladder of
+  bucketed batch shapes (pad-to-bucket, powers of two up to
+  ``MXTPU_SERVE_MAX_BATCH``). Programs register through
+  ``telemetry/programs.register`` and are signature-cached, so
+  steady-state serving does ZERO recompiles — assertable via the
+  existing ``xla.compiles`` counter;
+- :mod:`.batcher` — :class:`DynamicBatcher`: a thread-safe request
+  queue that coalesces waiting requests up to the largest warm bucket
+  or ``MXTPU_SERVE_MAX_WAIT_MS`` (whichever first), dispatches one
+  padded device call, and splits/strips pad rows back per request.
+  Continuous, not lockstep: the device fetch runs on a side thread
+  (the ``window_pipeline`` pipelined-upload pattern), so new arrivals
+  board the next dispatch while the current one is in flight;
+- :mod:`.step_cache` — :class:`StepCache` / :class:`DecodeEngine`: an
+  O(1) carried-state decode step for recurrent (rnn/lstm) graphs —
+  per-session hidden state lives in a device-resident ring (LRU
+  evicted), so autoregressive serving dispatches one fixed-shape step
+  program per token instead of re-running the prefix
+  (arXiv:2603.09555);
+- :mod:`.http` — ``/predict`` + ``/models`` on the same
+  ThreadingHTTPServer pattern as ``telemetry/serve.py``, fronted by
+  ``tools/serve_model.py``.
+
+Observability comes for free: ``serve.request_latency`` histograms
+(p50 via the registry ring, p99 published as the
+``serve.request_latency_p99_ms`` gauge), ``serve.queue_depth`` /
+``serve.batch_size`` / ``serve.pad_fraction`` gauges and
+``serve.requests`` / ``serve.errors`` counters all flow through the
+existing telemetry registry onto ``/metrics`` (docs/serving.md).
+"""
+from .engine import ServingEngine
+from .batcher import DynamicBatcher
+from .step_cache import StepCache, DecodeEngine
+
+__all__ = ['ServingEngine', 'DynamicBatcher', 'StepCache', 'DecodeEngine']

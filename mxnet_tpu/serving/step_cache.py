@@ -1,0 +1,288 @@
+"""O(1) autoregressive serving: device-resident per-session state.
+
+A recurrent decode served naively re-runs the whole prefix per token —
+O(T) compute and, worse, a fresh program shape per prefix length.
+Compiler-first autoregressive caching (arXiv:2603.09555) keeps the
+carried state DEVICE-RESIDENT between steps instead, so serving
+dispatches one fixed-shape step program per token batch: O(1) compute,
+zero recompiles after warmup.
+
+Two pieces:
+
+- :class:`StepCache` — the session table: per-session hidden-state
+  slots live in a ring of device arrays ((capacity,) + state_shape,
+  ``MXTPU_SERVE_SESSIONS`` slots), mapped session-id -> slot on the
+  host and evicted LRU. Slot ``capacity`` is a scratch row pad rows
+  scatter into, so padding never corrupts a live session.
+- :class:`DecodeEngine` — the step dispatcher: a bound Module whose
+  graph is ONE recurrent step (state inputs among its data, new states
+  as its trailing outputs — the ``mx.rnn`` cell ``__call__`` shape)
+  compiles to one program per batch bucket that gathers the batch's
+  state rows from the ring, runs the step, and scatters the new state
+  back — the ring is DONATED to the program, so the update is in
+  place. Fresh sessions (first token, or re-admitted after an LRU
+  eviction) start from zero state via an in-graph mask; the host never
+  touches state bytes.
+
+The step-symbol contract: ``state_names`` are data inputs of the bound
+module (build the reference module with
+``data_names=('data', 'state_h', ...)``), and the graph's LAST
+``len(state_names)`` outputs are the new states in the same order —
+exactly what ``mx.sym.Group([out] + new_states)`` over an rnn/lstm
+cell produces (docs/serving.md walks through it).
+"""
+import collections
+import logging
+import threading
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import random as _random
+from .. import telemetry as _tele
+from .engine import _SingleExecutorEngine, bucket_ladder
+
+__all__ = ['StepCache', 'DecodeEngine']
+
+
+def _serve_sessions():
+    from ..config import flags
+    flags.reload('MXTPU_SERVE_SESSIONS')
+    return flags.get('MXTPU_SERVE_SESSIONS')
+
+
+class StepCache:
+    """Session-id -> ring-slot table with LRU eviction.
+
+    The device arrays themselves belong to :class:`DecodeEngine` (they
+    are donated through the step program); this class owns only the
+    host-side mapping, so it is cheap to test in isolation.
+    """
+
+    def __init__(self, capacity):
+        self.capacity = int(capacity)
+        assert self.capacity >= 1
+        self._slots = collections.OrderedDict()   # session -> slot (LRU)
+        self._free = list(range(self.capacity - 1, -1, -1))
+        self._lock = threading.Lock()
+
+    def lookup(self, session_ids):
+        """(slots, fresh) for a batch of session ids: ``slots`` the
+        int32 ring rows, ``fresh`` True where the session has no cached
+        state (new, or LRU-evicted since its last step — it must
+        restart from zero state). Touch order is LRU."""
+        slots = np.empty(len(session_ids), np.int32)
+        fresh = np.zeros(len(session_ids), bool)
+        with self._lock:
+            if len(set(session_ids)) != len(session_ids):
+                raise ValueError('duplicate session ids in one batch')
+            for i, sid in enumerate(session_ids):
+                slot = self._slots.pop(sid, None)
+                if slot is None:
+                    fresh[i] = True
+                    if self._free:
+                        slot = self._free.pop()
+                    else:
+                        evicted, slot = self._slots.popitem(last=False)
+                        _tele.counter('serve.session_evictions').inc()
+                self._slots[sid] = slot        # most-recently-used end
+                slots[i] = slot
+            _tele.gauge('serve.sessions_live').set(len(self._slots))
+        return slots, fresh
+
+    def drop(self, session_id):
+        """Explicitly end a session (its slot frees immediately)."""
+        with self._lock:
+            slot = self._slots.pop(session_id, None)
+            if slot is not None:
+                self._free.append(slot)
+            _tele.gauge('serve.sessions_live').set(len(self._slots))
+        return slot is not None
+
+    def sessions(self):
+        with self._lock:
+            return list(self._slots)
+
+
+class DecodeEngine(_SingleExecutorEngine):
+    """Fixed-shape recurrent decode steps over a StepCache ring."""
+
+    _default_name = 'decoder'
+
+    def __init__(self, module, state_names, capacity=None, max_batch=None,
+                 logger=logging, name=None):
+        super().__init__(module, logger=logger, name=name)
+        self.state_names = list(state_names)
+        missing = [n for n in self.state_names if n not in self._descs]
+        if missing:
+            raise ValueError('state inputs %s are not data inputs of the '
+                             'bound module' % missing)
+        self._token_names = [n for n in module._data_names
+                             if n not in self.state_names]
+        n_out = len(module._output_names)
+        if n_out <= len(self.state_names):
+            raise ValueError('the step graph must output its payload '
+                             'plus one new state per state input (last '
+                             '%d outputs)' % len(self.state_names))
+        self.n_payload = n_out - len(self.state_names)
+        self.capacity = int(capacity) if capacity else _serve_sessions()
+        max_b = int(max_batch) if max_batch else min(self.capacity, 32)
+        self.buckets = [b for b in bucket_ladder(max_b)
+                        if b <= self.capacity] or [1]
+        self._reset_ring()
+        self._lock = threading.Lock()    # decode serializes: the ring
+                                         # is donated through each step
+
+    def _reset_ring(self):
+        """(Re)build the device state ring: one slot per session + a
+        scratch row (index ``capacity``) that pad rows harmlessly
+        scatter into. Also called after a failed step dispatch — the
+        ring was DONATED into the failed program, so the old buffers
+        may already be consumed; every session restarts from zero
+        state, exactly the LRU-eviction semantics."""
+        descs = self._descs
+        self._store = [
+            jnp.zeros((self.capacity + 1,) + tuple(descs[n].shape[1:]),
+                      self._desc_dtype(n))
+            for n in self.state_names]
+        if self._mesh is not None:
+            from ..module.window_pipeline import place_replicated
+            (self._store,) = place_replicated(self._mesh, self._store)
+            self._store = list(self._store)
+        self.cache = StepCache(self.capacity)
+
+    # -- program -----------------------------------------------------------
+    def _build_program(self, bucket):
+        run = self._run
+        arg_pos = {n: i for i, n in enumerate(self._arg_names)}
+        token_names, state_names = self._token_names, self.state_names
+        io_pos = set(arg_pos[n] for n in token_names + state_names)
+        fixed_names = [n for i, n in enumerate(self._arg_names)
+                       if i not in io_pos]
+        n_payload = self.n_payload
+
+        def step(fixed, aux, store, slots, fresh, tokens, key):
+            states = []
+            for s in store:
+                st = s[slots]                       # gather (b, ...)
+                mask = fresh.reshape((-1,) + (1,) * (st.ndim - 1))
+                states.append(jnp.where(mask, jnp.zeros_like(st), st))
+            full = [None] * len(arg_pos)
+            for n, v in zip(fixed_names, fixed):
+                full[arg_pos[n]] = v
+            for n, v in zip(token_names, tokens):
+                full[arg_pos[n]] = v
+            for n, v in zip(state_names, states):
+                full[arg_pos[n]] = v
+            outs, _ = run(tuple(full), aux, key, False)
+            payload, new_states = outs[:n_payload], outs[n_payload:]
+            # scatter the new state back into the (donated) ring; pad
+            # rows all target the scratch slot, whose value is dead
+            store = tuple(s.at[slots].set(ns.astype(s.dtype))
+                          for s, ns in zip(store, new_states))
+            return tuple(payload), store
+
+        from ..module.window_pipeline import registered_jit
+        prog = registered_jit('serve.decode[%s][b%d]' % (self.name, bucket),
+                              step, donate_argnums=(2,))
+        return prog, fixed_names
+
+    # -- the decode step ---------------------------------------------------
+    def decode(self, session_ids, arrays, reset=False):
+        """One recurrent step for a batch of sessions: ``arrays`` are
+        the token inputs (row i belongs to ``session_ids[i]``), the
+        carried state comes from / returns to the device ring. Returns
+        the payload outputs as host arrays, one row per session.
+        ``reset=True`` restarts every named session from zero state."""
+        if not isinstance(arrays, (list, tuple)):
+            arrays = [arrays]
+        rows = len(session_ids)
+        if rows == 0:
+            raise ValueError('empty session batch')
+        if rows > self.buckets[-1]:
+            raise ValueError('decode batch %d exceeds the largest bucket '
+                             '%d' % (rows, self.buckets[-1]))
+        if len(arrays) != len(self._token_names):
+            raise ValueError('expected %d token inputs (%s)'
+                             % (len(self._token_names),
+                                ', '.join(self._token_names)))
+        # validate + stage the token arrays BEFORE the session table is
+        # touched: a rejected call must not register/evict sessions (a
+        # retry would otherwise find fresh=False and read a reused
+        # slot's leftover state)
+        bucket = next(b for b in self.buckets if b >= rows)
+        pad = bucket - rows
+        host_tokens = []
+        for n, a in zip(self._token_names, arrays):
+            desc = self._descs[n]
+            a = np.asarray(a, dtype=self._desc_dtype(n))
+            if a.shape[0] != rows or \
+                    tuple(a.shape[1:]) != tuple(desc.shape[1:]):
+                raise ValueError('token input %r: shape %s does not '
+                                 'match %d rows of %s'
+                                 % (n, a.shape, rows,
+                                    tuple(desc.shape[1:])))
+            if pad:
+                a = np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+            host_tokens.append(a)
+        with self._lock:
+            slots, fresh = self.cache.lookup(session_ids)
+            # everything past the lookup runs under the failure guard:
+            # the table is mutated now, so ANY later failure (program
+            # build, snapshot/placement transfer, the dispatch itself)
+            # must rebuild ring + table together — otherwise a retried
+            # session would find fresh=False and gather an evicted
+            # session's leftover state from its reused slot
+            try:
+                if reset:
+                    fresh[:] = True
+                prog, fixed_names = self._program(bucket)
+                fixed, aux = self._snapshot(fixed_names)
+                slots_b = np.concatenate(
+                    [slots, np.full(pad, self.capacity, np.int32)]) \
+                    if pad else slots
+                fresh_b = np.concatenate([fresh, np.ones(pad, bool)]) \
+                    if pad else fresh
+                # device_put takes the host arrays directly — one
+                # transfer, not a default-device stage + re-place
+                tokens = tuple(self._place(a) for a in host_tokens)
+                with _tele.span('serve.decode', 'serve'):
+                    payload, store = prog(fixed, aux, tuple(self._store),
+                                          self._place(slots_b),
+                                          self._place(fresh_b),
+                                          tokens, _random.next_key())
+            except Exception:
+                # the ring may have been DONATED into the failed
+                # dispatch — its buffers may be consumed. Rebuild ring
+                # + session table (every session restarts from zero
+                # state, the eviction semantics) instead of leaving
+                # self._store on deleted arrays, where ONE transient
+                # device error would brick every later decode.
+                self._reset_ring()
+                _tele.counter('serve.errors').inc()
+                self.logger.warning(
+                    'decode step failed — session state ring reset '
+                    '(all sessions restart from zero state)')
+                raise
+            self._store = list(store)
+            _tele.counter('serve.decode_steps').inc()
+        return [np.asarray(p)[:rows] for p in payload]
+
+    def warmup(self):
+        """Compile every bucket's step program (against throwaway
+        sessions, dropped afterwards so the table starts empty)."""
+        for b in self.buckets:
+            sids = ['__warmup_%d_%d' % (b, i) for i in range(b)]
+            tokens = [np.zeros((b,) + tuple(self._descs[n].shape[1:]),
+                               self._desc_dtype(n))
+                      for n in self._token_names]
+            self.decode(sids, tokens)
+            for s in sids:
+                self.cache.drop(s)
+        self.logger.info('decode engine %s: %d step programs warm '
+                         '(buckets %s, %d sessions)',
+                         self.name, len(self.buckets), self.buckets,
+                         self.capacity)
+        return len(self.buckets)

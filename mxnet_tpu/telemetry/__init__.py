@@ -68,7 +68,11 @@ spans + ``kvstore.push_bytes`` / ``kvstore.pull_bytes`` counters,
 gauge ``speedometer.samples_per_sec``, the ``xla.*`` compile/memory
 metrics, and — with MXTPU_COMPILE_CACHE set — ``xla.cache_hits`` /
 ``xla.cache_saved_secs`` for compiles served from the persistent
-cache.
+cache. The serving plane (mxnet_tpu/serving) reports through the same
+registry: ``serve.request_latency`` histogram + ``serve.requests`` /
+``serve.errors`` / ``serve.dispatches`` counters, queue/batch/pad
+gauges, and ``serve.decode_steps`` for the autoregressive step cache
+(docs/serving.md).
 """
 import atexit
 import logging

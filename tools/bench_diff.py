@@ -26,6 +26,8 @@ Compared metrics, with direction and default tolerance:
   per-device optimizer-state footprint)   — higher is a regression (10%)
 - ``compile_s`` (cold compile)             — higher is a regression (25%,
   compile time is the noisiest of the set)
+- ``serving_p99_ms`` (the serving bench's closed-loop request tail
+  latency)                                 — higher is a regression (10%)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -45,12 +47,14 @@ import sys
 # bad_direction: -1 = a DROP is a regression, +1 = a RISE is one
 _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 10.0,
             'xla_live_bytes': 10.0,
-            'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0}
+            'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0,
+            'serving_p99_ms': 10.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
               'xla_live_bytes': +1,
-              'opt_state_bytes_per_device': +1, 'compile_s': +1}
+              'opt_state_bytes_per_device': +1, 'compile_s': +1,
+              'serving_p99_ms': +1}
 _ORDER = ('throughput', 'mfu', 'xla_temp_bytes', 'xla_live_bytes',
-          'opt_state_bytes_per_device', 'compile_s')
+          'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms')
 
 
 def load_bench(path):
@@ -122,6 +126,10 @@ def extract(rec):
     c = _compile_s(rec)
     if c is not None:
         out['compile_s'] = c
+    # serving tail latency (bench.py run_serving_bench): higher = a
+    # regression in the continuous-batching plane
+    if rec.get('serving_p99_ms') is not None:
+        out['serving_p99_ms'] = float(rec['serving_p99_ms'])
     return out
 
 

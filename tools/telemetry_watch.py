@@ -67,10 +67,10 @@ def _fmt(v, suffix=''):
     return str(v) + suffix
 
 
-def render(summary, steps_per_s=None):
+def render(summary, steps_per_s=None, reqs_per_s=None):
     """The dashboard frame for one /summary dict, as a list of lines
-    (pure — tested offline). ``steps_per_s`` is the poll-to-poll step
-    rate the caller measured."""
+    (pure — tested offline). ``steps_per_s`` / ``reqs_per_s`` are the
+    poll-to-poll step and serving-request rates the caller measured."""
     snap = summary.get('snapshot') or {}
     c = snap.get('counters', {})
     g = snap.get('gauges', {})
@@ -134,6 +134,29 @@ def render(summary, steps_per_s=None):
             bits.append('step collectives %s%%'
                         % _fmt(float(g['roofline.comm_pct_of_step'])))
         lines.append('  opt_state    %s' % ', '.join(bits))
+    if c.get('serve.requests'):
+        # serving plane (mxnet_tpu/serving): request rate + latency
+        # percentiles + queue/batch state whenever serve.* metrics exist
+        bits = ['%d reqs' % int(c['serve.requests'])]
+        if reqs_per_s is not None:
+            bits.append('%.2f req/s' % reqs_per_s)
+        lat = h.get('serve.request_latency') or {}
+        p99 = g.get('serve.request_latency_p99_ms')
+        if lat.get('p50') is not None:
+            bits.append('latency p50 %s ms%s'
+                        % (_fmt(lat['p50']),
+                           ' / p99 %s ms' % _fmt(float(p99))
+                           if p99 is not None else ''))
+        if g.get('serve.queue_depth') is not None:
+            bits.append('queue %d' % int(g['serve.queue_depth']))
+        if g.get('serve.batch_size_p50') is not None:
+            bits.append('batch p50 %d' % int(g['serve.batch_size_p50']))
+        if g.get('serve.pad_fraction') is not None:
+            bits.append('pad %.0f%%' % (100.0
+                                        * float(g['serve.pad_fraction'])))
+        if c.get('serve.errors'):
+            bits.append('%d errors' % int(c['serve.errors']))
+        lines.append('  serving      %s' % ', '.join(bits))
     hs = summary.get('health')
     # hang / restart / elastic events render on the health line even
     # when the sentinel plane (MXTPU_HEALTH) is off — they live in
@@ -189,7 +212,7 @@ def main(argv=None):
     ap.add_argument('--once', action='store_true',
                     help='render one frame and exit (no screen clear)')
     args = ap.parse_args(argv)
-    prev_steps = prev_t = None
+    prev_steps = prev_reqs = prev_t = None
     while True:
         try:
             summary = fetch(args.source)
@@ -200,13 +223,17 @@ def main(argv=None):
             time.sleep(args.interval)
             continue
         now = time.time()
-        steps = (summary.get('snapshot') or {}).get('counters', {}) \
-            .get('fit.steps')
-        rate = None
+        counters = (summary.get('snapshot') or {}).get('counters', {})
+        steps = counters.get('fit.steps')
+        reqs = counters.get('serve.requests')
+        rate = req_rate = None
         if None not in (steps, prev_steps, prev_t) and now > prev_t:
             rate = max(0.0, (steps - prev_steps) / (now - prev_t))
-        prev_steps, prev_t = steps, now
-        frame = '\n'.join(render(summary, steps_per_s=rate))
+        if None not in (reqs, prev_reqs, prev_t) and now > prev_t:
+            req_rate = max(0.0, (reqs - prev_reqs) / (now - prev_t))
+        prev_steps, prev_reqs, prev_t = steps, reqs, now
+        frame = '\n'.join(render(summary, steps_per_s=rate,
+                                 reqs_per_s=req_rate))
         if args.once:
             print(frame)
             return 0
