@@ -715,16 +715,38 @@ def run_serving_bench(platform):
     for th in threads:
         th.join()
     wall = time.perf_counter() - t0
-    log = list(batcher.dispatch_log)
+    # read the ledgers AFTER close(): it joins the dispatcher and the
+    # fetch pool, so the final batch's stage entry has landed and no
+    # thread mutates the deques mid-iteration
     batcher.close()
+    log = list(batcher.dispatch_log)
+    queue_waits = list(batcher.queue_wait_log)
+    stage_log = list(batcher.stage_log)
     if not lats:
         raise RuntimeError('serving bench produced no successful requests')
     total_rows = sum(r for r, _, _ in log)
     bucket_rows = sum(b for _, b, _ in log)
+
+    def _stage_p50(key):
+        vals = [s[key] for s in stage_log if s.get(key) is not None]
+        return round(float(np.percentile(vals, 50)), 3) if vals else None
+
     out = {
         'serving_p50_ms': round(float(np.percentile(lats, 50)), 3),
         'serving_p99_ms': round(float(np.percentile(lats, 99)), 3),
         'serving_throughput_rps': round(len(lats) / wall, 2),
+        # per-stage breakdown (the tracing plane's host-measured
+        # decomposition; queue wait gated by tools/bench_diff.py)
+        'serving_queue_wait_p50_ms': round(
+            float(np.percentile(queue_waits, 50)), 3)
+        if queue_waits else None,
+        'serving_stage_p50_ms': {
+            'coalesce': _stage_p50('coalesce_ms'),
+            'pad': _stage_p50('pad_ms'),
+            'dispatch': _stage_p50('dispatch_ms'),
+            'fetch': _stage_p50('fetch_ms'),
+            'split': _stage_p50('split_ms'),
+        },
         'pad_fraction': round((bucket_rows - total_rows)
                               / float(max(bucket_rows, 1)), 4),
         'requests': len(lats),
@@ -742,6 +764,12 @@ def run_serving_bench(platform):
          % (out['serving_throughput_rps'], out['serving_p50_ms'],
             out['serving_p99_ms'], out['mean_batch'], out['dispatches'],
             out['coalesced_dispatches'], 100 * out['pad_fraction']))
+    stages = out['serving_stage_p50_ms']
+    _log('serving stages p50: queue %s ms, %s'
+         % (out['serving_queue_wait_p50_ms'],
+            ', '.join('%s %s ms' % (k, stages[k])
+                      for k in ('coalesce', 'pad', 'dispatch', 'fetch',
+                                'split'))))
     return out
 
 
@@ -1337,9 +1365,11 @@ def main():
     if serving:
         out['serving_bench'] = serving
         # top-level copies of the gated/ledger metrics
-        # (tools/bench_diff.py gates serving_p99_ms at 10%)
+        # (tools/bench_diff.py gates serving_p99_ms AND
+        # serving_queue_wait_p50_ms at 10%)
         for k in ('serving_p50_ms', 'serving_p99_ms',
-                  'serving_throughput_rps', 'pad_fraction'):
+                  'serving_throughput_rps', 'pad_fraction',
+                  'serving_queue_wait_p50_ms', 'serving_stage_p50_ms'):
             if serving.get(k) is not None:
                 out[k] = serving[k]
     if sharded_ab:

@@ -543,6 +543,43 @@ flags.declare('MXTPU_SERVE_SESSIONS', int, 64,
               'per token batch instead of re-running the prefix '
               '(arXiv:2603.09555\'s O(1) autoregressive caching)',
               min_value=1)
+flags.declare('MXTPU_FLIGHT_RECORDER', int, 2048,
+              'Incident flight recorder (telemetry/flight.py, requires '
+              'MXTPU_TELEMETRY=1): a fixed-size in-memory ring retaining '
+              'the last N telemetry records (spans, traces, health/'
+              'anomaly events) at negligible cost — no extra I/O, no '
+              'thread. Every incident path (watchdog stall, non-finite '
+              'incident, OOM report, SLO burn, supervised restart) dumps '
+              'the ring to a flight-<reason>.jsonl next to the telemetry '
+              'log, so a postmortem has the seconds BEFORE the incident '
+              'without full telemetry export. Render with '
+              'tools/trace_report.py. 0 = off: no ring is ever allocated',
+              min_value=0, max_value=1 << 20)
+flags.declare('MXTPU_SLO_LATENCY_MS', float, 0.0,
+              'Serving latency objective (telemetry/slo.py, requires '
+              'MXTPU_TELEMETRY=1): a request slower than this many '
+              'milliseconds counts against the error budget exactly '
+              'like a server-side error. Together with '
+              'MXTPU_SLO_ERROR_PCT it arms the SLO plane: slo.* gauges '
+              'on /metrics (burn rate, budget remaining) and an '
+              '"slo_degraded" /healthz state on sustained burn — '
+              'distinct from "hung" and the non-finite "degraded". '
+              '0 (default) = no latency objective', min_value=0.0)
+flags.declare('MXTPU_SLO_ERROR_PCT', float, 0.0,
+              'Serving error budget (telemetry/slo.py): the allowed '
+              'share (%) of bad requests — server-side 5xx errors plus '
+              'requests over MXTPU_SLO_LATENCY_MS. The rolling burn '
+              'rate is bad_share/budget; burn >= 1 sustained over the '
+              'MXTPU_SLO_WINDOW flips /healthz to slo_degraded (and '
+              'back when the window clears). 0 (default) = no error '
+              'objective; with only the latency objective set the '
+              'budget defaults to 1%', min_value=0.0, max_value=100.0)
+flags.declare('MXTPU_SLO_WINDOW', int, 128,
+              'Rolling request window (count) backing the SLO burn-rate '
+              'computation (telemetry/slo.py): burn and the degraded '
+              'verdict are computed over the most recent this-many '
+              'requests, so recovery is automatic once fresh traffic '
+              'meets the objectives', min_value=8)
 flags.declare('MXTPU_GANG_MIN_HOSTS', int, 0,
               'Elastic floor for tools/gang_supervisor.py (read from '
               'the environment — the supervisor never imports the '

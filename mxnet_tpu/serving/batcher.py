@@ -21,11 +21,25 @@ previous one to land.
 Metrics (through the existing telemetry registry, so they surface on
 ``/metrics`` and in ``tools/telemetry_watch.py``): the
 ``serve.request_latency`` histogram (enqueue -> answer, ms; p99
-published as the ``serve.request_latency_p99_ms`` gauge),
+published as the ``serve.request_latency_p99_ms`` gauge, exemplar
+trace ids attached), the ``serve.queue_wait`` histogram (enqueue ->
+dispatcher pop, ms; p50 published as ``serve.queue_wait_p50_ms``),
 ``serve.queue_depth`` / ``serve.batch_size`` / ``serve.pad_fraction``
 gauges, ``serve.batch_size_p50`` (recent-window), and the
 ``serve.requests`` / ``serve.errors`` / ``serve.dispatches`` /
 ``serve.rows`` / ``serve.pad_rows`` counters.
+
+Tracing (telemetry/trace.py, rides MXTPU_TELEMETRY): every submitted
+request carries a RequestTrace (client-supplied id or minted) that
+accumulates the stage breakdown — queue_wait (per request), coalesce /
+pad / dispatch / fetch / split (batch-shared) — and lands as a
+``trace`` JSONL record; the N requests of one coalesced dispatch share
+ONE dispatch span id. Completed requests also feed the SLO plane
+(telemetry/slo.py): latency per request, and dispatch/fetch failures
+as the 5xx the error budget measures (client-side rejects in submit
+never burn budget). Telemetry off = no trace object, no SLO state —
+the host-side queue_wait/stage logs (plain deques, like dispatch_log)
+are the only unconditional bookkeeping, and the bench reads those.
 """
 import collections
 import logging
@@ -36,6 +50,8 @@ from concurrent.futures import Future, ThreadPoolExecutor
 import numpy as np
 
 from .. import telemetry as _tele
+from ..telemetry import slo as _slo
+from ..telemetry import trace as _trace
 
 __all__ = ['DynamicBatcher']
 
@@ -47,13 +63,15 @@ def _serve_max_wait_s():
 
 
 class _Request:
-    __slots__ = ('arrays', 'rows', 'future', 't0')
+    __slots__ = ('arrays', 'rows', 'future', 't0', 'trace', 'queue_ms')
 
-    def __init__(self, arrays, rows):
+    def __init__(self, arrays, rows, trace=None):
         self.arrays = arrays
         self.rows = rows
         self.future = Future()
         self.t0 = time.monotonic()
+        self.trace = trace       # RequestTrace or None (telemetry off)
+        self.queue_ms = None     # stamped when the dispatcher pops it
 
 
 class DynamicBatcher:
@@ -84,14 +102,23 @@ class DynamicBatcher:
         # (rows, bucket_rows, n_requests) per dispatch — the test/debug
         # ledger proving requests actually coalesced
         self.dispatch_log = collections.deque(maxlen=1024)
+        # per-request queue waits (ms) + per-dispatch stage timings —
+        # host clock reads only, kept unconditionally like dispatch_log
+        # so the bench can bank the breakdown without telemetry
+        self.queue_wait_log = collections.deque(maxlen=4096)
+        self.stage_log = collections.deque(maxlen=1024)
 
     # -- client API --------------------------------------------------------
-    def submit(self, arrays):
+    def submit(self, arrays, trace_id=None):
         """Enqueue one request (list of per-input arrays sharing a row
         count, or a single array). Returns a Future resolving to the
-        list of output arrays for exactly those rows."""
+        list of output arrays for exactly those rows. ``trace_id``
+        seeds the request's trace (client-supplied X-Request-Id /
+        traceparent); with telemetry on and none given, one is minted —
+        telemetry off mints nothing."""
         arrays, rows = self.engine._check_and_cast(arrays)
-        req = _Request(arrays, rows)
+        req = _Request(arrays, rows, trace=_trace.start(trace_id,
+                                                        rows=rows))
         with self._cond:
             if self._closed:
                 # after close() no dispatcher will ever serve the queue
@@ -103,9 +130,10 @@ class DynamicBatcher:
             self._cond.notify_all()
         return req.future
 
-    def predict(self, arrays, timeout=None):
+    def predict(self, arrays, timeout=None, trace_id=None):
         """submit + wait — the synchronous client call."""
-        return self.submit(arrays).result(timeout=timeout)
+        return self.submit(arrays,
+                           trace_id=trace_id).result(timeout=timeout)
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -189,17 +217,37 @@ class DynamicBatcher:
                 return
             self._dispatch(batch, rows)
 
+    def _fail_batch(self, batch, e):
+        """Answer every passenger of a failed dispatch: exception on
+        the future, an error-status trace record, and one bad request
+        against the SLO error budget (these are the 5xx the budget
+        measures; client-side rejects never reach a batch)."""
+        _tele.counter('serve.errors').inc(len(batch))
+        now = time.monotonic()
+        for r in batch:
+            r.future.set_exception(e)
+            _slo.note_request((now - r.t0) * 1e3, error=True)
+            if r.trace is not None:
+                r.trace.finish(status='error')
+
     def _dispatch(self, batch, rows):
+        # queue_wait: enqueue -> the dispatcher owning the request
+        # (includes the coalesce hold on the oldest passenger)
+        t_pop = time.monotonic()
+        for r in batch:
+            r.queue_ms = (t_pop - r.t0) * 1e3
+            self.queue_wait_log.append(r.queue_ms)
+        timings = {}
         try:
             n_in = len(batch[0].arrays)
+            t0 = time.perf_counter()
             arrays = [np.concatenate([r.arrays[i] for r in batch])
                       if len(batch) > 1 else batch[0].arrays[i]
                       for i in range(n_in)]
-            chunks = self.engine.dispatch_rows(arrays)
+            timings['coalesce_ms'] = (time.perf_counter() - t0) * 1e3
+            chunks = self.engine.dispatch_rows(arrays, timings=timings)
         except Exception as e:  # noqa: BLE001 — answer, don't die
-            _tele.counter('serve.errors').inc(len(batch))
-            for r in batch:
-                r.future.set_exception(e)
+            self._fail_batch(batch, e)
             return
         bucket_rows = sum(b for _, _, b in chunks)
         self.dispatch_log.append((rows, bucket_rows, len(batch)))
@@ -212,30 +260,56 @@ class DynamicBatcher:
         _tele.gauge('serve.batch_size_p50').set(rb[len(rb) // 2])
         _tele.gauge('serve.pad_fraction').set(
             round((bucket_rows - rows) / float(bucket_rows), 4))
+        # ONE dispatch span id shared by every passenger's trace — the
+        # coalescing structure survives into the per-request records
+        if any(r.trace is not None for r in batch):
+            timings['dispatch_span'] = _trace.new_span_id()
         # hand the blocking fetch to the side thread and go collect the
         # next batch — arrivals during device compute board dispatch k+1
         self._inflight.append(
-            self._fetch_pool.submit(self._complete, batch, chunks))
+            self._fetch_pool.submit(self._complete, batch, chunks,
+                                    timings))
         while self._inflight and self._inflight[0].done():
             self._inflight.popleft()
 
-    def _complete(self, batch, chunks):
+    def _complete(self, batch, chunks, timings):
         try:
-            outs = self.engine.fetch_chunks(chunks)
+            outs = self.engine.fetch_chunks(chunks, timings=timings)
         except Exception as e:  # noqa: BLE001
-            _tele.counter('serve.errors').inc(len(batch))
-            for r in batch:
-                r.future.set_exception(e)
+            self._fail_batch(batch, e)
             return
-        now = time.monotonic()
+        t0 = time.perf_counter()
         hist = _tele.histogram('serve.request_latency')
+        qhist = _tele.histogram('serve.queue_wait')
         off = 0
         for r in batch:
             r.future.set_result([o[off:off + r.rows] for o in outs])
             off += r.rows
-            hist.observe((now - r.t0) * 1e3)
+        timings['split_ms'] = (time.perf_counter() - t0) * 1e3
+        self.stage_log.append(dict(timings, rows=sum(r.rows
+                                                     for r in batch),
+                                   requests=len(batch)))
+        dispatch_span = timings.get('dispatch_span')
+        now = time.monotonic()
+        for r in batch:
+            lat_ms = (now - r.t0) * 1e3
+            hist.observe(lat_ms,
+                         exemplar={'trace_id': r.trace.trace_id}
+                         if r.trace is not None else None)
+            if r.queue_ms is not None:
+                qhist.observe(r.queue_ms)
+            _slo.note_request(lat_ms, error=False)
+            if r.trace is not None:
+                # per-request queue wait + the batch-shared stages, all
+                # pointing at the ONE dispatch span
+                r.trace.add('queue_wait', r.queue_ms or 0.0)
+                r.trace.add_shared(dispatch_span, timings)
+                r.trace.finish(status='ok')
         _tele.counter('serve.requests').inc(len(batch))
         p99 = hist.percentile(99)
         if p99 is not None:
             _tele.gauge('serve.request_latency_p99_ms').set(round(p99, 3))
+        q50 = qhist.percentile(50)
+        if q50 is not None:
+            _tele.gauge('serve.queue_wait_p50_ms').set(round(q50, 3))
         _tele.watchdog.note_progress('serve.dispatch')

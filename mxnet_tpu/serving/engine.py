@@ -245,10 +245,12 @@ class ServingEngine(_SingleExecutorEngine):
             raise ValueError('input arrays disagree on the row count')
         return out, rows
 
-    def _dispatch_chunk(self, arrays, rows):
+    def _dispatch_chunk(self, arrays, rows, timings=None):
+        import time as _time
         bucket = self.bucket_for(rows)
         prog, fixed_names = self._program(bucket)
         fixed, aux = self._snapshot(fixed_names)
+        t0 = _time.perf_counter()
         padded = []
         for a in arrays:
             if rows < bucket:
@@ -257,33 +259,46 @@ class ServingEngine(_SingleExecutorEngine):
             # device_put takes the host array directly — one transfer,
             # not a default-device stage + re-place
             padded.append(self._place(a))
+        t1 = _time.perf_counter()
+        _tele.histogram('serve.pad').observe((t1 - t0) * 1e3)
         with _tele.span('serve.dispatch', 'serve'):
             pieces = prog(fixed, aux, tuple(padded), _random.next_key())
+        if timings is not None:
+            timings['pad_ms'] = timings.get('pad_ms', 0.0) \
+                + (t1 - t0) * 1e3
+            timings['dispatch_ms'] = timings.get('dispatch_ms', 0.0) \
+                + (_time.perf_counter() - t1) * 1e3
         return pieces, rows, bucket
 
-    def dispatch_rows(self, arrays):
+    def dispatch_rows(self, arrays, timings=None):
         """Asynchronously dispatch ``arrays`` (row counts beyond the
         largest bucket are chunked across several device calls).
         Returns a list of (device_outputs, rows, bucket) chunks —
         device compute proceeds while the caller does host work; hand
         the chunks to :meth:`fetch_chunks` for the one blocking
-        device->host fetch."""
+        device->host fetch. ``timings`` (a dict, optional) accumulates
+        the host-measured ``pad_ms`` / ``dispatch_ms`` for the caller's
+        request-trace breakdown."""
         arrays, rows = self._check_and_cast(arrays)
         chunks = []
         off = 0
         while off < rows:
             take = min(rows - off, self.buckets[-1])
             chunks.append(self._dispatch_chunk(
-                [a[off:off + take] for a in arrays], take))
+                [a[off:off + take] for a in arrays], take,
+                timings=timings))
             off += take
         return chunks
 
-    def fetch_chunks(self, chunks):
+    def fetch_chunks(self, chunks, timings=None):
         """Fetch + pad-strip the chunks of one :meth:`dispatch_rows`
         call back into host arrays: one np list per output, rows in
         request order, pad rows sliced off axis 0 exactly where
-        ``Module.predict`` slices the iterator pad."""
+        ``Module.predict`` slices the iterator pad. ``timings``
+        accumulates the blocking ``fetch_ms``."""
+        import time as _time
         per_out = None
+        t0 = _time.perf_counter()
         with _tele.span('serve.fetch', 'serve'):
             for pieces, rows, _bucket in chunks:
                 host = [np.asarray(o)[:rows] for o in pieces]
@@ -292,6 +307,9 @@ class ServingEngine(_SingleExecutorEngine):
                 else:
                     for acc, h in zip(per_out, host):
                         acc.append(h)
+        if timings is not None:
+            timings['fetch_ms'] = timings.get('fetch_ms', 0.0) \
+                + (_time.perf_counter() - t0) * 1e3
         return [np.concatenate(parts) if len(parts) > 1 else parts[0]
                 for parts in per_out]
 

@@ -16,15 +16,25 @@ Endpoints:
   or a raw ``.npy`` payload (Content-Type ``application/x-npy`` or
   ``application/octet-stream``, single input). Answers JSON
   ``{"outputs": [...], "rows": N}`` with one nested list per graph
-  output, pad rows already stripped;
+  output, pad rows already stripped — or, with ``Accept:
+  application/x-npy``, the FIRST graph output as a raw ``.npy`` body
+  (headers ``X-Rows`` and ``X-Outputs`` carry the row/output counts),
+  so an npy-in client round-trips without JSON re-encoding. A
+  client-supplied ``X-Request-Id`` (or W3C ``traceparent``) becomes
+  the request's trace id (telemetry/trace.py) and is echoed back as
+  ``X-Request-Id``; with telemetry on and no client id, a minted id is
+  echoed instead — either way the id names the request's ``trace``
+  JSONL record;
 - ``GET /models`` — the engine description (name, bucket ladder,
   input/output signature, warm state);
 - ``GET /metrics`` — Prometheus text exposition of the telemetry
   registry (``telemetry/serve.py``'s renderer), so the ``serve.*``
   family is scrapeable from the serving port even when the telemetry
   endpoint is off;
-- ``GET /healthz`` — 200 with a small JSON digest (requests served,
-  queue depth) — the load balancer probe.
+- ``GET /healthz`` — a small JSON digest (requests served, queue
+  depth, SLO state): 200 while healthy, 503 with status
+  ``slo_degraded`` while the SLO plane (telemetry/slo.py) reports the
+  error budget burning — the load balancer probe.
 """
 import io
 import json
@@ -84,28 +94,51 @@ class ServingServer:
         self._thread = None
 
     # -- request handling (pure-ish: tested without sockets too) -----------
-    def predict_payload(self, body, ctype):
+    def predict_arrays(self, body, ctype, trace_id=None):
+        """(code, output-arrays-or-error-dict): the parse + batcher
+        round, shared by the JSON and npy answer paths. Client-side
+        rejects answer 400 (counted, but NOT against the SLO error
+        budget — the service was fine); server-side failures propagate
+        to the handler's 500 (and the batcher already charged them to
+        the budget)."""
         from .. import telemetry as _tele
         try:
             arrays = _parse_predict_body(body, ctype,
                                          self.engine._data_names)
-            outs = self.batcher.predict(arrays)
+            outs = self.batcher.predict(arrays, trace_id=trace_id)
         except (ValueError, json.JSONDecodeError) as e:
             _tele.counter('serve.errors').inc()
             return 400, {'error': str(e)}
-        return 200, {'outputs': [o.tolist() for o in outs],
-                     'rows': int(outs[0].shape[0])}
+        return 200, outs
+
+    def predict_payload(self, body, ctype, trace_id=None):
+        code, res = self.predict_arrays(body, ctype, trace_id=trace_id)
+        if code != 200:
+            return code, res
+        payload = {'outputs': [o.tolist() for o in res],
+                   'rows': int(res[0].shape[0])}
+        if trace_id:
+            payload['trace_id'] = trace_id
+        return 200, payload
 
     def healthz_payload(self):
         from .. import telemetry as _tele
+        from ..telemetry import slo as _slo
         snap = _tele.snapshot() if _tele.enabled() else {}
         c = snap.get('counters', {})
         g = snap.get('gauges', {})
-        return {'status': 'ok', 'model': self.engine.name,
+        slo_bad = _slo.degraded()
+        body = {'status': 'slo_degraded' if slo_bad is not None
+                else 'ok',
+                'model': self.engine.name,
                 'warmed': bool(self.engine.warmed),
                 'requests': int(c.get('serve.requests', 0)),
                 'errors': int(c.get('serve.errors', 0)),
                 'queue_depth': int(g.get('serve.queue_depth', 0) or 0)}
+        slo_snap = _slo.snapshot_slo()
+        if slo_snap is not None:
+            body['slo'] = slo_snap
+        return body
 
     def _make_handler(self):
         from http.server import BaseHTTPRequestHandler
@@ -117,11 +150,15 @@ class ServingServer:
             def log_message(self, fmt, *args):
                 logging.debug('serving.http: ' + fmt, *args)
 
-            def _send(self, code, body, ctype='application/json'):
-                data = body.encode('utf-8')
+            def _send(self, code, body, ctype='application/json',
+                      headers=None):
+                data = body if isinstance(body, bytes) \
+                    else body.encode('utf-8')
                 self.send_response(code)
                 self.send_header('Content-Type', ctype)
                 self.send_header('Content-Length', str(len(data)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
                 self.end_headers()
                 self.wfile.write(data)
 
@@ -153,8 +190,10 @@ class ServingServer:
                             host=_cluster.host_index())
                         self._send(200, body, _tserve._CONTENT_PROM)
                     elif path == '/healthz':
-                        self._send(200, json.dumps(
-                            outer.healthz_payload(), indent=2) + '\n')
+                        payload = outer.healthz_payload()
+                        self._send(200 if payload['status'] == 'ok'
+                                   else 503,
+                                   json.dumps(payload, indent=2) + '\n')
                     elif path == '/':
                         self._send(200, 'mxnet_tpu serving endpoints: '
                                    'POST /predict, GET /models /metrics '
@@ -172,11 +211,41 @@ class ServingServer:
                         self._send(404, json.dumps(
                             {'error': 'not found'}) + '\n')
                         return
+                    from ..telemetry import trace as _trace
                     n = int(self.headers.get('Content-Length') or 0)
                     body = self.rfile.read(n)
+                    # the client's X-Request-Id / traceparent names the
+                    # request end to end; with telemetry on and no
+                    # client id, mint one so the echoed header still
+                    # links to the trace JSONL record
+                    trace_id = _trace.from_headers(self.headers) \
+                        or (_trace.new_trace_id() if _trace.enabled()
+                            else None)
+                    hdrs = {'X-Request-Id': trace_id} if trace_id \
+                        else None
+                    accept = (self.headers.get('Accept') or '') \
+                        .split(';', 1)[0].strip().lower()
+                    if accept in _NPY_TYPES:
+                        code, res = outer.predict_arrays(
+                            body, self.headers.get('Content-Type'),
+                            trace_id=trace_id)
+                        if code != 200:
+                            self._send(code, json.dumps(res) + '\n',
+                                       headers=hdrs)
+                            return
+                        buf = io.BytesIO()
+                        np.save(buf, res[0], allow_pickle=False)
+                        hdrs = dict(hdrs or {})
+                        hdrs['X-Rows'] = str(int(res[0].shape[0]))
+                        hdrs['X-Outputs'] = str(len(res))
+                        self._send(200, buf.getvalue(),
+                                   'application/x-npy', headers=hdrs)
+                        return
                     code, payload = outer.predict_payload(
-                        body, self.headers.get('Content-Type'))
-                    self._send(code, json.dumps(payload) + '\n')
+                        body, self.headers.get('Content-Type'),
+                        trace_id=trace_id)
+                    self._send(code, json.dumps(payload) + '\n',
+                               headers=hdrs)
                 self._guarded(run)
 
         return Handler

@@ -189,12 +189,16 @@ class DecodeEngine(_SingleExecutorEngine):
         return prog, fixed_names
 
     # -- the decode step ---------------------------------------------------
-    def decode(self, session_ids, arrays, reset=False):
+    def decode(self, session_ids, arrays, reset=False, timings=None):
         """One recurrent step for a batch of sessions: ``arrays`` are
         the token inputs (row i belongs to ``session_ids[i]``), the
         carried state comes from / returns to the device ring. Returns
         the payload outputs as host arrays, one row per session.
-        ``reset=True`` restarts every named session from zero state."""
+        ``reset=True`` restarts every named session from zero state.
+        ``timings`` (a dict, optional) accumulates the host-measured
+        ``pad_ms`` / ``dispatch_ms`` / ``fetch_ms`` the same way
+        :meth:`ServingEngine.dispatch_rows` does, so a decode-serving
+        driver can attach the breakdown to its request traces."""
         if not isinstance(arrays, (list, tuple)):
             arrays = [arrays]
         rows = len(session_ids)
@@ -211,8 +215,10 @@ class DecodeEngine(_SingleExecutorEngine):
         # touched: a rejected call must not register/evict sessions (a
         # retry would otherwise find fresh=False and read a reused
         # slot's leftover state)
+        import time as _time
         bucket = next(b for b in self.buckets if b >= rows)
         pad = bucket - rows
+        t_pad0 = _time.perf_counter()
         host_tokens = []
         for n, a in zip(self._token_names, arrays):
             desc = self._descs[n]
@@ -227,6 +233,9 @@ class DecodeEngine(_SingleExecutorEngine):
                 a = np.concatenate(
                     [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
             host_tokens.append(a)
+        if timings is not None:
+            timings['pad_ms'] = timings.get('pad_ms', 0.0) \
+                + (_time.perf_counter() - t_pad0) * 1e3
         with self._lock:
             slots, fresh = self.cache.lookup(session_ids)
             # everything past the lookup runs under the failure guard:
@@ -248,11 +257,16 @@ class DecodeEngine(_SingleExecutorEngine):
                 # device_put takes the host arrays directly — one
                 # transfer, not a default-device stage + re-place
                 tokens = tuple(self._place(a) for a in host_tokens)
+                t_disp0 = _time.perf_counter()
                 with _tele.span('serve.decode', 'serve'):
                     payload, store = prog(fixed, aux, tuple(self._store),
                                           self._place(slots_b),
                                           self._place(fresh_b),
                                           tokens, _random.next_key())
+                if timings is not None:
+                    timings['dispatch_ms'] = \
+                        timings.get('dispatch_ms', 0.0) \
+                        + (_time.perf_counter() - t_disp0) * 1e3
             except Exception:
                 # the ring may have been DONATED into the failed
                 # dispatch — its buffers may be consumed. Rebuild ring
@@ -268,7 +282,12 @@ class DecodeEngine(_SingleExecutorEngine):
                 raise
             self._store = list(store)
             _tele.counter('serve.decode_steps').inc()
-        return [np.asarray(p)[:rows] for p in payload]
+        t_fetch0 = _time.perf_counter()
+        outs = [np.asarray(p)[:rows] for p in payload]
+        if timings is not None:
+            timings['fetch_ms'] = timings.get('fetch_ms', 0.0) \
+                + (_time.perf_counter() - t_fetch0) * 1e3
+        return outs
 
     def warmup(self):
         """Compile every bucket's step program (against throwaway

@@ -40,6 +40,25 @@ instrumentation the hot paths report through:
   host additionally derives the same shard-shift decision from the
   same gathered round and re-balances input shards away from an
   input-bound host at the next epoch boundary;
+- request-level tracing (:mod:`.trace`): one trace id per serving
+  request (minted, or client-supplied via ``X-Request-Id`` /
+  ``traceparent``), a queue/coalesce/pad/dispatch/fetch/split stage
+  breakdown per request as a ``trace`` JSONL record (N coalesced
+  requests share ONE dispatch span id), exemplar trace ids on the
+  ``serve.request_latency`` /metrics summary, and the request's spans
+  merged into the chrome-trace timeline when the profiler runs;
+- the SLO plane (:mod:`.slo`, ``MXTPU_SLO_LATENCY_MS`` /
+  ``MXTPU_SLO_ERROR_PCT``): rolling error-budget burn rate over the
+  serving request stream, ``slo.*`` gauges on ``/metrics``, and an
+  ``slo_degraded`` /healthz state (distinct from hung/non-finite) on
+  sustained burn, clearing on recovery;
+- the incident flight recorder (:mod:`.flight`,
+  ``MXTPU_FLIGHT_RECORDER``, default on with telemetry): a bounded
+  in-memory ring of the most recent records, dumped to
+  ``flight-<reason>.jsonl`` by every incident path — watchdog stall,
+  non-finite incident, OOM report, SLO burn, supervised restart —
+  so a postmortem has the seconds BEFORE the incident
+  (``tools/trace_report.py`` renders a dump);
 - the hang watchdog (:mod:`.watchdog`, ``MXTPU_WATCHDOG_SECS``):
   a daemon-thread progress monitor fed by the hot loops' dispatch /
   sync / kvstore / checkpoint sites; a stall dumps all-thread stacks
@@ -89,11 +108,14 @@ from . import cluster  # noqa: F401  (public submodule: telemetry.cluster.*)
 from . import serve  # noqa: F401  (public submodule: telemetry.serve.*)
 from . import roofline  # noqa: F401  (public submodule: telemetry.roofline.*)
 from . import watchdog  # noqa: F401  (public submodule: telemetry.watchdog.*)
+from . import trace  # noqa: F401  (public submodule: telemetry.trace.*)
+from . import slo  # noqa: F401  (public submodule: telemetry.slo.*)
+from . import flight  # noqa: F401  (public submodule: telemetry.flight.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
            'programs', 'health', 'cluster', 'serve', 'roofline',
-           'watchdog', 'get_registry']
+           'watchdog', 'trace', 'slo', 'flight', 'get_registry']
 
 
 class _State:
@@ -390,3 +412,5 @@ def _reset_for_tests():
     cluster._reset_for_tests()
     roofline._reset_for_tests()
     watchdog._reset_for_tests()
+    slo._reset_for_tests()
+    flight._reset_for_tests()

@@ -310,6 +310,16 @@ def sync_now():
         return None
     st = _tele()
     st.registry.counter('cluster.syncs').inc()
+    # refresh the roofline.* gauges at the sync cadence (read-only
+    # modeled analysis, no JSONL record) so mid-run /metrics scrapes —
+    # and this round's own comm_pct slot — see live roofline state
+    # instead of the values frozen at the last write_summary()
+    from . import roofline
+    try:
+        roofline.republish()
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('telemetry.cluster: roofline republish failed: %s',
+                      e)
     try:
         mat = _allgather(_local_stats())
     except Exception as e:  # noqa: BLE001 — observability must not kill

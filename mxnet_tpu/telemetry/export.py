@@ -4,7 +4,8 @@ The JSONL sink is the machine-readable record a perf investigation
 greps after the fact: one JSON object per line, each with a ``type``
 ('start', 'span', 'compile', 'cache_hit', 'retrace_storm', 'event',
 'program', 'oom', 'health', 'anomaly', 'cluster', 'restart', 'hang',
-'elastic', 'roofline', 'summary') and a ``t`` epoch-seconds stamp. Records buffer in memory and flush every
+'elastic', 'roofline', 'trace', 'slo', 'summary') and a ``t``
+epoch-seconds stamp. Records buffer in memory and flush every
 ``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
 on a per-batch fsync.
 
@@ -68,13 +69,18 @@ class JsonlSink:
     def emit(self, record):
         if self._closed:
             return
+        record.setdefault('t', time.time())
+        if self.host is not None:
+            record.setdefault('host', self.host)
+        # the flight recorder rides the emit chokepoint: everything
+        # headed for the log (including records a capped sink drops)
+        # enters the bounded in-memory ring too — one deque append
+        from . import flight
+        flight.note(record)
         if self._capped:
             self._count_dropped()
             self._heartbeat()
             return
-        record.setdefault('t', time.time())
-        if self.host is not None:
-            record.setdefault('host', self.host)
         line = json.dumps(record)
         tripped = False
         raced = False

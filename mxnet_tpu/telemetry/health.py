@@ -269,6 +269,13 @@ def _incident(info, bisect=None):
     rec = {'type': 'health', 'event': 'nonfinite'}
     rec.update(info)
     _emit(rec)
+    # flight recorder: the window of records BEFORE the first bad step
+    # (dump-bounded per reason, so a permanently-NaN run cannot spam)
+    try:
+        from . import flight
+        flight.dump('nonfinite', extra={'step': info.get('step')})
+    except Exception:  # noqa: BLE001 — forensics must not add a crash
+        pass
     with _state.lock:
         # bounded: a warn-action run that goes permanently NaN keeps
         # training and flags every bad step — count them all (the
@@ -488,6 +495,15 @@ def note_restart(attempt, reason=None, message=None, restore_step=None,
     if diagnostic:
         rec['diagnostic'] = dict(diagnostic)
     _emit(rec)
+    # flight recorder: a restart is the supervision tier's observation
+    # of an unclean exit — dump what led up to it before the restore
+    # wipes the in-memory trail
+    try:
+        from . import flight
+        flight.dump('restart', extra={'attempt': int(attempt),
+                                      'reason': reason})
+    except Exception:  # noqa: BLE001 — forensics must not add a crash
+        pass
 
 
 def note_loss(value):

@@ -359,6 +359,13 @@ def maybe_oom_report(exc):
         st.sink.emit({'type': 'oom', 'error': msg[:500],
                       'programs': progs, 'memory_stats': clean_stats})
         st.sink.flush()
+    # flight recorder: what the process was doing in the records
+    # before the allocation failed
+    try:
+        from . import flight
+        flight.dump('oom')
+    except Exception:  # noqa: BLE001 — forensics must not add a crash
+        pass
     return True
 
 

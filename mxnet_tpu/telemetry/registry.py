@@ -18,12 +18,14 @@ Every site gets its metric via ``registry.counter(name)`` etc. —
 create-once by name, like the reference's dmlc registry pattern.
 Distinct kinds may not share a name (that is a bug at the call site).
 """
+import collections
 import threading
 
 __all__ = ['Counter', 'Gauge', 'Histogram', 'Registry',
            'NULL_COUNTER', 'NULL_GAUGE', 'NULL_HISTOGRAM']
 
 _HIST_WINDOW = 8192   # ring capacity backing the percentile estimates
+_EXEMPLARS_KEPT = 8   # recent exemplar-carrying observations retained
 
 
 class Counter:
@@ -64,10 +66,14 @@ class Gauge:
 
 class Histogram:
     """count/sum/min/max over everything; p50/p95/max over the recent
-    ring (last ``_HIST_WINDOW`` observations)."""
+    ring (last ``_HIST_WINDOW`` observations). Observations may carry
+    an exemplar — a small label dict (e.g. ``{'trace_id': ...}``)
+    linking the sample to a concrete artifact; the most recent few are
+    retained and the highest-valued one rides the snapshot, so a
+    scraped p99 names a trace an operator can actually pull up."""
 
     __slots__ = ('name', '_count', '_sum', '_min', '_max', '_ring',
-                 '_ring_pos', '_lock')
+                 '_ring_pos', '_exemplars', '_lock')
 
     def __init__(self, name):
         self.name = name
@@ -77,9 +83,10 @@ class Histogram:
         self._max = None
         self._ring = []
         self._ring_pos = 0
+        self._exemplars = collections.deque(maxlen=_EXEMPLARS_KEPT)
         self._lock = threading.Lock()
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         v = float(v)
         with self._lock:
             self._count += 1
@@ -93,6 +100,8 @@ class Histogram:
             else:
                 self._ring[self._ring_pos] = v
                 self._ring_pos = (self._ring_pos + 1) % _HIST_WINDOW
+            if exemplar:
+                self._exemplars.append((v, dict(exemplar)))
 
     @property
     def count(self):
@@ -124,10 +133,24 @@ class Histogram:
                          int(round(p / 100.0 * (len(vals) - 1)))))
         return vals[idx]
 
+    def exemplar(self):
+        """The highest-valued recent exemplar as {'value': v,
+        'labels': {...}}, or None — the tail sample the /metrics
+        quantile line links to."""
+        with self._lock:
+            if not self._exemplars:
+                return None
+            v, labels = max(self._exemplars, key=lambda e: e[0])
+        return {'value': v, 'labels': dict(labels)}
+
     def stats(self):
-        return {'count': self._count, 'sum': self._sum, 'mean': self.mean,
-                'min': self._min, 'max': self._max,
-                'p50': self.percentile(50), 'p95': self.percentile(95)}
+        out = {'count': self._count, 'sum': self._sum, 'mean': self.mean,
+               'min': self._min, 'max': self._max,
+               'p50': self.percentile(50), 'p95': self.percentile(95)}
+        ex = self.exemplar()
+        if ex is not None:
+            out['exemplar'] = ex
+        return out
 
 
 class Registry:
@@ -214,10 +237,13 @@ class _NullHistogram:
     min = None
     max = None
 
-    def observe(self, v):
+    def observe(self, v, exemplar=None):
         pass
 
     def percentile(self, p):
+        return None
+
+    def exemplar(self):
         return None
 
     def stats(self):

@@ -59,7 +59,7 @@ import re
 import threading
 
 __all__ = ['enabled', 'note_compiled', 'note_hlo', 'hlo_layer_costs',
-           'load_trace_events', 'analyze', 'summarize',
+           'load_trace_events', 'analyze', 'summarize', 'republish',
            'snapshot_roofline', 'comm_bytes_by_op', 'suggest_action',
            'RECLAIM_ACTIONS', 'TOP_N',
            'OVERHEAD_UTIL_PCT', 'CLASS_COMPUTE', 'CLASS_MEMORY',
@@ -782,7 +782,19 @@ def summarize(step_time_ms=None):
     if d is None:
         return None
     st = _tele()
-    reg = st.registry
+    _publish_gauges(d, st.registry)
+    if st.sink is not None:
+        rec = {'type': 'roofline'}
+        rec.update(d)
+        st.sink.emit(rec)
+    with _lock:
+        _last = d
+    return d
+
+
+def _publish_gauges(d, reg):
+    """One analysis dict -> the roofline.* gauge family (shared by
+    :func:`summarize` and the cluster-cadence :func:`republish`)."""
     reg.gauge('roofline.layers').set(len(d['layers']))
     if d['layers']:
         worst = d['layers'][0]
@@ -809,10 +821,24 @@ def summarize(step_time_ms=None):
         if comm['pct_of_step'] is not None:
             reg.gauge('roofline.comm_pct_of_step').set(
                 comm['pct_of_step'])
-    if st.sink is not None:
-        rec = {'type': 'roofline'}
-        rec.update(d)
-        st.sink.emit(rec)
+
+
+def republish():
+    """Cluster-sync-cadence hook (telemetry/cluster.py): refresh the
+    ``roofline.*`` gauges from a read-only MODELED analysis so a
+    mid-run ``/metrics`` scrape sees live roofline state, not just the
+    values frozen at the last summarize()/write_summary(). No JSONL
+    record is emitted and no profiler capture is loaded from disk — a
+    sync round must stay cheap. Returns the analysis dict, or None
+    while the flag is off / nothing is ingested yet."""
+    global _last
+    if not enabled():
+        return None
+    d = analyze(step_time_ms=_explicit_step_ms, events=[],
+                warn_unknown=False)
+    if d is None:
+        return None
+    _publish_gauges(d, _tele().registry)
     with _lock:
         _last = d
     return d

@@ -117,6 +117,24 @@ def render_prometheus(snapshot, host=None):
             if st.get(key) is not None:
                 lines.append('%s%s %s' % (m, lbl('quantile="%s"' % q),
                                           _prom_num(st[key])))
+        # exemplar: a sibling info-style gauge (NOT an OpenMetrics '#'
+        # suffix — the 0.0.4 text format this endpoint declares has no
+        # exemplar syntax, and a strict scraper would fail the whole
+        # scrape on one). The highest-valued recent exemplar-carrying
+        # observation lands with its labels, so a scraped p95/p99
+        # still links to a concrete trace id
+        ex = st.get('exemplar')
+        if ex and ex.get('labels'):
+            em = m + '_exemplar'
+            lines.append('# HELP %s mxnet_tpu exemplar for %s (recent '
+                         'high sample and the trace that produced it)'
+                         % (em, name))
+            lines.append('# TYPE %s gauge' % em)
+            lines.append('%s%s %s' % (
+                em,
+                lbl(','.join('%s="%s"' % (k, ex['labels'][k])
+                             for k in sorted(ex['labels']))),
+                _prom_num(float(ex['value']))))
         lines.append('%s_sum%s %s' % (m, lbl(),
                                       _prom_num(float(st.get('sum') or 0.0))))
         lines.append('%s_count%s %s' % (m, lbl(),
@@ -130,21 +148,26 @@ def render_prometheus(snapshot, host=None):
 
 def healthz_payload():
     """(ok, digest) for /healthz. ``ok`` flips False — the endpoint
-    answers 503 — once a non-finite incident is on record OR the hang
-    watchdog says the loop is stalled right now; the digest carries the
-    health snapshot (incidents, anomaly counts, last anomaly,
-    input-bound share), the active hang digest (stall age, last
-    progress mark, thread stacks) and the last cluster round. A hang
-    clears back to 200 when progress resumes."""
-    from . import health, cluster, watchdog
+    answers 503 — once a non-finite incident is on record, the hang
+    watchdog says the loop is stalled right now, OR the SLO plane's
+    error budget is burning (telemetry/slo.py). The three unhealthy
+    states are DISTINCT (``degraded`` / ``hung`` / ``slo_degraded``)
+    so a supervisor or load balancer can choose its reaction: evict a
+    hung replica, page on slo_degraded, keep a warn-action NaN run
+    visible. The digest carries the health snapshot, the active hang
+    digest, the SLO snapshot and the last cluster round; hang and SLO
+    states clear automatically on recovery."""
+    from . import health, cluster, watchdog, slo
     st = _tele()
     hs = health.snapshot_health(input_bound=health.input_bound_pct()) \
         if st.active else None
     bad = int(hs.get('nonfinite_steps') or 0) if hs else 0
     hang = watchdog.hang_info()
+    slo_bad = slo.degraded()
     body = {
         'status': 'hung' if hang is not None
-        else ('ok' if not bad else 'degraded'),
+        else ('slo_degraded' if slo_bad is not None
+              else ('ok' if not bad else 'degraded')),
         'telemetry': bool(st.active),
         'health_sentinels': bool(health.enabled()),
         'host': cluster.host_index(),
@@ -153,10 +176,13 @@ def healthz_payload():
         body['hang'] = hang
     if hs is not None:
         body['health'] = hs
+    slo_snap = slo.snapshot_slo()
+    if slo_snap is not None:
+        body['slo'] = slo_snap
     clus = cluster.snapshot_cluster()
     if clus:
         body['cluster'] = clus
-    return bad == 0 and hang is None, body
+    return bad == 0 and hang is None and slo_bad is None, body
 
 
 def summary_payload():
@@ -164,7 +190,7 @@ def summary_payload():
     renders from, read-only (no gauges written, no records emitted),
     plus the rendered table itself."""
     import time
-    from . import programs, health, cluster, roofline
+    from . import programs, health, cluster, roofline, slo
     from .export import summary_table
     st = _tele()
     snap = st.registry.snapshot()
@@ -188,6 +214,7 @@ def summary_payload():
         'health': hs,
         'cluster': clus,
         'roofline': roof,
+        'slo': slo.snapshot_slo(),
         'table': summary_table(snap, elapsed, programs=progs, health=hs,
                                cluster=clus, roofline=roof),
     }

@@ -1,0 +1,166 @@
+"""Request-level tracing: one id per serving request, end to end.
+
+The serving plane's aggregate metrics (``serve.request_latency`` p99,
+queue depth, pad fraction) say *that* tail latency moved, never *which*
+request was slow or *where* in the pipeline its time went. This module
+is the per-request half: a trace id is minted at the HTTP frontend (or
+accepted from the client via ``X-Request-Id`` / W3C ``traceparent``),
+rides the request through :class:`~mxnet_tpu.serving.batcher.
+DynamicBatcher` into the engine dispatch, and lands as one ``trace``
+JSONL record carrying the stage breakdown::
+
+    {"type": "trace", "trace_id": "...", "dispatch_span": "...",
+     "rows": 2, "status": "ok", "total_ms": 7.31,
+     "stages": {"queue_wait_ms": 4.8, "coalesce_ms": 0.02,
+                "pad_ms": 0.05, "dispatch_ms": 0.7, "fetch_ms": 1.6,
+                "split_ms": 0.03}}
+
+The batcher's shared-dispatch structure is preserved: N coalesced
+requests emit N trace records that all carry the SAME ``dispatch_span``
+id (the batch-level pad/dispatch/fetch stages are shared; queue_wait
+and split are per-request), so a dump groups back into one dispatch
+with N passengers. When the chrome-trace profiler is running, each
+finished trace also lands on the profiler timeline (one request span +
+its stage sub-events), merging with the engine's own ``serve.dispatch``
+span rows.
+
+The ``serve.request_latency`` histogram gains the trace id as an
+exemplar, so a scraped p99 on ``/metrics`` links to a concrete trace id
+greppable in the JSONL log / flight recording
+(``tools/trace_report.py`` renders either).
+
+Gating: tracing rides ``MXTPU_TELEMETRY`` — with telemetry off no
+trace object is ever allocated and no id is minted (one cached-bool
+check at the submit site; the compiled programs are untouched either
+way — tracing is pure host-side bookkeeping).
+"""
+import os
+import re
+import time
+
+__all__ = ['enabled', 'new_trace_id', 'new_span_id', 'from_headers',
+           'start', 'RequestTrace', 'STAGES']
+
+# the stage vocabulary, in pipeline order — shared with
+# tools/trace_report.py so the offline renderer and the emitter can
+# never disagree on the breakdown's columns
+STAGES = ('queue_wait', 'coalesce', 'pad', 'dispatch', 'fetch', 'split')
+
+_TRACEPARENT_RE = re.compile(
+    r'^[0-9a-f]{2}-([0-9a-f]{32})-[0-9a-f]{16}-[0-9a-f]{2}$')
+_ID_SAFE_RE = re.compile(r'[^A-Za-z0-9_.\-]')
+_MAX_ID_LEN = 64
+
+
+def enabled():
+    """Whether request tracing is on — exactly the telemetry switch."""
+    from . import enabled as _tele_enabled
+    return _tele_enabled()
+
+
+def new_trace_id():
+    """A fresh 16-hex-char trace id."""
+    return os.urandom(8).hex()
+
+
+def new_span_id():
+    """A fresh 8-hex-char span id (the shared dispatch span)."""
+    return os.urandom(4).hex()
+
+
+def from_headers(headers):
+    """The client-supplied trace id out of an HTTP header mapping, or
+    None. ``X-Request-Id`` wins (sanitized, bounded); else the
+    trace-id field of a well-formed W3C ``traceparent``."""
+    if headers is None:
+        return None
+    rid = headers.get('X-Request-Id')
+    if rid:
+        rid = _ID_SAFE_RE.sub('_', rid.strip())[:_MAX_ID_LEN]
+        if rid:
+            return rid
+    tp = headers.get('traceparent')
+    if tp:
+        m = _TRACEPARENT_RE.match(tp.strip().lower())
+        if m:
+            return m.group(1)
+    return None
+
+
+class RequestTrace:
+    """One request's accumulating span breakdown (host-side only)."""
+
+    __slots__ = ('trace_id', 'dispatch_span', 'rows', 'status',
+                 't0_wall', 't0', 'stages', '_done')
+
+    def __init__(self, trace_id, rows=None):
+        self.trace_id = trace_id or new_trace_id()
+        self.dispatch_span = None
+        self.rows = rows
+        self.status = 'ok'
+        self.t0_wall = time.time()
+        self.t0 = time.monotonic()
+        self.stages = {}
+        self._done = False
+
+    def add(self, stage, ms):
+        """Accumulate ``ms`` under ``stage`` (chunked dispatches add
+        per chunk)."""
+        self.stages[stage] = self.stages.get(stage, 0.0) + float(ms)
+
+    def add_shared(self, dispatch_span, timings):
+        """Absorb one dispatch's batch-level stage timings ({'pad_ms':
+        ..}-style dict from the engine) plus the shared dispatch span
+        id all passengers point at."""
+        self.dispatch_span = dispatch_span
+        for stage in STAGES:
+            v = timings.get(stage + '_ms')
+            if v is not None:
+                self.add(stage, v)
+
+    def finish(self, status='ok'):
+        """Seal the trace: emit the ``trace`` JSONL record (which also
+        enters the flight-recorder ring) and, when the chrome-trace
+        profiler is running, the request's timeline events. Idempotent
+        — the error path and the completion path can race."""
+        if self._done:
+            return None
+        self._done = True
+        self.status = status
+        total_ms = (time.monotonic() - self.t0) * 1e3
+        rec = {'type': 'trace', 'trace_id': self.trace_id,
+               'dispatch_span': self.dispatch_span,
+               'rows': self.rows, 'status': status,
+               't': self.t0_wall, 'total_ms': round(total_ms, 4),
+               'stages': {s + '_ms': round(v, 4)
+                          for s, v in self.stages.items()}}
+        from . import _state as st
+        if st.active and st.sink is not None:
+            st.sink.emit(dict(rec))
+        from .. import profiler as _profiler
+        if _profiler.is_running():
+            t0_us = int(self.t0_wall * 1e6)
+            _profiler.record_event('serve.request[%s]' % self.trace_id,
+                                   t0_us, t0_us + int(total_ms * 1e3),
+                                   'serve')
+            # stage sub-events laid out cumulatively in pipeline order:
+            # the host measured durations, not absolute stamps, so the
+            # reconstruction is sequential by construction
+            off = 0.0
+            for stage in STAGES:
+                v = self.stages.get(stage)
+                if not v:
+                    continue
+                _profiler.record_event(
+                    'serve.req.%s' % stage, t0_us + int(off * 1e3),
+                    t0_us + int((off + v) * 1e3), 'serve')
+                off += v
+        return rec
+
+
+def start(trace_id=None, rows=None):
+    """A live :class:`RequestTrace` when tracing is on, else None (the
+    batcher's one cached-bool check per submit)."""
+    if not enabled():
+        return None
+    return RequestTrace(trace_id, rows=rows)

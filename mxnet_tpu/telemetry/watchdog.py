@@ -305,6 +305,16 @@ def _trip(stalled):
     if tst.active and tst.sink is not None:
         tst.sink.emit(rec)
         tst.sink.flush()    # the process may be about to die — no buffer
+    # flight recorder: the spans/records BEFORE the stall are exactly
+    # what the postmortem wants (and under action=abort this is the
+    # last chance to write them)
+    try:
+        from . import flight
+        flight.dump('hang', extra={'stalled_s': digest['stalled_s'],
+                                   'last_progress':
+                                   digest['last_progress']})
+    except Exception:  # noqa: BLE001 — forensics must not add a crash
+        pass
     logging.warning(
         'watchdog: no training progress for %.1fs (threshold %.1fs; '
         'last mark: %s) — the run looks hung. Thread stacks recorded%s',

@@ -28,6 +28,8 @@ Compared metrics, with direction and default tolerance:
   compile time is the noisiest of the set)
 - ``serving_p99_ms`` (the serving bench's closed-loop request tail
   latency)                                 — higher is a regression (10%)
+- ``serving_queue_wait_p50_ms`` (median time a request sits in the
+  batcher queue before its dispatch)       — higher is a regression (10%)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -48,13 +50,14 @@ import sys
 _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 10.0,
             'xla_live_bytes': 10.0,
             'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0,
-            'serving_p99_ms': 10.0}
+            'serving_p99_ms': 10.0, 'serving_queue_wait_p50_ms': 10.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
               'xla_live_bytes': +1,
               'opt_state_bytes_per_device': +1, 'compile_s': +1,
-              'serving_p99_ms': +1}
+              'serving_p99_ms': +1, 'serving_queue_wait_p50_ms': +1}
 _ORDER = ('throughput', 'mfu', 'xla_temp_bytes', 'xla_live_bytes',
-          'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms')
+          'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms',
+          'serving_queue_wait_p50_ms')
 
 
 def load_bench(path):
@@ -130,6 +133,13 @@ def extract(rec):
     # regression in the continuous-batching plane
     if rec.get('serving_p99_ms') is not None:
         out['serving_p99_ms'] = float(rec['serving_p99_ms'])
+    # serving queue wait (the tracing plane's per-stage breakdown):
+    # a rise means requests sit in the batcher longer before their
+    # dispatch — the batching economics regressed even if device
+    # latency held
+    if rec.get('serving_queue_wait_p50_ms') is not None:
+        out['serving_queue_wait_p50_ms'] = \
+            float(rec['serving_queue_wait_p50_ms'])
     return out
 
 

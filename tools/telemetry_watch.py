@@ -157,6 +157,43 @@ def render(summary, steps_per_s=None, reqs_per_s=None):
         if c.get('serve.errors'):
             bits.append('%d errors' % int(c['serve.errors']))
         lines.append('  serving      %s' % ', '.join(bits))
+        # per-stage latency breakdown (the tracing plane's histograms):
+        # where a request's time goes — queue wait vs pad vs the
+        # device round (dispatch + blocking fetch)
+        qw = (h.get('serve.queue_wait') or {}).get('p50')
+        pad = (h.get('serve.pad') or {}).get('p50')
+        disp = (h.get('serve.dispatch') or {}).get('p50')
+        fetch = (h.get('serve.fetch') or {}).get('p50')
+        if qw is not None or pad is not None or disp is not None:
+            comp = None
+            if disp is not None or fetch is not None:
+                comp = float(disp or 0.0) + float(fetch or 0.0)
+            lines.append('  stages       queue p50 %s ms, pad p50 %s '
+                         'ms, compute p50 %s ms (dispatch+fetch)'
+                         % (_fmt(qw), _fmt(pad), _fmt(comp)))
+    # SLO plane (telemetry/slo.py): objective, burn, budget — from the
+    # slo.* gauges (HTTP and JSONL modes both carry them) or the
+    # /summary payload's slo snapshot
+    slo = summary.get('slo') or {}
+    slo_lat = g.get('slo.latency_objective_ms',
+                    slo.get('latency_objective_ms'))
+    slo_budget = g.get('slo.error_budget_pct', slo.get('error_budget_pct'))
+    if slo_lat is not None or slo_budget is not None:
+        bits = []
+        if slo_lat is not None:
+            bits.append('latency obj %s ms' % _fmt(float(slo_lat)))
+        if slo_budget is not None:
+            bits.append('err budget %s%%' % _fmt(float(slo_budget)))
+        burn = g.get('slo.burn_rate', slo.get('burn_rate'))
+        if burn is not None:
+            bits.append('burn %sx' % _fmt(float(burn)))
+        remaining = g.get('slo.budget_remaining_pct',
+                          slo.get('budget_remaining_pct'))
+        if remaining is not None:
+            bits.append('budget left %s%%' % _fmt(float(remaining)))
+        if g.get('slo.degraded') or slo.get('degraded'):
+            bits.append('DEGRADED')
+        lines.append('  slo          %s' % ', '.join(bits))
     hs = summary.get('health')
     # hang / restart / elastic events render on the health line even
     # when the sentinel plane (MXTPU_HEALTH) is off — they live in

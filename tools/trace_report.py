@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Render a trace/flight JSONL dump offline.
+
+The serving plane writes one ``trace`` record per request (stage
+breakdown + the shared dispatch span id, telemetry/trace.py), and every
+incident path dumps the flight recorder's ring to a
+``flight-<reason>.jsonl`` (telemetry/flight.py). This tool renders
+either — or a plain telemetry log containing trace records::
+
+    python tools/trace_report.py telemetry.jsonl
+    python tools/trace_report.py flight-hang.jsonl
+    python tools/trace_report.py telemetry.jsonl --trace 0af7651916cd
+    python tools/trace_report.py flight-slo-burn.jsonl --tail 30
+
+Output: for traces, a per-request table (trace id, rows, status,
+total, per-stage ms) grouped under each shared dispatch span — the N
+passengers of one coalesced dispatch render together, proving the
+batcher's structure; for a flight dump, the header (reason, when,
+ring size), a per-type census of the retained records, and the last
+``--tail`` records as a timeline.
+"""
+import argparse
+import collections
+import json
+import sys
+
+# keep the stage column order identical to the emitter's vocabulary
+# without importing the framework (the tool must render dumps from a
+# machine that cannot import jax)
+STAGES = ('queue_wait', 'coalesce', 'pad', 'dispatch', 'fetch', 'split')
+
+
+def load(path):
+    """All parseable JSONL records in file order (bad lines skipped —
+    a crashed writer's torn tail must not void the report)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                continue
+    return records
+
+
+def _fmt(v):
+    if v is None:
+        return '-'
+    if isinstance(v, float):
+        return '%.2f' % v
+    return str(v)
+
+
+def render_traces(records, trace_id=None):
+    """The per-request table, grouped by shared dispatch span."""
+    traces = [r for r in records if r.get('type') == 'trace']
+    if trace_id:
+        traces = [t for t in traces
+                  if str(t.get('trace_id', '')).startswith(trace_id)]
+    if not traces:
+        return ['(no trace records%s)'
+                % (' matching %r' % trace_id if trace_id else '')]
+    by_span = collections.OrderedDict()
+    for t in traces:
+        by_span.setdefault(t.get('dispatch_span') or '-', []).append(t)
+    w = max(max(len(str(t.get('trace_id', '?'))) for t in traces),
+            len('trace_id'))
+    head = '  %-*s %5s %6s %9s ' % (w, 'trace_id', 'rows', 'status',
+                                    'total_ms')
+    head += ' '.join('%9s' % (s + '_ms') for s in STAGES)
+    lines = ['%d trace record(s), %d dispatch span(s)'
+             % (len(traces), len(by_span))]
+    for span, ts in by_span.items():
+        lines.append('dispatch %s (%d request%s):'
+                     % (span, len(ts), 's' if len(ts) != 1 else ''))
+        lines.append(head)
+        for t in ts:
+            st = t.get('stages') or {}
+            row = '  %-*s %5s %6s %9s ' % (
+                w, t.get('trace_id', '?'), _fmt(t.get('rows')),
+                t.get('status', '?'), _fmt(t.get('total_ms')))
+            row += ' '.join('%9s' % _fmt(st.get(s + '_ms'))
+                            for s in STAGES)
+            lines.append(row)
+    return lines
+
+
+def render_flight(records, tail=20):
+    """The flight-dump view: header, per-type census, recent tail."""
+    lines = []
+    head = records[0] if records and records[0].get('type') == 'flight' \
+        else None
+    body = records[1:] if head else records
+    if head:
+        lines.append('flight recording: reason=%s records=%s '
+                     'ring_size=%s' % (head.get('reason', '?'),
+                                       head.get('records', '?'),
+                                       head.get('ring_size', '?')))
+    counts = collections.Counter(r.get('type', '?') for r in body)
+    if counts:
+        lines.append('record census: '
+                     + ', '.join('%s=%d' % (k, counts[k])
+                                 for k in sorted(counts)))
+    shown = body[-tail:]
+    if shown:
+        lines.append('last %d record(s):' % len(shown))
+        t0 = shown[0].get('t')
+        for r in shown:
+            dt = ('%+8.3fs' % (r['t'] - t0)) \
+                if t0 is not None and r.get('t') is not None else '       ?'
+            kind = r.get('type', '?')
+            detail = r.get('name') or r.get('event') \
+                or r.get('trace_id') or r.get('detector') \
+                or r.get('last_progress') or ''
+            extra = ''
+            if kind == 'span' and r.get('dur_ms') is not None:
+                extra = ' %.2fms' % r['dur_ms']
+            elif kind == 'trace' and r.get('total_ms') is not None:
+                extra = ' %.2fms %s' % (r['total_ms'],
+                                        r.get('status', ''))
+            lines.append('  %s  %-10s %s%s' % (dt, kind, detail, extra))
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Render serving trace records and flight-recorder '
+                    'dumps (flight-<reason>.jsonl) offline.')
+    ap.add_argument('path', help='a telemetry/trace/flight JSONL file')
+    ap.add_argument('--trace', default=None, metavar='ID',
+                    help='show only trace records whose id starts '
+                         'with ID')
+    ap.add_argument('--tail', type=int, default=20,
+                    help='timeline rows rendered for a flight dump '
+                         '(default 20)')
+    args = ap.parse_args(argv)
+    records = load(args.path)
+    if not records:
+        print('trace_report: %s holds no parseable JSONL records'
+              % args.path)
+        return 1
+    is_flight = records[0].get('type') == 'flight'
+    has_traces = any(r.get('type') == 'trace' for r in records)
+    out = []
+    # --trace narrows the whole report to the matching requests: the
+    # flight timeline (which shows every retained record) is skipped
+    if is_flight and not args.trace:
+        out.extend(render_flight(records, tail=args.tail))
+        if has_traces:
+            out.append('')
+    if has_traces or not is_flight or args.trace:
+        out.extend(render_traces(records, trace_id=args.trace))
+    try:
+        print('\n'.join(out))
+    except BrokenPipeError:   # | head — not an error worth a traceback
+        try:
+            sys.stdout.close()
+        except Exception:  # noqa: BLE001
+            pass
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
