@@ -1207,6 +1207,8 @@ def main():
              '(fresh processes)' % (cache_cold_s, compile_warm_s))
 
     t = time.perf_counter()
+    warm_t0 = t
+    warm_losses = []
     for _ in range(WARMUP_STEPS):
         masters, aux, vel, loss = compiled(
             masters, aux, vel, images, labels, key)
@@ -1214,6 +1216,7 @@ def main():
         # wrapper never sees these dispatches — count them explicitly
         # or the bench.train_step program record reports dispatches=0
         _tele.programs.note_dispatch('bench.train_step')
+        warm_losses.append(loss)   # scalar handles: banked post-barrier
     # sync via host fetch: on tunneled runtimes block_until_ready can
     # return before the chain drains; a device->host copy cannot
     loss_val = float(np.asarray(loss))
@@ -1227,6 +1230,7 @@ def main():
     if platform.startswith('cpu'):
         bench_steps = min(bench_steps, 5)   # part of the CPU shrink
     _log('measuring %d steps...' % bench_steps)
+    bench_losses = []
     t0 = time.perf_counter()
     for _ in range(bench_steps):
         # span = host-side dispatch cost per device call (the tunnel-RTT
@@ -1237,8 +1241,43 @@ def main():
         _tele.programs.note_dispatch('bench.train_step')  # see warmup
         # feeds the xla.mfu estimate together with note_step_flops above
         _tele.counter('fit.steps').inc(STEPS_PER_CALL)
+        bench_losses.append(loss)
     float(np.asarray(loss))  # host fetch = true barrier (see warmup)
     dt = time.perf_counter() - t0
+
+    # run-ledger feed (ISSUE 15): bank the warmup + measured loss
+    # trajectory as `scalars` records. Dispatch is async, so per-call
+    # enqueue clocks would bunch at the loop head — timestamps are
+    # amortized evenly over each phase's measured wall time instead
+    # (only deltas matter to time_to_loss). Fetched AFTER the barrier:
+    # zero syncs inside the timed region.
+    ledger_final_loss = None
+    ledger_time_to_loss = None
+    try:
+        from mxnet_tpu.telemetry import ledger as _ledger
+        if _ledger.enabled():
+            # phase clocks are perf_counter (process uptime) — shift
+            # them onto the epoch timeline so every scalars record's
+            # 't' matches the rest of the JSONL (documented contract)
+            epoch_anchor = time.time() - time.perf_counter()
+            for phase_t0, phase_dt, losses, base in (
+                    (warm_t0, warmup_dt, warm_losses, 0),
+                    (t0, dt, bench_losses, WARMUP_STEPS)):
+                n = len(losses)
+                for i, l in enumerate(losses):
+                    _ledger.feed((base + i + 1) * STEPS_PER_CALL,
+                                 float(np.asarray(l)),
+                                 t=epoch_anchor + phase_t0
+                                 + (i + 1) * phase_dt / n)
+            ledger_final_loss = _ledger.final_loss()
+            tgt = _ledger.progress_target(0.9)
+            secs = _ledger.time_to_loss(tgt)
+            if tgt is not None and secs is not None:
+                ledger_time_to_loss = {'target': round(tgt, 6),
+                                       'seconds': secs}
+    except Exception as e:  # noqa: BLE001 — the ledger must never cost
+        _log('ledger feed failed (headline unaffected): %s' % e)
+    del warm_losses, bench_losses
 
     # sentinel-overhead probe (MXTPU_BENCH_HEALTH=0 skips): the same
     # in-graph reductions MXTPU_HEALTH adds, timed against the base
@@ -1289,6 +1328,20 @@ def main():
         }
     if mfu is not None:
         out['mfu'] = round(mfu, 4)
+    if ledger_final_loss is not None:
+        # run-ledger metrics (ISSUE 15): tools/bench_diff.py gates
+        # final_loss (a nan/diverged run must not bank as a healthy
+        # throughput number); time_to_loss is ledger context, ungated.
+        # bench_steps scales with measured throughput, so convergence
+        # is only comparable between runs that trained the same number
+        # of steps — final_loss_step lets bench_diff skip the gate
+        # (visibly) on a mismatch instead of conflating a throughput
+        # change with a convergence change
+        out['final_loss'] = round(float(ledger_final_loss), 6)
+        out['final_loss_step'] = \
+            (WARMUP_STEPS + bench_steps) * STEPS_PER_CALL
+    if ledger_time_to_loss is not None:
+        out['time_to_loss'] = ledger_time_to_loss
     if BACKEND_ATTEMPTS:
         # how many probe rounds the backend cost this run (1 = first
         # try; >1 = the flaky-tunnel shape; CPU fallback burned all)

@@ -256,6 +256,14 @@ flags.declare('MXTPU_HEALTH_WINDOW', int, 64,
               'Trailing-window length (observations) backing the health '
               "anomaly detectors' rolling median/MAD baseline",
               min_value=4)
+flags.declare('MXTPU_TFEVENTS_DIR', str, '',
+              'Directory for native TensorBoard event files '
+              '(telemetry/ledger.py): every ledger scalar '
+              '(MXTPU_SCALARS_EVERY) is also encoded as a tfevents '
+              'record through the dependency-free TFRecord/Event '
+              'writer — `tensorboard --logdir <dir>` works on any run '
+              'without tensorboardX or torch installed. Empty '
+              '(default) = no event file is written')
 flags.declare('MXTPU_WATCHDOG_SECS', float, 0.0,
               'Hang watchdog (telemetry/watchdog.py): once the training '
               'loop has made its first progress mark, a daemon thread '
@@ -510,6 +518,18 @@ flags.declare('MXTPU_FAULT_HOST', int, -1,
               'every worker of a gang, and a chaos test usually wants '
               'to lose exactly one). -1 (default) = arm wherever the '
               'env reaches', min_value=-1)
+flags.declare('MXTPU_SCALARS_EVERY', int, 25,
+              'Run-ledger scalar cadence (telemetry/ledger.py, requires '
+              'MXTPU_TELEMETRY=1): every N trained steps one `scalars` '
+              'JSONL record banks the step\'s loss, learning rate, '
+              'throughput, global + worst-layer gradient statistics and '
+              'MFU — the bounded per-step timeseries tools/'
+              'run_compare.py diffs across runs — and the per-layer '
+              'dynamics plane (MXTPU_DYNAMICS) publishes its gauges at '
+              'the same decimated cadence. With MXTPU_TFEVENTS_DIR set '
+              'each record also lands as native TensorBoard scalars. '
+              '0 = no scalar records (the manifest still writes)',
+              min_value=0)
 flags.declare('MXTPU_SERVE_BIND', str, '127.0.0.1',
               'Bind address for the model-serving HTTP frontend '
               '(mxnet_tpu/serving/http.py, tools/serve_model.py). '
@@ -580,6 +600,25 @@ flags.declare('MXTPU_SLO_WINDOW', int, 128,
               'verdict are computed over the most recent this-many '
               'requests, so recovery is automatic once fresh traffic '
               'meets the objectives', min_value=8)
+flags.declare('MXTPU_DYNAMICS', bool, False,
+              'Per-layer training dynamics (telemetry/dynamics.py, '
+              'requires MXTPU_TELEMETRY=1): extend the in-graph health '
+              'sentinel from one global vector to a per-parameter '
+              'matrix — per-layer gradient norm, parameter norm and '
+              'update ratio ||dw||/||w||, plus an activation '
+              'zero-fraction per named graph output (dead-ReLU '
+              'detection) — computed inside the already-compiled '
+              'fused-fit window and per-batch executor programs and '
+              'shipped home in the window\'s EXISTING single fetch (no '
+              'added device syncs). Publishes dynamics.<layer>.* '
+              'gauges + `dynamics` JSONL records at the '
+              'MXTPU_SCALARS_EVERY cadence and feeds each layer\'s '
+              'grad-norm/update-ratio into the MXTPU_HEALTH spike '
+              'detectors so a vanishing or exploding LAYER raises a '
+              'named anomaly before the global norm moves. Off (or '
+              'telemetry off) = true no-op: the compiled programs are '
+              'byte-identical ("Following training dynamics", '
+              'docs/observability.md)')
 flags.declare('MXTPU_GANG_MIN_HOSTS', int, 0,
               'Elastic floor for tools/gang_supervisor.py (read from '
               'the environment — the supervisor never imports the '

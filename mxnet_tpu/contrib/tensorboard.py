@@ -7,23 +7,32 @@ __all__ = ['LogMetricsCallback']
 class LogMetricsCallback:
     """Log metric values as tensorboard scalars each batch.
 
-    Needs a SummaryWriter provider (`tensorboardX` or `torch.utils.
-    tensorboard`); raises a clear ImportError otherwise (the reference
-    requires the standalone `tensorboard` python package the same way).
+    Uses a SummaryWriter provider when one is installed (`tensorboardX`
+    or `torch.utils.tensorboard`, in that order — the reference
+    behavior); otherwise falls back to the framework's own
+    dependency-free tfevents writer
+    (:class:`mxnet_tpu.telemetry.ledger.TfEventsWriter`), so
+    ``tensorboard --logdir`` works without either package installed.
+    The callback API is unchanged either way.
     """
 
     def __init__(self, logging_dir, prefix=None):
         self.prefix = prefix
         self.step = 0
+        SummaryWriter = None
         try:
             from tensorboardX import SummaryWriter
         except ImportError:
             try:
                 from torch.utils.tensorboard import SummaryWriter
             except ImportError:
-                raise ImportError(
-                    'LogMetricsCallback needs tensorboardX or torch '
-                    'with tensorboard support installed')
+                SummaryWriter = None
+        if SummaryWriter is None:
+            # native fallback: the hand-rolled TFRecord/Event encoder
+            # (golden-bytes tested) — add_scalar is the only method the
+            # callback needs
+            from ..telemetry.ledger import TfEventsWriter
+            SummaryWriter = TfEventsWriter
         self.summary_writer = SummaryWriter(logging_dir)
 
     def __call__(self, param):

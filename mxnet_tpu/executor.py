@@ -200,8 +200,17 @@ class Executor:
         # inside the same compiled step. Off: the trace is byte-
         # identical to the plain form (asserted by test_health.py).
         from .telemetry import health as _health
+        from .telemetry import dynamics as _dynamics
         self._health_on = _health.enabled() and bool(self._grad_names)
         health_on = self._health_on
+        # per-layer training dynamics (telemetry/dynamics): with
+        # MXTPU_DYNAMICS=1 the fused fwd+bwd ALSO returns the packed
+        # per-layer stats vector — it rides the same per-batch host
+        # sync the health sentinel already pays. Off: byte-identical
+        # trace (asserted by test_dynamics.py).
+        self._dyn_on = _dynamics.enabled() and bool(self._grad_names)
+        dyn_on = self._dyn_on
+        self._out_names = list(symbol.list_outputs())
 
         def fwd_bwd(arg_arrays, aux_arrays, key, head_grads):
             def f(wrt):
@@ -215,10 +224,14 @@ class Executor:
             (outs, new_aux), vjp = jax.vjp(mirror_wrap(f), wrt)
             zero_aux = tuple(jnp.zeros_like(a) for a in new_aux)
             (grads,) = vjp((head_grads, zero_aux))
+            rets = (outs, new_aux, grads)
             if health_on:
-                hv = _health.step_stats(outs, grads=grads, params=wrt)
-                return outs, new_aux, grads, hv
-            return outs, new_aux, grads
+                rets += (_health.step_stats(outs, grads=grads,
+                                            params=wrt),)
+            if dyn_on:
+                rets += (_dynamics.step_stats(outs, grads=grads,
+                                              params=wrt),)
+            return rets
 
         self._fwd_bwd = jax.jit(fwd_bwd)
         self._run_eager = run
@@ -419,13 +432,14 @@ class Executor:
             # dispatch-exception seam: the per-batch loop's fused
             # fwd+bwd is about to train one step
             _faults.maybe_raise('executor')
-        hv = None
+        hv = dv = None
+        rets = list(self._fwd_bwd(arg_data, aux_data, key, heads))
+        outs, new_aux, grads = rets[0], rets[1], rets[2]
+        extra = rets[3:]
         if self._health_on:
-            outs, new_aux, grads, hv = self._fwd_bwd(arg_data, aux_data,
-                                                     key, heads)
-        else:
-            outs, new_aux, grads = self._fwd_bwd(arg_data, aux_data, key,
-                                                 heads)
+            hv = extra.pop(0)
+        if self._dyn_on:
+            dv = extra.pop(0)
         self._write_aux(new_aux)
         if self._pending is not None:
             for h, o in zip(self._out_handles, outs):
@@ -444,6 +458,10 @@ class Executor:
             from .telemetry import health as _health
             _health.note_step(hv, source='executor',
                               bisect=self.first_nonfinite_node)
+        if dv is not None:
+            # per-layer dynamics row: rides the same per-batch sync
+            from .telemetry import dynamics as _dynamics
+            _dynamics.note_step(dv, self._grad_names, self._out_names)
 
     def _head_grads(self, out_grads, arg_data, aux_data):
         if out_grads is None:

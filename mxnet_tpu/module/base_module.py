@@ -295,7 +295,17 @@ class BaseModule:
         # One cached-bool check — zero overhead while off. The cluster
         # sync hook (telemetry/cluster.py) is gated the same way.
         health_on = _tele.health.enabled()
+        # per-layer dynamics (telemetry/dynamics): executor-level rows
+        # take their step index from the same note_batch context the
+        # health incidents use, so the batch context is fed when EITHER
+        # plane is on
+        dyn_on = _tele.dynamics.enabled()
         cluster_on = _tele.cluster.enabled()
+        # run ledger (telemetry/ledger): the manifest records this
+        # run's resolved configuration once; the per-step scalars
+        # (loss/lr/throughput/grad stats) bank at MXTPU_SCALARS_EVERY
+        ledger_on = _tele.ledger.enabled()
+        _tele.ledger.ensure_manifest(module=self)
         # hang watchdog (telemetry/watchdog.py): per-step progress marks
         # feed the stall monitor; off = one cached-bool check here and
         # no call in the loop
@@ -348,7 +358,7 @@ class BaseModule:
                     if monitor is not None:
                         monitor.tic()
                     t_step = time.time() if health_on else 0.0
-                    if health_on:
+                    if health_on or dyn_on:
                         # executor-level incidents carry the real batch index
                         _tele.health.note_batch(nbatch)
                     # per-batch telemetry: host-dispatch vs draw vs metric vs
@@ -386,6 +396,13 @@ class BaseModule:
                         # off-sync steps: one clock read + a deque append;
                         # the allgather fires every SYNC_EVERY steps only
                         _tele.cluster.note_step()
+                    if ledger_on:
+                        # lr passed lazily: the scheduler sample only
+                        # runs on the decimated due steps
+                        _tele.ledger.note_train_step(
+                            lr=lambda: _cur_lr(
+                                getattr(self, '_optimizer', None)),
+                            metric=eval_metric)
                     if ckpt is not None:
                         # per-batch path: the sentinel check already ran in
                         # backward, so health trails by nothing (lag=0)
@@ -443,8 +460,11 @@ class BaseModule:
         _tele.health.note_batch(None)
         _tele.counter('fit.epochs').inc()
         _tele.xla.sample_memory()   # live/peak device bytes, once per epoch
-        for name, val in eval_metric.get_name_value():
+        name_vals = eval_metric.get_name_value()
+        for name, val in name_vals:
             self.logger.info('Epoch[%d] Train-%s=%f', epoch, name, val)
+        _tele.ledger.note_eval([('train-%s' % n, v) for n, v in name_vals],
+                               epoch=epoch)
         toc = time.time()
         self.logger.info('Epoch[%d] Time cost=%.3f', epoch, (toc - tic))
 
@@ -462,6 +482,8 @@ class BaseModule:
             for name, val in res:
                 self.logger.info('Epoch[%d] Validation-%s=%f',
                                  epoch, name, val)
+            _tele.ledger.note_eval([('val-%s' % n, v) for n, v in res],
+                                   epoch=epoch)
         # score() suspends the hang watchdog on exit (standalone-eval
         # semantics); mid-fit the NEXT epoch is coming, so re-arm here
         # — a host lost during eval wedges exactly the next epoch's
@@ -575,3 +597,16 @@ def _as_list(obj):
     if isinstance(obj, list):
         return obj
     return [obj]
+
+
+def _cur_lr(opt):
+    """The optimizer's CURRENT effective base learning rate (scheduler
+    honored), or None — the run ledger's lr scalar."""
+    if opt is None:
+        return None
+    try:
+        if getattr(opt, 'lr_scheduler', None) is not None:
+            return float(opt.lr_scheduler(opt.num_update))
+        return float(opt.lr)
+    except Exception:  # noqa: BLE001 — exotic optimizer: no lr scalar
+        return None

@@ -62,7 +62,8 @@ from ..executor import mirror_wrap
 from ..kvstore import _updater_key
 from ..ndarray.ndarray import from_jax
 from ..ops import registry as _reg
-from .window_pipeline import (WindowPipeline, health_sentinel, host_wrap,
+from .window_pipeline import (WindowPipeline, dynamics_sentinel,
+                              health_sentinel, host_wrap,
                               registered_jit, window_bisect, window_size)
 from .window_pipeline import plan_metric as _metric_plan
 
@@ -458,6 +459,12 @@ class FusedFitLoop:
         # keys reuse on the flag) — None keeps the traced window
         # byte-identical to the plain form
         self._health_fn = health_sentinel()
+        # per-layer training dynamics (telemetry/dynamics): same
+        # contract — captured at build, traced into the window, rides
+        # the existing single fetch; None = byte-identical program
+        self._dyn_fn = dynamics_sentinel()
+        self._out_names = list(module._symbol.list_outputs())
+        self._last_lr = None   # last sampled lr (run-ledger scalars)
         self._upd_keys = updater_keys(module, self._grad_names)
         self._ensure_states()
         # ZeRO-style sharded weight update (arXiv:2004.13336): on an
@@ -575,7 +582,9 @@ class FusedFitLoop:
                        # the health sentinels are traced INTO the window
                        # program — flipping MXTPU_HEALTH between fit()
                        # calls must rebuild the loop
-                       bool(_tele.health.enabled()))
+                       bool(_tele.health.enabled()),
+                       # ...and so is the per-layer dynamics matrix
+                       bool(_tele.dynamics.enabled()))
         cached = module.__dict__.get('_fused_fit_cache')
         if cached is not None and sig is not None and cached[0] == sig:
             loop = cached[1]
@@ -694,6 +703,8 @@ class FusedFitLoop:
                 idx = self._upd_keys[name]
                 o._update_count(idx)
                 lr[w, j], wd[w, j] = self._plan.lr_wd(idx)
+        if n:
+            self._last_lr = float(lr[-1, 0])
         return lr, wd
 
     def _mode(self, n):
@@ -712,6 +723,7 @@ class FusedFitLoop:
         ops = {mode: _reg.get(mode) for mode in set(modes.values())}
         stat_fns = self.stat_fns
         health_fn = self._health_fn
+        dyn_fn = self._dyn_fn
         accum = self._accum
         W = self.window
         mesh = self._mesh
@@ -842,16 +854,27 @@ class FusedFitLoop:
                     # host-fallback metric: ship the raw outputs; scan
                     # stacks them into (W, ...) per output
                     ys = outs
+                extras = []
                 if health_fn is not None:
                     # per-step sentinel vector rides the scan ys — the
                     # (W, k) stack comes home in the window's existing
                     # fetch, so a mid-window NaN keeps its step index
-                    hv = health_fn(
+                    extras.append(health_fn(
                         outs, grads=grads,
                         params=tuple(params[i] for i in grad_carry_idx),
                         new_params=tuple(new_params[i]
-                                         for i in grad_carry_idx))
-                    ys = (ys, hv)
+                                         for i in grad_carry_idx)))
+                if dyn_fn is not None:
+                    # per-layer dynamics vector rides the same ys — the
+                    # (W, 3n+outs) matrix ships in the SAME single
+                    # fetch (no added syncs; counter-asserted in tests)
+                    extras.append(dyn_fn(
+                        outs, grads=grads,
+                        params=tuple(params[i] for i in grad_carry_idx),
+                        new_params=tuple(new_params[i]
+                                         for i in grad_carry_idx)))
+                if extras:
+                    ys = (ys, *extras)
                 return (tuple(new_params), tuple(new_states), new_aux,
                         gaccs), ys
 
@@ -1060,13 +1083,22 @@ class FusedFitLoop:
         # metric's .asnumpy() calls cost no device round-trip
         host_nd = host_wrap(self._exec._ctx)
 
-        # health sentinels: which metric children carry a per-batch
-        # loss (CrossEntropy sufficient statistics feed the rolling
-        # loss-spike detector for free)
+        # which metric children carry a per-batch loss: the in-graph
+        # CrossEntropy sufficient statistics feed the health plane's
+        # rolling loss-spike detector AND the run ledger's per-step
+        # loss scalar for free (note_loss no-ops while health is off)
         ce_idx = [j for j, c in enumerate(self.children or ())
                   if type(c) is metric_mod.CrossEntropy] \
-            if self._health_fn is not None and self.stat_fns is not None \
+            if self.stat_fns is not None and (
+                self._health_fn is not None or _tele.ledger.enabled()) \
             else []
+
+        # wall stamp of the previous apply_stats fetch: the ledger's
+        # per-step timestamps amortize over the inter-window wall so
+        # W steps processed in one burst don't bunch at one instant
+        # (which would inflate steps_per_sec and zero run_compare's
+        # step_time deltas)
+        _stats_t = [None]
 
         def apply_stats(pieces, labels_w, nbatch, win_snaps=None):
             """One host fetch for the window's results, then exact
@@ -1076,13 +1108,19 @@ class FusedFitLoop:
             each step's outputs against the window's own labels
             (snapshotted at collection time — see below), the way the
             reference loop's update_metric would."""
-            hrows = None
-            if self._health_fn is not None:
-                pieces, hrows = pieces
+            hrows = drows = None
+            if self._health_fn is not None or self._dyn_fn is not None:
+                parts = list(pieces)
+                pieces = parts.pop(0)
+                if self._health_fn is not None:
+                    hrows = parts.pop(0)
+                if self._dyn_fn is not None:
+                    drows = parts.pop(0)
             with _tele.span('fused_fit.fetch', 'fused_fit'):
                 # the window's one device->host fetch (full RTT on a
                 # tunneled runtime; everything after is host math) —
-                # the (W, k) sentinel matrix rides the same fetch
+                # the (W, k) sentinel AND dynamics matrices ride the
+                # same fetch
                 if self.stat_fns is not None:
                     host = np.asarray(pieces)      # (W, 2 * n_metrics)
                     steps = host.shape[0]
@@ -1091,6 +1129,8 @@ class FusedFitLoop:
                     steps = outs_host[0].shape[0]
                 if hrows is not None:
                     hmat = np.asarray(hrows)
+                if drows is not None:
+                    dmat = np.asarray(drows)
             if hrows is not None:
                 # mid-window NaN -> exact step attribution + (first
                 # incident) staged-path first-bad-layer bisect on the
@@ -1103,17 +1143,47 @@ class FusedFitLoop:
                         list(self.module._label_names), win_snaps, True,
                         defer_fn=self._defer_eager)
                     if win_snaps is not None else None)
+            if drows is not None:
+                # per-layer dynamics: each row keeps its exact step,
+                # feeds the per-layer spike detectors and raises a
+                # named-layer incident on a non-finite statistic
+                _tele.dynamics.note_window(
+                    dmat, self._grad_names, self._out_names,
+                    nbatch_base=nbatch)
+            ledger_on = _tele.ledger.enabled()
+            if ledger_on:
+                t_apply = time.time()
+                t_prev = _stats_t[0]
+                _stats_t[0] = t_apply
             for i in range(steps):
+                loss_i = None
                 if self.stat_fns is not None:
                     for j, child in enumerate(self.children):
                         child.sum_metric += float(host[i, 2 * j])
                         child.num_inst += int(host[i, 2 * j + 1])
                     for j in ce_idx:
-                        _tele.health.note_loss(
-                            host[i, 2 * j] / max(host[i, 2 * j + 1], 1.0))
+                        loss_i = host[i, 2 * j] / max(host[i, 2 * j + 1],
+                                                      1.0)
+                        _tele.health.note_loss(loss_i)
                 else:
                     preds = [host_nd(o[i]) for o in outs_host]
                     eval_metric.update(labels_w[i], preds)
+                if ledger_on:
+                    # run-ledger scalars (decimated inside): the step's
+                    # in-graph CE loss when the stats plan computes one,
+                    # the running metric otherwise. Steps spread evenly
+                    # across the inter-window wall; the first window has
+                    # no baseline so its due steps bunch at ITS fetch
+                    # stamp — the same timeline later windows
+                    # interpolate on (emission-time clocks would land
+                    # PAST the next window's anchor and break
+                    # monotonicity)
+                    _tele.ledger.note_train_step(
+                        loss=loss_i, lr=self._last_lr,
+                        metric=None if loss_i is not None
+                        else eval_metric,
+                        t=t_apply if t_prev is None else
+                        t_prev + (t_apply - t_prev) * (i + 1) / steps)
                 if batch_end_callback is not None:
                     p = BatchEndParam(epoch=epoch, nbatch=nbatch,
                                       eval_metric=eval_metric,
@@ -1393,9 +1463,10 @@ class FusedFitLoop:
                 data=[from_jax(d, self._exec._ctx) for d in ds],
                 label=[from_jax(l, self._exec._ctx) for l in ls],
                 pad=pad, index=idx)
-            if health_on:
-                # the tail runs the executor path: incidents carry the
-                # real batch index through the note_batch context
+            if health_on or self._dyn_fn is not None:
+                # the tail runs the executor path: incidents (health
+                # AND dynamics) carry the real batch index through the
+                # note_batch context
                 _tele.health.note_batch(nbatch)
             m.forward_backward(sb)
             m.update()
@@ -1407,6 +1478,8 @@ class FusedFitLoop:
                 _faults.note_steps(1)
             _profiler.note_step()
             m.update_metric(eval_metric, sb.label)
+            _tele.ledger.note_train_step(lr=self._last_lr,
+                                         metric=eval_metric)
             if ckpt is not None:
                 # after update_metric, so a save initiated on a tail
                 # step captures the metric including this batch; the

@@ -40,7 +40,8 @@ from .. import telemetry as _tele
 from ..ndarray.ndarray import from_jax
 
 __all__ = ['WindowPipeline', 'window_size', 'plan_metric', 'host_wrap',
-           'registered_jit', 'health_sentinel', 'window_bisect']
+           'registered_jit', 'health_sentinel', 'dynamics_sentinel',
+           'window_bisect']
 
 
 def window_size(flag='MXTPU_FIT_STEPS_PER_CALL'):
@@ -76,6 +77,18 @@ def health_sentinel():
     leaving the traced window byte-identical to today's program."""
     from ..telemetry import health as _health
     return _health.step_stats if _health.enabled() else None
+
+
+def dynamics_sentinel():
+    """The in-graph per-layer dynamics stats fn for a compiled window
+    body (telemetry/dynamics: per-layer grad/param norms + update
+    ratios and per-output activation zero-fractions packed into one
+    f32 vector per step, stacked by the scan so the (W, k) matrix
+    rides the window's single host fetch) — or None while
+    MXTPU_DYNAMICS is off, leaving the traced window byte-identical
+    to today's program."""
+    from ..telemetry import dynamics as _dynamics
+    return _dynamics.step_stats if _dynamics.enabled() else None
 
 
 def window_bisect(executor, data_names, label_names, snaps, is_train,

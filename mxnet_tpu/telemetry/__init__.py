@@ -59,6 +59,24 @@ instrumentation the hot paths report through:
   non-finite incident, OOM report, SLO burn, supervised restart —
   so a postmortem has the seconds BEFORE the incident
   (``tools/trace_report.py`` renders a dump);
+- per-layer training dynamics (:mod:`.dynamics`, ``MXTPU_DYNAMICS``):
+  the in-graph sentinel extended from one global vector to a
+  per-parameter matrix — per-layer grad-norm, param-norm, update
+  ratio ``||dw||/||w||`` and activation zero-fractions on named
+  outputs — computed inside the compiled fused window / executor
+  programs and shipped home in the window's existing single fetch;
+  per-layer spike detectors raise NAMED anomalies, non-finite layer
+  statistics raise named-layer ``dynamics`` incidents, and
+  ``dynamics.<layer>.*`` gauges publish at the decimated
+  ``MXTPU_SCALARS_EVERY`` cadence;
+- the run ledger (:mod:`.ledger`, ``MXTPU_SCALARS_EVERY``): a
+  ``manifest`` JSONL record (resolved flags, jax version, device kind,
+  mesh, git sha) plus a bounded per-step ``scalars`` timeseries (loss,
+  lr, throughput, grad stats, eval metrics, MFU), mirrored as native
+  TensorBoard event files through a dependency-free TFRecord/Event
+  writer when ``MXTPU_TFEVENTS_DIR`` is set —
+  ``tools/run_compare.py`` diffs two runs' ledgers with
+  bench_diff-style verdicts;
 - the hang watchdog (:mod:`.watchdog`, ``MXTPU_WATCHDOG_SECS``):
   a daemon-thread progress monitor fed by the hot loops' dispatch /
   sync / kvstore / checkpoint sites; a stall dumps all-thread stacks
@@ -111,11 +129,14 @@ from . import watchdog  # noqa: F401  (public submodule: telemetry.watchdog.*)
 from . import trace  # noqa: F401  (public submodule: telemetry.trace.*)
 from . import slo  # noqa: F401  (public submodule: telemetry.slo.*)
 from . import flight  # noqa: F401  (public submodule: telemetry.flight.*)
+from . import dynamics  # noqa: F401  (public submodule: telemetry.dynamics.*)
+from . import ledger  # noqa: F401  (public submodule: telemetry.ledger.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
            'programs', 'health', 'cluster', 'serve', 'roofline',
-           'watchdog', 'trace', 'slo', 'flight', 'get_registry']
+           'watchdog', 'trace', 'slo', 'flight', 'dynamics', 'ledger',
+           'get_registry']
 
 
 class _State:
@@ -326,7 +347,8 @@ def summary():
                                  health=health.snapshot_health(
                                      input_bound=health.input_bound_pct()),
                                  cluster=cluster.snapshot_cluster(),
-                                 roofline=roofline.snapshot_roofline())
+                                 roofline=roofline.snapshot_roofline(),
+                                 ledger=ledger.snapshot_ledger())
 
 
 def write_summary(log=True):
@@ -348,6 +370,7 @@ def write_summary(log=True):
     # below so the gauges land in the summary record too
     rsnap = roofline.summarize()
     csnap = cluster.snapshot_cluster()
+    lsnap = ledger.snapshot_ledger()
     snap = _state.registry.snapshot()
     progs = programs.snapshot_programs()
     elapsed = time.time() - _state.t_start
@@ -362,11 +385,13 @@ def write_summary(log=True):
             rec['cluster'] = csnap
         if rsnap:
             rec['roofline'] = rsnap
+        if lsnap:
+            rec['ledger'] = lsnap
         _state.sink.emit(rec)
         _state.sink.flush()
     table = _export.summary_table(snap, elapsed, programs=progs or None,
                                   health=hsnap, cluster=csnap,
-                                  roofline=rsnap)
+                                  roofline=rsnap, ledger=lsnap)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -414,3 +439,5 @@ def _reset_for_tests():
     watchdog._reset_for_tests()
     slo._reset_for_tests()
     flight._reset_for_tests()
+    dynamics._reset_for_tests()
+    ledger._reset_for_tests()

@@ -1,0 +1,378 @@
+"""Per-layer training dynamics: watch the MODEL, not just the system.
+
+The observability planes so far watch the run as a system — where time
+goes (spans, roofline), whether values are finite (health), how hosts
+compare (cluster). Nothing watches the model's layers: PR 4's sentinel
+packs ONE global grad-norm, so a head whose gradients vanish or a layer
+that silently dies is invisible until the final metric. XLA fuses
+layers away (the compiler-opacity problem behind the named-scope
+attribution; cf. arXiv:1810.09868), so per-layer statistics must be
+computed IN-GRAPH — inside the already-compiled programs — and ride
+the fused window's existing single fetch, exactly the machinery
+``window_pipeline.health_sentinel`` proves out.
+
+Per step, per parameter: gradient L2 norm, parameter L2 norm, update
+ratio ``||dw|| / ||w||`` (the in-window delta on the fused path, the
+``||g||/||w||`` pre-lr proxy on the per-batch executor path where the
+optimizer runs outside the program); per named graph output: the
+activation zero-fraction (a ReLU head whose output is mostly zeros is
+dying). All of it packs into one f32 vector per step —
+``N_STATS * n_layers + n_outputs`` floats — stacked by the fused scan
+into a (W, k) matrix that comes home in the window's EXISTING fetch:
+no new host<->device syncs (asserted via the registrar dispatch and
+``fused_fit.fetch`` counters in tests/unittest/test_dynamics.py).
+
+Host side, each row:
+
+- feeds every layer's grad-norm and update-ratio into PR 4's
+  :class:`~mxnet_tpu.telemetry.health.SpikeDetector` (detectors named
+  ``grad_norm.<layer>`` / ``update_ratio.<layer>``) — a vanishing or
+  exploding LAYER raises a named anomaly before the global norm moves;
+- raises a named-layer ``dynamics`` incident on a non-finite per-layer
+  statistic (``event=layer_nonfinite``, the first bad layer named) —
+  complementary to health's global flag + bisect;
+- publishes ``dynamics.<layer>.*`` gauges, the worst-layer roll-up
+  (``dynamics.worst_layer`` / ``worst_update_ratio`` /
+  ``dead_frac_max``) and a ``dynamics`` JSONL record at the decimated
+  ``MXTPU_SCALARS_EVERY`` cadence (per-step publication of n_layers
+  gauges would dwarf the training loop's own host work).
+
+Gating: ``MXTPU_DYNAMICS=1`` *and* ``MXTPU_TELEMETRY=1``. Off, the
+compile sites trace byte-identical programs (the PR 4/7 contract,
+asserted by tests) and every entry point is one cached-bool check.
+"""
+import logging
+import threading
+
+import numpy as np
+
+__all__ = ['enabled', 'every', 'step_stats', 'decode', 'note_step',
+           'note_window', 'snapshot_dynamics', 'N_STATS']
+
+N_STATS = 3
+_IDX_GRAD, _IDX_PARAM, _IDX_RATIO = range(N_STATS)
+
+_MAX_INCIDENT_WARNINGS = 3
+_MAX_INCIDENTS_KEPT = 16    # dicts retained; the counter keeps the total
+_DEAD_DEFAULT_EVERY = 25    # decimation fallback when MXTPU_SCALARS_EVERY=0
+
+
+class _DState:
+    __slots__ = ('decided', 'active', 'every', 'seen', 'incidents',
+                 'incident_warnings', 'last', 'lock')
+
+    def __init__(self):
+        self.decided = False
+        self.active = False
+        self.every = _DEAD_DEFAULT_EVERY
+        self.seen = 0           # rows observed (== trained steps)
+        self.incidents = []
+        self.incident_warnings = 0
+        self.last = None        # last decoded {'layers':…, 'outputs':…}
+        self.lock = threading.Lock()
+
+
+_state = _DState()
+_decide_lock = threading.Lock()
+
+
+def _tele():
+    from . import enabled as _tele_enabled, _state as st
+    _tele_enabled()
+    return st
+
+
+def _decide():
+    with _decide_lock:
+        if _state.decided:
+            return _state.active
+        tele_on = _tele().active
+        on = False
+        ev = _DEAD_DEFAULT_EVERY
+        if tele_on:
+            from ..config import flags
+            try:
+                flags.reload('MXTPU_DYNAMICS')
+                flags.reload('MXTPU_SCALARS_EVERY')
+                on = bool(flags.get('MXTPU_DYNAMICS'))
+                ev = int(flags.get('MXTPU_SCALARS_EVERY')) \
+                    or _DEAD_DEFAULT_EVERY
+            except Exception:  # noqa: BLE001 — stripped builds w/o the flag
+                on, ev = False, _DEAD_DEFAULT_EVERY
+        _state.active = on
+        _state.every = ev
+        _state.decided = True
+    return _state.active
+
+
+def enabled():
+    """Whether the per-layer dynamics plane is on: MXTPU_TELEMETRY=1
+    *and* MXTPU_DYNAMICS=1, decided once. Compile sites read this at
+    program-build time; after the first call it is one attribute
+    check."""
+    if _state.decided:
+        return _state.active
+    return _decide()
+
+
+def every():
+    """Decimation cadence (steps) for gauge/JSONL publication — the
+    ledger's MXTPU_SCALARS_EVERY (its default when that is 0)."""
+    enabled()
+    return _state.every
+
+
+# ---------------------------------------------------------------------------
+# in-graph statistics
+# ---------------------------------------------------------------------------
+
+def step_stats(outs, grads, params, new_params=None):
+    """The per-step per-layer dynamics vector, traced INTO a compiled
+    program. Layout (f32, length ``N_STATS * len(params) + len(outs)``):
+
+    - ``[3*i + 0]`` layer i gradient L2 norm;
+    - ``[3*i + 1]`` layer i parameter L2 norm;
+    - ``[3*i + 2]`` layer i update ratio ``||new - old|| / ||old||``
+      when the update ran in-graph (fused window), else the pre-lr
+      proxy ``||g|| / ||w||`` (per-batch executor path);
+    - ``[3*n:]`` one activation zero-fraction per graph output.
+
+    Per-layer reductions — XLA fuses them into the surrounding step the
+    same way the global health sentinel fuses; the fused window ships
+    the stacked (W, k) matrix home in its existing single fetch.
+    """
+    import jax.numpy as jnp
+
+    eps = jnp.float32(1e-12)
+
+    def _norm(a):
+        return jnp.sqrt(jnp.sum(jnp.square(a.astype(jnp.float32))))
+
+    rows = []
+    for i, p in enumerate(params):
+        gn = _norm(grads[i])
+        pn = _norm(p)
+        if new_params is not None:
+            delta = new_params[i].astype(jnp.float32) \
+                - p.astype(jnp.float32)
+            ratio = _norm(delta) / (pn + eps)
+        else:
+            ratio = gn / (pn + eps)
+        rows.extend([gn, pn, ratio])
+    for o in outs:
+        of = o.astype(jnp.float32)
+        rows.append(jnp.mean((of == 0).astype(jnp.float32)))
+    return jnp.stack(rows)
+
+
+def decode(row, layer_names, out_names):
+    """Host-side decode of one dynamics row -> plain dict. Non-finite
+    statistics decode to None (strict-JSON safe; their non-finiteness
+    is what the incident path reports)."""
+    row = np.asarray(row, np.float64)
+
+    def _f(v):
+        v = float(v)
+        return round(v, 8) if np.isfinite(v) else None
+
+    layers = {}
+    for i, n in enumerate(layer_names):
+        base = N_STATS * i
+        layers[n] = {'grad_norm': _f(row[base + _IDX_GRAD]),
+                     'param_norm': _f(row[base + _IDX_PARAM]),
+                     'update_ratio': _f(row[base + _IDX_RATIO])}
+    tail = row[N_STATS * len(layer_names):]
+    outputs = {n: _f(tail[i]) for i, n in enumerate(out_names)}
+    return {'layers': layers, 'outputs': outputs}
+
+
+# ---------------------------------------------------------------------------
+# host-side pipeline
+# ---------------------------------------------------------------------------
+
+def _emit(rec):
+    st = _tele()
+    if st.active and st.sink is not None:
+        st.sink.emit(rec)
+
+
+def _first_bad_layer(info):
+    """(layer, stat) of the first non-finite per-layer statistic, or
+    None (decode turned non-finite values into None)."""
+    for n, stats in info['layers'].items():
+        for stat in ('grad_norm', 'param_norm', 'update_ratio'):
+            if stats[stat] is None:
+                return n, stat
+    return None
+
+
+def _incident(layer, stat, step):
+    """A named-layer non-finite statistic: a `dynamics` JSONL record +
+    counter + rate-limited warning. The global health sentinel fires
+    for the same step when MXTPU_HEALTH is on; this record adds the
+    LAYER name without waiting for the once-per-process bisect."""
+    reg = _tele().registry
+    reg.counter('dynamics.layer_incidents').inc()
+    info = {'type': 'dynamics', 'event': 'layer_nonfinite',
+            'layer': layer, 'stat': stat}
+    if step is not None:
+        info['step'] = int(step)
+    _emit(info)
+    with _state.lock:
+        if len(_state.incidents) < _MAX_INCIDENTS_KEPT:
+            _state.incidents.append({k: v for k, v in info.items()
+                                     if k != 'type'})
+        warn_ok = _state.incident_warnings < _MAX_INCIDENT_WARNINGS
+        if warn_ok:
+            _state.incident_warnings += 1
+    msg = ('training dynamics: non-finite %s in layer %s%s'
+           % (stat, layer, '' if step is None else ' at step %s' % step))
+    if warn_ok:
+        logging.warning('%s', msg)
+    else:
+        logging.debug('%s', msg)
+
+
+def _feed_detectors(info):
+    """Per-layer spike detection through PR 4's SpikeDetector registry
+    (named ``grad_norm.<layer>`` / ``update_ratio.<layer>``) — only
+    while the health plane is on; the detectors, counters and anomaly
+    records belong to it."""
+    from . import health as _health
+    if not _health.enabled():
+        return
+    for n, stats in info['layers'].items():
+        g = stats['grad_norm']
+        if g is not None:
+            _health._observe('grad_norm.%s' % n, g)
+        r = stats['update_ratio']
+        if r is not None:
+            _health._observe('update_ratio.%s' % n, r)
+
+
+def _worst(info):
+    """(worst_layer, worst_update_ratio, dead_frac_max) roll-up of one
+    decoded row — the layer changing fastest relative to its size, and
+    the deadest output."""
+    worst_layer, worst_ratio = None, None
+    for n, stats in info['layers'].items():
+        r = stats['update_ratio']
+        if r is not None and (worst_ratio is None or r > worst_ratio):
+            worst_layer, worst_ratio = n, r
+    dead = [v for v in info['outputs'].values() if v is not None]
+    return worst_layer, worst_ratio, (max(dead) if dead else None)
+
+
+def _publish(info, step):
+    """Decimated publication: per-layer gauges + the `dynamics` JSONL
+    record + the worst-layer roll-up."""
+    reg = _tele().registry
+    for n, stats in info['layers'].items():
+        for stat, v in stats.items():
+            if v is not None:
+                reg.gauge('dynamics.%s.%s' % (n, stat)).set(round(v, 6))
+    for n, v in info['outputs'].items():
+        if v is not None:
+            reg.gauge('dynamics.out.%s.zero_frac' % n).set(round(v, 4))
+    worst_layer, worst_ratio, dead_max = _worst(info)
+    if worst_layer is not None:
+        reg.gauge('dynamics.worst_layer').set(worst_layer)
+        reg.gauge('dynamics.worst_update_ratio').set(round(worst_ratio, 8))
+    if dead_max is not None:
+        reg.gauge('dynamics.dead_frac_max').set(round(dead_max, 4))
+    rec = {'type': 'dynamics', 'layers': info['layers'],
+           'outputs': info['outputs']}
+    if step is not None:
+        rec['step'] = int(step)
+    if worst_layer is not None:
+        rec['worst_layer'] = worst_layer
+        rec['worst_update_ratio'] = round(worst_ratio, 8)
+    if dead_max is not None:
+        rec['dead_frac_max'] = round(dead_max, 4)
+    _emit(rec)
+
+
+def _note_row(row, layer_names, out_names, step):
+    """Decode + detector-feed one row; returns (info, first_bad) —
+    incident emission is the caller's (so a fully-NaN window raises
+    ONE incident, like the health plane, not W)."""
+    info = decode(row, layer_names, out_names)
+    _feed_detectors(info)
+    with _state.lock:
+        _state.seen += 1
+        _state.last = info
+        due = (_state.seen % _state.every) == 0 or _state.seen == 1
+    if due:
+        _publish(info, step)
+    return info, _first_bad_layer(info)
+
+
+def note_step(dv, layer_names, out_names, step=None):
+    """Check one step's dynamics vector (per-batch executor path —
+    ``dv`` rides the same host sync the health sentinel already pays).
+    ``step=None`` falls back to the fit loop's health.note_batch
+    context."""
+    if not enabled():
+        return None
+    if step is None:
+        from . import health as _health
+        step = _health._state.cur_step
+    info, bad = _note_row(np.asarray(dv), layer_names, out_names, step)
+    if bad is not None:
+        _incident(bad[0], bad[1], step)
+    return info
+
+
+def note_window(dmat, layer_names, out_names, nbatch_base=0):
+    """Check a fused window's (W, k) dynamics matrix — fetched together
+    with the window's one host fetch; each row keeps its exact step
+    index. A window with many bad steps raises ONE incident (the
+    first bad row, exact step attribution) — the health plane's
+    one-incident-per-window convention."""
+    if not enabled():
+        return None
+    mat = np.asarray(dmat)
+    if mat.ndim == 1:
+        mat = mat[None, :]
+    last = None
+    first_bad = None
+    for i, row in enumerate(mat):
+        last, bad = _note_row(row, layer_names, out_names,
+                              nbatch_base + i)
+        if bad is not None and first_bad is None:
+            first_bad = (bad[0], bad[1], nbatch_base + i)
+    if first_bad is not None:
+        _incident(*first_bad)
+    return last
+
+
+def snapshot_dynamics():
+    """Point-in-time per-layer dynamics dict (JSON-serializable) — the
+    watch line's and the ledger's input. None while the plane is off
+    or before the first observed step."""
+    if not enabled():
+        return None
+    with _state.lock:
+        if _state.last is None:
+            return None
+        info = _state.last
+        out = {'steps': _state.seen,
+               'layers': {n: dict(s) for n, s in info['layers'].items()},
+               'outputs': dict(info['outputs']),
+               'incidents': [dict(i) for i in _state.incidents[:8]]}
+    reg = _tele().registry
+    n_inc = int(reg.counter('dynamics.layer_incidents').value)
+    if n_inc:
+        out['layer_incidents'] = n_inc
+    worst_layer, worst_ratio, dead_max = _worst(info)
+    if worst_layer is not None:
+        out['worst_layer'] = worst_layer
+        out['worst_update_ratio'] = round(worst_ratio, 8)
+    if dead_max is not None:
+        out['dead_frac_max'] = round(dead_max, 4)
+    return out
+
+
+def _reset_for_tests():
+    global _state
+    _state = _DState()

@@ -4,8 +4,10 @@ The JSONL sink is the machine-readable record a perf investigation
 greps after the fact: one JSON object per line, each with a ``type``
 ('start', 'span', 'compile', 'cache_hit', 'retrace_storm', 'event',
 'program', 'oom', 'health', 'anomaly', 'cluster', 'restart', 'hang',
-'elastic', 'roofline', 'trace', 'slo', 'summary') and a ``t``
-epoch-seconds stamp. Records buffer in memory and flush every
+'elastic', 'roofline', 'trace', 'slo', 'flight', 'manifest',
+'scalars', 'dynamics', 'summary') and a ``t`` epoch-seconds stamp —
+the full list is documented (and lint-gated) under
+MXTPU_TELEMETRY_PATH in docs/env_vars.md. Records buffer in memory and flush every
 ``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
 on a per-batch fsync.
 
@@ -260,6 +262,49 @@ def _roofline_lines(roof):
     return lines
 
 
+def _ledger_lines(led):
+    """The "run ledger" block (telemetry.ledger.snapshot_ledger's
+    dict): the manifest roll-up, the scalar cadence and the last
+    banked point — rendered deterministically so the offline CLI
+    reproduces the live table byte-for-byte."""
+    lines = ['-- run ledger --']
+    man = led.get('manifest') or {}
+    if man:
+        bits = []
+        if man.get('device_kind') or man.get('platform'):
+            dev = man.get('device_kind') or man.get('platform')
+            if man.get('device_count'):
+                dev += ' x%d' % int(man['device_count'])
+            bits.append('device=%s' % dev)
+        if man.get('jax_version'):
+            bits.append('jax=%s' % man['jax_version'])
+        if man.get('git_sha'):
+            bits.append('git=%s' % man['git_sha'])
+        if man.get('mesh'):
+            bits.append('mesh=%s' % json.dumps(man['mesh'],
+                                               sort_keys=True))
+        if bits:
+            lines.append('  manifest          %s' % ', '.join(bits))
+        if man.get('env_set'):
+            lines.append('  flags_set         %s'
+                         % ', '.join(man['env_set']))
+    if led.get('steps'):
+        lines.append('  scalars           %d steps, every %d'
+                     % (int(led['steps']), int(led.get('every') or 0)))
+    last = led.get('last')
+    if last:
+        line = '  last              step %s' % last.get('step')
+        if last.get('loss') is not None:
+            line += ', loss %s' % _fmt(float(last['loss']))
+        if led.get('final_loss') is not None \
+                and led['final_loss'] != last.get('loss'):
+            line += ' (final_loss %s)' % _fmt(float(led['final_loss']))
+        lines.append(line)
+    if led.get('tfevents'):
+        lines.append('  tfevents          %s' % led['tfevents'])
+    return lines
+
+
 def _cluster_lines(cluster):
     """The "Cluster" block (telemetry.cluster.snapshot_cluster's dict):
     one row per host from the last aggregation round, the spread, and
@@ -292,7 +337,7 @@ def _cluster_lines(cluster):
 
 
 def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
-                  cluster=None, roofline=None):
+                  cluster=None, roofline=None, ledger=None):
     """Registry snapshot -> aligned text table (one block per kind).
     ``programs`` is telemetry.programs.snapshot_programs()'s {name:
     record} — rendered as a per-program cost table (and the redundant
@@ -303,7 +348,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
     "Cluster" block (its per-host ``cluster.*`` gauges are elided the
     same way); ``roofline`` is telemetry.roofline.analyze()'s dict —
     rendered as the ranked-bottleneck "roofline" block (the
-    ``roofline.*`` gauges are elided the same way)."""
+    ``roofline.*`` gauges are elided the same way); ``ledger`` is
+    telemetry.ledger.snapshot_ledger()'s dict — rendered as the
+    "run ledger" block (manifest roll-up + last scalars; its
+    ``dynamics.*`` per-layer gauges stay in the gauges block)."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
@@ -351,6 +399,8 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
         lines.extend(_roofline_lines(roofline))
     if cluster:
         lines.extend(_cluster_lines(cluster))
+    if ledger:
+        lines.extend(_ledger_lines(ledger))
     if health:
         lines.extend(_health_lines(health))
     if hists:

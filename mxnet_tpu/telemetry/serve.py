@@ -191,6 +191,7 @@ def summary_payload():
     plus the rendered table itself."""
     import time
     from . import programs, health, cluster, roofline, slo
+    from . import dynamics, ledger
     from .export import summary_table
     st = _tele()
     snap = st.registry.snapshot()
@@ -198,6 +199,7 @@ def summary_payload():
     progs = programs.snapshot_programs() or None
     hs = health.snapshot_health(input_bound=health.input_bound_pct())
     clus = cluster.snapshot_cluster()
+    led = ledger.snapshot_ledger()
     # roofline (MXTPU_ROOFLINE): the last published analysis, else a
     # fresh read-only one (warn_unknown=False: analyze writes no
     # gauges — not even peaks_unknown — and emits no records; the
@@ -215,8 +217,10 @@ def summary_payload():
         'cluster': clus,
         'roofline': roof,
         'slo': slo.snapshot_slo(),
+        'ledger': led,
+        'dynamics': dynamics.snapshot_dynamics(),
         'table': summary_table(snap, elapsed, programs=progs, health=hs,
-                               cluster=clus, roofline=roof),
+                               cluster=clus, roofline=roof, ledger=led),
     }
 
 

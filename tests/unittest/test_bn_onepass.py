@@ -37,8 +37,14 @@ def clean_flags(monkeypatch):
     telemetry._reset_for_tests()
     yield monkeypatch
     telemetry._reset_for_tests()
+    # _train sets these via os.environ directly, so they must be
+    # cleared the same way: monkeypatch.delenv here would REGISTER the
+    # leaked value for restoration at monkeypatch teardown, leaking
+    # e.g. MXTPU_FUSED_FIT=0 into every later test of the process
+    # (caught by test_dynamics.py running after this file in tier-1)
+    import os
     for f in _FLAGS:
-        monkeypatch.delenv(f, raising=False)
+        os.environ.pop(f, None)
     _reload()
 
 

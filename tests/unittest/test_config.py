@@ -196,3 +196,41 @@ def test_env_vars_doc_in_sync_with_flag_catalog():
     # the catalog stays alphabetized (the doc's stated convention)
     entries = re.findall(r'^(MXTPU_[A-Z0-9_]+) \[', doc, re.M)
     assert entries == sorted(entries), 'env_vars.md entries not sorted'
+
+
+def test_jsonl_record_types_documented():
+    """CI gate: every JSONL record type the telemetry plane emits
+    (grep for the `{'type': '<name>'` literal at the emit sites —
+    mxnet_tpu plus the framework-free supervisors in tools/) appears
+    in docs/env_vars.md's MXTPU_TELEMETRY_PATH type list, and the
+    documented list names no type nothing emits — the drift that
+    required PR 5's nine-flag backfill (and this PR's trace/slo/flight
+    backfill) cannot recur."""
+    import glob
+    import os
+    import re
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    sources = glob.glob(os.path.join(repo, 'mxnet_tpu', '**', '*.py'),
+                        recursive=True)
+    sources += glob.glob(os.path.join(repo, 'tools', '*.py'))
+    sources.append(os.path.join(repo, 'bench.py'))
+    emitted = set()
+    for src in sources:
+        with open(src) as f:
+            emitted.update(re.findall(r"\{'type': '([a-z_]+)'", f.read()))
+    assert emitted, 'no emit sites found — the grep pattern broke'
+    with open(os.path.join(repo, 'docs', 'env_vars.md')) as f:
+        doc = f.read()
+    m = re.search(r"a 'type' \(([^)]*)\)", doc)
+    assert m, 'MXTPU_TELEMETRY_PATH no longer documents the type list'
+    documented = set(re.findall(r"'([a-z_]+)'", m.group(1)))
+    undocumented = sorted(emitted - documented)
+    assert not undocumented, (
+        'JSONL record types emitted but missing from the '
+        'MXTPU_TELEMETRY_PATH list in docs/env_vars.md: %s'
+        % undocumented)
+    stale = sorted(documented - emitted)
+    assert not stale, (
+        'docs/env_vars.md documents JSONL record types nothing '
+        'emits: %s' % stale)

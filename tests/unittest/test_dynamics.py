@@ -1,0 +1,686 @@
+"""Per-layer training dynamics + run ledger (ISSUE 15).
+
+Contracts under test:
+- gating: MXTPU_DYNAMICS needs MXTPU_TELEMETRY; either off = true
+  no-op (no I/O, empty registry, byte-identical compiled programs);
+- zero-overhead ON-contract: the per-layer matrix rides the fused
+  window's EXISTING single fetch — window program dispatches and
+  fused_fit.fetch observations are identical with the flag on or off;
+- per-layer attribution: fused + per-batch fits publish
+  dynamics.<layer>.* gauges under the real parameter names, `dynamics`
+  JSONL records at the MXTPU_SCALARS_EVERY cadence, and per-layer
+  spike detectors raise NAMED anomalies;
+- named-layer incidents: an injected gradient fault (faults.py
+  nan-grad) produces a `dynamics` record naming the layer and step —
+  independent of MXTPU_HEALTH;
+- run ledger: one `manifest` record (resolved flags, jax version,
+  device), `scalars` records at the exact cadence, eval-event records;
+- tfevents: golden-bytes pin of the hand-rolled TFRecord/Event
+  encoding (CRC-32C standard vector included), write->read round
+  trip, CRC verification catches corruption;
+- tools/run_compare.py: ok / regression / diverged-with-layer-name /
+  no-scalars exit codes and layer-drift attribution.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.telemetry import dynamics
+from mxnet_tpu.telemetry import export as tele_export
+from mxnet_tpu.telemetry import ledger
+
+_FLAGS = ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_PATH', 'MXTPU_DYNAMICS',
+          'MXTPU_SCALARS_EVERY', 'MXTPU_TFEVENTS_DIR', 'MXTPU_HEALTH',
+          'MXTPU_HEALTH_ACTION', 'MXTPU_FAULT_INJECT', 'MXTPU_FUSED_FIT')
+
+_TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), 'tools')
+if _TOOLS not in sys.path:
+    sys.path.insert(0, _TOOLS)
+
+
+def _reload_flags():
+    for f in _FLAGS:
+        flags.reload(f)
+
+
+def _reset_faults():
+    from mxnet_tpu import faults
+    faults._reset_for_tests()
+
+
+@pytest.fixture
+def dyn_path(tmp_path, monkeypatch):
+    """Telemetry + dynamics ON (health off — the plane must stand
+    alone), scalars every 2 steps, logging to a tmp JSONL."""
+    path = tmp_path / 'telemetry.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_DYNAMICS', '1')
+    monkeypatch.setenv('MXTPU_SCALARS_EVERY', '2')
+    # explicit: several assertions depend on the fused window running
+    monkeypatch.setenv('MXTPU_FUSED_FIT', '1')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _reset_faults()
+    yield path
+    telemetry._reset_for_tests()
+    _reset_faults()
+    for f in _FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload_flags()
+
+
+@pytest.fixture
+def all_off(monkeypatch):
+    for f in _FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _reset_faults()
+    yield
+    telemetry._reset_for_tests()
+    _reset_faults()
+    _reload_flags()
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _mlp_sym():
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    return mx.sym.SoftmaxOutput(fc2, name='softmax')
+
+
+_LAYERS = ('fc1_weight', 'fc1_bias', 'fc2_weight', 'fc2_bias')
+
+
+def _fit(X=None, y=None, num_epoch=1, batch=8, n=32, metric='acc'):
+    np.random.seed(0)
+    mx.random.seed(0)
+    if X is None:
+        X = np.random.randn(n, 10).astype(np.float32)
+    if y is None:
+        y = (np.random.rand(n) * 4).astype(int).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=batch,
+                           label_name='softmax_label')
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, num_epoch=num_epoch, optimizer='sgd', eval_metric=metric,
+            optimizer_params=(('learning_rate', 0.1),))
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# gating / zero-overhead contracts
+# ---------------------------------------------------------------------------
+
+def test_true_noop_without_telemetry(all_off, monkeypatch):
+    """MXTPU_DYNAMICS=1 with telemetry OFF is a true no-op: no I/O, no
+    registry writes, the executor never arms."""
+    monkeypatch.setenv('MXTPU_DYNAMICS', '1')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    io_before = tele_export._io_calls
+    mod = _fit()
+    assert not dynamics.enabled()
+    assert not ledger.enabled()
+    assert tele_export._io_calls == io_before
+    assert telemetry.get_registry().names() == []
+    assert mod._exec_group.execs[0]._dyn_on is False
+
+
+def test_dynamics_off_leaves_programs_byte_identical(tmp_path,
+                                                     monkeypatch):
+    """With telemetry ON, MXTPU_DYNAMICS unset and =0 lower the SAME
+    executor fwd+bwd text (the off-contract is in the traced program);
+    =1 traces a different one."""
+    import jax.numpy as jnp
+    from mxnet_tpu import random as _random
+
+    def _lowered_text(dyn):
+        telemetry._reset_for_tests()
+        monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+        monkeypatch.setenv('MXTPU_TELEMETRY_PATH',
+                           str(tmp_path / ('d_%s.jsonl' % (dyn or 'u'))))
+        if dyn is None:
+            monkeypatch.delenv('MXTPU_DYNAMICS', raising=False)
+        else:
+            monkeypatch.setenv('MXTPU_DYNAMICS', dyn)
+        _reload_flags()
+        telemetry._reset_for_tests()
+        mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+        mod.bind(data_shapes=[('data', (8, 10))],
+                 label_shapes=[('softmax_label', (8,))])
+        mod.init_params()
+        ex = mod._exec_group.execs[0]
+        assert ex._dyn_on is (dyn == '1')
+        arg_data = tuple(a._data for a in ex.arg_arrays)
+        aux_data = tuple(a._data for a in ex.aux_arrays)
+        heads = (jnp.ones((8, 4), jnp.float32),)
+        return ex._fwd_bwd.lower(arg_data, aux_data, _random.next_key(),
+                                 heads).as_text()
+
+    try:
+        unset = _lowered_text(None)
+        off = _lowered_text('0')
+        on = _lowered_text('1')
+        assert unset == off
+        assert on != off
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+def _window_counts():
+    """(window dispatches, fused_fit.fetch observations) from the live
+    registry — the no-new-fetch contract's two counters."""
+    progs = telemetry.programs.snapshot_programs() or {}
+    win = [r for n, r in progs.items()
+           if n.startswith('fused_fit.window')]
+    assert win, sorted(progs)
+    fetch = telemetry.get_registry().get('fused_fit.fetch')
+    return win[0]['dispatches'], int(fetch.count if fetch else 0)
+
+
+def test_dynamics_adds_no_fetch_per_window(tmp_path, monkeypatch):
+    """ON-contract: the (W, k) matrix rides the window's existing
+    single fetch — window dispatches and fetch observations are
+    IDENTICAL with the flag on or off."""
+    counts = {}
+    try:
+        for dyn in ('0', '1'):
+            telemetry._reset_for_tests()
+            monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+            monkeypatch.setenv('MXTPU_TELEMETRY_PATH',
+                               str(tmp_path / ('f%s.jsonl' % dyn)))
+            monkeypatch.setenv('MXTPU_DYNAMICS', dyn)
+            monkeypatch.setenv('MXTPU_FUSED_FIT', '1')
+            _reload_flags()
+            telemetry._reset_for_tests()
+            _fit()
+            counts[dyn] = _window_counts()
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+    assert counts['0'] == counts['1']
+    assert counts['1'][0] >= 1 and counts['1'][1] >= 1
+
+
+# ---------------------------------------------------------------------------
+# per-layer attribution (fused + per-batch)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize('fused', ['1', '0'])
+def test_fit_publishes_per_layer_dynamics(fused, dyn_path, monkeypatch):
+    monkeypatch.setenv('MXTPU_FUSED_FIT', fused)
+    _reload_flags()
+    _fit()
+    snap = telemetry.snapshot()
+    g = snap['gauges']
+    for layer in _LAYERS:
+        for stat in ('grad_norm', 'param_norm', 'update_ratio'):
+            assert g.get('dynamics.%s.%s' % (layer, stat)) is not None, \
+                (layer, stat, sorted(g))
+    assert g.get('dynamics.out.softmax_output.zero_frac') is not None
+    assert g.get('dynamics.worst_layer') in _LAYERS
+    assert g.get('dynamics.worst_update_ratio') > 0
+    telemetry.shutdown()
+    recs = _records(dyn_path)
+    dyn = [r for r in recs if r['type'] == 'dynamics'
+           and not r.get('event')]
+    assert dyn and sorted(dyn[-1]['layers']) == sorted(_LAYERS)
+    assert dyn[-1]['worst_layer'] in _LAYERS
+    # ...and nothing non-finite was flagged on a healthy run
+    assert not [r for r in recs if r.get('event') == 'layer_nonfinite']
+
+
+def test_dynamics_off_publishes_nothing(dyn_path, monkeypatch):
+    monkeypatch.setenv('MXTPU_DYNAMICS', '0')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _fit()
+    assert not [n for n in telemetry.get_registry().names()
+                if n.startswith('dynamics.')]
+    telemetry.shutdown()
+    assert not [r for r in _records(dyn_path) if r['type'] == 'dynamics']
+
+
+def test_update_ratio_is_in_window_delta_on_fused_path(dyn_path):
+    """Fused path: update_ratio is the REAL ||new-old||/||old|| —
+    bounded by lr * grad/param for SGD, far under the per-batch proxy
+    for a 0.1 lr. Sanity: ratio < proxy on every layer."""
+    _fit()
+    snap = telemetry.snapshot()['gauges']
+    for layer in _LAYERS:
+        ratio = snap['dynamics.%s.update_ratio' % layer]
+        proxy = (snap['dynamics.%s.grad_norm' % layer]
+                 / max(snap['dynamics.%s.param_norm' % layer], 1e-12))
+        assert ratio < proxy, (layer, ratio, proxy)
+
+
+def test_per_layer_spike_detector_names_layer(dyn_path, monkeypatch):
+    """A layer whose grad-norm explodes raises an anomaly NAMED for
+    the layer (grad_norm.<layer>) through PR 4's detector registry —
+    health plane on."""
+    monkeypatch.setenv('MXTPU_HEALTH', '1')
+    monkeypatch.setenv('MXTPU_HEALTH_ACTION', 'record')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    telemetry.enabled()
+    names = ['a', 'b']
+    outs = ['o']
+    base = np.array([1.0, 1.0, 0.1, 2.0, 1.0, 0.2, 0.0], np.float32)
+    for _ in range(12):
+        dynamics.note_step(base, names, outs)
+    spiked = base.copy()
+    spiked[3] = 500.0               # layer b's grad_norm
+    dynamics.note_step(spiked, names, outs)
+    reg = telemetry.get_registry()
+    assert reg.counter('health.anomalies.grad_norm.b').value == 1
+    assert reg.counter('health.anomalies.grad_norm.a').value == 0
+    telemetry.shutdown()
+
+
+def test_nan_grad_fault_raises_named_layer_incident(dyn_path,
+                                                    monkeypatch):
+    """Acceptance: an injected per-layer gradient fault (faults.py
+    nan-grad) produces a NAMED-layer dynamics incident — health plane
+    OFF, the dynamics plane stands alone."""
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'nan-grad:2')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _reset_faults()
+    _fit()
+    reg = telemetry.get_registry()
+    assert reg.counter('dynamics.layer_incidents').value >= 1
+    telemetry.shutdown()
+    recs = _records(dyn_path)
+    incs = [r for r in recs if r['type'] == 'dynamics'
+            and r.get('event') == 'layer_nonfinite']
+    assert incs
+    assert incs[0]['layer'] in _LAYERS
+    assert incs[0]['step'] == 2     # the armed draw, exact attribution
+    assert incs[0]['stat'] in ('grad_norm', 'param_norm', 'update_ratio')
+
+
+def test_nan_grad_fault_per_batch_path_carries_step(dyn_path,
+                                                    monkeypatch):
+    """Per-batch executor path: the named-layer incident carries the
+    real batch index through the note_batch context — fed for the
+    dynamics plane even with MXTPU_HEALTH off."""
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'nan-grad:2')
+    monkeypatch.setenv('MXTPU_FUSED_FIT', '0')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _reset_faults()
+    _fit()
+    telemetry.shutdown()
+    incs = [r for r in _records(dyn_path) if r['type'] == 'dynamics'
+            and r.get('event') == 'layer_nonfinite']
+    assert incs
+    assert incs[0]['layer'] in _LAYERS
+    assert incs[0]['step'] == 2
+
+
+# ---------------------------------------------------------------------------
+# run ledger: manifest + scalars cadence
+# ---------------------------------------------------------------------------
+
+def test_manifest_and_scalars_cadence(dyn_path):
+    _fit(num_epoch=2, metric=mx.metric.CrossEntropy())
+    telemetry.shutdown()
+    recs = _records(dyn_path)
+    mans = [r for r in recs if r['type'] == 'manifest']
+    assert len(mans) == 1           # once per process, even across epochs
+    man = mans[0]
+    assert man['flags']['MXTPU_TELEMETRY'] is True
+    assert man['flags']['MXTPU_SCALARS_EVERY'] == 2
+    assert man['jax_version'] and man['platform']
+    assert 'MXTPU_DYNAMICS' in man['env_set']
+    train = [r for r in recs if r['type'] == 'scalars'
+             and not r.get('event')]
+    # 8 steps at every-2 cadence = records exactly at steps 2,4,6,8
+    assert [r['step'] for r in train] == [2, 4, 6, 8]
+    assert all(r.get('loss') is not None for r in train)
+    assert all(r.get('lr') == 0.1 for r in train)
+    assert train[-1].get('worst_layer') in _LAYERS
+    evals = [r for r in recs if r.get('event') == 'eval']
+    assert len(evals) == 2          # one per epoch (train metric)
+    assert any(k.startswith('eval_train-') for k in evals[0])
+    # the summary record + table carry the ledger block
+    summ = [r for r in recs if r['type'] == 'summary'][-1]
+    assert summ['ledger']['steps'] == 8
+    assert summ['ledger']['last']['loss'] is not None
+    table = tele_export.summary_table(
+        summ['snapshot'], summ.get('elapsed_s'),
+        ledger=summ['ledger'])
+    assert '-- run ledger --' in table
+
+
+def test_note_train_step_lazy_lr_and_explicit_t(dyn_path):
+    """An lr callable is sampled only on due steps (the per-batch
+    loop's scheduler sample must not cost the non-due steps) and an
+    explicit ``t=`` stamp lands as the record's 't' (the fused window
+    amortizes burst-processed steps over the inter-window wall)."""
+    calls = []
+
+    def lr():
+        calls.append(1)
+        return 0.5
+
+    base = 1000.0
+    for i in range(6):
+        ledger.note_train_step(loss=1.0, lr=lr, t=base + i)
+    assert len(calls) == 3          # cadence 2: due at steps 2, 4, 6
+    telemetry._state.sink.flush()
+    recs = [r for r in _records(dyn_path) if r['type'] == 'scalars']
+    assert [r['t'] for r in recs] == [base + 1, base + 3, base + 5]
+    assert all(r['lr'] == 0.5 for r in recs)
+
+
+def test_run_compare_renders_eval_metrics(tmp_path, capsys):
+    """Eval-event records banked by note_eval surface as the
+    informational eval-metric block (common names, both sides)."""
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl', [1.0, 0.8, 0.6, 0.5])
+    b = _ledger_file(tmp_path, 'b.jsonl', [1.0, 0.8, 0.61, 0.5])
+    for path, acc in ((a, 0.9), (b, 0.8)):
+        with open(path, 'a') as f:
+            f.write(json.dumps({'type': 'scalars', 'step': 8,
+                                'event': 'eval',
+                                'eval_accuracy': acc}) + '\n')
+    assert run_compare.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert 'eval metrics (last banked):' in out
+    assert 'accuracy' in out and '-11.1%' in out
+
+
+def test_scalars_off_keeps_manifest(dyn_path, monkeypatch):
+    monkeypatch.setenv('MXTPU_SCALARS_EVERY', '0')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _fit()
+    assert not ledger.enabled()
+    telemetry.shutdown()
+    recs = _records(dyn_path)
+    assert [r for r in recs if r['type'] == 'manifest']
+    assert not [r for r in recs if r['type'] == 'scalars']
+
+
+# ---------------------------------------------------------------------------
+# tfevents: golden bytes + round trip
+# ---------------------------------------------------------------------------
+
+def test_crc32c_standard_vector():
+    # the canonical CRC-32C check value (RFC 3720 appendix B.4)
+    assert ledger.crc32c(b'123456789') == 0xE3069283
+    assert ledger.masked_crc(b'') == ((0 >> 15 | 0 << 17)
+                                      + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def test_tfevents_golden_bytes():
+    """The TFRecord/Event encoding is PINNED byte-for-byte: the
+    version-header event and a scalar event, framing included."""
+    ev = ledger.encode_event(1.5, file_version='brain.Event:2')
+    assert ev.hex() == ('09000000000000f83f'
+                        '1a0d627261696e2e4576656e743a32')
+    rec = ledger.encode_record(ev)
+    assert rec.hex() == ('1800000000000000a37f4b22'
+                         '09000000000000f83f'
+                         '1a0d627261696e2e4576656e743a32'
+                         '2a28646c')
+    sc = ledger.encode_event(2.0, step=7, scalars={'loss': 0.5})
+    assert sc.hex() == ('090000000000000040'
+                        '1007'
+                        '2a0d0a0b0a046c6f7373150000003f')
+
+
+def test_tfevents_round_trip_and_crc(tmp_path):
+    w = ledger.TfEventsWriter(str(tmp_path / 'tb'))
+    w.add_scalar('loss', 0.75, 10)
+    w.add_scalars({'loss': 0.5, 'lr': 0.1}, 20)
+    w.close()
+    events = ledger.read_tfevents(w.path)
+    assert events[0]['file_version'] == 'brain.Event:2'
+    assert events[1]['step'] == 10
+    assert events[1]['scalars'] == {'loss': 0.75}
+    assert events[2]['step'] == 20
+    assert events[2]['scalars']['loss'] == 0.5
+    assert abs(events[2]['scalars']['lr'] - 0.1) < 1e-7
+    # corrupt one payload byte: the CRC check raises
+    data = bytearray(open(w.path, 'rb').read())
+    data[14] ^= 0xFF
+    bad = tmp_path / 'bad.tfevents'
+    bad.write_bytes(bytes(data))
+    with pytest.raises(ValueError, match='CRC'):
+        ledger.read_tfevents(str(bad))
+
+
+def test_tfevents_writers_never_share_a_file(tmp_path):
+    """Two writers born in the same second on one host (the ledger's
+    and the contrib callback's, or two gang workers sharing a logdir)
+    get DISTINCT files — append-interleaved records would corrupt
+    both streams."""
+    d = str(tmp_path / 'tb')
+    a = ledger.TfEventsWriter(d)
+    b = ledger.TfEventsWriter(d)
+    assert a.path != b.path
+    a.add_scalar('loss', 1.0, 1)
+    b.add_scalar('loss', 2.0, 1)
+    a.close()
+    b.close()
+    assert len(os.listdir(d)) == 2
+    for w, v in ((a, 1.0), (b, 2.0)):
+        events = ledger.read_tfevents(w.path)
+        assert events[0]['file_version'] == 'brain.Event:2'
+        assert events[1]['scalars'] == {'loss': v}
+
+
+def test_fit_writes_tfevents(dyn_path, monkeypatch, tmp_path):
+    tb = tmp_path / 'tb'
+    monkeypatch.setenv('MXTPU_TFEVENTS_DIR', str(tb))
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _fit(metric=mx.metric.CrossEntropy())
+    telemetry._reset_for_tests()    # closes the writer
+    files = [f for f in os.listdir(tb) if 'tfevents' in f]
+    assert len(files) == 1
+    events = ledger.read_tfevents(str(tb / files[0]))
+    scalar_events = [e for e in events if e.get('scalars')]
+    assert scalar_events
+    assert any('loss' in e['scalars'] for e in scalar_events)
+    steps = [e['step'] for e in scalar_events if 'loss' in e['scalars']]
+    assert steps == sorted(steps) and steps[0] == 2
+
+
+# ---------------------------------------------------------------------------
+# run_compare
+# ---------------------------------------------------------------------------
+
+def _ledger_file(tmp_path, name, losses, layers=None, t0=100.0,
+                 dt=1.0, incidents=()):
+    """Craft a run ledger JSONL: scalars at steps 2,4,... plus an
+    optional final dynamics record and layer_nonfinite incidents."""
+    path = tmp_path / name
+    recs = [{'type': 'manifest', 'flags': {'MXTPU_FUSED_FIT': True},
+             'jax_version': 'x', 'platform': 'cpu'}]
+    for i, loss in enumerate(losses):
+        recs.append({'type': 'scalars', 'step': 2 * (i + 1),
+                     't': t0 + dt * (i + 1), 'loss': loss})
+    if layers:
+        recs.append({'type': 'dynamics', 'step': 2 * len(losses),
+                     'layers': layers})
+    for inc in incidents:
+        recs.append(dict({'type': 'dynamics',
+                          'event': 'layer_nonfinite'}, **inc))
+    with open(path, 'w') as f:
+        for r in recs:
+            f.write(json.dumps(r) + '\n')
+    return str(path)
+
+
+def _layers(ratio):
+    return {'fc1_weight': {'grad_norm': 1.0, 'param_norm': 2.0,
+                           'update_ratio': 0.004},
+            'fc2_weight': {'grad_norm': 1.0, 'param_norm': 2.0,
+                           'update_ratio': ratio}}
+
+
+def test_run_compare_ok(tmp_path, capsys):
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl', [1.0, 0.8, 0.6, 0.5],
+                     layers=_layers(0.004))
+    b = _ledger_file(tmp_path, 'b.jsonl', [1.0, 0.79, 0.61, 0.5],
+                     layers=_layers(0.004))
+    assert run_compare.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert 'REGRESSION' not in out and 'DIVERGED' not in out
+    assert 'last common step 8' in out
+
+
+def test_run_compare_regression_names_layer(tmp_path, capsys):
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl', [1.0, 0.8, 0.6, 0.5],
+                     layers=_layers(0.004))
+    b = _ledger_file(tmp_path, 'b.jsonl', [1.0, 0.9, 0.8, 0.75],
+                     layers=_layers(0.021))
+    assert run_compare.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert 'REGRESSION' in out
+    assert 'final_loss' in out
+    assert 'time_to_loss' in out    # never reached the baseline target
+    assert 'fc2_weight' in out      # layer drift attribution
+    assert 'worst layer: fc2_weight' in out
+
+
+def test_run_compare_diverged_nonzero_exit(tmp_path, capsys):
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl', [1.0, 0.8, 0.6, 0.5])
+    b = _ledger_file(tmp_path, 'b.jsonl', [1.0, 0.8, float('nan'),
+                                           float('nan')],
+                     incidents=[{'layer': 'fc2_weight',
+                                 'stat': 'grad_norm', 'step': 6}])
+    assert run_compare.main([a, b]) == 1
+    out = capsys.readouterr().out
+    assert 'DIVERGED' in out
+    assert 'fc2_weight' in out and 'step 6' in out
+
+
+def test_run_compare_nonfinite_baseline_skips(tmp_path, capsys):
+    """A diverged BASELINE can't certify anything: its loss gates
+    render a visible skip (never an 'ok' from a nan delta), a loud
+    warning names it, and a finite candidate passes; a candidate that
+    ALSO diverged still yields no verdict — two wrecked runs are not
+    comparative evidence (same rule as the DIVERGED gate)."""
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl',
+                     [1.0, 0.8, float('nan'), float('nan')])
+    b = _ledger_file(tmp_path, 'b.jsonl', [1.0, 0.8, 0.6, 0.5])
+    assert run_compare.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert 'skipped (baseline non-finite)' in out
+    assert 'warning: baseline' in out
+    assert 'DIVERGED' not in out
+    loss_rows = [l for l in out.splitlines() if 'loss_at_step' in l]
+    assert loss_rows and ' ok' not in loss_rows[0]
+    b2 = _ledger_file(tmp_path, 'b2.jsonl',
+                      [1.0, 0.9, float('nan'), float('nan')])
+    assert run_compare.main([a, b2]) == 0
+    out = capsys.readouterr().out
+    assert 'DIVERGED' not in out and 'REGRESSION' not in out
+
+
+def test_run_compare_improvement_never_fails(tmp_path, capsys):
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl', [1.0, 0.9, 0.8, 0.7])
+    b = _ledger_file(tmp_path, 'b.jsonl', [1.0, 0.7, 0.5, 0.3])
+    assert run_compare.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert 'note: per-layer dynamics not banked' in out
+
+
+def test_run_compare_missing_scalars(tmp_path, capsys):
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl', [1.0, 0.8])
+    empty = tmp_path / 'empty.jsonl'
+    empty.write_text(json.dumps({'type': 'start'}) + '\n')
+    assert run_compare.main([a, str(empty)]) == 2
+    assert 'no scalars records' in capsys.readouterr().out
+
+
+def test_run_compare_manifest_diff_printed(tmp_path, capsys):
+    import run_compare
+    a = _ledger_file(tmp_path, 'a.jsonl', [1.0, 0.8])
+    b = _ledger_file(tmp_path, 'b.jsonl', [1.0, 0.8])
+    recs = [json.loads(ln) for ln in open(b)]
+    # per-run output paths necessarily differ between any two runs —
+    # they must NOT read as a config diff (they'd bury the real one)
+    recs[0]['flags'] = {'MXTPU_FUSED_FIT': False,
+                        'MXTPU_TELEMETRY_PATH': 'b.jsonl'}
+    with open(b, 'w') as f:
+        for r in recs:
+            f.write(json.dumps(r) + '\n')
+    assert run_compare.main([a, b]) == 0
+    out = capsys.readouterr().out
+    assert 'config diff' in out
+    assert 'MXTPU_FUSED_FIT True -> False' in out
+    assert 'MXTPU_TELEMETRY_PATH' not in out
+
+
+def test_run_compare_fault_e2e(dyn_path, monkeypatch, tmp_path,
+                               capsys):
+    """The acceptance loop end to end: a clean fit vs a nan-grad-
+    injected fit of the SAME job — run_compare flags the divergent
+    run with a nonzero exit and names the layer."""
+    import run_compare
+    clean = str(tmp_path / 'clean.jsonl')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', clean)
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _fit(metric=mx.metric.CrossEntropy())
+    telemetry.shutdown()
+
+    bad = str(tmp_path / 'bad.jsonl')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', bad)
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'nan-grad:0')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    _reset_faults()
+    _fit(metric=mx.metric.CrossEntropy())
+    telemetry.shutdown()
+    telemetry._reset_for_tests()
+    _reset_faults()
+    monkeypatch.delenv('MXTPU_FAULT_INJECT')
+    _reload_flags()
+
+    assert run_compare.main([clean, bad]) == 1
+    out = capsys.readouterr().out
+    assert 'DIVERGED' in out
+    # the named-layer incident rode the candidate's ledger into the
+    # divergence line
+    assert any(layer in out for layer in _LAYERS)
+
+
+def test_snapshot_ledger_recent_series(dyn_path):
+    _fit(metric=mx.metric.CrossEntropy())
+    led = ledger.snapshot_ledger()
+    assert led['steps'] == 4
+    assert led['every'] == 2
+    assert [p['step'] for p in led['recent']] == [2, 4]
+    assert led['final_loss'] is not None
+    assert led['manifest']['platform']

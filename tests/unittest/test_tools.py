@@ -633,3 +633,138 @@ def test_executor_manager_trains():
         pred = ex.forward()[0].asnumpy().argmax(1)
         losses.append((pred == y).mean())
     assert losses[-1] > 0.8, losses
+
+
+# ---------------------------------------------------------------------------
+# run ledger satellites (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+def test_bench_diff_gates_final_loss(tmp_path, capsys):
+    """final_loss (the run ledger's last banked loss) is in the gated
+    set at 5%: a higher candidate loss fails, a lower one never does,
+    a NaN candidate — a diverged run — fails outright, and a missing
+    side is a visible skip."""
+    import json
+    import bench_diff
+    a = tmp_path / 'a.json'
+    b = tmp_path / 'b.json'
+    a.write_text(json.dumps(_bench_rec(final_loss=0.693)))
+    # +3%: inside tolerance
+    b.write_text(json.dumps(_bench_rec(final_loss=0.713)))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    capsys.readouterr()
+    # +12%: the run converged worse — exit 1
+    b.write_text(json.dumps(_bench_rec(final_loss=0.776)))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    assert 'REGRESSION: final_loss' in capsys.readouterr().out
+    # improvement never fails
+    b.write_text(json.dumps(_bench_rec(final_loss=0.3)))
+    assert bench_diff.main([str(a), str(b), '--tol-pct', '0.1']) == 0
+    capsys.readouterr()
+    # a nan candidate can never sneak through a tolerance comparison
+    b.write_text(json.dumps(_bench_rec(final_loss=float('nan'))))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    assert 'non-finite' in capsys.readouterr().out
+    # missing on the candidate side: skipped with the trailing note
+    b.write_text(json.dumps(_bench_rec()))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert 'skipped (missing in new run)' in out
+    # a nan BASELINE (a diverged run got banked) can't gate anything:
+    # a visible skip, never an 'ok' from a nan delta
+    a.write_text(json.dumps(_bench_rec(final_loss=float('nan'))))
+    b.write_text(json.dumps(_bench_rec(final_loss=0.5)))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert 'skipped (baseline non-finite)' in out
+    assert ' ok' not in [l for l in out.splitlines()
+                         if 'final_loss' in l][0]
+    # different trained step counts (bench scales steps to measured
+    # throughput): a loss delta would conflate convergence with speed
+    a.write_text(json.dumps(_bench_rec(final_loss=0.5,
+                                       final_loss_step=600)))
+    b.write_text(json.dumps(_bench_rec(final_loss=0.9,
+                                       final_loss_step=300)))
+    assert bench_diff.main([str(a), str(b)]) == 0
+    out = capsys.readouterr().out
+    assert 'skipped (trained 600 vs 300 steps)' in out
+    # equal step counts still gate
+    b.write_text(json.dumps(_bench_rec(final_loss=0.9,
+                                       final_loss_step=600)))
+    assert bench_diff.main([str(a), str(b)]) == 1
+    assert 'REGRESSION: final_loss' in capsys.readouterr().out
+
+
+def test_telemetry_watch_renders_dynamics_and_sparkline():
+    """The watch frame shows the per-layer dynamics roll-up (worst
+    layer, dead fraction, incident count) and a loss sparkline from
+    the ledger's recent scalars; neither line renders without its
+    data."""
+    import telemetry_watch
+    summary = {
+        'snapshot': {
+            'counters': {'fit.steps': 64,
+                         'dynamics.layer_incidents': 2},
+            'gauges': {'dynamics.worst_layer': 'fc2_weight',
+                       'dynamics.worst_update_ratio': 0.0042,
+                       'dynamics.dead_frac_max': 0.12},
+            'histograms': {}},
+        'ledger': {'recent': [{'step': 2, 'loss': 1.0},
+                              {'step': 4, 'loss': 0.8},
+                              {'step': 6, 'loss': 0.5}]},
+    }
+    lines = telemetry_watch.render(summary)
+    dyn = [ln for ln in lines if ln.strip().startswith('dynamics')]
+    assert dyn and 'fc2_weight' in dyn[0]
+    assert 'dead 12%' in dyn[0]
+    assert '2 layer incidents' in dyn[0]
+    loss = [ln for ln in lines if ln.strip().startswith('loss')]
+    assert loss
+    # the sparkline descends with the loss series
+    assert telemetry_watch._SPARK[0] in loss[0]
+    assert telemetry_watch._SPARK[-1] in loss[0]
+    # no dynamics gauges, no ledger: neither line
+    lines = telemetry_watch.render({'snapshot': {'counters': {},
+                                                 'gauges': {},
+                                                 'histograms': {}}})
+    assert not [ln for ln in lines
+                if ln.strip().startswith(('dynamics', 'loss'))]
+
+
+def test_telemetry_report_renders_ledger_block(tmp_path, capsys):
+    """A crashed run's log (manifest + scalars, no summary record)
+    reconstructs the run-ledger block offline; a summary-carrying log
+    renders it from the summary's ledger key."""
+    import json
+    import telemetry_report
+    recs = [
+        {'type': 'start', 'pid': 1, 't': 1.0},
+        {'type': 'manifest', 't': 1.0, 'jax_version': '0.4.37',
+         'platform': 'cpu', 'device_kind': 'cpu', 'device_count': 8,
+         'git_sha': 'abc1234', 'flags': {'MXTPU_TELEMETRY': True},
+         'env_set': ['MXTPU_TELEMETRY']},
+        {'type': 'scalars', 'step': 2, 't': 2.0, 'loss': 1.0},
+        {'type': 'scalars', 'step': 4, 't': 3.0, 'loss': 0.5},
+    ]
+    path = tmp_path / 'crashed.jsonl'
+    path.write_text(''.join(json.dumps(r) + '\n' for r in recs))
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert '-- run ledger --' in out
+    assert 'jax=0.4.37' in out and 'git=abc1234' in out
+    assert 'scalars           4 steps, every 2' in out
+    assert 'loss 0.500' in out
+    assert 'no summary record found' in out
+    # summary path: the ledger key renders directly
+    recs.append({'type': 'summary', 't': 4.0, 'elapsed_s': 3.0,
+                 'snapshot': {},
+                 'ledger': {'steps': 4, 'every': 2,
+                            'manifest': {'jax_version': '0.4.37'},
+                            'recent': [{'step': 4, 'loss': 0.5}],
+                            'last': {'step': 4, 'loss': 0.5},
+                            'final_loss': 0.5}})
+    path.write_text(''.join(json.dumps(r) + '\n' for r in recs))
+    assert telemetry_report.main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert '-- run ledger --' in out
+    assert 'no summary record found' not in out

@@ -30,6 +30,10 @@ Compared metrics, with direction and default tolerance:
   latency)                                 — higher is a regression (10%)
 - ``serving_queue_wait_p50_ms`` (median time a request sits in the
   batcher queue before its dispatch)       — higher is a regression (10%)
+- ``final_loss`` (the run ledger's last banked loss,
+  telemetry/ledger.py)                     — higher is a regression (5%;
+  a non-finite candidate loss is a regression outright — a diverged
+  run must not bank as a healthy throughput number)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -43,6 +47,7 @@ not evidence of a perf regression; ``--strict`` turns that into exit 3.
 """
 import argparse
 import json
+import math
 import sys
 
 # metric -> (extractor, bad_direction, default_tol_pct)
@@ -50,14 +55,16 @@ import sys
 _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 10.0,
             'xla_live_bytes': 10.0,
             'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0,
-            'serving_p99_ms': 10.0, 'serving_queue_wait_p50_ms': 10.0}
+            'serving_p99_ms': 10.0, 'serving_queue_wait_p50_ms': 10.0,
+            'final_loss': 5.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
               'xla_live_bytes': +1,
               'opt_state_bytes_per_device': +1, 'compile_s': +1,
-              'serving_p99_ms': +1, 'serving_queue_wait_p50_ms': +1}
+              'serving_p99_ms': +1, 'serving_queue_wait_p50_ms': +1,
+              'final_loss': +1}
 _ORDER = ('throughput', 'mfu', 'xla_temp_bytes', 'xla_live_bytes',
           'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms',
-          'serving_queue_wait_p50_ms')
+          'serving_queue_wait_p50_ms', 'final_loss')
 
 
 def load_bench(path):
@@ -140,6 +147,15 @@ def extract(rec):
     if rec.get('serving_queue_wait_p50_ms') is not None:
         out['serving_queue_wait_p50_ms'] = \
             float(rec['serving_queue_wait_p50_ms'])
+    # the run ledger's last banked loss (bench feeds telemetry/ledger):
+    # convergence gate next to the throughput gates — a faster step
+    # that stopped learning is a regression
+    if rec.get('final_loss') is not None:
+        out['final_loss'] = float(rec['final_loss'])
+        # not a gated metric — comparability context for final_loss
+        # (bench scales its step count to measured throughput)
+        if rec.get('final_loss_step') is not None:
+            out['final_loss_step'] = int(rec['final_loss_step'])
     return out
 
 
@@ -172,6 +188,28 @@ def diff(old, new, tols):
                 rows.append((metric, vo, vn, None, tols[metric],
                              'skipped (missing in new run)'))
             continue
+        if not math.isfinite(vn):
+            # a nan/inf candidate (a diverged run's final_loss) can
+            # never pass a tolerance comparison by accident
+            rows.append((metric, vo, vn, None, tols[metric],
+                         'REGRESSION (non-finite)'))
+            continue
+        if not math.isfinite(vo):
+            # a nan baseline (a diverged run got banked) can't gate
+            # anything: a visible skip, never an 'ok' from a nan delta
+            rows.append((metric, vo, vn, None, tols[metric],
+                         'skipped (baseline non-finite)'))
+            continue
+        if metric == 'final_loss':
+            so, sn = mo.get('final_loss_step'), mn.get('final_loss_step')
+            if so is not None and sn is not None and so != sn:
+                # the runs trained different step counts (bench scales
+                # steps to measured throughput): a loss delta here
+                # conflates convergence with speed — skip, visibly
+                rows.append((metric, vo, vn, None, tols[metric],
+                             'skipped (trained %d vs %d steps)'
+                             % (so, sn)))
+                continue
         if vo:
             delta = (vn - vo) / vo * 100.0
         else:
@@ -252,7 +290,7 @@ def main(argv=None):
         print('note: ungated this round — %s'
               % '; '.join('%s %s' % (r[0], r[5][len('skipped '):])
                           for r in skipped))
-    bad = [r for r in rows if r[5] == 'REGRESSION']
+    bad = [r for r in rows if r[5].startswith('REGRESSION')]
     if bad:
         print('REGRESSION: %s' % ', '.join(r[0] for r in bad))
         return 1

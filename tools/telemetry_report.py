@@ -192,8 +192,42 @@ def _reconstruct(records):
     return snapshot, elapsed, programs or None, _reconstruct_health(records)
 
 
+def _reconstruct_ledger(records):
+    """Run-ledger dict rebuilt from raw `manifest` / `scalars` records
+    — the crashed-run path (and the fallback for a summary record that
+    predates the ledger key). None when the run banked neither."""
+    man = next((r for r in records if r.get('type') == 'manifest'), None)
+    scalars = [r for r in records if r.get('type') == 'scalars'
+               and r.get('event') != 'eval' and r.get('step') is not None]
+    if man is None and not scalars:
+        return None
+    out = {}
+    if man is not None:
+        from mxnet_tpu.telemetry.ledger import MANIFEST_KEYS
+        out['manifest'] = {k: man.get(k) for k in MANIFEST_KEYS
+                           if man.get(k) is not None}
+        if man.get('env_set'):
+            out['manifest']['env_set'] = man['env_set']
+    if scalars:
+        scalars.sort(key=lambda r: r['step'])
+        out['steps'] = int(scalars[-1]['step'])
+        deltas = [b['step'] - a['step']
+                  for a, b in zip(scalars, scalars[1:])
+                  if b['step'] > a['step']]
+        out['every'] = min(deltas) if deltas else 0
+        recent = scalars[-32:]
+        out['recent'] = [{'step': int(r['step']), 'loss': r.get('loss')}
+                         for r in recent]
+        out['last'] = out['recent'][-1]
+        final = next((r.get('loss') for r in reversed(scalars)
+                      if r.get('loss') is not None), None)
+        if final is not None:
+            out['final_loss'] = final
+    return out
+
+
 def _summary_parts(records):
-    """(snapshot, elapsed, programs, health, cluster, roofline,
+    """(snapshot, elapsed, programs, health, cluster, roofline, ledger,
     reconstructed) for one host's record list — the last summary record
     when present, else the crashed-run reconstruction."""
     summaries = [r for r in records if r.get('type') == 'summary']
@@ -233,18 +267,20 @@ def _summary_parts(records):
         return (s.get('snapshot') or {}, s.get('elapsed_s'),
                 s.get('programs'), health,
                 s.get('cluster') or cluster,
-                s.get('roofline') or roofline, False)
+                s.get('roofline') or roofline,
+                s.get('ledger') or _reconstruct_ledger(records), False)
     snapshot, elapsed, programs, health = _reconstruct(records)
-    return snapshot, elapsed, programs, health, cluster, roofline, True
+    return (snapshot, elapsed, programs, health, cluster, roofline,
+            _reconstruct_ledger(records), True)
 
 
 def render(records):
     """The summary table for a parsed record list, as a string."""
-    snapshot, elapsed, programs, health, cluster, roofline, reco = \
+    snapshot, elapsed, programs, health, cluster, roofline, led, reco = \
         _summary_parts(records)
     table = summary_table(snapshot, elapsed, programs=programs,
                           health=health, cluster=cluster,
-                          roofline=roofline)
+                          roofline=roofline, ledger=led)
     if reco:
         table += ('\n(no summary record found — reconstructed from '
                   '%d individual records; registry-only counters and '
@@ -337,8 +373,8 @@ def render_hosts(by_host):
     from mxnet_tpu.telemetry.cluster import classify, _SPREAD_BALANCED_PCT
     rows = []
     for host in sorted(by_host):
-        snapshot, elapsed, programs, health, cluster, roof, reco = \
-            _summary_parts(by_host[host])
+        (snapshot, elapsed, programs, health, cluster, roof, _led,
+         reco) = _summary_parts(by_host[host])
         steps = snapshot.get('counters', {}).get('fit.steps')
         if steps is None:
             steps = (snapshot.get('histograms', {})

@@ -51,12 +51,15 @@ def fetch(source):
                 'programs': s.get('programs'),
                 'health': s.get('health'),
                 'cluster': s.get('cluster')
-                or (clus[-1] if clus else None)}
+                or (clus[-1] if clus else None),
+                'ledger': s.get('ledger')
+                or telemetry_report._reconstruct_ledger(records)}
     snapshot, elapsed, programs, health = telemetry_report._reconstruct(
         records)
     return {'elapsed_s': elapsed, 'host': None, 'snapshot': snapshot,
             'programs': programs, 'health': health,
-            'cluster': clus[-1] if clus else None}
+            'cluster': clus[-1] if clus else None,
+            'ledger': telemetry_report._reconstruct_ledger(records)}
 
 
 def _fmt(v, suffix=''):
@@ -65,6 +68,22 @@ def _fmt(v, suffix=''):
     if isinstance(v, float):
         return ('%.3g' % v) + suffix
     return str(v) + suffix
+
+
+_SPARK = '▁▂▃▄▅▆▇█'
+
+
+def _sparkline(values):
+    """Unicode block sparkline of a numeric series (min..max scaled;
+    a flat series renders flat-low)."""
+    vals = [float(v) for v in values]
+    lo, hi = min(vals), max(vals)
+    if hi <= lo:
+        return _SPARK[0] * len(vals)
+    return ''.join(_SPARK[min(len(_SPARK) - 1,
+                              int((v - lo) / (hi - lo)
+                                  * (len(_SPARK) - 1) + 0.5))]
+                   for v in vals)
 
 
 def render(summary, steps_per_s=None, reqs_per_s=None):
@@ -134,6 +153,35 @@ def render(summary, steps_per_s=None, reqs_per_s=None):
             bits.append('step collectives %s%%'
                         % _fmt(float(g['roofline.comm_pct_of_step'])))
         lines.append('  opt_state    %s' % ', '.join(bits))
+    # per-layer training dynamics (MXTPU_DYNAMICS): the layer changing
+    # fastest relative to its size + the deadest output, straight from
+    # the decimated dynamics.* gauges
+    if g.get('dynamics.worst_update_ratio') is not None \
+            or g.get('dynamics.dead_frac_max') is not None:
+        bits = []
+        if g.get('dynamics.worst_update_ratio') is not None:
+            bits.append('worst %s dw/w %s'
+                        % (g.get('dynamics.worst_layer') or '?',
+                           _fmt(float(g['dynamics.worst_update_ratio']))))
+        if g.get('dynamics.dead_frac_max') is not None:
+            bits.append('dead %.0f%%'
+                        % (100.0 * float(g['dynamics.dead_frac_max'])))
+        if c.get('dynamics.layer_incidents'):
+            n = int(c['dynamics.layer_incidents'])
+            bits.append('%d layer incident%s' % (n,
+                                                 's' if n != 1 else ''))
+        lines.append('  dynamics     %s' % ', '.join(bits))
+    # loss sparkline from the run ledger's recent scalars (non-finite
+    # points — a diverged run's NaNs — are dropped from the scale)
+    import math as _math
+    led = summary.get('ledger') or {}
+    recent = [p.get('loss') for p in (led.get('recent') or [])
+              if isinstance(p.get('loss'), (int, float))
+              and _math.isfinite(p['loss'])]
+    if recent:
+        lines.append('  loss         %s %s (last %d scalars)'
+                     % (_fmt(float(recent[-1])), _sparkline(recent),
+                        len(recent)))
     if c.get('serve.requests'):
         # serving plane (mxnet_tpu/serving): request rate + latency
         # percentiles + queue/batch state whenever serve.* metrics exist
