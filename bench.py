@@ -1059,6 +1059,12 @@ def _telemetry_breakdown(device, step_ms=None):
             top_n = _tele.roofline.TOP_N
             tel['roofline'] = dict(roof, layers=roof['layers'][:top_n],
                                    n_layers=len(roof['layers']))
+        # goodput attribution (ISSUE 16): where this process's wall-
+        # clock went, bucketed — AFTER roofline.summarize so the comm
+        # bucket reads the just-published provenance-labeled share
+        good = _tele.goodput.current()
+        if good:
+            tel['goodput'] = good
         return tel or None
     except Exception as e:  # noqa: BLE001 — the bench number must survive
         _log('telemetry fold-in failed: %s' % e)
@@ -1379,6 +1385,15 @@ def main():
         devices[0], step_ms=dt / (bench_steps * STEPS_PER_CALL) * 1e3)
     if tel:
         out['telemetry'] = tel
+        # top-level copy of the gated metric (tools/bench_diff.py gates
+        # goodput_pct: lower = regression) + the per-bucket breakdown
+        # the diff renders next to it
+        good = tel.get('goodput') or {}
+        if good.get('goodput_pct') is not None:
+            out['goodput_pct'] = good['goodput_pct']
+            out['goodput'] = {'buckets': good.get('buckets'),
+                              'badput_top': good.get('badput_top'),
+                              'wall_s': good.get('wall_s')}
     # sharded-vs-replicated weight-update A/B (MXTPU_SHARDED_UPDATE):
     # only runs at dp > 1, and AFTER the telemetry fold above so the
     # probe model's compiles/programs/roofline never contaminate the

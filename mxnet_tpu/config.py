@@ -174,6 +174,26 @@ flags.declare('MXTPU_TELEMETRY_MAX_MB', float, 0.0,
               'telemetry.dropped_records, warned once) instead of '
               'filling the disk on week-long runs. 0 = unlimited',
               min_value=0.0)
+flags.declare('MXTPU_GOODPUT', bool, True,
+              'Goodput accounting plane (telemetry/goodput.py, requires '
+              'MXTPU_TELEMETRY=1 — telemetry off means true no-op): '
+              'classify every second of measured wall-clock into named '
+              'buckets (productive step compute, XLA compile, input '
+              'wait, checkpoint, eval, collective comm, restart rework, '
+              'unattributed overhead) from the existing span/mark '
+              'sites; buckets + overhead sum to wall-clock exactly. '
+              'goodput.* gauges, a goodput JSONL record, the "Where the '
+              'time went" summary block, /metrics + /summary, fleet '
+              'aggregation through the cluster sync vector. 0 = off')
+flags.declare('MXTPU_GOODPUT_LOST_S', float, 0.0,
+              'Cumulative lost-work seconds of PRIOR supervised '
+              'attempts, stamped into a relaunched child\'s environment '
+              'by tools/train_supervisor.py / tools/gang_supervisor.py '
+              '(dead-attempt wall since the last_good checkpoint '
+              'pointer). The goodput record reports it as prior_lost_s '
+              'with the derived job_wall_s / job_goodput_pct; per-'
+              'process buckets still sum to per-process wall. Not for '
+              'humans to set', min_value=0.0)
 flags.declare('MXTPU_TELEMETRY_BIND', str, '127.0.0.1',
               'Bind address for the live telemetry endpoint '
               '(telemetry/serve.py). Default 127.0.0.1 = loopback only; '

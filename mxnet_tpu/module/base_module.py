@@ -301,11 +301,13 @@ class BaseModule:
         # plane is on
         dyn_on = _tele.dynamics.enabled()
         cluster_on = _tele.cluster.enabled()
-        # run ledger (telemetry/ledger): the manifest records this
-        # run's resolved configuration once; the per-step scalars
+        # run ledger (telemetry/ledger): every fit() emits a fresh
+        # run_seq-tagged manifest — a second in-process fit (or a
+        # resilient_fit retry) may run under different flags, and
+        # run_compare keys on the latest; the per-step scalars
         # (loss/lr/throughput/grad stats) bank at MXTPU_SCALARS_EVERY
         ledger_on = _tele.ledger.enabled()
-        _tele.ledger.ensure_manifest(module=self)
+        _tele.ledger.begin_run(module=self)
         # hang watchdog (telemetry/watchdog.py): per-step progress marks
         # feed the stall monitor; off = one cached-bool check here and
         # no call in the loop

@@ -91,6 +91,12 @@ def resilient_fit(module, train_data, restart_max=None,
                 except Exception:  # noqa: BLE001 — never mask the retry
                     pass
                 restore_from = ckpt.last_good
+                # restart rework: every step between the restore point
+                # and where the crashed attempt had reached will be
+                # re-trained — badput the goodput ledger must attribute
+                reached = int(getattr(ckpt, 'global_step', 0) or 0)
+                _tele.goodput.note_rework(
+                    reached - int(restore_from or 0))
             _tele.health.note_restart(
                 attempt=attempts, reason=type(e).__name__,
                 message=str(e)[:200], restore_step=restore_from,

@@ -131,12 +131,13 @@ from . import slo  # noqa: F401  (public submodule: telemetry.slo.*)
 from . import flight  # noqa: F401  (public submodule: telemetry.flight.*)
 from . import dynamics  # noqa: F401  (public submodule: telemetry.dynamics.*)
 from . import ledger  # noqa: F401  (public submodule: telemetry.ledger.*)
+from . import goodput  # noqa: F401  (public submodule: telemetry.goodput.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
            'programs', 'health', 'cluster', 'serve', 'roofline',
            'watchdog', 'trace', 'slo', 'flight', 'dynamics', 'ledger',
-           'get_registry']
+           'goodput', 'get_registry']
 
 
 class _State:
@@ -348,7 +349,8 @@ def summary():
                                      input_bound=health.input_bound_pct()),
                                  cluster=cluster.snapshot_cluster(),
                                  roofline=roofline.snapshot_roofline(),
-                                 ledger=ledger.snapshot_ledger())
+                                 ledger=ledger.snapshot_ledger(),
+                                 goodput=goodput.current())
 
 
 def write_summary(log=True):
@@ -371,9 +373,14 @@ def write_summary(log=True):
     rsnap = roofline.summarize()
     csnap = cluster.snapshot_cluster()
     lsnap = ledger.snapshot_ledger()
+    elapsed = time.time() - _state.t_start
+    # wall-clock attribution: publishes goodput.* gauges + the goodput
+    # JSONL record; after roofline (the comm bucket reads its published
+    # provenance-labeled share) and before the snapshot below so the
+    # gauges land in the summary record too
+    gsnap = goodput.summarize(elapsed)
     snap = _state.registry.snapshot()
     progs = programs.snapshot_programs()
-    elapsed = time.time() - _state.t_start
     if _state.sink is not None:
         rec = {'type': 'summary', 'elapsed_s': round(elapsed, 3),
                'snapshot': snap}
@@ -387,11 +394,14 @@ def write_summary(log=True):
             rec['roofline'] = rsnap
         if lsnap:
             rec['ledger'] = lsnap
+        if gsnap:
+            rec['goodput'] = gsnap
         _state.sink.emit(rec)
         _state.sink.flush()
     table = _export.summary_table(snap, elapsed, programs=progs or None,
                                   health=hsnap, cluster=csnap,
-                                  roofline=rsnap, ledger=lsnap)
+                                  roofline=rsnap, ledger=lsnap,
+                                  goodput=gsnap)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -441,3 +451,4 @@ def _reset_for_tests():
     flight._reset_for_tests()
     dynamics._reset_for_tests()
     ledger._reset_for_tests()
+    goodput._reset_for_tests()

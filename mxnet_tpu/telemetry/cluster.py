@@ -57,9 +57,17 @@ __all__ = ['enabled', 'host_index', 'set_host', 'note_step', 'sync_now',
 # (tests/dist/gang_fit.py): the per-host gauges and /metrics series on
 # process 0 are keyed off it instead of assuming the gathered row
 # order is process order; rows without the slot (older senders,
-# crafted test matrices) fall back to the positional index
+# crafted test matrices) fall back to the positional index. The three
+# trailing slots rode in with the goodput plane (appended AFTER
+# proc_index so every earlier position is stable): 'goodput_pct' is the
+# host's productive wall share, 'badput_top' its top badput bucket as a
+# telemetry.goodput.BUCKETS index, and 'comm_src' the comm_pct sample's
+# provenance (1.0 = measured from a joined trace, 0.0 = roofline
+# modeled, NaN = no sample) — so the communication_bound verdict can
+# never launder a model into a measurement
 SYNC_KEYS = ('step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes',
-             'comm_pct', 'proc_index')
+             'comm_pct', 'proc_index', 'goodput_pct', 'badput_top',
+             'comm_src')
 
 _SPREAD_BALANCED_PCT = 5.0   # step-time spread below this = no straggler
 _COMM_BOUND_PCT = 30.0       # collective share of the step above which a
@@ -223,14 +231,23 @@ def _local_stats():
     # a communication_bound straggler verdict in numbers instead of
     # inference. NaN = unavailable (flag off / nothing ingested yet)
     from . import roofline
-    comm = roofline.comm_pct_of_step()
+    comm, comm_src = roofline.comm_share()
     try:
         import jax
         proc = float(jax.process_index())
     except Exception:  # noqa: BLE001 — backend not up
         proc = float(host_index())
+    # the goodput plane's contribution: this host's productive wall
+    # share and its top badput bucket (as a BUCKETS index) — what lets
+    # a gang round report fleet goodput = the slowest host's with the
+    # per-bucket culprit named
+    from . import goodput
+    good_pct, badput_idx = goodput.local_stats()
     return [step_ms, float(io_pct), float(disp), live,
-            float(comm) if comm is not None else float('nan'), proc]
+            float(comm) if comm is not None else float('nan'), proc,
+            good_pct, badput_idx,
+            float('nan') if comm_src is None
+            else (1.0 if comm_src == 'measured' else 0.0)]
 
 
 def _allgather(vals):
@@ -369,6 +386,19 @@ def _publish(mat, steps):
             # (step ring still empty): omit it — JSON null, no gauge —
             # rather than publish a fake zero
             row[key] = None if np.isnan(v) else round(v, 3)
+        # decode the encoded trailing slots to their real types:
+        # badput_top is a telemetry.goodput.BUCKETS index, comm_src the
+        # comm provenance flag (1.0 measured / 0.0 modeled) — the
+        # record and gauges carry the NAMES so a modeled comm share is
+        # labeled as such everywhere downstream
+        bi = row.pop('badput_top', None)
+        from . import goodput as _goodput
+        row['badput_top'] = _goodput.BUCKETS[int(bi)] \
+            if bi is not None and 0 <= int(bi) < len(_goodput.BUCKETS) \
+            else None
+        src = row.pop('comm_src', None)
+        row['comm_src'] = None if src is None \
+            else ('measured' if src >= 0.5 else 'modeled')
         per_host.append(row)
         if row['step_time_ms'] is not None:
             reg.gauge('cluster.h%d.step_time_ms' % hid).set(
@@ -379,6 +409,11 @@ def _publish(mat, steps):
             round(row['live_bytes'] / 2.0**20, 1))
         if row['comm_pct'] is not None:
             reg.gauge('cluster.h%d.comm_pct' % hid).set(row['comm_pct'])
+        if row['comm_src'] is not None:
+            reg.gauge('cluster.h%d.comm_src' % hid).set(row['comm_src'])
+        if row['goodput_pct'] is not None:
+            reg.gauge('cluster.h%d.goodput_pct' % hid).set(
+                row['goodput_pct'])
     slowest_row, spread, straggler = round_verdict(mat)
     slowest = host_ids[slowest_row] if slowest_row is not None else None
     reg.gauge('cluster.hosts').set(n)
@@ -389,6 +424,19 @@ def _publish(mat, steps):
     snap = {'hosts': n, 'step': int(steps), 'per_host': per_host,
             'slowest_host': slowest, 'spread_pct': round(spread, 1),
             'straggler': straggler}
+    # fleet goodput = the WORST host's (a gang advances in lockstep, so
+    # one host's badput is everyone's wall-clock), with the culprit
+    # host and its top badput bucket named
+    goods = [(r['goodput_pct'], r['host'], r.get('badput_top'))
+             for r in per_host if r.get('goodput_pct') is not None]
+    if goods:
+        fleet, c_host, c_bucket = min(goods)
+        culprit = 'h%s%s' % (c_host,
+                             ':%s' % c_bucket if c_bucket else '')
+        reg.gauge('cluster.fleet_goodput_pct').set(round(fleet, 2))
+        reg.gauge('cluster.goodput_culprit').set(culprit)
+        snap['fleet_goodput_pct'] = round(fleet, 2)
+        snap['goodput_culprit'] = culprit
     with _state.lock:
         _state.snapshot = snap
     if st.sink is not None:

@@ -5,7 +5,8 @@ greps after the fact: one JSON object per line, each with a ``type``
 ('start', 'span', 'compile', 'cache_hit', 'retrace_storm', 'event',
 'program', 'oom', 'health', 'anomaly', 'cluster', 'restart', 'hang',
 'elastic', 'roofline', 'trace', 'slo', 'flight', 'manifest',
-'scalars', 'dynamics', 'summary') and a ``t`` epoch-seconds stamp —
+'scalars', 'dynamics', 'goodput', 'summary') and a ``t`` epoch-seconds
+stamp —
 the full list is documented (and lint-gated) under
 MXTPU_TELEMETRY_PATH in docs/env_vars.md. Records buffer in memory and flush every
 ``_FLUSH_EVERY`` lines (and at shutdown) so the fit loop never blocks
@@ -305,6 +306,44 @@ def _ledger_lines(led):
     return lines
 
 
+def _goodput_lines(good):
+    """The "Where the time went" block (telemetry.goodput's dict): one
+    row per bucket with seconds and wall share, the goodput verdict and
+    the rework/provenance context — rendered deterministically so the
+    offline CLI reproduces the live table byte-for-byte."""
+    lines = ['-- where the time went --']
+    wall = float(good.get('wall_s') or 0.0)
+    buckets = good.get('buckets') or {}
+    # canonical bucket order (telemetry.goodput.BUCKETS), without
+    # importing the live module: the record carries the order
+    order = ('step', 'compile', 'input_wait', 'checkpoint', 'eval',
+             'comm', 'rework', 'overhead')
+    names = [n for n in order if n in buckets]
+    names += [n for n in sorted(buckets) if n not in order]
+    for name in names:
+        secs = float(buckets[name] or 0.0)
+        pct = (100.0 * secs / wall) if wall > 0.0 else 0.0
+        label = name
+        if name == 'comm' and good.get('comm_source'):
+            label = 'comm (%s)' % good['comm_source']
+        lines.append('  %-18s  %9ss  %5.1f%%'
+                     % (label, _fmt(round(secs, 3)), pct))
+    lines.append('  %-18s  %9ss' % ('wall', _fmt(round(wall, 3))))
+    verdict = 'goodput           %s%%' % _fmt(good.get('goodput_pct'))
+    if good.get('badput_top'):
+        verdict += ' (top badput: %s)' % good['badput_top']
+    lines.append('  %s' % verdict)
+    if good.get('rework_steps'):
+        lines.append('  rework_steps      %d' % int(good['rework_steps']))
+    if good.get('prior_lost_s'):
+        lines.append('  prior_lost        %ss across relaunches -> '
+                     'job goodput %s%% of %ss'
+                     % (_fmt(good['prior_lost_s']),
+                        _fmt(good.get('job_goodput_pct')),
+                        _fmt(good.get('job_wall_s'))))
+    return lines
+
+
 def _cluster_lines(cluster):
     """The "Cluster" block (telemetry.cluster.snapshot_cluster's dict):
     one row per host from the last aggregation round, the spread, and
@@ -337,7 +376,7 @@ def _cluster_lines(cluster):
 
 
 def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
-                  cluster=None, roofline=None, ledger=None):
+                  cluster=None, roofline=None, ledger=None, goodput=None):
     """Registry snapshot -> aligned text table (one block per kind).
     ``programs`` is telemetry.programs.snapshot_programs()'s {name:
     record} — rendered as a per-program cost table (and the redundant
@@ -351,7 +390,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
     ``roofline.*`` gauges are elided the same way); ``ledger`` is
     telemetry.ledger.snapshot_ledger()'s dict — rendered as the
     "run ledger" block (manifest roll-up + last scalars; its
-    ``dynamics.*`` per-layer gauges stay in the gauges block)."""
+    ``dynamics.*`` per-layer gauges stay in the gauges block);
+    ``goodput`` is telemetry.goodput.summarize()'s dict — rendered as
+    the "Where the time went" block (the ``goodput.*`` gauges are
+    elided the same way)."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
@@ -369,6 +411,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
         # the roofline block already carries these values
         gauges = {n: v for n, v in gauges.items()
                   if not n.startswith('roofline.')}
+    if goodput:
+        # the "Where the time went" block already carries these values
+        gauges = {n: v for n, v in gauges.items()
+                  if not n.startswith('goodput.')}
     if counters:
         lines.append('-- counters --')
         w = max(len(n) for n in counters)
@@ -397,6 +443,8 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
                           _mib(r.get('output_bytes', 0))))
     if roofline:
         lines.extend(_roofline_lines(roofline))
+    if goodput:
+        lines.extend(_goodput_lines(goodput))
     if cluster:
         lines.extend(_cluster_lines(cluster))
     if ledger:

@@ -1,0 +1,325 @@
+"""Goodput accounting: where every second of the run's wall-clock went.
+
+The other telemetry planes explain *rates* (spans, MFU, roofline) and
+*failures* (health, watchdog); this one answers the operator's top-line
+question — of the N hours this job ran, how many were productive
+training? Every second of measured wall-clock is classified into a
+named bucket:
+
+- ``step``        productive train-step compute (dispatch + stats fetch)
+- ``compile``     XLA compilation (the ``xla.compile_secs`` counter)
+- ``input_wait``  the host waiting on / preparing input (draw + put)
+- ``checkpoint``  checkpoint capture + save time
+- ``eval``        evaluation / inference loops
+- ``comm``        the collective share carved out of step time, labeled
+                  with its provenance (measured trace vs roofline model)
+- ``rework``      steps re-trained between ``last_good`` and a crash
+                  (fed by module/resilient_fit.py restart hooks)
+- ``overhead``    everything unattributed: wall minus the sum above
+
+The invariant that makes the accounting trustworthy: buckets + overhead
+sum to measured wall-clock EXACTLY (overhead is the unclamped
+remainder, so over-attribution shows up as negative overhead instead of
+silently vanishing — attribution that doesn't sum is a graph, not an
+accounting). tests/unittest/test_goodput.py pins the sum property and
+bounds the over-count at 5% on an instrumented CPU fit.
+
+Inputs are the EXISTING span/mark sites — histogram sums and counters
+already in the registry — so the plane adds no device syncs and no new
+instrumentation to the hot loops. Only LEAF spans feed the buckets:
+parents (``fit.batch``) and nested spans (``io.prefetch_wait`` inside
+draw) stay out, because a span counted twice breaks the sum invariant
+this plane exists for.
+
+Across supervised relaunches, tools/train_supervisor.py and
+tools/gang_supervisor.py stamp the cumulative lost-work seconds of
+every dead attempt into ``MXTPU_GOODPUT_LOST_S``; the relaunched
+process reports it as ``prior_lost_s`` plus the derived ``job_wall_s``
+/ ``job_goodput_pct`` — separate fields, so the per-process buckets
+still sum to the per-process wall.
+
+Gating: ``MXTPU_GOODPUT`` (default on) *and* ``MXTPU_TELEMETRY=1``.
+Telemetry off = true no-op: no registry writes, no I/O, one cached-bool
+check per entry point, and the compiled programs are untouched (this
+module never reaches a trace path).
+"""
+import threading
+import time
+
+__all__ = ['BUCKETS', 'enabled', 'compute', 'note_rework', 'current',
+           'summarize', 'snapshot_goodput', 'local_stats']
+
+# bucket order is the contract: the cluster sync vector encodes the
+# top-badput bucket as this tuple's index, and the summary block and
+# JSONL record render in this order
+BUCKETS = ('step', 'compile', 'input_wait', 'checkpoint', 'eval', 'comm',
+           'rework', 'overhead')
+
+# leaf span families feeding each raw bucket (histogram sums are
+# milliseconds). fused_fit.build is where the fused window's compiles
+# block, so compile seconds landing there are not double-counted.
+STEP_SPANS = ('fit.dispatch', 'fused_fit.dispatch', 'fused_fit.fetch')
+INPUT_SPANS = ('fit.draw', 'fused_fit.draw', 'fused_fit.put')
+EVAL_SPANS = ('eval.dispatch', 'eval.metric', 'eval.fetch',
+              'fused_eval.draw', 'fused_eval.put', 'fused_eval.dispatch',
+              'fused_eval.fetch')
+CKPT_SPANS = ('ckpt.save', 'ckpt.capture')
+BUILD_SPANS = ('fused_fit.build',)
+
+
+class _GState:
+    __slots__ = ('decided', 'active', 'rework_steps', 'prior_lost_s',
+                 'last', 'lock')
+
+    def __init__(self):
+        self.decided = False
+        self.active = False
+        self.rework_steps = 0
+        self.prior_lost_s = 0.0
+        self.last = None
+        self.lock = threading.Lock()
+
+
+_state = _GState()
+_decide_lock = threading.Lock()
+
+
+def _tele():
+    """The telemetry package state (deciding it from the flag first)."""
+    from . import enabled as _tele_enabled, _state as st
+    _tele_enabled()
+    return st
+
+
+def _decide():
+    with _decide_lock:
+        if _state.decided:
+            return _state.active
+        tele_on = _tele().active
+        on = False
+        prior = 0.0
+        if tele_on:
+            from ..config import flags
+            try:
+                flags.reload('MXTPU_GOODPUT')
+                flags.reload('MXTPU_GOODPUT_LOST_S')
+                on = bool(flags.get('MXTPU_GOODPUT'))
+                prior = float(flags.get('MXTPU_GOODPUT_LOST_S'))
+            except Exception:  # noqa: BLE001 — stripped builds w/o the flag
+                on, prior = False, 0.0
+        _state.active = on
+        _state.prior_lost_s = max(0.0, prior)
+        _state.decided = True
+    return _state.active
+
+
+def enabled():
+    return _state.active if _state.decided else _decide()
+
+
+def _emit(rec):
+    st = _tele()
+    if st.active and st.sink is not None:
+        st.sink.emit(rec)
+
+
+# ---------------------------------------------------------------------------
+# the pure attribution arithmetic (shared with tools/telemetry_report.py's
+# offline reconstruction — a run that died mid-epoch accounts its badput
+# from raw records through this same function)
+# ---------------------------------------------------------------------------
+
+def _span_sum_s(hists, names):
+    total = 0.0
+    for name in names:
+        h = hists.get(name)
+        if h:
+            total += float(h.get('sum') or 0.0)
+    return total / 1e3
+
+
+def compute(snapshot, elapsed_s, rework_steps=0, total_steps=None,
+            comm_pct=None, comm_source=None, prior_lost_s=0.0):
+    """Classify ``elapsed_s`` wall-clock seconds into the named buckets,
+    from a registry snapshot (live ``Registry.snapshot()`` or the
+    offline reconstruction — both carry histogram ``sum`` values).
+
+    Pure: no registry access, no flag reads — callable with telemetry
+    off (telemetry_report reconstructs crashed runs through it).
+
+    - ``comm_pct``/``comm_source`` carve the collective share out of
+      the step bucket, provenance attached (measured vs modeled —
+      never confuse the two);
+    - ``rework_steps`` re-prices that many steps at the run's mean
+      per-step cost and moves them from ``step`` (productive) to
+      ``rework`` (badput);
+    - ``overhead`` is the UNCLAMPED remainder, so buckets + overhead
+      always sum to ``elapsed_s`` exactly.
+    """
+    elapsed_s = max(0.0, float(elapsed_s or 0.0))
+    hists = snapshot.get('histograms') or {}
+    counters = snapshot.get('counters') or {}
+    step_s = _span_sum_s(hists, STEP_SPANS)
+    input_s = _span_sum_s(hists, INPUT_SPANS)
+    eval_s = _span_sum_s(hists, EVAL_SPANS)
+    ckpt_s = _span_sum_s(hists, CKPT_SPANS)
+    build_s = _span_sum_s(hists, BUILD_SPANS)
+    compile_s = float(counters.get('xla.compile_secs') or 0.0)
+    # compile overlap: fused-window compiles block inside
+    # fused_fit.build (its own span, not otherwise bucketed); per-batch
+    # compiles block inside the first fit.dispatch. Compile seconds not
+    # covered by build must come out of the step bucket or they'd be
+    # counted twice.
+    in_build = min(compile_s, build_s)
+    step_s = max(0.0, step_s - min(compile_s - in_build, step_s))
+    comm_s = 0.0
+    if comm_pct is not None and comm_pct > 0.0:
+        comm_s = step_s * min(100.0, float(comm_pct)) / 100.0
+        step_s -= comm_s
+    rework_s = 0.0
+    rework_steps = max(0, int(rework_steps or 0))
+    if rework_steps and total_steps:
+        per_step = step_s / max(1, int(total_steps))
+        rework_s = min(step_s, per_step * rework_steps)
+        step_s -= rework_s
+    buckets = {
+        'step': step_s,
+        'compile': compile_s,
+        'input_wait': input_s,
+        'checkpoint': ckpt_s,
+        'eval': eval_s,
+        'comm': comm_s,
+        'rework': rework_s,
+    }
+    attributed = sum(buckets.values())
+    buckets['overhead'] = elapsed_s - attributed
+    badput = [(v, k) for k, v in buckets.items()
+              if k != 'step' and v > 0.0]
+    out = {
+        'wall_s': round(elapsed_s, 3),
+        'buckets': {k: round(buckets[k], 3) for k in BUCKETS},
+        'goodput_pct': round(100.0 * step_s / elapsed_s, 2)
+        if elapsed_s > 0.0 else 0.0,
+        'badput_top': max(badput)[1] if badput else None,
+        'rework_steps': rework_steps,
+    }
+    if comm_pct is not None:
+        out['comm_source'] = comm_source or 'modeled'
+    prior_lost_s = max(0.0, float(prior_lost_s or 0.0))
+    if prior_lost_s > 0.0:
+        job_wall = elapsed_s + prior_lost_s
+        out['prior_lost_s'] = round(prior_lost_s, 3)
+        out['job_wall_s'] = round(job_wall, 3)
+        out['job_goodput_pct'] = round(100.0 * step_s / job_wall, 2) \
+            if job_wall > 0.0 else 0.0
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live feeds
+# ---------------------------------------------------------------------------
+
+def note_rework(steps):
+    """Record ``steps`` re-trained steps (restart rework badput): the
+    span between the restored ``last_good`` checkpoint and the step the
+    crashed attempt had reached. Fed by module/resilient_fit.py at each
+    restart; the re-priced seconds land in the ``rework`` bucket."""
+    if not enabled():
+        return
+    steps = max(0, int(steps))
+    if not steps:
+        return
+    with _state.lock:
+        _state.rework_steps += steps
+        total = _state.rework_steps
+    _tele().registry.gauge('goodput.rework_steps').set(total)
+
+
+def current(comm_pct=None, comm_source=None):
+    """The goodput dict computed from the live registry right now
+    (no gauges published, no record emitted), or None while off.
+    When the caller has no comm share at hand the roofline's
+    provenance-labeled one is used."""
+    if not enabled():
+        return None
+    st = _tele()
+    if comm_pct is None:
+        from . import roofline
+        comm_pct, comm_source = roofline.comm_share()
+    snap = st.registry.snapshot()
+    with _state.lock:
+        rework = _state.rework_steps
+    total_steps = int((snap.get('counters') or {}).get('fit.steps') or 0)
+    return compute(snap, time.time() - st.t_start,
+                   rework_steps=rework, total_steps=total_steps,
+                   comm_pct=comm_pct, comm_source=comm_source,
+                   prior_lost_s=_state.prior_lost_s)
+
+
+def local_stats():
+    """This host's contribution to the cluster sync vector:
+    ``(goodput_pct, badput_top_index)`` with NaN for unavailable —
+    the fleet aggregation (telemetry/cluster.py) reports fleet goodput
+    as the slowest host's, with its top badput bucket named."""
+    nan = float('nan')
+    if not enabled():
+        return nan, nan
+    g = current()
+    if g is None or not g['wall_s']:
+        return nan, nan
+    top = g.get('badput_top')
+    return (float(g['goodput_pct']),
+            float(BUCKETS.index(top)) if top in BUCKETS else nan)
+
+
+def summarize(elapsed_s=None):
+    """End-of-run hook (telemetry.write_summary): compute the
+    attribution, publish the ``goodput.*`` gauges and the ``goodput``
+    JSONL record, and return the dict for the summary table / summary
+    record (None while off)."""
+    if not enabled():
+        return None
+    st = _tele()
+    if elapsed_s is None:
+        elapsed_s = time.time() - st.t_start
+    from . import roofline
+    comm_pct, comm_source = roofline.comm_share()
+    snap = st.registry.snapshot()
+    with _state.lock:
+        rework = _state.rework_steps
+    total_steps = int((snap.get('counters') or {}).get('fit.steps') or 0)
+    out = compute(snap, elapsed_s, rework_steps=rework,
+                  total_steps=total_steps, comm_pct=comm_pct,
+                  comm_source=comm_source,
+                  prior_lost_s=_state.prior_lost_s)
+    reg = st.registry
+    reg.gauge('goodput.goodput_pct').set(out['goodput_pct'])
+    for name in BUCKETS:
+        reg.gauge('goodput.%s_s' % name).set(out['buckets'][name])
+    if out.get('badput_top'):
+        reg.gauge('goodput.badput_top').set(out['badput_top'])
+    if out.get('comm_source'):
+        reg.gauge('goodput.comm_source').set(out['comm_source'])
+    if rework:
+        reg.gauge('goodput.rework_steps').set(rework)
+    if out.get('prior_lost_s'):
+        reg.gauge('goodput.prior_lost_s').set(out['prior_lost_s'])
+        reg.gauge('goodput.job_goodput_pct').set(out['job_goodput_pct'])
+    rec = {'type': 'goodput'}
+    rec.update(out)
+    _emit(rec)
+    with _state.lock:
+        _state.last = out
+    return out
+
+
+def snapshot_goodput():
+    """The last summarize() result (JSON-serializable), or None — the
+    summary record's ``goodput`` key and /summary's input."""
+    with _state.lock:
+        return dict(_state.last) if _state.last else None
+
+
+def _reset_for_tests():
+    global _state
+    _state = _GState()

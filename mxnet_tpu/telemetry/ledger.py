@@ -33,7 +33,8 @@ import threading
 import time
 import collections
 
-__all__ = ['enabled', 'ensure_manifest', 'note_train_step', 'note_eval',
+__all__ = ['enabled', 'ensure_manifest', 'begin_run', 'note_train_step',
+           'note_eval',
            'snapshot_ledger', 'final_loss', 'time_to_loss',
            'progress_target', 'TfEventsWriter', 'read_tfevents',
            'crc32c', 'masked_crc', 'MANIFEST_KEYS']
@@ -326,8 +327,8 @@ def read_tfevents(path, verify_crc=True):
 
 class _LState:
     __slots__ = ('decided', 'active', 'every', 'step', 'records',
-                 'manifest', 'manifest_emitted', 'writer', 'writer_failed',
-                 'last_emit_t', 'last_emit_step', 'lock')
+                 'manifest', 'manifest_emitted', 'run_seq', 'writer',
+                 'writer_failed', 'last_emit_t', 'last_emit_step', 'lock')
 
     def __init__(self):
         self.decided = False
@@ -337,6 +338,7 @@ class _LState:
         self.records = collections.deque(maxlen=_RECENT_KEEP)
         self.manifest = None
         self.manifest_emitted = False
+        self.run_seq = 0
         self.writer = None
         self.writer_failed = False
         self.last_emit_t = None
@@ -483,7 +485,8 @@ def build_manifest(module=None):
 def ensure_manifest(module=None):
     """Build + emit the `manifest` JSONL record once per process
     (whenever telemetry is on — the manifest is worth one record even
-    with the scalar cadence off)."""
+    with the scalar cadence off). Fit boundaries call
+    :func:`begin_run` instead, which re-emits per run."""
     st = _tele()
     if not st.active:
         return None
@@ -491,7 +494,32 @@ def ensure_manifest(module=None):
         if _state.manifest_emitted:
             return _state.manifest
         _state.manifest_emitted = True
+        _state.run_seq += 1
+        seq = _state.run_seq
+    return _emit_manifest(module, seq)
+
+
+def begin_run(module=None):
+    """Build + emit a fresh `manifest` record for a new fit() run —
+    every in-process fit (and every resilient_fit attempt) gets its
+    own, tagged with a monotonically increasing ``run_seq`` so
+    tools/run_compare.py and the offline report key on the LATEST
+    configuration instead of the process's first. Flags may legally
+    change between fits (tests and sweeps flip MXTPU_* between calls),
+    so the re-emit is what keeps the ledger honest."""
+    st = _tele()
+    if not st.active:
+        return None
+    with _state.lock:
+        _state.manifest_emitted = True
+        _state.run_seq += 1
+        seq = _state.run_seq
+    return _emit_manifest(module, seq)
+
+
+def _emit_manifest(module, seq):
     man = build_manifest(module)
+    man['run_seq'] = int(seq)
     _state.manifest = man
     rec = {'type': 'manifest'}
     rec.update(man)
@@ -701,6 +729,11 @@ def snapshot_ledger():
         out['manifest'] = {k: man.get(k) for k in MANIFEST_KEYS
                            if man.get(k) is not None}
         out['manifest']['env_set'] = man.get('env_set')
+        # which in-process fit this manifest belongs to (run_seq stays
+        # out of MANIFEST_KEYS: it is identity, not configuration, and
+        # run_compare's config diff must not flag it)
+        if man.get('run_seq') is not None:
+            out['manifest']['run_seq'] = int(man['run_seq'])
     if recent:
         out['recent'] = [{'step': s, 'loss': l} for s, _, l in recent]
         out['last'] = {'step': recent[-1][0], 'loss': recent[-1][2]}

@@ -60,7 +60,8 @@ import threading
 
 __all__ = ['enabled', 'note_compiled', 'note_hlo', 'hlo_layer_costs',
            'load_trace_events', 'analyze', 'summarize', 'republish',
-           'snapshot_roofline', 'comm_bytes_by_op', 'suggest_action',
+           'snapshot_roofline', 'comm_bytes_by_op', 'comm_share',
+           'comm_pct_of_step', 'suggest_action',
            'RECLAIM_ACTIONS', 'TOP_N',
            'OVERHEAD_UTIL_PCT', 'CLASS_COMPUTE', 'CLASS_MEMORY',
            'CLASS_OVERHEAD']
@@ -734,33 +735,47 @@ def comm_bytes_by_op(name_prefix=None):
     return out
 
 
-def comm_pct_of_step():
-    """The collective share of the step (%), or None — the
-    per-collective number the cluster straggler classifier grounds its
-    communication_bound verdict in. Uses the last published analysis
-    when one carries comm numbers; otherwise a live sync round computes
-    the MODELED share directly from the program's collective bytes and
-    the HBM ceiling — the same arithmetic as analyze()'s modeled comm
-    path, without rebuilding the per-layer analysis every sync round
-    (the common no-collective program exits on the bytes check)."""
+def comm_share():
+    """``(pct, source)`` — the collective share of the step (%) with
+    its provenance attached: ``'measured'`` when the number comes from
+    a joined device trace, ``'modeled'`` when it is the HBM-ceiling
+    lower bound, ``(None, None)`` when there is nothing to report.
+    The provenance travels with the number everywhere it is consumed
+    (cluster records, /metrics, the goodput comm bucket) so a model is
+    never laundered into a measurement. Uses the last published
+    analysis when one carries comm numbers; otherwise a live sync round
+    computes the MODELED share directly from the program's collective
+    bytes and the HBM ceiling — the same arithmetic as analyze()'s
+    modeled comm path, without rebuilding the per-layer analysis every
+    sync round (the common no-collective program exits on the bytes
+    check)."""
     with _lock:
         last = _last
     if last is not None and last.get('comm'):
-        return last['comm'].get('pct_of_step')
+        comm = last['comm']
+        return (comm.get('pct_of_step'),
+                comm.get('source') or last.get('source') or 'modeled')
     if not enabled():
-        return None
+        return None, None
     prog = _pick_step_program()
     if prog is None or prog['comm_bytes'] <= 0:
-        return None
+        return None, None
     from . import xla
     peaks = xla.device_peaks()
     if peaks['hbm_bytes_s'] <= 0:
-        return None
+        return None, None
     step_ms = _registry_step_ms(_tele().registry)
     if not step_ms:
-        return None
+        return None, None
     comm_ms = prog['comm_bytes'] / peaks['hbm_bytes_s'] * 1e3
-    return round(100.0 * comm_ms / step_ms, 1)
+    return round(100.0 * comm_ms / step_ms, 1), 'modeled'
+
+
+def comm_pct_of_step():
+    """The collective share of the step (%), or None — the provenance-
+    free convenience over :func:`comm_share` (callers feeding records
+    or /metrics should use comm_share and carry the source along)."""
+    return comm_share()[0]
 
 
 def summarize(step_time_ms=None):

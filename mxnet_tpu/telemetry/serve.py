@@ -71,6 +71,15 @@ def _prom_num(v):
     return repr(f)
 
 
+def _prom_label_val(v):
+    """A value escaped for a Prometheus label position (text format
+    0.0.4: backslash, double-quote and newline must be escaped, in that
+    order — an info-style gauge carrying a path or an error string must
+    not break the whole scrape)."""
+    return str(v).replace('\\', '\\\\').replace('"', '\\"') \
+        .replace('\n', '\\n')
+
+
 def render_prometheus(snapshot, host=None):
     """A registry snapshot as Prometheus text exposition (format 0.0.4).
 
@@ -102,7 +111,8 @@ def render_prometheus(snapshot, host=None):
         lines.append('# TYPE %s gauge' % m)
         num = _prom_num(v)
         if num is None:
-            lines.append('%s%s 1' % (m, lbl('value="%s"' % v)))
+            lines.append('%s%s 1'
+                         % (m, lbl('value="%s"' % _prom_label_val(v))))
         else:
             lines.append('%s%s %s' % (m, lbl(), num))
     hists = snapshot.get('histograms', {})
@@ -132,7 +142,8 @@ def render_prometheus(snapshot, host=None):
             lines.append('# TYPE %s gauge' % em)
             lines.append('%s%s %s' % (
                 em,
-                lbl(','.join('%s="%s"' % (k, ex['labels'][k])
+                lbl(','.join('%s="%s"'
+                             % (k, _prom_label_val(ex['labels'][k]))
                              for k in sorted(ex['labels']))),
                 _prom_num(float(ex['value']))))
         lines.append('%s_sum%s %s' % (m, lbl(),
@@ -191,7 +202,7 @@ def summary_payload():
     plus the rendered table itself."""
     import time
     from . import programs, health, cluster, roofline, slo
-    from . import dynamics, ledger
+    from . import dynamics, ledger, goodput
     from .export import summary_table
     st = _tele()
     snap = st.registry.snapshot()
@@ -208,6 +219,9 @@ def summary_payload():
     # capture from disk
     roof = roofline.snapshot_roofline() \
         or roofline.analyze(events=[], warn_unknown=False)
+    # goodput: a fresh read-only attribution (no gauges, no record) so
+    # a mid-run scrape sees live numbers, not the last summary's
+    good = goodput.current()
     return {
         'elapsed_s': round(elapsed, 3) if elapsed is not None else None,
         'host': cluster.host_index(),
@@ -219,8 +233,10 @@ def summary_payload():
         'slo': slo.snapshot_slo(),
         'ledger': led,
         'dynamics': dynamics.snapshot_dynamics(),
+        'goodput': good,
         'table': summary_table(snap, elapsed, programs=progs, health=hs,
-                               cluster=clus, roofline=roof, ledger=led),
+                               cluster=clus, roofline=roof, ledger=led,
+                               goodput=good),
     }
 
 

@@ -836,7 +836,7 @@ def test_report_reconstructs_hang_incidents(all_off):
     # summary (the relaunched child's counter never saw the abort)
     recs2 = [{'type': 'hang', 'stalled_s': 1.0, 'stacks': {}},
              {'type': 'summary', 'snapshot': {}, 'elapsed_s': 1.0}]
-    _, _, _, health2, _, _, _, _ = telemetry_report._summary_parts(recs2)
+    health2 = telemetry_report._summary_parts(recs2)[3]
     assert health2['hangs'] == 1
 
 

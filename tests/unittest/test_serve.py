@@ -166,6 +166,184 @@ def test_prometheus_empty_and_unlabeled():
 
 
 # ---------------------------------------------------------------------------
+# strict text-format 0.0.4 lint over the FULL /metrics payload
+# ---------------------------------------------------------------------------
+
+import re as _re
+
+_PROM_NAME_RE = _re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*$')
+_PROM_VALUE_RE = _re.compile(
+    r'^(?:[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?|\+Inf|-Inf|NaN)$')
+
+
+def _parse_prom_labels(s):
+    """Character-level parse of a '{k="v",...}' label block. The only
+    legal escapes in a label value are \\\\, \\" and \\n (format 0.0.4);
+    anything else — raw newline, stray backslash, unterminated quote,
+    duplicate key, trailing comma — is a lint failure."""
+    assert s[0] == '{' and s[-1] == '}', s
+    body, out, i = s[1:-1], {}, 0
+    while i < len(body):
+        j = body.index('=', i)
+        key = body[i:j]
+        assert _PROM_NAME_RE.match(key), 'bad label name %r' % key
+        assert body[j + 1] == '"', 'unquoted label value in %r' % s
+        i, val = j + 2, []
+        while True:
+            assert i < len(body), 'unterminated label value in %r' % s
+            c = body[i]
+            if c == '\\':
+                nxt = body[i + 1]
+                assert nxt in ('\\', '"', 'n'), \
+                    'illegal escape \\%s in %r' % (nxt, s)
+                val.append({'\\': '\\', '"': '"', 'n': '\n'}[nxt])
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                assert c != '\n', 'raw newline inside label value'
+                val.append(c)
+                i += 1
+        assert key not in out, 'duplicate label %r in %r' % (key, s)
+        out[key] = ''.join(val)
+        if i < len(body):
+            assert body[i] == ',', 'garbage after label value in %r' % s
+            i += 1
+            assert i < len(body), 'trailing comma in %r' % s
+    return out
+
+
+def _lint_prometheus(text):
+    """Strict structural lint of a full exposition payload. Every
+    sample must belong to a declared family (HELP before TYPE, one of
+    each), counters must end in _total with non-negative values,
+    quantile labels may only appear on summaries, and summary _sum /
+    _count samples resolve to their family. Returns
+    {family: {'type': t, 'samples': [(name, labels, value)]}}."""
+    assert text.endswith('\n'), 'payload must end with a newline'
+    families, helped = {}, set()
+    for ln in text.split('\n')[:-1]:
+        if not ln:
+            continue
+        if ln.startswith('#'):
+            m = _re.match(
+                r'^# (HELP|TYPE) ([a-zA-Z_:][a-zA-Z0-9_:]*) (.+)$', ln)
+            assert m, 'malformed comment line: %r' % ln
+            kind, name, rest = m.groups()
+            if kind == 'HELP':
+                assert name not in helped, 'duplicate HELP %s' % name
+                helped.add(name)
+            else:
+                assert name not in families, 'duplicate TYPE %s' % name
+                assert rest in ('counter', 'gauge', 'summary',
+                                'histogram', 'untyped'), \
+                    'bad TYPE %r for %s' % (rest, name)
+                assert name in helped, 'TYPE before HELP for %s' % name
+                families[name] = {'type': rest, 'samples': []}
+            continue
+        m = _re.match(r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})? (\S+)$', ln)
+        assert m, 'malformed sample line: %r' % ln
+        name, labels, value = m.groups()
+        assert _PROM_VALUE_RE.match(value), \
+            'bad sample value %r on %r' % (value, ln)
+        fam = families.get(name)
+        if fam is None:                       # summary child samples
+            for suffix in ('_sum', '_count'):
+                if name.endswith(suffix):
+                    cand = families.get(name[:-len(suffix)])
+                    if cand and cand['type'] in ('summary', 'histogram'):
+                        fam = cand
+        assert fam is not None, 'sample %r has no TYPE family' % name
+        lab = _parse_prom_labels(labels) if labels else {}
+        if 'quantile' in lab:
+            assert fam['type'] == 'summary', \
+                'quantile label on non-summary sample %r' % name
+        if fam['type'] == 'counter':
+            assert name.endswith('_total'), \
+                'counter sample %r lacks _total' % name
+            assert not value.startswith('-'), 'negative counter %r' % name
+        fam['samples'].append((name, lab, value))
+    for name, fam in families.items():
+        assert fam['samples'], 'TYPE %s declared with no samples' % name
+    return families
+
+
+def test_prometheus_strict_lint_full_metrics(tele_live):
+    """The ENTIRE /metrics payload after a real fit + summary parses
+    under the strict 0.0.4 lint — goodput.* gauges, cluster roll-up,
+    histogram summaries and an exemplar sibling included — and nasty
+    label content (quotes, backslashes, newlines, braces) round-trips
+    through the escaper."""
+    _mlp_fit(num_epoch=2)
+    telemetry.write_summary(log=False)     # publishes goodput.* gauges
+    reg = telemetry.get_registry()
+    nasty = 'a"b\\c\nd{},= '
+    reg.gauge('lint.nasty').set(nasty)
+    reg.gauge('lint.inf').set(float('inf'))
+    reg.gauge('lint.nan').set(float('nan'))
+    reg.histogram('lint.span').observe(
+        7.5, exemplar={'trace_id': 'abc"1\\2', 'route': 'x\ny'})
+    status, body = _get(serve.port(), '/metrics')
+    assert status == 200
+    fams = _lint_prometheus(body)
+    # pre-existing families all survive the lint, host-labeled
+    for f in ('mxtpu_fit_steps_total', 'mxtpu_fused_fit_dispatch_ms',
+              'mxtpu_cluster_hosts', 'mxtpu_xla_compiles_total'):
+        assert f in fams, '%s missing from /metrics' % f
+        assert all(lab.get('host') == '0'
+                   for _, lab, _ in fams[f]['samples'])
+    # the goodput plane is on /metrics: one gauge per bucket + the
+    # verdict gauges, and the info-style strings parse as labels
+    for b in ('step', 'compile', 'input_wait', 'checkpoint', 'eval',
+              'comm', 'rework', 'overhead'):
+        assert 'mxtpu_goodput_%s_s' % b in fams
+    assert fams['mxtpu_goodput_goodput_pct']['type'] == 'gauge'
+    (_, lab, v), = fams['mxtpu_goodput_badput_top']['samples']
+    assert lab['value'] in ('step', 'compile', 'input_wait', 'checkpoint',
+                            'eval', 'comm', 'rework', 'overhead')
+    assert v == '1'
+    # nasty label content round-trips exactly through the escaper
+    (_, lab, _), = fams['mxtpu_lint_nasty']['samples']
+    assert lab['value'] == nasty
+    # ... and the raw escaped form is what's on the wire
+    assert 'value="a\\"b\\\\c\\nd{},= "' in body
+    # non-finite gauges render as the spec's literals
+    assert fams['mxtpu_lint_inf']['samples'][0][2] == '+Inf'
+    assert fams['mxtpu_lint_nan']['samples'][0][2] == 'NaN'
+    # the exemplar sibling gauge carries its (escaped) trace labels
+    (_, lab, v), = fams['mxtpu_lint_span_ms_exemplar']['samples']
+    assert lab['trace_id'] == 'abc"1\\2'
+    assert lab['route'] == 'x\ny'
+    assert v == '7.5'
+    # summaries: quantiles + _sum/_count resolved to the family
+    names = [n for n, _, _ in fams['mxtpu_lint_span_ms']['samples']]
+    assert 'mxtpu_lint_span_ms_sum' in names
+    assert 'mxtpu_lint_span_ms_count' in names
+
+
+def test_prometheus_lint_rejects_malformed():
+    """The lint itself has teeth: hand-broken payloads fail."""
+    ok = ('# HELP mxtpu_x mxnet_tpu gauge x\n'
+          '# TYPE mxtpu_x gauge\n'
+          'mxtpu_x{host="0"} 1\n')
+    _lint_prometheus(ok)
+    for bad in (
+            ok.replace(' 1\n', ' one\n'),              # non-numeric value
+            ok.replace('# HELP mxtpu_x mxnet_tpu gauge x\n', ''),
+            ok.replace('gauge\n', 'gouge\n'),          # bad TYPE
+            ok.replace('host="0"', 'host="0'),         # unterminated
+            ok.replace('host="0"', r'host="a\q"'),     # illegal escape
+            ok.replace('host="0"', 'host="0",host="1"'),
+            ok + 'mxtpu_orphan 2\n',                   # no TYPE family
+            ok.replace('mxtpu_x{host="0"} 1\n',
+                       'mxtpu_x{host="0",quantile="0.5"} 1\n'),
+    ):
+        with pytest.raises(AssertionError):
+            _lint_prometheus(bad)
+
+
+# ---------------------------------------------------------------------------
 # endpoints against a live registry
 # ---------------------------------------------------------------------------
 

@@ -55,6 +55,17 @@ def test_telemetry_report_golden(tmp_path, capsys):
         '   arg_MiB   out_MiB\n'
         '  p            1          2   1000.000   2048.000       1.0'
         '       2.0       0.5\n'
+        '-- where the time went --\n'
+        '  step                    0.000s    0.0%\n'
+        '  compile                 0.000s    0.0%\n'
+        '  input_wait              0.000s    0.0%\n'
+        '  checkpoint              0.000s    0.0%\n'
+        '  eval                    0.000s    0.0%\n'
+        '  comm                    0.000s    0.0%\n'
+        '  rework                  0.000s    0.0%\n'
+        '  overhead                1.500s  100.0%\n'
+        '  wall                    1.500s\n'
+        '  goodput           0.000% (top badput: overhead)\n'
         '-- histograms (ms) --\n'
         '  name          count       mean        p50        p95'
         '        max\n'
@@ -396,12 +407,15 @@ def test_every_report_and_diff_cli_smokes(tmp_path):
     import glob
     import subprocess
     patterns = [os.path.join(REPO, 'tools', '*_report.py'),
-                os.path.join(REPO, 'tools', '*_diff.py')]
+                os.path.join(REPO, 'tools', '*_diff.py'),
+                os.path.join(REPO, 'tools', 'run_compare.py'),
+                os.path.join(REPO, 'tools', 'telemetry_watch.py')]
     clis = sorted(p for pat in patterns for p in glob.glob(pat))
     assert clis, 'no report/diff CLIs found'
     names = {os.path.basename(p) for p in clis}
     assert {'telemetry_report.py', 'roofline_report.py',
-            'bench_diff.py'} <= names
+            'bench_diff.py', 'run_compare.py',
+            'telemetry_watch.py'} <= names
     for cli in clis:
         out = subprocess.run([sys.executable, cli, '--help'],
                              capture_output=True, text=True, timeout=120)

@@ -34,6 +34,10 @@ Compared metrics, with direction and default tolerance:
   telemetry/ledger.py)                     — higher is a regression (5%;
   a non-finite candidate loss is a regression outright — a diverged
   run must not bank as a healthy throughput number)
+- ``goodput_pct`` (the goodput ledger's productive share of wall-clock,
+  telemetry/goodput.py)                    — lower is a regression (5%:
+  the same throughput with more time lost to compile/input/checkpoint
+  badput is a worse run even when the step time held)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -56,15 +60,15 @@ _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 10.0,
             'xla_live_bytes': 10.0,
             'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0,
             'serving_p99_ms': 10.0, 'serving_queue_wait_p50_ms': 10.0,
-            'final_loss': 5.0}
+            'final_loss': 5.0, 'goodput_pct': 5.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
               'xla_live_bytes': +1,
               'opt_state_bytes_per_device': +1, 'compile_s': +1,
               'serving_p99_ms': +1, 'serving_queue_wait_p50_ms': +1,
-              'final_loss': +1}
+              'final_loss': +1, 'goodput_pct': -1}
 _ORDER = ('throughput', 'mfu', 'xla_temp_bytes', 'xla_live_bytes',
           'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms',
-          'serving_queue_wait_p50_ms', 'final_loss')
+          'serving_queue_wait_p50_ms', 'final_loss', 'goodput_pct')
 
 
 def load_bench(path):
@@ -156,6 +160,10 @@ def extract(rec):
         # (bench scales its step count to measured throughput)
         if rec.get('final_loss_step') is not None:
             out['final_loss_step'] = int(rec['final_loss_step'])
+    # goodput (telemetry/goodput.py): the productive share of the bench
+    # process's wall-clock — a DROP is the regression (more badput)
+    if rec.get('goodput_pct') is not None:
+        out['goodput_pct'] = float(rec['goodput_pct'])
     return out
 
 

@@ -181,7 +181,14 @@ def run_gang(cmd, hosts, restart_max, backoff, log_path, log_dir,
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     base_env['PYTHONPATH'] = (repo + os.pathsep + base_env['PYTHONPATH']
                               if base_env.get('PYTHONPATH') else repo)
+    # cumulative lost-work seconds across gang relaunches
+    # (train_supervisor's accounting, priced once per gang attempt —
+    # the gang dies as a unit); every relaunched worker reads it back
+    # as MXTPU_GOODPUT_LOST_S and reports prior_lost_s in its goodput
+    # record
+    lost_total = _sup._env_float('MXTPU_GOODPUT_LOST_S', 0.0)
     while True:
+        base_env['MXTPU_GOODPUT_LOST_S'] = '%.3f' % lost_total
         coord_sock, port = _reserve_coord_port(used_ports)
         used_ports.add(port)
         t0 = time.time()
@@ -262,6 +269,8 @@ def run_gang(cmd, hosts, restart_max, backoff, log_path, log_dir,
             # last-good checkpoint onto the smaller mesh
             next_hosts = hosts - 1
         delay = _sup.backoff_delay(attempts, backoff)
+        lost = _sup.lost_work_secs(elapsed)
+        lost_total += lost
         _sup._record(log_path, {
             'type': 'restart', 'attempt': attempts,
             'reason': 'liveness_timeout' if timed_out else 'worker_exit',
@@ -269,7 +278,10 @@ def run_gang(cmd, hosts, restart_max, backoff, log_path, log_dir,
             'exit_code': code, 'worker': idx, 'host': idx,
             'hosts': hosts, 'next_hosts': next_hosts,
             'coordinator_port': port,
-            'elapsed_s': round(elapsed, 1), 'backoff_s': delay})
+            'elapsed_s': round(elapsed, 1),
+            'lost_s': round(lost, 1),
+            'lost_total_s': round(lost_total, 1),
+            'backoff_s': delay})
         if not quiet:
             print('gang_supervisor: attempt %d/%d — worker %d died '
                   '(%s after %.0fs); relaunching %d worker(s) on a '

@@ -73,7 +73,10 @@ class Run:
         self.layer_incidents = []
         for r in records:
             typ = r.get('type')
-            if typ == 'manifest' and self.manifest is None:
+            if typ == 'manifest':
+                # a process emits one manifest PER fit (run_seq-tagged);
+                # the latest one describes the run this log's final
+                # state belongs to
                 self.manifest = r
             elif typ == 'scalars':
                 if r.get('event') == 'eval':

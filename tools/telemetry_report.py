@@ -196,7 +196,11 @@ def _reconstruct_ledger(records):
     """Run-ledger dict rebuilt from raw `manifest` / `scalars` records
     — the crashed-run path (and the fallback for a summary record that
     predates the ledger key). None when the run banked neither."""
-    man = next((r for r in records if r.get('type') == 'manifest'), None)
+    # LAST manifest wins: ledger.begin_run re-emits one per fit() with a
+    # run_seq, and the latest describes the run that produced the tail
+    # of the log (run_compare keys on the same record)
+    man = next((r for r in reversed(records)
+                if r.get('type') == 'manifest'), None)
     scalars = [r for r in records if r.get('type') == 'scalars'
                and r.get('event') != 'eval' and r.get('step') is not None]
     if man is None and not scalars:
@@ -226,10 +230,53 @@ def _reconstruct_ledger(records):
     return out
 
 
+def _reconstruct_rework(records):
+    """Restart-rework steps rebuilt from ``restart`` + ``scalars``
+    records — each non-final restart re-trains the span between its
+    restore point and the last step the crashed attempt logged before
+    it died. Best-effort: a restart with no scalars record preceding it
+    contributes nothing (the rework existed, but is unmeasurable from
+    this log)."""
+    scalars = [(r.get('t'), r['step']) for r in records
+               if r.get('type') == 'scalars'
+               and isinstance(r.get('step'), (int, float))]
+    rework = 0
+    for r in records:
+        if r.get('type') != 'restart' or r.get('final'):
+            continue
+        restore = r.get('restore_step')
+        t = r.get('t')
+        if restore is None or t is None:
+            continue
+        reached = max((s for ts, s in scalars
+                       if ts is not None and ts <= t), default=None)
+        if reached is not None:
+            rework += max(0, int(reached) - int(restore))
+    return rework
+
+
+def _reconstruct_goodput(records, snapshot, elapsed, roofline, ledger):
+    """Goodput attribution recomputed from the reconstructed snapshot —
+    the crashed-run path (the process died before summarize() ran).
+    Same pure compute as the live ledger, so the offline block cannot
+    drift from what the run would have reported."""
+    if not elapsed or elapsed <= 0:
+        return None
+    from mxnet_tpu.telemetry import goodput as _goodput
+    comm = ((roofline or {}).get('comm') or {})
+    return _goodput.compute(
+        snapshot, elapsed,
+        rework_steps=_reconstruct_rework(records),
+        total_steps=(ledger or {}).get('steps'),
+        comm_pct=comm.get('pct_of_step'),
+        comm_source=comm.get('source') or ((roofline or {}).get('source')
+                                           if comm else None))
+
+
 def _summary_parts(records):
     """(snapshot, elapsed, programs, health, cluster, roofline, ledger,
-    reconstructed) for one host's record list — the last summary record
-    when present, else the crashed-run reconstruction."""
+    goodput, reconstructed) for one host's record list — the last
+    summary record when present, else the crashed-run reconstruction."""
     summaries = [r for r in records if r.get('type') == 'summary']
     clus_recs = [r for r in records if r.get('type') == 'cluster']
     cluster = clus_recs[-1] if clus_recs else None
@@ -264,23 +311,28 @@ def _summary_parts(records):
             health = dict(health or {'nonfinite_steps': 0, 'incidents': [],
                                      'anomaly_counts': {}})
             health['hangs'] = max(int(health.get('hangs') or 0), hangs)
+        led = s.get('ledger') or _reconstruct_ledger(records)
+        roof = s.get('roofline') or roofline
+        good = s.get('goodput') or _reconstruct_goodput(
+            records, s.get('snapshot') or {}, s.get('elapsed_s'),
+            roof, led)
         return (s.get('snapshot') or {}, s.get('elapsed_s'),
                 s.get('programs'), health,
-                s.get('cluster') or cluster,
-                s.get('roofline') or roofline,
-                s.get('ledger') or _reconstruct_ledger(records), False)
+                s.get('cluster') or cluster, roof, led, good, False)
     snapshot, elapsed, programs, health = _reconstruct(records)
+    led = _reconstruct_ledger(records)
+    good = _reconstruct_goodput(records, snapshot, elapsed, roofline, led)
     return (snapshot, elapsed, programs, health, cluster, roofline,
-            _reconstruct_ledger(records), True)
+            led, good, True)
 
 
 def render(records):
     """The summary table for a parsed record list, as a string."""
-    snapshot, elapsed, programs, health, cluster, roofline, led, reco = \
-        _summary_parts(records)
+    (snapshot, elapsed, programs, health, cluster, roofline, led, good,
+     reco) = _summary_parts(records)
     table = summary_table(snapshot, elapsed, programs=programs,
                           health=health, cluster=cluster,
-                          roofline=roofline, ledger=led)
+                          roofline=roofline, ledger=led, goodput=good)
     if reco:
         table += ('\n(no summary record found — reconstructed from '
                   '%d individual records; registry-only counters and '
@@ -374,7 +426,7 @@ def render_hosts(by_host):
     rows = []
     for host in sorted(by_host):
         (snapshot, elapsed, programs, health, cluster, roof, _led,
-         reco) = _summary_parts(by_host[host])
+         good, reco) = _summary_parts(by_host[host])
         steps = snapshot.get('counters', {}).get('fit.steps')
         if steps is None:
             steps = (snapshot.get('histograms', {})
@@ -390,6 +442,7 @@ def render_hosts(by_host):
                      # diverge on communication_bound hosts
                      'comm_pct': ((roof or {}).get('comm') or {})
                      .get('pct_of_step'),
+                     'goodput': (good or {}).get('goodput_pct'),
                      'nonfinite': int((health or {})
                                       .get('nonfinite_steps') or 0),
                      'records': by_host[host]})
@@ -405,20 +458,23 @@ def render_hosts(by_host):
         med = statistics.median(times)
         spread = ((max(times) - min(times)) / med * 100.0) if med else 0.0
     lines = ['== per-host comparison (%d hosts) ==' % len(rows)]
-    lines.append('  host    steps   step_ms   io_wait%  nonfinite  class')
+    lines.append('  host    steps   step_ms   io_wait%  goodput%  '
+                 'nonfinite  class')
     for r in rows:
         mark = '*' if (r['host'] == slowest and len(rows) > 1) else ''
         # no io-wait data = no classification; a confident
         # 'compute_bound' with a '-' io column would be fabricated
         cls = '-' if r['io_wait_pct'] is None \
             else classify(r['io_wait_pct'], comm_pct=r['comm_pct'])
-        lines.append('  %-6s  %-6s  %-8s  %-8s  %-9s  %s'
+        lines.append('  %-6s  %-6s  %-8s  %-8s  %-8s  %-9s  %s'
                      % ('%s%s' % (r['host'], mark),
                         '-' if r['steps'] is None else r['steps'],
                         '-' if r['step_ms'] is None
                         else '%.3f' % r['step_ms'],
                         '-' if r['io_wait_pct'] is None
                         else '%.1f' % r['io_wait_pct'],
+                        '-' if r['goodput'] is None
+                        else '%.1f' % r['goodput'],
                         r['nonfinite'], cls))
     if spread is not None and len(rows) > 1:
         if spread < _SPREAD_BALANCED_PCT:

@@ -53,13 +53,24 @@ def fetch(source):
                 'cluster': s.get('cluster')
                 or (clus[-1] if clus else None),
                 'ledger': s.get('ledger')
-                or telemetry_report._reconstruct_ledger(records)}
+                or telemetry_report._reconstruct_ledger(records),
+                'goodput': s.get('goodput')
+                or telemetry_report._reconstruct_goodput(
+                    records, s.get('snapshot') or {}, s.get('elapsed_s'),
+                    s.get('roofline'),
+                    s.get('ledger')
+                    or telemetry_report._reconstruct_ledger(records))}
     snapshot, elapsed, programs, health = telemetry_report._reconstruct(
         records)
+    led = telemetry_report._reconstruct_ledger(records)
+    roofs = [r for r in records if r.get('type') == 'roofline']
     return {'elapsed_s': elapsed, 'host': None, 'snapshot': snapshot,
             'programs': programs, 'health': health,
             'cluster': clus[-1] if clus else None,
-            'ledger': telemetry_report._reconstruct_ledger(records)}
+            'ledger': led,
+            'goodput': telemetry_report._reconstruct_goodput(
+                records, snapshot, elapsed,
+                roofs[-1] if roofs else None, led)}
 
 
 def _fmt(v, suffix=''):
@@ -130,6 +141,24 @@ def render(summary, steps_per_s=None, reqs_per_s=None):
     if g.get('fit.input_bound_pct') is not None:
         lines.append('  io_wait      %s%% of loop time'
                      % _fmt(float(g['fit.input_bound_pct'])))
+    # goodput line (telemetry/goodput.py): the productive share of
+    # wall-clock so far, plus the biggest badput bucket by name — the
+    # live twin of the end-of-run "where the time went" block
+    good = summary.get('goodput') or {}
+    if good.get('goodput_pct') is not None:
+        bits = ['%.1f%% productive' % float(good['goodput_pct'])]
+        top = good.get('badput_top')
+        if top:
+            secs = (good.get('buckets') or {}).get(top)
+            bits.append('top badput %s%s'
+                        % (top, ' (%.1fs)' % secs
+                           if isinstance(secs, (int, float)) else ''))
+        if good.get('rework_steps'):
+            bits.append('%d steps reworked' % int(good['rework_steps']))
+        if good.get('job_goodput_pct') is not None:
+            bits.append('job %.1f%% across restarts'
+                        % float(good['job_goodput_pct']))
+        lines.append('  goodput      %s' % ', '.join(bits))
     if g.get('xla.bytes_in_use') is not None:
         lines.append('  device_mem   %.1f MiB live, %.1f MiB peak'
                      % (g['xla.bytes_in_use'] / 2.0**20,

@@ -97,6 +97,29 @@ def _record(path, rec):
               file=sys.stderr)
 
 
+def lost_work_secs(attempt_elapsed, ckpt_dir=None, now=None):
+    """Wall seconds a dead attempt loses to the goodput ledger:
+    everything since the last-good checkpoint pointer was certified
+    (the ``last_good.step`` file's mtime — the framework-free mirror of
+    module/checkpointing.py's pointer contract), clamped to the
+    attempt's own elapsed; the FULL attempt when no pointer exists
+    (nothing to resume from — every second re-trains). Shared with
+    tools/gang_supervisor.py so both tiers price lost work the same
+    way."""
+    if ckpt_dir is None:
+        ckpt_dir = os.environ.get('MXTPU_CKPT_DIR', '')
+    if now is None:
+        now = time.time()
+    if ckpt_dir:
+        try:
+            mtime = os.stat(
+                os.path.join(ckpt_dir, 'last_good.step')).st_mtime
+            return max(0.0, min(float(attempt_elapsed), now - mtime))
+        except OSError:
+            pass
+    return max(0.0, float(attempt_elapsed))
+
+
 def _describe(code):
     if code is None:
         return 'running'
@@ -199,11 +222,18 @@ def run(cmd, restart_max, backoff, log_path, quiet=False,
     telemetry JSONL (``liveness_path``) stops growing for that many
     seconds — the tier for a child too wedged to self-abort."""
     attempts = 0
+    # cumulative lost-work seconds across relaunches, seeded from the
+    # environment so chained supervisors keep one running total; each
+    # child reads it back as MXTPU_GOODPUT_LOST_S and reports
+    # prior_lost_s / job_goodput_pct in its goodput record
+    lost_total = _env_float('MXTPU_GOODPUT_LOST_S', 0.0)
     while True:
         t0 = time.time()
         timed_out = False
+        env = dict(os.environ)
+        env['MXTPU_GOODPUT_LOST_S'] = '%.3f' % lost_total
         try:
-            proc = subprocess.Popen(cmd)
+            proc = subprocess.Popen(cmd, env=env)
         except OSError as e:
             print('train_supervisor: cannot launch %r (%s)'
                   % (cmd[0], e), file=sys.stderr)
@@ -253,11 +283,15 @@ def run(cmd, restart_max, backoff, log_path, quiet=False,
             return code if not (timed_out and code == 0) else 1
         attempts += 1
         delay = backoff_delay(attempts, backoff)
+        lost = lost_work_secs(elapsed)
+        lost_total += lost
         _record(log_path, {'type': 'restart', 'attempt': attempts,
                            'reason': 'liveness_timeout' if timed_out
                            else 'process_exit',
                            'message': _describe(code), 'exit_code': code,
                            'elapsed_s': round(elapsed, 1),
+                           'lost_s': round(lost, 1),
+                           'lost_total_s': round(lost_total, 1),
                            'backoff_s': delay})
         if not quiet:
             print('train_supervisor: attempt %d/%d died (%s after %.0fs) '
