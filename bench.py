@@ -1023,6 +1023,19 @@ def _telemetry_breakdown(device, step_ms=None):
             tel['opt_state_bytes_per_device'] = \
                 int(g['update.opt_state_bytes_per_device'])
             tel['sharded_update'] = bool(g.get('update.sharded'))
+        # quantized gradient collectives (ISSUE 17): wire bytes per
+        # sync step + ratio, with the measured/modeled provenance the
+        # gauges carry — bench_diff gates the byte count
+        if 'comm.bytes_on_wire_per_step' in g:
+            tel['bytes_on_wire_per_step'] = \
+                int(g['comm.bytes_on_wire_per_step'])
+            if g.get('comm.compression_ratio') is not None:
+                tel['compression_ratio'] = \
+                    float(g['comm.compression_ratio'])
+            if g.get('comm.mode'):
+                tel['compress_mode'] = g['comm.mode']
+            if g.get('comm.bytes_src'):
+                tel['comm_bytes_src'] = g['comm.bytes_src']
         # training-health counts (ISSUE 4): anomalies / non-finite
         # steps seen by the sentinels, when MXTPU_HEALTH ran
         hc = {n[len('health.'):]: int(v) for n, v in c.items()
@@ -1394,6 +1407,13 @@ def main():
             out['goodput'] = {'buckets': good.get('buckets'),
                               'badput_top': good.get('badput_top'),
                               'wall_s': good.get('wall_s')}
+        # top-level copy of the wire-byte gate (bench_diff gates
+        # bytes_on_wire_per_step: higher = regression)
+        if tel.get('bytes_on_wire_per_step') is not None:
+            out['bytes_on_wire_per_step'] = \
+                tel['bytes_on_wire_per_step']
+            if tel.get('compression_ratio') is not None:
+                out['compression_ratio'] = tel['compression_ratio']
     # sharded-vs-replicated weight-update A/B (MXTPU_SHARDED_UPDATE):
     # only runs at dp > 1, and AFTER the telemetry fold above so the
     # probe model's compiles/programs/roofline never contaminate the

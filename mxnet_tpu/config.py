@@ -440,6 +440,27 @@ flags.declare('MXTPU_SHARDED_UPDATE', bool, True,
               'anywhere else the update runs replicated (warn-once '
               'when the flag was set explicitly). 0 keeps the '
               'replicated update everywhere')
+flags.declare('MXTPU_GRAD_COMPRESS', str, 'off',
+              'Quantized gradient collectives with error feedback '
+              '(parallel/compression.py, EQuARX recipe): int8 = '
+              'block-quantized grads with per-block scales and a '
+              'persistent error-feedback residual carried through the '
+              'fused window; bf16 = half-width cast, no scales; auto = '
+              'start uncompressed, flip to int8 when a cluster sync '
+              'round classifies the run communication_bound (the flip '
+              'rebuilds the window program and emits one compression '
+              'JSONL record with the step-time delta). Also switches '
+              'the kvstore_dist push/pull wire format to compressed, '
+              'version-tagged payloads. off lowers byte-identically '
+              'to the uncompressed program. Gauges: comm.bytes_on_'
+              'wire_per_step, comm.compression_ratio',
+              choices={'off', 'int8', 'bf16', 'auto'})
+flags.declare('MXTPU_GRAD_COMPRESS_BLOCK', int, 256,
+              'Block size for int8 gradient quantization: one fp32 '
+              'scale (amax/127) per this many gradient elements. '
+              'Smaller blocks track outliers tighter at more scale '
+              'overhead (4 bytes per block on the wire)',
+              min_value=8)
 flags.declare('MXTPU_BN_ONEPASS', bool, True,
               'BatchNorm training stats via one-pass moments '
               '(sum/sum-of-squares in one fused HBM read of the '

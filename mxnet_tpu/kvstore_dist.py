@@ -195,6 +195,13 @@ class KVStoreDist(KVStore):
         self._conns = [_ServerConn(a) for a in topo[2]]
         self._sync = '_async' not in kv_type
         self._key_meta = {}  # key -> (shape, dtype)
+        # MXTPU_GRAD_COMPRESS wire state: per-shard-key error-feedback
+        # residual (this worker's accumulated quantization error, numpy
+        # host-side) and per-key (compressed, uncompressed) byte counts
+        # feeding the MEASURED comm.* gauges — these are real bytes
+        # crossing the TCP wire, unlike the SPMD window's modeled twin
+        self._push_ef = {}
+        self._wire_stats = {}
         self._aux = None     # heartbeat / dead-node channel
         self._aux_lock = threading.Lock()
         self._start_heartbeat(root, 'worker')
@@ -417,10 +424,24 @@ class KVStoreDist(KVStore):
                 if k not in self._key_meta:
                     self._key_meta[k] = (merged.shape, merged.dtype)
                 flat = merged.reshape(-1)
+                cmode = self._compress_mode() \
+                    if merged.dtype.kind == 'f' else 'off'
+                comp = unc = 0
                 for sid, skey, sl in self._shards(k, merged.shape,
                                                   merged.dtype):
-                    self._conns[sid].submit(
-                        ('push', skey, pack_array(flat[sl])))
+                    seg = flat[sl]
+                    if cmode != 'off':
+                        msg = self._encode_push(skey, seg, cmode)
+                        self._conns[sid].submit(('push_c', skey, msg))
+                        from .parallel import compression
+                        comp += compression.wire_message_bytes(msg)
+                    else:
+                        self._conns[sid].submit(
+                            ('push', skey, pack_array(seg)))
+                        comp += seg.nbytes
+                    unc += seg.nbytes
+                self._wire_stats[k] = (comp, unc)
+            self._publish_wire_gauges()
             if nbytes:
                 # host-observed push rate (reduce + serialize + submit;
                 # the server ack is async). /metrics labels it with
@@ -431,6 +452,42 @@ class KVStoreDist(KVStore):
                     _tele.gauge('kvstore.push_mb_s').set(
                         round(nbytes / 2.0**20 / dt, 2))
             _tele.watchdog.note_progress('kvstore.push')
+
+    # -- compressed wire format (MXTPU_GRAD_COMPRESS) ----------------------
+    @staticmethod
+    def _compress_mode():
+        from .parallel import compression
+        return compression.resolved_mode()
+
+    def _encode_push(self, skey, seg, cmode):
+        """Error-feedback encode of one shard segment: this worker's
+        residual for the key re-enters the carry before quantization,
+        and what the quantizer drops becomes the next residual —
+        host-side numpy, mirroring the in-window EF math."""
+        from .parallel import compression
+        carry = seg.astype(np.float32, copy=True)
+        resid = self._push_ef.get(skey)
+        if resid is not None and resid.shape == carry.shape:
+            carry += resid
+        msg = compression.encode_wire(carry, cmode)
+        nr = carry - compression.decode_wire(msg).astype(np.float32)
+        self._push_ef[skey] = np.where(np.isfinite(nr), nr, 0.0)
+        return msg
+
+    def _publish_wire_gauges(self):
+        """MEASURED comm.* gauges: actual payload bytes submitted to
+        the server sockets this push round, summed over keys — the
+        kvstore path counts real wire traffic where the SPMD window
+        can only model it (comm.bytes_src says which one you read)."""
+        if not _tele.enabled() or not self._wire_stats:
+            return
+        comp = sum(c for c, _ in self._wire_stats.values())
+        unc = sum(u for _, u in self._wire_stats.values())
+        _tele.gauge('comm.bytes_on_wire_per_step').set(int(comp))
+        _tele.gauge('comm.compression_ratio').set(
+            round(unc / max(comp, 1), 3))
+        _tele.gauge('comm.mode').set(self._compress_mode())
+        _tele.gauge('comm.bytes_src').set('measured')
 
     def _push_row_sparse(self, k, vlist):
         """Row-sparse grads go whole to the key's home server (the
@@ -463,13 +520,21 @@ class KVStoreDist(KVStore):
                     k, (olist[0].shape, olist[0].dtype))
                 shards = self._shards(k, shape, dtype)
                 timeout, _ = self._retry_cfg()
+                # bf16 mode compresses the pull wire too (a half-width
+                # value cast is loss-bounded for weights); int8 pulls
+                # stay full-precision — the blockwise-EF recipe is a
+                # GRADIENT transform, weights get no residual stream
+                pkind = 'pull'
+                if np.dtype(dtype).kind == 'f' \
+                        and self._compress_mode() == 'bf16':
+                    pkind = 'pull_c'
                 # first attempt stays parallel across servers; a shard
                 # whose reply errors or times out drops into the
                 # serial reconnect-retry path (_request)
                 futs = []
                 for sid, skey, sl in shards:
                     try:
-                        fut = self._conns[sid].submit(('pull', skey))
+                        fut = self._conns[sid].submit((pkind, skey))
                     except (RuntimeError, OSError):
                         fut = None   # conn poisoned/closed: retry path
                     futs.append((sid, skey, sl, fut))
@@ -480,9 +545,15 @@ class KVStoreDist(KVStore):
                             raise OSError('connection already failed')
                         reply = f.wait(timeout)
                     except (OSError, TimeoutError):
-                        reply = self._request(sid, ('pull', skey))
-                    assert reply and reply[0] == 'arr', reply
-                    flat[sl] = unpack_array(reply[1]).reshape(-1)
+                        reply = self._request(sid, (pkind, skey))
+                    if pkind == 'pull_c':
+                        assert reply and reply[0] == 'arr_c', reply
+                        from .parallel import compression
+                        flat[sl] = compression.decode_wire(
+                            reply[1]).reshape(-1)
+                    else:
+                        assert reply and reply[0] == 'arr', reply
+                        flat[sl] = unpack_array(reply[1]).reshape(-1)
                 arr = flat.reshape(shape)
                 for o in olist:
                     o._data = jax.device_put(

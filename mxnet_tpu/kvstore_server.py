@@ -316,10 +316,30 @@ class KVStoreServer:
             indices = unpack_array(msg[2])
             values = unpack_array(msg[3])
             self._handle_push(msg[1], (indices, values), conn, sparse=True)
+        elif kind == 'push_c':
+            # compressed push (MXTPU_GRAD_COMPRESS): version-tagged
+            # payload. decode_wire raises on version/mode skew and the
+            # serve loop turns that into an ('error', ...) reply — a
+            # mixed-version gang fails loudly on its first compressed
+            # push, never merges a misparsed gradient. An OLD server
+            # hits the unknown-message branch below with the same
+            # loud outcome.
+            from .parallel import compression
+            self._handle_push(msg[1], compression.decode_wire(msg[2]),
+                              conn)
         elif kind == 'pull':
             with self._lock:
                 arr = self.store[msg[1]]
             send_msg(conn, ('arr', pack_array(arr)))
+        elif kind == 'pull_c':
+            # bf16-compressed pull: the stored value goes back at half
+            # width (value cast, no residual — weights are not a
+            # gradient stream)
+            from .parallel import compression
+            with self._lock:
+                arr = self.store[msg[1]]
+            send_msg(conn, ('arr_c', compression.encode_wire(
+                np.asarray(arr).reshape(-1), 'bf16')))
         elif kind == 'pull_rsp':
             # stored values are flat (init ships flattened stripes); view
             # them as rows of the requested width before gathering

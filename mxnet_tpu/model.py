@@ -80,6 +80,17 @@ def _update_params(param_arrays, grad_arrays, updater, num_device,
             kvstore.pull(name, grad_list, priority=-index)
         for k, p in enumerate(zip(arg_list, grad_list)):
             w, g = p
+            if kvstore is not None and w._data.sharding != \
+                    g._data.sharding:
+                # the pull above re-materialized the summed gradient on
+                # its own context's single device, but on an SPMD group
+                # the weight is a mesh-sharded (or differently placed)
+                # global array — the updater would then mix placements
+                # and jax either raises or silently gathers. Restore
+                # the invariant the executor group established: the
+                # gradient lives exactly where its weight lives.
+                import jax
+                g._data = jax.device_put(g._data, w._data.sharding)
             updater(index * num_device + k, g, w)
 
 

@@ -111,6 +111,23 @@ def note_replicated_update(reason, site='fused_fit'):
         'docs/env_vars.md', site, reason)
 
 
+_compress_off_warned = set()
+
+
+def _warn_compress_off(reason):
+    """Flag-honesty warning, once per reason per process:
+    MXTPU_GRAD_COMPRESS was set but the gradients about to move are
+    UNCOMPRESSED. Quantization rides the ZeRO sharded-update path
+    (the flat, dp-sharded leaf is the block layout) — see
+    MXTPU_GRAD_COMPRESS in docs/env_vars.md."""
+    if reason in _compress_off_warned:
+        return
+    _compress_off_warned.add(reason)
+    logging.warning(
+        'MXTPU_GRAD_COMPRESS is set but gradients run UNCOMPRESSED: '
+        '%s — see MXTPU_GRAD_COMPRESS in docs/env_vars.md', reason)
+
+
 def flush_sharded_states(module):
     """Materialize any optimizer-state leaves the module's cached fused
     loop holds in the ZeRO update-phase layout (flat, padded,
@@ -151,6 +168,18 @@ def zero_shape_probe(module):
     # per leaf per save) — the checkpoint walk relabels onto this
     probe.row = loop._zero['row']
     return probe
+
+
+def _compress_flag():
+    from ..config import flags
+    flags.reload('MXTPU_GRAD_COMPRESS')
+    return flags.get('MXTPU_GRAD_COMPRESS')
+
+
+def _compress_block():
+    from ..config import flags
+    flags.reload('MXTPU_GRAD_COMPRESS_BLOCK')
+    return int(flags.get('MXTPU_GRAD_COMPRESS_BLOCK'))
 
 
 def _mirror_flag():
@@ -511,6 +540,23 @@ class FusedFitLoop:
                 'module opted out (sharded_update=False)'
                 if self._mesh is not None and dp > 1
                 else 'no SPMD mesh / dp axis is 1')
+        # Quantized gradient collectives (MXTPU_GRAD_COMPRESS): the
+        # error-feedback residuals live here between windows — one flat
+        # leaf per grad in the ZeRO update-phase layout, donated
+        # through the scan carry like opt-state leaves. Loop-local on
+        # purpose: a restart resets the residual to zero, which costs
+        # one step of quantization error and nothing else (documented
+        # in docs/perf.md), so the checkpoint format is untouched.
+        self._resid = None
+        self._resid_meta = None
+        # per-run flip bookkeeping: last window's resolved mode + wall
+        # ms, and whether the one-shot 'compression' record fired
+        self._cstate = {'mode': None, 'ms': None, 'emitted': False,
+                        'windows': 0}
+        if _compress_flag() != 'off' and self._zero is None:
+            _warn_compress_off(
+                'no ZeRO sharded update engaged (the flat dp-sharded '
+                'leaf form is the quantization block layout)')
 
     # -- reuse across fit() calls ------------------------------------------
     @staticmethod
@@ -572,6 +618,10 @@ class FusedFitLoop:
                        getattr(module._kvstore, 'type', None),
                        _window_size(), bool(_shard_update_enabled()),
                        bool(getattr(module, 'sharded_update', True)),
+                       # the compression FLAG + block (not the auto-
+                       # resolved mode: an auto flip mid-run is handled
+                       # by the per-window program key, not a rebuild)
+                       str(_compress_flag()), _compress_block(),
                        str(_mirror_flag()), str(_remat_policy()),
                        bool(_donate_flag()),
                        # BatchNorm's stats form is traced INTO the
@@ -711,7 +761,25 @@ class FusedFitLoop:
         """Update-op choice per param, delegated to the optimizer plan."""
         return self._plan.mode(self._exec.arg_dict[n]._data.dtype)
 
-    def _build_program(self, static_attrs, shapes_key):
+    def _cmode(self):
+        """Resolved gradient-compression mode for the NEXT window:
+        'off'/'int8'/'bf16'. 'auto' resolves against the cluster
+        verdict state (parallel/compression.py), so a sync round that
+        classifies the run communication_bound flips this mid-run —
+        the mode is part of the per-window program key, so the flip
+        rebuilds the window program at the next dispatch. Pinned to
+        'off' (warn-once) when the ZeRO update path is not engaged:
+        the flat dp-sharded leaf IS the quantization block layout."""
+        from ..parallel import compression
+        mode = compression.resolved_mode()
+        if mode != 'off' and self._zero is None:
+            _warn_compress_off(
+                'no ZeRO sharded update engaged (the flat dp-sharded '
+                'leaf form is the quantization block layout)')
+            return 'off'
+        return mode
+
+    def _build_program(self, static_attrs, shapes_key, cmode=None):
         run = self._run
         arg_pos = {n: i for i, n in enumerate(self._arg_names)}
         data_names = list(self.module._data_names)
@@ -739,6 +807,15 @@ class FusedFitLoop:
             from .executor_group import SPMDExecutorGroup
             rep_pin = SPMDExecutorGroup.replicate_sharding(mesh)
         shard_update = self._zero is not None
+        cmode = self._cmode() if cmode is None else cmode
+        compress = shard_update and cmode != 'off'
+        if compress:
+            # error-feedback quantization of the update-form gradient
+            # (parallel/compression.py): the numerics of the EQuARX
+            # recipe, applied inside the jitted window; the residual
+            # rides the scan carry next to the opt-state leaves
+            from ..parallel import compression as _compr
+            cblock = _compress_block()
         if shard_update:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from ..parallel.sharding import zero_flatten, zero_unflatten
@@ -770,10 +847,13 @@ class FusedFitLoop:
                 # layout the loop holds between windows
                 return jax.lax.with_sharding_constraint(t, row)
 
-        def window_fn(params, states, aux, gaccs, data_stack, label_stack,
-                      key, lr_arr, wd_arr):
+        def make_body(key):
             def body(carry, xs):
-                params, states, aux, gaccs = carry
+                if compress:
+                    params, states, aux, gaccs, resids = carry
+                    new_resids = list(resids)
+                else:
+                    params, states, aux, gaccs = carry
                 step_i, datas, labels, lr_row, wd_row = xs
                 k = jax.random.fold_in(key, step_i)
                 if defer_fn is not None:
@@ -820,6 +900,16 @@ class FusedFitLoop:
                         w_shape = w.shape
                         w, g = to_update_form(w), to_update_form(g)
                         st = tuple(pin_state(s) for s in st)
+                    if compress:
+                        # quantize -> dequantize the reduced gradient
+                        # with error feedback: the dropped precision of
+                        # this step re-enters at the next via the
+                        # carried residual (convergence gated by the
+                        # chaos-lane run_compare e2e, never assumed)
+                        g, nr = _compr.ef_roundtrip(g, resids[j], cmode,
+                                                    cblock)
+                        g = pin_state(g)
+                        new_resids[j] = pin_state(nr)
                     # every fused update op returns (w, *states) with
                     # states in input order — application is generic
                     res = ops[modes[n]].fn(attrs, w, g, *st)
@@ -875,9 +965,14 @@ class FusedFitLoop:
                                          for i in grad_carry_idx)))
                 if extras:
                     ys = (ys, *extras)
+                if compress:
+                    return (tuple(new_params), tuple(new_states),
+                            new_aux, gaccs, tuple(new_resids)), ys
                 return (tuple(new_params), tuple(new_states), new_aux,
                         gaccs), ys
+            return body
 
+        def make_xs(lr_arr, wd_arr):
             step_idx = jnp.arange(W)
             lr_xs = jnp.asarray(lr_arr)
             wd_xs = jnp.asarray(wd_arr)
@@ -886,10 +981,27 @@ class FusedFitLoop:
                                                             rep_pin)
                 lr_xs = jax.lax.with_sharding_constraint(lr_xs, rep_pin)
                 wd_xs = jax.lax.with_sharding_constraint(wd_xs, rep_pin)
-            (p, s, a, g), ys = jax.lax.scan(
-                body, (params, states, aux, gaccs),
-                (step_idx, data_stack, label_stack, lr_xs, wd_xs))
-            return p, s, a, g, ys
+            return step_idx, lr_xs, wd_xs
+
+        if compress:
+            # the residual tuple is an extra carry member right after
+            # gaccs — donated like the other carry leaves, returned in
+            # the ZeRO layout for the loop to hold between windows
+            def window_fn(params, states, aux, gaccs, resids, data_stack,
+                          label_stack, key, lr_arr, wd_arr):
+                step_idx, lr_xs, wd_xs = make_xs(lr_arr, wd_arr)
+                (p, s, a, g, r), ys = jax.lax.scan(
+                    make_body(key), (params, states, aux, gaccs, resids),
+                    (step_idx, data_stack, label_stack, lr_xs, wd_xs))
+                return p, s, a, g, r, ys
+        else:
+            def window_fn(params, states, aux, gaccs, data_stack,
+                          label_stack, key, lr_arr, wd_arr):
+                step_idx, lr_xs, wd_xs = make_xs(lr_arr, wd_arr)
+                (p, s, a, g), ys = jax.lax.scan(
+                    make_body(key), (params, states, aux, gaccs),
+                    (step_idx, data_stack, label_stack, lr_xs, wd_xs))
+                return p, s, a, g, ys
 
         # the train-step program of the fused path: its XLA cost
         # analysis (scan body counted once = per-step FLOPs) feeds the
@@ -903,9 +1015,13 @@ class FusedFitLoop:
         # tested) for A/B evidence.
         if donate:
             _install_donate_filter()
+        if compress:
+            donate_idx = (0, 1, 2, 3, 4, 5, 6) if donate else ()
+        else:
+            donate_idx = (0, 1, 2, 3, 4, 5) if donate else ()
         return registered_jit(
             self._prog_name, window_fn, step_flops=True,
-            donate_argnums=(0, 1, 2, 3, 4, 5) if donate else ())
+            donate_argnums=donate_idx)
 
     # -- ZeRO state layout -------------------------------------------------
     def zero_wrapper_shapes(self):
@@ -1014,6 +1130,90 @@ class FusedFitLoop:
             _tele.gauge('update.sharded').set(0)
         self._update_gauged = True
         _tele.gauge('update.opt_state_bytes_per_device').set(int(total))
+
+    # -- quantized gradient collectives ------------------------------------
+    def _resid_specs(self):
+        """(name, padded flat length, dtype) per grad leaf in the ZeRO
+        update-phase layout — the residual shapes AND the wire-byte
+        model's element counts."""
+        if self._resid_meta is None:
+            from ..parallel.sharding import zero_pad_len
+            dp = self._zero['dp']
+            meta = []
+            for n in self._grad_names:
+                a = self._exec.arg_dict[n]._data
+                size = int(np.prod(a.shape)) if a.shape else 1
+                meta.append((n, zero_pad_len(size, dp), np.dtype(a.dtype)))
+            self._resid_meta = meta
+        return self._resid_meta
+
+    def _ensure_resids(self):
+        """Error-feedback residuals in grad_names order: zeros on first
+        use (or after a shape change), row-sharded like the opt-state
+        leaves, then carried window to window via the donated call."""
+        if self._resid is None:
+            self._resid = {}
+        row = self._zero['row']
+        out = []
+        for n, L, dt in self._resid_specs():
+            r = self._resid.get(n)
+            if r is None or r.shape != (L,):
+                r = jax.device_put(np.zeros((L,), dt), row)
+            self._resid[n] = r
+            out.append(r)
+        return tuple(out)
+
+    def _publish_comm_gauges(self, cmode):
+        """comm.* gauges for the window just dispatched. The byte count
+        is the wire MODEL (comm.bytes_src='modeled'): in global-view
+        SPMD the partitioner moves the reduced gradient itself, so the
+        gauge is arithmetic over the leaf layout, not a socket counter
+        — the kvstore_dist path publishes the measured twin."""
+        if not _tele.enabled():
+            return
+        from ..parallel import compression
+        block = _compress_block()
+        total = unc = 0
+        for _n, L, dt in self._resid_specs():
+            total += compression.wire_bytes(L, cmode, block, dt.itemsize)
+            unc += compression.wire_bytes(L, 'off', block, dt.itemsize)
+        _tele.gauge('comm.bytes_on_wire_per_step').set(int(total))
+        _tele.gauge('comm.compression_ratio').set(
+            round(unc / max(total, 1), 3))
+        _tele.gauge('comm.mode').set(cmode)
+        _tele.gauge('comm.bytes_src').set('modeled')
+
+    def _note_compress_window(self, cmode, win_ms):
+        """Per-window compression bookkeeping: publish the comm gauges
+        and, on the first completed window after a mode flip (the auto
+        trigger engaging mid-run), emit the one-shot 'compression'
+        JSONL record carrying the before/after per-step wall delta."""
+        st = self._cstate
+        st['windows'] += 1
+        self._publish_comm_gauges(cmode)
+        prev, last_ms = st['mode'], st['ms']
+        W = self.window
+        if (prev is not None and cmode != prev and not st['emitted']
+                and st.get('flip') is None and last_ms is not None):
+            # the first window in the new mode pays the program
+            # rebuild + compile — hold the record until the next
+            # (steady-state) window so the after-side is honest
+            st['flip'] = {'prev': prev, 'to': cmode,
+                          'before_ms': last_ms}
+        elif (st.get('flip') is not None and not st['emitted']
+                and cmode == st['flip']['to']):
+            from ..parallel import compression
+            before = st['flip']['before_ms']
+            compression.emit_record(
+                event='mode_flip', mode=cmode,
+                prev_mode=st['flip']['prev'],
+                auto=compression.auto_engaged(),
+                step=int(st['windows'] * W),
+                before_step_ms=round(before / W, 3),
+                after_step_ms=round(win_ms / W, 3),
+                delta_step_ms=round((win_ms - before) / W, 3))
+            st['emitted'] = True
+        st['mode'], st['ms'] = cmode, win_ms
 
     # -- per-epoch drive ---------------------------------------------------
     def _snapshot(self):
@@ -1325,11 +1525,16 @@ class FusedFitLoop:
                 attrs_key = tuple(sorted(static_attrs.items()))
                 shapes_key = tuple((tuple(d.shape), str(d.dtype))
                                    for d in snaps[0][0])
-                prog_key = (attrs_key, shapes_key, self._defer_sig)
+                # resolved compression mode is part of the program key:
+                # an auto flip (cluster verdict) lands here as a new
+                # key and rebuilds the window at this dispatch edge
+                cmode = self._cmode()
+                prog_key = (attrs_key, shapes_key, self._defer_sig,
+                            cmode)
                 if prog_key not in self._programs:
                     with _tele.span('fused_fit.build', 'fused_fit'):
                         self._programs[prog_key] = self._build_program(
-                            static_attrs, shapes_key)
+                            static_attrs, shapes_key, cmode)
                     # same-key rebuilds only happen when the program dict
                     # was torn down; the storm detector keys on the
                     # SHAPES — a shape/attr leaking into attrs_key shows
@@ -1369,9 +1574,18 @@ class FusedFitLoop:
                     _t = _now
                 with _tele.span('fused_fit.dispatch', 'fused_fit'):
                     self._base_key = _random.next_key()
-                    params, states, aux, gaccs, pieces = window_fn(
-                        params, states, aux, gaccs, data_stack, label_stack,
-                        self._base_key, lr_arr, wd_arr)
+                    if cmode != 'off':
+                        resids = self._ensure_resids()
+                        (params, states, aux, gaccs, resids,
+                         pieces) = window_fn(
+                            params, states, aux, gaccs, resids,
+                            data_stack, label_stack,
+                            self._base_key, lr_arr, wd_arr)
+                        self._resid = dict(zip(self._grad_names, resids))
+                    else:
+                        params, states, aux, gaccs, pieces = window_fn(
+                            params, states, aux, gaccs, data_stack,
+                            label_stack, self._base_key, lr_arr, wd_arr)
                     self._writeback(params, states, aux, gaccs)
                 _tele.counter('fit.steps').inc(self.window)
                 _tele.counter('fused_fit.windows').inc()
@@ -1404,14 +1618,19 @@ class FusedFitLoop:
                     nbatch = apply_stats(pending[0], pending[1], nbatch,
                                          pending[2])
                 pending = (pieces, labels_snap, win_snaps)
+                # one wall observation per window (window-edge to
+                # window-edge): in steady state the loop is device-
+                # bound, so wall / W IS the per-step time — health's
+                # step-time stream and the compression flip record's
+                # before/after delta both read it
+                _now = _clk()
+                _win_wall = _now - _t_win
+                _t_win = _now
                 if health_on:
-                    # one step-time observation per window (wall / W):
-                    # in steady state the loop is device-bound, so the
-                    # iteration wall IS the per-step time
-                    _now = _clk()
-                    _tele.health.note_step_time(_now - _t_win,
+                    _tele.health.note_step_time(_win_wall,
                                                 steps=self.window)
-                    _t_win = _now
+                if self._zero is not None:
+                    self._note_compress_window(cmode, _win_wall * 1e3)
                 if ckpt is not None:
                     lag = self.window
                     if pending is not None and ckpt.save_due(self.window):

@@ -33,6 +33,8 @@ from .multihost import (init_multihost, global_mesh, process_index,
                         process_count, is_multihost)
 from .five_d import (TransformerConfig, full_mesh, make_5d_train_step,
                      make_loss_fn as make_5d_loss_fn)
+from . import compression  # noqa: F401  (quantized gradient collectives)
+from .compression import compressed_psum
 
 __all__ = [
     'DeviceMesh', 'make_mesh', 'local_mesh',
@@ -50,4 +52,5 @@ __all__ = [
     'make_5d_loss_fn',
     'init_multihost', 'global_mesh', 'process_index', 'process_count',
     'is_multihost',
+    'compression', 'compressed_psum',
 ]

@@ -452,3 +452,8 @@ def _reset_for_tests():
     dynamics._reset_for_tests()
     ledger._reset_for_tests()
     goodput._reset_for_tests()
+    try:
+        from ..parallel import compression
+        compression._reset_for_tests()
+    except Exception:  # noqa: BLE001 — parallel may not be importable
+        pass

@@ -350,6 +350,17 @@ def sync_now():
     # matrix is identical everywhere, so every host derives the same
     # shift) — the process-0 gate below only guards publication
     _elastic_decide(mat, steps)
+    # gradient-compression auto trigger: MXTPU_GRAD_COMPRESS=auto
+    # flips to int8 when a round reads communication_bound. Decided on
+    # EVERY host from the identical matrix (same contract as the
+    # elastic decision) — no extra collective, and every gang member
+    # rebuilds its window program at the same dispatch edge
+    try:
+        from ..parallel import compression
+        compression.note_round_verdict(round_verdict(mat)[2])
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('telemetry.cluster: compression trigger failed: '
+                      '%s', e)
     try:
         import jax
         me = jax.process_index()

@@ -50,6 +50,7 @@ from mxnet_tpu import io as mio                  # noqa: E402
 from mxnet_tpu import telemetry                  # noqa: E402
 from mxnet_tpu.module import checkpointing as mckpt   # noqa: E402
 from mxnet_tpu.parallel import checkpoint as ckpt     # noqa: E402
+from mxnet_tpu.parallel import compression            # noqa: E402
 from mxnet_tpu.parallel import multihost as mh        # noqa: E402
 from mxnet_tpu.parallel.sharding import (             # noqa: E402
     zero_flatten, zero_pad_len, zero_unflatten)
@@ -109,18 +110,30 @@ def main():
     w = jax.device_put(jnp.zeros((FEATURES,), jnp.float32), rep)
     m = jax.device_put(jnp.zeros((L,), jnp.float32), row)
 
-    def step_fn(w, m, x, y):
+    # MXTPU_GRAD_COMPRESS drives the compressed-collective arm of the
+    # chaos lane: the flat dp-sharded gradient goes through the
+    # quantize->dequantize EF round-trip (parallel/compression.py) with
+    # the residual carried like an optimizer-state leaf — the exact
+    # numerics a wire deployment computes, same-seed comparable against
+    # the uncompressed run via tools/run_compare.py.
+    cmode = compression.resolved_mode()
+    r = jax.device_put(jnp.zeros((L,), jnp.float32), row)
+
+    def step_fn(w, m, r, x, y):
         def loss_fn(w):
             return jnp.mean((x @ w - y) ** 2)
         loss, g = jax.value_and_grad(loss_fn)(w)
-        m2 = MOMENTUM * m + zero_flatten(g, dp)
+        gf = zero_flatten(g, dp)
+        if cmode != 'off':
+            gf, r = compression.ef_roundtrip(gf, r, cmode)
+        m2 = MOMENTUM * m + gf
         w2 = w - LR * zero_unflatten(m2, (FEATURES,))
-        return w2, m2, loss
+        return w2, m2, r, loss
 
     jstep = jax.jit(step_fn,
-                    in_shardings=(rep, row, data_sh, row),
-                    out_shardings=(rep, row, rep),
-                    donate_argnums=(1,))
+                    in_shardings=(rep, row, row, data_sh, row),
+                    out_shardings=(rep, row, row, rep),
+                    donate_argnums=(1, 2))
 
     start_step = 0
     mngr = None
@@ -169,10 +182,15 @@ def main():
                 Y[lo:lo + per_host], mesh, P('dp'))
             # the fault seams a supervised production step crosses
             faults.maybe_raise('dispatch')
-            w, m, loss = jstep(w, m, gx, gy)
+            w, m, r, loss = jstep(w, m, r, gx, gy)
             faults.note_steps(1)
             telemetry.watchdog.note_progress('gang_fit.step')
             telemetry.cluster.note_step(1)
+            if telemetry.enabled():
+                # scalars ledger (MXTPU_SCALARS_EVERY) — what
+                # tools/run_compare.py diffs the compressed arm against
+                telemetry.ledger.note_train_step(
+                    loss=float(np.asarray(loss)))
             done = s + 1
             if mngr is not None and done % args.ckpt_every == 0 \
                     and done < args.steps:
@@ -199,12 +217,16 @@ def main():
                     assert agreed == done, (agreed, done)
 
     loss_f = float(np.asarray(loss))
+    comm_bytes = compression.wire_bytes(L, cmode)
+    compression.publish_gauges(L, cmode, 'modeled')
     if os.environ.get('GANG_ASSERT_CLUSTER') == '1':
         _assert_cluster(rank, nproc)
     if args.out:
         np.save('%s.h%d.npy' % (args.out, rank), np.asarray(w))
-    print('GANG_FIT_OK rank=%d procs=%d steps=%d loss=%.6f'
-          % (rank, nproc, args.steps, loss_f), flush=True)
+    print('GANG_FIT_OK rank=%d procs=%d steps=%d loss=%.6f '
+          'compress=%s comm_bytes=%d'
+          % (rank, nproc, args.steps, loss_f, cmode, comm_bytes),
+          flush=True)
 
 
 def _assert_cluster(rank, nproc):

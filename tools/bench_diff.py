@@ -38,6 +38,9 @@ Compared metrics, with direction and default tolerance:
   telemetry/goodput.py)                    — lower is a regression (5%:
   the same throughput with more time lost to compile/input/checkpoint
   badput is a worse run even when the step time held)
+- ``bytes_on_wire_per_step`` (gradient bytes per sync step, the
+  quantized-collectives plane)             — higher is a regression (10%:
+  the collective traffic regrew, e.g. compression silently disengaged)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -60,15 +63,18 @@ _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 10.0,
             'xla_live_bytes': 10.0,
             'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0,
             'serving_p99_ms': 10.0, 'serving_queue_wait_p50_ms': 10.0,
-            'final_loss': 5.0, 'goodput_pct': 5.0}
+            'final_loss': 5.0, 'goodput_pct': 5.0,
+            'bytes_on_wire_per_step': 10.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
               'xla_live_bytes': +1,
               'opt_state_bytes_per_device': +1, 'compile_s': +1,
               'serving_p99_ms': +1, 'serving_queue_wait_p50_ms': +1,
-              'final_loss': +1, 'goodput_pct': -1}
+              'final_loss': +1, 'goodput_pct': -1,
+              'bytes_on_wire_per_step': +1}
 _ORDER = ('throughput', 'mfu', 'xla_temp_bytes', 'xla_live_bytes',
           'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms',
-          'serving_queue_wait_p50_ms', 'final_loss', 'goodput_pct')
+          'serving_queue_wait_p50_ms', 'final_loss', 'goodput_pct',
+          'bytes_on_wire_per_step')
 
 
 def load_bench(path):
@@ -164,6 +170,13 @@ def extract(rec):
     # process's wall-clock — a DROP is the regression (more badput)
     if rec.get('goodput_pct') is not None:
         out['goodput_pct'] = float(rec['goodput_pct'])
+    # gradient bytes per sync step (parallel/compression.py): a RISE
+    # means the collective traffic regrew — e.g. quantization silently
+    # disengaged. Improvements (compression landing) never fail; a
+    # baseline that predates the gauge is a visible skip.
+    if rec.get('bytes_on_wire_per_step') is not None:
+        out['bytes_on_wire_per_step'] = \
+            float(rec['bytes_on_wire_per_step'])
     return out
 
 

@@ -182,6 +182,21 @@ def render(summary, steps_per_s=None, reqs_per_s=None):
             bits.append('step collectives %s%%'
                         % _fmt(float(g['roofline.comm_pct_of_step'])))
         lines.append('  opt_state    %s' % ', '.join(bits))
+    # quantized gradient collectives (MXTPU_GRAD_COMPRESS): bytes per
+    # sync step + ratio + mode, with the provenance spelled out —
+    # 'measured' is real kvstore wire traffic, 'modeled' is the SPMD
+    # window's arithmetic over the leaf layout
+    if g.get('comm.bytes_on_wire_per_step') is not None:
+        bits = ['%.2f MiB/step'
+                % (float(g['comm.bytes_on_wire_per_step']) / 2.0**20)]
+        if g.get('comm.compression_ratio') is not None:
+            bits.append('%sx compressed'
+                        % _fmt(float(g['comm.compression_ratio'])))
+        if g.get('comm.mode'):
+            bits.append('mode %s' % g['comm.mode'])
+        if g.get('comm.bytes_src'):
+            bits.append('(%s)' % g['comm.bytes_src'])
+        lines.append('  comm         %s' % ', '.join(bits))
     # per-layer training dynamics (MXTPU_DYNAMICS): the layer changing
     # fastest relative to its size + the deadest output, straight from
     # the decimated dynamics.* gauges
