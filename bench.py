@@ -1072,6 +1072,18 @@ def _telemetry_breakdown(device, step_ms=None):
             top_n = _tele.roofline.TOP_N
             tel['roofline'] = dict(roof, layers=roof['layers'][:top_n],
                                    n_layers=len(roof['layers']))
+        # memory attribution (ISSUE 19): per-layer HBM shares + the
+        # headroom/steps-to-OOM forecast — same truncation treatment;
+        # per-program peak bytes ride the programs dict above
+        mem = _tele.memory.summarize()
+        if mem:
+            lay = mem.get('layers') or []
+            tel['memory'] = dict(mem, layers=lay[:_tele.memory.TOP_N],
+                                 n_layers=len(lay))
+            if mem.get('peaks') and tel.get('programs'):
+                for n, pk in mem['peaks'].items():
+                    if n in tel['programs']:
+                        tel['programs'][n]['peak_bytes'] = int(pk)
         # goodput attribution (ISSUE 16): where this process's wall-
         # clock went, bucketed — AFTER roofline.summarize so the comm
         # bucket reads the just-published provenance-labeled share
@@ -1099,6 +1111,11 @@ def main():
     # achieved-vs-peak classification + collective accounting fold into
     # the emitted JSON below. setdefault: an explicit =0 still wins.
     os.environ.setdefault('MXTPU_ROOFLINE', '1')
+    # memory plane rides every bench run (ISSUE 19): per-layer HBM
+    # attribution + headroom forecast fold into the emitted JSON below,
+    # and bench_diff gates the headroom. setdefault: an explicit =0
+    # still wins.
+    os.environ.setdefault('MXTPU_MEMORY', '1')
     if os.environ.get('MXTPU_BENCH_DIRECT'):
         # child of a successful late reprobe: init the default backend
         # straight away (the parent just verified it is healthy)
@@ -1407,6 +1424,12 @@ def main():
             out['goodput'] = {'buckets': good.get('buckets'),
                               'badput_top': good.get('badput_top'),
                               'wall_s': good.get('wall_s')}
+        # top-level copy of the headroom gate (bench_diff gates
+        # mem_headroom_pct: lower = regression) — a program that grew
+        # its footprint shows up as a shrunken safety margin here
+        mem = tel.get('memory') or {}
+        if mem.get('headroom_pct') is not None:
+            out['mem_headroom_pct'] = mem['headroom_pct']
         # top-level copy of the wire-byte gate (bench_diff gates
         # bytes_on_wire_per_step: higher = regression)
         if tel.get('bytes_on_wire_per_step') is not None:

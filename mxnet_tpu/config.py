@@ -362,6 +362,26 @@ flags.declare('MXTPU_ROOFLINE', bool, False,
               'table, and account collective bytes/time/overlap per '
               'step. Off = no HLO text is ever rendered or parsed (one '
               'cached-bool check at the program registrar)')
+flags.declare('MXTPU_MEMORY', bool, False,
+              'HBM attribution & forecast plane '
+              '(mxnet_tpu/telemetry/memory.py, requires '
+              'MXTPU_TELEMETRY=1): attribute every registered '
+              "program's argument/temp/output/alias bytes to named "
+              'layers (HLO buffer parse calibrated against '
+              "XLA's own memory_analysis totals), keep a bounded "
+              'live-bytes ring sampled at the scalars cadence, and '
+              'forecast steps-to-OOM — a forecast at or below '
+              'MXTPU_MEMORY_OOM_STEPS flips /healthz to mem_pressure '
+              'and dumps the flight recorder BEFORE the allocator '
+              'dies. Off = no HLO text is ever rendered or parsed and '
+              'no ring is filled (one cached-bool check at the '
+              'registrar and the step loops)')
+flags.declare('MXTPU_MEMORY_OOM_STEPS', int, 200,
+              'mem_pressure threshold for the MXTPU_MEMORY forecaster: '
+              'a linear steps-to-OOM forecast at or below this many '
+              'steps trips the alarm (healthz mem_pressure + the '
+              'flight-mem-pressure dump). Forecasts above it only '
+              'publish the mem.steps_to_oom gauge', min_value=1)
 flags.declare('MXTPU_ROOFLINE_TRACE', str, '',
               'Path to a jax.profiler capture (directory, or a '
               '*.trace.json[.gz] file) supplying the roofline\'s '

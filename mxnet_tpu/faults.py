@@ -29,6 +29,13 @@ is exercised by real failures instead of mocks. Kinds:
 - ``slow-host:<k>[:<ms>]`` — sleep ``ms`` (default 50) per training
   step from step k on, persistently: this host becomes the straggler
   the cluster telemetry names. Never disarms.
+- ``mem-hog:<k>[:<mb>]`` — allocate and retain ``mb`` MiB (default 8)
+  of device memory per training step from step k on, persistently:
+  deterministic host-side allocation growth (a leak's shape) at the
+  same step-counter seam slow-host uses. The MXTPU_MEMORY forecaster
+  is what should notice — steps-to-OOM shrinking, /healthz flipping to
+  ``mem_pressure``, the flight recorder dumped — before the allocator
+  dies. Never disarms; the compiled programs are untouched.
 - ``hang:<k>[:<secs>]`` — wedge the first dispatch seam that reaches
   step k by sleeping ``secs`` (default 3600) in place: the shape of a
   collective waiting on a dead peer or a tunneled dispatch that never
@@ -66,9 +73,12 @@ __all__ = ['FaultInjected', 'HOST_LOSS_EXIT_CODE', 'enabled', 'spec',
            'maybe_raise', 'maybe_corrupt_checkpoint']
 
 KINDS = ('nan-grad', 'checkpoint-corrupt', 'dispatch-exception',
-         'backend-probe-timeout', 'slow-host', 'hang', 'host-loss')
+         'backend-probe-timeout', 'slow-host', 'hang', 'host-loss',
+         'mem-hog')
 
 _SLOW_DEFAULT_MS = 50.0
+_HOG_DEFAULT_MB = 8.0
+_hog = []   # mem-hog's retained device allocations (the leak itself)
 _HANG_DEFAULT_SECS = 3600.0
 HOST_LOSS_EXIT_CODE = 113   # distinct from the watchdog's 85
 
@@ -189,18 +199,36 @@ def spec():
 def note_steps(n=1):
     """Advance the trained-step counter (fed by the fit loops at the
     same sites that count fit.steps). An armed ``slow-host`` fault
-    sleeps here once the counter passes its step."""
+    sleeps here once the counter passes its step; an armed ``mem-hog``
+    allocates-and-retains here — both persist, never disarm."""
     if not enabled():
         return
     with _state.lock:
         _state.steps += n
         slow = (_state.kind == 'slow-host' and _state.steps > _state.step)
+        hog = (_state.kind == 'mem-hog' and _state.steps > _state.step)
     if slow:
         try:
             ms = float(_state.arg) if _state.arg else _SLOW_DEFAULT_MS
         except ValueError:
             ms = _SLOW_DEFAULT_MS
         time.sleep(n * ms / 1e3)
+    if hog:
+        try:
+            mb = float(_state.arg) if _state.arg else _HOG_DEFAULT_MB
+        except ValueError:
+            mb = _HOG_DEFAULT_MB
+        try:
+            import jax.numpy as jnp
+            # n steps' worth of leak, committed to the device so the
+            # allocator's bytes_in_use actually climbs (block_until_
+            # ready: a never-dispatched lazy array leaks nothing)
+            arr = jnp.zeros((max(1, int(n * mb * 2**20 / 4)),),
+                            jnp.float32)
+            _hog.append(arr.block_until_ready())
+        except Exception as e:  # noqa: BLE001 — a chaos harness must
+            logging.warning(                   # not crash the run itself
+                'fault injection: mem-hog allocation failed: %s', e)
 
 
 def _poison(arr):
@@ -364,3 +392,4 @@ def maybe_corrupt_checkpoint(directory, step):
 def _reset_for_tests():
     global _state
     _state = _FState()
+    _hog.clear()

@@ -312,6 +312,9 @@ class BaseModule:
         # feed the stall monitor; off = one cached-bool check here and
         # no call in the loop
         wd_on = _tele.watchdog.enabled()
+        # live-bytes timeline (telemetry/memory): one cached-bool check
+        # here, a host-side allocator sample at the scalars cadence
+        mem_on = _tele.memory.enabled()
 
         try:
             for epoch in range(begin_epoch, num_epoch):
@@ -411,6 +414,8 @@ class BaseModule:
                         ckpt.note_steps(1)
                     if faults_on:
                         _faults.note_steps(1)
+                    if mem_on:
+                        _tele.memory.note_step(1)
                     nbatch += 1
 
                 self._fit_epoch_end(epoch, eval_metric, tic,

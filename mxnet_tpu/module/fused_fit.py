@@ -1498,6 +1498,7 @@ class FusedFitLoop:
 
         health_on = self._health_fn is not None
         cluster_on = _tele.cluster.enabled()
+        mem_on = _tele.memory.enabled()
         _t_win = _clk()   # wall clock per dispatched window (health)
         batches, snaps = collect()
         if not batches:
@@ -1649,6 +1650,11 @@ class FusedFitLoop:
                     ckpt.note_steps(self.window, lag=lag)
                 if faults_on:
                     _faults.note_steps(self.window)
+                if mem_on:
+                    # live-bytes timeline (MXTPU_MEMORY): a host-side
+                    # allocator query at the scalars cadence, no
+                    # device sync
+                    _tele.memory.note_step(self.window)
                 if _timing:
                     _tm['fetch'] += _clk() - _t
         finally:

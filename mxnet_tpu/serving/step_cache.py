@@ -65,6 +65,7 @@ class StepCache:
         assert self.capacity >= 1
         self._slots = collections.OrderedDict()   # session -> slot (LRU)
         self._free = list(range(self.capacity - 1, -1, -1))
+        self.evictions = 0
         self._lock = threading.Lock()
 
     def lookup(self, session_ids):
@@ -86,9 +87,15 @@ class StepCache:
                     else:
                         evicted, slot = self._slots.popitem(last=False)
                         _tele.counter('serve.session_evictions').inc()
+                        self.evictions += 1
                 self._slots[sid] = slot        # most-recently-used end
                 slots[i] = slot
             _tele.gauge('serve.sessions_live').set(len(self._slots))
+            # the memory plane's serving-pressure view: live sessions
+            # and cumulative evictions as gauges (an eviction-heavy
+            # cache under a flat session count reads as churn)
+            _tele.gauge('serve.sessions').set(len(self._slots))
+            _tele.gauge('serve.evictions').set(self.evictions)
         return slots, fresh
 
     def drop(self, session_id):
@@ -98,6 +105,7 @@ class StepCache:
             if slot is not None:
                 self._free.append(slot)
             _tele.gauge('serve.sessions_live').set(len(self._slots))
+            _tele.gauge('serve.sessions').set(len(self._slots))
         return slot is not None
 
     def sessions(self):
@@ -151,6 +159,10 @@ class DecodeEngine(_SingleExecutorEngine):
             (self._store,) = place_replicated(self._mesh, self._store)
             self._store = list(self._store)
         self.cache = StepCache(self.capacity)
+        # the device-resident session ring's footprint — serving's
+        # standing claim on HBM, next to mem.* in the memory plane
+        _tele.gauge('serve.ring_bytes').set(
+            int(sum(int(s.nbytes) for s in self._store)))
 
     # -- program -----------------------------------------------------------
     def _build_program(self, bucket):

@@ -132,12 +132,13 @@ from . import flight  # noqa: F401  (public submodule: telemetry.flight.*)
 from . import dynamics  # noqa: F401  (public submodule: telemetry.dynamics.*)
 from . import ledger  # noqa: F401  (public submodule: telemetry.ledger.*)
 from . import goodput  # noqa: F401  (public submodule: telemetry.goodput.*)
+from . import memory  # noqa: F401  (public submodule: telemetry.memory.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
            'programs', 'health', 'cluster', 'serve', 'roofline',
            'watchdog', 'trace', 'slo', 'flight', 'dynamics', 'ledger',
-           'goodput', 'get_registry']
+           'goodput', 'memory', 'get_registry']
 
 
 class _State:
@@ -350,7 +351,8 @@ def summary():
                                  cluster=cluster.snapshot_cluster(),
                                  roofline=roofline.snapshot_roofline(),
                                  ledger=ledger.snapshot_ledger(),
-                                 goodput=goodput.current())
+                                 goodput=goodput.current(),
+                                 memory=memory.snapshot_memory())
 
 
 def write_summary(log=True):
@@ -371,6 +373,9 @@ def write_summary(log=True):
     # gauges + the roofline JSONL record; must run before the snapshot
     # below so the gauges land in the summary record too
     rsnap = roofline.summarize()
+    # memory attribution + forecast (MXTPU_MEMORY): publishes mem.*
+    # gauges + the full memory JSONL record, same contract as roofline
+    msnap = memory.summarize()
     csnap = cluster.snapshot_cluster()
     lsnap = ledger.snapshot_ledger()
     elapsed = time.time() - _state.t_start
@@ -396,12 +401,14 @@ def write_summary(log=True):
             rec['ledger'] = lsnap
         if gsnap:
             rec['goodput'] = gsnap
+        if msnap:
+            rec['memory'] = msnap
         _state.sink.emit(rec)
         _state.sink.flush()
     table = _export.summary_table(snap, elapsed, programs=progs or None,
                                   health=hsnap, cluster=csnap,
                                   roofline=rsnap, ledger=lsnap,
-                                  goodput=gsnap)
+                                  goodput=gsnap, memory=msnap)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -452,6 +459,7 @@ def _reset_for_tests():
     dynamics._reset_for_tests()
     ledger._reset_for_tests()
     goodput._reset_for_tests()
+    memory._reset_for_tests()
     try:
         from ..parallel import compression
         compression._reset_for_tests()

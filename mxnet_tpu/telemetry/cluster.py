@@ -64,10 +64,14 @@ __all__ = ['enabled', 'host_index', 'set_host', 'note_step', 'sync_now',
 # telemetry.goodput.BUCKETS index, and 'comm_src' the comm_pct sample's
 # provenance (1.0 = measured from a joined trace, 0.0 = roofline
 # modeled, NaN = no sample) — so the communication_bound verdict can
-# never launder a model into a measurement
+# never launder a model into a measurement. 'mem_headroom_pct' rode in
+# with the memory plane (appended at the end, same stability rule):
+# each host's latest device-byte headroom %, NaN while MXTPU_MEMORY is
+# off or no sample carries a byte limit — process 0 names the most
+# memory-pressured host from it
 SYNC_KEYS = ('step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes',
              'comm_pct', 'proc_index', 'goodput_pct', 'badput_top',
-             'comm_src')
+             'comm_src', 'mem_headroom_pct')
 
 _SPREAD_BALANCED_PCT = 5.0   # step-time spread below this = no straggler
 _COMM_BOUND_PCT = 30.0       # collective share of the step above which a
@@ -243,11 +247,16 @@ def _local_stats():
     # per-bucket culprit named
     from . import goodput
     good_pct, badput_idx = goodput.local_stats()
+    # the memory plane's contribution: this host's latest headroom %
+    # (NaN while off / no limit) — the fleet's min names the most
+    # memory-pressured host
+    from . import memory
     return [step_ms, float(io_pct), float(disp), live,
             float(comm) if comm is not None else float('nan'), proc,
             good_pct, badput_idx,
             float('nan') if comm_src is None
-            else (1.0 if comm_src == 'measured' else 0.0)]
+            else (1.0 if comm_src == 'measured' else 0.0),
+            memory.local_headroom()]
 
 
 def _allgather(vals):
@@ -337,6 +346,14 @@ def sync_now():
     except Exception as e:  # noqa: BLE001 — observability must not kill
         logging.debug('telemetry.cluster: roofline republish failed: %s',
                       e)
+    # same contract for the memory plane's mem.* gauges (read-only
+    # analysis, no JSONL record)
+    from . import memory
+    try:
+        memory.republish()
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('telemetry.cluster: memory republish failed: %s',
+                      e)
     try:
         mat = _allgather(_local_stats())
     except Exception as e:  # noqa: BLE001 — observability must not kill
@@ -425,6 +442,9 @@ def _publish(mat, steps):
         if row['goodput_pct'] is not None:
             reg.gauge('cluster.h%d.goodput_pct' % hid).set(
                 row['goodput_pct'])
+        if row.get('mem_headroom_pct') is not None:
+            reg.gauge('cluster.h%d.mem_headroom_pct' % hid).set(
+                row['mem_headroom_pct'])
     slowest_row, spread, straggler = round_verdict(mat)
     slowest = host_ids[slowest_row] if slowest_row is not None else None
     reg.gauge('cluster.hosts').set(n)
@@ -448,6 +468,17 @@ def _publish(mat, steps):
         reg.gauge('cluster.goodput_culprit').set(culprit)
         snap['fleet_goodput_pct'] = round(fleet, 2)
         snap['goodput_culprit'] = culprit
+    # fleet memory headroom = the TIGHTEST host's (the first allocator
+    # to die takes the lockstep gang with it), with that host named
+    heads = [(r['mem_headroom_pct'], r['host']) for r in per_host
+             if r.get('mem_headroom_pct') is not None]
+    if heads:
+        fleet_head, m_host = min(heads)
+        reg.gauge('cluster.fleet_mem_headroom_pct').set(
+            round(fleet_head, 2))
+        reg.gauge('cluster.mem_pressured_host').set(m_host)
+        snap['fleet_mem_headroom_pct'] = round(fleet_head, 2)
+        snap['mem_pressured_host'] = m_host
     with _state.lock:
         _state.snapshot = snap
     if st.sink is not None:

@@ -5,7 +5,8 @@ greps after the fact: one JSON object per line, each with a ``type``
 ('start', 'span', 'compile', 'cache_hit', 'retrace_storm', 'event',
 'program', 'oom', 'health', 'anomaly', 'cluster', 'restart', 'hang',
 'elastic', 'roofline', 'trace', 'slo', 'flight', 'manifest',
-'scalars', 'dynamics', 'goodput', 'summary') and a ``t`` epoch-seconds
+'scalars', 'dynamics', 'goodput', 'memory', 'summary') and a ``t``
+epoch-seconds
 stamp —
 the full list is documented (and lint-gated) under
 MXTPU_TELEMETRY_PATH in docs/env_vars.md. Records buffer in memory and flush every
@@ -263,6 +264,62 @@ def _roofline_lines(roof):
     return lines
 
 
+def _memory_lines(mem):
+    """The "memory" block (telemetry.memory.analyze()'s dict): the
+    ranked per-layer peak attribution — args/temp/out/alias bytes,
+    calibrated to memory_analysis totals — plus the live-bytes
+    timeline and the steps-to-OOM forecast. Rendered deterministically
+    from the dict alone so the offline CLI (tools/memory_report.py)
+    reproduces the live block byte-for-byte from the JSONL record."""
+    from .memory import TOP_N
+    prog = mem.get('program')
+    lines = ['-- memory: %s --' % prog if prog else '-- memory --']
+    layers = mem.get('layers') or []
+    if layers:
+        w = max(max(len(str(r.get('layer', '?'))) for r in layers[:TOP_N]),
+                len('layer'))
+        lines.append('  %-*s  %9s %9s %9s %9s %10s'
+                     % (w, 'layer', 'args_MiB', 'temp_MiB', 'out_MiB',
+                        'alias_MiB', 'total_MiB'))
+        for r in layers[:TOP_N]:
+            lines.append('  %-*s  %9s %9s %9s %9s %10s'
+                         % (w, r.get('layer', '?'),
+                            _mib(r.get('args') or 0),
+                            _mib(r.get('temp') or 0),
+                            _mib(r.get('out') or 0),
+                            _mib(r.get('alias') or 0),
+                            _mib(r.get('total') or 0)))
+        if len(layers) > TOP_N:
+            lines.append('  (+%d more layers)' % (len(layers) - TOP_N))
+    if mem.get('live_bytes') is not None:
+        lines.append('  program_live      %s MiB (args %s + temp %s + '
+                     'out %s - alias %s)'
+                     % (_mib(mem['live_bytes']),
+                        _mib(mem.get('args_bytes') or 0),
+                        _mib(mem.get('temp_bytes') or 0),
+                        _mib(mem.get('output_bytes') or 0),
+                        _mib(mem.get('alias_bytes') or 0)))
+    if mem.get('bytes_in_use') is not None:
+        line = '  device_bytes      %s MiB' % _mib(mem['bytes_in_use'])
+        if mem.get('bytes_limit'):
+            line += ' of %s MiB' % _mib(mem['bytes_limit'])
+        if mem.get('headroom_pct') is not None:
+            line += ' (headroom %s%%)' % _fmt(float(mem['headroom_pct']))
+        if mem.get('samples'):
+            line += ', %d samples' % int(mem['samples'])
+        lines.append(line)
+    if mem.get('slope_bytes_per_step') is not None:
+        line = ('  forecast          %+.0f bytes/step'
+                % float(mem['slope_bytes_per_step']))
+        if mem.get('steps_to_oom') is not None:
+            line += ' -> ~%d steps to OOM' % int(mem['steps_to_oom'])
+        lines.append(line)
+    if mem.get('pressure'):
+        lines.append('  pressure          MEM_PRESSURE (forecast at or '
+                     'below MXTPU_MEMORY_OOM_STEPS)')
+    return lines
+
+
 def _ledger_lines(led):
     """The "run ledger" block (telemetry.ledger.snapshot_ledger's
     dict): the manifest roll-up, the scalar cadence and the last
@@ -376,7 +433,8 @@ def _cluster_lines(cluster):
 
 
 def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
-                  cluster=None, roofline=None, ledger=None, goodput=None):
+                  cluster=None, roofline=None, ledger=None, goodput=None,
+                  memory=None):
     """Registry snapshot -> aligned text table (one block per kind).
     ``programs`` is telemetry.programs.snapshot_programs()'s {name:
     record} — rendered as a per-program cost table (and the redundant
@@ -393,7 +451,9 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
     ``dynamics.*`` per-layer gauges stay in the gauges block);
     ``goodput`` is telemetry.goodput.summarize()'s dict — rendered as
     the "Where the time went" block (the ``goodput.*`` gauges are
-    elided the same way)."""
+    elided the same way); ``memory`` is telemetry.memory.analyze()'s
+    dict — rendered as the per-layer-peak "memory" block (the
+    ``mem.*`` gauges are elided the same way)."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
@@ -415,6 +475,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
         # the "Where the time went" block already carries these values
         gauges = {n: v for n, v in gauges.items()
                   if not n.startswith('goodput.')}
+    if memory:
+        # the memory block already carries these values
+        gauges = {n: v for n, v in gauges.items()
+                  if not n.startswith('mem.')}
     if counters:
         lines.append('-- counters --')
         w = max(len(n) for n in counters)
@@ -443,6 +507,8 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
                           _mib(r.get('output_bytes', 0))))
     if roofline:
         lines.extend(_roofline_lines(roofline))
+    if memory:
+        lines.extend(_memory_lines(memory))
     if goodput:
         lines.extend(_goodput_lines(goodput))
     if cluster:

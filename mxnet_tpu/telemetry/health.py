@@ -440,6 +440,16 @@ def _observe(name, value):
     a = detector(name).observe(value)
     if a is None:
         return None
+    return publish_anomaly(a)
+
+
+def publish_anomaly(a):
+    """Publish one pre-built anomaly dict (counter + ``anomaly`` JSONL
+    record + the last-anomaly state): the shared tail of
+    :func:`_observe`, also used by detectors living in other planes —
+    the memory plane's ``mem_growth`` feeds its observations itself and
+    publishes only upward excursions through here."""
+    name = a['detector']
     reg = _tele().registry
     reg.counter('health.anomalies').inc()
     reg.counter('health.anomalies.%s' % name).inc()

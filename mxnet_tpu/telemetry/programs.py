@@ -122,6 +122,12 @@ def note_program(name, compiled=None, analysis=None, step_flops=False,
         if roofline.enabled():
             roofline.note_compiled(name, compiled, analysis=analysis,
                                    step_flops=step_flops)
+        # memory attribution (MXTPU_MEMORY): same contract — parse the
+        # HLO into per-layer buffer bytes while the executable is in
+        # hand, one cached-bool check when the flag is off
+        from . import memory
+        if memory.enabled():
+            memory.note_compiled(name, compiled, analysis=analysis)
     with _lock:
         rec = _programs.get(name)
         if rec is None:
@@ -356,8 +362,19 @@ def maybe_oom_report(exc):
     if st.sink is not None:
         clean_stats = {k: v for k, v in (stats or {}).items()
                        if isinstance(v, (int, float, str, bool))}
-        st.sink.emit({'type': 'oom', 'error': msg[:500],
-                      'programs': progs, 'memory_stats': clean_stats})
+        rec = {'type': 'oom', 'error': msg[:500],
+               'programs': progs, 'memory_stats': clean_stats}
+        # cross-link what the MXTPU_MEMORY forecaster last said before
+        # the allocator died — the post-mortem's "was this predicted?"
+        try:
+            from . import memory
+            fc = memory.last_forecast()
+            if fc:
+                rec['last_forecast'] = {k: v for k, v in fc.items()
+                                        if k != 'type'}
+        except Exception:  # noqa: BLE001 — forensics must not add a crash
+            pass
+        st.sink.emit(rec)
         st.sink.flush()
     # flight recorder: what the process was doing in the records
     # before the allocation failed

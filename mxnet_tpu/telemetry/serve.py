@@ -160,31 +160,38 @@ def render_prometheus(snapshot, host=None):
 def healthz_payload():
     """(ok, digest) for /healthz. ``ok`` flips False — the endpoint
     answers 503 — once a non-finite incident is on record, the hang
-    watchdog says the loop is stalled right now, OR the SLO plane's
-    error budget is burning (telemetry/slo.py). The three unhealthy
-    states are DISTINCT (``degraded`` / ``hung`` / ``slo_degraded``)
-    so a supervisor or load balancer can choose its reaction: evict a
-    hung replica, page on slo_degraded, keep a warn-action NaN run
-    visible. The digest carries the health snapshot, the active hang
-    digest, the SLO snapshot and the last cluster round; hang and SLO
+    watchdog says the loop is stalled right now, the SLO plane's
+    error budget is burning (telemetry/slo.py), OR the memory plane's
+    steps-to-OOM forecast is at/below threshold (telemetry/memory.py).
+    The unhealthy states are DISTINCT (``degraded`` / ``hung`` /
+    ``slo_degraded`` / ``mem_pressure``) so a supervisor or load
+    balancer can choose its reaction: evict a hung replica, page on
+    slo_degraded, checkpoint-and-shrink on mem_pressure, keep a
+    warn-action NaN run visible. The digest carries the health
+    snapshot, the active hang digest, the SLO snapshot, the memory
+    forecast and the last cluster round; hang, SLO and mem-pressure
     states clear automatically on recovery."""
-    from . import health, cluster, watchdog, slo
+    from . import health, cluster, watchdog, slo, memory
     st = _tele()
     hs = health.snapshot_health(input_bound=health.input_bound_pct()) \
         if st.active else None
     bad = int(hs.get('nonfinite_steps') or 0) if hs else 0
     hang = watchdog.hang_info()
     slo_bad = slo.degraded()
+    mem_bad = memory.pressure_info()
     body = {
         'status': 'hung' if hang is not None
         else ('slo_degraded' if slo_bad is not None
-              else ('ok' if not bad else 'degraded')),
+              else ('mem_pressure' if mem_bad is not None
+                    else ('ok' if not bad else 'degraded'))),
         'telemetry': bool(st.active),
         'health_sentinels': bool(health.enabled()),
         'host': cluster.host_index(),
     }
     if hang is not None:
         body['hang'] = hang
+    if mem_bad is not None:
+        body['mem_pressure'] = mem_bad
     if hs is not None:
         body['health'] = hs
     slo_snap = slo.snapshot_slo()
@@ -193,7 +200,8 @@ def healthz_payload():
     clus = cluster.snapshot_cluster()
     if clus:
         body['cluster'] = clus
-    return bad == 0 and hang is None and slo_bad is None, body
+    return (bad == 0 and hang is None and slo_bad is None
+            and mem_bad is None), body
 
 
 def summary_payload():
@@ -202,7 +210,7 @@ def summary_payload():
     plus the rendered table itself."""
     import time
     from . import programs, health, cluster, roofline, slo
-    from . import dynamics, ledger, goodput
+    from . import dynamics, ledger, goodput, memory
     from .export import summary_table
     st = _tele()
     snap = st.registry.snapshot()
@@ -222,6 +230,9 @@ def summary_payload():
     # goodput: a fresh read-only attribution (no gauges, no record) so
     # a mid-run scrape sees live numbers, not the last summary's
     good = goodput.current()
+    # memory: same convention — a fresh read-only analysis (pure: no
+    # gauges written, no records emitted)
+    mem = memory.analyze()
     return {
         'elapsed_s': round(elapsed, 3) if elapsed is not None else None,
         'host': cluster.host_index(),
@@ -234,9 +245,10 @@ def summary_payload():
         'ledger': led,
         'dynamics': dynamics.snapshot_dynamics(),
         'goodput': good,
+        'memory': mem,
         'table': summary_table(snap, elapsed, programs=progs, health=hs,
                                cluster=clus, roofline=roof, ledger=led,
-                               goodput=good),
+                               goodput=good, memory=mem),
     }
 
 

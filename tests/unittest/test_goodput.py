@@ -480,8 +480,8 @@ def test_supervisor_stamps_lost_work_into_relaunch(tmp_path):
 
 def test_cluster_fleet_goodput_and_culprit(tele_on):
     from mxnet_tpu.telemetry import cluster
-    assert cluster.SYNC_KEYS[6:] == ('goodput_pct', 'badput_top',
-                                     'comm_src')
+    assert cluster.SYNC_KEYS[6:9] == ('goodput_pct', 'badput_top',
+                                      'comm_src')
     nan = float('nan')
     mat = np.array([
         [5.0, 10.0, 4.0, 1e6, 12.0, 0.0, 90.0,

@@ -1,0 +1,543 @@
+"""HBM attribution & forecast plane (mxnet_tpu/telemetry/memory).
+
+Contracts under test:
+- HLO text -> per-layer buffer-byte parse (ENTRY parameters are args,
+  the ENTRY ROOT is the output, materialized intermediates are temp,
+  nested-computation parameters/ROOTs never count as program I/O,
+  free ops own no buffer);
+- calibration: the parsed per-layer split rescales so each bucket sums
+  exactly to memory_analysis()'s totals, alias bytes ride the argument
+  holders, and a worst layer is named (the 10% acceptance criterion
+  holds by construction);
+- the forecaster's units: a constant timeline never alarms or trips,
+  injected growth produces a slope, a steps-to-OOM estimate, the
+  mem_pressure /healthz flip, the flight-recorder dump BEFORE death,
+  and a NAMED mem_growth anomaly on an upward excursion;
+- MXTPU_MEMORY=0/1 parametrized fit acceptance: =1 puts a ranked
+  memory block in the summary plus mem.* gauges and a JSONL record;
+  =0 leaves no trace anywhere and renders no HLO text;
+- the no-op contract: the lowered step HLO is byte-identical with the
+  flag on or off (attribution is host-side parsing, never graph edits);
+- the mem-hog chaos fault allocates-and-retains on the step seam;
+- the offline CLI (tools/memory_report.py) renders the JSONL record
+  byte-identically to the live summary block, plus the what-if table.
+"""
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.telemetry import memory
+from mxnet_tpu.telemetry import serve as tserve
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+_FLAGS = ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_PATH', 'MXTPU_MEMORY',
+          'MXTPU_MEMORY_OOM_STEPS', 'MXTPU_SCALARS_EVERY',
+          'MXTPU_FAULT_INJECT')
+
+_MIB = 2 ** 20
+
+
+def _reload_flags():
+    for f in _FLAGS:
+        flags.reload(f)
+
+
+@pytest.fixture
+def mem_on(tmp_path, monkeypatch):
+    """Telemetry + memory plane ON, logging to a tmp JSONL."""
+    path = tmp_path / 'memory.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_MEMORY', '1')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    yield path
+    telemetry._reset_for_tests()
+    for f in _FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload_flags()
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _flush():
+    telemetry._state.sink.flush()
+
+
+# A synthetic HLO module exercising every buffer-parse path: ENTRY
+# parameters (args), a dot and a fusion + its body (temp), a real ROOT
+# (out), a free op (bitcast — no buffer), and a NESTED computation
+# whose parameter must not count as a program argument.
+_SYNTH_HLO = '''\
+HloModule synthetic_mem, entry_computation_layout={(f32[64,128]{1,0}, f32[64,128]{1,0})->f32[64,64]{1,0}}
+
+%fused_body (p0.1: f32[64,64]) -> f32[64,64] {
+  %p0.1 = f32[64,64]{1,0} parameter(0)
+  ROOT %add.9 = f32[64,64]{1,0} add(f32[64,64]{1,0} %p0.1, f32[64,64]{1,0} %p0.1), metadata={op_name="jit(main)/relu1/add"}
+}
+
+ENTRY %main () -> f32[64,64] {
+  %p0 = f32[64,128]{1,0} parameter(0)
+  %p1 = f32[64,128]{1,0} parameter(1)
+  %dot.1 = f32[64,64]{1,0} dot(f32[64,128]{1,0} %p0, f32[64,128]{1,0} %p1), lhs_contracting_dims={1}, rhs_contracting_dims={1}, metadata={op_name="jit(main)/fc1/dot_general"}
+  %fusion.2 = f32[64,64]{1,0} fusion(f32[64,64]{1,0} %dot.1), kind=kLoop, calls=%fused_body, metadata={op_name="jit(main)/relu1/add"}
+  %bitcast.3 = f32[64,64]{1,0} bitcast(f32[64,64]{1,0} %fusion.2)
+  ROOT %subtract.4 = f32[64,64]{1,0} subtract(f32[64,64]{1,0} %bitcast.3, f32[64,64]{1,0} %dot.1), metadata={op_name="jit(main)/out/sub"}
+}
+'''
+
+_P_BYTES = 64 * 128 * 4        # one ENTRY parameter
+_T_BYTES = 64 * 64 * 4         # one [64,64] f32 buffer
+_ARGS_TOTAL = 2 * _P_BYTES
+_TEMP_TOTAL = 3 * _T_BYTES     # dot.1 + fusion.2 + the fusion body add
+_OUT_TOTAL = _T_BYTES
+
+
+# ---------------------------------------------------------------------------
+# HLO buffer parse
+# ---------------------------------------------------------------------------
+
+def test_hlo_layer_buffers_golden():
+    buf = memory.hlo_layer_buffers(_SYNTH_HLO)
+    assert buf['args_total'] == _ARGS_TOTAL
+    assert buf['temp_total'] == _TEMP_TOTAL
+    assert buf['out_total'] == _OUT_TOTAL
+    # ENTRY parameters carry no named scope -> pooled _unattributed;
+    # the nested computation's parameter counted NOWHERE
+    assert buf['layers']['_unattributed'] == {
+        'args': float(_ARGS_TOTAL), 'temp': 0.0, 'out': 0.0}
+    assert buf['layers']['fc1']['temp'] == _T_BYTES
+    # fusion instruction + its body line both land on relu1 (the
+    # calibration step absorbs the double count — shares, not totals)
+    assert buf['layers']['relu1']['temp'] == 2 * _T_BYTES
+    assert buf['layers']['out']['out'] == _OUT_TOTAL
+    # the free bitcast owns no buffer
+    assert set(buf['layers']) == {'_unattributed', 'fc1', 'relu1', 'out'}
+
+
+def test_note_hlo_keeps_largest_variant(mem_on):
+    memory.note_hlo('p', _SYNTH_HLO)
+    small = _SYNTH_HLO.replace('f32[64,128]', 'f32[8,128]')
+    memory.note_hlo('p', small)            # tail-batch recompile
+    prog = memory._pick_program()
+    assert prog['args_total'] == _ARGS_TOTAL
+
+
+def test_calibration_sums_to_analysis_totals(mem_on):
+    """The acceptance criterion: per-layer attribution sums to
+    memory_analysis()'s bucket totals (exactly, so within any
+    tolerance) and a worst layer is named."""
+    ana = {'argument_bytes': 2 * _ARGS_TOTAL, 'temp_bytes': 3 * _TEMP_TOTAL,
+           'output_bytes': _OUT_TOTAL, 'alias_bytes': _T_BYTES,
+           'live_bytes': 2 * _ARGS_TOTAL + 3 * _TEMP_TOTAL
+           + _OUT_TOTAL - _T_BYTES}
+    memory.note_hlo('p', _SYNTH_HLO, analysis=ana)
+    d = memory.analyze()
+    assert d['program'] == 'p'
+    assert sum(r['args'] for r in d['layers']) == ana['argument_bytes']
+    assert sum(r['temp'] for r in d['layers']) == ana['temp_bytes']
+    assert sum(r['out'] for r in d['layers']) == ana['output_bytes']
+    assert sum(r['alias'] for r in d['layers']) == ana['alias_bytes']
+    total = sum(r['total'] for r in d['layers'])
+    budget = (ana['argument_bytes'] + ana['temp_bytes']
+              + ana['output_bytes'])
+    assert abs(total - budget) <= max(1, 0.10 * budget)
+    assert d['worst_layer'] == d['layers'][0]['layer']
+    assert d['worst_layer_bytes'] == d['layers'][0]['total']
+    # alias rides the argument holders (donation refunds inputs)
+    by = {r['layer']: r for r in d['layers']}
+    assert by['_unattributed']['alias'] == ana['alias_bytes']
+    assert by['fc1']['alias'] == 0
+
+
+# ---------------------------------------------------------------------------
+# timeline + forecaster units
+# ---------------------------------------------------------------------------
+
+def test_constant_timeline_never_alarms(mem_on):
+    for step in range(12):
+        memory.record_sample(step, 1000 * _MIB, 2000 * _MIB)
+    g = telemetry.snapshot()['gauges']
+    assert g['mem.bytes_in_use'] == 1000 * _MIB
+    assert g['mem.headroom_pct'] == 50.0
+    assert g['mem.slope_bytes_per_step'] == 0.0
+    assert 'mem.steps_to_oom' not in g
+    assert g['mem.pressure'] == 0
+    assert memory.pressure_info() is None
+    ok, body = tserve.healthz_payload()
+    assert ok and body['status'] == 'ok'
+    _flush()
+    recs = _records(mem_on)
+    assert not any(r['type'] == 'anomaly' for r in recs)
+    mems = [r for r in recs if r['type'] == 'memory']
+    assert len(mems) == 12
+    assert mems[-1]['headroom_pct'] == 50.0
+    assert 'pressure' not in mems[-1]
+
+
+def test_growth_forecasts_oom_and_flips_healthz(mem_on, caplog):
+    """The ramp: +40 MiB/step against a 2000 MiB limit. The forecast
+    names steps-to-OOM, trips at/below MXTPU_MEMORY_OOM_STEPS (default
+    200), flips /healthz to mem_pressure and dumps the flight recorder
+    — all before any allocator failure exists to react to."""
+    for step in range(20):
+        memory.record_sample(step, (1000 + 40 * step) * _MIB,
+                             2000 * _MIB)
+    d = memory.analyze()
+    assert d['slope_bytes_per_step'] == pytest.approx(40 * _MIB, rel=0.01)
+    assert d['steps_to_oom'] <= 10
+    assert d['pressure'] is True
+    g = telemetry.snapshot()['gauges']
+    assert g['mem.pressure'] == 1
+    assert g['mem.steps_to_oom'] <= 10
+    info = memory.pressure_info()
+    assert info and info['steps_to_oom'] == g['mem.steps_to_oom']
+    ok, body = tserve.healthz_payload()
+    assert not ok and body['status'] == 'mem_pressure'
+    assert body['mem_pressure']['steps_to_oom'] <= 10
+    # the pre-mortem landed next to the telemetry log
+    dump = mem_on.parent / 'flight-mem-pressure.jsonl'
+    assert dump.exists()
+    head = json.loads(dump.read_text().splitlines()[0])
+    assert head['reason'] == 'mem-pressure'
+    # dumped at the FIRST trip, so the banked forecast is whatever
+    # first crossed the threshold — not the final sample's
+    assert head['forecast']['steps_to_oom'] <= 200
+    # the OOM report's cross-link: the last forecast survives
+    fc = memory.last_forecast()
+    assert fc and fc['steps_to_oom'] == d['steps_to_oom']
+    # pressure is RECOVERABLE: growth stops -> the trip clears. A flat
+    # tail longer than RING_CAP evicts the ramp entirely, the fitted
+    # slope returns to zero, and the digest must clear with it.
+    for step in range(20, 20 + memory.RING_CAP + 20):
+        memory.record_sample(step, 1800 * _MIB, 2000 * _MIB)
+    assert memory.pressure_info() is None
+    ok, body = tserve.healthz_payload()
+    assert ok and body['status'] == 'ok'
+
+
+def test_growth_excursion_raises_named_anomaly(mem_on):
+    """An upward excursion past the rolling baseline raises the NAMED
+    mem_growth anomaly; the preceding constant plateau never did."""
+    for step in range(10):
+        memory.record_sample(step, 1000 * _MIB, 2000 * _MIB)
+    _flush()
+    assert not any(r['type'] == 'anomaly' for r in _records(mem_on))
+    memory.record_sample(10, 1500 * _MIB, 2000 * _MIB)
+    _flush()
+    anomalies = [r for r in _records(mem_on) if r['type'] == 'anomaly']
+    assert anomalies and anomalies[-1]['detector'] == 'mem_growth'
+    assert anomalies[-1]['value'] > anomalies[-1]['baseline']
+    c = telemetry.snapshot()['counters']
+    assert c['health.anomalies.mem_growth'] >= 1
+
+
+def test_local_headroom_nan_contract(mem_on):
+    assert math.isnan(memory.local_headroom())   # no sample yet
+    memory.record_sample(0, 500 * _MIB)          # sample without a limit
+    assert math.isnan(memory.local_headroom())
+    memory.record_sample(1, 500 * _MIB, 1000 * _MIB)
+    assert memory.local_headroom() == pytest.approx(50.0)
+    from mxnet_tpu.telemetry import cluster
+    assert cluster.SYNC_KEYS[-1] == 'mem_headroom_pct'
+
+
+# ---------------------------------------------------------------------------
+# fit acceptance + no-op contract
+# ---------------------------------------------------------------------------
+
+def _mlp_fit():
+    np.random.seed(0)
+    mx.random.seed(0)
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    X = np.random.randn(32, 10).astype(np.float32)
+    y = (np.random.rand(32) * 4).astype(int).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8,
+                           label_name='softmax_label')
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.1),))
+    return mod
+
+
+@pytest.mark.parametrize('mem', ['0', '1'])
+def test_fit_acceptance_on_off(mem, tmp_path, monkeypatch):
+    """=1: the summary carries a ranked memory block naming a worst
+    layer, plus mem.* gauges and a JSONL record. =0: no trace
+    anywhere — no gauges, no records, no block."""
+    path = tmp_path / 'onoff.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_MEMORY', mem)
+    _reload_flags()
+    telemetry._reset_for_tests()
+    try:
+        _mlp_fit()
+        table = telemetry.write_summary(log=False)
+        recs = _records(path)
+        gauges = telemetry.snapshot()['gauges']
+        mem_gauges = [n for n in gauges if n.startswith('mem.')]
+        if mem == '0':
+            assert not memory.enabled()
+            assert '-- memory' not in table
+            assert mem_gauges == []
+            assert not any(r['type'] == 'memory' for r in recs)
+            assert memory.snapshot_memory() is None
+        else:
+            assert memory.enabled()
+            assert '-- memory' in table
+            d = memory.snapshot_memory()
+            assert d and d['layers']
+            assert d['worst_layer'] is not None
+            names = {r['layer'] for r in d['layers']}
+            assert names & {'fc1', 'relu1', 'fc2', 'softmax'}, names
+            assert gauges['mem.worst_layer'] == d['worst_layer']
+            mm = [r for r in recs if r['type'] == 'memory']
+            assert mm and mm[-1]['layers'] == json.loads(
+                json.dumps(d['layers']))
+            summ = [r for r in recs if r['type'] == 'summary'][-1]
+            assert summ.get('memory')
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+def test_memory_off_lowering_byte_identical(tmp_path, monkeypatch):
+    """Attribution is host-side HLO parsing — the lowered step program
+    is byte-identical with the flag on or off. The acceptance
+    criterion's no-op contract."""
+    import jax.numpy as jnp
+    from mxnet_tpu import random as _random
+
+    def _lowered_text(mem_flag):
+        telemetry._reset_for_tests()
+        monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+        monkeypatch.setenv('MXTPU_TELEMETRY_PATH',
+                           str(tmp_path / ('m%s.jsonl' % mem_flag)))
+        monkeypatch.setenv('MXTPU_MEMORY', mem_flag)
+        _reload_flags()
+        telemetry._reset_for_tests()
+        np.random.seed(0)
+        mx.random.seed(0)
+        data = mx.sym.Variable('data')
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+        out = mx.sym.SoftmaxOutput(fc1, name='softmax')
+        mod = mx.mod.Module(out, context=mx.cpu())
+        mod.bind(data_shapes=[('data', (8, 10))],
+                 label_shapes=[('softmax_label', (8,))])
+        mod.init_params()
+        ex = mod._exec_group.execs[0]
+        arg_data = tuple(a._data for a in ex.arg_arrays)
+        aux_data = tuple(a._data for a in ex.aux_arrays)
+        heads = (jnp.ones((8, 16), jnp.float32),)
+        return ex._fwd_bwd.lower(arg_data, aux_data, _random.next_key(),
+                                 heads).as_text()
+
+    try:
+        assert _lowered_text('0') == _lowered_text('1')
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+def test_off_no_parse_no_registry(tmp_path, monkeypatch):
+    """MXTPU_MEMORY unset: the registrar hook is one cached-bool
+    check — no HLO text is rendered, nothing lands anywhere."""
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(tmp_path / 'x.jsonl'))
+    monkeypatch.delenv('MXTPU_MEMORY', raising=False)
+    _reload_flags()
+    telemetry._reset_for_tests()
+
+    class _Boom:
+        def as_text(self):
+            raise AssertionError('HLO rendered with memory off')
+
+        def memory_analysis(self):
+            raise AssertionError('analysis run with memory off')
+
+    try:
+        memory.note_compiled('p', _Boom())
+        assert memory._pick_program() is None
+        assert memory.analyze() is None
+        assert memory.summarize() is None
+        assert memory.record_sample(0, 1) is None
+        assert memory.pressure_info() is None
+        assert memory.last_forecast() is None
+        assert math.isnan(memory.local_headroom())
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+# ---------------------------------------------------------------------------
+# mem-hog chaos fault
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_mem_hog_fault_allocates_and_retains(mem_on, monkeypatch):
+    """mem-hog:0:1 retains ~1 MiB of device memory per counted step
+    from step 0 on — the deterministic leak the forecaster exists to
+    call before the allocator does."""
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'mem-hog:0:1')
+    flags.reload('MXTPU_FAULT_INJECT')
+    faults._reset_for_tests()
+    try:
+        assert faults.enabled()
+        assert faults.spec() == ('mem-hog', 0, '1')
+        faults.note_steps(2)
+        faults.note_steps(3)
+        assert len(faults._hog) == 2       # retained, never disarmed
+        assert faults._hog[0].size == 2 * _MIB // 4
+        assert faults._hog[1].size == 3 * _MIB // 4
+    finally:
+        faults._reset_for_tests()
+
+
+@pytest.mark.chaos
+def test_mem_hog_fit_end_to_end(tmp_path, monkeypatch):
+    """A full fit with mem-hog armed and the memory plane on: the leak
+    accumulates on the step seam, training completes, and the plane
+    stays alive (CPU has no memory_stats, so the timeline stays empty
+    — the forecaster path is pinned in the synthetic ramp tests)."""
+    path = tmp_path / 'hog.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_MEMORY', '1')
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'mem-hog:0:1')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    faults._reset_for_tests()
+    try:
+        _mlp_fit()
+        assert faults._hog                 # the leak really accumulated
+        table = telemetry.write_summary(log=False)
+        assert '-- memory' in table        # and the plane still reports
+    finally:
+        faults._reset_for_tests()
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+# ---------------------------------------------------------------------------
+# offline CLI round-trip + crashed-run reconstruction
+# ---------------------------------------------------------------------------
+
+def _seed_plane():
+    ana = {'argument_bytes': _ARGS_TOTAL, 'temp_bytes': _TEMP_TOTAL,
+           'output_bytes': _OUT_TOTAL, 'alias_bytes': 0,
+           'live_bytes': _ARGS_TOTAL + _TEMP_TOTAL + _OUT_TOTAL}
+    memory.note_hlo('p', _SYNTH_HLO, analysis=ana)
+    for step in range(6):
+        memory.record_sample(step, (100 + step) * _MIB, 1000 * _MIB)
+
+
+def test_memory_report_matches_live_block(mem_on, capsys):
+    """JSONL -> tools/memory_report.py reproduces the live summary
+    block byte-for-byte (the acceptance criterion's round-trip)."""
+    import memory_report
+    _seed_plane()
+    table = telemetry.write_summary(log=False)
+    _flush()
+    lines = table.splitlines()
+    i = next(j for j, ln in enumerate(lines)
+             if ln.startswith('-- memory'))
+    j = next((k for k in range(i + 1, len(lines))
+              if lines[k].startswith('-- ')), len(lines))
+    live_block = '\n'.join(lines[i:j])
+    assert memory_report.main([str(mem_on)]) == 0
+    out = capsys.readouterr().out
+    assert out.rstrip('\n') == live_block
+    # --json round-trips the analysis dict itself
+    assert memory_report.main([str(mem_on), '--json']) == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d['layers'] and d['worst_layer']
+    # the what-if table names the largest batch that fits
+    assert memory_report.main([str(mem_on), '--what-if',
+                               '--batch', '8']) == 0
+    out = capsys.readouterr().out
+    assert '-- what-if' in out
+    assert 'largest batch that fits' in out
+
+
+def test_memory_report_no_record(tmp_path, capsys):
+    import memory_report
+    p = tmp_path / 'empty.jsonl'
+    p.write_text('{"type": "start", "pid": 1}\n')
+    assert memory_report.main([str(p)]) == 1
+    assert 'MXTPU_MEMORY' in capsys.readouterr().err
+
+
+def test_what_if_scaling_math():
+    from memory_report import what_if_lines
+    mem = {'args_bytes': 400 * _MIB, 'temp_bytes': 200 * _MIB,
+           'output_bytes': 100 * _MIB, 'alias_bytes': 0,
+           'bytes_limit': 1000 * _MIB}
+    lines = what_if_lines(mem, batch=8)
+    text = '\n'.join(lines)
+    # (1000 - 400) / 300 = 2x -> batch 16
+    assert 'largest batch that fits: 16 (2.00x of current)' in text
+    assert 'OOM' in text                   # the 4x row overflows
+    # no limit -> an explanation, not a crash
+    assert 'bytes_limit' in '\n'.join(what_if_lines({'temp_bytes': 1}))
+
+
+def test_crashed_run_reconstructs_memory_block(mem_on):
+    """No summary record (the process died): telemetry_report still
+    renders the memory block from the standalone timeline records."""
+    import telemetry_report
+    _seed_plane()
+    _flush()
+    records = telemetry_report.load(str(mem_on))
+    assert not any(r.get('type') == 'summary' for r in records)
+    out = telemetry_report.render(records)
+    assert '-- memory' in out
+    assert 'device_bytes' in out
+    assert 'reconstructed' in out
+
+
+def test_watch_renders_memory_line():
+    import telemetry_watch
+    summary = {
+        'elapsed_s': 100.0, 'host': 0,
+        'snapshot': {'counters': {},
+                     'gauges': {'mem.headroom_pct': 12.5,
+                                'mem.steps_to_oom': 150,
+                                'mem.worst_layer': 'fc2',
+                                'mem.worst_layer_bytes': 64 * _MIB,
+                                'mem.pressure': 1,
+                                'serve.ring_bytes': 32 * _MIB},
+                     'histograms': {}}}
+    lines = telemetry_watch.render(summary)
+    line = next(ln for ln in lines if ln.startswith('  memory'))
+    assert 'headroom 12.5%' in line
+    assert '~150 steps to OOM' in line
+    assert 'worst layer fc2 (64.0 MiB)' in line
+    assert 'serve ring 32.0 MiB' in line
+    assert 'MEM_PRESSURE' in line

@@ -414,7 +414,7 @@ def test_every_report_and_diff_cli_smokes(tmp_path):
     assert clis, 'no report/diff CLIs found'
     names = {os.path.basename(p) for p in clis}
     assert {'telemetry_report.py', 'roofline_report.py',
-            'bench_diff.py', 'run_compare.py',
+            'memory_report.py', 'bench_diff.py', 'run_compare.py',
             'telemetry_watch.py'} <= names
     for cli in clis:
         out = subprocess.run([sys.executable, cli, '--help'],

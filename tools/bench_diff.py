@@ -41,6 +41,10 @@ Compared metrics, with direction and default tolerance:
 - ``bytes_on_wire_per_step`` (gradient bytes per sync step, the
   quantized-collectives plane)             — higher is a regression (10%:
   the collective traffic regrew, e.g. compression silently disengaged)
+- ``mem_headroom_pct`` (the memory plane's device-bytes safety margin,
+  telemetry/memory.py)                     — lower is a regression (10%:
+  the program's HBM footprint grew toward the limit even when the step
+  time held — the next model tweak OOMs instead of landing)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -64,17 +68,17 @@ _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 10.0,
             'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0,
             'serving_p99_ms': 10.0, 'serving_queue_wait_p50_ms': 10.0,
             'final_loss': 5.0, 'goodput_pct': 5.0,
-            'bytes_on_wire_per_step': 10.0}
+            'bytes_on_wire_per_step': 10.0, 'mem_headroom_pct': 10.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
               'xla_live_bytes': +1,
               'opt_state_bytes_per_device': +1, 'compile_s': +1,
               'serving_p99_ms': +1, 'serving_queue_wait_p50_ms': +1,
               'final_loss': +1, 'goodput_pct': -1,
-              'bytes_on_wire_per_step': +1}
+              'bytes_on_wire_per_step': +1, 'mem_headroom_pct': -1}
 _ORDER = ('throughput', 'mfu', 'xla_temp_bytes', 'xla_live_bytes',
           'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms',
           'serving_queue_wait_p50_ms', 'final_loss', 'goodput_pct',
-          'bytes_on_wire_per_step')
+          'bytes_on_wire_per_step', 'mem_headroom_pct')
 
 
 def load_bench(path):
@@ -177,6 +181,11 @@ def extract(rec):
     if rec.get('bytes_on_wire_per_step') is not None:
         out['bytes_on_wire_per_step'] = \
             float(rec['bytes_on_wire_per_step'])
+    # device-bytes headroom (telemetry/memory.py): a DROP means the
+    # footprint crept toward the limit — the regression that OOMs the
+    # NEXT change rather than this one
+    if rec.get('mem_headroom_pct') is not None:
+        out['mem_headroom_pct'] = float(rec['mem_headroom_pct'])
     return out
 
 

@@ -1,0 +1,149 @@
+#!/usr/bin/env python
+"""Render the memory attribution block from a telemetry JSONL log,
+offline.
+
+A run with ``MXTPU_TELEMETRY=1 MXTPU_MEMORY=1`` appends ``memory``
+records (and folds the end-of-run dict into the ``summary`` record)
+carrying the per-layer HBM attribution, the live-bytes timeline tail
+and the steps-to-OOM forecast. This tool re-renders it without
+re-running anything::
+
+    python tools/memory_report.py telemetry.jsonl
+
+Uses the SAME renderer as the live end-of-run summary
+(mxnet_tpu/telemetry/export.py::_memory_lines), so the offline block
+is byte-identical to the one the run logged — the round-trip the
+memory tests pin. ``--json`` dumps the raw analysis dict instead.
+Multiple records keep the LAST full one (the end-of-run view) unless
+``--all`` lists every one with its timestamp.
+
+``--what-if`` appends a capacity-planning table: holding the program's
+argument bytes (weights/optimizer state) fixed and scaling the
+activation footprint (temp + out - alias) linearly, it lists the
+projected device bytes at several multiples of the current batch or
+window and the largest multiple that still fits ``bytes_limit``.
+Pass ``--batch N`` (the run's global batch or decode window) to label
+the rows in concrete batch sizes instead of bare multiples.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from mxnet_tpu.telemetry.export import _memory_lines  # noqa: E402
+from telemetry_report import load  # noqa: E402  (same loader conventions)
+
+
+def memory_records(records):
+    """Every memory analysis dict in a parsed record list, oldest
+    first: the dedicated ``memory`` records, plus any ``summary``
+    record's ``memory`` key (a crashed run may have either). Summary
+    folds sort after same-log timeline samples so the end-of-run view
+    (which carries the per-layer table) wins the default pick."""
+    out = []
+    for r in records:
+        if r.get('type') == 'memory':
+            out.append((r.get('t'), {k: v for k, v in r.items()
+                                     if k not in ('type', 't', 'host')}))
+        elif r.get('type') == 'summary' and r.get('memory'):
+            out.append((r.get('t'), r['memory']))
+    return out
+
+
+def render(mem):
+    """One analysis dict -> the summary-table block, as a string."""
+    return '\n'.join(_memory_lines(mem))
+
+
+def what_if_lines(mem, batch=None):
+    """Capacity planning from one analysis dict: args bytes are fixed
+    (weights + optimizer state survive any batch), activations
+    (temp + out - alias) scale linearly with batch/window, so the
+    largest multiple that fits is k = (limit - args) / activations."""
+    args_b = int(mem.get('args_bytes') or 0)
+    act = (int(mem.get('temp_bytes') or 0) + int(mem.get('output_bytes')
+           or 0) - int(mem.get('alias_bytes') or 0))
+    limit = mem.get('bytes_limit')
+    lines = ['-- what-if: batch/window scaling --']
+    if act <= 0 or not limit:
+        lines.append('  (needs a compiled-program analysis and a '
+                     'device bytes_limit; re-run with MXTPU_MEMORY=1 '
+                     'on an accelerator)')
+        return lines
+    limit = int(limit)
+    k_max = max(0.0, (limit - args_b) / float(act))
+    mib = 2.0 ** 20
+    unit = 'batch' if batch else 'scale'
+    lines.append('  %-10s %12s %12s  %s'
+                 % (unit, 'projected_MiB', 'limit_MiB', 'fits'))
+    mults = [0.5, 1.0, 2.0, 4.0]
+    if k_max > 0 and all(abs(k_max - m) > 1e-9 for m in mults):
+        mults = sorted(mults + [k_max])
+    for k in mults:
+        proj = args_b + k * act
+        label = ('%d' % round(k * batch)) if batch else ('%.2fx' % k)
+        lines.append('  %-10s %12.1f %12.1f  %s'
+                     % (label, proj / mib, limit / mib,
+                        'yes' if proj <= limit else 'OOM'))
+    if batch:
+        lines.append('  largest %s that fits: %d (%.2fx of current)'
+                     % (unit, int(k_max * batch), k_max))
+    else:
+        lines.append('  largest scale that fits: %.2fx' % k_max)
+    return lines
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Render the memory attribution block (per-layer '
+                    'args/temp/out/alias byte shares calibrated to '
+                    "XLA's memory_analysis totals, live-bytes tail, "
+                    'headroom and steps-to-OOM forecast) from a '
+                    'telemetry JSONL log, offline — byte-identical to '
+                    'the block the live summary table logged.')
+    ap.add_argument('path', help='telemetry JSONL file to render')
+    ap.add_argument('--json', action='store_true',
+                    help='dump the raw analysis dict(s) as JSON instead '
+                         'of the rendered block')
+    ap.add_argument('--all', action='store_true',
+                    help='render every memory record in the log, not '
+                         'just the last')
+    ap.add_argument('--what-if', action='store_true',
+                    help='append a capacity-planning table: projected '
+                         'device bytes at several activation-scale '
+                         'multiples and the largest that fits')
+    ap.add_argument('--batch', type=int, default=None,
+                    help='current global batch (or decode window) — '
+                         'labels the what-if rows in concrete sizes')
+    args = ap.parse_args(argv)
+    recs = memory_records(load(args.path))
+    if not recs:
+        sys.stderr.write(
+            'memory_report: %s holds no memory record — was the run '
+            'started with MXTPU_TELEMETRY=1 MXTPU_MEMORY=1?\n'
+            % args.path)
+        return 1
+    picked = recs if args.all else recs[-1:]
+    if args.json:
+        dicts = [r for _t, r in picked]
+        print(json.dumps(dicts[0] if len(dicts) == 1 else dicts,
+                         indent=2))
+        return 0
+    blocks = []
+    for t, mem in picked:
+        if args.all and t is not None:
+            blocks.append('== t=%s ==' % t)
+        blocks.append(render(mem))
+    if args.what_if:
+        blocks.append('\n'.join(what_if_lines(picked[-1][1],
+                                              batch=args.batch)))
+    print('\n'.join(blocks))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -275,8 +275,9 @@ def _reconstruct_goodput(records, snapshot, elapsed, roofline, ledger):
 
 def _summary_parts(records):
     """(snapshot, elapsed, programs, health, cluster, roofline, ledger,
-    goodput, reconstructed) for one host's record list — the last
-    summary record when present, else the crashed-run reconstruction."""
+    goodput, memory, reconstructed) for one host's record list — the
+    last summary record when present, else the crashed-run
+    reconstruction."""
     summaries = [r for r in records if r.get('type') == 'summary']
     clus_recs = [r for r in records if r.get('type') == 'cluster']
     cluster = clus_recs[-1] if clus_recs else None
@@ -290,6 +291,14 @@ def _summary_parts(records):
     if roofline is not None:
         roofline = {k: v for k, v in roofline.items()
                     if k not in ('type', 't', 'host')}
+    # the memory plane likewise: timeline samples are standalone
+    # ``memory`` records (a crashed run's trail), the end-of-run
+    # analysis (with the per-layer table) is folded into the summary
+    mem_recs = [r for r in records if r.get('type') == 'memory']
+    memory = mem_recs[-1] if mem_recs else None
+    if memory is not None:
+        memory = {k: v for k, v in memory.items()
+                  if k not in ('type', 't', 'host')}
     if summaries:
         s = summaries[-1]
         health = s.get('health')
@@ -318,21 +327,23 @@ def _summary_parts(records):
             roof, led)
         return (s.get('snapshot') or {}, s.get('elapsed_s'),
                 s.get('programs'), health,
-                s.get('cluster') or cluster, roof, led, good, False)
+                s.get('cluster') or cluster, roof, led, good,
+                s.get('memory') or memory, False)
     snapshot, elapsed, programs, health = _reconstruct(records)
     led = _reconstruct_ledger(records)
     good = _reconstruct_goodput(records, snapshot, elapsed, roofline, led)
     return (snapshot, elapsed, programs, health, cluster, roofline,
-            led, good, True)
+            led, good, memory, True)
 
 
 def render(records):
     """The summary table for a parsed record list, as a string."""
     (snapshot, elapsed, programs, health, cluster, roofline, led, good,
-     reco) = _summary_parts(records)
+     memory, reco) = _summary_parts(records)
     table = summary_table(snapshot, elapsed, programs=programs,
                           health=health, cluster=cluster,
-                          roofline=roofline, ledger=led, goodput=good)
+                          roofline=roofline, ledger=led, goodput=good,
+                          memory=memory)
     if reco:
         table += ('\n(no summary record found — reconstructed from '
                   '%d individual records; registry-only counters and '
@@ -426,7 +437,7 @@ def render_hosts(by_host):
     rows = []
     for host in sorted(by_host):
         (snapshot, elapsed, programs, health, cluster, roof, _led,
-         good, reco) = _summary_parts(by_host[host])
+         good, _mem, reco) = _summary_parts(by_host[host])
         steps = snapshot.get('counters', {}).get('fit.steps')
         if steps is None:
             steps = (snapshot.get('histograms', {})

@@ -43,6 +43,9 @@ def fetch(source):
     records = telemetry_report.load(source)
     summaries = [r for r in records if r.get('type') == 'summary']
     clus = [r for r in records if r.get('type') == 'cluster']
+    mems = [r for r in records if r.get('type') == 'memory']
+    last_mem = ({k: v for k, v in mems[-1].items()
+                 if k not in ('type', 't', 'host')} if mems else None)
     if summaries:
         s = summaries[-1]
         return {'elapsed_s': s.get('elapsed_s'),
@@ -52,6 +55,7 @@ def fetch(source):
                 'health': s.get('health'),
                 'cluster': s.get('cluster')
                 or (clus[-1] if clus else None),
+                'memory': s.get('memory') or last_mem,
                 'ledger': s.get('ledger')
                 or telemetry_report._reconstruct_ledger(records),
                 'goodput': s.get('goodput')
@@ -67,6 +71,7 @@ def fetch(source):
     return {'elapsed_s': elapsed, 'host': None, 'snapshot': snapshot,
             'programs': programs, 'health': health,
             'cluster': clus[-1] if clus else None,
+            'memory': last_mem,
             'ledger': led,
             'goodput': telemetry_report._reconstruct_goodput(
                 records, snapshot, elapsed,
@@ -164,6 +169,31 @@ def render(summary, steps_per_s=None, reqs_per_s=None):
                      % (g['xla.bytes_in_use'] / 2.0**20,
                         (g.get('xla.peak_bytes_in_use')
                          or g['xla.bytes_in_use']) / 2.0**20))
+    # memory plane (MXTPU_MEMORY): headroom + steps-to-OOM forecast +
+    # the worst layer by attributed peak bytes, from the mem.* gauges
+    # or (JSONL mode) the last memory record / summary fold
+    mem = summary.get('memory') or {}
+    head = g.get('mem.headroom_pct', mem.get('headroom_pct'))
+    oom = g.get('mem.steps_to_oom', mem.get('steps_to_oom'))
+    worst = g.get('mem.worst_layer', mem.get('worst_layer'))
+    ring = g.get('serve.ring_bytes')
+    if head is not None or oom is not None or worst is not None \
+            or ring is not None:
+        bits = []
+        if head is not None:
+            bits.append('headroom %s%%' % _fmt(float(head)))
+        if oom is not None:
+            bits.append('~%d steps to OOM' % int(oom))
+        if worst is not None:
+            wb = g.get('mem.worst_layer_bytes', mem.get('worst_layer_bytes'))
+            bits.append('worst layer %s%s'
+                        % (worst, ' (%.1f MiB)' % (float(wb) / 2.0**20)
+                           if wb is not None else ''))
+        if ring is not None:
+            bits.append('serve ring %.1f MiB' % (float(ring) / 2.0**20))
+        if g.get('mem.pressure', 1 if mem.get('pressure') else None):
+            bits.append('MEM_PRESSURE')
+        lines.append('  memory       %s' % ', '.join(bits))
     if g.get('update.opt_state_bytes_per_device') is not None:
         # sharded weight update (MXTPU_SHARDED_UPDATE): whether the
         # ZeRO layout is engaged and what the optimizer state costs
