@@ -1090,6 +1090,15 @@ def _telemetry_breakdown(device, step_ms=None):
         good = _tele.goodput.current()
         if good:
             tel['goodput'] = good
+        # step timeline (ISSUE 20): the per-step phase decomposition
+        # (compute / collective-wait / io / host-side shares) —
+        # bench_diff gates the host-side share (host_overhead_pct)
+        pb = _tele.timeline.phase_breakdown()
+        if pb:
+            tel['step_phase_breakdown'] = pb
+            tl = _tele.timeline.summarize()
+            if tl:
+                tel['timeline'] = tl
         return tel or None
     except Exception as e:  # noqa: BLE001 — the bench number must survive
         _log('telemetry fold-in failed: %s' % e)
@@ -1116,6 +1125,10 @@ def main():
     # and bench_diff gates the headroom. setdefault: an explicit =0
     # still wins.
     os.environ.setdefault('MXTPU_MEMORY', '1')
+    # step timeline rides every bench run (ISSUE 20): the phase
+    # decomposition folds into the emitted JSON below and bench_diff
+    # gates the host-side share. setdefault: an explicit =0 still wins.
+    os.environ.setdefault('MXTPU_TIMELINE', '1')
     if os.environ.get('MXTPU_BENCH_DIRECT'):
         # child of a successful late reprobe: init the default backend
         # straight away (the parent just verified it is healthy)
@@ -1277,6 +1290,10 @@ def main():
         _tele.programs.note_dispatch('bench.train_step')  # see warmup
         # feeds the xla.mfu estimate together with note_step_flops above
         _tele.counter('fit.steps').inc(STEPS_PER_CALL)
+        if _tele.timeline.enabled():
+            # feeds the step-phase ledger so the timeline fold below
+            # can decompose the step (dispatch share + wall per step)
+            _tele.timeline.note_step(STEPS_PER_CALL)
         bench_losses.append(loss)
     float(np.asarray(loss))  # host fetch = true barrier (see warmup)
     dt = time.perf_counter() - t0
@@ -1437,6 +1454,13 @@ def main():
                 tel['bytes_on_wire_per_step']
             if tel.get('compression_ratio') is not None:
                 out['compression_ratio'] = tel['compression_ratio']
+        # top-level copy of the step-phase gate (bench_diff gates
+        # host_overhead_pct: higher = regression) — host-side work
+        # creeping into the step shows up as a grown share here
+        pb = tel.get('step_phase_breakdown') or {}
+        if pb.get('host_pct') is not None:
+            out['step_phase_breakdown'] = pb
+            out['host_overhead_pct'] = pb['host_pct']
     # sharded-vs-replicated weight-update A/B (MXTPU_SHARDED_UPDATE):
     # only runs at dp > 1, and AFTER the telemetry fold above so the
     # probe model's compiles/programs/roofline never contaminate the

@@ -194,6 +194,21 @@ flags.declare('MXTPU_GOODPUT_LOST_S', float, 0.0,
               'with the derived job_wall_s / job_goodput_pct; per-'
               'process buckets still sum to per-process wall. Not for '
               'humans to set', min_value=0.0)
+flags.declare('MXTPU_TIMELINE', bool, False,
+              'Pod-level step timeline (telemetry/timeline.py, requires '
+              'MXTPU_TELEMETRY=1): cross-host clock alignment piggy-'
+              'backed on the cluster sync allgather (no new collective; '
+              'cluster.h<i>.clock_offset_ms), a step-phase ledger from '
+              'the existing spans, and per-sync-round critical-path '
+              'attribution — the gang step decomposed into compute / '
+              'collective-wait / io / host-side with the gating host '
+              'AND phase named (timeline.critical_host/critical_phase/'
+              'skew_ms gauges, timeline JSONL records, the "step '
+              'timeline" summary block; tools/trace_merge.py stitches '
+              'the per-host logs into one offset-corrected Perfetto '
+              'trace). Off (default) = true no-op: one cached-bool per '
+              'seam, lowered programs byte-identical, the sync vector '
+              'slots ride as NaN')
 flags.declare('MXTPU_TELEMETRY_BIND', str, '127.0.0.1',
               'Bind address for the live telemetry endpoint '
               '(telemetry/serve.py). Default 127.0.0.1 = loopback only; '
@@ -246,8 +261,8 @@ flags.declare('MXTPU_FAULT_INJECT', str, '',
               'Deterministic fault injection (mxnet_tpu/faults.py): '
               "'<kind>:<step>[:<arg>]' with kind one of nan-grad, "
               'checkpoint-corrupt, dispatch-exception, '
-              'backend-probe-timeout, slow-host, hang, host-loss — '
-              'fires one real fault '
+              'backend-probe-timeout, slow-host, hang, host-loss, '
+              'mem-hog, clock-skew — fires one real fault '
               'at a deterministic training step so every recovery path '
               '(health raise, restore-from-last-good, restart backoff, '
               'bench reprobe) is exercised by real tests, not mocks. '

@@ -36,6 +36,13 @@ is exercised by real failures instead of mocks. Kinds:
   is what should notice — steps-to-OOM shrinking, /healthz flipping to
   ``mem_pressure``, the flight recorder dumped — before the allocator
   dies. Never disarms; the compiled programs are untouched.
+- ``clock-skew:<k>[:<ms>]`` — shift this host's wall clock BY MS as
+  the timeline plane samples it (telemetry/timeline.py's
+  ``note_sync_exit``), from step k on, persistently: injected clock
+  drift with zero effect on the training math, the schedule or the
+  real clocks. The MXTPU_TIMELINE offset estimator is what should
+  notice — ``cluster.h<i>.clock_offset_ms`` naming this host's offset
+  while the merged Perfetto trace stays aligned. Never disarms.
 - ``hang:<k>[:<secs>]`` — wedge the first dispatch seam that reaches
   step k by sleeping ``secs`` (default 3600) in place: the shape of a
   collective waiting on a dead peer or a tunneled dispatch that never
@@ -69,15 +76,17 @@ import time
 import numpy as np
 
 __all__ = ['FaultInjected', 'HOST_LOSS_EXIT_CODE', 'enabled', 'spec',
-           'note_steps', 'maybe_poison_snap', 'maybe_poison_batch',
-           'maybe_raise', 'maybe_corrupt_checkpoint']
+           'note_steps', 'clock_skew_ms', 'maybe_poison_snap',
+           'maybe_poison_batch', 'maybe_raise',
+           'maybe_corrupt_checkpoint']
 
 KINDS = ('nan-grad', 'checkpoint-corrupt', 'dispatch-exception',
          'backend-probe-timeout', 'slow-host', 'hang', 'host-loss',
-         'mem-hog')
+         'mem-hog', 'clock-skew')
 
 _SLOW_DEFAULT_MS = 50.0
 _HOG_DEFAULT_MB = 8.0
+_SKEW_DEFAULT_MS = 100.0
 _hog = []   # mem-hog's retained device allocations (the leak itself)
 _HANG_DEFAULT_SECS = 3600.0
 HOST_LOSS_EXIT_CODE = 113   # distinct from the watchdog's 85
@@ -229,6 +238,25 @@ def note_steps(n=1):
         except Exception as e:  # noqa: BLE001 — a chaos harness must
             logging.warning(                   # not crash the run itself
                 'fault injection: mem-hog allocation failed: %s', e)
+
+
+def clock_skew_ms():
+    """The wall-clock shift (ms) an armed ``clock-skew`` fault applies
+    to this host's timeline clock samples — 0.0 unarmed / before the
+    armed step. ``>=`` so ``clock-skew:0`` skews from the very first
+    sync round (the trained-step counter may still be 0 then); like
+    slow-host/mem-hog it persists and never disarms."""
+    if not enabled():
+        return 0.0
+    with _state.lock:
+        hit = (_state.kind == 'clock-skew' and _state.steps >= _state.step)
+        arg = _state.arg
+    if not hit:
+        return 0.0
+    try:
+        return float(arg) if arg else _SKEW_DEFAULT_MS
+    except ValueError:
+        return _SKEW_DEFAULT_MS
 
 
 def _poison(arr):

@@ -315,6 +315,10 @@ class BaseModule:
         # live-bytes timeline (telemetry/memory): one cached-bool check
         # here, a host-side allocator sample at the scalars cadence
         mem_on = _tele.memory.enabled()
+        # pod step timeline (telemetry/timeline): the per-step counter
+        # behind the phase ledger's per-step normalization — the phase
+        # durations themselves ride the spans this loop already emits
+        tl_on = _tele.timeline.enabled()
 
         try:
             for epoch in range(begin_epoch, num_epoch):
@@ -416,6 +420,8 @@ class BaseModule:
                         _faults.note_steps(1)
                     if mem_on:
                         _tele.memory.note_step(1)
+                    if tl_on:
+                        _tele.timeline.note_step(1)
                     nbatch += 1
 
                 self._fit_epoch_end(epoch, eval_metric, tic,

@@ -1499,6 +1499,7 @@ class FusedFitLoop:
         health_on = self._health_fn is not None
         cluster_on = _tele.cluster.enabled()
         mem_on = _tele.memory.enabled()
+        tl_on = _tele.timeline.enabled()
         _t_win = _clk()   # wall clock per dispatched window (health)
         batches, snaps = collect()
         if not batches:
@@ -1655,6 +1656,11 @@ class FusedFitLoop:
                     # allocator query at the scalars cadence, no
                     # device sync
                     _tele.memory.note_step(self.window)
+                if tl_on:
+                    # pod step timeline (MXTPU_TIMELINE): a whole
+                    # window of steps for the phase ledger's per-step
+                    # normalization — one clock read
+                    _tele.timeline.note_step(self.window)
                 if _timing:
                     _tm['fetch'] += _clk() - _t
         finally:
@@ -1701,6 +1707,8 @@ class FusedFitLoop:
                 _tele.cluster.note_step()
             if faults_on:
                 _faults.note_steps(1)
+            if tl_on:
+                _tele.timeline.note_step(1)
             _profiler.note_step()
             m.update_metric(eval_metric, sb.label)
             _tele.ledger.note_train_step(lr=self._last_lr,

@@ -133,12 +133,13 @@ from . import dynamics  # noqa: F401  (public submodule: telemetry.dynamics.*)
 from . import ledger  # noqa: F401  (public submodule: telemetry.ledger.*)
 from . import goodput  # noqa: F401  (public submodule: telemetry.goodput.*)
 from . import memory  # noqa: F401  (public submodule: telemetry.memory.*)
+from . import timeline  # noqa: F401  (public submodule: telemetry.timeline.*)
 
 __all__ = ['enabled', 'counter', 'gauge', 'histogram', 'span', 'event',
            'snapshot', 'summary', 'write_summary', 'shutdown', 'xla',
            'programs', 'health', 'cluster', 'serve', 'roofline',
            'watchdog', 'trace', 'slo', 'flight', 'dynamics', 'ledger',
-           'goodput', 'memory', 'get_registry']
+           'goodput', 'memory', 'timeline', 'get_registry']
 
 
 class _State:
@@ -291,6 +292,10 @@ class _Span:
         st = _state
         if st.active:
             st.registry.histogram(self.name).observe(dur_ms)
+            # step-phase ledger (MXTPU_TIMELINE): leaf phase spans
+            # bucket into per-phase accumulators — one cached bool off
+            if timeline.enabled():
+                timeline.note_span(self.name, dur_ms)
             if st.sink is not None:
                 st.sink.emit({'type': 'span', 'name': self.name,
                               'path': self.path, 't': self.t0,
@@ -352,7 +357,8 @@ def summary():
                                  roofline=roofline.snapshot_roofline(),
                                  ledger=ledger.snapshot_ledger(),
                                  goodput=goodput.current(),
-                                 memory=memory.snapshot_memory())
+                                 memory=memory.snapshot_memory(),
+                                 timeline=timeline.snapshot_timeline())
 
 
 def write_summary(log=True):
@@ -384,6 +390,11 @@ def write_summary(log=True):
     # provenance-labeled share) and before the snapshot below so the
     # gauges land in the summary record too
     gsnap = goodput.summarize(elapsed)
+    # pod step timeline (MXTPU_TIMELINE): the last sync round's
+    # critical-path attribution, or a local one on a run that never
+    # synced — publishes timeline.* gauges + the timeline JSONL record
+    # before the snapshot below so the gauges land in the summary too
+    tsnap = timeline.summarize()
     snap = _state.registry.snapshot()
     progs = programs.snapshot_programs()
     if _state.sink is not None:
@@ -403,12 +414,15 @@ def write_summary(log=True):
             rec['goodput'] = gsnap
         if msnap:
             rec['memory'] = msnap
+        if tsnap:
+            rec['timeline'] = tsnap
         _state.sink.emit(rec)
         _state.sink.flush()
     table = _export.summary_table(snap, elapsed, programs=progs or None,
                                   health=hsnap, cluster=csnap,
                                   roofline=rsnap, ledger=lsnap,
-                                  goodput=gsnap, memory=msnap)
+                                  goodput=gsnap, memory=msnap,
+                                  timeline=tsnap)
     if log:
         logging.info('%s', table)
     _state.summary_written = True
@@ -460,6 +474,7 @@ def _reset_for_tests():
     ledger._reset_for_tests()
     goodput._reset_for_tests()
     memory._reset_for_tests()
+    timeline._reset_for_tests()
     try:
         from ..parallel import compression
         compression._reset_for_tests()

@@ -68,10 +68,26 @@ __all__ = ['enabled', 'host_index', 'set_host', 'note_step', 'sync_now',
 # with the memory plane (appended at the end, same stability rule):
 # each host's latest device-byte headroom %, NaN while MXTPU_MEMORY is
 # off or no sample carries a byte limit — process 0 names the most
-# memory-pressured host from it
+# memory-pressured host from it. The eight trailing slots rode in with
+# the timeline plane (appended at the end, same stability rule):
+# 'clock_wall_s'/'clock_mono_s' are the clock pair each host sampled at
+# the PREVIOUS round's allgather exit (the barrier exit is the shared
+# time reference — zero new collectives) and 'tl_*_ms' the per-step
+# phase milliseconds of its step-phase ledger over the round window;
+# all NaN while MXTPU_TIMELINE is off. They feed process 0's clock-
+# offset rings and critical-path attribution (telemetry/timeline.py),
+# NOT the per-host cluster record rows (_TL_SLOTS below skips them)
 SYNC_KEYS = ('step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes',
              'comm_pct', 'proc_index', 'goodput_pct', 'badput_top',
-             'comm_src', 'mem_headroom_pct')
+             'comm_src', 'mem_headroom_pct',
+             'clock_wall_s', 'clock_mono_s', 'tl_draw_ms', 'tl_put_ms',
+             'tl_dispatch_ms', 'tl_fetch_ms', 'tl_ckpt_ms', 'tl_kv_ms')
+
+# the timeline plane's slots: carried in the vector for the allgather,
+# published through the separate 'timeline' record/gauges — raw clock
+# epochs and ledger fragments in every per-host cluster row would be
+# noise (telemetry/timeline.py asserts this slice matches its SLOTS)
+_TL_SLOTS = frozenset(SYNC_KEYS[10:])
 
 _SPREAD_BALANCED_PCT = 5.0   # step-time spread below this = no straggler
 _COMM_BOUND_PCT = 30.0       # collective share of the step above which a
@@ -251,12 +267,16 @@ def _local_stats():
     # (NaN while off / no limit) — the fleet's min names the most
     # memory-pressured host
     from . import memory
+    # the timeline plane's contribution (MXTPU_TIMELINE): the clock
+    # pair sampled at the previous round's barrier exit + the per-step
+    # phase ledger over the round window — all NaN while off
+    from . import timeline
     return [step_ms, float(io_pct), float(disp), live,
             float(comm) if comm is not None else float('nan'), proc,
             good_pct, badput_idx,
             float('nan') if comm_src is None
             else (1.0 if comm_src == 'measured' else 0.0),
-            memory.local_headroom()]
+            memory.local_headroom()] + timeline.local_slots()
 
 
 def _allgather(vals):
@@ -359,6 +379,15 @@ def sync_now():
     except Exception as e:  # noqa: BLE001 — observability must not kill
         logging.debug('telemetry.cluster: sync failed: %s', e)
         return None
+    # the allgather is a barrier, so the instant it returns is the same
+    # true time on every host — the clock sample the timeline plane
+    # ships in the NEXT round's vector (MXTPU_TIMELINE; no-op while off)
+    from . import timeline as _timeline
+    try:
+        _timeline.note_sync_exit()
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('telemetry.cluster: timeline clock sample failed: '
+                      '%s', e)
     from . import watchdog as _watchdog
     _watchdog.note_progress('cluster.sync')
     with _state.lock:
@@ -407,6 +436,9 @@ def _publish(mat, steps):
         for j, key in enumerate(SYNC_KEYS):
             if key == 'proc_index':
                 continue        # identity, already the 'host' field
+            if key in _TL_SLOTS:
+                continue        # the timeline plane's raw slots: they
+                                # publish through publish_round below
             # rows shorter than SYNC_KEYS (a crafted test matrix, or a
             # sender predating a slot) pad with NaN = unavailable
             v = float(mat[i, j]) if j < mat.shape[1] else float('nan')
@@ -479,6 +511,15 @@ def _publish(mat, steps):
         reg.gauge('cluster.mem_pressured_host').set(m_host)
         snap['fleet_mem_headroom_pct'] = round(fleet_head, 2)
         snap['mem_pressured_host'] = m_host
+    # the timeline plane's per-round work (MXTPU_TIMELINE; one cached
+    # bool while off): clock-offset rings from this round's gathered
+    # samples, critical-path attribution, cluster.h<i>.clock_offset_ms
+    # + timeline.* gauges and the 'timeline' JSONL record
+    from . import timeline as _timeline
+    try:
+        _timeline.publish_round(mat, host_ids, steps)
+    except Exception as e:  # noqa: BLE001 — observability must not kill
+        logging.debug('telemetry.cluster: timeline publish failed: %s', e)
     with _state.lock:
         _state.snapshot = snap
     if st.sink is not None:

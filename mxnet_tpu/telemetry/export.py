@@ -5,7 +5,8 @@ greps after the fact: one JSON object per line, each with a ``type``
 ('start', 'span', 'compile', 'cache_hit', 'retrace_storm', 'event',
 'program', 'oom', 'health', 'anomaly', 'cluster', 'restart', 'hang',
 'elastic', 'roofline', 'trace', 'slo', 'flight', 'manifest',
-'scalars', 'dynamics', 'goodput', 'memory', 'summary') and a ``t``
+'scalars', 'dynamics', 'goodput', 'memory', 'timeline', 'summary')
+and a ``t``
 epoch-seconds
 stamp —
 the full list is documented (and lint-gated) under
@@ -401,6 +402,48 @@ def _goodput_lines(good):
     return lines
 
 
+def _timeline_lines(tl):
+    """The "step timeline" block (telemetry.timeline's attribution
+    dict): one decomposition row per host from the last sync round —
+    step time split into compute / collective-wait / io / host-side,
+    plus the estimated clock offset — then the skew (fastest-host idle
+    at the allreduce) and the gating host+phase. Rendered
+    deterministically from the dict alone so the offline CLI
+    (tools/timeline_report.py) reproduces the live block byte-for-byte
+    from the JSONL record."""
+    lines = ['-- step timeline --']
+    lines.append('  hosts             %s' % tl.get('hosts'))
+    per = tl.get('per_host') or []
+    if per:
+        lines.append('  host   step_ms    compute    collect    io    '
+                     '     host_side  offset_ms')
+        crit = tl.get('critical_host')
+        for r in per:
+            mark = '*' if (r.get('host') == crit and len(per) > 1) else ''
+            lines.append('  %-5s  %-9s  %-9s  %-9s  %-9s  %-9s  %s'
+                         % ('%s%s' % (r.get('host'), mark),
+                            _fmt(r.get('step_time_ms')),
+                            _fmt(r.get('compute_ms')),
+                            _fmt(r.get('collective_ms')),
+                            _fmt(r.get('io_ms')),
+                            _fmt(r.get('host_ms')),
+                            _fmt(r.get('clock_offset_ms'))))
+    if tl.get('skew_ms') is not None:
+        lines.append('  skew              %s ms/step (fastest-host idle '
+                     'at the allreduce)' % _fmt(float(tl['skew_ms'])))
+    if tl.get('critical_phase') is not None:
+        line = '  critical_path     host %s %s' % (tl.get('critical_host'),
+                                                   tl['critical_phase'])
+        if tl.get('phase_excess_ms') is not None:
+            if (tl.get('hosts') or 1) > 1:
+                line += ' (+%s ms/step of skew)' \
+                    % _fmt(float(tl['phase_excess_ms']))
+            else:
+                line += ' (%s ms/step)' % _fmt(float(tl['phase_excess_ms']))
+        lines.append(line)
+    return lines
+
+
 def _cluster_lines(cluster):
     """The "Cluster" block (telemetry.cluster.snapshot_cluster's dict):
     one row per host from the last aggregation round, the spread, and
@@ -434,7 +477,7 @@ def _cluster_lines(cluster):
 
 def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
                   cluster=None, roofline=None, ledger=None, goodput=None,
-                  memory=None):
+                  memory=None, timeline=None):
     """Registry snapshot -> aligned text table (one block per kind).
     ``programs`` is telemetry.programs.snapshot_programs()'s {name:
     record} — rendered as a per-program cost table (and the redundant
@@ -453,7 +496,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
     the "Where the time went" block (the ``goodput.*`` gauges are
     elided the same way); ``memory`` is telemetry.memory.analyze()'s
     dict — rendered as the per-layer-peak "memory" block (the
-    ``mem.*`` gauges are elided the same way)."""
+    ``mem.*`` gauges are elided the same way); ``timeline`` is
+    telemetry.timeline's attribution dict — rendered as the
+    critical-path "step timeline" block (the ``timeline.*`` gauges
+    are elided the same way)."""
     lines = ['== telemetry summary%s ==' %
              (' (%.1fs)' % elapsed_s if elapsed_s is not None else '')]
     counters = snapshot.get('counters', {})
@@ -479,6 +525,10 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
         # the memory block already carries these values
         gauges = {n: v for n, v in gauges.items()
                   if not n.startswith('mem.')}
+    if timeline:
+        # the step-timeline block already carries these values
+        gauges = {n: v for n, v in gauges.items()
+                  if not n.startswith('timeline.')}
     if counters:
         lines.append('-- counters --')
         w = max(len(n) for n in counters)
@@ -513,6 +563,8 @@ def summary_table(snapshot, elapsed_s=None, programs=None, health=None,
         lines.extend(_goodput_lines(goodput))
     if cluster:
         lines.extend(_cluster_lines(cluster))
+    if timeline:
+        lines.extend(_timeline_lines(timeline))
     if ledger:
         lines.extend(_ledger_lines(ledger))
     if health:

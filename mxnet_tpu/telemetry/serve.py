@@ -210,7 +210,7 @@ def summary_payload():
     plus the rendered table itself."""
     import time
     from . import programs, health, cluster, roofline, slo
-    from . import dynamics, ledger, goodput, memory
+    from . import dynamics, ledger, goodput, memory, timeline
     from .export import summary_table
     st = _tele()
     snap = st.registry.snapshot()
@@ -233,6 +233,9 @@ def summary_payload():
     # memory: same convention — a fresh read-only analysis (pure: no
     # gauges written, no records emitted)
     mem = memory.analyze()
+    # timeline: the last sync round's critical-path attribution, read
+    # only — a scrape never advances the clock rings or emits a record
+    tl = timeline.snapshot_timeline()
     return {
         'elapsed_s': round(elapsed, 3) if elapsed is not None else None,
         'host': cluster.host_index(),
@@ -246,9 +249,10 @@ def summary_payload():
         'dynamics': dynamics.snapshot_dynamics(),
         'goodput': good,
         'memory': mem,
+        'timeline': tl,
         'table': summary_table(snap, elapsed, programs=progs, health=hs,
                                cluster=clus, roofline=roof, ledger=led,
-                               goodput=good, memory=mem),
+                               goodput=good, memory=mem, timeline=tl),
     }
 
 

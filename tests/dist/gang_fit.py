@@ -182,10 +182,14 @@ def main():
                 Y[lo:lo + per_host], mesh, P('dp'))
             # the fault seams a supervised production step crosses
             faults.maybe_raise('dispatch')
-            w, m, r, loss = jstep(w, m, r, gx, gy)
+            # a telemetry span per step: the JSONL span records are what
+            # tools/trace_merge.py folds into the merged Perfetto trace
+            with telemetry.span('gang_fit.step', 'fit'):
+                w, m, r, loss = jstep(w, m, r, gx, gy)
             faults.note_steps(1)
             telemetry.watchdog.note_progress('gang_fit.step')
             telemetry.cluster.note_step(1)
+            telemetry.timeline.note_step(1)
             if telemetry.enabled():
                 # scalars ledger (MXTPU_SCALARS_EVERY) — what
                 # tools/run_compare.py diffs the compressed arm against
@@ -221,6 +225,8 @@ def main():
     compression.publish_gauges(L, cmode, 'modeled')
     if os.environ.get('GANG_ASSERT_CLUSTER') == '1':
         _assert_cluster(rank, nproc)
+    if os.environ.get('GANG_ASSERT_TIMELINE') == '1':
+        _assert_timeline(rank, nproc)
     if args.out:
         np.save('%s.h%d.npy' % (args.out, rank), np.asarray(w))
     print('GANG_FIT_OK rank=%d procs=%d steps=%d loss=%.6f '
@@ -262,6 +268,44 @@ def _assert_cluster(rank, nproc):
     assert 'host="0"' in prom
     print('GANG_CLUSTER_OK rank=0 hosts=%d snapshot=%s'
           % (nproc, json.dumps(cs['per_host'])), flush=True)
+
+
+def _assert_timeline(rank, nproc):
+    """The pod step-timeline contract on a real gang: process 0 holds a
+    per-host phase ledger with aligned clock offsets and a critical-path
+    verdict; non-zero processes publish nothing.  When the harness
+    injected a clock skew (GANG_TIMELINE_SKEW_MS), the skewed host's
+    offset must stand out from the fleet by at least half the injection
+    — that is the alignment actually *naming* the skewed host."""
+    from mxnet_tpu.telemetry import timeline
+    assert timeline.enabled(), 'timeline plane was off'
+    if rank != 0:
+        assert timeline.snapshot_timeline() is None, \
+            'non-zero process published a timeline snapshot'
+        print('GANG_TIMELINE_OK rank=%d' % rank, flush=True)
+        return
+    tl = timeline.snapshot_timeline()
+    assert tl is not None, 'process 0 published no timeline snapshot'
+    assert tl['hosts'] == nproc, tl
+    hosts = [r['host'] for r in tl['per_host']]
+    assert hosts == list(range(nproc)), hosts
+    offs = {r['host']: r.get('clock_offset_ms') for r in tl['per_host']}
+    assert all(o is not None for o in offs.values()), \
+        ('clock offsets missing — too few sync rounds?', offs)
+    gauges = telemetry.snapshot()['gauges']
+    for i in range(nproc):
+        assert 'cluster.h%d.clock_offset_ms' % i in gauges, \
+            ('missing per-host clock offset gauge', i)
+    assert gauges.get('timeline.critical_host') is not None
+    skew = float(os.environ.get('GANG_TIMELINE_SKEW_MS', '0') or '0')
+    if skew > 0:
+        victim = int(os.environ.get('MXTPU_FAULT_HOST', '0') or '0')
+        rest = [o for h, o in offs.items() if h != victim]
+        assert offs[victim] - max(rest) > skew / 2.0, \
+            ('injected skew not visible in offsets', offs)
+    print('GANG_TIMELINE_OK rank=0 offsets=%s critical=%s:%s'
+          % (json.dumps(offs), tl.get('critical_host'),
+             tl.get('critical_phase')), flush=True)
 
 
 if __name__ == '__main__':

@@ -625,6 +625,54 @@ def test_gang_2proc_fit_cluster_and_shard_only_writes(tmp_path):
 
 @pytest.mark.chaos
 @pytest.mark.slow
+def test_gang_clock_skew_timeline_names_host_and_merges_trace(tmp_path):
+    """The pod step timeline on a REAL 2-process gang under an injected
+    80 ms wall-clock skew on host 1 (clock-skew:0:80 + MXTPU_FAULT_HOST):
+    process 0's NTP-style estimator names the skewed host (asserted
+    in-worker — its offset stands out by > half the injection), the
+    per-round timeline record lands in h0's log, and trace_merge
+    stitches both host logs into ONE offset-corrected Perfetto trace."""
+    env = _e2e_env(tmp_path, MXTPU_TELEMETRY_SYNC_EVERY='4',
+                   MXTPU_TIMELINE='1',
+                   MXTPU_FAULT_INJECT='clock-skew:0:80',
+                   MXTPU_FAULT_HOST='1',
+                   GANG_ASSERT_TIMELINE='1',
+                   GANG_TIMELINE_SKEW_MS='80')
+    proc = _run_gang_fit(tmp_path, 2, env)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-3000:]
+    assert out.count('GANG_FIT_OK') == 2, out[-3000:]
+    # in-worker timeline asserts ran on both ranks; process 0 named the
+    # skewed host via the offset gap
+    assert '[h0] GANG_TIMELINE_OK rank=0' in out
+    assert '[h1] GANG_TIMELINE_OK rank=1' in out
+    # the per-round timeline record trail lives in h0's jsonl and keeps
+    # the skew direction: host 1's wall clock runs ~80 ms ahead
+    tls = [r for r in _records(tmp_path / 'logs' / 'h0.jsonl')
+           if r.get('type') == 'timeline']
+    assert tls, 'no timeline record in h0.jsonl'
+    offs = {r['host']: r.get('clock_offset_ms')
+            for r in tls[-1]['per_host']}
+    assert offs[1] is not None and offs[0] is not None, offs
+    assert offs[1] - offs[0] > 40.0, offs
+    # one merged Perfetto trace out of the gang log dir: both hosts as
+    # separate pids on the offset-corrected shared clock
+    import trace_merge
+    merged = tmp_path / 'pod.trace.json'
+    assert trace_merge.main([str(tmp_path / 'logs'),
+                             '-o', str(merged)]) == 0
+    doc = json.loads(merged.read_text())
+    assert doc['displayTimeUnit'] == 'ms'
+    events = [e for e in doc['traceEvents'] if e.get('ph') == 'X']
+    assert {e['pid'] for e in events} == {0, 1}, 'both hosts must appear'
+    names = {e['args']['name']
+             for e in doc['traceEvents'] if e.get('ph') == 'M'}
+    assert any('host 0' in n for n in names), names
+    assert any('host 1' in n for n in names), names
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
 def test_gang_host_loss_relaunch_agreed_restore_parity(tmp_path):
     """Kill worker 1 mid-run (host-loss:6, MXTPU_FAULT_HOST=1): the
     gang tears down, relaunches on a fresh port, restores from the

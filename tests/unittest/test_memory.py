@@ -253,7 +253,9 @@ def test_local_headroom_nan_contract(mem_on):
     memory.record_sample(1, 500 * _MIB, 1000 * _MIB)
     assert memory.local_headroom() == pytest.approx(50.0)
     from mxnet_tpu.telemetry import cluster
-    assert cluster.SYNC_KEYS[-1] == 'mem_headroom_pct'
+    # slot 9 of the append-only sync vector (the timeline plane's
+    # slots were appended after it)
+    assert cluster.SYNC_KEYS[9] == 'mem_headroom_pct'
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,582 @@
+"""Pod step timeline (mxnet_tpu/telemetry/timeline).
+
+Contracts under test:
+- offset estimation: per-round walls -> offsets vs the fleet median
+  (NaN rows — senders without a sample yet — stay NaN, a single host
+  is always at offset 0), the bounded ring's median tolerates one
+  noisy barrier exit, and a wall clock that STEPS against its
+  monotonic companion discards its ring instead of averaging;
+- the gang-step decomposition (compute / collective-wait / io /
+  host-side) and critical-path attribution: gating host AND phase,
+  skew = slowest minus fastest, NaN-padded short rows (old senders)
+  never crash the round;
+- the sync-vector contract: cluster.SYNC_KEYS grew append-only and
+  its timeline slots mirror timeline.SLOTS; local_slots() is all-NaN
+  while off;
+- the clock-skew chaos fault shifts exactly the armed host's wall
+  samples by the requested ms;
+- MXTPU_TIMELINE=0/1 parametrized fit acceptance: =1 puts a "step
+  timeline" block in the summary plus timeline.* gauges and a JSONL
+  record; =0 leaves no trace anywhere;
+- the no-op contract: the lowered step HLO is byte-identical with the
+  flag on or off (everything here is host-side arithmetic);
+- the offline CLIs: tools/timeline_report.py renders the JSONL record
+  byte-identically to the live summary block, and tools/trace_merge.py
+  merges crafted 2-host logs into ONE offset-corrected chrome trace
+  with pid=host.
+"""
+import json
+import math
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import faults
+from mxnet_tpu import telemetry
+from mxnet_tpu.config import flags
+from mxnet_tpu.telemetry import cluster
+from mxnet_tpu.telemetry import timeline
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.join(REPO, 'tools'))
+
+_FLAGS = ('MXTPU_TELEMETRY', 'MXTPU_TELEMETRY_PATH', 'MXTPU_TIMELINE',
+          'MXTPU_FAULT_INJECT', 'MXTPU_FAULT_HOST')
+
+NAN = float('nan')
+
+
+def _reload_flags():
+    for f in _FLAGS:
+        flags.reload(f)
+
+
+@pytest.fixture
+def tl_on(tmp_path, monkeypatch):
+    """Telemetry + timeline plane ON, logging to a tmp JSONL."""
+    path = tmp_path / 'timeline.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_TIMELINE', '1')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    yield path
+    telemetry._reset_for_tests()
+    for f in _FLAGS:
+        monkeypatch.delenv(f, raising=False)
+    _reload_flags()
+
+
+def _records(path):
+    with open(path) as f:
+        return [json.loads(ln) for ln in f if ln.strip()]
+
+
+def _flush():
+    telemetry._state.sink.flush()
+
+
+def _row(step_ms=NAN, comm=NAN, proc=0.0, wall=NAN, mono=NAN, **phases):
+    """One SYNC_KEYS-shaped vector row (NaN everywhere not named)."""
+    keys = cluster.SYNC_KEYS
+    row = [NAN] * len(keys)
+    row[keys.index('step_time_ms')] = step_ms
+    row[keys.index('comm_pct')] = comm
+    row[keys.index('proc_index')] = proc
+    row[keys.index('clock_wall_s')] = wall
+    row[keys.index('clock_mono_s')] = mono
+    for k, p in enumerate(timeline.PHASES):
+        if p in phases:
+            row[keys.index(timeline.SLOTS[2 + k])] = phases[p]
+    return row
+
+
+# ---------------------------------------------------------------------------
+# sync-vector contract
+# ---------------------------------------------------------------------------
+
+def test_sync_keys_grew_append_only():
+    # slots 0-9 predate this plane (their indices are load-bearing for
+    # old senders); the timeline slots are EXACTLY the appended tail
+    assert cluster.SYNC_KEYS[:10] == (
+        'step_time_ms', 'io_wait_pct', 'dispatch_ms', 'live_bytes',
+        'comm_pct', 'proc_index', 'goodput_pct', 'badput_top',
+        'comm_src', 'mem_headroom_pct')
+    assert cluster.SYNC_KEYS[10:] == timeline.SLOTS
+    assert timeline.SLOTS[2:] == tuple(
+        't' + 'l_' + s for s in ('draw_ms', 'put_ms', 'dispatch_ms',
+                                 'fetch_ms', 'ckpt_ms', 'kv_ms'))
+
+
+def test_local_slots_nan_while_off():
+    telemetry._reset_for_tests()
+    assert not timeline.enabled()
+    slots = timeline.local_slots()
+    assert len(slots) == len(timeline.SLOTS)
+    assert all(math.isnan(v) for v in slots)
+
+
+def test_local_slots_carry_phases(tl_on):
+    assert timeline.enabled()
+    timeline.note_step(2)
+    timeline.note_span('fused_fit.draw', 6.0)
+    timeline.note_span('fused_fit.dispatch', 10.0)
+    timeline.note_span('not.a.phase', 99.0)
+    timeline.note_sync_exit()
+    slots = timeline.local_slots()
+    by = dict(zip(timeline.SLOTS, slots))
+    assert math.isfinite(by['clock_wall_s'])
+    assert math.isfinite(by['clock_mono_s'])
+    assert by['tl_draw_ms'] == pytest.approx(3.0)      # 6 ms / 2 steps
+    assert by['tl_dispatch_ms'] == pytest.approx(5.0)
+    assert by['tl_fetch_ms'] == pytest.approx(0.0)
+    # the round window reset: a second read with no new steps is NaN
+    assert all(math.isnan(v) for v in timeline.local_slots()[2:])
+
+
+# ---------------------------------------------------------------------------
+# offset estimation
+# ---------------------------------------------------------------------------
+
+def test_estimate_offsets_median_and_nan():
+    offs = timeline.estimate_offsets([100.0, 100.08, NAN])
+    assert offs[0] == pytest.approx(-40.0)
+    assert offs[1] == pytest.approx(40.0)
+    assert math.isnan(offs[2])
+    # a single host is its own median: always offset 0
+    assert timeline.estimate_offsets([123.4]) == [0.0]
+    # nobody sampled yet: all NaN, no crash
+    assert all(math.isnan(v) for v in timeline.estimate_offsets([NAN, NAN]))
+
+
+def test_offset_ring_median_tolerates_noise(tl_on):
+    # 5 rounds of a steady 80 ms skew on host 1, one noisy barrier
+    # exit (+30 ms) in the middle: the ring median stays at the truth
+    for i, noise in enumerate([0.0, 0.0, 0.030, 0.0, 0.0]):
+        t = 1000.0 + i
+        out = timeline._note_round_clocks(
+            [t, t + 0.080 + noise], [t, t], [0, 1])
+    assert out[0] == pytest.approx(-40.0)
+    assert out[1] == pytest.approx(40.0)
+
+
+def test_wall_step_discards_ring(tl_on):
+    # two clean rounds, then host 1's wall JUMPS 0.5 s while its
+    # monotonic advances 1 s like everyone else: ntpdate, not drift —
+    # the stale ring history is discarded, and the post-step rounds
+    # rebuild from the new clock alone
+    timeline._note_round_clocks([1000.0, 1000.080], [50.0, 50.0], [0, 1])
+    timeline._note_round_clocks([1001.0, 1001.080], [51.0, 51.0], [0, 1])
+    out = timeline._note_round_clocks(
+        [1002.0, 1002.580], [52.0, 52.0], [0, 1])
+    # the post-step round seeds a fresh ring with the new offsets
+    assert out[1] == pytest.approx(290.0)
+    assert len(timeline._state.offset_rings[1]) == 1
+    assert len(timeline._state.offset_rings[0]) == 3
+
+
+# ---------------------------------------------------------------------------
+# decomposition + critical path (pure)
+# ---------------------------------------------------------------------------
+
+def test_decompose_buckets():
+    d = timeline.decompose(10.0, {'draw': 2.0, 'put': 1.0, 'fetch': 0.5,
+                                  'checkpoint': 0.3, 'kvstore': 0.2},
+                           comm_pct=20.0)
+    assert d['collective_ms'] == pytest.approx(2.0)
+    assert d['io_ms'] == pytest.approx(3.0)
+    assert d['host_ms'] == pytest.approx(1.0)
+    assert d['compute_ms'] == pytest.approx(4.0)
+    # over-attributed phases clamp compute at 0, never negative
+    d2 = timeline.decompose(1.0, {'draw': 5.0}, comm_pct=None)
+    assert d2['compute_ms'] == 0.0
+
+
+def test_attribute_names_gating_host_and_phase():
+    mat = [_row(step_ms=10.0, comm=20.0, proc=0.0, draw=0.4, put=0.2,
+                dispatch=1.0, fetch=0.1),
+           _row(step_ms=14.0, comm=15.0, proc=1.0, draw=4.5, put=0.2,
+                dispatch=1.1, fetch=0.1)]
+    out = timeline.attribute(mat, step=200,
+                             offsets={0: -40.0, 1: 40.0})
+    assert out['hosts'] == 2
+    assert out['gang_step_ms'] == pytest.approx(14.0)
+    assert out['skew_ms'] == pytest.approx(4.0)
+    assert out['critical_host'] == 1
+    assert out['critical_phase'] == 'draw'
+    assert out['phase_excess_ms'] == pytest.approx(4.1)
+    rows = {r['host']: r for r in out['per_host']}
+    assert rows[1]['clock_offset_ms'] == 40.0
+    assert rows[0]['collective_ms'] == pytest.approx(2.0)
+    assert rows[1]['io_ms'] == pytest.approx(4.7)
+
+
+def test_attribute_single_host_largest_share():
+    out = timeline.attribute([_row(step_ms=8.0, proc=0.0, draw=1.0,
+                                   fetch=5.0)])
+    assert out['skew_ms'] == 0.0
+    assert out['critical_host'] == 0
+    assert out['critical_phase'] == 'fetch'
+
+
+def test_attribute_tolerates_short_and_nan_rows():
+    # an old sender's row stops at mem_headroom_pct: the matrix is
+    # only 10 wide — every timeline slot reads NaN, nothing crashes
+    mat = np.array([[5.0, 10.0, 4.0, 1e6, NAN, 0.0, NAN, NAN, NAN, NAN],
+                    [9.0, 40.0, 8.0, 2e6, NAN, 1.0, NAN, NAN, NAN, NAN]])
+    out = timeline.attribute(mat)
+    assert out['gang_step_ms'] == pytest.approx(9.0)
+    assert out['critical_host'] == 1
+    assert out['skew_ms'] == pytest.approx(4.0)
+    # all-NaN step times: per-host rows only, no verdict keys
+    out2 = timeline.attribute([_row(), _row(proc=1.0)])
+    assert out2['hosts'] == 2
+    assert 'critical_host' not in out2
+
+
+def test_publish_round_gauges_and_record(tl_on):
+    mat = [_row(step_ms=10.0, comm=20.0, proc=0.0, wall=1000.0,
+                mono=50.0, draw=0.4, put=0.2, dispatch=1.0, fetch=0.1),
+           _row(step_ms=14.0, comm=15.0, proc=1.0, wall=1000.080,
+                mono=50.0, draw=4.5, put=0.2, dispatch=1.1, fetch=0.1)]
+    out = timeline.publish_round(np.array(mat), [0, 1], 100)
+    assert out['critical_host'] == 1
+    g = telemetry.snapshot()['gauges']
+    assert g['cluster.h0.clock_offset_ms'] == pytest.approx(-40.0)
+    assert g['cluster.h1.clock_offset_ms'] == pytest.approx(40.0)
+    assert g['timeline.critical_host'] == 1
+    assert g['timeline.critical_phase'] == 'draw'
+    assert g['timeline.skew_ms'] == pytest.approx(4.0)
+    assert g['timeline.gang_step_ms'] == pytest.approx(14.0)
+    assert timeline.snapshot_timeline()['critical_phase'] == 'draw'
+    _flush()
+    recs = [r for r in _records(telemetry._state.sink.path)
+            if r['type'] == 'timeline']
+    assert recs and recs[-1]['critical_host'] == 1
+    assert recs[-1]['per_host'][1]['clock_offset_ms'] == 40.0
+
+
+# ---------------------------------------------------------------------------
+# clock-skew chaos fault
+# ---------------------------------------------------------------------------
+
+def test_clock_skew_fault_shifts_wall(monkeypatch):
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'clock-skew:2:80')
+    faults._reset_for_tests()
+    try:
+        assert faults.enabled()
+        assert faults.clock_skew_ms() == 0.0    # step 0 < armed step 2
+        faults.note_steps(2)
+        assert faults.clock_skew_ms() == 80.0
+        faults.note_steps(10)                   # persistent, never disarms
+        assert faults.clock_skew_ms() == 80.0
+    finally:
+        monkeypatch.delenv('MXTPU_FAULT_INJECT', raising=False)
+        faults._reset_for_tests()
+
+
+def test_clock_skew_fault_default_and_host_scope(monkeypatch):
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'clock-skew:0')
+    faults._reset_for_tests()
+    try:
+        assert faults.clock_skew_ms() == 100.0   # default ms
+    finally:
+        monkeypatch.delenv('MXTPU_FAULT_INJECT', raising=False)
+        faults._reset_for_tests()
+    # host-scoped: a non-matching MXTPU_FAULT_HOST never arms
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'clock-skew:0:80')
+    monkeypatch.setenv('MXTPU_FAULT_HOST', '7')
+    faults._reset_for_tests()
+    try:
+        assert faults.clock_skew_ms() == 0.0
+    finally:
+        monkeypatch.delenv('MXTPU_FAULT_INJECT', raising=False)
+        monkeypatch.delenv('MXTPU_FAULT_HOST', raising=False)
+        faults._reset_for_tests()
+
+
+def test_note_sync_exit_carries_injected_skew(tl_on, monkeypatch):
+    monkeypatch.setenv('MXTPU_FAULT_INJECT', 'clock-skew:0:80')
+    faults._reset_for_tests()
+    try:
+        import time
+        before = time.time()
+        timeline.note_sync_exit()
+        shifted = timeline._state.pend_wall
+        assert shifted - before >= 0.075        # the 80 ms shift rode along
+    finally:
+        monkeypatch.delenv('MXTPU_FAULT_INJECT', raising=False)
+        faults._reset_for_tests()
+
+
+# ---------------------------------------------------------------------------
+# fit acceptance + no-op contract
+# ---------------------------------------------------------------------------
+
+def _mlp_fit():
+    np.random.seed(0)
+    mx.random.seed(0)
+    data = mx.sym.Variable('data')
+    fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+    act = mx.sym.Activation(fc1, act_type='relu', name='relu1')
+    fc2 = mx.sym.FullyConnected(act, num_hidden=4, name='fc2')
+    out = mx.sym.SoftmaxOutput(fc2, name='softmax')
+    X = np.random.randn(32, 10).astype(np.float32)
+    y = (np.random.rand(32) * 4).astype(int).astype(np.float32)
+    it = mx.io.NDArrayIter(X, y, batch_size=8,
+                           label_name='softmax_label')
+    mod = mx.mod.Module(out, context=mx.cpu())
+    mod.fit(it, num_epoch=1, optimizer='sgd',
+            optimizer_params=(('learning_rate', 0.1),))
+    return mod
+
+
+@pytest.mark.parametrize('tl', ['0', '1'])
+def test_fit_acceptance_on_off(tl, tmp_path, monkeypatch):
+    """=1: the summary carries a step-timeline block naming the
+    critical phase, plus timeline.* gauges and a JSONL record. =0: no
+    trace anywhere."""
+    path = tmp_path / 'onoff.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_TIMELINE', tl)
+    _reload_flags()
+    telemetry._reset_for_tests()
+    try:
+        _mlp_fit()
+        table = telemetry.write_summary(log=False)
+        recs = _records(path)
+        gauges = telemetry.snapshot()['gauges']
+        tl_gauges = [n for n in gauges if n.startswith('timeline.')]
+        if tl == '0':
+            assert not timeline.enabled()
+            assert '-- step timeline --' not in table
+            assert tl_gauges == []
+            assert not any(r['type'] == 'timeline' for r in recs)
+            assert timeline.snapshot_timeline() is None
+        else:
+            assert timeline.enabled()
+            assert '-- step timeline --' in table
+            assert 'critical_path' in table
+            d = timeline.snapshot_timeline()
+            assert d and d['per_host']
+            assert d['critical_host'] == 0
+            assert d['critical_phase'] in timeline.PHASES + (
+                'compute', 'collective')
+            assert gauges['timeline.critical_phase'] == \
+                d['critical_phase']
+            assert d['per_host'][0]['step_time_ms'] > 0
+            # every measured phase landed in the row
+            ph = d['per_host'][0]['phases']
+            assert ph['draw'] is not None and ph['dispatch'] is not None
+            tls = [r for r in recs if r['type'] == 'timeline']
+            assert tls and tls[-1]['critical_host'] == 0
+            summ = [r for r in recs if r['type'] == 'summary'][-1]
+            assert summ.get('timeline')
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+def test_timeline_off_lowering_byte_identical(tmp_path, monkeypatch):
+    """The plane is host-side arithmetic over already-collected
+    numbers — the lowered step program is byte-identical with the flag
+    on or off. The acceptance criterion's no-op contract."""
+    import jax.numpy as jnp
+    from mxnet_tpu import random as _random
+
+    def _lowered_text(tl_flag):
+        telemetry._reset_for_tests()
+        monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+        monkeypatch.setenv('MXTPU_TELEMETRY_PATH',
+                           str(tmp_path / ('t%s.jsonl' % tl_flag)))
+        monkeypatch.setenv('MXTPU_TIMELINE', tl_flag)
+        _reload_flags()
+        telemetry._reset_for_tests()
+        np.random.seed(0)
+        mx.random.seed(0)
+        data = mx.sym.Variable('data')
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name='fc1')
+        out = mx.sym.SoftmaxOutput(fc1, name='softmax')
+        mod = mx.mod.Module(out, context=mx.cpu())
+        mod.bind(data_shapes=[('data', (8, 10))],
+                 label_shapes=[('softmax_label', (8,))])
+        mod.init_params()
+        ex = mod._exec_group.execs[0]
+        arg_data = tuple(a._data for a in ex.arg_arrays)
+        aux_data = tuple(a._data for a in ex.aux_arrays)
+        heads = (jnp.ones((8, 16), jnp.float32),)
+        return ex._fwd_bwd.lower(arg_data, aux_data, _random.next_key(),
+                                 heads).as_text()
+
+    try:
+        assert _lowered_text('0') == _lowered_text('1')
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+# ---------------------------------------------------------------------------
+# offline CLIs
+# ---------------------------------------------------------------------------
+
+def test_timeline_report_byte_identical(tmp_path, monkeypatch, capsys):
+    """The offline CLI renders the JSONL record into EXACTLY the block
+    the live summary table logged (same renderer — the round-trip this
+    plane pins, like roofline_report/memory_report before it)."""
+    path = tmp_path / 'roundtrip.jsonl'
+    monkeypatch.setenv('MXTPU_TELEMETRY', '1')
+    monkeypatch.setenv('MXTPU_TELEMETRY_PATH', str(path))
+    monkeypatch.setenv('MXTPU_TIMELINE', '1')
+    _reload_flags()
+    telemetry._reset_for_tests()
+    try:
+        _mlp_fit()
+        table = telemetry.write_summary(log=False)
+        start = table.index('-- step timeline --')
+        block = table[start:]
+        for stop in ('\n-- ', '\n== '):
+            if stop in block:
+                block = block[:block.index(stop)]
+        block = block.rstrip('\n')
+        import timeline_report
+        assert timeline_report.main([str(path)]) == 0
+        out = capsys.readouterr().out.rstrip('\n')
+        assert out == block
+        # --json round-trips the raw dict
+        assert timeline_report.main([str(path), '--json']) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert d['critical_host'] == 0
+    finally:
+        telemetry._reset_for_tests()
+        for f in _FLAGS:
+            monkeypatch.delenv(f, raising=False)
+        _reload_flags()
+
+
+def test_timeline_report_no_record_exits_1(tmp_path, capsys):
+    path = tmp_path / 'empty.jsonl'
+    path.write_text(json.dumps({'type': 'span', 'name': 'fit.batch',
+                                'dur_ms': 1.0, 't': 10.0}) + '\n')
+    import timeline_report
+    assert timeline_report.main([str(path)]) == 1
+    assert 'MXTPU_TIMELINE' in capsys.readouterr().err
+
+
+def _craft_gang_logs(log_dir):
+    """Two hosts' JSONL logs with known clocks: host 1's wall runs
+    80 ms ahead, so its offset is +40 vs the 2-host median. One span
+    per host at the SAME true time, 5 ms long."""
+    log_dir.mkdir(parents=True, exist_ok=True)
+    t0 = 1000.0
+    tl = {'type': 'timeline', 't': t0 + 9.0, 'host': 0, 'hosts': 2,
+          'per_host': [
+              {'host': 0, 'step_time_ms': 10.0, 'clock_offset_ms': -40.0},
+              {'host': 1, 'step_time_ms': 14.0, 'clock_offset_ms': 40.0}],
+          'gang_step_ms': 14.0, 'skew_ms': 4.0,
+          'critical_host': 1, 'critical_phase': 'draw'}
+    h0 = [{'type': 'span', 'name': 'fused_fit.dispatch', 't': t0 - 0.040,
+           'dur_ms': 5.0, 'host': 0}, tl]
+    h1 = [{'type': 'span', 'name': 'fused_fit.dispatch', 't': t0 + 0.040,
+           'dur_ms': 5.0, 'host': 1}]
+    (log_dir / 'h0.jsonl').write_text(
+        '\n'.join(json.dumps(r) for r in h0) + '\n')
+    (log_dir / 'h1.jsonl').write_text(
+        '\n'.join(json.dumps(r) for r in h1) + '\n')
+    return t0
+
+
+def test_trace_merge_golden(tmp_path, capsys):
+    """The crafted 2-host pair merges into ONE chrome trace: both pids
+    present, offsets in the process labels, and the two spans — which
+    happened at the same TRUE time on skewed clocks — land on the same
+    corrected timestamp."""
+    t0 = _craft_gang_logs(tmp_path / 'logs')
+    out_path = tmp_path / 'merged.json'
+    import trace_merge
+    assert trace_merge.main([str(tmp_path / 'logs'),
+                             '-o', str(out_path)]) == 0
+    doc = json.loads(out_path.read_text())
+    assert doc['displayTimeUnit'] == 'ms'
+    evs = doc['traceEvents']
+    meta = [e for e in evs if e['ph'] == 'M']
+    spans = [e for e in evs if e['ph'] == 'X']
+    assert {e['pid'] for e in meta} == {0, 1}
+    assert {e['pid'] for e in spans} == {0, 1}
+    labels = {e['pid']: e['args']['name'] for e in meta}
+    assert 'offset -40.000 ms' in labels[0]
+    assert 'offset +40.000 ms' in labels[1]
+    ts = {e['pid']: e['ts'] for e in spans}
+    # span 't' is the START stamp (telemetry._Span emits t0): corrected
+    # start = t - offset, identical for both hosts
+    assert ts[0] == pytest.approx(ts[1])
+    assert ts[0] == pytest.approx(t0 * 1e6)
+    assert all(e['dur'] == pytest.approx(5000.0) for e in spans)
+
+
+def test_trace_merge_no_timeline_warns(tmp_path, capsys):
+    p = tmp_path / 'h0.jsonl'
+    p.write_text(json.dumps({'type': 'span', 'name': 'fit.batch',
+                             't': 10.0, 'dur_ms': 2.0, 'host': 0}) + '\n')
+    out_path = tmp_path / 'merged.json'
+    import trace_merge
+    assert trace_merge.main([str(p), '-o', str(out_path)]) == 0
+    err = capsys.readouterr().err
+    assert 'MXTPU_TIMELINE' in err
+    doc = json.loads(out_path.read_text())
+    assert any(e['ph'] == 'X' for e in doc['traceEvents'])
+
+
+def test_trace_merge_folds_chrome_trace(tmp_path):
+    t0 = _craft_gang_logs(tmp_path / 'logs')
+    chrome = tmp_path / 'h1.trace.json'
+    chrome.write_text(json.dumps({'traceEvents': [
+        {'name': 'device_compute', 'cat': 'xla', 'ph': 'X',
+         'ts': (t0 + 0.040) * 1e6, 'dur': 3000.0, 'pid': 999, 'tid': 4},
+        {'name': 'process_name', 'ph': 'M', 'pid': 999,
+         'args': {'name': 'stale'}}], 'displayTimeUnit': 'ms'}))
+    out_path = tmp_path / 'merged.json'
+    import trace_merge
+    assert trace_merge.main([str(tmp_path / 'logs'),
+                             '--trace', '1=%s' % chrome,
+                             '-o', str(out_path)]) == 0
+    doc = json.loads(out_path.read_text())
+    dev = [e for e in doc['traceEvents'] if e['name'] == 'device_compute']
+    assert len(dev) == 1
+    assert dev[0]['pid'] == 1                      # re-stamped onto host 1
+    assert dev[0]['ts'] == pytest.approx(t0 * 1e6)  # offset-corrected
+    # the stale metadata row was dropped (the merge re-emits its own)
+    assert not any(e.get('args', {}).get('name') == 'stale'
+                   for e in doc['traceEvents'] if e['ph'] == 'M')
+
+
+def test_telemetry_report_renders_timeline_block(tl_on, capsys):
+    _mlp_fit()
+    telemetry.write_summary(log=False)
+    _flush()
+    import telemetry_report
+    assert telemetry_report.main([os.environ['MXTPU_TELEMETRY_PATH']]) == 0
+    out = capsys.readouterr().out
+    assert '-- step timeline --' in out
+    assert 'critical_path' in out
+
+
+def test_watch_renders_timeline_row():
+    import telemetry_watch
+    summary = {
+        'elapsed_s': 50.0, 'host': 0,
+        'snapshot': {'counters': {}, 'gauges': {}, 'histograms': {}},
+        'timeline': {'critical_host': 3, 'critical_phase': 'draw',
+                     'skew_ms': 4.1, 'gang_step_ms': 14.0}}
+    lines = telemetry_watch.render(summary)
+    row = next(ln for ln in lines if ln.startswith('  timeline'))
+    assert 'host 3 draw' in row
+    assert 'skew 4.1 ms/step' in row

@@ -45,6 +45,10 @@ Compared metrics, with direction and default tolerance:
   telemetry/memory.py)                     — lower is a regression (10%:
   the program's HBM footprint grew toward the limit even when the step
   time held — the next model tweak OOMs instead of landing)
+- ``host_overhead_pct`` (the step timeline's host-side share of the
+  step, telemetry/timeline.py)             — higher is a regression (10%:
+  host-side work — stats fetch, checkpoint commit, kvstore traffic —
+  crept into the step where the device used to overlap it)
 
 A delta past tolerance in the bad direction prints REGRESSION and the
 exit code is 1 — wire it straight into CI after a bench round.
@@ -68,17 +72,20 @@ _DEF_TOL = {'throughput': 5.0, 'mfu': 5.0, 'xla_temp_bytes': 10.0,
             'opt_state_bytes_per_device': 10.0, 'compile_s': 25.0,
             'serving_p99_ms': 10.0, 'serving_queue_wait_p50_ms': 10.0,
             'final_loss': 5.0, 'goodput_pct': 5.0,
-            'bytes_on_wire_per_step': 10.0, 'mem_headroom_pct': 10.0}
+            'bytes_on_wire_per_step': 10.0, 'mem_headroom_pct': 10.0,
+            'host_overhead_pct': 10.0}
 _DIRECTION = {'throughput': -1, 'mfu': -1, 'xla_temp_bytes': +1,
               'xla_live_bytes': +1,
               'opt_state_bytes_per_device': +1, 'compile_s': +1,
               'serving_p99_ms': +1, 'serving_queue_wait_p50_ms': +1,
               'final_loss': +1, 'goodput_pct': -1,
-              'bytes_on_wire_per_step': +1, 'mem_headroom_pct': -1}
+              'bytes_on_wire_per_step': +1, 'mem_headroom_pct': -1,
+              'host_overhead_pct': +1}
 _ORDER = ('throughput', 'mfu', 'xla_temp_bytes', 'xla_live_bytes',
           'opt_state_bytes_per_device', 'compile_s', 'serving_p99_ms',
           'serving_queue_wait_p50_ms', 'final_loss', 'goodput_pct',
-          'bytes_on_wire_per_step', 'mem_headroom_pct')
+          'bytes_on_wire_per_step', 'mem_headroom_pct',
+          'host_overhead_pct')
 
 
 def load_bench(path):
@@ -186,6 +193,11 @@ def extract(rec):
     # NEXT change rather than this one
     if rec.get('mem_headroom_pct') is not None:
         out['mem_headroom_pct'] = float(rec['mem_headroom_pct'])
+    # host-side share of the step (telemetry/timeline.py): a RISE means
+    # fetch/checkpoint/kvstore work stopped overlapping the device —
+    # the step got slower for a reason throughput alone may hide
+    if rec.get('host_overhead_pct') is not None:
+        out['host_overhead_pct'] = float(rec['host_overhead_pct'])
     return out
 
 

@@ -275,8 +275,8 @@ def _reconstruct_goodput(records, snapshot, elapsed, roofline, ledger):
 
 def _summary_parts(records):
     """(snapshot, elapsed, programs, health, cluster, roofline, ledger,
-    goodput, memory, reconstructed) for one host's record list — the
-    last summary record when present, else the crashed-run
+    goodput, memory, timeline, reconstructed) for one host's record
+    list — the last summary record when present, else the crashed-run
     reconstruction."""
     summaries = [r for r in records if r.get('type') == 'summary']
     clus_recs = [r for r in records if r.get('type') == 'cluster']
@@ -299,6 +299,15 @@ def _summary_parts(records):
     if memory is not None:
         memory = {k: v for k, v in memory.items()
                   if k not in ('type', 't', 'host')}
+    # the step timeline too: every sync round appends a standalone
+    # ``timeline`` record (process 0 only), so a crashed run keeps its
+    # last critical-path verdict; a clean run folds the final one into
+    # the summary record (preferred below)
+    tl_recs = [r for r in records if r.get('type') == 'timeline']
+    timeline = tl_recs[-1] if tl_recs else None
+    if timeline is not None:
+        timeline = {k: v for k, v in timeline.items()
+                    if k not in ('type', 't', 'host')}
     if summaries:
         s = summaries[-1]
         health = s.get('health')
@@ -328,22 +337,23 @@ def _summary_parts(records):
         return (s.get('snapshot') or {}, s.get('elapsed_s'),
                 s.get('programs'), health,
                 s.get('cluster') or cluster, roof, led, good,
-                s.get('memory') or memory, False)
+                s.get('memory') or memory, s.get('timeline') or timeline,
+                False)
     snapshot, elapsed, programs, health = _reconstruct(records)
     led = _reconstruct_ledger(records)
     good = _reconstruct_goodput(records, snapshot, elapsed, roofline, led)
     return (snapshot, elapsed, programs, health, cluster, roofline,
-            led, good, memory, True)
+            led, good, memory, timeline, True)
 
 
 def render(records):
     """The summary table for a parsed record list, as a string."""
     (snapshot, elapsed, programs, health, cluster, roofline, led, good,
-     memory, reco) = _summary_parts(records)
+     memory, timeline, reco) = _summary_parts(records)
     table = summary_table(snapshot, elapsed, programs=programs,
                           health=health, cluster=cluster,
                           roofline=roofline, ledger=led, goodput=good,
-                          memory=memory)
+                          memory=memory, timeline=timeline)
     if reco:
         table += ('\n(no summary record found — reconstructed from '
                   '%d individual records; registry-only counters and '
@@ -437,7 +447,7 @@ def render_hosts(by_host):
     rows = []
     for host in sorted(by_host):
         (snapshot, elapsed, programs, health, cluster, roof, _led,
-         good, _mem, reco) = _summary_parts(by_host[host])
+         good, _mem, _tl, reco) = _summary_parts(by_host[host])
         steps = snapshot.get('counters', {}).get('fit.steps')
         if steps is None:
             steps = (snapshot.get('histograms', {})

@@ -46,6 +46,9 @@ def fetch(source):
     mems = [r for r in records if r.get('type') == 'memory']
     last_mem = ({k: v for k, v in mems[-1].items()
                  if k not in ('type', 't', 'host')} if mems else None)
+    tls = [r for r in records if r.get('type') == 'timeline']
+    last_tl = ({k: v for k, v in tls[-1].items()
+                if k not in ('type', 't', 'host')} if tls else None)
     if summaries:
         s = summaries[-1]
         return {'elapsed_s': s.get('elapsed_s'),
@@ -56,6 +59,7 @@ def fetch(source):
                 'cluster': s.get('cluster')
                 or (clus[-1] if clus else None),
                 'memory': s.get('memory') or last_mem,
+                'timeline': s.get('timeline') or last_tl,
                 'ledger': s.get('ledger')
                 or telemetry_report._reconstruct_ledger(records),
                 'goodput': s.get('goodput')
@@ -72,6 +76,7 @@ def fetch(source):
             'programs': programs, 'health': health,
             'cluster': clus[-1] if clus else None,
             'memory': last_mem,
+            'timeline': last_tl,
             'ledger': led,
             'goodput': telemetry_report._reconstruct_goodput(
                 records, snapshot, elapsed,
@@ -194,6 +199,23 @@ def render(summary, steps_per_s=None, reqs_per_s=None):
         if g.get('mem.pressure', 1 if mem.get('pressure') else None):
             bits.append('MEM_PRESSURE')
         lines.append('  memory       %s' % ', '.join(bits))
+    # step timeline (MXTPU_TIMELINE): who gates the gang step and by
+    # how much — from the timeline.* gauges or (JSONL mode) the last
+    # timeline record / summary fold
+    tl = summary.get('timeline') or {}
+    crit_host = g.get('timeline.critical_host', tl.get('critical_host'))
+    crit_phase = g.get('timeline.critical_phase', tl.get('critical_phase'))
+    if crit_host is not None or crit_phase is not None:
+        bits = ['critical host %s %s'
+                % ('-' if crit_host is None else int(crit_host),
+                   crit_phase or '-')]
+        skew = g.get('timeline.skew_ms', tl.get('skew_ms'))
+        if skew is not None:
+            bits.append('skew %s ms/step' % _fmt(float(skew)))
+        gs = g.get('timeline.gang_step_ms', tl.get('gang_step_ms'))
+        if gs is not None:
+            bits.append('gang step %s ms' % _fmt(float(gs)))
+        lines.append('  timeline     %s' % ', '.join(bits))
     if g.get('update.opt_state_bytes_per_device') is not None:
         # sharded weight update (MXTPU_SHARDED_UPDATE): whether the
         # ZeRO layout is engaged and what the optimizer state costs

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Render the step-timeline critical-path block from a telemetry JSONL
+log, offline.
+
+A run with ``MXTPU_TELEMETRY=1 MXTPU_TIMELINE=1`` appends a
+``timeline`` record per sync round (process 0) and folds the final one
+into the ``summary`` record — the gang step decomposed into compute /
+collective-wait / io / host-side per host, with the gating host and
+phase named. This tool re-renders it without re-running anything::
+
+    python tools/timeline_report.py telemetry.jsonl
+    python tools/timeline_report.py /mnt/run1/logs   # gang log dir
+
+Uses the SAME renderer as the live end-of-run summary
+(mxnet_tpu/telemetry/export.py::_timeline_lines), so the offline block
+is byte-identical to the one the run logged — the round-trip the
+timeline tests pin. ``--json`` dumps the raw attribution dict instead
+(for scripting: jq over per_host/critical_phase). Multiple records
+keep the LAST one — the end-of-run view — unless ``--all`` lists every
+one with its timestamp, which reads as a per-round phase table: how
+the critical path moved over the run.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from mxnet_tpu.telemetry.export import _timeline_lines  # noqa: E402
+from telemetry_report import expand_paths, load  # noqa: E402
+
+
+def timeline_records(records):
+    """Every timeline attribution dict in a parsed record list, oldest
+    first: the dedicated ``timeline`` records, plus any ``summary``
+    record's ``timeline`` key (a crashed run may have either)."""
+    out = []
+    for r in records:
+        if r.get('type') == 'timeline':
+            out.append((r.get('t'), {k: v for k, v in r.items()
+                                     if k not in ('type', 't', 'host')}))
+        elif r.get('type') == 'summary' and r.get('timeline'):
+            out.append((r.get('t'), r['timeline']))
+    return out
+
+
+def render(tl):
+    """One attribution dict -> the summary-table block, as a string."""
+    return '\n'.join(_timeline_lines(tl))
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Render the step-timeline block (per-host compute/'
+                    'collective/io/host-side decomposition, clock '
+                    'offsets, gating host and phase, skew) from a '
+                    'telemetry JSONL log, offline — byte-identical to '
+                    'the block the live summary table logged.')
+    ap.add_argument('paths', nargs='+',
+                    help='telemetry JSONL file(s) to render, or a gang '
+                         'log directory holding h<i>.jsonl files')
+    ap.add_argument('--json', action='store_true',
+                    help='dump the raw attribution dict(s) as JSON '
+                         'instead of the rendered block')
+    ap.add_argument('--all', action='store_true',
+                    help='render every timeline record in the log(s) — '
+                         'the per-round phase table — not just the last')
+    args = ap.parse_args(argv)
+    records = []
+    for p in expand_paths(args.paths):
+        records.extend(load(p))
+    records.sort(key=lambda r: r.get('t') or 0.0)
+    recs = timeline_records(records)
+    if not recs:
+        sys.stderr.write(
+            'timeline_report: %s hold(s) no timeline record — was the '
+            'run started with MXTPU_TELEMETRY=1 MXTPU_TIMELINE=1?\n'
+            % ', '.join(args.paths))
+        return 1
+    picked = recs if args.all else recs[-1:]
+    if args.json:
+        dicts = [r for _t, r in picked]
+        print(json.dumps(dicts[0] if len(dicts) == 1 else dicts,
+                         indent=2))
+        return 0
+    blocks = []
+    for t, tl in picked:
+        if args.all and t is not None:
+            blocks.append('== t=%s ==' % t)
+        blocks.append(render(tl))
+    print('\n'.join(blocks))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

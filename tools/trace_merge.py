@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Stitch a gang run's per-host logs into ONE offset-corrected
+Perfetto trace.
+
+Each host of a pod run writes its own telemetry JSONL (``h<i>.jsonl``
+under the gang log directory) and, when profiling is on, its own
+chrome trace — all stamped with that host's LOCAL clock. This tool
+merges them into a single chrome-trace JSON that Perfetto (or
+chrome://tracing) opens as one timeline, with one process row per host
+(``pid`` = host index) and every timestamp shifted onto host-median
+time using the per-host ``clock_offset_ms`` the timeline plane
+estimated (MXTPU_TIMELINE=1 — the LAST ``timeline`` record wins, the
+end-of-run view of the clock rings)::
+
+    python tools/trace_merge.py /mnt/run1/logs -o pod.trace.json
+
+Span records in the JSONL logs (every telemetry run has them) become
+the trace events; a host's dedicated chrome trace (MXTPU_TRACE_PATH)
+can be folded in on top with a repeatable ``--trace HOST=PATH`` — its
+events keep their names/durations but are re-stamped ``pid=HOST`` and
+shifted by that host's offset, so device lanes and telemetry spans
+line up on the same corrected clock.
+
+Without a timeline record the merge still works, with a warning and
+zero offsets — the hosts render side by side on their raw clocks.
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from telemetry_report import expand_paths, load  # noqa: E402
+
+
+def clock_offsets(record_lists):
+    """{host: offset_ms} from the LAST ``timeline`` record across the
+    logs (process 0 publishes them, so one log carries them all).
+    Empty when the run never aligned clocks (MXTPU_TIMELINE off)."""
+    last = None
+    for recs in record_lists:
+        for r in recs:
+            if r.get('type') == 'timeline' and r.get('per_host'):
+                # exit summaries on non-zero ranks are single-host and
+                # carry no offsets — only aligned rounds qualify
+                if not any(row.get('clock_offset_ms') is not None
+                           for row in r['per_host']):
+                    continue
+                if last is None or (r.get('t') or 0) >= (last.get('t') or 0):
+                    last = r
+    if last is None:
+        return {}
+    out = {}
+    for row in last['per_host']:
+        off = row.get('clock_offset_ms')
+        if row.get('host') is not None and off is not None:
+            out[int(row['host'])] = float(off)
+    return out
+
+
+def span_events(record_lists, offsets):
+    """Chrome trace events built from the JSONL ``span`` records, one
+    process row per host, timestamps shifted onto the aligned clock
+    (chrome 'ts' is microseconds; a span record's 't' is the epoch
+    stamp of the span's START — telemetry._Span emits t0)."""
+    events = []
+    for i, recs in enumerate(record_lists):
+        for r in recs:
+            if r.get('type') != 'span':
+                continue
+            t = r.get('t')
+            dur = r.get('dur_ms')
+            if not isinstance(t, (int, float)) \
+                    or not isinstance(dur, (int, float)):
+                continue
+            host = int(r.get('host', i))
+            off_s = offsets.get(host, 0.0) / 1e3
+            events.append({'name': r.get('name', '?'), 'cat': 'span',
+                           'ph': 'X', 'ts': (t - off_s) * 1e6,
+                           'dur': dur * 1e3, 'pid': host, 'tid': 0})
+    return events
+
+
+def fold_trace(path, host, offsets):
+    """Events of one host's dedicated chrome trace, re-stamped onto
+    the merged pid space and the aligned clock. Both container shapes
+    chrome emits are accepted ({'traceEvents': [...]} and a bare
+    list)."""
+    with open(path) as f:
+        doc = json.load(f)
+    raw = doc.get('traceEvents', doc) if isinstance(doc, dict) else doc
+    shift_us = offsets.get(host, 0.0) * 1e3
+    out = []
+    for ev in raw:
+        if not isinstance(ev, dict):
+            continue
+        ev = dict(ev)
+        if ev.get('ph') == 'M':
+            # metadata rows (process_name etc.) are re-emitted by the
+            # merge itself — a second, host-local copy would fight it
+            continue
+        ev['pid'] = host
+        if isinstance(ev.get('ts'), (int, float)):
+            ev['ts'] = ev['ts'] - shift_us
+        out.append(ev)
+    return out
+
+
+def merge(record_lists, traces=()):
+    """The merged chrome-trace document for per-host record lists plus
+    optional (host, chrome-trace-path) pairs."""
+    offsets = clock_offsets(record_lists)
+    events = span_events(record_lists, offsets)
+    for host, path in traces:
+        events.extend(fold_trace(path, host, offsets))
+    hosts = sorted({ev['pid'] for ev in events})
+    meta = []
+    for host in hosts:
+        label = 'host %d' % host
+        if host in offsets:
+            label += ' (offset %+.3f ms)' % offsets[host]
+        meta.append({'name': 'process_name', 'ph': 'M', 'pid': host,
+                     'args': {'name': label}})
+    events.sort(key=lambda ev: ev.get('ts', 0.0))
+    return {'traceEvents': meta + events, 'displayTimeUnit': 'ms'}, offsets
+
+
+def _parse_trace_arg(spec):
+    host, _, path = spec.partition('=')
+    try:
+        return int(host), path
+    except ValueError:
+        raise argparse.ArgumentTypeError(
+            '--trace wants HOST=PATH (e.g. 0=trace.h0.json), got %r'
+            % spec)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description='Merge a gang run\'s per-host telemetry logs (and '
+                    'optional per-host chrome traces) into one '
+                    'offset-corrected Perfetto trace, pid = host.')
+    ap.add_argument('paths', nargs='+',
+                    help='gang log directory, or the h<i>.jsonl files')
+    ap.add_argument('--trace', action='append', default=[],
+                    type=_parse_trace_arg, metavar='HOST=PATH',
+                    help='fold a host\'s dedicated chrome trace '
+                         '(MXTPU_TRACE_PATH) into its process row; '
+                         'repeatable')
+    ap.add_argument('-o', '--out', default='merged.trace.json',
+                    help='output trace file (default: %(default)s)')
+    args = ap.parse_args(argv)
+    paths = expand_paths(args.paths)
+    if not paths:
+        sys.stderr.write('trace_merge: nothing to merge\n')
+        return 1
+    record_lists = [load(p) for p in paths]
+    if not any(record_lists):
+        sys.stderr.write('trace_merge: %s hold(s) no records\n'
+                         % ', '.join(paths))
+        return 1
+    doc, offsets = merge(record_lists, traces=args.trace)
+    n_ev = sum(1 for ev in doc['traceEvents'] if ev.get('ph') != 'M')
+    if not n_ev:
+        sys.stderr.write('trace_merge: no span events found — was the '
+                         'run started with MXTPU_TELEMETRY=1?\n')
+        return 1
+    if not offsets:
+        sys.stderr.write('trace_merge: no timeline record — merging on '
+                         'raw host clocks (run with MXTPU_TIMELINE=1 '
+                         'for aligned timestamps)\n')
+    with open(args.out, 'w') as f:
+        json.dump(doc, f)
+    hosts = sorted({ev['pid'] for ev in doc['traceEvents']})
+    print('trace_merge: %d events from %d host(s) -> %s'
+          % (n_ev, len(hosts), args.out))
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())
